@@ -1,0 +1,76 @@
+"""pint_tpu — a TPU-native pulsar-timing framework built on JAX/XLA.
+
+A ground-up redesign of the capabilities of PINT (NANOGrav's pulsar timing
+package, reference: /root/reference) for TPU hardware:
+
+- The delay/phase component chain is a pure jit-compiled function
+  ``phase(params, toa_batch)`` over struct-of-array TOA batches.
+- ``numpy.longdouble`` (x87 80-bit) precision is replaced by double-double
+  float64 arithmetic (:mod:`pint_tpu.dd`, ~32 significant digits) which runs
+  on TPU, where no extended-precision type exists.
+- Design matrices come from autodiff (``jax.jacfwd``) instead of a
+  hand-written derivative registry (reference: ``timing_model.py:1910``),
+  with hand-derivative escape hatches for precision-critical columns.
+- Whole fits batch with ``vmap`` over chi^2-grid points and over pulsars and
+  shard over device meshes with ``jax.sharding``.
+
+The host-side ingest layer (``.par``/``.tim`` parsing, clock corrections,
+time-scale transforms, solar-system ephemerides) is self-contained: unlike
+the reference, this package does not depend on astropy / erfa / jplephem.
+"""
+
+import jax
+
+# Double-double arithmetic and microsecond-level time handling require real
+# float64 semantics everywhere; enable before any tracing happens.
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"
+
+# --- Physical constants -----------------------------------------------------
+# Values match the reference's unit system (src/pint/__init__.py:61-107) so
+# par files round-trip identically; all are public IAU/CODATA values.
+
+C_M_PER_S = 299792458.0  #: speed of light [m/s] (exact, SI)
+SECS_PER_DAY = 86400.0
+AU_M = 149597870700.0  #: astronomical unit [m] (IAU 2012, exact)
+AU_LS = AU_M / C_M_PER_S  #: AU in light-seconds (~499.005)
+
+#: Dispersion constant: delay[s] = DM / DMconst / freq[MHz]^2.
+#: The pulsar community fixes K == 1/2.41e-4 s MHz^2 cm^3 / pc by convention
+#: (reference src/pint/__init__.py:84-90) rather than the CODATA value.
+DM_CONST = 1.0 / 2.41e-4
+
+#: GM/c^3 for solar-system bodies in seconds ("mass in time units"), used by
+#: the Shapiro delay (reference src/pint/__init__.py:91-107).
+T_SUN_S = 4.925490947000452e-06
+T_MERCURY_S = 8.176988758e-13
+T_VENUS_S = 1.205680558e-11
+T_EARTH_S = 1.497600750e-11
+T_MARS_S = 1.589111861e-12
+T_JUPITER_S = 4.702819050e-09
+T_SATURN_S = 1.408128810e-09
+T_URANUS_S = 2.149646268e-10
+T_NEPTUNE_S = 2.536815068e-10
+
+#: Obliquity of the ecliptic at J2000 (IERS 2010 / "IERS2010" in ecliptic.dat),
+#: arcseconds; the default frame for ecliptic astrometry.
+OBLIQUITY_J2000_ARCSEC = 84381.406
+
+MJD_J2000 = 51544.5  #: J2000.0 epoch as an MJD (TT)
+DAYS_PER_JULIAN_YEAR = 365.25
+SECS_PER_JULIAN_YEAR = DAYS_PER_JULIAN_YEAR * SECS_PER_DAY
+
+from pint_tpu import dd  # noqa: E402  (re-export precision core)
+
+__all__ = [
+    "dd",
+    "C_M_PER_S",
+    "SECS_PER_DAY",
+    "AU_M",
+    "AU_LS",
+    "DM_CONST",
+    "T_SUN_S",
+    "MJD_J2000",
+    "OBLIQUITY_J2000_ARCSEC",
+]

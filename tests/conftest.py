@@ -1,0 +1,37 @@
+"""Test configuration: force an 8-virtual-device CPU platform.
+
+Multi-chip sharding is validated on a virtual CPU mesh (no multi-chip TPU
+hardware in CI); bench.py, not the tests, runs on the real chip.  The
+container's sitecustomize registers a TPU ('axon') backend at interpreter
+start, so setting env vars is not enough — the jax config must be flipped
+and any initialized backends discarded before tests import pint_tpu.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.clear_backends()
+except Exception:
+    pass
+assert jax.default_backend() == "cpu", jax.default_backend()
+assert len(jax.devices()) == 8
+
+# The dd tests use numpy longdouble as their oracle; on platforms where
+# longdouble is just float64 (ARM, MSVC) they would pass vacuously.  Same
+# guard as the reference's conftest.py:49, inverted purpose: there it
+# protected the computation, here it protects the oracle.
+import numpy as _np
+
+assert _np.finfo(_np.longdouble).eps < 2e-19, (
+    "tests need an extended-precision numpy.longdouble as oracle"
+)

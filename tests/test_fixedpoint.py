@@ -1,0 +1,97 @@
+"""Fixed-point exact phase vs exact-integer and longdouble oracles."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu import fixedpoint as fp
+
+
+rng = np.random.default_rng(7)
+
+
+def test_mul_64x64_128_vs_python_bigint():
+    a = rng.integers(-(2**62), 2**62, 5000, dtype=np.int64)
+    b = rng.integers(-(2**62), 2**62, 5000, dtype=np.int64)
+    hi, lo = jax.jit(fp.mul_64x64_128)(jnp.asarray(a), jnp.asarray(b))
+    hi = np.asarray(hi).astype(object)
+    lo = np.asarray(lo).astype(object)
+    got = hi * (2**64) + lo
+    expect = a.astype(object) * b.astype(object)
+    assert np.all(got == expect)
+
+
+def test_phase_f0_t_exact_vs_bigint():
+    """The (n, frac) pair must equal the exact rational F0_fix * t / 2^84."""
+    f0 = 716.35155687  # fastest known MSP
+    t_ticks = rng.integers(-(2**61), 2**61, 2000, dtype=np.int64)
+    n, frac = jax.jit(fp.phase_f0_t)(jnp.float64(f0), jnp.asarray(t_ticks))
+    n = np.asarray(n)
+    frac = np.asarray(frac)
+
+    f0_fix = int(round(f0 * 2**52))
+    for i in range(0, 2000, 97):
+        exact = f0_fix * int(t_ticks[i])  # python bigint, units 2^-84 turns
+        exact_turns_int = exact >> 84
+        exact_frac = (exact - (exact_turns_int << 84)) / 2**84  # in [0,1)
+        if exact_frac >= 0.5:
+            exact_turns_int += 1
+            exact_frac -= 1.0
+        assert n[i] == exact_turns_int
+        assert abs(frac[i] - exact_frac) < 1e-15
+
+
+def test_phase_precision_realistic():
+    """20 yr of TOAs at F0=716 Hz: frac phase within 1e-6 turns of the
+    longdouble oracle (the requirement that f64 and TPU-dd both fail)."""
+    f0 = np.float64(716.35155687)
+    t_sec = np.sort(rng.uniform(-3.15e8, 3.15e8, 10000))
+    t_ticks = np.round(t_sec * fp.TICKS_PER_SEC).astype(np.int64)
+
+    n, frac = jax.jit(fp.phase_f0_t)(jnp.float64(f0), jnp.asarray(t_ticks))
+
+    t_ld = t_ticks.astype(np.longdouble) / np.longdouble(2**32)
+    ph_ld = np.longdouble(f0) * t_ld
+    n_ld = np.rint(ph_ld)
+    frac_ld = (ph_ld - n_ld).astype(np.float64)
+
+    err = np.abs(np.asarray(frac) - frac_ld)
+    # f0 quantization to 2^-52 Hz costs <= 2.2e-16 Hz * 3.15e8 s = 7e-8 turns
+    assert err.max() < 1e-7, err.max()
+    assert np.array_equal(np.asarray(n), n_ld.astype(np.int64))
+
+
+def test_frac_in_range():
+    f0 = jnp.float64(61.485476554)
+    t_ticks = jnp.asarray(rng.integers(-(2**61), 2**61, 5000, dtype=np.int64))
+    _, frac = fp.phase_f0_t(f0, t_ticks)
+    f = np.asarray(frac)
+    assert np.all(f >= -0.5) and np.all(f < 0.5)
+
+
+def test_custom_jvp_derivative():
+    """d(frac)/dF0 == t seconds (mod the integer part), via jax.jacfwd."""
+    t_ticks = jnp.asarray(np.array([12345678901234, -9876543210987], dtype=np.int64))
+
+    def frac_phase(f0):
+        _, frac = fp.phase_f0_t(f0, t_ticks)
+        return frac
+
+    jac = jax.jacfwd(frac_phase)(jnp.float64(100.0))
+    t_sec = np.asarray(t_ticks, dtype=np.float64) / 2**32
+    np.testing.assert_allclose(np.asarray(jac), t_sec, rtol=1e-12)
+
+
+def test_renorm_phase():
+    n = jnp.asarray(np.array([10, -5], dtype=np.int64))
+    frac = jnp.asarray(np.array([0.4 + 3.0, -0.2 - 7.0]))
+    n2, f2 = fp.renorm_phase(n, frac)
+    np.testing.assert_array_equal(np.asarray(n2), [13, -12])
+    np.testing.assert_allclose(np.asarray(f2), [0.4, -0.2], atol=1e-12)
+
+
+def test_seconds_ticks_roundtrip():
+    sec = rng.uniform(-1e6, 1e6, 1000)
+    ticks = fp.seconds_to_ticks_f64(jnp.asarray(sec))
+    back = fp.ticks_to_seconds(ticks)
+    np.testing.assert_allclose(np.asarray(back), sec, atol=1.0 / 2**32)

@@ -1,0 +1,64 @@
+"""On-device precision audit for the real TPU chip.
+
+Run directly (no pytest): ``python tools/tpu_precision_check.py``.
+Validates the two platform assumptions pint_tpu's precision design rests on:
+
+1. int64/uint64 arithmetic is bit-exact (the fixed-point phase path);
+2. the fixed-point phase F0*t matches the host longdouble oracle to
+   <1e-6 turns at full 20-yr/4e11-turn magnitudes — the level where both
+   plain f64 and double-double-on-TPU fail (TPU f64 is ~49-bit emulated).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import pint_tpu  # noqa: F401  (enables x64)
+from pint_tpu import fixedpoint as fp
+
+
+def main():
+    print(f"backend: {jax.default_backend()}, devices: {jax.devices()}")
+    rng = np.random.default_rng(11)
+    failures = []
+
+    # 1) integer exactness
+    a = rng.integers(-(2**62), 2**62, 200000, dtype=np.int64)
+    b = rng.integers(-(2**62), 2**62, 200000, dtype=np.int64)
+    hi, lo = jax.jit(fp.mul_64x64_128)(jnp.asarray(a), jnp.asarray(b))
+    got = np.asarray(hi).astype(object) * 2**64 + np.asarray(lo).astype(object)
+    ok = bool(np.all(got == a.astype(object) * b.astype(object)))
+    print(f"int64 128-bit products exact: {ok}")
+    if not ok:
+        failures.append("mul_64x64_128")
+
+    # 2) phase precision at full magnitude
+    f0 = np.float64(716.35155687)
+    t_sec = np.sort(rng.uniform(-3.15e8, 3.15e8, 100000))
+    t_ticks = np.round(t_sec * fp.TICKS_PER_SEC).astype(np.int64)
+    n, frac = jax.jit(fp.phase_f0_t)(jnp.float64(f0), jnp.asarray(t_ticks))
+    t_ld = t_ticks.astype(np.longdouble) / np.longdouble(2**32)
+    ph_ld = np.longdouble(f0) * t_ld
+    n_ld = np.rint(ph_ld)
+    frac_ld = (ph_ld - n_ld).astype(np.float64)
+    err = float(np.max(np.abs(np.asarray(frac) - frac_ld)))
+    n_ok = bool(np.array_equal(np.asarray(n), n_ld.astype(np.int64)))
+    print(f"phase frac max err vs longdouble: {err:.3e} turns "
+          f"(limit 1e-6); integer turns exact: {n_ok}")
+    if err >= 1e-6 or not n_ok:
+        failures.append("phase_f0_t")
+
+    if failures:
+        print(f"FAIL: {failures}")
+        return 1
+    print("OK: TPU precision assumptions hold")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
