@@ -1,0 +1,130 @@
+"""Solar-system ephemerides, owned natively.
+
+The reference package reads binary JPL SPK kernels through jplephem
+(reference: src/pint/solar_system_ephemerides.py) and downloads them on
+demand.  Here:
+
+- :mod:`pint_tpu.ephem.spk` is a self-contained DAF/SPK reader
+  (numpy-only) for user-supplied JPL kernels (DE405...DE440) — full
+  JPL accuracy when a ``.bsp`` file is available.
+- :mod:`pint_tpu.ephem.analytic` is a built-in fallback: Keplerian mean
+  elements (Standish approximate elements, 1800-2050 AD) for the planets
+  and EMB plus a truncated lunar series for the Earth/EMB offset.
+  Absolute accuracy ~1e-5 AU (Earth), i.e. ~10 ms of Roemer delay — it is
+  self-consistent (simulate->fit cancels it exactly) but NOT suitable for
+  absolute timing of real data; supply a kernel for that.
+
+``get_ephemeris(name_or_path)`` resolves "builtin"/"analytic" or a path or
+a DE name searched in $PINT_TPU_EPHEM_DIR and ./ephemerides.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+class PosVel:
+    """Position [light-seconds] and velocity [ls/s] arrays, shape (..., 3).
+
+    A lean counterpart of the reference's utils.PosVel (utils.py:185):
+    plain numpy, + and - compose frames (obj/origin bookkeeping dropped —
+    callers here always work SSB-relative).
+    """
+
+    __slots__ = ("pos", "vel")
+
+    def __init__(self, pos, vel):
+        self.pos = np.asarray(pos, dtype=np.float64)
+        self.vel = np.asarray(vel, dtype=np.float64)
+
+    def __add__(self, other):
+        return PosVel(self.pos + other.pos, self.vel + other.vel)
+
+    def __sub__(self, other):
+        return PosVel(self.pos - other.pos, self.vel - other.vel)
+
+    def __neg__(self):
+        return PosVel(-self.pos, -self.vel)
+
+
+class Ephemeris:
+    """Abstract ephemeris: body posvel wrt the solar-system barycenter."""
+
+    name = "abstract"
+
+    #: bodies every backend must serve
+    BODIES = (
+        "sun",
+        "earth",
+        "moon",
+        "mercury",
+        "venus",
+        "mars",
+        "jupiter",
+        "saturn",
+        "uranus",
+        "neptune",
+    )
+
+    def posvel_ssb(self, body: str, tdb_sec_j2000) -> PosVel:
+        """Body posvel wrt SSB at TDB seconds since J2000 (float64 array),
+        in light-seconds / ls-per-second, ICRS-equatorial axes."""
+        raise NotImplementedError
+
+
+_cache: dict = {}
+
+
+def get_ephemeris(name: str = "builtin") -> Ephemeris:
+    key = (name or "builtin").lower()
+    if key in _cache:
+        return _cache[key]
+    if key in ("builtin", "analytic", "none", ""):
+        from pint_tpu.ephem.analytic import AnalyticEphemeris
+
+        eph = AnalyticEphemeris()
+    else:
+        path = _find_kernel(name)
+        if path is None:
+            import warnings
+
+            warnings.warn(
+                f"ephemeris '{name}' not found locally; falling back to the "
+                "builtin analytic ephemeris (absolute accuracy ~1e-5 AU). "
+                "Place the kernel at $PINT_TPU_EPHEM_DIR/<name>.bsp for "
+                "JPL accuracy."
+            )
+            from pint_tpu.ephem.analytic import AnalyticEphemeris
+
+            # do NOT cache the fallback under the kernel's name — a kernel
+            # dropped into place later in the process must take effect
+            return AnalyticEphemeris()
+        from pint_tpu.ephem.spk import SPKEphemeris
+
+        eph = SPKEphemeris(path)
+    _cache[key] = eph
+    return eph
+
+
+def _find_kernel(name: str):
+    # exact path first (case preserved — filesystems are case-sensitive)
+    if os.path.exists(name):
+        return name
+    lname = name.lower()
+    candidates = []
+    for d in (os.environ.get("PINT_TPU_EPHEM_DIR"), "ephemerides", "."):
+        if d:
+            for n in (name, lname, name.upper()):
+                candidates += [os.path.join(d, n + ".bsp"), os.path.join(d, n)]
+    for c in candidates:
+        if os.path.exists(c):
+            return c
+    return None
+
+
+def body_posvel_ssb(body, ticks, ephem="builtin") -> PosVel:
+    """Convenience: posvel at int64 device ticks (2^-32 s since J2000 TDB)."""
+    tdb_sec = np.asarray(ticks, dtype=np.float64) / 2**32
+    return get_ephemeris(ephem).posvel_ssb(body, tdb_sec)

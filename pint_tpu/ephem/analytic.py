@@ -1,0 +1,209 @@
+"""Built-in analytic solar-system ephemeris (no data files).
+
+Keplerian propagation from the Standish (JPL) approximate mean elements,
+valid 1800-2050 AD (public table), heliocentric ecliptic-J2000; the Sun's
+own motion about the SSB is recovered from the mass-weighted planet sum;
+the Earth is offset from the EMB by a truncated Meeus-style lunar series.
+
+Accuracy (vs DE):  EMB ~1e-5 AU (planetary perturbations are not modeled),
+Earth/EMB offset ~10 km, outer planets ~1e-4 AU.  In Roemer-delay terms
+that is ~10 ms absolute — fine for self-consistent simulate->fit work and
+geometry-insensitive paths (Shapiro, solar-wind angles), NOT for absolute
+timing against real data (supply an SPK kernel; see pint_tpu.ephem).
+
+All angles in radians internally; positions returned in light-seconds,
+ICRS-equatorial axes (rotated from ecliptic by the J2000 obliquity).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pint_tpu import AU_LS, OBLIQUITY_J2000_ARCSEC
+from pint_tpu.ephem import Ephemeris, PosVel
+
+_DEG = np.pi / 180.0
+
+# Standish approximate elements (1800-2050): a[AU], e, i[deg], L[deg],
+# varpi[deg], Omega[deg] + per-julian-century rates.  Public JPL table.
+_ELEMENTS = {
+    "mercury": (
+        (0.38709927, 0.20563593, 7.00497902, 252.25032350, 77.45779628, 48.33076593),
+        (0.00000037, 0.00001906, -0.00594749, 149472.67411175, 0.16047689, -0.12534081),
+    ),
+    "venus": (
+        (0.72333566, 0.00677672, 3.39467605, 181.97909950, 131.60246718, 76.67984255),
+        (0.00000390, -0.00004107, -0.00078890, 58517.81538729, 0.00268329, -0.27769418),
+    ),
+    "emb": (
+        (1.00000261, 0.01671123, -0.00001531, 100.46457166, 102.93768193, 0.0),
+        (0.00000562, -0.00004392, -0.01294668, 35999.37244981, 0.32327364, 0.0),
+    ),
+    "mars": (
+        (1.52371034, 0.09339410, 1.84969142, -4.55343205, -23.94362959, 49.55953891),
+        (0.00001847, 0.00007882, -0.00813131, 19140.30268499, 0.44441088, -0.29257343),
+    ),
+    "jupiter": (
+        (5.20288700, 0.04838624, 1.30439695, 34.39644051, 14.72847983, 100.47390909),
+        (-0.00011607, -0.00013253, -0.00183714, 3034.74612775, 0.21252668, 0.20469106),
+    ),
+    "saturn": (
+        (9.53667594, 0.05386179, 2.48599187, 49.95424423, 92.59887831, 113.66242448),
+        (-0.00125060, -0.00050991, 0.00193609, 1222.49362201, -0.41897216, -0.28867794),
+    ),
+    "uranus": (
+        (19.18916464, 0.04725744, 0.77263783, 313.23810451, 170.95427630, 74.01692503),
+        (-0.00196176, -0.00004397, -0.00242939, 428.48202785, 0.40805281, 0.04240589),
+    ),
+    "neptune": (
+        (30.06992276, 0.00859048, 1.77004347, -55.12002969, 44.96476227, 131.78422574),
+        (0.00026291, 0.00005105, 0.00035372, 218.45945325, -0.32241464, -0.06027121),
+    ),
+}
+
+# 1 / (mass in solar masses); IAU values.
+_INV_MASS = {
+    "mercury": 6023600.0,
+    "venus": 408523.71,
+    "emb": 328900.56,
+    "mars": 3098708.0,
+    "jupiter": 1047.3486,
+    "saturn": 3497.898,
+    "uranus": 22902.98,
+    "neptune": 19412.24,
+}
+
+_EARTH_MOON_MASS_RATIO = 81.30056  # M_earth / M_moon
+
+
+def _kepler_E(M, e, iters=10):
+    """Solve Kepler's equation E - e sin E = M (Newton, fixed iterations)."""
+    E = M + e * np.sin(M)
+    for _ in range(iters):
+        E = E - (E - e * np.sin(E) - M) / (1.0 - e * np.cos(E))
+    return E
+
+
+def _helio_ecliptic_au(body, T):
+    """Heliocentric ecliptic-J2000 position [AU] for julian centuries T."""
+    el0, el1 = _ELEMENTS[body]
+    a = el0[0] + el1[0] * T
+    e = el0[1] + el1[1] * T
+    inc = (el0[2] + el1[2] * T) * _DEG
+    L = (el0[3] + el1[3] * T) * _DEG
+    varpi = (el0[4] + el1[4] * T) * _DEG
+    Om = (el0[5] + el1[5] * T) * _DEG
+
+    M = np.mod(L - varpi + np.pi, 2 * np.pi) - np.pi
+    w = varpi - Om
+    E = _kepler_E(M, e)
+    xp = a * (np.cos(E) - e)
+    yp = a * np.sqrt(1.0 - e * e) * np.sin(E)
+
+    cw, sw = np.cos(w), np.sin(w)
+    cO, sO = np.cos(Om), np.sin(Om)
+    ci, si = np.cos(inc), np.sin(inc)
+    x = (cw * cO - sw * sO * ci) * xp + (-sw * cO - cw * sO * ci) * yp
+    y = (cw * sO + sw * cO * ci) * xp + (-sw * sO + cw * cO * ci) * yp
+    z = (sw * si) * xp + (cw * si) * yp
+    return np.stack([x, y, z], axis=-1)
+
+
+def _moon_geocentric_au(T):
+    """Geocentric ecliptic lunar position [AU], truncated Meeus series
+    (~0.1 deg; enters only via the 4670-km EMB->Earth offset)."""
+    d = T * 36525.0  # days since J2000
+    Lp = (218.3164477 + 13.17639648 * d) * _DEG  # mean longitude
+    D = (297.8501921 + 12.19074912 * d) * _DEG  # mean elongation
+    Mp = (134.9633964 + 13.06499295 * d) * _DEG  # moon mean anomaly
+    Ms = (357.5291092 + 0.98560028 * d) * _DEG  # sun mean anomaly
+    F = (93.2720950 + 13.22935024 * d) * _DEG  # argument of latitude
+
+    lon = Lp + _DEG * (
+        6.288774 * np.sin(Mp)
+        + 1.274027 * np.sin(2 * D - Mp)
+        + 0.658314 * np.sin(2 * D)
+        + 0.213618 * np.sin(2 * Mp)
+        - 0.185116 * np.sin(Ms)
+        - 0.114332 * np.sin(2 * F)
+    )
+    lat = _DEG * (
+        5.128122 * np.sin(F)
+        + 0.280602 * np.sin(Mp + F)
+        + 0.277693 * np.sin(Mp - F)
+    )
+    r_km = (
+        385000.56
+        - 20905.355 * np.cos(Mp)
+        - 3699.111 * np.cos(2 * D - Mp)
+        - 2955.968 * np.cos(2 * D)
+    )
+    r_au = r_km / 149597870.7
+    cl, sl = np.cos(lon), np.sin(lon)
+    cb, sb = np.cos(lat), np.sin(lat)
+    return np.stack([r_au * cb * cl, r_au * cb * sl, r_au * sb], axis=-1)
+
+
+_ECL = OBLIQUITY_J2000_ARCSEC / 3600.0 * _DEG
+_ECL_TO_EQ = np.array(
+    [
+        [1.0, 0.0, 0.0],
+        [0.0, np.cos(_ECL), -np.sin(_ECL)],
+        [0.0, np.sin(_ECL), np.cos(_ECL)],
+    ]
+)
+
+
+class AnalyticEphemeris(Ephemeris):
+    name = "builtin"
+
+    def __init__(self):
+        # memo of recent time arrays -> all-body positions; callers ask for
+        # several bodies at identical epochs (earth, sun, planets for
+        # Shapiro), and velocities need t-h/t/t+h — without this every
+        # body costs 3 full solar-system sweeps.
+        self._memo: dict = {}
+        self._memo_order: list = []
+
+    def _positions_cached(self, tdb_sec):
+        key = (tdb_sec.shape, hash(tdb_sec.tobytes()))
+        hit = self._memo.get(key)
+        if hit is not None:
+            return hit
+        out = self._positions_au(tdb_sec)
+        self._memo[key] = out
+        self._memo_order.append(key)
+        if len(self._memo_order) > 8:
+            self._memo.pop(self._memo_order.pop(0), None)
+        return out
+
+    def _positions_au(self, tdb_sec):
+        """dict of body -> SSB ecliptic positions [AU] at tdb_sec (arr)."""
+        T = np.asarray(tdb_sec, dtype=np.float64) / (86400.0 * 36525.0)
+        helio = {b: _helio_ecliptic_au(b, T) for b in _ELEMENTS}
+        # SSB offset: sum m_b r_b / M_total (heliocentric)
+        masses = {b: 1.0 / _INV_MASS[b] for b in _ELEMENTS}
+        mtot = 1.0 + sum(masses.values())
+        ssb_from_sun = sum(masses[b] * helio[b] for b in _ELEMENTS) / mtot
+        out = {"sun": -ssb_from_sun}
+        for b in _ELEMENTS:
+            out[b] = helio[b] - ssb_from_sun
+        moon_geo = _moon_geocentric_au(T)
+        # EMB = Earth + m_moon/(m_e+m_moon) * r_moon_geo
+        f = 1.0 / (1.0 + _EARTH_MOON_MASS_RATIO)
+        out["earth"] = out["emb"] - f * moon_geo
+        out["moon"] = out["earth"] + moon_geo
+        return out
+
+    def posvel_ssb(self, body, tdb_sec_j2000):
+        body = body.lower()
+        t = np.asarray(tdb_sec_j2000, dtype=np.float64)
+        # velocity by central difference (30 s step): error ~ a*h^2/6
+        # ~1e-13 AU/s^2 * 150 -> far below the mean-element model error
+        h = 30.0
+        p0 = self._positions_cached(t)[body]
+        pm = self._positions_cached(t - h)[body]
+        pp = self._positions_cached(t + h)[body]
+        pos = p0 @ _ECL_TO_EQ.T * AU_LS
+        vel = (pp - pm) @ _ECL_TO_EQ.T * (AU_LS / (2.0 * h))
+        return PosVel(pos, vel)

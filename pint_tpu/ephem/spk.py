@@ -1,0 +1,174 @@
+"""Self-contained JPL SPK (DAF) binary kernel reader, numpy-only.
+
+Replaces the reference's jplephem dependency (reference:
+src/pint/solar_system_ephemerides.py loads DE405..DE440 through jplephem).
+Implements the NAIF DAF container and SPK data types 2 (Chebyshev
+position) and 3 (Chebyshev position+velocity) — the only types JPL DE
+planetary kernels use.  Format per NAIF's public DAF/SPK Required Reading.
+
+Evaluation is vectorized numpy (host-side ingest); times are TDB seconds
+since J2000 — exactly the framework's native time coordinate.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_KM_PER_LS = 299792.458
+
+_NAIF_ID = {
+    "sun": 10,
+    "mercury": 1,
+    "venus": 2,
+    "emb": 3,
+    "earth": 399,
+    "moon": 301,
+    "mars": 4,
+    "jupiter": 5,
+    "saturn": 6,
+    "uranus": 7,
+    "neptune": 8,
+    "pluto": 9,
+}
+
+
+class _Segment:
+    __slots__ = ("start_et", "end_et", "target", "center", "frame",
+                 "data_type", "init", "intlen", "rsize", "n", "coeffs")
+
+    def __init__(self, start_et, end_et, target, center, frame, data_type,
+                 words):
+        self.start_et = start_et
+        self.end_et = end_et
+        self.target = target
+        self.center = center
+        self.frame = frame
+        self.data_type = data_type
+        init, intlen, rsize, n = words[-4:]
+        self.init = init
+        self.intlen = intlen
+        self.rsize = int(rsize)
+        self.n = int(n)
+        ncomp = 3 if data_type == 2 else 6
+        ncoef = (self.rsize - 2) // ncomp
+        recs = words[: self.rsize * self.n].reshape(self.n, self.rsize)
+        # per record: MID, RADIUS, then ncomp blocks of ncoef coefficients
+        self.coeffs = (
+            recs[:, 2:].reshape(self.n, ncomp, ncoef),
+            recs[:, 0],
+            recs[:, 1],
+        )
+
+    def eval(self, et):
+        """Position [km] and velocity [km/s] at TDB seconds since J2000."""
+        et = np.atleast_1d(np.asarray(et, dtype=np.float64))
+        coeffs, mid, radius = self.coeffs
+        if np.any(et < self.start_et) or np.any(et > self.end_et):
+            raise ValueError(
+                f"epoch outside SPK segment coverage "
+                f"[{self.start_et}, {self.end_et}] (target {self.target})"
+            )
+        idx = np.floor((et - self.init) / self.intlen).astype(np.int64)
+        idx = np.clip(idx, 0, self.n - 1)  # et == end_et lands in last record
+        m = mid[idx]
+        r = radius[idx]
+        x = (et - m) / r
+        c = coeffs[idx]  # (nt, ncomp, ncoef)
+        ncoef = c.shape[-1]
+        # Chebyshev via recurrence; also derivative polynomials
+        T = np.zeros((ncoef,) + x.shape)
+        U = np.zeros((ncoef,) + x.shape)
+        T[0] = 1.0
+        U[0] = 0.0
+        if ncoef > 1:
+            T[1] = x
+            U[1] = 1.0
+        for k in range(2, ncoef):
+            T[k] = 2.0 * x * T[k - 1] - T[k - 2]
+            U[k] = 2.0 * x * U[k - 1] + 2.0 * T[k - 1] - U[k - 2]
+        if self.data_type == 2:
+            pos = np.einsum("tck,kt->tc", c, T)
+            vel = np.einsum("tck,kt->tc", c, U) / r[:, None]
+        else:  # type 3: explicit velocity coefficient blocks
+            pos = np.einsum("tck,kt->tc", c[:, 0:3], T)
+            vel = np.einsum("tck,kt->tc", c[:, 3:6], T)
+        return pos, vel
+
+
+class SPKEphemeris:
+    """Reader/evaluator for a JPL SPK kernel; posvel in light-seconds."""
+
+    def __init__(self, path):
+        self.name = path
+        with open(path, "rb") as f:
+            data = f.read()
+        locfmt = data[88:96]
+        endian = "<" if locfmt == b"LTL-IEEE" else ">"
+        if data[:8] not in (b"DAF/SPK ", b"NAIF/DAF"):
+            raise ValueError(f"{path}: not a DAF/SPK file")
+        i4 = np.dtype(endian + "i4")
+        f8 = np.dtype(endian + "f8")
+        nd = int(np.frombuffer(data[8:12], i4)[0])
+        ni = int(np.frombuffer(data[12:16], i4)[0])
+        fward = int(np.frombuffer(data[76:80], i4)[0])
+        ss = nd + (ni + 1) // 2  # summary size in doubles
+        self.segments = []
+        rec = fward
+        while rec > 0:
+            base = (rec - 1) * 1024
+            ctrl = np.frombuffer(data[base : base + 24], f8)
+            nxt, _prev, nsum = int(ctrl[0]), int(ctrl[1]), int(ctrl[2])
+            for k in range(nsum):
+                off = base + 24 + k * ss * 8
+                dbl = np.frombuffer(data[off : off + nd * 8], f8)
+                ints = np.frombuffer(
+                    data[off + nd * 8 : off + ss * 8], i4
+                )[:ni]
+                target, center, frame, dtype_, start_w, end_w = (
+                    int(v) for v in ints
+                )
+                if dtype_ not in (2, 3):
+                    continue
+                words = np.frombuffer(
+                    data[(start_w - 1) * 8 : end_w * 8], f8
+                ).copy()
+                self.segments.append(
+                    _Segment(dbl[0], dbl[1], target, center, frame,
+                             dtype_, words)
+                )
+            rec = nxt
+        self._by_target = {}
+        for seg in self.segments:
+            self._by_target.setdefault(seg.target, []).append(seg)
+
+    def _posvel_wrt_center(self, target, et):
+        segs = self._by_target.get(target)
+        if not segs:
+            raise KeyError(f"no SPK segment for NAIF id {target}")
+        # pick the segment covering the requested span (merged kernels can
+        # carry several per target); require one segment to cover all epochs
+        lo, hi = float(np.min(et)), float(np.max(et))
+        for seg in segs:
+            if seg.start_et <= lo and hi <= seg.end_et:
+                pos, vel = seg.eval(et)
+                return pos, vel, seg.center
+        raise ValueError(
+            f"no single SPK segment for NAIF id {target} covers "
+            f"[{lo}, {hi}]; available: "
+            + ", ".join(f"[{s.start_et}, {s.end_et}]" for s in segs)
+        )
+
+    def posvel_ssb(self, body, tdb_sec_j2000):
+        from pint_tpu.ephem import PosVel
+
+        et = np.atleast_1d(np.asarray(tdb_sec_j2000, dtype=np.float64))
+        target = _NAIF_ID[body.lower()]
+        pos = np.zeros(et.shape + (3,))
+        vel = np.zeros(et.shape + (3,))
+        # chain target -> center -> ... -> SSB (0)
+        while target != 0:
+            p, v, center = self._posvel_wrt_center(target, et)
+            pos += p
+            vel += v
+            target = center
+        return PosVel(pos / _KM_PER_LS, vel / _KM_PER_LS)

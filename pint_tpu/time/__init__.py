@@ -1,0 +1,24 @@
+"""Host-side precision time layer.
+
+The reference package delegates time scales to astropy/erfa (C extensions);
+this framework owns them natively.  Everything here is *host-side ingest*
+(runs once per dataset, in numpy longdouble / exact python integers) and
+produces int64 tick arrays (2^-32 s since MJD 51544.5 TDB) for the device.
+
+Accuracy notes are in :mod:`pint_tpu.time.scales`.
+"""
+
+from pint_tpu.time.mjd import (  # noqa: F401
+    MJD_EPOCH_TICKS,
+    mjd_string_to_day_frac,
+    mjd_to_ticks_utc,
+    mjd_to_ticks_tdb,
+    mjd_float_to_ticks_tdb,
+    ticks_to_mjd_tdb,
+    ticks_to_mjd_string_tdb,
+)
+from pint_tpu.time.scales import (  # noqa: F401
+    tai_minus_utc,
+    tdb_minus_tt_seconds,
+    TT_MINUS_TAI,
+)

@@ -1,0 +1,198 @@
+"""Ephemeris layer: builtin analytic sanity + SPK reader vs synthetic kernel."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from pint_tpu import AU_LS
+from pint_tpu.ephem import body_posvel_ssb, get_ephemeris
+from pint_tpu.ephem.spk import SPKEphemeris
+
+
+SEC_PER_YR = 365.25 * 86400
+
+
+class TestAnalytic:
+    def test_earth_distance_and_period(self):
+        t = np.arange(0, 366) * 86400.0
+        eph = get_ephemeris("builtin")
+        # orbit shape is heliocentric: subtract the Sun's SSB wobble
+        r = (
+            np.linalg.norm(
+                eph.posvel_ssb("earth", t).pos - eph.posvel_ssb("sun", t).pos,
+                axis=-1,
+            )
+            / AU_LS
+        )
+        assert abs(r.min() - 0.9833) < 2e-3
+        assert abs(r.max() - 1.0167) < 2e-3
+        # perihelion within ~5 days of Jan 3-4 (J2000 starts Jan 1.5)
+        assert np.argmin(r) < 10 or np.argmin(r) > 355
+
+    def test_earth_speed(self):
+        pv = get_ephemeris("builtin").posvel_ssb("earth", np.array([0.0]))
+        v_km_s = np.linalg.norm(pv.vel, axis=-1)[0] * 299792.458
+        assert abs(v_km_s - 29.8) < 1.5
+
+    def test_velocity_consistency(self):
+        # finite-difference positions over 1000 s vs reported velocity
+        eph = get_ephemeris("builtin")
+        t0 = 3.0e8
+        p0 = eph.posvel_ssb("earth", np.array([t0]))
+        p1 = eph.posvel_ssb("earth", np.array([t0 + 1000.0]))
+        pm = eph.posvel_ssb("earth", np.array([t0 + 500.0]))  # midpoint
+        v_fd = (p1.pos - p0.pos) / 1000.0
+        np.testing.assert_allclose(v_fd, pm.vel, rtol=1e-6, atol=1e-12)
+
+    def test_sun_near_ssb(self):
+        # Sun stays within ~0.01 AU of the SSB (Jupiter dominates)
+        t = np.linspace(0, 12 * SEC_PER_YR, 50)
+        pv = get_ephemeris("builtin").posvel_ssb("sun", t)
+        r = np.linalg.norm(pv.pos, axis=-1) / AU_LS
+        assert np.all(r < 0.02)
+        assert np.max(r) > 0.002
+
+    def test_moon_earth_offset(self):
+        eph = get_ephemeris("builtin")
+        t = np.array([1.0e8])
+        d = eph.posvel_ssb("moon", t).pos - eph.posvel_ssb("earth", t).pos
+        r_km = np.linalg.norm(d) * 299792.458
+        assert 356000 < r_km < 407000
+
+    def test_jupiter_distance(self):
+        pv = get_ephemeris("builtin").posvel_ssb("jupiter", np.array([0.0]))
+        r = np.linalg.norm(pv.pos) / AU_LS
+        assert 4.9 < r < 5.5
+
+    def test_earth_in_ecliptic_equatorial_frame(self):
+        # in ICRS-equatorial axes the Earth's z amplitude ~ sin(23.44 deg)
+        t = np.linspace(0, SEC_PER_YR, 100)
+        pv = get_ephemeris("builtin").posvel_ssb("earth", t)
+        zmax = np.max(np.abs(pv.pos[:, 2])) / AU_LS
+        assert abs(zmax - np.sin(np.deg2rad(23.4392911))) < 0.01
+
+    def test_ticks_api(self):
+        pv = body_posvel_ssb("earth", np.array([0], dtype=np.int64))
+        assert pv.pos.shape == (1, 3)
+
+
+def _write_synthetic_spk(path, segments):
+    """Minimal valid little-endian DAF/SPK writer for tests.
+
+    segments: list of (target, center, data_type, init, intlen, records)
+    where records is (n, rsize) float64: [mid, radius, coeffs...].
+    """
+    nd, ni = 2, 6
+    ss = nd + (ni + 1) // 2  # 5 doubles per summary
+    # layout: rec1 file record, rec2 summary record, rec3 name record,
+    # data from rec4 (word 385)
+    word = 385
+    seg_meta = []
+    data_words = []
+    for (target, center, dtype_, init, intlen, records) in segments:
+        n, rsize = records.shape
+        words = list(records.reshape(-1)) + [init, intlen, float(rsize), float(n)]
+        start_w = word
+        end_w = word + len(words) - 1
+        start_et = init
+        end_et = init + intlen * n
+        seg_meta.append((start_et, end_et, target, center, 1, dtype_, start_w, end_w))
+        data_words += words
+        word = end_w + 1
+
+    frec = bytearray(1024)
+    frec[0:8] = b"DAF/SPK "
+    struct.pack_into("<ii", frec, 8, nd, ni)
+    frec[16:76] = b"synthetic".ljust(60)
+    struct.pack_into("<iii", frec, 76, 2, 2, word)  # fward, bward, free
+    frec[88:96] = b"LTL-IEEE"
+
+    srec = bytearray(1024)
+    struct.pack_into("<ddd", srec, 0, 0.0, 0.0, float(len(seg_meta)))
+    for k, (s, e, t, c, f, dt, sw, ew) in enumerate(seg_meta):
+        off = 24 + k * ss * 8
+        struct.pack_into("<dd", srec, off, s, e)
+        struct.pack_into("<iiiiii", srec, off + 16, t, c, f, dt, sw, ew)
+
+    nrec = bytearray(1024)  # segment names, unused by reader
+
+    body = b"".join(struct.pack("<d", w) for w in data_words)
+    pad = (-len(body)) % 1024
+    with open(path, "wb") as fh:
+        fh.write(bytes(frec) + bytes(srec) + bytes(nrec) + body + b"\0" * pad)
+
+
+class TestSPK:
+    def test_type2_chebyshev_roundtrip(self, tmp_path):
+        """Kernel with known Chebyshev coeffs: eval must reproduce them."""
+        # segment: sun (10) wrt SSB (0), 2 records of 100000 s
+        # x(t) = 100 + 50*T1(x) + 10*T2(x); y, z similar
+        rec = np.zeros((2, 2 + 3 * 4))
+        for i in range(2):
+            mid = 50000.0 + i * 100000.0
+            rec[i, 0] = mid
+            rec[i, 1] = 50000.0
+            rec[i, 2:6] = [100.0 + i, 50.0, 10.0, 0.0]  # x coeffs
+            rec[i, 6:10] = [-20.0, 5.0, 0.0, 1.0]  # y coeffs
+            rec[i, 10:14] = [7.0, 0.0, 0.0, 0.0]  # z coeffs
+        p = tmp_path / "test.bsp"
+        _write_synthetic_spk(str(p), [(10, 0, 2, 0.0, 100000.0, rec)])
+        eph = SPKEphemeris(str(p))
+
+        # at record 0 center: x=-1 -> wait, et=50000 -> x=0: T=[1,0,-1,0]
+        pv = eph.posvel_ssb("sun", np.array([50000.0]))
+        km = pv.pos[0] * 299792.458
+        np.testing.assert_allclose(km, [100 - 10, -20 - 0, 7.0], atol=1e-9)
+        # at et=100000 (x=+1): sums of coeffs
+        pv = eph.posvel_ssb("sun", np.array([100000.0 - 1e-6]))
+        km = pv.pos[0] * 299792.458
+        np.testing.assert_allclose(km, [160.0, -14.0, 7.0], atol=1e-3)
+        # velocity: dx/det at x=0: (50*1 + 10*(4*0) + 0)/radius...
+        # d/dx [c0 + c1 T1 + c2 T2 + c3 T3] = c1 + 4 c2 x + c3(12x^2-3)
+        pv = eph.posvel_ssb("sun", np.array([50000.0]))
+        vkm = pv.vel[0] * 299792.458
+        np.testing.assert_allclose(
+            vkm, np.array([50.0, 5.0 - 3.0, 0.0]) / 50000.0, atol=1e-12
+        )
+
+    def test_chain_earth_through_emb(self, tmp_path):
+        """earth(399 wrt 3) + emb(3 wrt 0) chain must add."""
+        const = lambda x, y, z: np.array([[5e4, 5e4, x, 0, 0, y, 0, 0, z, 0, 0]])
+        rec_emb = np.array([[5e4, 5e4, 1000.0, 0, 0, 2000.0, 0, 0, 0.0, 0, 0]])
+        rec_earth = np.array([[5e4, 5e4, 1.0, 0, 0, -2.0, 0, 0, 3.0, 0, 0]])
+        p = tmp_path / "chain.bsp"
+        _write_synthetic_spk(
+            str(p),
+            [(3, 0, 2, 0.0, 100000.0, rec_emb), (399, 3, 2, 0.0, 100000.0, rec_earth)],
+        )
+        eph = SPKEphemeris(str(p))
+        pv = eph.posvel_ssb("earth", np.array([50000.0]))
+        np.testing.assert_allclose(
+            pv.pos[0] * 299792.458, [1001.0, 1998.0, 3.0], atol=1e-9
+        )
+
+    def test_type3_velocity_blocks(self, tmp_path):
+        rec = np.zeros((1, 2 + 6 * 2))
+        rec[0, 0] = 5e4
+        rec[0, 1] = 5e4
+        rec[0, 2:4] = [10.0, 1.0]  # x: 10 + T1
+        rec[0, 4:6] = [20.0, 0.0]
+        rec[0, 6:8] = [30.0, 0.0]
+        rec[0, 8:10] = [0.5, 0.0]  # vx = 0.5 km/s
+        rec[0, 10:12] = [0.0, 0.0]
+        rec[0, 12:14] = [0.0, 0.0]
+        p = tmp_path / "t3.bsp"
+        _write_synthetic_spk(str(p), [(10, 0, 3, 0.0, 100000.0, rec)])
+        eph = SPKEphemeris(str(p))
+        pv = eph.posvel_ssb("sun", np.array([75000.0]))  # x = 0.5
+        np.testing.assert_allclose(
+            pv.pos[0] * 299792.458, [10.5, 20.0, 30.0], atol=1e-9
+        )
+        np.testing.assert_allclose(pv.vel[0] * 299792.458, [0.5, 0, 0], atol=1e-12)
+
+    def test_bad_file_rejected(self, tmp_path):
+        p = tmp_path / "junk.bsp"
+        p.write_bytes(b"not a kernel" * 100)
+        with pytest.raises(ValueError):
+            SPKEphemeris(str(p))

@@ -1,0 +1,88 @@
+"""Time layer: exact MJD parsing, scale chain, round trips."""
+
+import numpy as np
+import pytest
+
+from pint_tpu.time import mjd as M
+from pint_tpu.time import scales as S
+
+
+def test_mjd_string_parse_exact():
+    d, n, den = M.mjd_string_to_day_frac("53478.2858714192189")
+    assert (d, n, den) == (53478, 2858714192189, 10**13)
+    d, n, den = M.mjd_string_to_day_frac("53750")
+    assert (d, n, den) == (53750, 0, 1)
+    d, n, den = M.mjd_string_to_day_frac("  53750.000000 ")
+    assert d == 53750 and n == 0
+    # Fortran D exponent (par files): -1.181D-15
+    d, n, den = M.mjd_string_to_day_frac("-1.181D-15")
+    assert d == -1  # floor
+    assert n / den == pytest.approx(1 - 1.181e-15, abs=1e-30)
+
+
+def test_tdb_ticks_roundtrip_string():
+    s = "53801.38605120074849"
+    d, n, den = M.mjd_string_to_day_frac(s)
+    t = M.mjd_to_ticks_tdb(d, n, den)
+    out = M.ticks_to_mjd_string_tdb(t, ndigits=14)
+    assert out == s[: len(out)]
+
+
+def test_tdb_ticks_exactness():
+    # epoch itself
+    assert M.mjd_to_ticks_tdb(51544, 5, 10) == 0
+    # one day later: 86400 s in ticks
+    assert M.mjd_to_ticks_tdb(51545, 5, 10) == 86400 * 2**32
+    # half-day grid
+    assert M.mjd_to_ticks_tdb(51545, 0, 1) == 43200 * 2**32
+
+
+def test_leap_seconds():
+    assert S.tai_minus_utc(57754) == 37.0
+    assert S.tai_minus_utc(57753) == 36.0
+    assert S.tai_minus_utc(50630) == 31.0
+    assert S.tai_minus_utc(41317) == 10.0
+    np.testing.assert_array_equal(
+        S.tai_minus_utc(np.array([44239, 44785, 44786])), [19.0, 19.0, 20.0]
+    )
+    with pytest.raises(ValueError):
+        S.tai_minus_utc(41000)
+
+
+def test_utc_chain_offsets():
+    # A UTC MJD in 2005 (TAI-UTC=32): TT - UTC = 64.184 s
+    d, n, den = M.mjd_string_to_day_frac("53478.0")
+    t_utc = M.mjd_to_ticks_utc(d, n, den)
+    t_tdb_same_label = M.mjd_to_ticks_tdb(d, n, den)
+    diff_sec = (t_utc - t_tdb_same_label) / 2**32
+    # TT-UTC = 64.184; TDB-TT is < 2 ms
+    assert abs(diff_sec - 64.184) < 0.002
+
+
+def test_tdb_minus_tt_magnitude_and_period():
+    # annual term dominates: amplitude ~1.657 ms, zero crossings twice/yr
+    t = np.arange(0, 366) * 86400.0
+    v = S.tdb_minus_tt_seconds(t)
+    assert np.max(np.abs(v)) < 2e-3
+    assert np.max(v) > 1.2e-3 and np.min(v) < -1.2e-3
+    # scalar input returns scalar
+    assert np.isscalar(S.tdb_minus_tt_seconds(0.0))
+
+
+def test_mjd_float_to_ticks():
+    t = M.mjd_float_to_ticks_tdb(np.array([51544.5, 51545.5]))
+    np.testing.assert_array_equal(t, [0, 86400 * 2**32])
+
+
+def test_ticks_to_mjd_tdb_vector():
+    ticks = np.array([0, 86400 * 2**32, -43200 * 2**32], dtype=np.int64)
+    day, frac = M.ticks_to_mjd_tdb(ticks)
+    np.testing.assert_array_equal(day, [51544, 51545, 51544])
+    np.testing.assert_allclose(frac.astype(float), [0.5, 0.5, 0.0], atol=1e-18)
+
+
+def test_clock_offset_applied():
+    d, n, den = M.mjd_string_to_day_frac("53478.0")
+    t0 = M.mjd_to_ticks_utc(d, n, den, clock_offset_sec=0.0)
+    t1 = M.mjd_to_ticks_utc(d, n, den, clock_offset_sec=1e-6)
+    assert abs((t1 - t0) / 2**32 - 1e-6) < 1e-9
