@@ -166,7 +166,7 @@ class AnalyticEphemeris(Ephemeris):
         self._memo_order: list = []
 
     def _positions_cached(self, tdb_sec):
-        key = (tdb_sec.shape, hash(tdb_sec.tobytes()))
+        key = (tdb_sec.shape, tdb_sec.tobytes())
         hit = self._memo.get(key)
         if hit is not None:
             return hit

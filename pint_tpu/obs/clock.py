@@ -1,0 +1,152 @@
+"""Clock correction files: tempo2 and TEMPO formats.
+
+Behavioral counterpart of the reference's ClockFile (reference:
+src/pint/observatory/clock_file.py:441,566): parses both community formats,
+evaluates by linear interpolation with an out-of-range policy, and chains
+files (site -> GPS -> UTC etc.).  No data ships with the framework (the
+reference downloads from the IPTA clock-corrections repo at runtime; this
+environment is zero-egress): files are discovered in $PINT_TPU_CLOCK_DIR
+or ./clock by conventional names.
+
+- tempo2 format (``*.clk``): ``# FROM TO`` header line, then
+  ``mjd offset_seconds [...]`` rows.
+- TEMPO format (``time*.dat``): fixed columns — MJD in [0:9],
+  clkcorr1 (us) in [9:21], clkcorr2 (us) in [21:33], one-char site code at
+  column 34; correction = clkcorr2 - clkcorr1; the historical
+  ``clkcorr1 > 800 -> -818.8`` tempo adjustment is applied.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+
+import numpy as np
+
+
+class ClockFile:
+    """MJD-indexed clock offsets [s] with linear interpolation."""
+
+    def __init__(self, mjds, offsets_sec, name="", limits="warn"):
+        mjds = np.asarray(mjds, dtype=np.float64)
+        offsets_sec = np.asarray(offsets_sec, dtype=np.float64)
+        order = np.argsort(mjds, kind="stable")
+        self.mjds = mjds[order]
+        self.offsets = offsets_sec[order]
+        self.name = name
+        self.limits = limits
+        self._warned = False
+
+    def evaluate_sec(self, mjd):
+        mjd = np.asarray(mjd, dtype=np.float64)
+        if self.mjds.size == 0:
+            return np.zeros_like(mjd)
+        out_of_range = (mjd < self.mjds[0]) | (mjd > self.mjds[-1])
+        if np.any(out_of_range):
+            msg = (
+                f"clock file {self.name}: {int(out_of_range.sum())} MJDs "
+                f"outside coverage [{self.mjds[0]}, {self.mjds[-1]}]"
+            )
+            if self.limits == "error":
+                raise ValueError(msg)
+            if not self._warned:
+                warnings.warn(msg + "; clamping to end values")
+                self._warned = True
+        return np.interp(mjd, self.mjds, self.offsets)
+
+    # -- parsers -------------------------------------------------------------
+    @classmethod
+    def read_tempo2(cls, path, limits="warn"):
+        mjds, offs = [], []
+        with open(path) as f:
+            for line in f:
+                if line.startswith("#") or not line.strip():
+                    continue
+                parts = line.split()
+                try:
+                    mjd = float(parts[0])
+                    off = float(parts[1])
+                except (ValueError, IndexError):
+                    continue
+                mjds.append(mjd)
+                offs.append(off)
+        return cls(mjds, offs, name=os.path.basename(path), limits=limits)
+
+    @classmethod
+    def read_tempo(cls, path, site_code=None, limits="warn"):
+        mjds, offs = [], []
+        with open(path) as f:
+            for line in f:
+                if line.startswith("#") or not line.strip():
+                    continue
+                first = line.split()[0].upper() if line.split() else ""
+                if first.startswith("MJD") or first.startswith("====="):
+                    continue
+                try:
+                    mjd = float(line[:9])
+                except (ValueError, IndexError):
+                    continue
+                if (mjd < 39000 and mjd != 0) or mjd > 100000:
+                    continue
+
+                def _field(a, b):
+                    try:
+                        return float(line[a:b])
+                    except (ValueError, IndexError):
+                        return None
+
+                c1 = _field(9, 21)
+                c2 = _field(21, 33)
+                if c1 is None and c2 is None:
+                    continue
+                csite = line[34].lower() if len(line) > 34 else None
+                if site_code is not None and csite != site_code.lower():
+                    continue
+                c1 = c1 or 0.0
+                c2 = c2 or 0.0
+                if c1 > 800.0:  # historical tempo convention
+                    c1 -= 818.8
+                mjds.append(mjd)
+                offs.append((c2 - c1) * 1e-6)  # us -> s
+        return cls(mjds, offs, name=os.path.basename(path), limits=limits)
+
+    @classmethod
+    def read(cls, path, fmt=None, **kw):
+        if fmt is None:
+            fmt = "tempo2" if str(path).endswith(".clk") else "tempo"
+        if fmt == "tempo2":
+            kw.pop("site_code", None)
+            return cls.read_tempo2(path, **kw)
+        return cls.read_tempo(path, **kw)
+
+
+def _clock_dirs():
+    dirs = []
+    env = os.environ.get("PINT_TPU_CLOCK_DIR")
+    if env:
+        dirs.append(env)
+    dirs.append("clock")
+    return [d for d in dirs if os.path.isdir(d)]
+
+
+def find_clock_chain(obs):
+    """Locate the clock chain for a TopoObs by conventional file names:
+    <name>2gps.clk + gps2utc.clk, or time_<name>.dat (tempo).  Returns a
+    (possibly empty) list of ClockFile."""
+    chain = []
+    for d in _clock_dirs():
+        site_files = [
+            (os.path.join(d, f"{obs.name}2gps.clk"), "tempo2", None),
+            (os.path.join(d, f"time_{obs.name}.dat"), "tempo", obs.tempo_code),
+            (os.path.join(d, f"time.dat"), "tempo", obs.tempo_code),
+        ]
+        for path, fmt, site in site_files:
+            if os.path.exists(path):
+                chain.append(ClockFile.read(path, fmt=fmt, site_code=site))
+                break
+        gps = os.path.join(d, "gps2utc.clk")
+        if chain and os.path.exists(gps):
+            chain.append(ClockFile.read_tempo2(gps))
+        if chain:
+            break
+    return chain

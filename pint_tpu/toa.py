@@ -1,0 +1,413 @@
+"""TOA ingest: .tim parsing, host container, device batch.
+
+Counterpart of the reference's data layer (reference: src/pint/toa.py:109
+``get_TOAs``, :1183 ``TOAs``), redesigned around the TPU split:
+
+- **host side** (this module, numpy + exact integer time math): parse
+  ``.tim`` files (tempo2 / Princeton / ITOA line formats and the command
+  set MODE/FORMAT/TIME/EFAC/EQUAD/PHASE/JUMP/SKIP/INCLUDE/END, reference
+  toa.py:441,471,701), apply observatory clock chains, convert to TDB
+  ticks, evaluate observatory & solar-system geometry per TOA.
+- **device side**: :class:`TOABatch`, a frozen struct-of-arrays pytree
+  (int64 ticks + float64 geometry) that the jitted delay/phase chain
+  consumes.  Per-flag boolean masks are resolved at model-prep time, so
+  the reference's repeated "Select TOA Mask" cost (profiling/README.txt:60,
+  10.8 s) becomes a one-time ingest step.
+
+No astropy Table, no per-TOA python objects on the hot path.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from dataclasses import dataclass, field
+from typing import NamedTuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from pint_tpu.obs import get_observatory
+from pint_tpu.time.mjd import (
+    mjd_string_to_day_frac,
+    mjd_to_ticks_tdb,
+    mjd_to_ticks_utc,
+)
+
+__all__ = ["TOA", "TOAs", "TOABatch", "get_TOAs", "read_tim"]
+
+
+@dataclass
+class TOA:
+    """One parsed TOA (host side only; never reaches the device)."""
+
+    mjd_day: int
+    frac_num: int
+    frac_den: int
+    error_us: float
+    freq_mhz: float
+    obs: str
+    flags: dict = field(default_factory=dict)
+    name: str = ""
+
+
+# --- tim file parsing -------------------------------------------------------
+
+
+def _toa_line_format(line: str, tempo2_mode: bool = False) -> str:
+    """Classify a TOA data line (reference behavior: toa.py:441).
+
+    Stateful like the reference: after a ``FORMAT 1`` command every data
+    line is Tempo2; otherwise Princeton is the legacy default, with
+    Parkes/ITOA recognized by their fixed-column signatures.
+    """
+    if not line.strip():
+        return "Blank"
+    if line.startswith(("C ", "c ", "#", "CC ")):
+        return "Comment"
+    first = line.split()[0] if line.split() else ""
+    if first.upper() in _COMMANDS:
+        return "Command"
+    if tempo2_mode or len(line) > 80:
+        return "Tempo2"
+    if line.startswith(" ") and len(line) > 41 and line[41] == ".":
+        return "Parkes"
+    if (
+        len(line) > 25
+        and line[0].isalpha()
+        and line[1].isalpha()
+        and line[14:15] == "."
+    ):
+        return "ITOA"
+    return "Princeton"
+
+
+_COMMANDS = {
+    "FORMAT",
+    "MODE",
+    "TIME",
+    "EFAC",
+    "EQUAD",
+    "EMAX",
+    "EMIN",
+    "FMAX",
+    "FMIN",
+    "SKIP",
+    "NOSKIP",
+    "END",
+    "PHASE",
+    "PHA1",
+    "PHA2",
+    "JUMP",
+    "INCLUDE",
+    "INFO",
+    "TRACK",
+}
+
+
+def _parse_line(line: str, fmt: str):
+    """One data line -> TOA (without command-state effects applied)."""
+    if fmt == "Tempo2":
+        parts = line.split()
+        if len(parts) < 5:
+            raise ValueError(f"bad tempo2 TOA line: {line!r}")
+        name, freq, mjd, err, obs = parts[:5]
+        flags = {}
+        i = 5
+        while i < len(parts):
+            tok = parts[i]
+            if tok.startswith("-") and not _is_number(tok):
+                key = tok.lstrip("-")
+                if i + 1 < len(parts):
+                    flags[key] = parts[i + 1]
+                    i += 2
+                else:
+                    flags[key] = ""
+                    i += 1
+            else:
+                i += 1
+        d, n, den = mjd_string_to_day_frac(mjd)
+        return TOA(d, n, den, float(err), float(freq), obs, flags, name)
+    if fmt == "Princeton":
+        obs = line[0]
+        name = line[2:15].strip()
+        freq = float(line[15:24])
+        d, n, den = mjd_string_to_day_frac(line[24:44])
+        err = float(line[44:53])
+        flags = {}
+        dmc = line[68:78].strip()
+        if dmc:
+            flags["ddm"] = dmc
+        return TOA(d, n, den, err, freq, obs, flags, name)
+    if fmt == "Parkes":
+        name = line[1:25].strip()
+        freq = float(line[25:34])
+        d, n, den = mjd_string_to_day_frac(line[34:55])
+        # phase offset at line[55:63] (rarely used)
+        err = float(line[63:71])
+        obs = line[79] if len(line) > 79 else line.strip()[-1]
+        return TOA(d, n, den, err, freq, obs, {}, name)
+    if fmt == "ITOA":
+        name = line[0:2]
+        d, n, den = mjd_string_to_day_frac(line[9:28])
+        err = float(line[28:34])
+        freq = float(line[34:45])
+        obs = line[57:59].strip()
+        return TOA(d, n, den, err, freq, obs, {}, name)
+    raise ValueError(f"unhandled TOA format {fmt}")
+
+
+def _is_number(tok: str) -> bool:
+    try:
+        float(tok)
+        return True
+    except ValueError:
+        return False
+
+
+def read_tim(path, _depth=0):
+    """Parse a .tim file -> list[TOA], applying command state
+    (TIME/EFAC/EQUAD/PHASE/JUMP/SKIP/INCLUDE; reference toa.py:701)."""
+    toas = []
+    state = {
+        "time_offset_s": 0.0,
+        "efac": 1.0,
+        "equad_us": 0.0,
+        "phase": 0.0,
+        "jump": 0,
+        "njumps": 0,
+        "skip": False,
+        "emax": None,
+        "emin": None,
+        "fmax": None,
+        "fmin": None,
+        "info": None,
+        "fmt_tempo2": False,
+    }
+    _read_tim_into(path, toas, state, _depth)
+    return toas
+
+
+def _read_tim_into(path, toas, state, depth):
+    if depth > 5:
+        raise ValueError("INCLUDE nesting too deep")
+    with open(path) as f:
+        for raw in f:
+            line = raw.rstrip("\n")
+            if not line.strip():
+                continue
+            fmt = _toa_line_format(line, state["fmt_tempo2"])
+            if fmt in ("Blank", "Comment"):
+                continue
+            if fmt == "Command":
+                parts = line.split()
+                cmd = parts[0].upper()
+                arg = parts[1] if len(parts) > 1 else None
+                if cmd == "FORMAT":
+                    state["fmt_tempo2"] = arg == "1"
+                elif cmd == "MODE":
+                    pass  # MODE 1 (errors in us) is the only supported mode
+                elif cmd == "TIME":
+                    state["time_offset_s"] += float(arg or 0.0)
+                elif cmd == "EFAC":
+                    state["efac"] = float(arg or 1.0)
+                elif cmd == "EQUAD":
+                    state["equad_us"] = float(arg or 0.0)
+                elif cmd == "EMAX":
+                    state["emax"] = float(arg)
+                elif cmd == "EMIN":
+                    state["emin"] = float(arg)
+                elif cmd == "FMAX":
+                    state["fmax"] = float(arg)
+                elif cmd == "FMIN":
+                    state["fmin"] = float(arg)
+                elif cmd in ("PHASE", "PHA1", "PHA2"):
+                    state["phase"] += float(arg or 0.0)
+                elif cmd == "JUMP":
+                    if state["jump"]:
+                        state["jump"] = 0
+                    else:
+                        state["njumps"] += 1
+                        state["jump"] = state["njumps"]
+                elif cmd == "SKIP":
+                    state["skip"] = True
+                elif cmd == "NOSKIP":
+                    state["skip"] = False
+                elif cmd == "INFO":
+                    state["info"] = arg
+                elif cmd == "INCLUDE":
+                    sub = os.path.join(os.path.dirname(str(path)), arg)
+                    _read_tim_into(sub, toas, state, depth + 1)
+                elif cmd == "END":
+                    return
+                continue
+            if state["skip"]:
+                continue
+            try:
+                toa = _parse_line(line, fmt)
+            except (ValueError, IndexError) as e:
+                warnings.warn(f"skipping unparseable TOA line {line!r}: {e}")
+                continue
+            if state["emax"] is not None and toa.error_us > state["emax"]:
+                continue
+            if state["emin"] is not None and toa.error_us < state["emin"]:
+                continue
+            if state["fmax"] is not None and toa.freq_mhz > state["fmax"]:
+                continue
+            if state["fmin"] is not None and toa.freq_mhz < state["fmin"]:
+                continue
+            toa.error_us = toa.error_us * state["efac"]
+            if state["equad_us"]:
+                toa.error_us = float(
+                    np.hypot(toa.error_us, state["equad_us"])
+                )
+            if state["time_offset_s"]:
+                toa.flags["to"] = repr(state["time_offset_s"])
+            if state["phase"]:
+                toa.flags["padd"] = repr(state["phase"])
+            if state["jump"]:
+                toa.flags["tim_jump"] = str(state["jump"])
+            if state["info"]:
+                toa.flags.setdefault("info", state["info"])
+            toas.append(toa)
+
+
+# --- host container ---------------------------------------------------------
+
+
+class TOAs:
+    """Host-side TOA table (struct of numpy arrays + python flag dicts)."""
+
+    def __init__(self, toa_list, ephem="builtin", planets=False,
+                 include_clock=True):
+        if not toa_list:
+            raise ValueError("no TOAs")
+        self.ephem = ephem
+        self.planets = planets
+        n = len(toa_list)
+        self.flags = [dict(t.flags) for t in toa_list]
+        self.names = [t.name for t in toa_list]
+        self.error_us = np.array([t.error_us for t in toa_list])
+        self.freq_mhz = np.array([t.freq_mhz for t in toa_list])
+        self.freq_mhz[self.freq_mhz == 0.0] = np.inf  # 0 => infinite freq
+        self.obs_names = [get_observatory(t.obs).name for t in toa_list]
+        obs_unique = sorted(set(self.obs_names))
+        self.obs_index = np.array(
+            [obs_unique.index(o) for o in self.obs_names], dtype=np.int64
+        )
+        self.obs_list = obs_unique
+
+        # clock corrections per observatory group (host, float64 seconds)
+        mjd_float = np.array(
+            [t.mjd_day + t.frac_num / t.frac_den for t in toa_list]
+        )
+        self.mjd_float = mjd_float
+        clock = np.zeros(n)
+        if include_clock:
+            for io, oname in enumerate(obs_unique):
+                obs = get_observatory(oname)
+                m = self.obs_index == io
+                if not obs.is_barycenter:
+                    clock[m] = obs.clock_corrections_sec(mjd_float[m])
+        # TIME command offsets ride the clock path too
+        for i, fl in enumerate(self.flags):
+            if "to" in fl:
+                clock[i] += float(fl["to"])
+        self.clock_sec = clock
+
+        # UTC(site)->TDB ticks (exact integer path per TOA)
+        ticks = np.empty(n, dtype=np.int64)
+        for i, t in enumerate(toa_list):
+            obs = get_observatory(self.obs_names[i])
+            if obs.is_barycenter:
+                # already TDB at the SSB; TIME-command offsets still apply
+                ticks[i] = mjd_to_ticks_tdb(
+                    t.mjd_day, t.frac_num, t.frac_den
+                ) + int(round(clock[i] * 2**32))
+            else:
+                ticks[i] = mjd_to_ticks_utc(
+                    t.mjd_day, t.frac_num, t.frac_den,
+                    clock_offset_sec=clock[i],
+                )
+        self.ticks = ticks
+        self._compute_posvels()
+
+    def __len__(self):
+        return len(self.flags)
+
+    def _compute_posvels(self):
+        """Observatory & solar-system geometry at each TOA (reference:
+        toa.py:2323 compute_posvels)."""
+        from pint_tpu.ephem import body_posvel_ssb
+
+        n = len(self)
+        self.ssb_obs_pos = np.zeros((n, 3))
+        self.ssb_obs_vel = np.zeros((n, 3))
+        for io, oname in enumerate(self.obs_list):
+            obs = get_observatory(oname)
+            m = self.obs_index == io
+            pv = obs.posvel_ssb(self.ticks[m], ephem=self.ephem)
+            self.ssb_obs_pos[m] = pv.pos
+            self.ssb_obs_vel[m] = pv.vel
+        sun = body_posvel_ssb("sun", self.ticks, self.ephem)
+        self.obs_sun_pos = sun.pos - self.ssb_obs_pos
+        self.planet_pos = {}
+        if self.planets:
+            for b in ("venus", "mars", "jupiter", "saturn", "uranus", "neptune"):
+                pv = body_posvel_ssb(b, self.ticks, self.ephem)
+                self.planet_pos[b] = pv.pos - self.ssb_obs_pos
+
+    def get_flag_values(self, flag, default=None, astype=str):
+        return [astype(f[flag]) if flag in f else default for f in self.flags]
+
+    def to_batch(self) -> "TOABatch":
+        planets = (
+            np.stack(
+                [self.planet_pos[b] for b in
+                 ("venus", "mars", "jupiter", "saturn", "uranus", "neptune")],
+                axis=0,
+            )
+            if self.planets
+            else np.zeros((0, len(self), 3))
+        )
+        return TOABatch(
+            ticks=jnp.asarray(self.ticks),
+            freq_mhz=jnp.asarray(self.freq_mhz),
+            error_s=jnp.asarray(self.error_us * 1e-6),
+            ssb_obs_pos=jnp.asarray(self.ssb_obs_pos),
+            ssb_obs_vel=jnp.asarray(self.ssb_obs_vel),
+            obs_sun_pos=jnp.asarray(self.obs_sun_pos),
+            planet_pos=jnp.asarray(planets),
+        )
+
+
+class TOABatch(NamedTuple):
+    """Device-side struct-of-arrays TOA batch (a JAX pytree).
+
+    ticks: int64 TDB arrival time at the observatory, 2^-32 s since J2000.
+    Geometry in light-seconds / ls-per-sec, ICRS axes:
+    ssb_obs_pos/vel (N,3); obs_sun_pos (N,3); planet_pos (6,N,3) in the
+    order venus, mars, jupiter, saturn, uranus, neptune (empty if not
+    loaded with planets=True).
+    """
+
+    ticks: jnp.ndarray
+    freq_mhz: jnp.ndarray
+    error_s: jnp.ndarray
+    ssb_obs_pos: jnp.ndarray
+    ssb_obs_vel: jnp.ndarray
+    obs_sun_pos: jnp.ndarray
+    planet_pos: jnp.ndarray
+
+    def __len__(self):
+        return int(self.ticks.shape[0])
+
+
+def get_TOAs(timfile, ephem="builtin", planets=False, include_clock=True)\
+        -> TOAs:
+    """Parse + prepare TOAs from a .tim file (reference: toa.py:109)."""
+    return TOAs(
+        read_tim(timfile), ephem=ephem, planets=planets,
+        include_clock=include_clock,
+    )

@@ -1,0 +1,161 @@
+"""Observatory layer: registry, Earth rotation invariants, clock files."""
+
+import numpy as np
+import pytest
+
+from pint_tpu import C_M_PER_S
+from pint_tpu.obs import get_observatory, Observatory
+from pint_tpu.obs.clock import ClockFile
+from pint_tpu.obs import erot
+
+SEC_DAY_TICKS = 86400 * 2**32
+
+
+class TestRegistry:
+    def test_name_alias_codes(self):
+        gbt = get_observatory("gbt")
+        assert get_observatory("GBT") is gbt
+        assert get_observatory("1") is gbt  # tempo code
+        assert get_observatory("GB") is gbt  # ITOA code
+        assert get_observatory("pks") is get_observatory("parkes")
+        assert get_observatory("@").is_barycenter
+        assert get_observatory("ssb").is_barycenter
+
+    def test_unknown_raises(self):
+        with pytest.raises(KeyError):
+            get_observatory("atlantis")
+
+    def test_geocenter(self):
+        geo = get_observatory("geocenter")
+        pv = geo.posvel_ssb(np.array([0], dtype=np.int64))
+        assert np.linalg.norm(pv.pos) > 480  # ~1 AU in light-seconds
+
+    def test_barycenter_zero(self):
+        pv = get_observatory("@").posvel_ssb(np.array([0], dtype=np.int64))
+        assert np.all(pv.pos == 0) and np.all(pv.vel == 0)
+
+
+class TestEarthRotation:
+    def test_site_radius_preserved(self):
+        gbt = get_observatory("gbt")
+        ticks = (np.arange(10) * 8641 * 2**32 * 1000).astype(np.int64)
+        pv = gbt.posvel_gcrs(ticks)
+        r = np.linalg.norm(pv.pos, axis=-1) * C_M_PER_S
+        expect = np.linalg.norm(gbt.itrf_xyz)
+        np.testing.assert_allclose(r, expect, rtol=1e-12)
+
+    def test_sidereal_period(self):
+        """Site direction repeats after one sidereal day (~86164.1 s)."""
+        gbt = get_observatory("gbt")
+        sid = 86164.0905
+        t0 = np.array([0], dtype=np.int64)
+        t1 = np.array([int(sid * 2**32)], dtype=np.int64)
+        p0 = gbt.posvel_gcrs(t0).pos[0]
+        p1 = gbt.posvel_gcrs(t1).pos[0]
+        # angular separation small (nutation/precession drift over a day ~ mas)
+        cosang = p0 @ p1 / (np.linalg.norm(p0) * np.linalg.norm(p1))
+        assert cosang > 1 - 1e-8
+        # but NOT after a solar day
+        t2 = np.array([SEC_DAY_TICKS], dtype=np.int64)
+        p2 = gbt.posvel_gcrs(t2).pos[0]
+        cosang2 = p0 @ p2 / (np.linalg.norm(p0) * np.linalg.norm(p2))
+        assert cosang2 < 1 - 1e-5
+
+    def test_rotation_speed(self):
+        gbt = get_observatory("gbt")
+        pv = gbt.posvel_gcrs(np.array([10**15], dtype=np.int64))
+        v = np.linalg.norm(pv.vel) * C_M_PER_S
+        # site speed = omega * r_perp; for GBT lat ~38.4 deg: ~360 m/s
+        r_perp = np.hypot(gbt.itrf_xyz[0], gbt.itrf_xyz[1])
+        expect = 2 * np.pi * 1.00273781191135448 / 86400 * r_perp
+        np.testing.assert_allclose(v, expect, rtol=1e-6)
+
+    def test_velocity_vs_finite_difference(self):
+        gbt = get_observatory("gbt")
+        t0 = 10**16
+        h = int(0.5 * 2**32)
+        pm = gbt.posvel_gcrs(np.array([t0 - h], dtype=np.int64)).pos[0]
+        pp = gbt.posvel_gcrs(np.array([t0 + h], dtype=np.int64)).pos[0]
+        v0 = gbt.posvel_gcrs(np.array([t0], dtype=np.int64)).vel[0]
+        v_fd = (pp - pm) / 1.0
+        np.testing.assert_allclose(v_fd, v0, rtol=2e-7, atol=1e-12)
+
+    def test_precession_direction(self):
+        """Pole of date mapped to J2000 moves toward +x by ~2004.2"/cy."""
+        T = np.array([0.25])  # 25 years
+        P = erot.precession_matrix(T)[0]
+        pole_j2000 = P @ np.array([0.0, 0.0, 1.0])
+        x_arcsec = pole_j2000[0] * 180 * 3600 / np.pi
+        assert abs(x_arcsec - 2004.19 * 0.25) < 1.0
+        assert abs(pole_j2000[1]) < abs(pole_j2000[0]) * 0.1
+
+    def test_nutation_magnitude(self):
+        T = np.linspace(0, 0.3, 200)
+        dpsi, deps = erot.nutation_angles(T)
+        # dominant 18.6-yr term: |dpsi| up to ~17.2", |deps| up to ~9.2"
+        assert 15 < np.max(np.abs(dpsi)) * 180 * 3600 / np.pi < 19
+        assert 8 < np.max(np.abs(deps)) * 180 * 3600 / np.pi < 10
+
+    def test_era_rate(self):
+        # ERA advances by 2pi * 1.0027378... per day
+        d0, d1 = 1000.0, 1001.0
+        de = (erot.era_radians(d1) - erot.era_radians(d0)) % (2 * np.pi)
+        expect = (2 * np.pi * 1.00273781191135448) % (2 * np.pi)
+        assert abs(de - expect) < 1e-12
+
+
+class TestClockFile:
+    def test_tempo2_format(self, tmp_path):
+        p = tmp_path / "wsrt2gps.clk"
+        p.write_text(
+            "# UTC(wsrt) UTC(GPS)\n"
+            "51179.5 6.5e-08 0.054 GPSWB1\n"
+            "51181.5 2.48e-07 0.049 GPSWB1\t#comment\n"
+        )
+        cf = ClockFile.read(str(p))
+        np.testing.assert_allclose(cf.evaluate_sec(51179.5), 6.5e-8)
+        # midpoint interpolation
+        np.testing.assert_allclose(
+            cf.evaluate_sec(51180.5), (6.5e-8 + 2.48e-7) / 2
+        )
+
+    def test_tempo_format(self, tmp_path):
+        p = tmp_path / "time_gbt.dat"
+        # fixed columns: mjd[0:9], c1[9:21], c2[21:33], site at col 34
+        def row(mjd, c1, c2, site):
+            return f"{mjd:9.2f}{c1:12.3f}{c2:12.3f} {site}\n"
+
+        p.write_text(
+            row(50000.0, 0.0, 1.5, "1")
+            + row(50010.0, 0.0, 2.5, "1")
+            + row(50010.0, 0.0, 9.9, "3")  # other site: skipped
+        )
+        cf = ClockFile.read(str(p), fmt="tempo", site_code="1")
+        np.testing.assert_allclose(cf.evaluate_sec(50000.0), 1.5e-6)
+        np.testing.assert_allclose(cf.evaluate_sec(50005.0), 2.0e-6)
+
+    def test_tempo_818_adjustment(self, tmp_path):
+        p = tmp_path / "time.dat"
+        p.write_text(f"{50000.0:9.2f}{818.8:12.3f}{0.0:12.3f} 1\n")
+        cf = ClockFile.read_tempo(str(p), site_code="1")
+        np.testing.assert_allclose(cf.evaluate_sec(50000.0), 0.0, atol=1e-12)
+
+    def test_out_of_range_policy(self, tmp_path):
+        p = tmp_path / "x.clk"
+        p.write_text("# a b\n50000 1e-6\n50010 2e-6\n")
+        cf = ClockFile.read(str(p), limits="error")
+        with pytest.raises(ValueError):
+            cf.evaluate_sec(49999.0)
+        cf2 = ClockFile.read(str(p))
+        with pytest.warns(UserWarning):
+            v = cf2.evaluate_sec(50020.0)
+        np.testing.assert_allclose(v, 2e-6)  # clamped
+
+    def test_noclock_warns_once(self, tmp_path, monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        obs = get_observatory("effelsberg")
+        obs._clock_chain = None
+        obs._warned_noclock = False
+        with pytest.warns(UserWarning, match="no clock files"):
+            v = obs.clock_corrections_sec(np.array([55000.0]))
+        assert np.all(v == 0)

@@ -1,0 +1,132 @@
+"""TOA ingest: tim parsing (both formats, commands), batch building."""
+
+import numpy as np
+import pytest
+
+from pint_tpu.toa import get_TOAs, read_tim, _toa_line_format
+
+REF_TIM = "/root/reference/profiling/NGC6440E.tim"
+
+
+def test_line_format_detection():
+    assert _toa_line_format("FORMAT 1") == "Command"
+    assert _toa_line_format("C this is a comment") == "Comment"
+    assert (
+        _toa_line_format(
+            "1               1949.609 53478.2858714192189    21.71"
+        )
+        == "Princeton"
+    )
+    assert (
+        _toa_line_format(
+            "fake.ff 1400.0 55000.000001 1.0 gbt -fe L-wide", tempo2_mode=True
+        )
+        == "Tempo2"
+    )
+
+
+def test_read_reference_tim():
+    toas = read_tim(REF_TIM)
+    assert len(toas) == 62  # 64 lines - MODE line - ... data lines
+    assert toas[0].obs == "1"
+    assert toas[0].freq_mhz == 1949.609
+    assert toas[0].mjd_day == 53478
+    assert toas[0].error_us == 21.71
+
+
+def test_get_toas_reference():
+    t = get_TOAs(REF_TIM)
+    assert len(t) == 62
+    assert t.obs_list == ["gbt"]
+    # ticks strictly increasing after sorting not guaranteed in file order,
+    # but range must span ~2005-2008
+    day = t.ticks / 2**32 / 86400 + 51544.5
+    assert day.min() > 53400 and day.max() < 54600
+    # geometry: observatory ~1 AU from SSB
+    r = np.linalg.norm(t.ssb_obs_pos, axis=-1)
+    assert np.all((r > 480) & (r < 520))
+    b = t.to_batch()
+    assert b.ticks.dtype == np.int64
+    assert b.ssb_obs_pos.shape == (62, 3)
+
+
+def test_tempo2_format_with_flags(tmp_path):
+    p = tmp_path / "t.tim"
+    p.write_text(
+        "FORMAT 1\n"
+        "fake.ff 1400.0 55000.1234567890123 1.50 gbt -fe L-wide -be GUPPI\n"
+        "fake.ff 800.0 55001.5 2.0 parkes\n"
+    )
+    toas = read_tim(str(p))
+    assert len(toas) == 2
+    assert toas[0].flags == {"fe": "L-wide", "be": "GUPPI"}
+    assert toas[1].obs == "parkes"
+    t = get_TOAs(str(p))
+    assert t.obs_list == ["gbt", "parkes"]
+
+
+def test_commands(tmp_path):
+    p = tmp_path / "c.tim"
+    p.write_text(
+        "FORMAT 1\n"
+        "EFAC 2.0\n"
+        "EQUAD 3.0\n"
+        "a 1400 55000.1 4.0 gbt\n"
+        "EFAC 1.0\n"
+        "EQUAD 0.0\n"
+        "TIME 1.5\n"
+        "a 1400 55000.2 4.0 gbt\n"
+        "JUMP\n"
+        "a 1400 55000.3 4.0 gbt\n"
+        "JUMP\n"
+        "SKIP\n"
+        "a 1400 55000.4 4.0 gbt\n"
+        "NOSKIP\n"
+        "a 1400 55000.5 4.0 gbt\n"
+    )
+    toas = read_tim(str(p))
+    assert len(toas) == 4  # SKIPped one dropped
+    # EFAC*err then EQUAD in quadrature: sqrt((2*4)^2 + 3^2)
+    np.testing.assert_allclose(toas[0].error_us, np.hypot(8.0, 3.0))
+    assert toas[1].flags.get("to") == repr(1.5)
+    assert toas[2].flags.get("tim_jump") == "1"
+    assert "tim_jump" not in toas[3].flags
+
+
+def test_include(tmp_path):
+    sub = tmp_path / "sub.tim"
+    sub.write_text("FORMAT 1\nx 1400 55010.5 1.0 gbt\n")
+    p = tmp_path / "main.tim"
+    p.write_text(
+        "FORMAT 1\n"
+        "x 1400 55000.5 1.0 gbt\n"
+        f"INCLUDE sub.tim\n"
+        "x 1400 55020.5 1.0 gbt\n"
+    )
+    toas = read_tim(str(p))
+    assert len(toas) == 3
+    assert toas[1].mjd_day == 55010
+
+
+def test_barycentric_site(tmp_path):
+    p = tmp_path / "b.tim"
+    p.write_text("FORMAT 1\nx 1400 55000.5 1.0 @\n")
+    t = get_TOAs(str(p))
+    # barycentric TOA: ticks equal the TDB MJD directly, no 64.184 offset
+    from pint_tpu.time.mjd import mjd_float_to_ticks_tdb
+
+    assert t.ticks[0] == mjd_float_to_ticks_tdb(55000.5)
+    assert np.all(t.ssb_obs_pos == 0)
+
+
+def test_end_command(tmp_path):
+    p = tmp_path / "e.tim"
+    p.write_text("FORMAT 1\nx 1400 55000.5 1.0 gbt\nEND\nx 1400 55001.5 1.0 gbt\n")
+    assert len(read_tim(str(p))) == 1
+
+
+def test_zero_freq_becomes_inf(tmp_path):
+    p = tmp_path / "z.tim"
+    p.write_text("FORMAT 1\nx 0.0 55000.5 1.0 @\n")
+    t = get_TOAs(str(p))
+    assert np.isinf(t.freq_mhz[0])
