@@ -1,0 +1,17 @@
+"""Timing-model layer: components, builder, parameters.
+
+``get_model`` / ``get_model_and_toas`` are the public entry points
+(reference: src/pint/models/model_builder.py:777,859).
+"""
+
+from pint_tpu.models.builder import (  # noqa: F401
+    get_model,
+    get_model_and_toas,
+    parse_parfile,
+)
+from pint_tpu.models.component import (  # noqa: F401
+    Component,
+    DelayComponent,
+    PhaseComponent,
+)
+from pint_tpu.models.timing_model import TimingModel, PreparedModel  # noqa: F401

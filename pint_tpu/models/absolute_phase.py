@@ -1,0 +1,88 @@
+"""Absolute phase reference (TZR) and explicit phase offset.
+
+Counterpart of the reference AbsPhase (reference:
+src/pint/models/absolute_phase.py:11-140 ``make_TZR_toa``) and PhaseOffset
+(reference: src/pint/models/phase_offset.py:9-53).  The TZR TOA is built
+once at prepare time as a single-element TOABatch and evaluated through
+the *same* jitted chain (SURVEY hard part (a)).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.models.component import PhaseComponent
+from pint_tpu.models.parameter import Param
+
+
+class AbsPhase(PhaseComponent):
+    category = "absolute_phase"
+    trigger_params = ("TZRMJD",)
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(Param("TZRMJD", kind="mjd", fittable=False,
+                             description="TZR reference epoch"))
+        self.add_param(Param("TZRFRQ", units="MHz", fittable=False,
+                             description="TZR reference frequency"))
+        # TZRSITE is a string; kept in model.meta by the builder
+
+    def build_params(self, pardict):
+        pass
+
+    def defaults(self):
+        return {"TZRMJD": np.nan, "TZRFRQ": np.inf}
+
+    def phase(self, values, batch, ctx, delay):
+        # contributes nothing directly; the TZR batch subtraction happens
+        # in PreparedModel._phase_raw via make_tzr_batch below
+        return jnp.zeros_like(delay)
+
+    def make_tzr_toas(self, model, toas):
+        """Single-TOA TOAs at TZRMJD/TZRSITE/TZRFRQ through full ingest.
+        Returned as a TOAs object so the PreparedModel can build a
+        TZR-specific prepare ctx for every component."""
+        from pint_tpu.time.mjd import ticks_to_mjd_string_tdb
+        from pint_tpu.toa import TOA, TOAs
+
+        tzr_sec = model.values.get("TZRMJD", np.nan)
+        if np.isnan(tzr_sec):
+            return None
+        site = model.meta.get("TZRSITE", "@")
+        freq = model.values.get("TZRFRQ", np.inf)
+        if not np.isfinite(freq) or freq == 0.0:
+            freq = 0.0  # ingest maps 0 -> inf
+        # TZRMJD is in the TOA convention for its site (UTC at a topo
+        # site, TDB at '@'), so feed the raw par string through the same
+        # ingest path a .tim line takes
+        raw = self.param("TZRMJD").raw
+        if raw is None:
+            raw = ticks_to_mjd_string_tdb(int(round(tzr_sec * 2**32)), 16)
+        from pint_tpu.time.mjd import mjd_string_to_day_frac
+
+        day, num, den = mjd_string_to_day_frac(raw)
+        tzr = TOA(day, num, den, 0.0, freq, site, {}, name="TZR")
+        return TOAs([tzr], ephem=toas.ephem, planets=toas.planets)
+
+
+class PhaseOffset(PhaseComponent):
+    """Explicit overall phase offset PHOFF (replaces implicit mean
+    subtraction when present; reference phase_offset.py)."""
+
+    category = "phase_offset"
+    trigger_params = ("PHOFF",)
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(Param("PHOFF", units="turns",
+                             description="Overall phase offset"))
+
+    def build_params(self, pardict):
+        pass
+
+    def defaults(self):
+        return {"PHOFF": 0.0}
+
+    def phase(self, values, batch, ctx, delay):
+        return -values["PHOFF"] * jnp.ones_like(delay)

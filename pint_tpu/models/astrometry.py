@@ -1,0 +1,147 @@
+"""Astrometry: Roemer delay, parallax, proper motion (equatorial & ecliptic).
+
+Counterpart of the reference Astrometry components (reference:
+src/pint/models/astrometry.py:41,272,753 — ``solar_system_geometric_delay``
+at :155-184, PM propagation ``ssb_to_psb_xyz_ICRS`` at :469-529).
+All geometry is float64 on-device: the Roemer delay is ~500 s needing
+~ns => 2e-12 relative, comfortably inside even TPU's sloppy f64.
+
+Equatorial (RAJ/DECJ/PMRA/PMDEC/PX) and ecliptic (ELONG/ELAT/PMELONG/
+PMELAT) variants share the delay; the ecliptic one rotates to ICRS by the
+fixed J2000 obliquity (reference: pulsar_ecliptic.py).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu import AU_LS, OBLIQUITY_J2000_ARCSEC, SECS_PER_JULIAN_YEAR
+from pint_tpu.models.component import DelayComponent
+from pint_tpu.models.parameter import Param
+
+#: mas/yr -> rad/s
+_MASYR = np.deg2rad(1.0 / 3.6e6) / SECS_PER_JULIAN_YEAR
+#: 1 kpc in light-seconds (IAU pc)
+_KPC_LS = 3.0856775814913673e19 / 299792458.0
+
+
+def _unit_vector(lon, lat):
+    clat = jnp.cos(lat)
+    return jnp.stack(
+        [clat * jnp.cos(lon), clat * jnp.sin(lon), jnp.sin(lat)], axis=-1
+    )
+
+
+class AstrometryBase(DelayComponent):
+    category = "astrometry"
+    register = False
+
+    def prepare(self, toas, model):
+        posepoch = model.values.get("POSEPOCH", np.nan)
+        if np.isnan(posepoch):
+            posepoch = model.values.get("PEPOCH", 0.0)
+        t_sec = toas.ticks.astype(np.float64) / 2**32
+        return {"dt_pos": jnp.asarray(t_sec - posepoch)}
+
+    def psr_dir(self, values, ctx):
+        """Unit vector obs->pulsar in ICRS at each TOA (with PM)."""
+        raise NotImplementedError
+
+    def delay(self, values, batch, ctx, delay_accum):
+        n = self.psr_dir(values, ctx)
+        r = batch.ssb_obs_pos  # light-seconds
+        roemer = -jnp.sum(n * r, axis=-1)
+        # parallax: (|r|^2 - (r.n)^2) / (2 d).  PX in mas => d = 1/PX kpc,
+        # so 1/d [ls^-1] = PX / _KPC_LS; term vanishes smoothly at PX=0.
+        r2 = jnp.sum(r * r, axis=-1)
+        rn = -roemer  # = (r.n)
+        inv_d_ls = values["PX"] / _KPC_LS
+        return roemer + 0.5 * (r2 - rn * rn) * inv_d_ls
+
+
+class AstrometryEquatorial(AstrometryBase):
+    register = True
+    trigger_params = ("RAJ", "DECJ")
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(Param("RAJ", kind="angle", hourangle=True,
+                             description="Right ascension (J2000)"))
+        self.add_param(Param("DECJ", kind="angle",
+                             description="Declination (J2000)"))
+        self.add_param(Param("PMRA", units="mas/yr", scale=1.0,
+                             description="Proper motion in RA*cos(DEC)"))
+        self.add_param(Param("PMDEC", units="mas/yr",
+                             description="Proper motion in DEC"))
+        self.add_param(Param("PX", units="mas", description="Parallax"))
+        self.add_param(Param("POSEPOCH", kind="mjd", fittable=False,
+                             description="Epoch of position"))
+
+    def build_params(self, pardict):
+        pass
+
+    def defaults(self):
+        return {"PMRA": 0.0, "PMDEC": 0.0, "PX": 0.0, "POSEPOCH": np.nan}
+
+    def psr_dir(self, values, ctx):
+        dt = ctx["dt_pos"]
+        ra = values["RAJ"]
+        dec = values["DECJ"]
+        cosdec = jnp.cos(dec)
+        ra_t = ra + values["PMRA"] * _MASYR * dt / jnp.where(
+            cosdec == 0, 1.0, cosdec
+        )
+        dec_t = dec + values["PMDEC"] * _MASYR * dt
+        return _unit_vector(ra_t, dec_t)
+
+
+_ECL_RAD = np.deg2rad(OBLIQUITY_J2000_ARCSEC / 3600.0)
+_EQ_FROM_ECL = jnp.asarray(
+    [
+        [1.0, 0.0, 0.0],
+        [0.0, np.cos(_ECL_RAD), -np.sin(_ECL_RAD)],
+        [0.0, np.sin(_ECL_RAD), np.cos(_ECL_RAD)],
+    ]
+)
+
+
+class AstrometryEcliptic(AstrometryBase):
+    register = True
+    trigger_params = ("ELONG", "ELAT")
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(Param("ELONG", kind="angle",
+                             description="Ecliptic longitude",
+                             aliases=("LAMBDA",)))
+        self.add_param(Param("ELAT", kind="angle",
+                             description="Ecliptic latitude",
+                             aliases=("BETA",)))
+        self.add_param(Param("PMELONG", units="mas/yr",
+                             description="PM in ecliptic longitude",
+                             aliases=("PMLAMBDA",)))
+        self.add_param(Param("PMELAT", units="mas/yr",
+                             description="PM in ecliptic latitude",
+                             aliases=("PMBETA",)))
+        self.add_param(Param("PX", units="mas", description="Parallax"))
+        self.add_param(Param("POSEPOCH", kind="mjd", fittable=False,
+                             description="Epoch of position"))
+
+    def build_params(self, pardict):
+        pass
+
+    def defaults(self):
+        return {"PMELONG": 0.0, "PMELAT": 0.0, "PX": 0.0, "POSEPOCH": np.nan}
+
+    def psr_dir(self, values, ctx):
+        dt = ctx["dt_pos"]
+        lon = values["ELONG"]
+        lat = values["ELAT"]
+        coslat = jnp.cos(lat)
+        lon_t = lon + values["PMELONG"] * _MASYR * dt / jnp.where(
+            coslat == 0, 1.0, coslat
+        )
+        lat_t = lat + values["PMELAT"] * _MASYR * dt
+        necl = _unit_vector(lon_t, lat_t)
+        return necl @ _EQ_FROM_ECL.T

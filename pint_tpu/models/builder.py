@@ -1,0 +1,263 @@
+"""Par-file parsing and model construction.
+
+Counterpart of the reference ModelBuilder (reference:
+src/pint/models/model_builder.py:59 ``parse_parfile``, :435
+``choose_model``, :777 ``get_model``, :859 ``get_model_and_toas``):
+tokenize the par file, select components by their trigger parameters
+(component classes self-register, so user components participate
+automatically), instantiate concrete prefix/mask families, set values,
+and record exact epoch ticks for precision-critical epochs.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import warnings
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from pint_tpu.models import component as _component  # noqa: F401
+from pint_tpu.models.component import Component, parse_mask_select
+from pint_tpu.models.parameter import Param, mjd_value_to_ticks
+from pint_tpu.models.timing_model import TimingModel
+
+# import builtin components so they register
+from pint_tpu.models.absolute_phase import AbsPhase, PhaseOffset  # noqa: F401
+from pint_tpu.models.astrometry import (  # noqa: F401
+    AstrometryEcliptic,
+    AstrometryEquatorial,
+)
+from pint_tpu.models.dispersion import (  # noqa: F401
+    DispersionDM,
+    DispersionDMX,
+    DispersionJump,
+)
+from pint_tpu.models.jump import PhaseJump  # noqa: F401
+from pint_tpu.models.solar_system_shapiro import SolarSystemShapiro  # noqa: F401
+from pint_tpu.models.spindown import Spindown  # noqa: F401
+
+__all__ = ["parse_parfile", "get_model", "get_model_and_toas",
+           "model_to_parfile"]
+
+#: BINARY value -> component class; binary families register here as they
+#: land (reference: model_builder.choose_binary_model, :576)
+_BINARY_MODELS: dict = {}
+
+#: par keys that are model metadata, not fit parameters
+_META_KEYS = {
+    "PSR", "PSRJ", "PSRB", "EPHEM", "CLK", "CLOCK", "UNITS", "TIMEEPH",
+    "T2CMETHOD", "CORRECT_TROPOSPHERE", "DILATEFREQ", "NTOA", "TRES",
+    "CHI2", "CHI2R", "TZRSITE", "INFO", "BINARY", "START", "FINISH",
+    "SOLARN0", "NE_SW", "SWM", "DMDATA", "MODE", "EPHVER", "NITS",
+    "IBOOT", "DMX",
+}
+
+#: parameter-name aliases -> canonical (reference: each Param's aliases +
+#: model_builder._pintify_parfile)
+_ALIASES = {
+    "E": "ECC",
+    "PSRJ": "PSR",
+    "PSRB": "PSR",
+    "LAMBDA": "ELONG",
+    "BETA": "ELAT",
+    "PMLAMBDA": "PMELONG",
+    "PMBETA": "PMELAT",
+    "A1DOT": "XDOT",
+}
+
+
+def parse_parfile(path_or_text: str) -> Dict[str, List[List[str]]]:
+    """Tokenize a par file: {KEY: [tokens-after-key, ...]} (repeats kept,
+    e.g. multiple JUMP lines; reference model_builder.py:59)."""
+    if "\n" in path_or_text or not os.path.exists(path_or_text):
+        text = path_or_text
+    else:
+        with open(path_or_text) as f:
+            text = f.read()
+    out: Dict[str, List[List[str]]] = {}
+    for raw in text.splitlines():
+        line = raw.split("#")[0].rstrip()
+        if not line.strip() or line.startswith(("C ", "c ")):
+            continue
+        tokens = line.split()
+        key = tokens[0].upper()
+        out.setdefault(key, []).append(tokens[1:])
+    return out
+
+
+def _canonical(key: str) -> str:
+    return _ALIASES.get(key, key)
+
+
+def choose_components(pardict) -> List[type]:
+    """Select component classes whose trigger params appear."""
+    keys = set(pardict)
+    chosen = []
+    for name, cls in Component.registry.items():
+        trig = cls.trigger_params
+        hit = False
+        for t in trig:
+            if t in keys:
+                hit = True
+            # prefix triggers: DMX matches DMX_0001 etc.
+            elif any(k.startswith(t + "_") or
+                     (k.startswith(t) and k[len(t):].isdigit())
+                     for k in keys):
+                hit = True
+        if hit:
+            chosen.append(cls)
+    # StandardTimingModel always includes solar-system Shapiro
+    if SolarSystemShapiro not in chosen:
+        chosen.append(SolarSystemShapiro)
+    return chosen
+
+
+def get_model(parfile) -> TimingModel:
+    pardict_raw = parse_parfile(parfile)
+    # canonicalize keys
+    pardict: Dict[str, List[List[str]]] = {}
+    for k, v in pardict_raw.items():
+        pardict.setdefault(_canonical(k), []).extend(v)
+
+    units = (pardict.get("UNITS", [["TDB"]])[0] or ["TDB"])[0].upper()
+    if units not in ("TDB", ""):
+        raise NotImplementedError(
+            f"UNITS {units} not supported (only TDB; TCB conversion is a "
+            "planned milestone — use tempo2/PINT convert_parfile for now)"
+        )
+    if "BINARY" in pardict:
+        binary = pardict["BINARY"][0][0].upper()
+        if binary not in _BINARY_MODELS:
+            avail = sorted(_BINARY_MODELS) or "none yet"
+            raise NotImplementedError(
+                f"BINARY {binary} not implemented yet (available: {avail})"
+            )
+
+    # mask-parameter selectors must exist before component instantiation
+    jump_selects = []
+    jump_rest = []
+    for tokens in pardict.get("JUMP", []):
+        sel, rest = parse_mask_select(tokens)
+        jump_selects.append(sel)
+        jump_rest.append(rest)
+    if jump_selects:
+        pardict["__JUMP_selects__"] = jump_selects  # type: ignore
+    dmjump_selects = []
+    dmjump_rest = []
+    for tokens in pardict.get("DMJUMP", []):
+        sel, rest = parse_mask_select(tokens)
+        dmjump_selects.append(sel)
+        dmjump_rest.append(rest)
+    if dmjump_selects:
+        pardict["__DMJUMP_selects__"] = dmjump_selects  # type: ignore
+
+    model = TimingModel(name=str(parfile)[:120])
+    for cls in choose_components(pardict):
+        comp = cls.from_parfile(pardict)
+        model.add_component(comp)
+
+    model.epoch_ticks = {}
+    params = model.params
+    consumed = set()
+    for key, occurrences in pardict.items():
+        if key.startswith("__"):
+            consumed.add(key)
+            continue
+        if key in _META_KEYS:
+            model.meta[key] = " ".join(occurrences[0])
+            consumed.add(key)
+            continue
+        if key in ("JUMP", "DMJUMP"):
+            consumed.add(key)
+            continue
+        p = params.get(key)
+        if p is None:
+            continue
+        tokens = occurrences[0]
+        if not tokens:
+            continue
+        p.raw = tokens[0]
+        model.values[key] = p.parse(tokens[0])
+        if p.kind == "mjd":
+            model.epoch_ticks[key] = mjd_value_to_ticks(tokens[0])
+        if len(tokens) > 1 and p.fittable:
+            if tokens[1] in ("1", "2"):
+                p.frozen = False
+            if len(tokens) > 2:
+                try:
+                    p.uncertainty = float(tokens[2].replace("D", "E"))
+                except ValueError:
+                    pass
+        consumed.add(key)
+
+    # JUMP/DMJUMP values (mask params): JUMPn in file order
+    for i, rest in enumerate(jump_rest, start=1):
+        name = f"JUMP{i}"
+        if name in model.values and rest:
+            model.values[name] = float(rest[0])
+            if len(rest) > 1 and rest[1] == "1":
+                params[name].frozen = False
+            if len(rest) > 2:
+                params[name].uncertainty = float(rest[2])
+    for i, rest in enumerate(dmjump_rest, start=1):
+        name = f"DMJUMP{i}"
+        if name in model.values and rest:
+            model.values[name] = float(rest[0])
+            if len(rest) > 1 and rest[1] == "1":
+                params[name].frozen = False
+
+    unknown = [
+        k for k in pardict
+        if k not in consumed and not k.startswith("__")
+    ]
+    if unknown:
+        warnings.warn(
+            f"par parameters not (yet) supported, carried as metadata: "
+            f"{sorted(unknown)}"
+        )
+        for k in unknown:
+            model.meta.setdefault("__unknown__", {})[k] = pardict[k]
+
+    # sanity: a timing model needs a spin frequency
+    if not model.has_component("Spindown") or np.isnan(
+        model.values.get("F0", np.nan)
+    ):
+        raise ValueError("par file lacks F0 (no spindown model)")
+    return model
+
+
+def get_model_and_toas(parfile, timfile, **kw):
+    from pint_tpu.toa import get_TOAs
+
+    model = get_model(parfile)
+    planets = bool(
+        model.meta.get("PLANET_SHAPIRO", "N").upper() in ("Y", "1", "TRUE")
+    ) or bool(model.values.get("PLANET_SHAPIRO", 0.0))
+    ephem = model.meta.get("EPHEM", "builtin")
+    toas = get_TOAs(timfile, ephem=ephem, planets=planets,
+                    **kw)
+    return model, toas
+
+
+def model_to_parfile(model: TimingModel) -> str:
+    """Round-trip a model to par format."""
+    lines = []
+    for k in ("PSR", "EPHEM", "CLK", "UNITS", "TZRSITE"):
+        if k in model.meta:
+            lines.append(f"{k:<15s} {model.meta[k]}")
+    params = model.params
+    for name, p in params.items():
+        v = model.values.get(name, np.nan)
+        if isinstance(v, float) and np.isnan(v):
+            continue
+        fit = "1" if not p.frozen else "0"
+        unc = f" {p.uncertainty:.6g}" if p.uncertainty is not None else ""
+        if p.select and p.select[0] == "flag":
+            sel = f"-{p.select[1]} {p.select[2]} "
+            base = re.sub(r"\d+$", "", name)
+            lines.append(f"{base:<8s} {sel}{p.format(v)} {fit}{unc}")
+        else:
+            lines.append(f"{name:<15s} {p.format(v)} {fit}{unc}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,146 @@
+"""Component base classes and registry.
+
+Counterpart of the reference's ModelMeta/Component machinery (reference:
+src/pint/models/timing_model.py:3264-3666) with the same extension
+contract — subclassing auto-registers, so user components plug in exactly
+like builtin ones — but a functional evaluation contract:
+
+- a Component *instance* holds only static structure: parameter metadata
+  (built from the par file, so prefix/mask families are concrete), epochs
+  as exact ticks, category and ordering;
+- ``prepare(toas)`` returns a ctx dict of static per-dataset arrays
+  (boolean masks for mask params, cached geometry) that the jit closure
+  captures as constants;
+- ``delay(values, batch, ctx, delay_accum)`` / ``phase(values, batch,
+  ctx, delay)`` are pure jax functions of the dynamic parameter dict.
+
+Delay components return float64 seconds; phase components return float64
+turns (small terms) or an (int64 turns, float64 frac) pair (spindown's
+exact path).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax.numpy as jnp
+
+from pint_tpu.models.parameter import Param
+
+
+class Component:
+    """Base component.  Subclasses auto-register by class name."""
+
+    registry: Dict[str, type] = {}
+    category: str = ""
+    register: bool = True
+    #: par-file keys whose presence selects this component (builder hint)
+    trigger_params: tuple = ()
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        if cls.__dict__.get("register", True) and cls.category:
+            Component.registry[cls.__name__] = cls
+
+    def __init__(self):
+        self.params: List[Param] = []
+
+    # -- structure -----------------------------------------------------------
+    def add_param(self, p: Param):
+        self.params.append(p)
+        return p
+
+    def param(self, name) -> Param:
+        for p in self.params:
+            if p.name == name:
+                return p
+        raise KeyError(name)
+
+    def has_param(self, name) -> bool:
+        return any(p.name == name for p in self.params)
+
+    @classmethod
+    def from_parfile(cls, pardict: dict):
+        """Instantiate with concrete prefix/mask families for this par.
+        Default: fixed parameter set from params_spec()."""
+        inst = cls()
+        inst.build_params(pardict)
+        return inst
+
+    def build_params(self, pardict: dict):
+        raise NotImplementedError
+
+    def defaults(self) -> dict:
+        """Default values for this component's params (internal units)."""
+        return {}
+
+    # -- evaluation ----------------------------------------------------------
+    def prepare(self, toas, model) -> dict:
+        """Static per-dataset arrays; captured as jit constants."""
+        return {}
+
+
+class DelayComponent(Component):
+    def delay(self, values, batch, ctx, delay_accum):
+        """Return delay in seconds (float64, shape of batch)."""
+        raise NotImplementedError
+
+
+class PhaseComponent(Component):
+    def phase(self, values, batch, ctx, delay):
+        """Return phase turns: float64 array, or (int64, float64) pair."""
+        raise NotImplementedError
+
+
+def mask_from_select(select: tuple, toas) -> "jnp.ndarray":
+    """Resolve a mask-parameter selector to a boolean array over TOAs.
+
+    Selector forms (reference maskParameter semantics, parameter.py:1782):
+    ("flag", key, value) | ("mjd", lo, hi) | ("freq", lo, hi) |
+    ("tel", obsname) | ("all",)
+    """
+    import numpy as np
+
+    n = len(toas)
+    kind = select[0]
+    if kind == "all" or kind == "":
+        m = np.ones(n, dtype=bool)
+    elif kind == "flag":
+        key, val = select[1], select[2]
+        m = np.array(
+            [f.get(key) == val for f in toas.flags], dtype=bool
+        )
+    elif kind == "mjd":
+        lo, hi = float(select[1]), float(select[2])
+        m = (toas.mjd_float >= lo) & (toas.mjd_float <= hi)
+    elif kind == "freq":
+        lo, hi = float(select[1]), float(select[2])
+        m = (toas.freq_mhz >= lo) & (toas.freq_mhz <= hi)
+    elif kind == "tel":
+        from pint_tpu.obs import get_observatory
+
+        target = get_observatory(select[1]).name
+        m = np.array([o == target for o in toas.obs_names], dtype=bool)
+    else:
+        raise ValueError(f"unknown mask selector {select!r}")
+    return jnp.asarray(m)
+
+
+def parse_mask_select(tokens) -> tuple:
+    """Parse par-file mask tokens after the value, e.g.
+    ``JUMP -fe L-wide 0.001 1`` -> select ("flag","fe","L-wide").
+    ``JUMP MJD 50000 51000 ...`` -> ("mjd", 50000.0, 51000.0).
+    Returns (select, remaining_tokens)."""
+    if not tokens:
+        return ("all",), []
+    t0 = tokens[0]
+    if t0.startswith("-"):
+        return ("flag", t0.lstrip("-"), tokens[1]), tokens[2:]
+    u = t0.upper()
+    if u == "MJD":
+        return ("mjd", float(tokens[1]), float(tokens[2])), tokens[3:]
+    if u == "FREQ":
+        return ("freq", float(tokens[1]), float(tokens[2])), tokens[3:]
+    if u in ("TEL", "T"):
+        return ("tel", tokens[1]), tokens[2:]
+    return ("all",), tokens

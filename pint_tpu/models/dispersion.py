@@ -1,0 +1,174 @@
+"""Dispersion: DM Taylor series, DMX piecewise, DMJUMP.
+
+Counterpart of the reference dispersion components (reference:
+src/pint/models/dispersion_model.py:132 DispersionDM ``dispersion_time_
+delay`` at :42-52, :310 DispersionDMX, :724 DispersionJump).
+delay[s] = K * DM(t) / freq[MHz]^2 with K = 1/2.41e-4 (the community
+convention constant, pint_tpu.DM_CONST).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu import DM_CONST
+from pint_tpu.models.component import (
+    DelayComponent,
+    mask_from_select,
+)
+from pint_tpu.models.parameter import Param, prefix_index
+
+
+class DispersionDM(DelayComponent):
+    category = "dispersion_constant"
+    trigger_params = ("DM",)
+
+    def __init__(self, num_dm_derivs=0):
+        super().__init__()
+        self.num_dm_derivs = num_dm_derivs
+        self.add_param(Param("DM", units="pc cm^-3", description="Dispersion measure"))
+        for k in range(1, num_dm_derivs + 1):
+            self.add_param(Param(f"DM{k}", units=f"pc cm^-3/yr^{k}",
+                                 description=f"DM derivative {k}"))
+        self.add_param(Param("DMEPOCH", kind="mjd", fittable=False,
+                             description="Epoch of DM"))
+
+    @classmethod
+    def from_parfile(cls, pardict):
+        n = 0
+        for key in pardict:
+            pi = prefix_index(key)
+            if pi and pi[0] == "DM" and not key.startswith("DMX"):
+                n = max(n, pi[1])
+        return cls(num_dm_derivs=n)
+
+    def defaults(self):
+        d = {f"DM{k}": 0.0 for k in range(1, self.num_dm_derivs + 1)}
+        d["DM"] = 0.0
+        d["DMEPOCH"] = np.nan
+        return d
+
+    def prepare(self, toas, model):
+        ep = model.values.get("DMEPOCH", np.nan)
+        if np.isnan(ep):
+            ep = model.values.get("PEPOCH", 0.0)
+        t = toas.ticks.astype(np.float64) / 2**32
+        # DM1.. are in pc cm^-3 per YEAR^k (par-file convention; the
+        # reference evaluates dt.to(u.yr), dispersion_model.py:274)
+        return {"dt_yr": jnp.asarray((t - ep) / (365.25 * 86400.0))}
+
+    def dm_at(self, values, ctx):
+        dm = values["DM"]
+        if self.num_dm_derivs:
+            dt = ctx["dt_yr"]
+            fact = 1.0
+            power = dt
+            for k in range(1, self.num_dm_derivs + 1):
+                fact *= k
+                dm = dm + values[f"DM{k}"] * power / fact
+                power = power * dt
+        return dm
+
+    def delay(self, values, batch, ctx, delay_accum):
+        dm = self.dm_at(values, ctx)
+        return DM_CONST * dm / batch.freq_mhz**2
+
+
+class DispersionDMX(DelayComponent):
+    """Piecewise DM offsets over MJD ranges (DMX_####/DMXR1/DMXR2)."""
+
+    category = "dispersion_dmx"
+    trigger_params = ("DMX",)
+
+    def __init__(self, indices=()):
+        super().__init__()
+        self.indices = tuple(indices)
+        for i in self.indices:
+            self.add_param(Param(f"DMX_{i:04d}", units="pc cm^-3",
+                                 description=f"DM offset in range {i}"))
+            self.add_param(Param(f"DMXR1_{i:04d}", kind="mjd",
+                                 fittable=False,
+                                 description=f"DMX range {i} start"))
+            self.add_param(Param(f"DMXR2_{i:04d}", kind="mjd",
+                                 fittable=False,
+                                 description=f"DMX range {i} end"))
+
+    @classmethod
+    def from_parfile(cls, pardict):
+        idx = sorted(
+            {
+                prefix_index(k)[1]
+                for k in pardict
+                if k.startswith("DMX_") and prefix_index(k)
+            }
+        )
+        return cls(indices=idx)
+
+    def defaults(self):
+        return {f"DMX_{i:04d}": 0.0 for i in self.indices}
+
+    def prepare(self, toas, model):
+        masks = []
+        for i in self.indices:
+            lo = model.values[f"DMXR1_{i:04d}"] / 86400.0 + 51544.5
+            hi = model.values[f"DMXR2_{i:04d}"] / 86400.0 + 51544.5
+            masks.append((toas.mjd_float >= lo) & (toas.mjd_float <= hi))
+        m = (
+            np.stack(masks, axis=0)
+            if masks
+            else np.zeros((0, len(toas)), dtype=bool)
+        )
+        return {"masks": jnp.asarray(m)}
+
+    def delay(self, values, batch, ctx, delay_accum):
+        if not self.indices:
+            return jnp.zeros_like(batch.freq_mhz)
+        dmx = jnp.stack([values[f"DMX_{i:04d}"] for i in self.indices])
+        dm_per_toa = jnp.sum(ctx["masks"] * dmx[:, None], axis=0)
+        return DM_CONST * dm_per_toa / batch.freq_mhz**2
+
+
+class DispersionJump(DelayComponent):
+    """Constant DM offsets on TOA subsets (DMJUMP mask parameters);
+    conventionally fit only in wideband mode (reference:
+    dispersion_model.py:724)."""
+
+    category = "dispersion_jump"
+    trigger_params = ("DMJUMP",)
+
+    def __init__(self, selects=()):
+        super().__init__()
+        self.selects = tuple(selects)
+        for i, sel in enumerate(self.selects, start=1):
+            self.add_param(Param(f"DMJUMP{i}", units="pc cm^-3",
+                                 select=sel,
+                                 description=f"DM jump {i} ({sel})"))
+
+    @classmethod
+    def from_parfile(cls, pardict):
+        return cls(selects=pardict.get("__DMJUMP_selects__", ()))
+
+    def defaults(self):
+        return {f"DMJUMP{i}": 0.0 for i in range(1, len(self.selects) + 1)}
+
+    def prepare(self, toas, model):
+        masks = [
+            np.asarray(mask_from_select(sel, toas)) for sel in self.selects
+        ]
+        m = (
+            np.stack(masks, 0)
+            if masks
+            else np.zeros((0, len(toas)), dtype=bool)
+        )
+        return {"masks": jnp.asarray(m)}
+
+    def delay(self, values, batch, ctx, delay_accum):
+        if not self.selects:
+            return jnp.zeros_like(batch.freq_mhz)
+        dj = jnp.stack(
+            [values[f"DMJUMP{i}"] for i in range(1, len(self.selects) + 1)]
+        )
+        dm = jnp.sum(ctx["masks"] * dj[:, None], axis=0)
+        # sign: DMJUMP measures *apparent* DM offset, subtracted
+        return -DM_CONST * dm / batch.freq_mhz**2

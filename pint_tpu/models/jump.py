@@ -1,0 +1,76 @@
+"""JUMPs: per-subset phase/delay offsets (mask parameters).
+
+Counterpart of the reference jump components (reference:
+src/pint/models/jump.py:12 DelayJump, :79 PhaseJump).  A JUMP selects a
+TOA subset (flag / MJD range / freq range / telescope) and applies a
+constant offset: PhaseJump adds ``+JUMP * F0`` turns (the reference's
+convention, jump.py:135 — equivalent to DelayJump's ``-JUMP`` seconds in
+the delay chain, since phase gains ``-F0 * delay``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.models.component import (
+    DelayComponent,
+    PhaseComponent,
+    mask_from_select,
+)
+from pint_tpu.models.parameter import Param
+
+
+class _JumpBase:
+    def __init__(self, selects=()):
+        super().__init__()
+        self.selects = tuple(selects)
+        for i, sel in enumerate(self.selects, start=1):
+            self.add_param(
+                Param(f"JUMP{i}", units="s", select=sel,
+                      description=f"Jump {i} on {sel}")
+            )
+
+    @classmethod
+    def from_parfile(cls, pardict):
+        return cls(selects=pardict.get("__JUMP_selects__", ()))
+
+    def defaults(self):
+        return {f"JUMP{i}": 0.0 for i in range(1, len(self.selects) + 1)}
+
+    def prepare(self, toas, model):
+        masks = [
+            np.asarray(mask_from_select(sel, toas)) for sel in self.selects
+        ]
+        m = (
+            np.stack(masks, 0)
+            if masks
+            else np.zeros((0, len(toas)), dtype=bool)
+        )
+        return {"masks": jnp.asarray(m)}
+
+    def _total_jump_sec(self, values, ctx, n_toa):
+        if not self.selects:
+            return jnp.zeros(n_toa)
+        j = jnp.stack(
+            [values[f"JUMP{i}"] for i in range(1, len(self.selects) + 1)]
+        )
+        return jnp.sum(ctx["masks"] * j[:, None], axis=0)
+
+
+class PhaseJump(_JumpBase, PhaseComponent):
+    category = "phase_jump"
+    trigger_params = ("JUMP",)
+
+    def phase(self, values, batch, ctx, delay):
+        jump = self._total_jump_sec(values, ctx, batch.ticks.shape[0])
+        return jump * values["F0"]
+
+
+class DelayJump(_JumpBase, DelayComponent):
+    category = "jump_delay"
+    register = True
+    trigger_params = ()
+
+    def delay(self, values, batch, ctx, delay_accum):
+        return -self._total_jump_sec(values, ctx, batch.ticks.shape[0])

@@ -1,0 +1,81 @@
+"""Solar-system Shapiro delay (Sun + optionally planets).
+
+Counterpart of the reference SolarSystemShapiro (reference:
+src/pint/models/solar_system_shapiro.py:22-124, ``ss_obj_shapiro_delay``
+at :59-81): GR log-term delay -2 T_obj ln((r - r.n)/AU) using body masses
+in time units (GM/c^3).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from pint_tpu import (
+    AU_LS,
+    T_JUPITER_S,
+    T_MARS_S,
+    T_NEPTUNE_S,
+    T_SATURN_S,
+    T_SUN_S,
+    T_URANUS_S,
+    T_VENUS_S,
+)
+from pint_tpu.models.component import DelayComponent
+from pint_tpu.models.parameter import Param
+
+#: order matches TOABatch.planet_pos stacking
+_PLANET_T = (T_VENUS_S, T_MARS_S, T_JUPITER_S, T_SATURN_S, T_URANUS_S,
+             T_NEPTUNE_S)
+
+
+def _obj_shapiro(obj_pos_ls, psr_dir, t_obj):
+    """-2 T ln((r - r.n)/AU): obj_pos is obs->body [ls], psr_dir obs->psr."""
+    r = jnp.sqrt(jnp.sum(obj_pos_ls * obj_pos_ls, axis=-1))
+    rcos = jnp.sum(obj_pos_ls * psr_dir, axis=-1)
+    return -2.0 * t_obj * jnp.log((r - rcos) / AU_LS)
+
+
+class SolarSystemShapiro(DelayComponent):
+    category = "solar_system_shapiro"
+    trigger_params = ("PLANET_SHAPIRO",)
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(Param("PLANET_SHAPIRO", kind="bool", fittable=False,
+                             description="Include planetary Shapiro delays"))
+
+    def build_params(self, pardict):
+        pass
+
+    def defaults(self):
+        return {"PLANET_SHAPIRO": 0.0}
+
+    def prepare(self, toas, model):
+        return {
+            "planets": bool(model.values.get("PLANET_SHAPIRO", 0.0))
+            and toas.planets
+        }
+
+    def delay(self, values, batch, ctx, delay_accum):
+        # psr direction from the astrometry component's parameters: the
+        # chain gives us only accumulated delay, so recompute the unit
+        # vector from RAJ/DECJ (or ELONG/ELAT) present in values.
+        n = _psr_dir_from_values(values)
+        d = _obj_shapiro(batch.obs_sun_pos, n, T_SUN_S)
+        if ctx["planets"]:
+            for i, t_obj in enumerate(_PLANET_T):
+                d = d + _obj_shapiro(batch.planet_pos[i], n, t_obj)
+        return d
+
+
+def _psr_dir_from_values(values):
+    """Pulsar unit vector (no PM propagation — Shapiro is insensitive at
+    the sub-ns level to mas-scale position changes)."""
+    import numpy as np
+
+    from pint_tpu.models.astrometry import _EQ_FROM_ECL, _unit_vector
+
+    if "RAJ" in values:
+        return _unit_vector(values["RAJ"], values["DECJ"])
+    necl = _unit_vector(values["ELONG"], values["ELAT"])
+    return necl @ _EQ_FROM_ECL.T

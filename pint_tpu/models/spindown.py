@@ -1,0 +1,80 @@
+"""Spindown: rotation-phase Taylor series.
+
+Counterpart of the reference Spindown (reference: src/pint/models/
+spindown.py:20-225 ``spindown_phase`` via longdouble taylor_horner).
+TPU redesign: the dominant F0*(t-PEPOCH) term goes through the exact
+fixed-point path (:func:`pint_tpu.fixedpoint.phase_f0_t` — int64 ticks,
+custom-JVP differentiable); every higher-order term F1, F2, ... is
+float64, where even sloppy TPU arithmetic leaves < 1e-7 turns (see
+fixedpoint module error budget).  The delay enters as
+-F0*delay - F1*dt*delay + ... i.e. the series is evaluated at
+dt = t - PEPOCH - delay with the large product split off exactly.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from pint_tpu import fixedpoint as fp
+from pint_tpu.models.component import PhaseComponent
+from pint_tpu.models.parameter import Param, prefix_index
+
+
+class Spindown(PhaseComponent):
+    category = "spindown"
+    trigger_params = ("F0",)
+
+    def __init__(self, num_freq_derivs=1):
+        super().__init__()
+        self.num_freq_derivs = num_freq_derivs
+        self.add_param(Param("F0", units="Hz", description="Spin frequency"))
+        for k in range(1, num_freq_derivs + 1):
+            self.add_param(
+                Param(f"F{k}", units=f"Hz/s^{k}",
+                      description=f"Spin frequency derivative {k}")
+            )
+        self.add_param(
+            Param("PEPOCH", kind="mjd", fittable=False,
+                  description="Epoch of spin parameters")
+        )
+
+    @classmethod
+    def from_parfile(cls, pardict):
+        nderiv = 0
+        for key in pardict:
+            pi = prefix_index(key)
+            if pi and pi[0] == "F":
+                nderiv = max(nderiv, pi[1])
+        return cls(num_freq_derivs=max(nderiv, 1))
+
+    def defaults(self):
+        d = {f"F{k}": 0.0 for k in range(1, self.num_freq_derivs + 1)}
+        d["PEPOCH"] = 0.0
+        return d
+
+    def prepare(self, toas, model):
+        # exact ticks from the par parse when available (f64 seconds would
+        # cost ~6e-8 s of epoch rounding — absorbed by TZR/mean, but keep
+        # the exact path exact)
+        pepoch_ticks = getattr(model, "epoch_ticks", {}).get(
+            "PEPOCH", int(round(model.values["PEPOCH"] * 2**32))
+        )
+        return {
+            "dt_ticks": jnp.asarray(toas.ticks) - jnp.int64(pepoch_ticks)
+        }
+
+    def phase(self, values, batch, ctx, delay):
+        dt_ticks = ctx["dt_ticks"]
+        f0 = values["F0"]
+        # exact giant term F0*(t - PEPOCH)
+        n, frac = fp.phase_f0_t(f0, dt_ticks)
+        # remaining terms in f64: -F0*delay + sum_k Fk dt^(k+1)/(k+1)!
+        dt = fp.ticks_to_seconds(dt_ticks) - delay
+        small = -f0 * delay
+        fact = 1.0
+        power = dt * dt
+        for k in range(1, self.num_freq_derivs + 1):
+            fact *= k + 1
+            small = small + values[f"F{k}"] * power / fact
+            power = power * dt
+        return n, frac + small

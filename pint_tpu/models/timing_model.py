@@ -1,0 +1,247 @@
+"""TimingModel: ordered component container and the pure phase function.
+
+Counterpart of the reference's TimingModel (reference:
+src/pint/models/timing_model.py:169,1515,1548 ``delay``/``phase``), with
+the evaluation made explicitly functional: a prepared model exposes
+
+    phase(values)  = (n_turns int64, frac float64)    [jit-compiled]
+
+computed as the sequential delay fold (each delay component sees the
+accumulated delay, matching the reference's chain semantics) followed by
+the phase components and the TZR-phase subtraction.  ``values`` is a
+``{param_name: f64 scalar}`` dict — a JAX pytree — so the same compiled
+function serves fitting, vmapped grids, and MCMC.
+
+Design matrices come from ``jax.jacfwd`` of the fractional phase
+(replacing the reference's hand-derivative registry and its 124-s
+designmatrix hot spot, profiling/README.txt:58-62).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu import fixedpoint as fp
+from pint_tpu.models.component import Component, DelayComponent, PhaseComponent
+
+#: evaluation order by category (reference DEFAULT_ORDER,
+#: timing_model.py:107-123)
+DEFAULT_ORDER = [
+    "astrometry",
+    "jump_delay",
+    "troposphere",
+    "solar_system_shapiro",
+    "solar_wind",
+    "dispersion_constant",
+    "dispersion_dmx",
+    "dispersion_jump",
+    "chromatic",
+    "pulsar_system",
+    "frequency_dependent",
+    "absolute_phase",
+    "spindown",
+    "phase_jump",
+    "glitch",
+    "piecewise",
+    "wave",
+    "wavex",
+    "ifunc",
+    "phase_offset",
+]
+
+
+class TimingModel:
+    """Host-side model object: components + parameter metadata + values."""
+
+    def __init__(self, name="", components=()):
+        self.name = name
+        self.components: List[Component] = []
+        self.values: Dict[str, float] = {}
+        self.meta: Dict[str, str] = {}  # PSR, EPHEM, CLK, UNITS ...
+        for c in components:
+            self.add_component(c)
+
+    # -- structure -----------------------------------------------------------
+    def add_component(self, comp: Component):
+        self.components.append(comp)
+        order = {cat: i for i, cat in enumerate(DEFAULT_ORDER)}
+        self.components.sort(key=lambda c: order.get(c.category, 99))
+        for p in comp.params:
+            self.values.setdefault(p.name, np.nan)
+        for k, v in comp.defaults().items():
+            if np.isnan(self.values.get(k, np.nan)):
+                self.values[k] = v
+
+    def remove_component(self, comp_or_name):
+        name = (
+            comp_or_name
+            if isinstance(comp_or_name, str)
+            else type(comp_or_name).__name__
+        )
+        self.components = [
+            c for c in self.components if type(c).__name__ != name
+        ]
+
+    def component(self, name) -> Component:
+        for c in self.components:
+            if type(c).__name__ == name:
+                return c
+        raise KeyError(name)
+
+    def has_component(self, name) -> bool:
+        return any(type(c).__name__ == name for c in self.components)
+
+    @property
+    def params(self) -> Dict[str, "Param"]:
+        out = {}
+        for c in self.components:
+            for p in c.params:
+                out[p.name] = p
+        return out
+
+    @property
+    def free_params(self) -> List[str]:
+        return [name for name, p in self.params.items() if not p.frozen]
+
+    @free_params.setter
+    def free_params(self, names):
+        names = set(names)
+        for _, p in self.params.items():
+            p.frozen = p.name not in names
+
+    @property
+    def delay_components(self):
+        return [c for c in self.components if isinstance(c, DelayComponent)]
+
+    @property
+    def phase_components(self):
+        return [c for c in self.components if isinstance(c, PhaseComponent)]
+
+    def __getitem__(self, name):
+        return self.values[name]
+
+    def __setitem__(self, name, value):
+        if name not in self.values:
+            raise KeyError(name)
+        self.values[name] = float(value)
+
+    # -- preparation ---------------------------------------------------------
+    def prepare(self, toas) -> "PreparedModel":
+        return PreparedModel(self, toas)
+
+    # -- output --------------------------------------------------------------
+    def as_parfile(self) -> str:
+        from pint_tpu.models.builder import model_to_parfile
+
+        return model_to_parfile(self)
+
+
+class PreparedModel:
+    """Model bound to a dataset: static ctx captured, pure fns jitted.
+
+    The reference recomputes mask selections and TZR phase lazily per call
+    (toa_select.py caching, absolute_phase.py:get_TZR_toa); here they are
+    resolved once, into jit-closure constants.
+    """
+
+    def __init__(self, model: TimingModel, toas):
+        self.model = model
+        self.toas = toas
+        self.batch = toas.to_batch()
+        self.ctx = {
+            type(c).__name__: c.prepare(toas, model) for c in model.components
+        }
+        # TZR reference: a single synthetic TOA evaluated through the SAME
+        # chain — but with its OWN prepare-time ctx (masks, dt_ticks, ...);
+        # reusing the data ctx would silently evaluate TZR with data-TOA
+        # static arrays (caught by simulate->fit self-consistency).
+        self.tzr_batch = None
+        self.tzr_ctx = None
+        for c in model.components:
+            if hasattr(c, "make_tzr_toas"):
+                tzr_toas = c.make_tzr_toas(model, toas)
+                if tzr_toas is not None:
+                    self.tzr_batch = tzr_toas.to_batch()
+                    self.tzr_ctx = {
+                        type(cc).__name__: cc.prepare(tzr_toas, model)
+                        for cc in model.components
+                    }
+        self._phase_jit = jax.jit(self._phase_raw)
+
+    # pure function of values (pytree dict of f64 scalars)
+    def _delay_raw(self, values, batch, ctx_map):
+        total = jnp.zeros(batch.ticks.shape, dtype=jnp.float64)
+        for c in self.model.delay_components:
+            ctx = ctx_map[type(c).__name__]
+            total = total + c.delay(values, batch, ctx, total)
+        return total
+
+    def _phase_sum(self, values, batch, ctx_map):
+        delay = self._delay_raw(values, batch, ctx_map)
+        n = jnp.zeros(batch.ticks.shape, dtype=jnp.int64)
+        frac = jnp.zeros(batch.ticks.shape, dtype=jnp.float64)
+        for c in self.model.phase_components:
+            ctx = ctx_map[type(c).__name__]
+            ph = c.phase(values, batch, ctx, delay)
+            if isinstance(ph, tuple):
+                n = n + ph[0]
+                frac = frac + ph[1]
+            else:
+                frac = frac + ph
+        return n, frac
+
+    def _phase_raw(self, values):
+        n, frac = self._phase_sum(values, self.batch, self.ctx)
+        if self.tzr_batch is not None:
+            tn, tfrac = self._phase_sum(values, self.tzr_batch, self.tzr_ctx)
+            n = n - tn[0]
+            frac = frac - tfrac[0]
+        return fp.renorm_phase(n, frac)
+
+    # -- public API ----------------------------------------------------------
+    def delay(self, values=None):
+        """Total delay [s] at the model's TOAs."""
+        v = self._values_pytree(values)
+        return self._delay_raw(v, self.batch, self.ctx)
+
+    def phase(self, values=None):
+        """(int64 turns, f64 frac) at the model's TOAs, TZR-referenced."""
+        return self._phase_jit(self._values_pytree(values))
+
+    def _values_pytree(self, values=None):
+        v = dict(self.model.values) if values is None else dict(values)
+        return {k: jnp.float64(x) for k, x in v.items()}
+
+    # free-parameter vector interface (for fitters/grids)
+    def values_to_vector(self, values=None) -> jnp.ndarray:
+        v = self.model.values if values is None else values
+        return jnp.array(
+            [v[name] for name in self.model.free_params], dtype=jnp.float64
+        )
+
+    def vector_to_values(self, vec, base=None):
+        out = dict(self.model.values if base is None else base)
+        for i, name in enumerate(self.model.free_params):
+            out[name] = vec[i]
+        return out
+
+    def frac_phase_fn(self):
+        """values_vector -> frac turns (f64), for jacfwd design matrices."""
+
+        def fn(vec):
+            values = self.vector_to_values_traced(vec)
+            _, frac = self._phase_raw(values)
+            return frac
+
+        return fn
+
+    def vector_to_values_traced(self, vec):
+        out = {k: jnp.float64(v) for k, v in self.model.values.items()}
+        for i, name in enumerate(self.model.free_params):
+            out[name] = vec[i]
+        return out
