@@ -1,0 +1,96 @@
+"""Driver benchmark: chi^2-grid points/sec vs the reference baseline.
+
+Mirrors the reference's profiling/bench_chisq_grid_WLSFitter.py shape —
+a 2-D chi^2 grid where every point refits the remaining free parameters
+by WLS — but as ONE vmapped XLA program instead of a process pool
+(BASELINE.md: reference total 176.437 s for a 3x3 grid on one CPU core
+=> 0.0510 points/sec; design-matrix construction alone was 121.5 s).
+
+Prints one JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Runs on whatever backend JAX selects (the real TPU under the driver).
+"""
+
+import json
+import sys
+import time
+import warnings
+
+import numpy as np
+
+warnings.filterwarnings("ignore")
+
+BASELINE_POINTS_PER_SEC = 9 / 176.437  # reference WLS grid benchmark
+
+
+def main():
+    import os
+
+    if os.environ.get("PINT_TPU_BENCH_CPU"):  # debug/smoke escape hatch
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        try:
+            jax.clear_backends()
+        except Exception:
+            pass
+    import jax
+
+    import pint_tpu  # noqa: F401  (x64)
+    from pint_tpu.grid import grid_chisq_vectorized
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    backend = jax.default_backend()
+
+    # Benchmark problem: NGC6440E model; simulated TOA set at the scale of
+    # the reference's J0740 benchmark (~10k TOAs) so the per-point work is
+    # comparable; grid over (F0, F1) with 3 remaining free params refit
+    # per point by 3 Gauss-Newton WLS iterations (the reference fitter
+    # also iterates per point).
+    m = get_model("/root/reference/profiling/NGC6440E.par")
+    n_toas = 10000
+    freqs = np.where(np.arange(n_toas) % 2 == 0, 1400.0, 800.0)
+    toas = make_fake_toas_uniform(
+        53000, 56500, n_toas, m, freq_mhz=freqs, obs="gbt", error_us=1.0,
+        add_noise=True,
+    )
+
+    sig_f0 = 2e-12
+    sig_f1 = 2e-19
+    n_side = 16  # 256 grid points (reference did 9)
+    f0s = m.values["F0"] + np.linspace(-2, 2, n_side) * sig_f0
+    f1s = m.values["F1"] + np.linspace(-2, 2, n_side) * sig_f1
+    mesh = np.array([(a, b) for a in f0s for b in f1s])
+
+    # warmup / compile
+    t0 = time.time()
+    chi2, _ = grid_chisq_vectorized(toas, m, ["F0", "F1"], mesh[:8],
+                                    n_steps=3)
+    compile_s = time.time() - t0
+
+    t0 = time.time()
+    chi2, _ = grid_chisq_vectorized(toas, m, ["F0", "F1"], mesh, n_steps=3)
+    wall = time.time() - t0
+    pts_per_sec = len(mesh) / wall
+
+    assert np.all(np.isfinite(chi2)), "grid produced non-finite chi2"
+    # chi2 surface must be convex-ish with minimum near center
+    imin = int(np.argmin(chi2))
+    print(
+        json.dumps(
+            {
+                "metric": "wls_chisq_grid_points_per_sec",
+                "value": round(pts_per_sec, 3),
+                "unit": f"grid points/s ({n_toas} TOAs, 3 GN iters/pt, "
+                f"backend={backend}, compile={compile_s:.1f}s, "
+                f"min@{imin})",
+                "vs_baseline": round(pts_per_sec / BASELINE_POINTS_PER_SEC, 1),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,7 +20,34 @@ import numpy as np
 
 from pint_tpu.residuals import Residuals
 
-__all__ = ["WLSFitter", "Fitter"]
+__all__ = ["WLSFitter", "Fitter", "wls_gn_solve"]
+
+
+def wls_gn_solve(resid_fn, vec, err, threshold=1e-14):
+    """One whitened, column-normalized SVD Gauss-Newton step.
+
+    The shared numerical core of WLSFitter and the vmapped grid (one
+    implementation, one threshold).  resid_fn(vec) -> residuals [s].
+    Returns (new_vec, chi2_before, dpar, covariance).
+    """
+    r = resid_fn(vec)
+    J = jax.jacfwd(resid_fn)(vec)  # (N, P) d resid / d param
+    w = 1.0 / err
+    rw = r * w
+    Jw = J * w[:, None]
+    # column normalize (reference: utils.normalize_designmatrix)
+    norms = jnp.sqrt(jnp.sum(Jw * Jw, axis=0))
+    norms = jnp.where(norms == 0, 1.0, norms)
+    Jn = Jw / norms[None, :]
+    U, s, Vt = jnp.linalg.svd(Jn, full_matrices=False)
+    smax = jnp.max(s)
+    s_inv = jnp.where(s > threshold * smax, 1.0 / s, 0.0)
+    dpar_n = -(Vt.T * s_inv[None, :]) @ (U.T @ rw)
+    dpar = dpar_n / norms
+    cov_n = (Vt.T * s_inv[None, :] ** 2) @ Vt
+    cov = cov_n / jnp.outer(norms, norms)
+    chi2 = jnp.sum(rw * rw)
+    return vec + dpar, chi2, dpar, cov
 
 
 class Fitter:
@@ -72,32 +99,20 @@ class WLSFitter(Fitter):
         self.threshold = threshold
         self._step_jit = jax.jit(self._step)
 
-    def _resid_vec_fn(self, vec):
-        values = self.prepared.vector_to_values_traced(vec)
-        return self.resids.time_resids_fn(values)
+    def _step(self, vec, base_values):
+        """One Gauss-Newton WLS step.  base_values (the full values dict,
+        including frozen params) is a dynamic argument so that edits to
+        frozen parameters between fits take effect without retracing."""
 
-    def _step(self, vec):
-        """One Gauss-Newton WLS step: returns (new_vec, chi2_before,
-        dpars, unscaled covariance)."""
-        r = self._resid_vec_fn(vec)
-        J = jax.jacfwd(self._resid_vec_fn)(vec)  # (N, P) d resid / d param
-        err = self.prepared.batch.error_s
-        w = 1.0 / err
-        rw = r * w
-        Jw = J * w[:, None]
-        # column normalize (reference: utils.normalize_designmatrix)
-        norms = jnp.sqrt(jnp.sum(Jw * Jw, axis=0))
-        norms = jnp.where(norms == 0, 1.0, norms)
-        Jn = Jw / norms[None, :]
-        U, s, Vt = jnp.linalg.svd(Jn, full_matrices=False)
-        smax = jnp.max(s)
-        s_inv = jnp.where(s > self.threshold * smax, 1.0 / s, 0.0)
-        dpar_n = -(Vt.T * s_inv[None, :]) @ (U.T @ rw)
-        dpar = dpar_n / norms
-        cov_n = (Vt.T * s_inv[None, :] ** 2) @ Vt
-        cov = cov_n / jnp.outer(norms, norms)
-        chi2 = jnp.sum(rw * rw)
-        return vec + dpar, chi2, dpar, cov
+        def resid_fn(v):
+            values = dict(base_values)
+            for i, name in enumerate(self.model.free_params):
+                values[name] = v[i]
+            return self.resids.time_resids_fn(values)
+
+        return wls_gn_solve(
+            resid_fn, vec, self.prepared.batch.error_s, self.threshold
+        )
 
     def fit_toas(self, maxiter=3):
         """Iterate Gauss-Newton steps; write back values + uncertainties."""
@@ -107,10 +122,11 @@ class WLSFitter(Fitter):
                 "in the par file or clear Param.frozen)"
             )
         vec = self.prepared.values_to_vector()
+        base = self.prepared._values_pytree()
         chi2_prev = None
         cov = None
         for _ in range(maxiter):
-            vec, chi2, dpar, cov = self._step_jit(vec)
+            vec, chi2, dpar, cov = self._step_jit(vec, base)
             if chi2_prev is not None and abs(float(chi2_prev) - float(chi2)) \
                     < 1e-8 * max(float(chi2), 1.0):
                 break

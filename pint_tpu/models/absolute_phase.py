@@ -63,7 +63,9 @@ class AbsPhase(PhaseComponent):
 
         day, num, den = mjd_string_to_day_frac(raw)
         tzr = TOA(day, num, den, 0.0, freq, site, {}, name="TZR")
-        return TOAs([tzr], ephem=toas.ephem, planets=toas.planets)
+        out = TOAs([tzr], ephem=toas.ephem, planets=toas.planets)
+        out.is_tzr = True  # lets components opt out at the TZR TOA
+        return out
 
 
 class PhaseOffset(PhaseComponent):
@@ -84,5 +86,13 @@ class PhaseOffset(PhaseComponent):
     def defaults(self):
         return {"PHOFF": 0.0}
 
+    def prepare(self, toas, model):
+        # PHOFF must NOT apply at the TZR TOA or it cancels out of the
+        # TZR-referenced residuals entirely (reference phase_offset.py
+        # returns 0 for the TZR TOA for exactly this reason)
+        return {"apply": not getattr(toas, "is_tzr", False)}
+
     def phase(self, values, batch, ctx, delay):
+        if not ctx["apply"]:
+            return jnp.zeros_like(delay)
         return -values["PHOFF"] * jnp.ones_like(delay)

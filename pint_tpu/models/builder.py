@@ -71,11 +71,15 @@ _ALIASES = {
 def parse_parfile(path_or_text: str) -> Dict[str, List[List[str]]]:
     """Tokenize a par file: {KEY: [tokens-after-key, ...]} (repeats kept,
     e.g. multiple JUMP lines; reference model_builder.py:59)."""
-    if "\n" in path_or_text or not os.path.exists(path_or_text):
+    if "\n" in path_or_text:
         text = path_or_text
-    else:
+    elif os.path.exists(path_or_text):
         with open(path_or_text) as f:
             text = f.read()
+    else:
+        # a single line without newline is a path, not par text — a typo'd
+        # filename must not be silently tokenized as parameters
+        raise FileNotFoundError(f"par file not found: {path_or_text!r}")
     out: Dict[str, List[List[str]]] = {}
     for raw in text.splitlines():
         line = raw.split("#")[0].rstrip()
@@ -197,7 +201,7 @@ def get_model(parfile) -> TimingModel:
         name = f"JUMP{i}"
         if name in model.values and rest:
             model.values[name] = float(rest[0])
-            if len(rest) > 1 and rest[1] == "1":
+            if len(rest) > 1 and rest[1] in ("1", "2"):
                 params[name].frozen = False
             if len(rest) > 2:
                 params[name].uncertainty = float(rest[2])
@@ -205,7 +209,7 @@ def get_model(parfile) -> TimingModel:
         name = f"DMJUMP{i}"
         if name in model.values and rest:
             model.values[name] = float(rest[0])
-            if len(rest) > 1 and rest[1] == "1":
+            if len(rest) > 1 and rest[1] in ("1", "2"):
                 params[name].frozen = False
 
     unknown = [
@@ -254,8 +258,16 @@ def model_to_parfile(model: TimingModel) -> str:
             continue
         fit = "1" if not p.frozen else "0"
         unc = f" {p.uncertainty:.6g}" if p.uncertainty is not None else ""
-        if p.select and p.select[0] == "flag":
-            sel = f"-{p.select[1]} {p.select[2]} "
+        if p.select:
+            kind = p.select[0]
+            if kind == "flag":
+                sel = f"-{p.select[1]} {p.select[2]} "
+            elif kind in ("mjd", "freq"):
+                sel = f"{kind.upper()} {p.select[1]} {p.select[2]} "
+            elif kind == "tel":
+                sel = f"TEL {p.select[1]} "
+            else:
+                sel = ""
             base = re.sub(r"\d+$", "", name)
             lines.append(f"{base:<8s} {sel}{p.format(v)} {fit}{unc}")
         else:

@@ -24,7 +24,7 @@ Kinds:
 from __future__ import annotations
 
 import re
-from dataclasses import dataclass, field, replace
+from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 import numpy as np
@@ -136,15 +136,3 @@ class Param:
             return "Y" if value else "N"
         return repr(value / self.scale) if self.scale != 1.0 else f"{value:.{ndigits}g}"
 
-    def with_index(self, index: int, **overrides) -> "Param":
-        """Instantiate a prefix-template param for a concrete index."""
-        base = self.name.rstrip("#")
-        sep = "_" if base.endswith("_") else ""
-        new = replace(
-            self,
-            name=f"{base}{index}" if not sep else f"{base}{index:04d}",
-            kind="float" if self.kind == "prefix" else self.kind,
-        )
-        for k, v in overrides.items():
-            setattr(new, k, v)
-        return new
