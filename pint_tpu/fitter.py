@@ -97,16 +97,26 @@ class WLSFitter(Fitter):
     def __init__(self, toas, model, residuals=None, threshold=1e-14):
         super().__init__(toas, model, residuals)
         self.threshold = threshold
+        self._retrace()
+
+    def _retrace(self):
+        """(Re)build the jitted step for the current free-param set.
+        The trace closes over the free-param *names*; a changed free set
+        with the same count would otherwise hit the stale jit cache and
+        silently write steps into the wrong parameters."""
+        self._traced_free = tuple(self.model.free_params)
         self._step_jit = jax.jit(self._step)
 
     def _step(self, vec, base_values):
         """One Gauss-Newton WLS step.  base_values (the full values dict,
         including frozen params) is a dynamic argument so that edits to
-        frozen parameters between fits take effect without retracing."""
+        frozen parameters between fits take effect without retracing;
+        changes to WHICH params are free go through _retrace()."""
+        free = self._traced_free
 
         def resid_fn(v):
             values = dict(base_values)
-            for i, name in enumerate(self.model.free_params):
+            for i, name in enumerate(free):
                 values[name] = v[i]
             return self.resids.time_resids_fn(values)
 
@@ -121,6 +131,8 @@ class WLSFitter(Fitter):
                 "no free parameters to fit (mark them with a '1' fit flag "
                 "in the par file or clear Param.frozen)"
             )
+        if tuple(self.model.free_params) != self._traced_free:
+            self._retrace()
         vec = self.prepared.values_to_vector()
         base = self.prepared._values_pytree()
         chi2_prev = None

@@ -19,7 +19,7 @@ import numpy as np
 
 from pint_tpu.residuals import Residuals
 
-__all__ = ["grid_chisq", "grid_chisq_vectorized"]
+__all__ = ["grid_chisq", "grid_chisq_vectorized", "make_grid_fn"]
 
 
 def _make_fit_one(prepared, resids, grid_params, fit_params, n_steps):
@@ -50,13 +50,27 @@ def _make_fit_one(prepared, resids, grid_params, fit_params, n_steps):
 
     def fit_one(grid_vec):
         vec = fit0
-        for _ in range(n_steps):  # unrolled: small fixed count
-            vec = gn_step(vec, grid_vec)
+        if fit_params:  # all-params-gridded case: plain chi2 evaluation
+            for _ in range(n_steps):  # unrolled: small fixed count
+                vec = gn_step(vec, grid_vec)
         r = resid_of(vec, grid_vec)
         chi2 = jnp.sum((r / err) ** 2)
         return chi2, vec
 
     return fit_one
+
+
+def make_grid_fn(toas, model, grid_params, n_steps=3):
+    """Compile once, call many times: returns (fn, fit_params) where
+    fn(grid_values (n,k)) -> (chi2 (n,), fitted (n, nfree)).  Lets
+    callers (bench, repeated scans) reuse the jitted program."""
+    resids = Residuals(toas, model)
+    prepared = resids.prepared
+    grid_params = list(grid_params)
+    fit_params = [p for p in model.free_params if p not in grid_params]
+    fit_one = _make_fit_one(prepared, resids, grid_params, fit_params,
+                            n_steps)
+    return jax.jit(jax.vmap(fit_one)), fit_params
 
 
 def grid_chisq_vectorized(
@@ -69,13 +83,7 @@ def grid_chisq_vectorized(
     device memory for very large grids.
     """
     grid_values = jnp.asarray(grid_values, dtype=jnp.float64)
-    resids = Residuals(toas, model)
-    prepared = resids.prepared
-    grid_params = list(grid_params)
-    fit_params = [p for p in model.free_params if p not in grid_params]
-    fit_one = _make_fit_one(prepared, resids, grid_params, fit_params,
-                            n_steps)
-    fn = jax.jit(jax.vmap(fit_one))
+    fn, _ = make_grid_fn(toas, model, grid_params, n_steps)
     if chunk is None or grid_values.shape[0] <= chunk:
         chi2, fitted = fn(grid_values)
     else:

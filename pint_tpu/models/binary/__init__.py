@@ -1,0 +1,27 @@
+"""Binary (pulsar_system) delay components.
+
+Counterpart of the reference's two-layer binary design (PINT-facing
+``PulsarBinary`` wrapper, reference: src/pint/models/pulsar_binary.py:40,
+over unitless ``stand_alone_psr_binaries`` engines).  TPU redesign: one
+layer — each binary family is a :class:`BinaryComponent` whose
+``delay(values, batch, ctx, accum)`` is a pure jax function; all
+parameter derivatives come from autodiff of that function (the
+reference's ``d_binarydelay_d_xxxx`` chain-rule registry has no
+equivalent here by construction).
+
+Families land in submodules: ``ell1`` (ELL1/ELL1H/ELL1k), ``bt`` (BT),
+``dd`` (DD/DDS/DDH/DDK/DDGR).
+"""
+
+from pint_tpu.models.binary.base import BinaryComponent, get_binary_class
+from pint_tpu.models.binary.ell1 import BinaryELL1, BinaryELL1H, BinaryELL1k  # noqa: F401
+from pint_tpu.models.binary.bt import BinaryBT  # noqa: F401
+from pint_tpu.models.binary.dd import (  # noqa: F401
+    BinaryDD,
+    BinaryDDGR,
+    BinaryDDH,
+    BinaryDDK,
+    BinaryDDS,
+)
+
+__all__ = ["BinaryComponent", "get_binary_class"]

@@ -1,0 +1,155 @@
+"""Shared binary-component machinery: orbit phase, epochs, Shapiro.
+
+Reference counterparts: PulsarBinary parameter set (pulsar_binary.py:
+88-205), OrbitPB/OrbitFBX (stand_alone_psr_binaries/binary_orbits.py),
+PSR_BINARY base (binary_generic.py:17).  Here the orbit abstraction is a
+pair of closed-form jax expressions (orbit count and orbital frequency)
+selected *statically* at model build from the par file's
+parameterization (PB vs FB0...), so the jitted delay has no branches.
+
+Internal units: PB seconds; PBDOT/XPBDOT s/s (tempo 1e-12 rule applied
+at parse); A1 light-seconds == seconds; XDOT s/s; FBk Hz s^{1-k};
+epochs TDB seconds since J2000 (exact ticks kept for the base offset);
+M2 solar masses; angles radians; OMDOT rad/s.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from pint_tpu import SECS_PER_DAY, SECS_PER_JULIAN_YEAR, T_SUN_S
+from pint_tpu import fixedpoint as fp
+from pint_tpu.models.component import BINARY_MODELS, DelayComponent
+from pint_tpu.models.parameter import Param, prefix_index
+
+#: deg/yr -> rad/s (OMDOT par units)
+DEG_PER_YEAR = jnp.pi / 180.0 / SECS_PER_JULIAN_YEAR
+
+
+def get_binary_class(name: str) -> type:
+    try:
+        return BINARY_MODELS[name.upper()]
+    except KeyError:
+        raise NotImplementedError(
+            f"BINARY {name} not implemented (available: "
+            f"{sorted(BINARY_MODELS)})"
+        ) from None
+
+
+class BinaryComponent(DelayComponent):
+    """Base for binary families.  Subclasses set ``binary_name`` and
+    ``epoch_param`` ('T0' or 'TASC') and implement ``binary_delay``."""
+
+    category = "pulsar_system"
+    binary_name: str = ""
+    epoch_param: str = "T0"
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        if cls.__dict__.get("binary_name"):
+            BINARY_MODELS[cls.binary_name.upper()] = cls
+
+    def __init__(self, fb_terms=None):
+        super().__init__()
+        #: None => PB parameterization; int n => FB0..FBn
+        self.fb_terms = fb_terms
+
+    # -- common parameter groups --------------------------------------------
+    def add_orbit_params(self, pardict):
+        nfb = None
+        for key in pardict:
+            pi = prefix_index(key)
+            if pi and pi[0] == "FB":
+                nfb = max(nfb if nfb is not None else 0, pi[1])
+        self.fb_terms = nfb
+        if nfb is not None:
+            for k in range(nfb + 1):
+                self.add_param(Param(
+                    f"FB{k}", units=f"1/s^{k+1}",
+                    description=f"Orbital frequency derivative {k}"))
+        else:
+            self.add_param(Param("PB", units="s", scale=SECS_PER_DAY,
+                                 description="Orbital period (par: days)"))
+            self.add_param(Param("PBDOT", unit_scale=True,
+                                 description="Orbital period derivative"))
+            self.add_param(Param("XPBDOT", unit_scale=True,
+                                 description="Excess PBDOT vs GR"))
+        self.add_param(Param(self.epoch_param, kind="mjd",
+                             description="Orbit reference epoch"))
+
+    def add_a1_params(self):
+        self.add_param(Param("A1", units="ls",
+                             description="Projected semi-major axis"))
+        self.add_param(Param("XDOT", unit_scale=True, aliases=("A1DOT",),
+                             description="Rate of change of A1"))
+
+    def add_shapiro_params(self):
+        self.add_param(Param("M2", units="Msun",
+                             description="Companion mass"))
+        self.add_param(Param("SINI", description="Sine of inclination"))
+
+    def orbit_defaults(self):
+        d = {self.epoch_param: 0.0}
+        if self.fb_terms is not None:
+            d.update({f"FB{k}": 0.0 for k in range(self.fb_terms + 1)})
+        else:
+            d.update({"PB": jnp.nan, "PBDOT": 0.0, "XPBDOT": 0.0})
+        return d
+
+    # -- evaluation helpers --------------------------------------------------
+    def prepare(self, toas, model):
+        ticks = getattr(model, "epoch_ticks", {}).get(
+            self.epoch_param,
+            int(round(model.values[self.epoch_param] * 2**32)),
+        )
+        return {
+            "dt0": fp.ticks_to_seconds(
+                jnp.asarray(toas.ticks) - jnp.int64(ticks)
+            ),
+            "epoch_ref": jnp.float64(ticks / 2**32),
+        }
+
+    def dt_epoch(self, values, ctx, accum):
+        """Barycentric time since the orbit epoch [s]: exact tick base,
+        differentiable epoch shift, minus the accumulated delay chain
+        (reference: pulsar_binary.py:396 barycentric_time = tdbld - acc)."""
+        return ctx["dt0"] - (values[self.epoch_param] - ctx["epoch_ref"]) \
+            - accum
+
+    def orbits_and_freq(self, values, dt):
+        """(orbit count since epoch, orbital frequency [1/s]) at dt."""
+        if self.fb_terms is not None:
+            # orbits = sum_k FBk dt^(k+1)/(k+1)!,  freq = d orbits / d dt
+            orbits = jnp.zeros_like(dt)
+            freq = jnp.zeros_like(dt)
+            k_fact = 1.0  # k!
+            power = jnp.ones_like(dt)  # dt^k
+            for k in range(self.fb_terms + 1):
+                if k > 0:
+                    k_fact *= k
+                    power = power * dt
+                fbk = values[f"FB{k}"]
+                freq = freq + fbk * power / k_fact
+                orbits = orbits + fbk * power * dt / (k_fact * (k + 1))
+            return orbits, freq
+        pb = values["PB"]
+        pbd = values["PBDOT"] + values["XPBDOT"]
+        u_ = dt / pb
+        return u_ - 0.5 * pbd * u_ * u_, (1.0 - pbd * u_) / pb
+
+    def orbit_phase(self, orbits):
+        """Orbit phase angle in (-pi, pi]: reduce the orbit count before
+        scaling by 2*pi so trig sees a small argument."""
+        return 2.0 * jnp.pi * (orbits - jnp.round(orbits))
+
+    def shapiro_m2sini(self, values, sin_phi_term):
+        """-2 T_sun M2 ln(1 - SINI * s) with s the orbital-geometry
+        factor (sin Phi for ELL1; DD passes its full bracket)."""
+        return -2.0 * T_SUN_S * values["M2"] * jnp.log(sin_phi_term)
+
+    def delay(self, values, batch, ctx, delay_accum):
+        dt = self.dt_epoch(values, ctx, delay_accum)
+        return self.binary_delay(values, dt, ctx)
+
+    def binary_delay(self, values, dt, ctx):
+        raise NotImplementedError

@@ -1,0 +1,79 @@
+"""BT binary model (Blandford & Teukolsky 1976).
+
+Reference counterpart: stand_alone_psr_binaries/BT_model.py (delayL1,
+delayL2, delayR composition) wrapped by binary_bt.py:21.  Delay =
+(L1 + L2) * R with
+
+    L1 = x sin(omega) (cosE - e)
+    L2 = (x cos(omega) sqrt(1-e^2) + GAMMA) sinE
+    R  = 1 - (2 pi / PB) (x cos(omega) sqrt(1-e^2) cosE
+                          - x sin(omega) sinE) / (1 - e cosE)
+
+where E solves Kepler's equation for the orbit phase and x, e, omega
+drift linearly (XDOT, EDOT, OMDOT).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from pint_tpu.models.binary.base import DEG_PER_YEAR, BinaryComponent
+from pint_tpu.models.binary.kepler import kepler_eccentric_anomaly
+from pint_tpu.models.parameter import Param
+
+
+class KeplerianMixin:
+    """Shared Keplerian parameter group for BT/DD families (T0 epoch,
+    ECC/EDOT, OM/OMDOT, GAMMA)."""
+
+    def add_keplerian_params(self, pardict):
+        self.add_orbit_params(pardict)
+        self.add_a1_params()
+        self.add_param(Param("ECC", aliases=("E",),
+                             description="Eccentricity"))
+        self.add_param(Param("EDOT", unit_scale=True, units="1/s",
+                             description="Eccentricity derivative"))
+        self.add_param(Param("OM", units="rad", scale=jnp.pi / 180.0,
+                             description="Longitude of periastron (deg)"))
+        self.add_param(Param("OMDOT", units="rad/s", scale=DEG_PER_YEAR,
+                             description="Periastron advance (deg/yr)"))
+        self.add_param(Param("GAMMA", units="s",
+                             description="Einstein delay amplitude"))
+
+    def keplerian_defaults(self):
+        d = self.orbit_defaults()
+        d.update(A1=0.0, XDOT=0.0, ECC=0.0, EDOT=0.0, OM=0.0, OMDOT=0.0,
+                 GAMMA=0.0)
+        return d
+
+    def eccentric_anomaly(self, values, dt):
+        """(E, ecc, orbital freq) at dt = t - T0."""
+        orbits, forb = self.orbits_and_freq(values, dt)
+        mean_anom = self.orbit_phase(orbits)
+        ecc = values["ECC"] + dt * values["EDOT"]
+        return kepler_eccentric_anomaly(mean_anom, ecc), ecc, forb
+
+
+class BinaryBT(KeplerianMixin, BinaryComponent):
+    binary_name = "BT"
+    epoch_param = "T0"
+
+    def build_params(self, pardict):
+        self.add_keplerian_params(pardict)
+
+    def defaults(self):
+        return self.keplerian_defaults()
+
+    def binary_delay(self, values, dt, ctx):
+        E, ecc, forb = self.eccentric_anomaly(values, dt)
+        a1 = values["A1"] + dt * values["XDOT"]
+        omega = values["OM"] + dt * values["OMDOT"]
+        sw, cw = jnp.sin(omega), jnp.cos(omega)
+        sE, cE = jnp.sin(E), jnp.cos(E)
+        root = jnp.sqrt(1.0 - ecc * ecc)
+        l1 = a1 * sw * (cE - ecc)
+        l2 = (a1 * cw * root + values["GAMMA"]) * sE
+        # first-order emission-time correction (BT76 Eq. 2.33 third term)
+        r = 1.0 - 2.0 * jnp.pi * forb * (a1 * cw * root * cE - a1 * sw * sE) \
+            / (1.0 - ecc * cE)
+        return (l1 + l2) * r

@@ -1,0 +1,330 @@
+"""Damour-Deruelle binary family: DD, DDS, DDH, DDGR, DDK.
+
+Physics: Damour & Deruelle (1986) timing formula — Roemer + Einstein
+delays through the second-order inverse timing expansion (their Eq.
+46-52), Shapiro delay (Eq. 26), aberration (Eq. 27); GR-constrained
+variant per Taylor & Weisberg (1989) Eq. 15-25; Kopeikin (1995, 1996)
+annual-orbital-parallax and proper-motion corrections for DDK.
+Reference counterparts: stand_alone_psr_binaries/DD_model.py,
+DDS_model.py, DDH_model.py, DDGR_model.py, DDK_model.py wrapped by
+binary_dd.py / binary_ddk.py.
+
+The family shares one jax delay kernel; subclasses override
+``dd_quantities`` (a1, omega, sini, tm2, gamma, dr, dth) — the analogue
+of the reference's property overrides, resolved statically at trace
+time so the jitted program contains only the selected variant.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu import T_SUN_S
+from pint_tpu.models.binary.base import BinaryComponent
+from pint_tpu.models.binary.bt import KeplerianMixin
+from pint_tpu.models.binary.kepler import true_anomaly
+from pint_tpu.models.parameter import Param
+
+_KPC_LS = 3.0856775814913673e19 / 299792458.0  #: kiloparsec in light-s
+_MAS = np.deg2rad(1.0 / 3.6e6)  #: milliarcsecond in radians
+
+
+class BinaryDD(KeplerianMixin, BinaryComponent):
+    binary_name = "DD"
+    epoch_param = "T0"
+
+    def build_params(self, pardict):
+        self.add_keplerian_params(pardict)
+        self.add_shapiro_params()
+        self.add_param(Param("DR", description="Relativistic e_r deformation"))
+        self.add_param(Param("DTH", aliases=("DTHETA",),
+                             description="Relativistic e_theta deformation"))
+        self.add_param(Param("A0", units="s",
+                             description="Aberration parameter A0"))
+        self.add_param(Param("B0", units="s",
+                             description="Aberration parameter B0"))
+
+    def defaults(self):
+        d = self.keplerian_defaults()
+        d.update(M2=0.0, SINI=0.0, DR=0.0, DTH=0.0, A0=0.0, B0=0.0)
+        return d
+
+    # -- overridable PK quantity block ---------------------------------------
+    def dd_quantities(self, values, dt, ctx, nu, forb):
+        """(a1, omega, sini, tm2, gamma, dr, dth) for the delay kernel."""
+        k = values["OMDOT"] / (2.0 * jnp.pi * forb)
+        return dict(
+            a1=values["A1"] + dt * values["XDOT"],
+            omega=values["OM"] + k * nu,
+            sini=values["SINI"],
+            tm2=T_SUN_S * values["M2"],
+            gamma=values["GAMMA"],
+            dr=values["DR"],
+            dth=values["DTH"],
+        )
+
+    def binary_delay(self, values, dt, ctx):
+        E, ecc, forb = self.eccentric_anomaly(values, dt)
+        sE, cE = jnp.sin(E), jnp.cos(E)
+        nu = true_anomaly(E, ecc)
+        q = self.dd_quantities(values, dt, ctx, nu, forb)
+        a1, omega, gamma = q["a1"], q["omega"], q["gamma"]
+        er = ecc * (1.0 + q["dr"])
+        eth = ecc * (1.0 + q["dth"])
+        sw, cw = jnp.sin(omega), jnp.cos(omega)
+        alpha = a1 * sw
+        beta = a1 * jnp.sqrt(1.0 - eth * eth) * cw
+        # Dre = Roemer (Eq. 48) + Einstein (Eq. 25); phase derivatives
+        # wrt eccentric anomaly for the inverse formula (Eq. 49-50)
+        dre = alpha * (cE - er) + (beta + gamma) * sE
+        drep = -alpha * sE + (beta + gamma) * cE
+        drepp = -alpha * cE - (beta + gamma) * sE
+        one_m_ecosE = 1.0 - ecc * cE
+        nhat = 2.0 * jnp.pi * forb / one_m_ecosE
+        nd = nhat * drep
+        # inverse timing formula, Eq. 46-52 second order
+        inv = dre * (
+            1.0 - nd + nd * nd
+            + 0.5 * nhat * nhat * dre * drepp
+            - 0.5 * ecc * sE / one_m_ecosE * nhat * nhat * dre * drep
+        )
+        # Shapiro (Eq. 26)
+        root = jnp.sqrt(1.0 - ecc * ecc)
+        bracket = one_m_ecosE - q["sini"] * (sw * (cE - ecc) + root * cw * sE)
+        shap = -2.0 * q["tm2"] * jnp.log(bracket)
+        # aberration (Eq. 27)
+        ab = values["A0"] * (jnp.sin(omega + nu) + ecc * sw) \
+            + values["B0"] * (jnp.cos(omega + nu) + ecc * cw)
+        return inv + shap + ab
+
+
+class BinaryDDS(BinaryDD):
+    """DD with SHAPMAX = -ln(1 - sin i) inclination parameterization
+    (Kramer et al. 2006; reference: DDS_model.py)."""
+
+    binary_name = "DDS"
+
+    def build_params(self, pardict):
+        super().build_params(pardict)
+        self.params = [p for p in self.params if p.name != "SINI"]
+        self.add_param(Param("SHAPMAX", description="-ln(1 - sin i)"))
+
+    def defaults(self):
+        d = super().defaults()
+        d.pop("SINI", None)
+        d["SHAPMAX"] = 0.0
+        return d
+
+    def dd_quantities(self, values, dt, ctx, nu, forb):
+        q = BinaryDD.dd_quantities(
+            self, dict(values, SINI=0.0), dt, ctx, nu, forb)
+        q["sini"] = 1.0 - jnp.exp(-values["SHAPMAX"])
+        return q
+
+
+class BinaryDDH(BinaryDD):
+    """DD with orthometric Shapiro parameters H3/STIGMA (Freire & Wex
+    2010; reference: DDH_model.py): sini = 2 stigma/(1+stigma^2),
+    T_Sun M2 = H3 / stigma^3."""
+
+    binary_name = "DDH"
+
+    def build_params(self, pardict):
+        super().build_params(pardict)
+        self.params = [p for p in self.params
+                       if p.name not in ("SINI", "M2")]
+        self.add_param(Param("H3", units="s",
+                             description="Orthometric Shapiro amplitude"))
+        self.add_param(Param("STIGMA", aliases=("VARSIGMA",),
+                             description="Orthometric ratio"))
+
+    def defaults(self):
+        d = super().defaults()
+        d.pop("SINI", None)
+        d.pop("M2", None)
+        d.update(H3=0.0, STIGMA=0.0)
+        return d
+
+    def dd_quantities(self, values, dt, ctx, nu, forb):
+        q = BinaryDD.dd_quantities(
+            self, dict(values, SINI=0.0, M2=0.0), dt, ctx, nu, forb)
+        sig = values["STIGMA"]
+        safe = jnp.where(sig == 0.0, 1.0, sig)
+        q["sini"] = 2.0 * sig / (1.0 + sig * sig)
+        q["tm2"] = jnp.where(sig == 0.0, 0.0, values["H3"] / safe**3)
+        return q
+
+
+class BinaryDDGR(BinaryDD):
+    """GR-constrained DD: all post-Keplerian quantities derived from
+    (MTOT, M2) per Taylor & Weisberg (1989) Eq. 15-25 (reference:
+    DDGR_model.py _updatePK).  Masses in geometrized seconds via T_sun;
+    the relativistic Kepler law is a fixed-point iteration."""
+
+    binary_name = "DDGR"
+
+    def build_params(self, pardict):
+        super().build_params(pardict)
+        if self.fb_terms is not None:
+            raise NotImplementedError(
+                "DDGR requires the PB parameterization (the relativistic "
+                "Kepler law TW89 Eq. 15 is defined through PB); FB0... "
+                "given")
+        drop = ("SINI", "M2", "GAMMA", "OMDOT", "DR", "DTH")
+        self.params = [p for p in self.params if p.name not in drop]
+        self.add_param(Param("MTOT", units="Msun", description="Total mass"))
+        self.add_param(Param("M2", units="Msun", description="Companion mass"))
+        from pint_tpu.models.binary.base import DEG_PER_YEAR
+
+        self.add_param(Param("XOMDOT", units="rad/s", scale=DEG_PER_YEAR,
+                             description="Excess OMDOT vs GR (deg/yr)"))
+        # XPBDOT already present via orbit params when PB-parameterized
+
+    def defaults(self):
+        d = super().defaults()
+        for k in ("SINI", "GAMMA", "OMDOT", "DR", "DTH"):
+            d.pop(k, None)
+        d.update(MTOT=0.0, M2=0.0, XOMDOT=0.0)
+        return d
+
+    def _pk(self, values, dt):
+        """GR PK quantities from (MTOT, M2, PB, ECC, A1)."""
+        mt = T_SUN_S * values["MTOT"]
+        m2 = T_SUN_S * values["M2"]
+        m1 = mt - m2
+        n = 2.0 * jnp.pi / values["PB"]
+        ecc = values["ECC"] + dt * values["EDOT"]
+        # relativistic Kepler (TW89 Eq. 15), fixed-point iterations
+        arr0 = (mt / n**2) ** (1.0 / 3.0)
+        arr = arr0
+        for _ in range(8):
+            arr = arr0 * (
+                1.0 + (m1 * m2 / mt**2 - 9.0) * (mt / (2.0 * arr))
+            ) ** (2.0 / 3.0)
+        ar = arr * (m2 / mt)
+        a1 = values["A1"] + dt * values["XDOT"]
+        fe = (1.0 + (73.0 / 24.0) * ecc**2 + (37.0 / 96.0) * ecc**4) \
+            * (1.0 - ecc**2) ** (-3.5)
+        return dict(
+            sini=a1 / ar,  # TW89 Eq. 20
+            gamma=ecc * m2 * (m1 + 2.0 * m2) / (n * arr0 * mt),  # Eq. 17
+            pbdot=(-192.0 * jnp.pi / 5.0) * n ** (5.0 / 3.0)
+            * m1 * m2 * mt ** (-1.0 / 3.0) * fe,  # Eq. 18
+            k=3.0 * mt / (arr0 * (1.0 - ecc**2)),  # Eq. 16
+            dr=(3.0 * m1**2 + 6.0 * m1 * m2 + 2.0 * m2**2) / (mt * arr),
+            dth=(3.5 * m1**2 + 6.0 * m1 * m2 + 2.0 * m2**2) / (mt * arr),
+            n=n,
+        )
+
+    def orbits_and_freq(self, values, dt):
+        if self.fb_terms is None:
+            pk = self._pk(values, dt)
+            values = dict(values, PBDOT=values["XPBDOT"] + pk["pbdot"],
+                          XPBDOT=0.0)
+        return BinaryComponent.orbits_and_freq(self, values, dt)
+
+    def dd_quantities(self, values, dt, ctx, nu, forb):
+        pk = self._pk(values, dt)
+        return dict(
+            a1=values["A1"] + dt * values["XDOT"],
+            omega=values["OM"] + nu * (pk["k"] + values["XOMDOT"] / pk["n"]),
+            sini=pk["sini"],
+            tm2=T_SUN_S * values["M2"],
+            gamma=pk["gamma"],
+            dr=pk["dr"],
+            dth=pk["dth"],
+        )
+
+
+class BinaryDDK(BinaryDD):
+    """DD with Kopeikin (1995, 1996) corrections: secular (proper
+    motion) and annual (parallax) variation of the apparent inclination,
+    projected semi-major axis and periastron longitude.  KIN/KOM in the
+    DT92 convention; KOM measured in the frame of the astrometry
+    component (reference: DDK_model.py, binary_ddk.py:44)."""
+
+    binary_name = "DDK"
+
+    def build_params(self, pardict):
+        super().build_params(pardict)
+        self.params = [p for p in self.params if p.name != "SINI"]
+        self.add_param(Param("KIN", kind="angle",
+                             description="Inclination angle (DT92)"))
+        self.add_param(Param("KOM", kind="angle",
+                             description="Long. of ascending node (DT92)"))
+        self.add_param(Param("K96", kind="bool", fittable=False,
+                             description="Apply proper-motion (K96) terms"))
+        self.k96 = parse_k96(pardict)
+        self.ecliptic = "ELONG" in pardict
+
+    def defaults(self):
+        d = super().defaults()
+        d.pop("SINI", None)
+        d.update(KIN=0.0, KOM=0.0, K96=1.0)
+        return d
+
+    def prepare(self, toas, model):
+        ctx = super().prepare(toas, model)
+        # observatory SSB position [ls] and pulsar unit vector, in the
+        # astrometry frame (Kopeikin 1995 Eq. 15-16 geometry)
+        obs = np.asarray(toas.ssb_obs_pos, dtype=np.float64)
+        astrom = None
+        for c in model.components:
+            if c.category == "astrometry":
+                astrom = c
+        if astrom is None:
+            raise ValueError("DDK requires an astrometry component")
+        if self.ecliptic:
+            from pint_tpu.models.astrometry import _EQ_FROM_ECL
+
+            obs = obs @ np.asarray(_EQ_FROM_ECL)  # ICRS -> ecliptic
+            lon = model.values["ELONG"]
+            lat = model.values["ELAT"]
+            self._pm_names = ("PMELONG", "PMELAT")
+        else:
+            lon = model.values["RAJ"]
+            lat = model.values["DECJ"]
+            self._pm_names = ("PMRA", "PMDEC")
+        # Kopeikin 1995 Eq. 15-16
+        sl, cl = np.sin(lon), np.cos(lon)
+        sb, cb = np.sin(lat), np.cos(lat)
+        ctx["delta_I0"] = jnp.asarray(-obs[:, 0] * sl + obs[:, 1] * cl)
+        ctx["delta_J0"] = jnp.asarray(
+            -obs[:, 0] * sb * cl - obs[:, 1] * sb * sl + obs[:, 2] * cb
+        )
+        return ctx
+
+    def dd_quantities(self, values, dt, ctx, nu, forb):
+        from pint_tpu import SECS_PER_JULIAN_YEAR
+
+        q = BinaryDD.dd_quantities(
+            self, dict(values, SINI=0.0), dt, ctx, nu, forb)
+        sin_kom, cos_kom = jnp.sin(values["KOM"]), jnp.cos(values["KOM"])
+        masyr = _MAS / SECS_PER_JULIAN_YEAR
+        pm_long = values[self._pm_names[0]] * masyr
+        pm_lat = values[self._pm_names[1]] * masyr
+        a1 = q["a1"]
+        omega = q["omega"]
+        kin = values["KIN"]
+        if self.k96:
+            # Kopeikin 1996 Eq. 10, 8, 9
+            d_kin = (-pm_long * sin_kom + pm_lat * cos_kom) * dt
+            kin = kin + d_kin
+            a1 = a1 + a1 * d_kin / jnp.tan(kin)
+            omega = omega + (pm_long * cos_kom + pm_lat * sin_kom) * dt \
+                / jnp.sin(kin)
+        # Kopeikin 1995 Eq. 18, 19 (annual orbital parallax); PX in mas
+        # => 1/d [1/ls] = PX / _KPC_LS, vanishing smoothly as PX -> 0
+        inv_d_ls = values["PX"] / _KPC_LS
+        geo_x = ctx["delta_I0"] * sin_kom - ctx["delta_J0"] * cos_kom
+        geo_w = ctx["delta_I0"] * cos_kom + ctx["delta_J0"] * sin_kom
+        a1 = a1 + a1 / jnp.tan(kin) * inv_d_ls * geo_x
+        omega = omega - inv_d_ls / jnp.sin(kin) * geo_w
+        q.update(a1=a1, omega=omega, sini=jnp.sin(kin))
+        return q
+
+
+def parse_k96(pardict) -> bool:
+    tok = pardict.get("K96", [["1"]])[0]
+    return str(tok[0] if tok else "1").upper() in ("1", "Y", "T", "TRUE")

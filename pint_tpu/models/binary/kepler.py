@@ -1,0 +1,52 @@
+"""Kepler-equation solver: fixed-iteration Newton with implicit autodiff.
+
+Counterpart of the reference's scipy-based ``compute_eccentric_anomaly``
+(reference: stand_alone_psr_binaries/binary_generic.py:337).  TPU
+redesign: a fixed Newton iteration count (no data-dependent control
+flow, so it jits and vmaps), with the derivative supplied by the
+implicit function theorem via ``jax.custom_jvp`` — dE/dM = 1/(1-e cosE),
+dE/de = sinE/(1-e cosE) — so autodiff never differentiates through the
+iteration loop.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+#: Newton iterations.  From E0 = M + e sinM convergence is quadratic;
+#: 10 iterations reach float64 roundoff for e <~ 0.97.
+_NEWTON_ITERS = 10
+
+
+@jax.custom_jvp
+def kepler_eccentric_anomaly(mean_anom, ecc):
+    """Solve E - e sinE = M elementwise.  M may be any real (use the
+    reduced branch for best trig accuracy); returns E near M."""
+    E = mean_anom + ecc * jnp.sin(mean_anom)
+    for _ in range(_NEWTON_ITERS):
+        f = E - ecc * jnp.sin(E) - mean_anom
+        fp = 1.0 - ecc * jnp.cos(E)
+        E = E - f / fp
+    return E
+
+
+@kepler_eccentric_anomaly.defjvp
+def _kepler_jvp(primals, tangents):
+    mean_anom, ecc = primals
+    dm, de = tangents
+    E = kepler_eccentric_anomaly(mean_anom, ecc)
+    denom = 1.0 - ecc * jnp.cos(E)
+    dE = (dm + jnp.sin(E) * de) / denom
+    return E, dE
+
+
+def true_anomaly(E, ecc):
+    """True anomaly nu from eccentric anomaly, continuous with E (the
+    atan2 half-angle form keeps nu on the same branch as E)."""
+    half = 0.5 * E
+    nu_half = jnp.arctan2(
+        jnp.sqrt(1.0 + ecc) * jnp.sin(half),
+        jnp.sqrt(1.0 - ecc) * jnp.cos(half),
+    )
+    return 2.0 * nu_half

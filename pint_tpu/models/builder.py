@@ -37,13 +37,10 @@ from pint_tpu.models.dispersion import (  # noqa: F401
 from pint_tpu.models.jump import PhaseJump  # noqa: F401
 from pint_tpu.models.solar_system_shapiro import SolarSystemShapiro  # noqa: F401
 from pint_tpu.models.spindown import Spindown  # noqa: F401
+import pint_tpu.models.binary  # noqa: F401  (registers binary families)
 
 __all__ = ["parse_parfile", "get_model", "get_model_and_toas",
            "model_to_parfile"]
-
-#: BINARY value -> component class; binary families register here as they
-#: land (reference: model_builder.choose_binary_model, :576)
-_BINARY_MODELS: dict = {}
 
 #: par keys that are model metadata, not fit parameters
 _META_KEYS = {
@@ -132,12 +129,9 @@ def get_model(parfile) -> TimingModel:
             "planned milestone — use tempo2/PINT convert_parfile for now)"
         )
     if "BINARY" in pardict:
-        binary = pardict["BINARY"][0][0].upper()
-        if binary not in _BINARY_MODELS:
-            avail = sorted(_BINARY_MODELS) or "none yet"
-            raise NotImplementedError(
-                f"BINARY {binary} not implemented yet (available: {avail})"
-            )
+        from pint_tpu.models.binary import get_binary_class
+
+        get_binary_class(pardict["BINARY"][0][0])  # raises if unknown
 
     # mask-parameter selectors must exist before component instantiation
     jump_selects = []
@@ -158,12 +152,24 @@ def get_model(parfile) -> TimingModel:
         pardict["__DMJUMP_selects__"] = dmjump_selects  # type: ignore
 
     model = TimingModel(name=str(parfile)[:120])
-    for cls in choose_components(pardict):
+    chosen = choose_components(pardict)
+    if "BINARY" in pardict:
+        from pint_tpu.models.binary import get_binary_class
+
+        chosen.append(get_binary_class(pardict["BINARY"][0][0]))
+    for cls in chosen:
         comp = cls.from_parfile(pardict)
         model.add_component(comp)
 
     model.epoch_ticks = {}
     params = model.params
+    # component-declared aliases (VARSIGMA->STIGMA, DTHETA->DTH, ...)
+    # resolved after instantiation, since only concrete components know
+    # their parameter families
+    alias_map = {}
+    for p in params.values():
+        for a in p.aliases:
+            alias_map.setdefault(a, p.name)
     consumed = set()
     for key, occurrences in pardict.items():
         if key.startswith("__"):
@@ -176,16 +182,17 @@ def get_model(parfile) -> TimingModel:
         if key in ("JUMP", "DMJUMP"):
             consumed.add(key)
             continue
-        p = params.get(key)
+        pname = key if key in params else alias_map.get(key)
+        p = params.get(pname) if pname else None
         if p is None:
             continue
         tokens = occurrences[0]
         if not tokens:
             continue
         p.raw = tokens[0]
-        model.values[key] = p.parse(tokens[0])
+        model.values[pname] = p.parse(tokens[0])
         if p.kind == "mjd":
-            model.epoch_ticks[key] = mjd_value_to_ticks(tokens[0])
+            model.epoch_ticks[pname] = mjd_value_to_ticks(tokens[0])
         if len(tokens) > 1 and p.fittable:
             if tokens[1] in ("1", "2"):
                 p.frozen = False

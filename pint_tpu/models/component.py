@@ -28,6 +28,12 @@ import jax.numpy as jnp
 from pint_tpu.models.parameter import Param
 
 
+#: BINARY par value -> binary component class (filled by
+#: pint_tpu.models.binary subclasses; reference:
+#: model_builder.choose_binary_model, model_builder.py:576)
+BINARY_MODELS: Dict[str, type] = {}
+
+
 class Component:
     """Base component.  Subclasses auto-register by class name."""
 

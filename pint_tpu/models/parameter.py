@@ -104,6 +104,12 @@ class Param:
     units: str = ""
     #: multiply par-file value by this to get internal units
     scale: float = 1.0
+    #: tempo convention: a par value with |v| > scale_threshold is taken
+    #: to be in units of scale_factor (e.g. "PBDOT 7.2" means 7.2e-12;
+    #: reference: parameter.py:791-793)
+    unit_scale: bool = False
+    scale_factor: float = 1e-12
+    scale_threshold: float = 1e-7
     frozen: bool = True
     fittable: bool = True
     hourangle: bool = False  # for kind=angle
@@ -123,7 +129,10 @@ class Param:
         if self.kind == "bool":
             return float(parse_bool(s))
         s2 = s.upper().replace("D", "E") if re.search(r"\dD[+-]?\d", s.upper()) else s
-        return float(s2) * self.scale
+        v = float(s2)
+        if self.unit_scale and abs(v) > self.scale_threshold:
+            v *= self.scale_factor
+        return v * self.scale
 
     def format(self, value: float, ndigits=15) -> str:
         if self.kind == "angle":

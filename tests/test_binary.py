@@ -1,0 +1,279 @@
+"""Binary-model tests: Kepler solver, cross-family oracles, fit recovery.
+
+Oracles (no reference runtime available):
+- Kepler equation residual + implicit-derivative check vs finite diff.
+- DD with exact Kepler solve vs ELL1's third-order expansion at small
+  eccentricity (independent formulations must agree).
+- BT vs DD in the purely Keplerian limit (different inverse-timing
+  truncations; agreement to the truncation order).
+- simulate -> perturb -> WLS fit -> parameter recovery per family
+  (the reference's own self-consistency strategy, SURVEY.md section 4).
+"""
+
+import numpy as np
+import pytest
+
+from pint_tpu.models import get_model
+from pint_tpu.models.binary.kepler import kepler_eccentric_anomaly
+from pint_tpu.residuals import Residuals
+from pint_tpu.simulation import make_fake_toas_uniform
+
+BASE = """
+PSR  FAKE
+F0   300.1  1
+F1   -1e-15 1
+DM   15.0
+PEPOCH 55000
+UNITS TDB
+RAJ  04:37:15.8
+DECJ -47:15:09.1
+"""
+
+
+def make_toas(m, n=200, error_us=1.0, seed=0):
+    return make_fake_toas_uniform(
+        54000, 56000, n, m, freq_mhz=1400.0, obs="gbt",
+        error_us=error_us, add_noise=True,
+        rng=np.random.default_rng(seed))
+
+
+class TestKepler:
+    def test_solves_equation(self):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(0)
+        M = jnp.asarray(rng.uniform(-np.pi, np.pi, 500))
+        for e in (0.0, 0.1, 0.6, 0.9, 0.95):
+            E = kepler_eccentric_anomaly(M, jnp.full_like(M, e))
+            resid = np.asarray(E - e * jnp.sin(E) - M)
+            assert np.max(np.abs(resid)) < 1e-13
+
+    def test_implicit_derivatives(self):
+        import jax
+
+        def f(M, e):
+            return kepler_eccentric_anomaly(M, e)
+
+        M0, e0 = 1.234, 0.456
+        dM = jax.grad(f, argnums=0)(M0, e0)
+        de = jax.grad(f, argnums=1)(M0, e0)
+        h = 1e-7
+        dM_fd = (f(M0 + h, e0) - f(M0 - h, e0)) / (2 * h)
+        de_fd = (f(M0, e0 + h) - f(M0, e0 - h)) / (2 * h)
+        assert abs(dM - dM_fd) < 1e-6
+        assert abs(de - de_fd) < 1e-6
+
+    def test_second_derivative(self):
+        import jax
+
+        def f(M):
+            return kepler_eccentric_anomaly(M, 0.3)
+
+        d2 = jax.grad(jax.grad(f))(0.7)
+        h = 1e-5
+        d2_fd = (f(0.7 + h) - 2 * f(0.7) + f(0.7 - h)) / h**2
+        assert abs(d2 - d2_fd) < 1e-4
+
+
+class TestCrossFamily:
+    def test_dd_matches_ell1_at_small_ecc(self):
+        """DD (exact Kepler) vs ELL1 (3rd-order expansion), after mean
+        subtraction: ELL1 drops the constant -(3/2) x e sin(omega) term
+        (unobservable, absorbed by the phase offset).  The remaining
+        difference is the O(e nhat x^2) inverse-formula truncation,
+        ~1.4e-8 s at e=1e-4 here."""
+        ecc, om_deg = 1e-4, 40.0
+        om = np.deg2rad(om_deg)
+        pb_days = 5.741
+        dd_par = BASE + (
+            f"BINARY DD\nPB {pb_days}\nA1 3.3667\nT0 54900.1234\n"
+            f"ECC {ecc}\nOM {om_deg}\n")
+        # TASC = T0 - PB * OM / (2 pi)  (ELL1 convention: Phi=0 at
+        # ascending node, mean anomaly = 0 at periastron)
+        tasc = 54900.1234 - pb_days * om / (2 * np.pi)
+        ell1_par = BASE + (
+            f"BINARY ELL1\nPB {pb_days}\nA1 3.3667\nTASC {tasc:.10f}\n"
+            f"EPS1 {ecc * np.sin(om):.12e}\nEPS2 {ecc * np.cos(om):.12e}\n")
+        m_dd = get_model(dd_par)
+        toas = make_toas(m_dd)
+        m_ell1 = get_model(ell1_par)
+        dd_comp = m_dd.component("BinaryDD")
+        e_comp = m_ell1.component("BinaryELL1")
+        pd = m_dd.prepare(toas)
+        pe = m_ell1.prepare(toas)
+        vals_d = pd._values_pytree()
+        vals_e = pe._values_pytree()
+        import jax.numpy as jnp
+
+        zero = jnp.zeros(len(toas))
+        d_dd = np.asarray(
+            dd_comp.delay(vals_d, pd.batch, pd.ctx["BinaryDD"], zero))
+        d_e = np.asarray(
+            e_comp.delay(vals_e, pe.batch, pe.ctx["BinaryELL1"], zero))
+        diff = (d_dd - d_dd.mean()) - (d_e - d_e.mean())
+        assert np.max(np.abs(diff)) < 5e-8
+
+    def test_bt_matches_dd_keplerian(self):
+        """BT vs DD with no relativistic terms: both reduce to the
+        Keplerian Roemer delay; truncation differences are
+        O((2 pi x / PB)^2 x) ~ 3e-8 s here."""
+        kepler = "PB 10.5\nA1 8.2\nT0 54900.5\nECC 0.31\nOM 110.0\n"
+        m_bt = get_model(BASE + "BINARY BT\n" + kepler)
+        m_dd = get_model(BASE + "BINARY DD\n" + kepler)
+        toas = make_toas(m_bt)
+        import jax.numpy as jnp
+
+        zero = jnp.zeros(len(toas))
+        pb = m_bt.prepare(toas)
+        pd = m_dd.prepare(toas)
+        d_bt = m_bt.component("BinaryBT").delay(
+            pb._values_pytree(), pb.batch, pb.ctx["BinaryBT"], zero)
+        d_dd = m_dd.component("BinaryDD").delay(
+            pd._values_pytree(), pd.batch, pd.ctx["BinaryDD"], zero)
+        assert np.max(np.abs(np.asarray(d_bt - d_dd))) < 2e-7
+
+
+FAMILIES = {
+    "ELL1": ("BINARY ELL1\nPB 5.7410 1\nA1 3.3667 1\nTASC 54900.1234 1\n"
+             "EPS1 1.2e-5 1\nEPS2 -3.4e-6 1\nM2 0.25\nSINI 0.97\n",
+             ["PB", "A1", "EPS1", "EPS2", "TASC"]),
+    "ELL1H": ("BINARY ELL1H\nPB 5.7410 1\nA1 3.3667 1\nTASC 54900.1234 1\n"
+              "EPS1 1.2e-5 1\nEPS2 -3.4e-6 1\nH3 2.6e-7 1\nSTIGMA 0.8\n",
+              ["PB", "A1", "EPS1", "EPS2"]),
+    "ELL1K": ("BINARY ELL1k\nPB 5.7410 1\nA1 3.3667 1\nTASC 54900.1234 1\n"
+              "EPS1 1.2e-4 1\nEPS2 -3.4e-5 1\nOMDOT 1.5 1\nLNEDOT 0\n",
+              ["PB", "A1", "EPS1", "EPS2"]),
+    "BT": ("BINARY BT\nPB 10.5 1\nA1 8.2 1\nT0 54900.5 1\nECC 0.31 1\n"
+           "OM 110.0 1\nGAMMA 0.002\n",
+           ["PB", "A1", "ECC", "OM", "T0"]),
+    "DD": ("BINARY DD\nPB 10.5 1\nA1 8.2 1\nT0 54900.5 1\nECC 0.31 1\n"
+           "OM 110.0 1\nOMDOT 0.01\nGAMMA 0.002\nM2 0.3\nSINI 0.9\n",
+           ["PB", "A1", "ECC", "OM", "T0"]),
+    "DDS": ("BINARY DDS\nPB 10.5 1\nA1 8.2 1\nT0 54900.5 1\nECC 0.31 1\n"
+            "OM 110.0 1\nSHAPMAX 2.5 1\nM2 0.3\n",
+            ["PB", "A1", "ECC"]),
+    "DDH": ("BINARY DDH\nPB 10.5 1\nA1 8.2 1\nT0 54900.5 1\nECC 0.31 1\n"
+            "OM 110.0 1\nH3 2.5e-7\nSTIGMA 0.7\n",
+            ["PB", "A1", "ECC"]),
+    "DDGR": ("BINARY DDGR\nPB 0.4 1\nA1 2.34 1\nT0 54900.5 1\nECC 0.61 1\n"
+             "OM 110.0 1\nMTOT 2.8\nM2 1.25\n",
+             ["PB", "A1", "ECC"]),
+    "DDK": ("BINARY DDK\nPB 10.5 1\nA1 8.2 1\nT0 54900.5 1\nECC 0.31 1\n"
+            "OM 110.0 1\nM2 0.3\nKIN 71.0\nKOM 107.0\nPX 1.2\n"
+            "PMRA 17.0\nPMDEC -9.0\n",
+            ["PB", "A1", "ECC"]),
+}
+
+#: relative perturbations ~ a few hundred ns of orbital-phase effect
+PERTURB = {"PB": 3e-9, "A1": 3e-8, "ECC": 1e-6, "OM": 1e-6, "T0": 3e-9,
+           "TASC": 3e-9, "EPS1": 1e-3, "EPS2": 1e-3, "SHAPMAX": 1e-3,
+           "H3": 1e-3, "OMDOT": 1e-3}
+
+
+@pytest.mark.parametrize("family", sorted(FAMILIES))
+def test_fit_recovery(family):
+    """Perturb the fitted binary parameters, refit, recover truth."""
+    from pint_tpu.fitter import WLSFitter
+
+    par, fit_names = FAMILIES[family]
+    m = get_model(BASE + par)
+    toas = make_toas(m, n=250)
+    truth = {k: m.values[k] for k in fit_names}
+    m.free_params = fit_names + ["F0", "F1"]
+    for k in fit_names:
+        m.values[k] = truth[k] * (1.0 + PERTURB.get(k, 1e-8)) \
+            if m.values[k] != 0 else 1e-10
+    f = WLSFitter(toas, m)
+    f.fit_toas(maxiter=6)
+    r = Residuals(toas, m)
+    assert r.reduced_chi2 < 1.5, f"{family}: bad fit chi2r={r.reduced_chi2}"
+    for k in fit_names:
+        unc = m.params[k].uncertainty
+        assert unc is not None and unc > 0
+        err = abs(m.values[k] - truth[k])
+        assert err < 5 * unc + 1e-15 * abs(truth[k]), (
+            f"{family}.{k}: fitted {m.values[k]!r} truth {truth[k]!r} "
+            f"err {err:.3e} unc {unc:.3e}")
+
+
+def test_binary_derivatives_vs_finite_difference():
+    """jacfwd design-matrix columns vs central finite differences for
+    the ELL1 and DD parameter sets."""
+    import jax
+
+    for fam in ("ELL1", "DD"):
+        par, fit_names = FAMILIES[fam]
+        m = get_model(BASE + par)
+        toas = make_toas(m, n=100)
+        m.free_params = fit_names
+        prepared = m.prepare(toas)
+        fn = prepared.frac_phase_fn()
+        vec = np.asarray(prepared.values_to_vector())
+        J = np.asarray(jax.jacfwd(fn)(prepared.values_to_vector()))
+        # free_params is in component order, not fit_names order
+        for i, name in enumerate(m.free_params):
+            if m.params[name].kind == "mjd":
+                h = 1e-3  # epochs are huge in seconds-since-J2000
+            else:
+                h = max(abs(vec[i]) * 1e-7, 1e-10)
+            vp, vm = vec.copy(), vec.copy()
+            vp[i] += h
+            vm[i] -= h
+            col_fd = (np.asarray(fn(vp)) - np.asarray(fn(vm))) / (2 * h)
+            scale = np.max(np.abs(col_fd)) + 1e-30
+            assert np.max(np.abs(J[:, i] - col_fd)) / scale < 1e-4, (
+                f"{fam}.{name} jacfwd vs FD mismatch")
+
+
+def test_component_alias_values_assigned():
+    """VARSIGMA (alias of STIGMA) must set the STIGMA value, not be
+    silently dropped to metadata (which left STIGMA=0 and produced NaN
+    residuals in ELL1H's exact Shapiro form)."""
+    par = BASE + ("BINARY ELL1H\nPB 5.741\nA1 3.3667\nTASC 54900.1\n"
+                  "EPS1 1.2e-5\nEPS2 -3.4e-6\nH3 2.6e-7\nVARSIGMA 0.8\n")
+    m = get_model(par)
+    assert m.values["STIGMA"] == 0.8
+    toas = make_toas(m, n=50)
+    assert np.all(np.isfinite(Residuals(toas, m).time_resids))
+
+
+def test_fitter_retraces_when_free_set_changes():
+    """Same free-param count, different set: the fitter must not reuse
+    the stale trace (which silently fit the old params)."""
+    from pint_tpu.fitter import WLSFitter
+
+    par, _ = FAMILIES["ELL1"]
+    m = get_model(BASE + par)
+    toas = make_toas(m, n=80)
+    m.free_params = ["F0"]
+    truth_a1 = m.values["A1"]
+    f = WLSFitter(toas, m)
+    f.fit_toas()
+    m.free_params = ["A1"]
+    m.values["A1"] = truth_a1 * (1 + 3e-8)
+    f.fit_toas()
+    assert abs(m.values["A1"] - truth_a1) < 5 * m.params["A1"].uncertainty
+
+
+def test_grid_all_params_gridded():
+    """Grid over every free parameter: plain chi2 evaluation, no refit
+    (the reference grid_chisq supports this fixed-grid case)."""
+    from pint_tpu.grid import grid_chisq_vectorized
+
+    m = get_model(BASE + FAMILIES["ELL1"][0])
+    toas = make_toas(m, n=60)
+    m.free_params = ["F0", "F1"]
+    mesh = np.array([[m.values["F0"] + d, m.values["F1"]]
+                     for d in (-1e-11, 0.0, 1e-11)])
+    chi2, fitted = grid_chisq_vectorized(toas, m, ["F0", "F1"], mesh)
+    assert chi2.shape == (3,) and np.all(np.isfinite(chi2))
+    assert np.argmin(chi2) == 1
+
+
+def test_free_params_order_is_component_order():
+    """Documents the contract the fitters rely on: the parameter vector
+    follows component order regardless of assignment order."""
+    par, fit_names = FAMILIES["DD"]
+    m = get_model(BASE + par)
+    m.free_params = list(reversed(fit_names))
+    assert m.free_params == ["PB", "T0", "A1", "ECC", "OM"]
