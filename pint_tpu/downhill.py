@@ -1,0 +1,170 @@
+"""Downhill fitters: step-halving Gauss-Newton with optional
+gradient-based noise-parameter fitting.
+
+Counterpart of the reference DownhillFitter family (reference:
+src/pint/fitter.py:982-1612): propose a WLS/GLS step, then
+``take_step(lambda)`` with lambda-halving until chi^2 decreases; the
+halving search runs as a ``lax.while_loop`` inside the jitted step, so a
+full downhill iteration is one device program.  The white-noise-fitting
+stage (reference ``_fit_noise``, fitter.py:1230) maximizes the analytic
+``Residuals.lnlikelihood`` over free noise parameters with ``jax.grad``
+supplying exact gradients (the reference uses hand-derived gradients +
+scipy Newton-CG; here autodiff replaces the hand derivatives) and
+``jax.hessian`` for uncertainties (the reference uses numdifftools).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.fitter import Fitter, GLSFitter, WLSFitter
+from pint_tpu.linalg import gls_normal_solve
+
+__all__ = ["DownhillWLSFitter", "DownhillGLSFitter"]
+
+
+class _DownhillMixin:
+    """Adds the lambda-halving acceptance loop around a solver step and
+    the optional noise-fitting stage."""
+
+    max_halvings = 8
+    #: stop when chi2 decrease falls below this (reference fitter.py:1078)
+    min_chi2_decrease = 1e-2
+
+    def _chi2_at(self, values):
+        return self.resids.chi2_fn(values)
+
+    def _halving_step(self, vec, base_values):
+        """Propose dpar at vec, then find the largest lambda in
+        {1, 1/2, 1/4, ...} whose step decreases chi^2.  Returns
+        (new_vec, chi2_old, chi2_new, cov)."""
+        new_vec, chi2_old, dpar, cov = self._propose(vec, base_values)
+
+        def chi2_of(v):
+            return self._chi2_at(self._merged(base_values, v))
+
+        def cond(carry):
+            lam, chi2_new, n = carry
+            return jnp.logical_and(
+                chi2_new >= chi2_old, n < self.max_halvings
+            )
+
+        def body(carry):
+            lam, _, n = carry
+            lam = lam * 0.5
+            return lam, chi2_of(vec + lam * dpar), n + 1
+
+        lam0 = jnp.float64(1.0)
+        lam, chi2_new, n = jax.lax.while_loop(
+            cond, body, (lam0, chi2_of(vec + dpar), jnp.int32(0))
+        )
+        # if even the smallest lambda failed, stay put (reference keeps
+        # the best state, fitter.py:1049-1057)
+        ok = chi2_new < chi2_old
+        lam = jnp.where(ok, lam, 0.0)
+        chi2_new = jnp.where(ok, chi2_new, chi2_old)
+        return vec + lam * dpar, chi2_old, chi2_new, cov
+
+    def fit_toas(self, maxiter=20, fit_noise=False, noise_maxiter=100):
+        if not self.model.free_timing_params:
+            raise ValueError("no free timing parameters to fit")
+        if tuple(self.model.free_timing_params) != getattr(
+                self, "_traced_free", ()):
+            self._retrace()
+            self._halving_jit = jax.jit(self._halving_step)
+        elif not hasattr(self, "_halving_jit"):
+            self._halving_jit = jax.jit(self._halving_step)
+        vec = jnp.array(
+            [self.model.values[k] for k in self._traced_free],
+            dtype=jnp.float64,
+        )
+        base = self.prepared._values_pytree()
+        cov = None
+        self.converged = False
+        for _ in range(maxiter):
+            vec, chi2_old, chi2_new, cov = self._halving_jit(vec, base)
+            if float(chi2_old) - float(chi2_new) < self.min_chi2_decrease:
+                self.converged = True
+                break
+        vec = np.asarray(vec)
+        errs = np.sqrt(np.diag(np.asarray(cov)))
+        params = self.model.params
+        for i, name in enumerate(self._traced_free):
+            self.model.values[name] = float(vec[i])
+            params[name].uncertainty = float(errs[i])
+        self.covariance = np.asarray(cov)
+        self._post_fit()
+        if fit_noise:
+            self.fit_noise(maxiter=noise_maxiter)
+        return float(self.resids.chi2)
+
+    # -- noise-parameter fitting ---------------------------------------------
+    @property
+    def free_noise_params(self):
+        return self.model.free_noise_params
+
+    def fit_noise(self, maxiter=100):
+        """Maximize lnlikelihood over the free noise parameters
+        (reference _fit_noise, fitter.py:1230).  Timing parameters stay
+        fixed; uncertainties from the inverse Hessian."""
+        names = self.free_noise_params
+        if not names:
+            raise ValueError(
+                "no free noise parameters (unfreeze EFAC/EQUAD/ECORR/... "
+                "params to fit them)"
+            )
+        base = self.prepared._values_pytree()
+
+        def neg_lnl(v):
+            values = dict(base)
+            for i, n in enumerate(names):
+                values[n] = v[i]
+            return -self.resids.lnlikelihood_fn(values)
+
+        val_grad = jax.jit(jax.value_and_grad(neg_lnl))
+        x = np.array([self.model.values[n] for n in names], dtype=np.float64)
+
+        from scipy.optimize import minimize
+
+        def fun(v):
+            f, g = val_grad(jnp.asarray(v))
+            return float(f), np.asarray(g, dtype=np.float64)
+
+        res = minimize(
+            fun, x, jac=True, method="L-BFGS-B",
+            options={"maxiter": maxiter},
+        )
+        x = res.x
+        for i, n in enumerate(names):
+            self.model.values[n] = float(x[i])
+        # uncertainties: inverse Hessian of -lnL at the optimum
+        H = np.asarray(jax.hessian(neg_lnl)(jnp.asarray(x)))
+        try:
+            hinv = np.linalg.inv(H)
+            errs = np.sqrt(np.clip(np.diag(hinv), 0, None))
+            params = self.model.params
+            for i, n in enumerate(names):
+                params[n].uncertainty = float(errs[i])
+            self.noise_covariance = hinv
+        except np.linalg.LinAlgError:
+            self.noise_covariance = None
+        return -float(res.fun)
+
+
+class DownhillWLSFitter(_DownhillMixin, WLSFitter):
+    """Step-halving WLS (reference DownhillWLSFitter, fitter.py:1379)."""
+
+    def _propose(self, vec, base_values):
+        new_vec, chi2, dpar, cov = WLSFitter._step(self, vec, base_values)
+        return new_vec, self._chi2_at(self._merged(base_values, vec)), \
+            dpar, cov
+
+
+class DownhillGLSFitter(_DownhillMixin, GLSFitter):
+    """Step-halving GLS (reference DownhillGLSFitter, fitter.py:1527)."""
+
+    def _propose(self, vec, base_values):
+        new_vec, chi2, dpar, cov, _ = GLSFitter._step(self, vec, base_values)
+        return new_vec, chi2, dpar, cov

@@ -1,15 +1,16 @@
-"""Fitters: WLS (SVD) Gauss-Newton on device.
+"""Fitters: WLS (SVD) and GLS (Woodbury) Gauss-Newton on device.
 
 Counterpart of the reference fitter layer (reference: src/pint/fitter.py:
-185 base, :1940-2087 WLSFitter).  The reference's per-iteration recipe —
-design matrix, whiten, column-normalize, SVD, parameter step, covariance —
-becomes one jitted function of the free-parameter vector; the design
-matrix is ``jax.jacfwd`` of the residual function (the reference's 124-s
+185 base, :252 ``Fitter.auto``, :1940-2087 WLSFitter, :2090-2289
+GLSFitter).  The reference's per-iteration recipe — design matrix,
+whiten, column-normalize, solve, parameter step, covariance — becomes
+one jitted function of the free-parameter vector; the design matrix is
+``jax.jacfwd`` of the residual function (the reference's 124-s
 hand-derivative hot spot, profiling/README.txt:58, disappears by
 construction).
 
 ``Fitter.auto`` mirrors the reference's dispatch (fitter.py:252): GLS
-when the model has correlated noise (later milestone), WLS otherwise.
+when the model has correlated noise, WLS otherwise.
 """
 
 from __future__ import annotations
@@ -18,9 +19,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pint_tpu.linalg import gls_normal_solve
 from pint_tpu.residuals import Residuals
 
-__all__ = ["WLSFitter", "Fitter", "wls_gn_solve"]
+__all__ = ["WLSFitter", "GLSFitter", "Fitter", "wls_gn_solve"]
 
 
 def wls_gn_solve(resid_fn, vec, err, threshold=1e-14):
@@ -61,7 +63,17 @@ class Fitter:
 
     @staticmethod
     def auto(toas, model, downhill=True):
-        # correlated-noise dispatch lands with the GLS milestone
+        """Pick a fitter like the reference (fitter.py:252): GLS when the
+        model carries correlated noise, WLS otherwise; downhill variants
+        when requested."""
+        if downhill:
+            from pint_tpu.downhill import DownhillGLSFitter, DownhillWLSFitter
+
+            if model.has_correlated_errors:
+                return DownhillGLSFitter(toas, model)
+            return DownhillWLSFitter(toas, model)
+        if model.has_correlated_errors:
+            return GLSFitter(toas, model)
         return WLSFitter(toas, model)
 
     # -- reporting -----------------------------------------------------------
@@ -89,29 +101,16 @@ class Fitter:
     def print_summary(self):
         print(self.get_summary())
 
-
-class WLSFitter(Fitter):
-    """Weighted least squares via SVD of the whitened, column-normalized
-    design matrix; Gauss-Newton iterations, all inside one jit."""
-
-    def __init__(self, toas, model, residuals=None, threshold=1e-14):
-        super().__init__(toas, model, residuals)
-        self.threshold = threshold
-        self._retrace()
-
+    # -- shared machinery -----------------------------------------------------
     def _retrace(self):
         """(Re)build the jitted step for the current free-param set.
         The trace closes over the free-param *names*; a changed free set
         with the same count would otherwise hit the stale jit cache and
         silently write steps into the wrong parameters."""
-        self._traced_free = tuple(self.model.free_params)
+        self._traced_free = tuple(self.model.free_timing_params)
         self._step_jit = jax.jit(self._step)
 
-    def _step(self, vec, base_values):
-        """One Gauss-Newton WLS step.  base_values (the full values dict,
-        including frozen params) is a dynamic argument so that edits to
-        frozen parameters between fits take effect without retracing;
-        changes to WHICH params are free go through _retrace()."""
+    def _resid_fn_of(self, base_values):
         free = self._traced_free
 
         def resid_fn(v):
@@ -120,42 +119,122 @@ class WLSFitter(Fitter):
                 values[name] = v[i]
             return self.resids.time_resids_fn(values)
 
-        return wls_gn_solve(
-            resid_fn, vec, self.prepared.batch.error_s, self.threshold
-        )
+        return resid_fn
+
+    def _merged(self, base_values, vec):
+        values = dict(base_values)
+        for i, name in enumerate(self._traced_free):
+            values[name] = vec[i]
+        return values
 
     def fit_toas(self, maxiter=3):
         """Iterate Gauss-Newton steps; write back values + uncertainties."""
-        if not self.model.free_params:
+        if not self.model.free_timing_params:
             raise ValueError(
-                "no free parameters to fit (mark them with a '1' fit flag "
-                "in the par file or clear Param.frozen)"
+                "no free timing parameters to fit (mark them with a '1' "
+                "fit flag in the par file or clear Param.frozen)"
             )
-        if tuple(self.model.free_params) != self._traced_free:
+        if tuple(self.model.free_timing_params) != getattr(
+                self, "_traced_free", ()):
             self._retrace()
-        vec = self.prepared.values_to_vector()
+        vec = jnp.array(
+            [self.model.values[k] for k in self._traced_free],
+            dtype=jnp.float64,
+        )
         base = self.prepared._values_pytree()
         chi2_prev = None
         cov = None
+        self._step_extras = ()
         for _ in range(maxiter):
-            vec, chi2, dpar, cov = self._step_jit(vec, base)
+            vec, chi2, dpar, cov, *extras = self._step_jit(vec, base)
+            self._step_extras = extras
             if chi2_prev is not None and abs(float(chi2_prev) - float(chi2)) \
                     < 1e-8 * max(float(chi2), 1.0):
                 break
             chi2_prev = chi2
         # write back
-        values = self.prepared.vector_to_values(np.asarray(vec))
-        for k, v in values.items():
-            self.model.values[k] = float(v)
+        vec = np.asarray(vec)
         errs = np.sqrt(np.diag(np.asarray(cov)))
         params = self.model.params
-        for i, name in enumerate(self.model.free_params):
+        for i, name in enumerate(self._traced_free):
+            self.model.values[name] = float(vec[i])
             params[name].uncertainty = float(errs[i])
         self.covariance = np.asarray(cov)
-        # refresh residuals cache-free view
+        self._post_fit()
         return float(self.resids.chi2)
+
+    def _post_fit(self):
+        """Hook for subclasses (e.g. noise realizations)."""
 
     @property
     def parameter_correlation_matrix(self):
         d = np.sqrt(np.diag(self.covariance))
         return self.covariance / np.outer(d, d)
+
+
+class WLSFitter(Fitter):
+    """Weighted least squares via SVD of the whitened, column-normalized
+    design matrix; Gauss-Newton iterations, all inside one jit.  Whitens
+    by the noise-scaled uncertainties (EFAC/EQUAD), matching the
+    reference WLS path (fitter.py:1990)."""
+
+    def __init__(self, toas, model, residuals=None, threshold=1e-14):
+        super().__init__(toas, model, residuals)
+        self.threshold = threshold
+        self._retrace()
+
+    def _step(self, vec, base_values):
+        """One Gauss-Newton WLS step.  base_values (the full values dict,
+        including frozen params) is a dynamic argument so that edits to
+        frozen parameters between fits take effect without retracing;
+        changes to WHICH params are free go through _retrace()."""
+        resid_fn = self._resid_fn_of(base_values)
+        sigma = self.resids.sigma_fn(self._merged(base_values, vec))
+        return wls_gn_solve(resid_fn, vec, sigma, self.threshold)
+
+
+class GLSFitter(Fitter):
+    """Generalized least squares over the low-rank noise basis: the
+    noise-augmented normal equations solved by Cholesky (reference:
+    GLSFitter.fit_toas, fitter.py:2090-2289), one jitted step.
+
+    After fit_toas(), ``noise_realizations`` maps each correlated-noise
+    component to its basis-amplitude realization U_c @ a_c [s]
+    (reference :2269-2282).
+    """
+
+    def __init__(self, toas, model, residuals=None):
+        super().__init__(toas, model, residuals)
+        self.noise_realizations = {}
+        self._retrace()
+
+    def _step(self, vec, base_values):
+        resid_fn = self._resid_fn_of(base_values)
+        values = self._merged(base_values, vec)
+        sigma = self.resids.sigma_fn(values)
+        U, phi = self.resids._noise_basis_phi(values)
+        r = resid_fn(vec)
+        J = jax.jacfwd(resid_fn)(vec)
+        dpar, cov, ncoef, chi2 = gls_normal_solve(r, J, sigma, U, phi)
+        return vec + dpar, chi2, dpar, cov, ncoef
+
+    def _set_noise_realizations(self, ncoef):
+        """Per-component noise realizations U_c @ a_c [s] (reference
+        fitter.py:2269)."""
+        ncoef = np.asarray(ncoef)
+        self.noise_realizations = {}
+        for name, (start, nb) in self.prepared.noise_dimensions().items():
+            basis = np.asarray(self.prepared.noise_basis[:, start:start + nb])
+            self.noise_realizations[name] = basis @ ncoef[start:start + nb]
+
+    def _post_fit(self):
+        """Solve once more at the written-back optimum so the noise
+        realizations correspond to the reported parameters (the loop's
+        extras are one Gauss-Newton step stale)."""
+        vec = jnp.array(
+            [self.model.values[k] for k in self._traced_free],
+            dtype=jnp.float64,
+        )
+        base = self.prepared._values_pytree()
+        *_, ncoef = self._step_jit(vec, base)
+        self._set_noise_realizations(ncoef)

@@ -26,21 +26,37 @@ def _make_fit_one(prepared, resids, grid_params, fit_params, n_steps):
     """Build the pure function grid_values -> (chi2, fitted_values)."""
 
     base_values = {k: jnp.float64(v) for k, v in prepared.model.values.items()}
-    err = prepared.batch.error_s
+    correlated = prepared.model.has_correlated_errors
 
-    def resid_of(fit_vec, grid_vec):
+    def values_of(fit_vec, grid_vec):
         values = dict(base_values)
         for i, name in enumerate(grid_params):
             values[name] = grid_vec[i]
         for i, name in enumerate(fit_params):
             values[name] = fit_vec[i]
-        return resids.time_resids_fn(values)
+        return values
+
+    def resid_of(fit_vec, grid_vec):
+        return resids.time_resids_fn(values_of(fit_vec, grid_vec))
 
     def gn_step(fit_vec, grid_vec):
+        values = values_of(fit_vec, grid_vec)
+        sigma = resids.sigma_fn(values)
+        if correlated:
+            import jax as _jax
+
+            from pint_tpu.linalg import gls_normal_solve
+
+            fn = lambda v: resid_of(v, grid_vec)  # noqa: E731
+            U, phi = resids._noise_basis_phi(values)
+            dpar, *_ = gls_normal_solve(
+                fn(fit_vec), _jax.jacfwd(fn)(fit_vec), sigma, U, phi
+            )
+            return fit_vec + dpar
         from pint_tpu.fitter import wls_gn_solve
 
         new_vec, _, _, _ = wls_gn_solve(
-            lambda v: resid_of(v, grid_vec), fit_vec, err
+            lambda v: resid_of(v, grid_vec), fit_vec, sigma
         )
         return new_vec
 
@@ -53,8 +69,7 @@ def _make_fit_one(prepared, resids, grid_params, fit_params, n_steps):
         if fit_params:  # all-params-gridded case: plain chi2 evaluation
             for _ in range(n_steps):  # unrolled: small fixed count
                 vec = gn_step(vec, grid_vec)
-        r = resid_of(vec, grid_vec)
-        chi2 = jnp.sum((r / err) ** 2)
+        chi2 = resids.chi2_fn(values_of(vec, grid_vec))
         return chi2, vec
 
     return fit_one
@@ -67,7 +82,7 @@ def make_grid_fn(toas, model, grid_params, n_steps=3):
     resids = Residuals(toas, model)
     prepared = resids.prepared
     grid_params = list(grid_params)
-    fit_params = [p for p in model.free_params if p not in grid_params]
+    fit_params = [p for p in model.free_timing_params if p not in grid_params]
     fit_one = _make_fit_one(prepared, resids, grid_params, fit_params,
                             n_steps)
     return jax.jit(jax.vmap(fit_one)), fit_params

@@ -179,6 +179,10 @@ class BinaryELL1H(ELL1Base):
                              description="Orthometric Shapiro amplitude"))
         self.mode = "H3"
         self.nharms = int(float(pardict.get("NHARMS", [["3"]])[0][0]))
+        # declared so the builder consumes it and parfile round-trips
+        # preserve it (the value used is the static self.nharms)
+        self.add_param(Param("NHARMS", fittable=False,
+                             description="Shapiro harmonics summed"))
         if "STIGMA" in pardict or "VARSIGMA" in pardict:
             self.add_param(Param("STIGMA", aliases=("VARSIGMA",),
                                  description="Orthometric ratio"))
@@ -192,6 +196,7 @@ class BinaryELL1H(ELL1Base):
     def defaults(self):
         d = super().defaults()
         d["H3"] = 0.0
+        d["NHARMS"] = float(self.nharms)
         if self.mode == "STIGMA":
             d["STIGMA"] = 0.0
         elif self.mode == "H4":

@@ -35,6 +35,14 @@ from pint_tpu.models.dispersion import (  # noqa: F401
     DispersionJump,
 )
 from pint_tpu.models.jump import PhaseJump  # noqa: F401
+from pint_tpu.models.noise import (  # noqa: F401
+    EcorrNoise,
+    PLChromNoise,
+    PLDMNoise,
+    PLRedNoise,
+    ScaleDmError,
+    ScaleToaError,
+)
 from pint_tpu.models.solar_system_shapiro import SolarSystemShapiro  # noqa: F401
 from pint_tpu.models.spindown import Spindown  # noqa: F401
 import pint_tpu.models.binary  # noqa: F401  (registers binary families)
@@ -62,7 +70,19 @@ _ALIASES = {
     "PMLAMBDA": "PMELONG",
     "PMBETA": "PMELAT",
     "A1DOT": "XDOT",
+    # noise mask-parameter aliases (reference noise_model.py:60-79,355)
+    "T2EFAC": "EFAC",
+    "TNEF": "EFAC",
+    "T2EQUAD": "EQUAD",
+    "TNECORR": "ECORR",
 }
+
+#: mask-parameter families: "KEY selector value [fit [unc]]" par lines
+#: (reference maskParameter, parameter.py:1782)
+_MASK_KEYS = (
+    "JUMP", "DMJUMP", "EFAC", "EQUAD", "TNEQ", "ECORR",
+    "DMEFAC", "DMEQUAD",
+)
 
 
 def parse_parfile(path_or_text: str) -> Dict[str, List[List[str]]]:
@@ -134,22 +154,13 @@ def get_model(parfile) -> TimingModel:
         get_binary_class(pardict["BINARY"][0][0])  # raises if unknown
 
     # mask-parameter selectors must exist before component instantiation
-    jump_selects = []
-    jump_rest = []
-    for tokens in pardict.get("JUMP", []):
-        sel, rest = parse_mask_select(tokens)
-        jump_selects.append(sel)
-        jump_rest.append(rest)
-    if jump_selects:
-        pardict["__JUMP_selects__"] = jump_selects  # type: ignore
-    dmjump_selects = []
-    dmjump_rest = []
-    for tokens in pardict.get("DMJUMP", []):
-        sel, rest = parse_mask_select(tokens)
-        dmjump_selects.append(sel)
-        dmjump_rest.append(rest)
-    if dmjump_selects:
-        pardict["__DMJUMP_selects__"] = dmjump_selects  # type: ignore
+    masks: Dict[str, list] = {}
+    for key in _MASK_KEYS:
+        for tokens in pardict.get(key, []):
+            sel, rest = parse_mask_select(tokens)
+            masks.setdefault(key, []).append((sel, rest))
+    if masks:
+        pardict["__MASKS__"] = masks  # type: ignore
 
     model = TimingModel(name=str(parfile)[:120])
     chosen = choose_components(pardict)
@@ -179,7 +190,7 @@ def get_model(parfile) -> TimingModel:
             model.meta[key] = " ".join(occurrences[0])
             consumed.add(key)
             continue
-        if key in ("JUMP", "DMJUMP"):
+        if key in _MASK_KEYS:
             consumed.add(key)
             continue
         pname = key if key in params else alias_map.get(key)
@@ -198,26 +209,26 @@ def get_model(parfile) -> TimingModel:
                 p.frozen = False
             if len(tokens) > 2:
                 try:
-                    p.uncertainty = float(tokens[2].replace("D", "E"))
+                    p.uncertainty = p.parse_uncertainty(tokens[2])
                 except ValueError:
                     pass
         consumed.add(key)
 
-    # JUMP/DMJUMP values (mask params): JUMPn in file order
-    for i, rest in enumerate(jump_rest, start=1):
-        name = f"JUMP{i}"
-        if name in model.values and rest:
-            model.values[name] = float(rest[0])
-            if len(rest) > 1 and rest[1] in ("1", "2"):
-                params[name].frozen = False
-            if len(rest) > 2:
-                params[name].uncertainty = float(rest[2])
-    for i, rest in enumerate(dmjump_rest, start=1):
-        name = f"DMJUMP{i}"
-        if name in model.values and rest:
-            model.values[name] = float(rest[0])
-            if len(rest) > 1 and rest[1] in ("1", "2"):
-                params[name].frozen = False
+    # mask-parameter values: KEYn in file order (JUMP1, EFAC2, ...)
+    for key, entries in masks.items():
+        for i, (_sel, rest) in enumerate(entries, start=1):
+            name = f"{key}{i}"
+            if name in params and rest:
+                model.values[name] = params[name].parse(rest[0])
+                if len(rest) > 1 and rest[1] in ("1", "2"):
+                    params[name].frozen = False
+                if len(rest) > 2:
+                    try:
+                        params[name].uncertainty = (
+                            params[name].parse_uncertainty(rest[2])
+                        )
+                    except ValueError:
+                        pass
 
     unknown = [
         k for k in pardict
@@ -264,7 +275,11 @@ def model_to_parfile(model: TimingModel) -> str:
         if isinstance(v, float) and np.isnan(v):
             continue
         fit = "1" if not p.frozen else "0"
-        unc = f" {p.uncertainty:.6g}" if p.uncertainty is not None else ""
+        unc = (
+            f" {p.uncertainty / p.scale:.6g}"
+            if p.uncertainty is not None
+            else ""
+        )
         if p.select:
             kind = p.select[0]
             if kind == "flag":

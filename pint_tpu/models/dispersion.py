@@ -147,7 +147,8 @@ class DispersionJump(DelayComponent):
 
     @classmethod
     def from_parfile(cls, pardict):
-        return cls(selects=pardict.get("__DMJUMP_selects__", ()))
+        masks = pardict.get("__MASKS__", {})
+        return cls(selects=[s for s, _ in masks.get("DMJUMP", [])])
 
     def defaults(self):
         return {f"DMJUMP{i}": 0.0 for i in range(1, len(self.selects) + 1)}

@@ -33,7 +33,8 @@ class _JumpBase:
 
     @classmethod
     def from_parfile(cls, pardict):
-        return cls(selects=pardict.get("__JUMP_selects__", ()))
+        masks = pardict.get("__MASKS__", {})
+        return cls(selects=[s for s, _ in masks.get("JUMP", [])])
 
     def defaults(self):
         return {f"JUMP{i}": 0.0 for i in range(1, len(self.selects) + 1)}

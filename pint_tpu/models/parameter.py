@@ -134,6 +134,16 @@ class Param:
             v *= self.scale_factor
         return v * self.scale
 
+    def parse_uncertainty(self, s: str) -> float:
+        """Par-file uncertainty token -> internal units.  Float kinds get
+        the full value treatment (D exponents, tempo unit_scale keyed on
+        the uncertainty's own magnitude — matching the reference, where
+        floatParameter shares one codec for value and uncertainty); other
+        kinds scale linearly."""
+        if self.kind == "float":
+            return self.parse(s)
+        return float(s.upper().replace("D", "E")) * self.scale
+
     def format(self, value: float, ndigits=15) -> str:
         if self.kind == "angle":
             return format_angle(value, self.hourangle)

@@ -122,6 +122,40 @@ class TimingModel:
     def phase_components(self):
         return [c for c in self.components if isinstance(c, PhaseComponent)]
 
+    @property
+    def noise_components(self):
+        from pint_tpu.models.noise import NoiseComponent
+
+        return [c for c in self.components if isinstance(c, NoiseComponent)]
+
+    @property
+    def has_correlated_errors(self) -> bool:
+        """Any noise component with a low-rank basis (reference:
+        timing_model.has_correlated_errors, timing_model.py:1062)."""
+        return any(
+            c.introduces_correlated_errors for c in self.noise_components
+        )
+
+    @property
+    def has_time_correlated_errors(self) -> bool:
+        return any(c.is_time_correlated for c in self.noise_components)
+
+    @property
+    def free_noise_params(self) -> List[str]:
+        """Free parameters owned by noise components — fit by
+        lnlikelihood maximization, not least squares (reference:
+        fitter._fit_noise, fitter.py:1230)."""
+        out = []
+        for c in self.noise_components:
+            out.extend(p.name for p in c.params if not p.frozen)
+        return out
+
+    @property
+    def free_timing_params(self) -> List[str]:
+        """Free parameters that enter the design matrix."""
+        noise = set(self.free_noise_params)
+        return [p for p in self.free_params if p not in noise]
+
     def __getitem__(self, name):
         return self.values[name]
 
@@ -171,7 +205,52 @@ class PreparedModel:
                         type(cc).__name__: cc.prepare(tzr_toas, model)
                         for cc in model.components
                     }
+        # correlated-noise bases are static per dataset; stack them once
+        # (reference: noise_model_designmatrix, timing_model.py:1690)
+        self._noise_basis_comps = []
+        parts = []
+        for c in model.noise_components:
+            b = c.basis(self.ctx[type(c).__name__])
+            if b is not None and b.shape[1] > 0:
+                self._noise_basis_comps.append(c)
+                parts.append(np.asarray(b))
+        n = self.batch.ticks.shape[0]
+        self.noise_basis = jnp.asarray(
+            np.concatenate(parts, axis=1) if parts else np.zeros((n, 0))
+        )
         self._phase_jit = jax.jit(self._phase_raw)
+
+    # -- noise interface ------------------------------------------------------
+    def scaled_sigma_fn(self, values):
+        """Per-TOA uncertainty [s] after white-noise scaling (reference:
+        scaled_toa_uncertainty, timing_model.py:1644)."""
+        sigma = self.batch.error_s
+        for c in self.model.noise_components:
+            sigma = c.scaled_sigma(
+                values, self.batch, self.ctx[type(c).__name__], sigma
+            )
+        return sigma
+
+    def noise_weights_fn(self, values):
+        """Concatenated basis weights phi, aligned with noise_basis
+        columns (reference: noise_model_basis_weight,
+        timing_model.py:1696)."""
+        parts = [
+            c.weights(values, self.ctx[type(c).__name__])
+            for c in self._noise_basis_comps
+        ]
+        return jnp.concatenate(parts) if parts else jnp.zeros(0)
+
+    def noise_dimensions(self):
+        """{component_name: (start, length)} slices into the stacked
+        basis (reference: noise_model_dimensions, timing_model.py:1702)."""
+        out = {}
+        start = 0
+        for c in self._noise_basis_comps:
+            nb = int(c.basis(self.ctx[type(c).__name__]).shape[1])
+            out[type(c).__name__] = (start, nb)
+            start += nb
+        return out
 
     # pure function of values (pytree dict of f64 scalars)
     def _delay_raw(self, values, batch, ctx_map):

@@ -2,11 +2,17 @@
 
 Counterpart of the reference Residuals (reference: src/pint/residuals.py:40,
 ``calc_phase_resids`` at :314-425, ``calc_time_resids`` at :483,
-``calc_chi2`` at :669).  Phase residuals come out of the jitted model as
-an (int64 turns, f64 frac) pair; 'nearest' tracking is the frac part by
-construction, 'pulse_number' tracking differences the integer part against
-tracked pulse numbers.  Mean subtraction is weighted (1/err^2) and skipped
-when the model carries an explicit PHOFF (reference :372-425 semantics).
+``calc_chi2`` at :669, ``lnlikelihood`` at :713).  Phase residuals come
+out of the jitted model as an (int64 turns, f64 frac) pair; 'nearest'
+tracking is the frac part by construction, 'pulse_number' tracking
+differences the integer part against tracked pulse numbers.  Mean
+subtraction is weighted (1/sigma^2, noise-scaled) and skipped when the
+model carries an explicit PHOFF (reference :372-425 semantics).
+
+chi^2 dispatch mirrors the reference: plain WLS sum when the model has
+no correlated noise; Woodbury over the low-rank noise basis otherwise,
+with a unit basis column at weight 1e40 absorbing the subtracted mean
+(reference :567-636, the 1e40 column at :583-585).
 """
 
 from __future__ import annotations
@@ -15,9 +21,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pint_tpu.linalg import woodbury_chi2_logdet
 from pint_tpu.models.timing_model import PreparedModel, TimingModel
 
 __all__ = ["Residuals"]
+
+#: weight given to the synthetic constant-offset basis column when the
+#: mean is subtracted (reference residuals.py:583-585)
+MEAN_OFFSET_WEIGHT = 1e40
 
 
 def weighted_mean_phase(frac, weights):
@@ -45,26 +56,61 @@ class Residuals:
                 "(-pn flags / track_pulse_numbers) milestone"
             )
         self.track_mode = track_mode
-        self._weights = jnp.asarray(1.0 / self.toas.error_us**2)
         self._phase_resids_jit = jax.jit(self.phase_resids_fn)
         self._time_resids_jit = jax.jit(self.time_resids_fn)
         self._chi2_jit = jax.jit(self.chi2_fn)
+        self._lnlike_jit = jax.jit(self.lnlikelihood_fn)
 
     # -- pure functions (values pytree -> arrays), jit-safe ------------------
+    def sigma_fn(self, values):
+        """Noise-scaled per-TOA uncertainty [s]."""
+        return self.prepared.scaled_sigma_fn(values)
+
     def phase_resids_fn(self, values):
         _, frac = self.prepared._phase_raw(values)
         resid = frac
         if self.subtract_mean:
-            resid = resid - weighted_mean_phase(resid, self._weights)
+            w = 1.0 / self.sigma_fn(values) ** 2
+            resid = resid - weighted_mean_phase(resid, w)
         return resid
 
     def time_resids_fn(self, values):
         return self.phase_resids_fn(values) / values["F0"]
 
+    def _noise_basis_phi(self, values):
+        """(U, phi) for the Woodbury paths, with the mean-offset column
+        appended when applicable."""
+        U = self.prepared.noise_basis
+        phi = self.prepared.noise_weights_fn(values)
+        if self.subtract_mean:
+            ones = jnp.ones((U.shape[0], 1))
+            U = jnp.concatenate([U, ones], axis=1)
+            phi = jnp.concatenate([phi, jnp.array([MEAN_OFFSET_WEIGHT])])
+        return U, phi
+
     def chi2_fn(self, values):
         r = self.time_resids_fn(values)
-        err = self.prepared.batch.error_s
-        return jnp.sum((r / err) ** 2)
+        sigma = self.sigma_fn(values)
+        if not self.model.has_correlated_errors:
+            return jnp.sum((r / sigma) ** 2)
+        U, phi = self._noise_basis_phi(values)
+        chi2, _ = woodbury_chi2_logdet(r, sigma, U, phi)
+        return chi2
+
+    def lnlikelihood_fn(self, values):
+        """Gaussian log-likelihood of the residuals under the full noise
+        covariance (reference residuals.py:713); differentiable wrt
+        noise parameters for gradient-based noise fitting."""
+        r = self.time_resids_fn(values)
+        sigma = self.sigma_fn(values)
+        n = r.shape[0]
+        if not self.model.has_correlated_errors:
+            chi2 = jnp.sum((r / sigma) ** 2)
+            logdet = 2.0 * jnp.sum(jnp.log(sigma))
+        else:
+            U, phi = self._noise_basis_phi(values)
+            chi2, logdet = woodbury_chi2_logdet(r, sigma, U, phi)
+        return -0.5 * (chi2 + logdet) - 0.5 * n * jnp.log(2.0 * jnp.pi)
 
     # -- convenience numpy accessors -----------------------------------------
     def _values(self, values=None):
@@ -82,6 +128,14 @@ class Residuals:
     def chi2(self):
         return float(self._chi2_jit(self._values()))
 
+    def lnlikelihood(self, values=None):
+        return float(self._lnlike_jit(self._values(values)))
+
+    @property
+    def scaled_errors(self):
+        """Noise-scaled uncertainties [s] at current parameter values."""
+        return np.asarray(self.sigma_fn(self._values()))
+
     @property
     def dof(self):
         return len(self.toas) - len(self.model.free_params) - int(
@@ -95,5 +149,5 @@ class Residuals:
     def rms_weighted(self):
         """Weighted RMS of time residuals [s]."""
         r = self.time_resids
-        w = 1.0 / (self.toas.error_us * 1e-6) ** 2
+        w = 1.0 / self.scaled_errors**2
         return float(np.sqrt(np.sum(r**2 * w) / np.sum(w)))

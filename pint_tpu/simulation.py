@@ -39,18 +39,23 @@ def make_fake_toas_uniform(
     add_noise=False,
     rng=None,
     wideband=False,
+    flags=None,
 ):
     """Evenly-spaced TOAs with zero residuals under ``model``
-    (+ optional white noise scaled by the TOA errors)."""
+    (+ optional white noise scaled by the TOA errors).  ``flags`` is an
+    optional per-TOA flag dict applied to every TOA (so mask parameters
+    like EFAC ``-f`` selectors have something to select on)."""
     mjds = np.linspace(float(start_mjd), float(end_mjd), int(ntoas))
     freqs = np.broadcast_to(np.asarray(freq_mhz, dtype=np.float64), (ntoas,))
+    flags = dict(flags or {})
     toa_list = []
     for mjd, f in zip(mjds, freqs):
         day = int(np.floor(mjd))
         frac = mjd - day
         num = int(round(frac * 10**12))
         toa_list.append(
-            TOA(day, num, 10**12, float(error_us), float(f), obs, {}, "fake")
+            TOA(day, num, 10**12, float(error_us), float(f), obs,
+                dict(flags), "fake")
         )
     planets = bool(model.values.get("PLANET_SHAPIRO", 0.0))
     toas = TOAs(toa_list, ephem=model.meta.get("EPHEM", "builtin"),
