@@ -145,3 +145,58 @@ class AstrometryEcliptic(AstrometryBase):
         lat_t = lat + values["PMELAT"] * _MASYR * dt
         necl = _unit_vector(lon_t, lat_t)
         return necl @ _EQ_FROM_ECL.T
+
+
+def psr_dir_static(model) -> np.ndarray:
+    """SSB->pulsar ICRS unit vector from the model's *current* astrometry
+    values, as a static numpy array (no proper motion).
+
+    Used for geometry that is effectively constant over a fit: barycentric
+    Doppler of the observing frequency, solar elongation for the solar-wind
+    delay, altitude for the troposphere delay (the reference likewise
+    computes these from the model coordinates once per evaluation,
+    e.g. astrometry.py ``sun_angle``, troposphere_delay.py
+    ``_get_target_skycoord``)."""
+    v = model.values
+    if "RAJ" in v and not np.isnan(v.get("RAJ", np.nan)):
+        ra, dec = float(v["RAJ"]), float(v["DECJ"])
+        return np.array(
+            [np.cos(dec) * np.cos(ra), np.cos(dec) * np.sin(ra), np.sin(dec)]
+        )
+    if "ELONG" in v and not np.isnan(v.get("ELONG", np.nan)):
+        lon, lat = float(v["ELONG"]), float(v["ELAT"])
+        necl = np.array(
+            [np.cos(lat) * np.cos(lon), np.cos(lat) * np.sin(lon),
+             np.sin(lat)]
+        )
+        return np.asarray(_EQ_FROM_ECL) @ necl
+    raise ValueError("model has no astrometry (RAJ/DECJ or ELONG/ELAT)")
+
+
+def bary_freq_mhz(toas, model) -> np.ndarray:
+    """Barycentric observing frequency (MHz) per TOA: first-order Doppler
+    ``f * (1 - n.v_obs/c)`` (reference: timing_model
+    ``barycentric_radio_freq``; ssb_obs_vel is stored in ls/s so ``n.v``
+    is already v/c).  Static per dataset — the change of the Doppler
+    factor under astrometry fitting is < 1e-9 relative."""
+    try:
+        n = psr_dir_static(model)
+    except ValueError:
+        # no astrometry component (already-barycentered data): the
+        # topocentric frequency is all we have (the reference warns and
+        # does the same, frequency_dependent.py FD_delay)
+        return np.asarray(toas.freq_mhz)
+    # many chromatic components call this per prepare(); memoize the O(N)
+    # product on the TOAs object, keyed by the direction it was built for
+    key = (round(float(n[0]), 14), round(float(n[1]), 14),
+           round(float(n[2]), 14))
+    memo = getattr(toas, "_bfreq_memo", None)
+    if memo is not None and memo[0] == key:
+        return memo[1]
+    beta = np.asarray(toas.ssb_obs_vel) @ n
+    bf = np.asarray(toas.freq_mhz) * (1.0 - beta)
+    try:
+        toas._bfreq_memo = (key, bf)
+    except AttributeError:
+        pass
+    return bf

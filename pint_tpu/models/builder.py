@@ -45,6 +45,16 @@ from pint_tpu.models.noise import (  # noqa: F401
 )
 from pint_tpu.models.solar_system_shapiro import SolarSystemShapiro  # noqa: F401
 from pint_tpu.models.spindown import Spindown  # noqa: F401
+from pint_tpu.models.wavex import CMWaveX, DMWaveX, WaveX  # noqa: F401
+from pint_tpu.models.wave import IFunc, Wave  # noqa: F401
+from pint_tpu.models.glitch import Glitch, PiecewiseSpindown  # noqa: F401
+from pint_tpu.models.chromatic import ChromaticCM  # noqa: F401
+from pint_tpu.models.fd import FD, FDJump, FDJumpDM  # noqa: F401
+from pint_tpu.models.solar_wind import (  # noqa: F401
+    SolarWindDispersion,
+    SolarWindDispersionX,
+)
+from pint_tpu.models.troposphere import TroposphereDelay  # noqa: F401
 import pint_tpu.models.binary  # noqa: F401  (registers binary families)
 
 __all__ = ["parse_parfile", "get_model", "get_model_and_toas",
@@ -53,9 +63,9 @@ __all__ = ["parse_parfile", "get_model", "get_model_and_toas",
 #: par keys that are model metadata, not fit parameters
 _META_KEYS = {
     "PSR", "PSRJ", "PSRB", "EPHEM", "CLK", "CLOCK", "UNITS", "TIMEEPH",
-    "T2CMETHOD", "CORRECT_TROPOSPHERE", "DILATEFREQ", "NTOA", "TRES",
+    "T2CMETHOD", "DILATEFREQ", "NTOA", "TRES",
     "CHI2", "CHI2R", "TZRSITE", "INFO", "BINARY", "START", "FINISH",
-    "SOLARN0", "NE_SW", "SWM", "DMDATA", "MODE", "EPHVER", "NITS",
+    "DMDATA", "MODE", "EPHVER", "NITS",
     "IBOOT", "DMX",
 }
 
@@ -75,13 +85,19 @@ _ALIASES = {
     "TNEF": "EFAC",
     "T2EQUAD": "EQUAD",
     "TNECORR": "ECORR",
+    "NE1AU": "NE_SW",
+    "SOLARN0": "NE_SW",
 }
+
+#: tempo2 writes "FDJUMPp"; internally the mask family key is "FDpJUMP"
+_FDJUMP_RE = re.compile(r"^FD(\d+)JUMP$")
+_FDJUMP_ALT_RE = re.compile(r"^FDJUMP(\d+)$")
 
 #: mask-parameter families: "KEY selector value [fit [unc]]" par lines
 #: (reference maskParameter, parameter.py:1782)
 _MASK_KEYS = (
     "JUMP", "DMJUMP", "EFAC", "EQUAD", "TNEQ", "ECORR",
-    "DMEFAC", "DMEQUAD",
+    "DMEFAC", "DMEQUAD", "FDJUMPDM",
 )
 
 
@@ -140,6 +156,9 @@ def get_model(parfile) -> TimingModel:
     # canonicalize keys
     pardict: Dict[str, List[List[str]]] = {}
     for k, v in pardict_raw.items():
+        m = _FDJUMP_ALT_RE.match(k)
+        if m:  # tempo2 "FDJUMPp" spelling -> internal "FDpJUMP"
+            k = f"FD{m.group(1)}JUMP"
         pardict.setdefault(_canonical(k), []).extend(v)
 
     units = (pardict.get("UNITS", [["TDB"]])[0] or ["TDB"])[0].upper()
@@ -154,8 +173,11 @@ def get_model(parfile) -> TimingModel:
         get_binary_class(pardict["BINARY"][0][0])  # raises if unknown
 
     # mask-parameter selectors must exist before component instantiation
+    mask_keys = list(_MASK_KEYS) + [
+        k for k in pardict if _FDJUMP_RE.match(k)
+    ]
     masks: Dict[str, list] = {}
-    for key in _MASK_KEYS:
+    for key in mask_keys:
         for tokens in pardict.get(key, []):
             sel, rest = parse_mask_select(tokens)
             masks.setdefault(key, []).append((sel, rest))
@@ -164,6 +186,8 @@ def get_model(parfile) -> TimingModel:
 
     model = TimingModel(name=str(parfile)[:120])
     chosen = choose_components(pardict)
+    if any(_FDJUMP_RE.match(k) for k in masks):
+        chosen.append(FDJump)
     if "BINARY" in pardict:
         from pint_tpu.models.binary import get_binary_class
 
@@ -190,7 +214,7 @@ def get_model(parfile) -> TimingModel:
             model.meta[key] = " ".join(occurrences[0])
             consumed.add(key)
             continue
-        if key in _MASK_KEYS:
+        if key in mask_keys:
             consumed.add(key)
             continue
         pname = key if key in params else alias_map.get(key)
@@ -229,6 +253,12 @@ def get_model(parfile) -> TimingModel:
                         )
                     except ValueError:
                         pass
+
+    # pair-valued and other component-specific par lines (WAVEn, IFUNCn)
+    for comp in model.components:
+        hook = getattr(comp, "consume_parfile", None)
+        if hook is not None:
+            consumed |= set(hook(pardict, model))
 
     unknown = [
         k for k in pardict
@@ -269,8 +299,19 @@ def model_to_parfile(model: TimingModel) -> str:
     for k in ("PSR", "EPHEM", "CLK", "UNITS", "TZRSITE"):
         if k in model.meta:
             lines.append(f"{k:<15s} {model.meta[k]}")
+    # components with non-par-shaped params (pair lines WAVEn a b,
+    # IFUNCn mjd val) serialize themselves and mark params handled
+    handled = set()
+    for comp in model.components:
+        hook = getattr(comp, "parfile_lines", None)
+        if hook is not None:
+            extra, done = hook(model)
+            lines.extend(extra)
+            handled |= set(done)
     params = model.params
     for name, p in params.items():
+        if name in handled:
+            continue
         v = model.values.get(name, np.nan)
         if isinstance(v, float) and np.isnan(v):
             continue

@@ -1,0 +1,83 @@
+"""Generic chromatic (nu^-alpha) delay: ChromaticCM Taylor series.
+
+Counterpart of the reference ChromaticCM (reference:
+src/pint/models/chromatic_model.py:113 ``chromatic_time_delay``:
+delay = K * CM(t) * (nu/MHz)^-TNCHROMIDX with CM(t) a Taylor series
+about CMEPOCH in pc cm^-3 MHz^(alpha-2) / yr^k).  The Fourier variant
+CMWaveX lives in :mod:`pint_tpu.models.wavex`.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu import DM_CONST
+from pint_tpu.models.component import DelayComponent
+from pint_tpu.models.parameter import Param, prefix_index
+
+
+class ChromaticCM(DelayComponent):
+    register = True
+    category = "chromatic"
+    trigger_params = ("CM",)
+
+    def __init__(self, num_cm_derivs=0):
+        super().__init__()
+        self.num_cm_derivs = num_cm_derivs
+        self.add_param(Param("CM", units="pc cm^-3 MHz^(alpha-2)",
+                             description="Chromatic measure"))
+        for k in range(1, num_cm_derivs + 1):
+            self.add_param(Param(f"CM{k}",
+                                 units=f"pc cm^-3 MHz^(alpha-2)/yr^{k}",
+                                 description=f"CM derivative {k}"))
+        self.add_param(Param("CMEPOCH", kind="mjd", fittable=False,
+                             description="Epoch of CM"))
+        self.add_param(Param("TNCHROMIDX", units="", fittable=False,
+                             description="Chromatic index alpha"))
+
+    @classmethod
+    def from_parfile(cls, pardict):
+        n = 0
+        for key in pardict:
+            pi = prefix_index(key)
+            if pi and pi[0] == "CM" and not key.startswith(
+                ("CMWX", "CMEPOCH")
+            ):
+                n = max(n, pi[1])
+        return cls(num_cm_derivs=n)
+
+    def defaults(self):
+        d = {f"CM{k}": 0.0 for k in range(1, self.num_cm_derivs + 1)}
+        d["CM"] = 0.0
+        d["CMEPOCH"] = np.nan
+        d["TNCHROMIDX"] = 4.0
+        return d
+
+    def prepare(self, toas, model):
+        from pint_tpu.models.astrometry import bary_freq_mhz
+
+        ep = model.values.get("CMEPOCH", np.nan)
+        if np.isnan(ep):
+            ep = model.values.get("PEPOCH", 0.0)
+        t = toas.ticks.astype(np.float64) / 2**32
+        return {
+            "dt_yr": jnp.asarray((t - ep) / (365.25 * 86400.0)),
+            "bfreq": jnp.asarray(bary_freq_mhz(toas, model)),
+        }
+
+    def cm_at(self, values, ctx):
+        cm = values["CM"]
+        if self.num_cm_derivs:
+            dt = ctx["dt_yr"]
+            fact = 1.0
+            power = dt
+            for k in range(1, self.num_cm_derivs + 1):
+                fact *= k
+                cm = cm + values[f"CM{k}"] * power / fact
+                power = power * dt
+        return cm
+
+    def delay(self, values, batch, ctx, delay_accum):
+        cm = self.cm_at(values, ctx)
+        return DM_CONST * cm * ctx["bfreq"] ** (-values["TNCHROMIDX"])

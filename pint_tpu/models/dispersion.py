@@ -54,9 +54,18 @@ class DispersionDM(DelayComponent):
         if np.isnan(ep):
             ep = model.values.get("PEPOCH", 0.0)
         t = toas.ticks.astype(np.float64) / 2**32
+        from pint_tpu.models.astrometry import bary_freq_mhz
+
         # DM1.. are in pc cm^-3 per YEAR^k (par-file convention; the
         # reference evaluates dt.to(u.yr), dispersion_model.py:274)
-        return {"dt_yr": jnp.asarray((t - ep) / (365.25 * 86400.0))}
+        return {
+            "dt_yr": jnp.asarray((t - ep) / (365.25 * 86400.0)),
+            # the reference evaluates dispersion at the *barycentric*
+            # radio frequency (dispersion_model.py uses
+            # barycentric_radio_freq); ~1e-4 relative Doppler matters
+            # at the 100-ns level for ms-pulsar DM delays
+            "bfreq": jnp.asarray(bary_freq_mhz(toas, model)),
+        }
 
     def dm_at(self, values, ctx):
         dm = values["DM"]
@@ -72,7 +81,7 @@ class DispersionDM(DelayComponent):
 
     def delay(self, values, batch, ctx, delay_accum):
         dm = self.dm_at(values, ctx)
-        return DM_CONST * dm / batch.freq_mhz**2
+        return DM_CONST * dm / ctx["bfreq"] ** 2
 
 
 class DispersionDMX(DelayComponent):
@@ -119,14 +128,19 @@ class DispersionDMX(DelayComponent):
             if masks
             else np.zeros((0, len(toas)), dtype=bool)
         )
-        return {"masks": jnp.asarray(m)}
+        from pint_tpu.models.astrometry import bary_freq_mhz
+
+        return {
+            "masks": jnp.asarray(m),
+            "bfreq": jnp.asarray(bary_freq_mhz(toas, model)),
+        }
 
     def delay(self, values, batch, ctx, delay_accum):
         if not self.indices:
             return jnp.zeros_like(batch.freq_mhz)
         dmx = jnp.stack([values[f"DMX_{i:04d}"] for i in self.indices])
         dm_per_toa = jnp.sum(ctx["masks"] * dmx[:, None], axis=0)
-        return DM_CONST * dm_per_toa / batch.freq_mhz**2
+        return DM_CONST * dm_per_toa / ctx["bfreq"] ** 2
 
 
 class DispersionJump(DelayComponent):
@@ -162,7 +176,12 @@ class DispersionJump(DelayComponent):
             if masks
             else np.zeros((0, len(toas)), dtype=bool)
         )
-        return {"masks": jnp.asarray(m)}
+        from pint_tpu.models.astrometry import bary_freq_mhz
+
+        return {
+            "masks": jnp.asarray(m),
+            "bfreq": jnp.asarray(bary_freq_mhz(toas, model)),
+        }
 
     def delay(self, values, batch, ctx, delay_accum):
         if not self.selects:
@@ -172,4 +191,4 @@ class DispersionJump(DelayComponent):
         )
         dm = jnp.sum(ctx["masks"] * dj[:, None], axis=0)
         # sign: DMJUMP measures *apparent* DM offset, subtracted
-        return -DM_CONST * dm / batch.freq_mhz**2
+        return -DM_CONST * dm / ctx["bfreq"] ** 2

@@ -1,0 +1,183 @@
+"""Frequency-dependent profile-evolution delays: FD, FDJump, FDJumpDM.
+
+Counterparts of the reference components (reference:
+src/pint/models/frequency_dependent.py:12 ``FD_delay`` — Arzoumanian+
+2015 Eq. 2: delay = sum_k FDk ln(nu/GHz)^k; src/pint/models/fdjump.py:15
+— per-system FD terms FDpJUMPq with FDJUMPLOG selecting log- vs
+linear-frequency basis; src/pint/models/dispersion_model.py:805 FDJumpDM
+— system DM offsets tied to FDJUMP systems, wideband only).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu import DM_CONST
+from pint_tpu.models.component import (
+    DelayComponent,
+    mask_from_select,
+)
+from pint_tpu.models.parameter import Param, prefix_index
+
+
+class FD(DelayComponent):
+    register = True
+    category = "frequency_dependent"
+    trigger_params = ("FD1",)
+
+    def __init__(self, num_terms=0):
+        super().__init__()
+        self.num_terms = num_terms
+        for k in range(1, num_terms + 1):
+            self.add_param(Param(f"FD{k}", units="s",
+                                 description=f"FD coefficient ln^{k}"))
+
+    @classmethod
+    def from_parfile(cls, pardict):
+        n = 0
+        for key in pardict:
+            pi = prefix_index(key)
+            if pi and pi[0] == "FD" and key[2:].isdigit():
+                n = max(n, pi[1])
+        return cls(num_terms=n)
+
+    def defaults(self):
+        return {f"FD{k}": 0.0 for k in range(1, self.num_terms + 1)}
+
+    def prepare(self, toas, model):
+        from pint_tpu.models.astrometry import bary_freq_mhz
+
+        bfreq = bary_freq_mhz(toas, model)
+        logf = np.log(bfreq / 1000.0)
+        logf[~np.isfinite(logf)] = 0.0
+        return {"log_freq_ghz": jnp.asarray(logf)}
+
+    def delay(self, values, batch, ctx, delay_accum):
+        if not self.num_terms:
+            return jnp.zeros_like(batch.freq_mhz)
+        y = ctx["log_freq_ghz"]
+        # Horner over k = num_terms .. 1 (no constant term)
+        acc = jnp.zeros_like(y)
+        for k in range(self.num_terms, 0, -1):
+            acc = (acc + values[f"FD{k}"]) * y
+        return acc
+
+
+class FDJump(DelayComponent):
+    """Per-system FD polynomials.  Internal names FD{p}JUMP{q}: p = FD
+    index, q = system/mask index (reference fdjump.py:44-49 naming)."""
+
+    register = True
+    category = "fdjump"
+    trigger_params = ()  # builder detects FD\d+JUMP mask keys
+
+    def __init__(self, terms=()):
+        """terms: sequence of (p, q, select) triples."""
+        super().__init__()
+        self.terms = tuple(terms)
+        self.add_param(Param("FDJUMPLOG", kind="bool", fittable=False,
+                             description="log-freq (Y) vs linear (N) basis"))
+        for p, q, sel in self.terms:
+            self.add_param(Param(f"FD{p}JUMP{q}", units="s", select=sel,
+                                 description=f"FD{p} jump, system {q}"))
+
+    @classmethod
+    def from_parfile(cls, pardict):
+        masks = pardict.get("__MASKS__", {})
+        terms = []
+        for key, entries in masks.items():
+            if key.startswith("FD") and key.endswith("JUMP"):
+                p = int(key[2:-4])
+                for q, (sel, _rest) in enumerate(entries, start=1):
+                    terms.append((p, q, sel))
+        return cls(terms=terms)
+
+    def defaults(self):
+        d = {f"FD{p}JUMP{q}": 0.0 for p, q, _ in self.terms}
+        d["FDJUMPLOG"] = 1.0
+        return d
+
+    def prepare(self, toas, model):
+        from pint_tpu.models.astrometry import bary_freq_mhz
+
+        bfreq = bary_freq_mhz(toas, model) / 1000.0  # GHz
+        use_log = bool(model.values.get("FDJUMPLOG", 1.0))
+        y = np.log(bfreq) if use_log else bfreq
+        y[~np.isfinite(y)] = 0.0
+        masks = [
+            np.asarray(mask_from_select(sel, toas))
+            for _p, _q, sel in self.terms
+        ]
+        m = (
+            np.stack(masks, 0)
+            if masks
+            else np.zeros((0, len(toas)), dtype=bool)
+        )
+        return {"y": jnp.asarray(y), "masks": jnp.asarray(m)}
+
+    def delay(self, values, batch, ctx, delay_accum):
+        y = ctx["y"]
+        out = jnp.zeros_like(y)
+        for j, (p, q, _sel) in enumerate(self.terms):
+            out = out + jnp.where(
+                ctx["masks"][j], values[f"FD{p}JUMP{q}"] * y**p, 0.0
+            )
+        return out
+
+
+class FDJumpDM(DelayComponent):
+    """System-dependent DM offsets (FDJUMPDM mask params) — the
+    narrow-band counterpart of wideband system DM offsets (reference:
+    dispersion_model.py:805-884).  Sign matches DMJUMP: the value is the
+    *apparent* DM offset, so the delay contribution is negative."""
+
+    register = True
+    category = "fdjumpdm"
+    trigger_params = ("FDJUMPDM",)
+
+    def __init__(self, selects=()):
+        super().__init__()
+        self.selects = tuple(selects)
+        for i, sel in enumerate(self.selects, start=1):
+            self.add_param(Param(f"FDJUMPDM{i}", units="pc cm^-3",
+                                 select=sel,
+                                 description=f"System DM offset {i}"))
+
+    @classmethod
+    def from_parfile(cls, pardict):
+        masks = pardict.get("__MASKS__", {})
+        return cls(selects=[s for s, _ in masks.get("FDJUMPDM", [])])
+
+    def defaults(self):
+        return {
+            f"FDJUMPDM{i}": 0.0 for i in range(1, len(self.selects) + 1)
+        }
+
+    def prepare(self, toas, model):
+        from pint_tpu.models.astrometry import bary_freq_mhz
+
+        masks = [
+            np.asarray(mask_from_select(sel, toas)) for sel in self.selects
+        ]
+        m = (
+            np.stack(masks, 0)
+            if masks
+            else np.zeros((0, len(toas)), dtype=bool)
+        )
+        return {
+            "masks": jnp.asarray(m),
+            "bfreq": jnp.asarray(bary_freq_mhz(toas, model)),
+        }
+
+    def delay(self, values, batch, ctx, delay_accum):
+        if not self.selects:
+            return jnp.zeros_like(batch.freq_mhz)
+        dj = jnp.stack(
+            [
+                values[f"FDJUMPDM{i}"]
+                for i in range(1, len(self.selects) + 1)
+            ]
+        )
+        dm = jnp.sum(ctx["masks"] * dj[:, None], axis=0)
+        return -DM_CONST * dm / ctx["bfreq"] ** 2

@@ -1,0 +1,182 @@
+"""Glitches and piecewise spindown solutions.
+
+Counterparts of the reference components (reference:
+src/pint/models/glitch.py:13 ``glitch_phase``, src/pint/models/
+piecewise.py:11 ``piecewise_phase``).  Both add extra spin-phase terms on
+TOA subsets selected by epoch:
+
+- Glitch i (t > GLEP_i): GLPH + dt (GLF0 + dt GLF1 / 2 + dt^2 GLF2 / 6)
+  + GLF0D GLTD (1 - exp(-dt / GLTD)),  dt = t - GLEP_i - delay [s]
+- Piecewise i (PWSTART_i <= t < PWSTOP_i): PWPH + dt PWF0 + dt^2 PWF1/2
+  + dt^3 PWF2/6,  dt = t - PWEP_i - delay [s]
+
+TPU design: the per-glitch Heaviside gates become ``jnp.where`` masks, so
+all glitches evaluate as one fused elementwise pass with no host branch.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu import SECS_PER_DAY
+from pint_tpu.models.component import PhaseComponent
+from pint_tpu.models.parameter import Param, prefix_index
+
+
+class Glitch(PhaseComponent):
+    register = True
+    category = "glitch"
+    trigger_params = ("GLEP",)
+
+    _FIELDS = ("GLEP_", "GLPH_", "GLF0_", "GLF1_", "GLF2_", "GLF0D_",
+               "GLTD_")
+
+    def __init__(self, indices=()):
+        super().__init__()
+        self.indices = tuple(indices)
+        for i in self.indices:
+            self.add_param(Param(f"GLEP_{i}", kind="mjd", fittable=False,
+                                 description=f"Epoch of glitch {i}"))
+            self.add_param(Param(f"GLPH_{i}", units="turns",
+                                 description=f"Phase step of glitch {i}"))
+            self.add_param(Param(f"GLF0_{i}", units="Hz",
+                                 description=f"Permanent dF0, glitch {i}"))
+            self.add_param(Param(f"GLF1_{i}", units="Hz/s",
+                                 description=f"Permanent dF1, glitch {i}"))
+            self.add_param(Param(f"GLF2_{i}", units="Hz/s^2",
+                                 description=f"Permanent dF2, glitch {i}"))
+            self.add_param(Param(f"GLF0D_{i}", units="Hz",
+                                 description=f"Decaying dF0, glitch {i}"))
+            self.add_param(Param(f"GLTD_{i}", units="d", scale=SECS_PER_DAY,
+                                 description=f"Decay timescale, glitch {i}"))
+
+    @classmethod
+    def from_parfile(cls, pardict):
+        idx = sorted(
+            {
+                prefix_index(k)[1]
+                for k in pardict
+                if k.startswith("GLEP_") and prefix_index(k)
+            }
+        )
+        return cls(indices=idx)
+
+    def defaults(self):
+        d = {}
+        for i in self.indices:
+            for f in ("GLPH_", "GLF0_", "GLF1_", "GLF2_", "GLF0D_",
+                      "GLTD_"):
+                d[f + str(i)] = 0.0
+        return d
+
+    def prepare(self, toas, model):
+        t = toas.ticks.astype(np.float64) / 2**32
+        return {"t_sec": jnp.asarray(t)}
+
+    def phase(self, values, batch, ctx, delay):
+        t = ctx["t_sec"] - delay
+        phs = jnp.zeros_like(t)
+        for i in self.indices:
+            dt = t - values[f"GLEP_{i}"]
+            on = dt > 0.0
+            dts = jnp.where(on, dt, 0.0)
+            tau = values[f"GLTD_{i}"]
+            # decay term with a safe divide at GLTD == 0
+            tau_safe = jnp.where(tau > 0.0, tau, 1.0)
+            decay = jnp.where(
+                tau > 0.0,
+                values[f"GLF0D_{i}"] * tau
+                * (1.0 - jnp.exp(-dts / tau_safe)),
+                0.0,
+            )
+            phs = phs + jnp.where(
+                on,
+                values[f"GLPH_{i}"]
+                + dts
+                * (
+                    values[f"GLF0_{i}"]
+                    + dts * (values[f"GLF1_{i}"] / 2.0
+                             + dts * values[f"GLF2_{i}"] / 6.0)
+                )
+                + decay,
+                0.0,
+            )
+        return phs
+
+
+class PiecewiseSpindown(PhaseComponent):
+    """Per-interval extra spindown solution (PWEP/PWSTART/PWSTOP/PWF0..)."""
+
+    register = True
+    category = "piecewise"
+    trigger_params = ("PWEP",)
+
+    def __init__(self, indices=()):
+        super().__init__()
+        self.indices = tuple(indices)
+        for i in self.indices:
+            self.add_param(Param(f"PWEP_{i}", kind="mjd", fittable=False,
+                                 description=f"Epoch of segment {i}"))
+            self.add_param(Param(f"PWSTART_{i}", kind="mjd", fittable=False,
+                                 description=f"Start of segment {i}"))
+            self.add_param(Param(f"PWSTOP_{i}", kind="mjd", fittable=False,
+                                 description=f"End of segment {i}"))
+            self.add_param(Param(f"PWPH_{i}", units="turns",
+                                 description=f"Phase offset, segment {i}"))
+            self.add_param(Param(f"PWF0_{i}", units="Hz",
+                                 description=f"dF0 in segment {i}"))
+            self.add_param(Param(f"PWF1_{i}", units="Hz/s",
+                                 description=f"dF1 in segment {i}"))
+            self.add_param(Param(f"PWF2_{i}", units="Hz/s^2",
+                                 description=f"dF2 in segment {i}"))
+
+    @classmethod
+    def from_parfile(cls, pardict):
+        idx = sorted(
+            {
+                prefix_index(k)[1]
+                for k in pardict
+                if k.startswith("PWEP_") and prefix_index(k)
+            }
+        )
+        return cls(indices=idx)
+
+    def defaults(self):
+        d = {}
+        for i in self.indices:
+            for f in ("PWPH_", "PWF0_", "PWF1_", "PWF2_"):
+                d[f + str(i)] = 0.0
+        return d
+
+    def prepare(self, toas, model):
+        t = toas.ticks.astype(np.float64) / 2**32
+        masks = []
+        for i in self.indices:
+            lo = model.values[f"PWSTART_{i}"]
+            hi = model.values[f"PWSTOP_{i}"]
+            masks.append((t >= lo) & (t < hi))
+        m = (
+            np.stack(masks, 0)
+            if masks
+            else np.zeros((0, len(toas)), dtype=bool)
+        )
+        return {"t_sec": jnp.asarray(t), "masks": jnp.asarray(m)}
+
+    def phase(self, values, batch, ctx, delay):
+        t = ctx["t_sec"] - delay
+        phs = jnp.zeros_like(t)
+        for j, i in enumerate(self.indices):
+            dt = t - values[f"PWEP_{i}"]
+            phs = phs + jnp.where(
+                ctx["masks"][j],
+                values[f"PWPH_{i}"]
+                + dt
+                * (
+                    values[f"PWF0_{i}"]
+                    + dt * (values[f"PWF1_{i}"] / 2.0
+                            + dt * values[f"PWF2_{i}"] / 6.0)
+                ),
+                0.0,
+            )
+        return phs

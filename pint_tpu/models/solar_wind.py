@@ -1,0 +1,224 @@
+"""Solar-wind dispersion: NE_SW spherical model and SWX piecewise model.
+
+Counterparts of the reference components (reference:
+src/pint/models/solar_wind_dispersion.py:290 SolarWindDispersion — SWM 0
+implements Edwards+ 2006 Eq. 29-30: DM_sw = NE_SW au^2 rho / (r sin rho)
+with rho = pi - elongation; SWM 1 implements Hazboun+ 2022 Eq. 11-12 (a
+power-law radial density n ~ r^-SWP, hypergeometric path integral
+``_dm_p_int`` at :19); :525 SolarWindDispersionX — per-interval SWXDM_
+amplitudes with power-law index SWXP_, normalized by (conjunction -
+opposition) geometry so SWXDM is the *excess* DM at conjunction).
+
+TPU design: the geometry factor depends only on the (static) TOA-Sun
+vectors and frozen power-law indices, so it is computed host-side once
+in ``prepare`` (with scipy's hyp2f1 for SWM 1) and enters the jit
+closure as a constant vector; the fittable amplitude NE_SW / SWXDM_k
+then scales it linearly on device.  SWP/SWM are not fittable here
+(the reference fits SWP numerically; rarely used).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu import AU_LS, DM_CONST
+from pint_tpu.models.component import DelayComponent
+from pint_tpu.models.parameter import Param, prefix_index
+
+#: 1 pc in AU (IAU): 648000/pi
+_AU_PER_PC = 648000.0 / np.pi
+
+
+def _geometry_swm0(r_au: np.ndarray, elong: np.ndarray) -> np.ndarray:
+    """Edwards+ 2006 geometry factor in pc (DM = NE_SW[cm^-3] * this)."""
+    rho = np.pi - elong
+    return rho / (r_au * np.sin(rho)) / _AU_PER_PC
+
+
+def _geometry_swm1(r_au: np.ndarray, elong: np.ndarray,
+                   p: float) -> np.ndarray:
+    """Hazboun+ 2022 Eq. 11 path integral in pc for density ~ r^-p."""
+    from scipy.special import hyp2f1
+
+    if p <= 1:
+        raise ValueError("solar-wind power-law index must be > 1")
+    b = r_au * np.sin(elong)  # impact parameter [AU]
+    z_sun = r_au * np.cos(elong)  # distance to closest approach [AU]
+    z_p = 1e14 * 299792458.0 / (AU_LS * 299792458.0)  # "infinity" in AU
+
+    def dm_p_int(z):
+        return (z / b) * hyp2f1(0.5, p / 2.0, 1.5, -(z**2) / b**2)
+
+    return (
+        (1.0 / b) ** p * b * (dm_p_int(z_p) - dm_p_int(-z_sun))
+    ) / _AU_PER_PC
+
+
+def _sun_geometry(toas, model):
+    """Per-TOA (r_AU, elongation_rad) of the Sun seen from the obs."""
+    from pint_tpu.models.astrometry import psr_dir_static
+
+    n = psr_dir_static(model)
+    s = np.asarray(toas.obs_sun_pos)  # obs->sun, light-seconds
+    r_ls = np.linalg.norm(s, axis=-1)
+    cos_e = np.clip((s @ n) / r_ls, -1.0, 1.0)
+    return r_ls / AU_LS, np.arccos(cos_e)
+
+
+class SolarWindDispersion(DelayComponent):
+    register = True
+    category = "solar_wind"
+    trigger_params = ("NE_SW", "NE1AU", "SOLARN0")
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(Param("NE_SW", units="cm^-3",
+                             aliases=("NE1AU", "SOLARN0"),
+                             description="Solar wind density at 1 AU"))
+        self.add_param(Param("SWM", units="", fittable=False,
+                             description="Solar wind model (0|1)"))
+        self.add_param(Param("SWP", units="", fittable=False,
+                             description="Radial power-law index (SWM 1)"))
+
+    def build_params(self, pardict):
+        pass
+
+    def defaults(self):
+        return {"NE_SW": 0.0, "SWM": 0.0, "SWP": 2.0}
+
+    def prepare(self, toas, model):
+        from pint_tpu.models.astrometry import bary_freq_mhz
+
+        r_au, elong = _sun_geometry(toas, model)
+        swm = int(round(model.values.get("SWM", 0.0)))
+        p = float(model.values.get("SWP", 2.0))
+        if swm == 0:
+            geom = _geometry_swm0(r_au, elong)
+        elif swm == 1:
+            geom = _geometry_swm1(r_au, elong, p)
+        else:
+            raise ValueError(f"SWM {swm} not supported (0|1)")
+        return {
+            "geometry_pc": jnp.asarray(geom),
+            "bfreq": jnp.asarray(bary_freq_mhz(toas, model)),
+        }
+
+    def dm_at(self, values, ctx):
+        return values["NE_SW"] * ctx["geometry_pc"]
+
+    def delay(self, values, batch, ctx, delay_accum):
+        return DM_CONST * self.dm_at(values, ctx) / ctx["bfreq"] ** 2
+
+
+class SolarWindDispersionX(DelayComponent):
+    """Piecewise solar wind: SWXDM_i is the conjunction-excess DM in
+    [SWXR1_i, SWXR2_i] with per-interval index SWXP_i (reference:
+    solar_wind_dispersion.py:525 ``swx_dm``)."""
+
+    register = True
+    category = "solar_windx"
+    trigger_params = ("SWXDM",)
+
+    def __init__(self, indices=()):
+        super().__init__()
+        self.indices = tuple(indices)
+        for i in self.indices:
+            self.add_param(Param(f"SWXDM_{i:04d}", units="pc cm^-3",
+                                 description=f"SW DM amplitude, range {i}"))
+            self.add_param(Param(f"SWXP_{i:04d}", units="", fittable=False,
+                                 description=f"SW power-law index {i}"))
+            self.add_param(Param(f"SWXR1_{i:04d}", kind="mjd",
+                                 fittable=False,
+                                 description=f"SWX range {i} start"))
+            self.add_param(Param(f"SWXR2_{i:04d}", kind="mjd",
+                                 fittable=False,
+                                 description=f"SWX range {i} end"))
+
+    @classmethod
+    def from_parfile(cls, pardict):
+        idx = sorted(
+            {
+                prefix_index(k)[1]
+                for k in pardict
+                if k.startswith("SWXDM_") and prefix_index(k)
+            }
+        )
+        return cls(indices=idx)
+
+    def defaults(self):
+        d = {f"SWXDM_{i:04d}": 0.0 for i in self.indices}
+        d.update({f"SWXP_{i:04d}": 2.0 for i in self.indices})
+        return d
+
+    def _conj_opp_elongation(self, toas, model):
+        """(min, max) Sun-pulsar elongation over a year, sampled from the
+        geocenter (reference uses ``pint.utils.get_conjunction``)."""
+        from pint_tpu.ephem import body_posvel_ssb
+        from pint_tpu.models.astrometry import psr_dir_static
+
+        n = psr_dir_static(model)
+        t0 = float(np.median(toas.ticks)) / 2**32
+        grid = np.linspace(t0 - 0.5 * 365.25 * 86400.0,
+                           t0 + 0.5 * 365.25 * 86400.0, 4001)
+        ticks = (grid * 2**32).astype(np.int64)
+        sun = body_posvel_ssb("sun", ticks, toas.ephem).pos
+        earth = body_posvel_ssb("earth", ticks, toas.ephem).pos
+        s = sun - earth
+        cos_e = np.clip(
+            (s @ n) / np.linalg.norm(s, axis=-1), -1.0, 1.0
+        )
+        e = np.arccos(cos_e)
+        return float(e.min()), float(e.max())
+
+    def prepare(self, toas, model):
+        from pint_tpu.models.astrometry import bary_freq_mhz
+
+        r_au, elong = _sun_geometry(toas, model)
+        e_conj, e_opp = self._conj_opp_elongation(toas, model)
+        t = toas.ticks.astype(np.float64) / 2**32
+        scaled = []
+        masks = []
+        for i in self.indices:
+            p = float(model.values.get(f"SWXP_{i:04d}", 2.0))
+            geom = (_geometry_swm1(r_au, elong, p)
+                    if p != 2.0 else _geometry_swm0(r_au, elong))
+            # normalization: conjunction/opposition geometry at r = 1 AU
+            if p != 2.0:
+                g_conj = _geometry_swm1(
+                    np.array([1.0]), np.array([e_conj]), p)[0]
+                g_opp = _geometry_swm1(
+                    np.array([1.0]), np.array([e_opp]), p)[0]
+            else:
+                g_conj = _geometry_swm0(
+                    np.array([1.0]), np.array([e_conj]))[0]
+                g_opp = _geometry_swm0(
+                    np.array([1.0]), np.array([e_opp]))[0]
+            scaled.append((geom - g_opp) / (g_conj - g_opp))
+            lo = model.values[f"SWXR1_{i:04d}"]
+            hi = model.values[f"SWXR2_{i:04d}"]
+            masks.append((t >= lo) & (t <= hi))
+        ns = len(self.indices)
+        return {
+            "scaled_geom": jnp.asarray(
+                np.stack(scaled, 0) if ns else np.zeros((0, len(toas)))
+            ),
+            "masks": jnp.asarray(
+                np.stack(masks, 0) if ns
+                else np.zeros((0, len(toas)), dtype=bool)
+            ),
+            "bfreq": jnp.asarray(bary_freq_mhz(toas, model)),
+        }
+
+    def dm_at(self, values, ctx):
+        if not self.indices:
+            return jnp.zeros(ctx["bfreq"].shape)
+        amps = jnp.stack(
+            [values[f"SWXDM_{i:04d}"] for i in self.indices]
+        )
+        return jnp.sum(
+            ctx["masks"] * ctx["scaled_geom"] * amps[:, None], axis=0
+        )
+
+    def delay(self, values, batch, ctx, delay_accum):
+        return DM_CONST * self.dm_at(values, ctx) / ctx["bfreq"] ** 2

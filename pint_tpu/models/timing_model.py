@@ -37,12 +37,17 @@ DEFAULT_ORDER = [
     "troposphere",
     "solar_system_shapiro",
     "solar_wind",
+    "solar_windx",
     "dispersion_constant",
     "dispersion_dmx",
     "dispersion_jump",
+    "fdjumpdm",
+    "dmwavex",
     "chromatic",
+    "cmwavex",
     "pulsar_system",
     "frequency_dependent",
+    "fdjump",
     "absolute_phase",
     "spindown",
     "phase_jump",

@@ -1,0 +1,186 @@
+"""Legacy Wave sinusoid series and IFunc tabulated phase offsets.
+
+Counterparts of the reference components (reference:
+src/pint/models/wave.py:10 ``wave_phase``, src/pint/models/ifunc.py:10
+``ifunc_phase``).  Both are phase components adding ``F0 * offset_sec``
+turns, where offset_sec is a sinusoid series (Wave) or an interpolation
+of tabulated (MJD, sec) points (IFunc).
+
+Par-file forms are *pair-valued* lines (``WAVE1 a b``, ``IFUNC1 mjd
+val [err]``), consumed via the component ``consume_parfile`` hook.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu import SECS_PER_DAY
+from pint_tpu.models.component import PhaseComponent
+from pint_tpu.models.parameter import Param, mjd_value_to_ticks, prefix_index
+
+
+class Wave(PhaseComponent):
+    """Sinusoid-series timing-noise decomposition:
+    phase = F0 * sum_k [a_k sin(k w tau) + b_k cos(k w tau)],
+    w = WAVE_OM rad/day, tau = t - WAVEEPOCH - delay in days."""
+
+    register = True
+    category = "wave"
+    trigger_params = ("WAVE_OM",)
+
+    def __init__(self, num_terms=0):
+        super().__init__()
+        self.num_terms = num_terms
+        self.add_param(Param("WAVE_OM", units="rad/d",
+                             description="Base frequency of wave solution"))
+        self.add_param(Param("WAVEEPOCH", kind="mjd", fittable=False,
+                             description="Reference epoch of wave solution"))
+        for k in range(1, num_terms + 1):
+            self.add_param(Param(f"WAVE{k}A", units="s",
+                                 description=f"Wave {k} sine amp"))
+            self.add_param(Param(f"WAVE{k}B", units="s",
+                                 description=f"Wave {k} cosine amp"))
+
+    @classmethod
+    def from_parfile(cls, pardict):
+        n = 0
+        for key in pardict:
+            pi = prefix_index(key)
+            if pi and pi[0] == "WAVE" and not key.startswith("WAVE_"):
+                n = max(n, pi[1])
+        return cls(num_terms=n)
+
+    def defaults(self):
+        d = {}
+        for k in range(1, self.num_terms + 1):
+            d[f"WAVE{k}A"] = 0.0
+            d[f"WAVE{k}B"] = 0.0
+        d["WAVEEPOCH"] = np.nan
+        return d
+
+    def consume_parfile(self, pardict, model):
+        consumed = set()
+        for k in range(1, self.num_terms + 1):
+            key = f"WAVE{k}"
+            if key in pardict and pardict[key][0]:
+                toks = pardict[key][0]
+                model.values[f"WAVE{k}A"] = float(toks[0].replace("D", "E"))
+                if len(toks) > 1:
+                    model.values[f"WAVE{k}B"] = float(
+                        toks[1].replace("D", "E")
+                    )
+                consumed.add(key)
+        return consumed
+
+    def parfile_lines(self, model):
+        lines = []
+        handled = set()
+        for k in range(1, self.num_terms + 1):
+            a = float(model.values.get(f"WAVE{k}A", 0.0))
+            b = float(model.values.get(f"WAVE{k}B", 0.0))
+            lines.append(f"WAVE{k}         {a!r} {b!r}")
+            handled |= {f"WAVE{k}A", f"WAVE{k}B"}
+        return lines, handled
+
+    def prepare(self, toas, model):
+        ep = model.values.get("WAVEEPOCH", np.nan)
+        if np.isnan(ep):
+            ep = model.values.get("PEPOCH", 0.0)
+        t = toas.ticks.astype(np.float64) / 2**32
+        return {"t_days": jnp.asarray((t - ep) / SECS_PER_DAY)}
+
+    def phase(self, values, batch, ctx, delay):
+        if not self.num_terms:
+            return jnp.zeros_like(ctx["t_days"])
+        tau = ctx["t_days"] - delay / SECS_PER_DAY
+        base = values["WAVE_OM"] * tau
+        sec = jnp.zeros_like(tau)
+        for k in range(1, self.num_terms + 1):
+            arg = k * base
+            sec = sec + values[f"WAVE{k}A"] * jnp.sin(arg)
+            sec = sec + values[f"WAVE{k}B"] * jnp.cos(arg)
+        return sec * values["F0"]
+
+
+class IFunc(PhaseComponent):
+    """Tabulated phase offsets: phase = F0 * interp(t) with SIFUNC type
+    0 (preceding-point/piecewise-constant) or 2 (linear); the reference's
+    type-0 tempo2 convention (ifunc.py:10-148).  Points are static data
+    (not fittable), matching the reference's pairParameters."""
+
+    register = True
+    category = "ifunc"
+    trigger_params = ("SIFUNC",)
+
+    def __init__(self, num_terms=0):
+        super().__init__()
+        self.num_terms = num_terms
+        self.add_param(Param("SIFUNC", units="", fittable=False,
+                             description="IFunc interpolation type (0|2)"))
+        #: (mjd_tdb_float, offset_sec) points, set by consume_parfile
+        self.points = np.zeros((0, 2))
+
+    @classmethod
+    def from_parfile(cls, pardict):
+        n = 0
+        for key in pardict:
+            pi = prefix_index(key)
+            if pi and pi[0] == "IFUNC":
+                n = max(n, pi[1])
+        return cls(num_terms=n)
+
+    def defaults(self):
+        return {"SIFUNC": 2.0}
+
+    def consume_parfile(self, pardict, model):
+        consumed = set()
+        pts = []
+        for k in range(1, self.num_terms + 1):
+            key = f"IFUNC{k}"
+            if key in pardict and len(pardict[key][0]) >= 2:
+                toks = pardict[key][0]
+                mjd_sec = mjd_value_to_ticks(toks[0]) / 2**32
+                pts.append((mjd_sec / SECS_PER_DAY + 51544.5,
+                            float(toks[1])))
+                consumed.add(key)
+        self.points = np.array(sorted(pts)) if pts else np.zeros((0, 2))
+        return consumed
+
+    def parfile_lines(self, model):
+        itype = int(round(model.values.get("SIFUNC", 2.0)))
+        lines = [f"SIFUNC          {itype} {self.points.shape[0]}"]
+        for k, (mjd, sec) in enumerate(self.points, start=1):
+            lines.append(
+                f"IFUNC{k}         {float(mjd)!r} {float(sec)!r} 0"
+            )
+        return lines, {"SIFUNC"}
+
+    def prepare(self, toas, model):
+        t = toas.ticks.astype(np.float64) / 2**32
+        return {
+            "t_mjd": jnp.asarray(t / SECS_PER_DAY + 51544.5),
+            "x": jnp.asarray(self.points[:, 0]),
+            "y": jnp.asarray(self.points[:, 1]),
+            # static: the interpolation type selects python control flow
+            "itype": int(round(model.values.get("SIFUNC", 2.0))),
+        }
+
+    def phase(self, values, batch, ctx, delay):
+        if self.points.shape[0] == 0:
+            return jnp.zeros_like(ctx["t_mjd"])
+        ts = ctx["t_mjd"] - delay / SECS_PER_DAY
+        itype = ctx["itype"]
+        x, y = ctx["x"], ctx["y"]
+        if itype == 0:
+            # nearest *preceding* tabulated point (tempo2 convention);
+            # TOAs before the first point take the first value
+            idx = jnp.clip(
+                jnp.searchsorted(x, ts, side="right") - 1, 0, x.shape[0] - 1
+            )
+            sec = y[idx]
+        elif itype == 2:
+            sec = jnp.interp(ts, x, y)
+        else:
+            raise ValueError(f"SIFUNC type {itype} not supported (0|2)")
+        return sec * values["F0"]

@@ -1,0 +1,168 @@
+"""Fourier-series delay/DM/chromatic variation: WaveX, DMWaveX, CMWaveX.
+
+Counterparts of the reference components (reference:
+src/pint/models/wavex.py:12 ``wavex_delay``, src/pint/models/dmwavex.py:14,
+src/pint/models/cmwavex.py:14): each holds sin/cos amplitude pairs at
+explicit frequencies (1/day) relative to an epoch,
+
+    q(t) = sum_k  S_k sin(2 pi f_k tau) + C_k cos(2 pi f_k tau),
+    tau  = t - EPOCH - accumulated_delay   [days]
+
+where q is an achromatic delay in seconds (WaveX), a DM in pc cm^-3
+(DMWaveX, delay = K q / nu^2), or a chromatic measure (CMWaveX, delay =
+K q / nu^TNCHROMIDX).  TPU design note: the k-sum is a single matmul-free
+``sum`` over a stacked (k, N) sinusoid tensor — XLA fuses the trig +
+reduction into one pass over HBM.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu import DM_CONST, SECS_PER_DAY
+from pint_tpu.models.component import DelayComponent
+from pint_tpu.models.parameter import Param, prefix_index
+
+
+class _FourierBase(DelayComponent):
+    """Shared machinery: indexed (FREQ, SIN, COS) triplets + epoch."""
+
+    register = False
+    #: prefix for the parameter family, e.g. "WX" -> WXFREQ_/WXSIN_/WXCOS_
+    px: str = ""
+    epoch_name: str = ""
+    amp_units: str = "s"
+
+    def __init__(self, indices=()):
+        super().__init__()
+        self.indices = tuple(indices)
+        self.add_param(Param(self.epoch_name, kind="mjd", fittable=False,
+                             description="Fourier series reference epoch"))
+        for i in self.indices:
+            self.add_param(Param(f"{self.px}FREQ_{i:04d}", units="1/d",
+                                 fittable=False,
+                                 description=f"Frequency of term {i}"))
+            self.add_param(Param(f"{self.px}SIN_{i:04d}",
+                                 units=self.amp_units,
+                                 description=f"Sine amplitude of term {i}"))
+            self.add_param(Param(f"{self.px}COS_{i:04d}",
+                                 units=self.amp_units,
+                                 description=f"Cosine amplitude {i}"))
+
+    @classmethod
+    def from_parfile(cls, pardict):
+        idx = sorted(
+            {
+                prefix_index(k)[1]
+                for k in pardict
+                if k.startswith(cls.px + "FREQ_") and prefix_index(k)
+            }
+        )
+        return cls(indices=idx)
+
+    def defaults(self):
+        d = {}
+        for i in self.indices:
+            d[f"{self.px}SIN_{i:04d}"] = 0.0
+            d[f"{self.px}COS_{i:04d}"] = 0.0
+        d[self.epoch_name] = np.nan
+        return d
+
+    def prepare(self, toas, model):
+        ep = model.values.get(self.epoch_name, np.nan)
+        if np.isnan(ep):
+            ep = model.values.get("PEPOCH", 0.0)
+        t = toas.ticks.astype(np.float64) / 2**32
+        return {"t_days": jnp.asarray((t - ep) / SECS_PER_DAY)}
+
+    def series(self, values, ctx, delay_accum):
+        """q(t) summed over terms; shape (N,)."""
+        if not self.indices:
+            return jnp.zeros_like(ctx["t_days"])
+        tau = ctx["t_days"] - delay_accum / SECS_PER_DAY
+        freqs = jnp.stack(
+            [values[f"{self.px}FREQ_{i:04d}"] for i in self.indices]
+        )
+        sins = jnp.stack(
+            [values[f"{self.px}SIN_{i:04d}"] for i in self.indices]
+        )
+        coss = jnp.stack(
+            [values[f"{self.px}COS_{i:04d}"] for i in self.indices]
+        )
+        arg = 2.0 * jnp.pi * freqs[:, None] * tau[None, :]
+        return jnp.sum(
+            sins[:, None] * jnp.sin(arg) + coss[:, None] * jnp.cos(arg),
+            axis=0,
+        )
+
+
+class WaveX(_FourierBase):
+    """Achromatic Fourier delay — the unbiased alternative to the legacy
+    Wave component (reference: wavex.py:12)."""
+
+    register = True
+    category = "wavex"
+    px = "WX"
+    epoch_name = "WXEPOCH"
+    amp_units = "s"
+    trigger_params = ("WXFREQ",)
+
+    def delay(self, values, batch, ctx, delay_accum):
+        return self.series(values, ctx, delay_accum)
+
+
+class DMWaveX(_FourierBase):
+    """Fourier DM(t) variation (reference: dmwavex.py:14); delay
+    = K DM(t) / nu^2 at the barycentric radio frequency."""
+
+    register = True
+    category = "dmwavex"
+    px = "DMWX"
+    epoch_name = "DMWXEPOCH"
+    amp_units = "pc cm^-3"
+    trigger_params = ("DMWXFREQ",)
+
+    def prepare(self, toas, model):
+        from pint_tpu.models.astrometry import bary_freq_mhz
+
+        ctx = super().prepare(toas, model)
+        ctx["bfreq"] = jnp.asarray(bary_freq_mhz(toas, model))
+        return ctx
+
+    def delay(self, values, batch, ctx, delay_accum):
+        dm = self.series(values, ctx, delay_accum)
+        return DM_CONST * dm / ctx["bfreq"] ** 2
+
+
+class CMWaveX(_FourierBase):
+    """Fourier chromatic-measure variation (reference: cmwavex.py:14);
+    delay = K CM(t) / nu^TNCHROMIDX."""
+
+    register = True
+    category = "cmwavex"
+    px = "CMWX"
+    epoch_name = "CMWXEPOCH"
+    amp_units = "pc cm^-3 MHz^(alpha-2)"
+    trigger_params = ("CMWXFREQ",)
+
+    def __init__(self, indices=()):
+        super().__init__(indices)
+        self.add_param(Param("TNCHROMIDX", units="", fittable=False,
+                             description="Chromatic index alpha"))
+
+    def defaults(self):
+        d = super().defaults()
+        d["TNCHROMIDX"] = 4.0
+        return d
+
+    def prepare(self, toas, model):
+        from pint_tpu.models.astrometry import bary_freq_mhz
+
+        ctx = super().prepare(toas, model)
+        ctx["bfreq"] = jnp.asarray(bary_freq_mhz(toas, model))
+        return ctx
+
+    def delay(self, values, batch, ctx, delay_accum):
+        cm = self.series(values, ctx, delay_accum)
+        return DM_CONST * cm * ctx["bfreq"] ** (-values["TNCHROMIDX"])

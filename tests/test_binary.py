@@ -215,7 +215,7 @@ def test_binary_derivatives_vs_finite_difference():
             if m.params[name].kind == "mjd":
                 h = 1e-3  # epochs are huge in seconds-since-J2000
             else:
-                h = max(abs(vec[i]) * 1e-7, 1e-10)
+                h = max(abs(vec[i]) * 1e-7, 1e-9)
             vp, vm = vec.copy(), vec.copy()
             vp[i] += h
             vm[i] -= h

@@ -44,6 +44,11 @@ class TestModelBuild:
             "DispersionDM",
             "AbsPhase",
             "Spindown",
+            # the par carries "SOLARN0 0.00" and "CORRECT_TROPOSPHERE N":
+            # like the reference, the components are instantiated (and
+            # evaluate to zero delay)
+            "SolarWindDispersion",
+            "TroposphereDelay",
         }
 
     def test_values_parsed(self, model):
