@@ -19,10 +19,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from pint_tpu.fitter import Fitter, GLSFitter, WLSFitter
+from pint_tpu.fitter import Fitter, GLSFitter, WLSFitter, WidebandTOAFitter
 from pint_tpu.linalg import gls_normal_solve
 
-__all__ = ["DownhillWLSFitter", "DownhillGLSFitter"]
+__all__ = ["DownhillWLSFitter", "DownhillGLSFitter",
+           "WidebandDownhillFitter"]
 
 
 class _DownhillMixin:
@@ -95,6 +96,7 @@ class _DownhillMixin:
             self.model.values[name] = float(vec[i])
             params[name].uncertainty = float(errs[i])
         self.covariance = np.asarray(cov)
+        self._update_fit_meta()
         self._post_fit()
         if fit_noise:
             self.fit_noise(maxiter=noise_maxiter)
@@ -167,4 +169,15 @@ class DownhillGLSFitter(_DownhillMixin, GLSFitter):
 
     def _propose(self, vec, base_values):
         new_vec, chi2, dpar, cov, _ = GLSFitter._step(self, vec, base_values)
+        return new_vec, chi2, dpar, cov
+
+
+class WidebandDownhillFitter(_DownhillMixin, WidebandTOAFitter):
+    """Step-halving wideband fitter (reference WidebandDownhillFitter,
+    fitter.py:1812)."""
+
+    def _propose(self, vec, base_values):
+        new_vec, chi2, dpar, cov, _ = WidebandTOAFitter._step(
+            self, vec, base_values
+        )
         return new_vec, chi2, dpar, cov

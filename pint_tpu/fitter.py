@@ -20,9 +20,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from pint_tpu.linalg import gls_normal_solve
-from pint_tpu.residuals import Residuals
+from pint_tpu.residuals import Residuals, WidebandTOAResiduals
 
-__all__ = ["WLSFitter", "GLSFitter", "Fitter", "wls_gn_solve"]
+__all__ = ["WLSFitter", "GLSFitter", "WidebandTOAFitter", "Fitter",
+           "wls_gn_solve"]
 
 
 def wls_gn_solve(resid_fn, vec, err, threshold=1e-14):
@@ -63,9 +64,24 @@ class Fitter:
 
     @staticmethod
     def auto(toas, model, downhill=True):
-        """Pick a fitter like the reference (fitter.py:252): GLS when the
-        model carries correlated noise, WLS otherwise; downhill variants
-        when requested."""
+        """Pick a fitter like the reference (fitter.py:252): wideband
+        when the TOAs carry -pp_dm data (and the model says DMDATA), GLS
+        when the model carries correlated noise, WLS otherwise; downhill
+        variants when requested."""
+        wideband = model.meta.get("DMDATA", "").split() and \
+            model.meta["DMDATA"].split()[0].upper() in ("1", "Y", "YES",
+                                                        "TRUE")
+        if wideband:
+            # DMDATA in the par is a request, not a guarantee — the TOAs
+            # must actually carry -pp_dm measurements (reference
+            # Fitter.auto checks toas.wideband)
+            wideband = toas.wideband_dm_data()[2].any()
+        if wideband:
+            if downhill:
+                from pint_tpu.downhill import WidebandDownhillFitter
+
+                return WidebandDownhillFitter(toas, model)
+            return WidebandTOAFitter(toas, model)
         if downhill:
             from pint_tpu.downhill import DownhillGLSFitter, DownhillWLSFitter
 
@@ -160,8 +176,18 @@ class Fitter:
             self.model.values[name] = float(vec[i])
             params[name].uncertainty = float(errs[i])
         self.covariance = np.asarray(cov)
+        self._update_fit_meta()
         self._post_fit()
         return float(self.resids.chi2)
+
+    def _update_fit_meta(self):
+        """Record the fit summary into the model metadata so it lands in
+        the output par file (reference: CHI2/TRES/NTOA params,
+        timing_model.py:344-386)."""
+        r = self.resids
+        self.model.meta["NTOA"] = str(len(self.toas))
+        self.model.meta["CHI2"] = f"{r.chi2:.6f}"
+        self.model.meta["TRES"] = f"{r.rms_weighted() * 1e6:.6f}"
 
     def _post_fit(self):
         """Hook for subclasses (e.g. noise realizations)."""
@@ -191,6 +217,52 @@ class WLSFitter(Fitter):
         resid_fn = self._resid_fn_of(base_values)
         sigma = self.resids.sigma_fn(self._merged(base_values, vec))
         return wls_gn_solve(resid_fn, vec, sigma, self.threshold)
+
+
+class WidebandTOAFitter(Fitter):
+    """Wideband fit: stacked [time; DM] residual vector with a block
+    design matrix, solved through the same noise-augmented normal
+    equations (reference: WidebandTOAFitter, fitter.py:2292-2640 via
+    combine_design_matrices_by_quantity).  The correlated-noise basis
+    acts on the time block; DM rows see DMEFAC/DMEQUAD-scaled white
+    noise."""
+
+    def __init__(self, toas, model, residuals=None):
+        if residuals is None:
+            residuals = WidebandTOAResiduals(toas, model)
+        super().__init__(toas, model, residuals=residuals)
+        self.noise_realizations = {}
+        self._retrace()
+
+    def _stacked_resid_fn(self, base_values):
+        free = self._traced_free
+        toa_r = self.resids.toa
+        dm_r = self.resids.dm
+
+        def resid_fn(v):
+            values = dict(base_values)
+            for i, name in enumerate(free):
+                values[name] = v[i]
+            return jnp.concatenate(
+                [toa_r.time_resids_fn(values), dm_r.dm_resids_fn(values)]
+            )
+
+        return resid_fn
+
+    def _step(self, vec, base_values):
+        values = self._merged(base_values, vec)
+        sigma_t = self.resids.toa.sigma_fn(values)
+        sigma_dm = self.resids.dm.sigma_fn(values)
+        sigma = jnp.concatenate([sigma_t, sigma_dm])
+        resid_fn = self._stacked_resid_fn(base_values)
+        r = resid_fn(vec)
+        J = jax.jacfwd(resid_fn)(vec)
+        U_t, phi = self.resids.toa._noise_basis_phi(values)
+        U = jnp.concatenate(
+            [U_t, jnp.zeros((sigma_dm.shape[0], U_t.shape[1]))], axis=0
+        )
+        dpar, cov, ncoef, chi2 = gls_normal_solve(r, J, sigma, U, phi)
+        return vec + dpar, chi2, dpar, cov, ncoef
 
 
 class GLSFitter(Fitter):

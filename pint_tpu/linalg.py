@@ -17,8 +17,10 @@ __all__ = ["woodbury_chi2_logdet", "gls_normal_solve"]
 
 #: floor on basis weights: a zero weight (e.g. ECORR 0) means infinite
 #: prior precision on that column — the coefficient is pinned to zero and
-#: the logdet contributions cancel, instead of 1/phi producing NaNs
-_PHI_FLOOR = 1e-40
+#: the logdet contributions cancel, instead of 1/phi producing NaNs.
+#: 1e-30 (not smaller): TPU's float32-pair f64 emulation loses precision
+#: below the f32 subnormal range (~1e-38), and 1/phi must stay finite
+_PHI_FLOOR = 1e-30
 
 
 def woodbury_chi2_logdet(r, sigma, U, phi):
@@ -70,10 +72,16 @@ def gls_normal_solve(r, J, sigma, U, phi):
     norm = jnp.sqrt(jnp.diag(mtcm))
     norm = jnp.where(norm == 0, 1.0, norm)
     mtcm_n = mtcm / jnp.outer(norm, norm)
-    cf = jax.scipy.linalg.cho_factor(mtcm_n, lower=True)
-    xhat = jax.scipy.linalg.cho_solve(cf, rhs / norm) / norm
-    inv_n = jax.scipy.linalg.cho_solve(cf, jnp.eye(mtcm.shape[0]))
-    cov_full = inv_n / jnp.outer(norm, norm)
+    # symmetric eigendecomposition with a pseudo-inverse cutoff instead
+    # of Cholesky: the reference falls back to SVD when cho_factor fails
+    # (fitter.py:2204); on TPU the f32-pair f64 emulation (~49-bit)
+    # makes near-degenerate normal matrices fail Cholesky outright, so
+    # the fallback is the main path here.  mtcm_n has unit diagonal, so
+    # eigenvalues are O(1)..O(P) and the cutoff is a clean relative one.
+    w, Q = jnp.linalg.eigh(mtcm_n)
+    w_inv = jnp.where(w > 1e-16 * jnp.max(w), 1.0 / w, 0.0)
+    xhat = (Q @ (w_inv * (Q.T @ (rhs / norm)))) / norm
+    cov_full = (Q * w_inv[None, :]) @ Q.T / jnp.outer(norm, norm)
     if U.shape[1]:
         chi2, _ = woodbury_chi2_logdet(r, sigma, U, phi)
     else:

@@ -151,7 +151,27 @@ def choose_components(pardict) -> List[type]:
     return chosen
 
 
-def get_model(parfile) -> TimingModel:
+def get_model(parfile, allow_tcb=False) -> TimingModel:
+    """Build a TimingModel from a par file (path or text).
+
+    ``allow_tcb=True`` converts a ``UNITS TCB`` par to TDB on the fly
+    (approximate — re-fit afterwards; reference: model_builder allow_tcb
+    + tcb_conversion.convert_tcb_tdb)."""
+    if allow_tcb:
+        if os.path.exists(str(parfile)) and "\n" not in str(parfile):
+            with open(parfile) as f:
+                text = f.read()
+        else:
+            text = str(parfile)
+        toks = parse_parfile(text).get("UNITS", [[""]])
+        if toks and toks[0] and toks[0][0].upper() == "TCB":
+            from pint_tpu.models.tcb import convert_parfile_tcb_tdb
+
+            warnings.warn(
+                "converting TCB par file to TDB; the conversion is "
+                "approximate — re-fit the resulting model"
+            )
+            parfile = convert_parfile_tcb_tdb(text)
     pardict_raw = parse_parfile(parfile)
     # canonicalize keys
     pardict: Dict[str, List[List[str]]] = {}
@@ -164,8 +184,8 @@ def get_model(parfile) -> TimingModel:
     units = (pardict.get("UNITS", [["TDB"]])[0] or ["TDB"])[0].upper()
     if units not in ("TDB", ""):
         raise NotImplementedError(
-            f"UNITS {units} not supported (only TDB; TCB conversion is a "
-            "planned milestone — use tempo2/PINT convert_parfile for now)"
+            f"UNITS {units} not supported directly; pass allow_tcb=True "
+            "to convert a TCB par file on the fly"
         )
     if "BINARY" in pardict:
         from pint_tpu.models.binary import get_binary_class
@@ -296,9 +316,17 @@ def get_model_and_toas(parfile, timfile, **kw):
 def model_to_parfile(model: TimingModel) -> str:
     """Round-trip a model to par format."""
     lines = []
-    for k in ("PSR", "EPHEM", "CLK", "UNITS", "TZRSITE"):
+    lead = ("PSR", "EPHEM", "CLK", "UNITS", "TZRSITE")
+    for k in lead:
         if k in model.meta:
             lines.append(f"{k:<15s} {model.meta[k]}")
+    # remaining metadata (START/FINISH/NTOA/CHI2/TRES/DMDATA/...,
+    # reference as_parfile includes the fit summary params,
+    # timing_model.py:344-386)
+    for k, v in model.meta.items():
+        if k in lead or k.startswith("__"):
+            continue
+        lines.append(f"{k:<15s} {v}")
     # components with non-par-shaped params (pair lines WAVEn a b,
     # IFUNCn mjd val) serialize themselves and mark params handled
     handled = set()

@@ -79,6 +79,9 @@ class DispersionDM(DelayComponent):
                 power = power * dt
         return dm
 
+    def dm_value(self, values, batch, ctx):
+        return self.dm_at(values, ctx)
+
     def delay(self, values, batch, ctx, delay_accum):
         dm = self.dm_at(values, ctx)
         return DM_CONST * dm / ctx["bfreq"] ** 2
@@ -135,18 +138,22 @@ class DispersionDMX(DelayComponent):
             "bfreq": jnp.asarray(bary_freq_mhz(toas, model)),
         }
 
-    def delay(self, values, batch, ctx, delay_accum):
+    def dm_value(self, values, batch, ctx):
         if not self.indices:
             return jnp.zeros_like(batch.freq_mhz)
         dmx = jnp.stack([values[f"DMX_{i:04d}"] for i in self.indices])
-        dm_per_toa = jnp.sum(ctx["masks"] * dmx[:, None], axis=0)
-        return DM_CONST * dm_per_toa / ctx["bfreq"] ** 2
+        return jnp.sum(ctx["masks"] * dmx[:, None], axis=0)
+
+    def delay(self, values, batch, ctx, delay_accum):
+        return DM_CONST * self.dm_value(values, batch, ctx) \
+            / ctx["bfreq"] ** 2
 
 
 class DispersionJump(DelayComponent):
-    """Constant DM offsets on TOA subsets (DMJUMP mask parameters);
-    conventionally fit only in wideband mode (reference:
-    dispersion_model.py:724)."""
+    """Constant offsets to the *measured DM values* on TOA subsets
+    (DMJUMP mask parameters).  Affects only the wideband DM residuals,
+    NOT the time delay (reference: dispersion_model.py:724-735 "will not
+    apply to the dispersion time delay")."""
 
     category = "dispersion_jump"
     trigger_params = ("DMJUMP",)
@@ -184,11 +191,16 @@ class DispersionJump(DelayComponent):
         }
 
     def delay(self, values, batch, ctx, delay_accum):
+        # DMJUMP models the DM *measurement*, not the dispersion delay
+        # (reference d_delay_d_dmjump is identically zero)
+        return jnp.zeros_like(batch.freq_mhz)
+
+    def dm_value(self, values, batch, ctx):
         if not self.selects:
             return jnp.zeros_like(batch.freq_mhz)
         dj = jnp.stack(
             [values[f"DMJUMP{i}"] for i in range(1, len(self.selects) + 1)]
         )
-        dm = jnp.sum(ctx["masks"] * dj[:, None], axis=0)
-        # sign: DMJUMP measures *apparent* DM offset, subtracted
-        return -DM_CONST * dm / ctx["bfreq"] ** 2
+        # sign: DMJUMP is subtracted from the modeled DM (reference
+        # jump_dm adds -value)
+        return -jnp.sum(ctx["masks"] * dj[:, None], axis=0)

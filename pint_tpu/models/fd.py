@@ -170,7 +170,7 @@ class FDJumpDM(DelayComponent):
             "bfreq": jnp.asarray(bary_freq_mhz(toas, model)),
         }
 
-    def delay(self, values, batch, ctx, delay_accum):
+    def dm_value(self, values, batch, ctx):
         if not self.selects:
             return jnp.zeros_like(batch.freq_mhz)
         dj = jnp.stack(
@@ -179,5 +179,11 @@ class FDJumpDM(DelayComponent):
                 for i in range(1, len(self.selects) + 1)
             ]
         )
-        dm = jnp.sum(ctx["masks"] * dj[:, None], axis=0)
-        return -DM_CONST * dm / ctx["bfreq"] ** 2
+        # reference fdjump_dm adds -value
+        return -jnp.sum(ctx["masks"] * dj[:, None], axis=0)
+
+    def delay(self, values, batch, ctx, delay_accum):
+        # unlike DMJUMP, FDJUMPDM does disperse the arrival times
+        # (reference fdjump_dm_delay -> dispersion_type_delay)
+        return DM_CONST * self.dm_value(values, batch, ctx) \
+            / ctx["bfreq"] ** 2

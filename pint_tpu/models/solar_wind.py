@@ -45,7 +45,10 @@ def _geometry_swm1(r_au: np.ndarray, elong: np.ndarray,
         raise ValueError("solar-wind power-law index must be > 1")
     b = r_au * np.sin(elong)  # impact parameter [AU]
     z_sun = r_au * np.cos(elong)  # distance to closest approach [AU]
-    z_p = 1e14 * 299792458.0 / (AU_LS * 299792458.0)  # "infinity" in AU
+    # upper integration limit ~ "infinity": 1e14 light-seconds in AU
+    # (the reference uses (1e14 s * c); the integral has converged many
+    # orders of magnitude before this for any p > 1)
+    z_p = 1e14 / AU_LS
 
     def dm_p_int(z):
         return (z / b) * hyp2f1(0.5, p / 2.0, 1.5, -(z**2) / b**2)
@@ -106,6 +109,9 @@ class SolarWindDispersion(DelayComponent):
 
     def dm_at(self, values, ctx):
         return values["NE_SW"] * ctx["geometry_pc"]
+
+    def dm_value(self, values, batch, ctx):
+        return self.dm_at(values, ctx)
 
     def delay(self, values, batch, ctx, delay_accum):
         return DM_CONST * self.dm_at(values, ctx) / ctx["bfreq"] ** 2
@@ -219,6 +225,9 @@ class SolarWindDispersionX(DelayComponent):
         return jnp.sum(
             ctx["masks"] * ctx["scaled_geom"] * amps[:, None], axis=0
         )
+
+    def dm_value(self, values, batch, ctx):
+        return self.dm_at(values, ctx)
 
     def delay(self, values, batch, ctx, delay_accum):
         return DM_CONST * self.dm_at(values, ctx) / ctx["bfreq"] ** 2

@@ -1,0 +1,162 @@
+"""TCB <-> TDB conversion of par files.
+
+Counterpart of the reference tcb_conversion module (reference:
+src/pint/models/tcb_conversion.py:29 ``scale_parameter``, :70
+``transform_mjd_parameter``, :98 ``convert_tcb_tdb``; constants from
+Irwin & Fukushima 1999, the same as tempo2's transform plugin):
+
+    x_tdb = x_tcb * K**(-d)            d = effective dimensionality
+    t_tdb = (t_tcb - MJD0) / K + MJD0  for epochs
+    K     = 1 + 1.55051979176e-8
+
+Unlike the reference (which converts a built TimingModel), conversion
+here happens at the par-text level before model construction — the
+functional core only ever sees TDB quantities, so there is no
+allow_tcb half-state to thread through components.  The conversion is
+approximate (same caveat as the reference: re-fit afterwards).
+"""
+
+from __future__ import annotations
+
+import re
+from decimal import Decimal, getcontext
+from typing import Optional
+
+__all__ = ["IFTE_K", "IFTE_MJD0", "convert_parfile_tcb_tdb"]
+
+getcontext().prec = 40
+
+IFTE_MJD0 = Decimal("43144.0003725")
+IFTE_KM1 = Decimal("1.55051979176e-8")
+IFTE_K = 1 + IFTE_KM1
+
+#: effective dimensionality d of each parameter: x_tdb = x_tcb * K^-d
+#: (reference: each Parameter's effective_dimensionality; examples in
+#: tcb_conversion.py:33-45 — F0: 1, F1: 2, A1: -1, DM: 1, PBDOT: 0).
+#: Indexed families use a callable of the index.
+_DIMS = {
+    # spindown
+    "F": lambda k: k + 1,
+    # astrometry: angles 0, proper motions 1/time, parallax 1/distance
+    "RAJ": 0, "DECJ": 0, "ELONG": 0, "ELAT": 0,
+    "PMRA": 1, "PMDEC": 1, "PMELONG": 1, "PMELAT": 1, "PX": 1,
+    # dispersion / chromatic
+    "DM": lambda k: k + 1,
+    "DMX": 1, "DMX_": 1, "DMJUMP": 1, "FDJUMPDM": 1,
+    "NE_SW": 1, "SWXDM_": 1,
+    "CM": lambda k: k + 1,
+    # binaries: times -1, dimensionless 0, rates +... PBDOT/XDOT are
+    # dimensionless; OMDOT deg/yr is 1; masses (time units via Tsun) -1
+    "PB": -1, "A1": -1, "T0": "mjd", "TASC": "mjd",
+    "ECC": 0, "OM": 0, "OMDOT": 1, "PBDOT": 0, "XDOT": 0, "EDOT": 1,
+    "EPS1": 0, "EPS2": 0, "EPS1DOT": 1, "EPS2DOT": 1,
+    "M2": -1, "MTOT": -1, "SINI": 0, "SHAPMAX": 0,
+    "H3": -1, "H4": -1, "STIGMA": 0, "KIN": 0, "KOM": 0,
+    "GAMMA": -1, "DR": 0, "DTH": 0, "A0": -1, "B0": -1,
+    "FB": lambda k: k + 1,
+    # glitches
+    "GLF0_": 1, "GLF1_": 2, "GLF2_": 3, "GLF0D_": 1, "GLTD_": -1,
+    "GLPH_": 0,
+    # jumps & misc (seconds)
+    "JUMP": -1, "WAVE_OM": 1,
+}
+
+#: parameters that are epochs (MJD transform); kind detection also
+#: catches *_EPOCH-style names
+_MJD_PARAMS = {
+    "PEPOCH", "POSEPOCH", "DMEPOCH", "CMEPOCH", "T0", "TASC", "TZRMJD",
+    "WAVEEPOCH", "START", "FINISH", "WXEPOCH", "DMWXEPOCH", "CMWXEPOCH",
+    "SWXR1_", "SWXR2_", "DMXR1_", "DMXR2_", "GLEP_", "PWEP_", "PWSTART_",
+    "PWSTOP_",
+}
+
+_NUM_RE = re.compile(r"^[+-]?(\d+\.?\d*|\.\d+)([eEdD][+-]?\d+)?$")
+
+
+def _dim_of(key: str) -> Optional[object]:
+    from pint_tpu.models.parameter import prefix_index
+
+    if key in _DIMS:
+        d = _DIMS[key]
+        return d(0) if callable(d) else d
+    pi = prefix_index(key)
+    if pi and pi[0] in _DIMS:
+        d = _DIMS[pi[0]]
+        return d(pi[1]) if callable(d) else d
+    return None
+
+
+def _is_mjd(key: str) -> bool:
+    if key in _MJD_PARAMS:
+        return True
+    m = re.match(r"^([A-Z0-9]+_)\d+$", key)
+    return bool(m and m.group(1) in _MJD_PARAMS)
+
+
+def _scale_str(tok: str, factor: Decimal) -> str:
+    v = Decimal(tok.upper().replace("D", "E"))
+    out = v * factor
+    return f"{out:.20E}"
+
+
+def _mjd_str(tok: str, backwards: bool) -> str:
+    t = Decimal(tok.upper().replace("D", "E"))
+    if backwards:
+        out = (t - IFTE_MJD0) * IFTE_K + IFTE_MJD0
+    else:
+        out = (t - IFTE_MJD0) / IFTE_K + IFTE_MJD0
+    return f"{out:.25f}".rstrip("0").rstrip(".")
+
+
+def convert_parfile_tcb_tdb(text: str, backwards: bool = False) -> str:
+    """Convert par-file text between TCB and TDB units.
+
+    Mirrors the reference's parameter coverage (tcb_conversion.py:105:
+    TZRMJD/TZRFRQ, EQUADs/ECORRs, red-noise amplitudes, Wave/IFunc pairs
+    and FD parameters are NOT converted — same as the reference — except
+    TZRMJD which we do transform since it is a plain epoch).
+    """
+    out_lines = []
+    units_seen = False
+    for raw in text.splitlines():
+        stripped = raw.split("#")[0].rstrip()
+        if not stripped.strip():
+            out_lines.append(raw)
+            continue
+        toks = stripped.split()
+        key = toks[0].upper()
+        if key == "UNITS":
+            out_lines.append(f"UNITS {'TCB' if backwards else 'TDB'}")
+            units_seen = True
+            continue
+        d = _dim_of(key)
+        try:
+            if _is_mjd(key) and len(toks) > 1 and _NUM_RE.match(toks[1]):
+                toks[1] = _mjd_str(toks[1], backwards)
+                out_lines.append(" ".join(toks))
+                continue
+            if d not in (None, "mjd") and d != 0:
+                p = 1 if backwards else -1
+                factor = IFTE_K ** (p * int(d))
+                # mask params: value sits after the selector tokens
+                vi = 1
+                if key in ("JUMP", "DMJUMP", "FDJUMPDM"):
+                    if toks[1].startswith("-"):
+                        vi = 3
+                    elif toks[1].upper() in ("MJD", "FREQ"):
+                        vi = 4
+                    elif toks[1].upper() in ("TEL", "T"):
+                        vi = 3
+                if len(toks) > vi and _NUM_RE.match(toks[vi]):
+                    toks[vi] = _scale_str(toks[vi], factor)
+                    # uncertainty column scales identically
+                    if len(toks) > vi + 2 and _NUM_RE.match(toks[vi + 2]):
+                        toks[vi + 2] = _scale_str(toks[vi + 2], factor)
+                out_lines.append(" ".join(toks))
+                continue
+        except Exception:
+            pass
+        out_lines.append(raw)
+    if not units_seen:
+        out_lines.append(f"UNITS {'TCB' if backwards else 'TDB'}")
+    return "\n".join(out_lines) + "\n"

@@ -179,6 +179,57 @@ class TimingModel:
 
         return model_to_parfile(self)
 
+    def compare(self, other, threshold_sigma=3.0, verbosity="max"):
+        """Human-readable parameter comparison with another model
+        (reference: TimingModel.compare, timing_model.py:2177 — the
+        five-column ``PARAMETER | Model1 | Model2 | Diff_Sigma1 |
+        Diff_Sigma2`` table; '!' marks > threshold_sigma changes, '*'
+        marks grown uncertainties).
+
+        verbosity: 'max' all params | 'med' fit params | 'min' only
+        significant changes."""
+        rows = [f"{'PARAMETER':<14s} {'Self':>24s} {'Other':>24s} "
+                f"{'Diff_Sigma1':>12s} {'Diff_Sigma2':>12s}"]
+        names = list(self.params)
+        for name in names:
+            p1 = self.params[name]
+            v1 = self.values.get(name, np.nan)
+            in2 = name in other.params
+            v2 = other.values.get(name, np.nan) if in2 else np.nan
+            u1 = p1.uncertainty
+            u2 = other.params[name].uncertainty if in2 else None
+            if isinstance(v1, float) and np.isnan(v1) and (
+                not in2 or (isinstance(v2, float) and np.isnan(v2))
+            ):
+                continue
+            diff = (v1 - v2) if in2 else np.nan
+            s1 = abs(diff) / u1 if u1 else np.nan
+            s2 = abs(diff) / u2 if u2 else np.nan
+            flag = ""
+            if (np.isfinite(s1) and s1 > threshold_sigma) or (
+                np.isfinite(s2) and s2 > threshold_sigma
+            ):
+                flag += " !"
+            if u1 and u2 and u2 > 1.05 * u1:
+                flag += " *"
+            if verbosity == "min" and not flag:
+                continue
+            if verbosity == "med" and p1.frozen and not flag:
+                continue
+            fmt = lambda v, p: (p.format(v) if not (
+                isinstance(v, float) and np.isnan(v)) else "--")
+            rows.append(
+                f"{name:<14s} {fmt(v1, p1):>24s} {fmt(v2, p1):>24s} "
+                f"{s1 if np.isfinite(s1) else float('nan'):>12.3g} "
+                f"{s2 if np.isfinite(s2) else float('nan'):>12.3g}{flag}"
+            )
+        only_other = [n for n in other.params if n not in self.params
+                      and not (isinstance(other.values.get(n), float)
+                               and np.isnan(other.values.get(n, np.nan)))]
+        if only_other:
+            rows.append(f"# only in other model: {' '.join(only_other)}")
+        return "\n".join(rows)
+
 
 class PreparedModel:
     """Model bound to a dataset: static ctx captured, pure fns jitted.
@@ -256,6 +307,27 @@ class PreparedModel:
             out[type(c).__name__] = (start, nb)
             start += nb
         return out
+
+    # -- wideband DM interface ------------------------------------------------
+    def total_dm_fn(self, values):
+        """Modeled DM [pc cm^-3] at each TOA: the sum of every
+        component's ``dm_value`` contribution (reference:
+        TimingModel.total_dm via dm_value_funcs)."""
+        dm = jnp.zeros(self.batch.ticks.shape, dtype=jnp.float64)
+        for c in self.model.components:
+            f = getattr(c, "dm_value", None)
+            if f is not None:
+                dm = dm + f(values, self.batch, self.ctx[type(c).__name__])
+        return dm
+
+    def scaled_dm_sigma_fn(self, values, dm_sigma):
+        """Wideband DM uncertainties after DMEFAC/DMEQUAD scaling
+        (reference: scaled_dm_uncertainty)."""
+        for c in self.model.noise_components:
+            f = getattr(c, "scaled_dm_sigma", None)
+            if f is not None:
+                dm_sigma = f(values, self.ctx[type(c).__name__], dm_sigma)
+        return dm_sigma
 
     # pure function of values (pytree dict of f64 scalars)
     def _delay_raw(self, values, batch, ctx_map):

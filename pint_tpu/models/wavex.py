@@ -130,6 +130,9 @@ class DMWaveX(_FourierBase):
         ctx["bfreq"] = jnp.asarray(bary_freq_mhz(toas, model))
         return ctx
 
+    def dm_value(self, values, batch, ctx):
+        return self.series(values, ctx, 0.0)
+
     def delay(self, values, batch, ctx, delay_accum):
         dm = self.series(values, ctx, delay_accum)
         return DM_CONST * dm / ctx["bfreq"] ** 2

@@ -24,11 +24,15 @@ import numpy as np
 from pint_tpu.linalg import woodbury_chi2_logdet
 from pint_tpu.models.timing_model import PreparedModel, TimingModel
 
-__all__ = ["Residuals"]
+__all__ = ["Residuals", "WidebandDMResiduals", "WidebandTOAResiduals"]
 
 #: weight given to the synthetic constant-offset basis column when the
-#: mean is subtracted (reference residuals.py:583-585)
-MEAN_OFFSET_WEIGHT = 1e40
+#: mean is subtracted (reference residuals.py:583-585 uses 1e40; we use
+#: 1e30 because TPU emulates f64 as a float32 pair whose high word
+#: saturates at ~3.4e38 — 1e40 silently becomes inf on device and NaNs
+#: the Cholesky.  1e30 s^2 of prior variance is equally "infinite" for
+#: any real dataset)
+MEAN_OFFSET_WEIGHT = 1e30
 
 
 def weighted_mean_phase(frac, weights):
@@ -151,3 +155,119 @@ class Residuals:
         r = self.time_resids
         w = 1.0 / self.scaled_errors**2
         return float(np.sqrt(np.sum(r**2 * w) / np.sum(w)))
+
+
+class WidebandDMResiduals:
+    """Wideband DM residuals: measured DM (``-pp_dm`` flags) minus the
+    model's total DM (reference: WidebandDMResiduals,
+    residuals.py:908-1077).  No mean subtraction by default (DM is an
+    absolute measurement, reference :33)."""
+
+    def __init__(self, toas, model, subtract_mean=False):
+        self.toas = toas
+        if isinstance(model, TimingModel):
+            self.prepared = model.prepare(toas)
+        else:
+            self.prepared = model
+        self.model = self.prepared.model
+        dm, dme, valid = toas.wideband_dm_data()
+        if not valid.any():
+            raise ValueError(
+                "no wideband DM data: TOAs lack -pp_dm flags"
+            )
+        self.valid = valid
+        self.valid_idx = jnp.asarray(np.flatnonzero(valid))
+        self.dm_data = jnp.asarray(np.where(valid, dm, 0.0))
+        self.dm_error = jnp.asarray(np.where(valid, dme, 1.0))
+        self.subtract_mean = subtract_mean
+        self._resids_jit = jax.jit(self.dm_resids_fn)
+        self._chi2_jit = jax.jit(self.chi2_fn)
+
+    # -- pure functions ------------------------------------------------------
+    def sigma_fn(self, values):
+        """DMEFAC/DMEQUAD-scaled DM uncertainties, valid TOAs only."""
+        sig = self.prepared.scaled_dm_sigma_fn(values, self.dm_error)
+        return sig[self.valid_idx]
+
+    def dm_resids_fn(self, values):
+        model_dm = self.prepared.total_dm_fn(values)
+        r = (self.dm_data - model_dm)[self.valid_idx]
+        if self.subtract_mean:
+            sig = self.sigma_fn(values)
+            w = 1.0 / sig**2
+            r = r - jnp.sum(r * w) / jnp.sum(w)
+        return r
+
+    def chi2_fn(self, values):
+        r = self.dm_resids_fn(values)
+        return jnp.sum((r / self.sigma_fn(values)) ** 2)
+
+    # -- numpy accessors -----------------------------------------------------
+    def _values(self, values=None):
+        return self.prepared._values_pytree(values)
+
+    @property
+    def dm_resids(self):
+        return np.asarray(self._resids_jit(self._values()))
+
+    @property
+    def chi2(self):
+        return float(self._chi2_jit(self._values()))
+
+    @property
+    def scaled_errors(self):
+        return np.asarray(self.sigma_fn(self._values()))
+
+    @property
+    def dof(self):
+        return int(np.count_nonzero(self.valid))
+
+    def rms_weighted(self):
+        r = self.dm_resids
+        w = 1.0 / self.scaled_errors**2
+        return float(np.sqrt(np.sum(r**2 * w) / np.sum(w)))
+
+
+class WidebandTOAResiduals:
+    """Stacked TOA + DM residuals sharing one PreparedModel (reference:
+    WidebandTOAResiduals / CombinedResiduals, residuals.py:1079-1272).
+    chi^2 is the sum of the two blocks; dof counts both data vectors."""
+
+    def __init__(self, toas, model, subtract_mean=None,
+                 track_mode="nearest"):
+        if isinstance(model, TimingModel):
+            prepared = model.prepare(toas)
+        else:
+            prepared = model
+        self.toas = toas
+        self.prepared = prepared
+        self.model = prepared.model
+        self.toa = Residuals(toas, prepared, subtract_mean=subtract_mean,
+                             track_mode=track_mode)
+        self.dm = WidebandDMResiduals(toas, prepared)
+        self._chi2_jit = jax.jit(self.chi2_fn)
+
+    def chi2_fn(self, values):
+        return self.toa.chi2_fn(values) + self.dm.chi2_fn(values)
+
+    def _values(self, values=None):
+        return self.prepared._values_pytree(values)
+
+    @property
+    def chi2(self):
+        return float(self._chi2_jit(self._values()))
+
+    @property
+    def dof(self):
+        return (
+            len(self.toas) + self.dm.dof
+            - len(self.model.free_params) - int(self.toa.subtract_mean)
+        )
+
+    @property
+    def reduced_chi2(self):
+        return self.chi2 / self.dof
+
+    def rms_weighted(self):
+        """Weighted RMS of the *time* block [s] (for fit summaries)."""
+        return self.toa.rms_weighted()

@@ -39,12 +39,18 @@ def make_fake_toas_uniform(
     add_noise=False,
     rng=None,
     wideband=False,
+    dm_error=1e-4,
     flags=None,
 ):
     """Evenly-spaced TOAs with zero residuals under ``model``
     (+ optional white noise scaled by the TOA errors).  ``flags`` is an
     optional per-TOA flag dict applied to every TOA (so mask parameters
-    like EFAC ``-f`` selectors have something to select on)."""
+    like EFAC ``-f`` selectors have something to select on).
+
+    ``wideband=True`` attaches ``-pp_dm``/``-pp_dme`` flags carrying the
+    model's total DM (+ noise when add_noise) with uncertainty
+    ``dm_error`` [pc cm^-3] (reference: update_fake_dms,
+    simulation.py:183)."""
     mjds = np.linspace(float(start_mjd), float(end_mjd), int(ntoas))
     freqs = np.broadcast_to(np.asarray(freq_mhz, dtype=np.float64), (ntoas,))
     flags = dict(flags or {})
@@ -66,4 +72,15 @@ def make_fake_toas_uniform(
         noise = rng.standard_normal(int(ntoas)) * error_us * 1e-6
         toas.ticks = toas.ticks + np.round(noise * 2**32).astype(np.int64)
         toas._compute_posvels()
+    if wideband:
+        prepared = model.prepare(toas)
+        dm = np.asarray(
+            prepared.total_dm_fn(prepared._values_pytree())
+        )
+        if add_noise:
+            rng = rng or np.random.default_rng(0)
+            dm = dm + rng.standard_normal(int(ntoas)) * dm_error
+        for i, f in enumerate(toas.flags):
+            f["pp_dm"] = repr(float(dm[i]))
+            f["pp_dme"] = repr(float(dm_error))
     return toas

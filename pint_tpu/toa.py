@@ -361,6 +361,28 @@ class TOAs:
     def get_flag_values(self, flag, default=None, astype=str):
         return [astype(f[flag]) if flag in f else default for f in self.flags]
 
+    def wideband_dm_data(self):
+        """Measured wideband DM data from ``-pp_dm``/``-pp_dme`` flags
+        (reference: WidebandDMResiduals.get_dm_data, residuals.py:128).
+
+        Returns (dm [pc cm^-3], dm_error, valid_mask), full TOA length
+        with NaN where the flags are absent."""
+        dm = np.array(
+            self.get_flag_values("pp_dm", default=np.nan, astype=float)
+        )
+        dme = np.array(
+            self.get_flag_values("pp_dme", default=np.nan, astype=float)
+        )
+        valid = np.isfinite(dm)
+        if np.any(valid & ~np.isfinite(dme)):
+            bad = np.flatnonzero(valid & ~np.isfinite(dme))
+            raise ValueError(
+                f"{len(bad)} TOAs carry -pp_dm but no finite -pp_dme "
+                f"uncertainty (first at index {bad[0]}); a NaN sigma "
+                "would silently poison the wideband fit"
+            )
+        return dm, dme, valid
+
     def to_batch(self) -> "TOABatch":
         planets = (
             np.stack(

@@ -1,0 +1,123 @@
+"""Par-file writing, model comparison, TCB<->TDB conversion.
+
+Oracles: round-trip identity (write then re-read gives the same model),
+the Irwin & Fukushima 1999 constants against hand-computed scalings
+(reference: tcb_conversion.py), and TCB->TDB->TCB inversion.
+"""
+
+import numpy as np
+import pytest
+
+from pint_tpu.models import get_model
+from pint_tpu.models.tcb import (
+    IFTE_K,
+    convert_parfile_tcb_tdb,
+)
+
+TDB_PAR = """
+PSR FAKE
+RAJ 05:00:00 1
+DECJ 20:00:00 1
+F0 100.0 1 1e-10
+F1 -1e-15 1
+PEPOCH 55000
+DM 10.0 1
+BINARY ELL1
+PB 5.741 1
+A1 3.3667 1
+TASC 54900.1
+EPS1 1.2e-5
+EPS2 -3.4e-6
+TZRMJD 55000
+TZRFRQ 1400
+TZRSITE gbt
+"""
+
+TCB_PAR = TDB_PAR + "UNITS TCB\n"
+
+
+class TestTcbConversion:
+    def test_f0_scaling(self):
+        out = convert_parfile_tcb_tdb(TCB_PAR)
+        m = get_model(out)
+        k = float(IFTE_K)
+        assert m.values["F0"] == pytest.approx(100.0 / k, rel=1e-14)
+        # F1 scales by K^-2
+        assert m.values["F1"] == pytest.approx(-1e-15 / k**2, rel=1e-12)
+        # times scale UP by K
+        assert m.values["PB"] == pytest.approx(
+            5.741 * k * 86400.0, rel=1e-14
+        )
+        assert m.values["A1"] == pytest.approx(3.3667 * k, rel=1e-14)
+        # dimensionless untouched
+        assert m.values["EPS1"] == 1.2e-5
+
+    def test_uncertainty_scales(self):
+        out = convert_parfile_tcb_tdb(TCB_PAR)
+        m = get_model(out)
+        assert m.params["F0"].uncertainty == pytest.approx(
+            1e-10 / float(IFTE_K), rel=1e-12
+        )
+
+    def test_epoch_transform(self):
+        out = convert_parfile_tcb_tdb(TCB_PAR)
+        m = get_model(out)
+        # t_tdb = (t - MJD0)/K + MJD0; shift at MJD 55000 is ~ -15.9 ms
+        t_tdb_days = m.values["PEPOCH"] / 86400.0 + 51544.5
+        shift_days = (55000.0 - 43144.0003725) * (1 - 1 / float(IFTE_K))
+        assert t_tdb_days == pytest.approx(55000.0 - shift_days, abs=1e-12)
+
+    def test_roundtrip(self):
+        tdb = convert_parfile_tcb_tdb(TCB_PAR)
+        tcb_again = convert_parfile_tcb_tdb(tdb, backwards=True)
+        m0 = get_model(TCB_PAR.replace("UNITS TCB", "UNITS TDB"))
+        m1 = get_model(tcb_again.replace("UNITS TCB", "UNITS TDB"))
+        for k in ("F0", "F1", "PB", "A1", "DM", "PEPOCH"):
+            assert m0.values[k] == pytest.approx(m1.values[k], rel=1e-13)
+
+    def test_get_model_allow_tcb(self):
+        with pytest.raises(NotImplementedError):
+            get_model(TCB_PAR)
+        with pytest.warns(UserWarning, match="approximate"):
+            m = get_model(TCB_PAR, allow_tcb=True)
+        assert m.values["F0"] == pytest.approx(
+            100.0 / float(IFTE_K), rel=1e-14
+        )
+
+
+class TestCompare:
+    def test_compare_flags_changes(self):
+        m1 = get_model(TDB_PAR)
+        m2 = get_model(TDB_PAR)
+        m2.values["F0"] += 1e-8  # 100 sigma given 1e-10 uncertainty
+        out = m1.compare(m2)
+        f0_line = [ln for ln in out.splitlines() if ln.startswith("F0")][0]
+        assert "!" in f0_line
+        out_min = m1.compare(m2, verbosity="min")
+        assert "F0" in out_min
+        assert "EPS1" not in out_min
+
+
+class TestParWriting:
+    def test_roundtrip_preserves_values(self):
+        m = get_model(TDB_PAR)
+        m2 = get_model(m.as_parfile())
+        for k, v in m.values.items():
+            v2 = m2.values.get(k, np.nan)
+            if isinstance(v, float) and np.isnan(v):
+                continue
+            assert v2 == pytest.approx(v, rel=1e-12, abs=1e-300), k
+
+    def test_fit_metadata_written(self):
+        from pint_tpu.fitter import WLSFitter
+        from pint_tpu.simulation import make_fake_toas_uniform
+
+        m = get_model(TDB_PAR)
+        toas = make_fake_toas_uniform(
+            54500, 55500, 60, m, freq_mhz=np.full(60, 1400.0), obs="gbt",
+            error_us=1.0, add_noise=True,
+        )
+        f = WLSFitter(toas, m)
+        f.fit_toas()
+        par = m.as_parfile()
+        assert "NTOA" in par and "CHI2" in par and "TRES" in par
