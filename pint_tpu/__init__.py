@@ -19,11 +19,19 @@ time-scale transforms, solar-system ephemerides) is self-contained: unlike
 the reference, this package does not depend on astropy / erfa / jplephem.
 """
 
+import os
+
 import jax
 
 # Double-double arithmetic and microsecond-level time handling require real
 # float64 semantics everywhere; enable before any tracing happens.
 jax.config.update("jax_enable_x64", True)
+
+# Honor JAX_PLATFORMS even when a site plugin (e.g. a preregistered TPU
+# backend) would otherwise win platform selection — the env var alone is
+# not enough once the plugin is registered, the config must be set too.
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 __version__ = "0.1.0"
 

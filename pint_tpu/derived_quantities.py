@@ -1,0 +1,194 @@
+"""Derived pulsar quantities: P/F conversions, age, B-field, masses, PK
+parameters.
+
+Counterpart of the reference derived_quantities module (reference:
+src/pint/derived_quantities.py — same formulas, same names), in plain
+float64 with explicit units in the names instead of astropy Quantities:
+frequencies in Hz, periods/times in seconds, masses in solar masses,
+angles in radians unless suffixed otherwise.  All functions accept
+numpy arrays (and jax arrays — nothing here branches on values).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pint_tpu import SECS_PER_DAY, T_SUN_S
+
+__all__ = [
+    "p_to_f", "f_to_p", "pferrs", "pulsar_age_yr", "pulsar_edot",
+    "pulsar_B_gauss", "pulsar_B_lightcyl_gauss", "mass_funct",
+    "mass_funct2", "pulsar_mass", "companion_mass", "pbdot", "gamma",
+    "omdot_deg_per_yr", "sini", "omdot_to_mtot", "a1sini",
+    "shklovskii_factor", "dispersion_slope",
+]
+
+_SECS_PER_YEAR = 365.25 * 86400.0
+_C = 299792458.0
+
+
+def p_to_f(p, pd=None, pdd=None):
+    """Period (s) derivatives -> frequency (Hz) derivatives and back
+    (the transformation is an involution; reference
+    derived_quantities.py:37)."""
+    f = 1.0 / p
+    if pd is None:
+        return f
+    fd = -pd / p**2
+    if pdd is None:
+        return f, fd
+    fdd = 2.0 * pd**2 / p**3 - pdd / p**2
+    return f, fd, fdd
+
+
+f_to_p = p_to_f  # the same involution
+
+
+def pferrs(por_f, porferr, pdorfd=None, pdorfderr=None):
+    """Uncertainty propagation for p/f conversions (reference :88)."""
+    if pdorfd is None:
+        return 1.0 / por_f, porferr / por_f**2
+    forp = 1.0 / por_f
+    fdorpd = -pdorfd / por_f**2
+    fdorpderr = np.sqrt(
+        (4.0 * pdorfd**2 * porferr**2 / por_f**6)
+        + pdorfderr**2 / por_f**4
+    )
+    return forp, porferr / por_f**2, fdorpd, fdorpderr
+
+
+def pulsar_age_yr(f_hz, fdot, n=3, fo_hz=1e99):
+    """Characteristic age [yr] with braking index n (reference :140)."""
+    return (
+        -f_hz / ((n - 1.0) * fdot) * (1.0 - (f_hz / fo_hz) ** (n - 1.0))
+    ) / _SECS_PER_YEAR
+
+
+def pulsar_edot(f_hz, fdot, I=1e45):
+    """Spin-down luminosity [erg/s] for moment of inertia I [g cm^2]
+    (reference :185)."""
+    return -4.0 * np.pi**2 * I * f_hz * fdot
+
+
+def pulsar_B_gauss(f_hz, fdot):
+    """Surface dipole field [G] (reference :223)."""
+    return 3.2e19 * np.sqrt(-fdot / f_hz**3)
+
+
+def pulsar_B_lightcyl_gauss(f_hz, fdot):
+    """Light-cylinder field [G] (reference :258)."""
+    p, pd = p_to_f(f_hz, fdot)
+    return 2.9e8 * p ** (-5.0 / 2.0) * np.sqrt(pd)
+
+
+def mass_funct(pb_s, x_ls):
+    """Binary mass function [Msun] from PB [s] and A1 [ls]
+    (reference :300)."""
+    return 4.0 * np.pi**2 * x_ls**3 / (T_SUN_S * pb_s**2)
+
+
+def mass_funct2(mp, mc, i_rad):
+    """Mass function [Msun] from component masses and inclination
+    (reference :341)."""
+    return (mc * np.sin(i_rad)) ** 3 / (mc + mp) ** 2
+
+
+def pulsar_mass(pb_s, x_ls, mc, i_rad):
+    """Pulsar mass [Msun] from PB/A1/companion mass/inclination
+    (reference :386; closed-form root of the mass function cubic)."""
+    massfunct = mass_funct(pb_s, x_ls)
+    # f = (mc sinI)^3/(mp+mc)^2  =>  mp = sqrt((mc sinI)^3/f) - mc
+    return np.sqrt((mc * np.sin(i_rad)) ** 3 / massfunct) - mc
+
+
+def companion_mass(pb_s, x_ls, i_rad=np.pi / 2, mp=1.4):
+    """Companion mass [Msun] by solving the mass-function cubic
+    (reference :453; real root via numpy.roots per element)."""
+    massfunct = mass_funct(pb_s, x_ls)
+    sini = np.sin(i_rad)
+
+    def one(mf, s, m):
+        # (mc s)^3 = mf (m + mc)^2
+        roots = np.roots([s**3, -mf, -2 * mf * m, -mf * m**2])
+        real = roots[np.isreal(roots) & (roots.real > 0)].real
+        return float(real.max()) if real.size else np.nan
+
+    mf = np.atleast_1d(massfunct)
+    s = np.broadcast_to(np.atleast_1d(sini), mf.shape)
+    m = np.broadcast_to(np.atleast_1d(mp), mf.shape)
+    out = np.array([one(a, b, c) for a, b, c in zip(mf, s, m)])
+    return out[0] if np.isscalar(pb_s) or np.ndim(pb_s) == 0 else out
+
+
+def pbdot(mp, mc, pb_s, e):
+    """GR orbital decay PBDOT [s/s] (reference :557; Peters 1964)."""
+    nb = 2.0 * np.pi / pb_s
+    fe = (1.0 + 73.0 / 24.0 * e**2 + 37.0 / 96.0 * e**4) \
+        / (1.0 - e**2) ** 3.5
+    return (
+        -192.0 * np.pi / 5.0
+        * (nb * T_SUN_S) ** (5.0 / 3.0)
+        * fe * mp * mc / (mp + mc) ** (1.0 / 3.0)
+    )
+
+
+def gamma(mp, mc, pb_s, e):
+    """Einstein delay amplitude GAMMA [s] (reference :622)."""
+    nb = 2.0 * np.pi / pb_s
+    return (
+        e * T_SUN_S ** (2.0 / 3.0) * nb ** (-1.0 / 3.0)
+        * mc * (mp + 2 * mc) / (mp + mc) ** (4.0 / 3.0)
+    )
+
+
+def omdot_deg_per_yr(mp, mc, pb_s, e):
+    """GR periastron advance [deg/yr] (reference :683)."""
+    nb = 2.0 * np.pi / pb_s
+    rad_per_s = (
+        3.0 * nb ** (5.0 / 3.0) * (T_SUN_S * (mp + mc)) ** (2.0 / 3.0)
+        / (1.0 - e**2)
+    )
+    return np.rad2deg(rad_per_s) * _SECS_PER_YEAR
+
+
+def sini(mp, mc, pb_s, x_ls):
+    """GR SINI from masses (reference :743)."""
+    nb = 2.0 * np.pi / pb_s
+    return (
+        T_SUN_S ** (-1.0 / 3.0) * nb ** (2.0 / 3.0)
+        * x_ls * (mp + mc) ** (2.0 / 3.0) / mc
+    )
+
+
+def omdot_to_mtot(omdot_deg_yr, pb_s, e):
+    """Invert the GR periastron advance for MTOT [Msun]
+    (reference :899)."""
+    omdot_rad_s = np.deg2rad(omdot_deg_yr) / _SECS_PER_YEAR
+    nb = 2.0 * np.pi / pb_s
+    return (
+        (omdot_rad_s * (1.0 - e**2) / (3.0 * nb ** (5.0 / 3.0)))
+        ** (3.0 / 2.0) / T_SUN_S
+    )
+
+
+def a1sini(mp, mc, pb_s):
+    """Projected semi-major axis [ls] from masses (reference :963)."""
+    nb = 2.0 * np.pi / pb_s
+    return (T_SUN_S * mc**3 / (mp + mc) ** 2) ** (1.0 / 3.0) \
+        * nb ** (-2.0 / 3.0)
+
+
+def shklovskii_factor(pmtot_mas_yr, d_kpc):
+    """Shklovskii acceleration a_s [1/s]: Pdot_shk = a_s * P
+    (reference :1017)."""
+    _KPC_M = 3.0856775814913673e19
+    pm_rad_s = np.deg2rad(pmtot_mas_yr / 3.6e6) / _SECS_PER_YEAR
+    return pm_rad_s**2 * d_kpc * _KPC_M / _C
+
+
+def dispersion_slope(dm):
+    """Dispersion slope [s Hz^2] (reference :1055): delay = slope /
+    nu_Hz^2.  DM_CONST carries MHz^2, hence the 1e12."""
+    from pint_tpu import DM_CONST
+
+    return DM_CONST * dm * 1e12

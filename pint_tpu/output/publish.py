@@ -1,0 +1,95 @@
+"""LaTeX timing-solution summary tables.
+
+Counterpart of the reference publish module (reference:
+src/pint/output/publish.py:1-321 ``publish``): render a fitted model +
+TOAs as a self-contained LaTeX table — dataset summary, fitted
+parameters with uncertainties, derived quantities.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["publish"]
+
+
+def _fmt_unc(val, unc, max_digits=18):
+    """'value(unc)' notation with 2 significant digits of uncertainty,
+    e.g. 245.4261196(32)."""
+    if unc is None or unc <= 0 or not np.isfinite(unc):
+        return f"{val:.10g}"
+    import math
+
+    expo = int(math.floor(math.log10(unc)))
+    ndec = max(0, min(max_digits, -(expo - 1)))
+    u2 = int(round(unc / 10 ** (expo - 1)))
+    if u2 >= 100:  # rounding pushed to 3 digits (e.g. 0.0999 -> 100)
+        u2 = 10
+        ndec = max(0, ndec - 1)
+    return f"{val:.{ndec}f}({u2})"
+
+
+def publish(model, toas=None, fitter=None, include_dmx=False):
+    """Return a LaTeX table string (reference publish.py ``publish``)."""
+    rows = []
+    psr = model.meta.get("PSR", "PSR")
+    rows.append(r"\begin{table}")
+    rows.append(rf"\caption{{Timing solution for {psr}}}")
+    rows.append(r"\begin{tabular}{ll}")
+    rows.append(r"\hline\hline")
+    rows.append(r"Parameter & Value \\")
+    rows.append(r"\hline")
+    rows.append(r"\multicolumn{2}{c}{Data summary} \\")
+    if toas is not None:
+        rows.append(rf"Number of TOAs & {len(toas)} \\")
+        mjds = toas.mjd_float
+        rows.append(
+            rf"MJD range & {mjds.min():.1f}--{mjds.max():.1f} \\"
+        )
+    for key, label in (("EPHEM", "Solar system ephemeris"),
+                       ("CLK", "Clock standard"),
+                       ("UNITS", "Time units"),
+                       ("TRES", r"Weighted RMS residual ($\mu$s)"),
+                       ("CHI2", r"$\chi^2$"),
+                       ("NTOA", "TOAs in fit")):
+        if key in model.meta:
+            rows.append(rf"{label} & {model.meta[key]} \\")
+    rows.append(r"\hline")
+    rows.append(r"\multicolumn{2}{c}{Fitted parameters} \\")
+    params = model.params
+    for name in model.free_params:
+        if not include_dmx and name.startswith("DMX"):
+            continue
+        p = params[name]
+        val = model.values.get(name, np.nan)
+        disp = p.format(val) if p.kind in ("angle", "mjd") else \
+            _fmt_unc(val / p.scale if p.scale != 1 else val,
+                     (p.uncertainty / p.scale if p.scale != 1
+                      else p.uncertainty) if p.uncertainty else None)
+        safe = name.replace("_", r"\_")
+        rows.append(rf"{safe} & {disp} \\")
+    # derived quantities when the spin params exist
+    if "F0" in model.values and "F1" in model.values:
+        import pint_tpu.derived_quantities as dq
+
+        f0 = float(model.values["F0"])
+        f1 = float(model.values["F1"])
+        rows.append(r"\hline")
+        rows.append(r"\multicolumn{2}{c}{Derived quantities} \\")
+        rows.append(rf"Spin period $P$ (s) & {1.0 / f0:.12g} \\")
+        if f1 < 0:
+            rows.append(
+                rf"Characteristic age $\tau_c$ (yr) & "
+                rf"{dq.pulsar_age_yr(f0, f1):.3g} \\"
+            )
+            rows.append(
+                rf"Surface field $B_s$ (G) & "
+                rf"{dq.pulsar_B_gauss(f0, f1):.3g} \\"
+            )
+            rows.append(
+                rf"$\dot E$ (erg/s) & {dq.pulsar_edot(f0, f1):.3g} \\"
+            )
+    rows.append(r"\hline")
+    rows.append(r"\end{tabular}")
+    rows.append(r"\end{table}")
+    return "\n".join(rows) + "\n"

@@ -1,0 +1,13 @@
+"""Console entry points.
+
+Counterpart of the reference script layer (reference: src/pint/scripts/,
+13 entry points registered in setup.cfg:55-68).  Run as modules:
+
+    python -m pint_tpu.scripts.pintempo PAR TIM [--fit]
+    python -m pint_tpu.scripts.zima PAR TIM [--ntoa N ...]
+    python -m pint_tpu.scripts.pintbary MJD --ra ... --dec ...
+    python -m pint_tpu.scripts.tcb2tdb IN.par OUT.par
+    python -m pint_tpu.scripts.convert_parfile IN.par [-o OUT]
+    python -m pint_tpu.scripts.compare_parfiles A.par B.par
+    python -m pint_tpu.scripts.pintpublish PAR TIM
+"""

@@ -1,0 +1,29 @@
+"""Compare two par files (reference:
+src/pint/scripts/compare_parfiles.py) using TimingModel.compare."""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="compare_parfiles")
+    p.add_argument("par1")
+    p.add_argument("par2")
+    p.add_argument("--sigma", type=float, default=3.0,
+                   help="threshold for the '!' marker")
+    p.add_argument("--verbosity", default="max",
+                   choices=["max", "med", "min"])
+    args = p.parse_args(argv)
+
+    from pint_tpu.models import get_model
+
+    m1 = get_model(args.par1)
+    m2 = get_model(args.par2)
+    print(m1.compare(m2, threshold_sigma=args.sigma,
+                     verbosity=args.verbosity))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,39 @@
+"""Normalize / convert a par file (reference:
+src/pint/scripts/convert_parfile.py): round-trip through the model
+(canonical aliases, formatting), optionally converting the binary
+parameterization or units."""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="convert_parfile")
+    p.add_argument("input")
+    p.add_argument("-o", "--out", default=None,
+                   help="output par (default stdout)")
+    p.add_argument("--binary", default=None,
+                   help="convert binary model (e.g. ELL1, DD, DDS)")
+    p.add_argument("--allow-tcb", action="store_true")
+    args = p.parse_args(argv)
+
+    from pint_tpu.models import get_model
+
+    model = get_model(args.input, allow_tcb=args.allow_tcb)
+    if args.binary:
+        from pint_tpu.binaryconvert import convert_binary
+
+        model = convert_binary(model, args.binary)
+    text = model.as_parfile()
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+        print(f"wrote {args.out}")
+    else:
+        print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,54 @@
+"""Quick barycentering of times (reference:
+src/pint/scripts/pintbary.py): convert topocentric UTC MJDs to
+barycentric (SSB TDB) MJDs for given sky coordinates."""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="pintbary", description="Barycenter times quickly"
+    )
+    p.add_argument("time", nargs="+", help="UTC MJD(s)")
+    p.add_argument("--obs", default="GBT")
+    p.add_argument("--ra", required=True,
+                   help='e.g. "12:13:14.2"')
+    p.add_argument("--dec", required=True,
+                   help='e.g. "-20:21:22.2"')
+    p.add_argument("--ephem", default="builtin")
+    p.add_argument("--freq", type=float, default=0.0,
+                   help="MHz (0 = infinite frequency)")
+    p.add_argument("--dm", type=float, default=0.0)
+    args = p.parse_args(argv)
+
+    import numpy as np
+
+    from pint_tpu.models import get_model
+    from pint_tpu.time.mjd import ticks_to_mjd_string_tdb
+    from pint_tpu.toa import TOA, TOAs
+    from pint_tpu.time.mjd import mjd_string_to_day_frac
+
+    par = (
+        f"PSR BARY\nRAJ {args.ra}\nDECJ {args.dec}\nF0 1.0\n"
+        f"PEPOCH 55000\nDM {args.dm}\nEPHEM {args.ephem}\n"
+    )
+    model = get_model(par)
+    toa_list = []
+    for s in args.time:
+        d, n, den = mjd_string_to_day_frac(s)
+        toa_list.append(
+            TOA(d, n, den, 0.0, args.freq or 0.0, args.obs, {}, "bary")
+        )
+    toas = TOAs(toa_list, ephem=args.ephem)
+    prepared = model.prepare(toas)
+    delay = np.asarray(prepared.delay())
+    for i in range(len(toas)):
+        bat_ticks = int(toas.ticks[i]) - int(round(delay[i] * 2**32))
+        print(ticks_to_mjd_string_tdb(bat_ticks, 13))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

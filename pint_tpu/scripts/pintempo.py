@@ -1,0 +1,75 @@
+"""Fit a timing model to TOAs — the tempo/tempo2 workalike CLI
+(reference: src/pint/scripts/pintempo.py)."""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="pintempo",
+        description="Fit a pulsar timing model (par) to TOAs (tim)",
+    )
+    p.add_argument("parfile")
+    p.add_argument("timfile")
+    p.add_argument("--outfile", "-o", default=None,
+                   help="write post-fit par here")
+    p.add_argument("--fit", action="store_true", default=True)
+    p.add_argument("--nofit", dest="fit", action="store_false")
+    p.add_argument("--gls", action="store_true",
+                   help="force the GLS fitter")
+    p.add_argument("--plotfile", default=None,
+                   help="write a pre/post-fit residual plot (png)")
+    p.add_argument("--allow-tcb", action="store_true")
+    args = p.parse_args(argv)
+
+    from pint_tpu.fitter import Fitter, GLSFitter
+    from pint_tpu.models import get_model
+    from pint_tpu.residuals import Residuals
+    from pint_tpu.toa import get_TOAs
+
+    model = get_model(args.parfile, allow_tcb=args.allow_tcb)
+    planets = model.meta.get("PLANET_SHAPIRO", "N").upper() in ("Y", "1")
+    toas = get_TOAs(args.timfile, ephem=model.meta.get("EPHEM", "builtin"),
+                    planets=planets)
+    print(f"Read {len(toas)} TOAs; model "
+          f"{model.meta.get('PSR', args.parfile)}")
+    r_pre = Residuals(toas, model)
+    print(f"Prefit  RMS {r_pre.rms_weighted() * 1e6:12.4f} us  "
+          f"chi2 {r_pre.chi2:.2f}")
+    if args.fit:
+        fitter = (GLSFitter(toas, model) if args.gls
+                  else Fitter.auto(toas, model))
+        fitter.fit_toas()
+        print(fitter.get_summary())
+    if args.plotfile:
+        _plot(toas, model, r_pre, args.plotfile)
+    if args.outfile:
+        with open(args.outfile, "w") as f:
+            f.write(model.as_parfile())
+        print(f"wrote {args.outfile}")
+    return 0
+
+
+def _plot(toas, model, r_pre, path):
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    from pint_tpu.residuals import Residuals
+
+    r_post = Residuals(toas, model)
+    fig, axes = plt.subplots(2, 1, sharex=True, figsize=(8, 6))
+    for ax, r, label in ((axes[0], r_pre, "prefit"),
+                         (axes[1], r_post, "postfit")):
+        ax.errorbar(toas.mjd_float, r.time_resids * 1e6,
+                    yerr=r.scaled_errors * 1e6, fmt=".", ms=3)
+        ax.set_ylabel(f"{label} resid [us]")
+    axes[1].set_xlabel("MJD")
+    fig.savefig(path, dpi=120)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,43 @@
+"""Generate a LaTeX timing summary (reference:
+src/pint/scripts/pintpublish.py)."""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="pintpublish")
+    p.add_argument("parfile")
+    p.add_argument("timfile", nargs="?", default=None)
+    p.add_argument("-o", "--out", default=None)
+    p.add_argument("--fit", action="store_true",
+                   help="re-fit before publishing")
+    args = p.parse_args(argv)
+
+    from pint_tpu.models import get_model
+    from pint_tpu.output.publish import publish
+
+    model = get_model(args.parfile)
+    toas = None
+    if args.timfile:
+        from pint_tpu.toa import get_TOAs
+
+        toas = get_TOAs(args.timfile,
+                        ephem=model.meta.get("EPHEM", "builtin"))
+        if args.fit:
+            from pint_tpu.fitter import Fitter
+
+            Fitter.auto(toas, model).fit_toas()
+    text = publish(model, toas)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+        print(f"wrote {args.out}")
+    else:
+        print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,30 @@
+"""Convert a TCB par file to TDB (reference:
+src/pint/scripts/tcb2tdb.py)."""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="tcb2tdb", description="Approximate TCB->TDB par conversion"
+    )
+    p.add_argument("input_par")
+    p.add_argument("output_par")
+    args = p.parse_args(argv)
+
+    from pint_tpu.models.tcb import convert_parfile_tcb_tdb
+
+    with open(args.input_par) as f:
+        text = f.read()
+    out = convert_parfile_tcb_tdb(text)
+    with open(args.output_par, "w") as f:
+        f.write(out)
+    print(f"wrote {args.output_par} (re-fit recommended; the "
+          "conversion is approximate)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,50 @@
+"""Simulate fake TOAs from a timing model (reference:
+src/pint/scripts/zima.py)."""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="zima", description="Simulate TOAs from a par file"
+    )
+    p.add_argument("parfile")
+    p.add_argument("timfile", help="output .tim")
+    p.add_argument("--ntoa", type=int, default=100)
+    p.add_argument("--startMJD", type=float, default=56000.0)
+    p.add_argument("--duration", type=float, default=400.0,
+                   help="days")
+    p.add_argument("--obs", default="GBT")
+    p.add_argument("--freq", type=float, nargs="+", default=[1400.0])
+    p.add_argument("--error", type=float, default=1.0,
+                   help="TOA uncertainty [us]")
+    p.add_argument("--addnoise", action="store_true")
+    p.add_argument("--wideband", action="store_true")
+    p.add_argument("--dmerror", type=float, default=1e-4)
+    p.add_argument("--seed", type=int, default=None)
+    args = p.parse_args(argv)
+
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_uniform
+    from pint_tpu.toa import write_tim
+
+    model = get_model(args.parfile)
+    freqs = np.array(args.freq)[np.arange(args.ntoa) % len(args.freq)]
+    toas = make_fake_toas_uniform(
+        args.startMJD, args.startMJD + args.duration, args.ntoa, model,
+        freq_mhz=freqs, obs=args.obs, error_us=args.error,
+        add_noise=args.addnoise, wideband=args.wideband,
+        dm_error=args.dmerror,
+        rng=np.random.default_rng(args.seed),
+    )
+    write_tim(toas, args.timfile)
+    print(f"wrote {len(toas)} simulated TOAs to {args.timfile}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -151,9 +151,41 @@ def ticks_to_mjd_tdb(ticks):
     return (day + EPOCH_MJD).astype(np.int64), frac
 
 
-def ticks_to_mjd_string_tdb(ticks: int, ndigits: int = 16) -> str:
-    """One tick value -> decimal MJD string with ndigits fractional digits."""
-    total = int(ticks) + 43200 * TICKS_PER_SEC_INT
+def ticks_to_mjd_string_utc(ticks: int, clock_offset_sec: float = 0.0,
+                            ndigits: int = 16) -> str:
+    """Invert the UTC->TDB chain: TDB ticks -> site-UTC pulsar-MJD string
+    (for .tim writing; reference: toa.py:566 format_toa_line).
+
+    clock_offset_sec is subtracted (the same offset mjd_to_ticks_utc
+    added).  Exact integer arithmetic except the small TDB-TT + clock
+    terms (~ms), which are f64 — sub-ns on the output."""
+    ticks = int(ticks)
+    tdb_sec = ticks / float(TICKS_PER_SEC_INT)
+    dtdb = float(tdb_minus_tt_seconds(tdb_sec))
+    tt_ticks = ticks - int(round((dtdb + clock_offset_sec)
+                                 * TICKS_PER_SEC_INT))
+    # TT -> TAI -> UTC; leap lookup from the TT day, re-checked on the
+    # UTC day (they can differ across a midnight boundary)
+    day_guess = int(
+        np.floor(tt_ticks / float(TICKS_PER_SEC_INT) / SECS_PER_DAY_INT
+                 + EPOCH_MJD + EPOCH_FRAC)
+    )
+    for _ in range(2):
+        leap = int(tai_minus_utc(day_guess))
+        utc_ticks = tt_ticks - _TT_MINUS_TAI_TICKS \
+            - leap * TICKS_PER_SEC_INT
+        total = utc_ticks + 43200 * TICKS_PER_SEC_INT
+        day = total // (SECS_PER_DAY_INT * TICKS_PER_SEC_INT) + EPOCH_MJD
+        if day == day_guess:
+            break
+        day_guess = int(day)
+    return _total_ticks_to_mjd_string(total, ndigits)
+
+
+def _total_ticks_to_mjd_string(total: int, ndigits: int) -> str:
+    """Midnight-based tick count -> decimal MJD string, rounding the
+    fraction with carry into the day (shared by the TDB and UTC string
+    paths)."""
     day_ticks = SECS_PER_DAY_INT * TICKS_PER_SEC_INT
     day, rem = divmod(total, day_ticks)
     scaled = rem * 10**ndigits
@@ -164,3 +196,9 @@ def ticks_to_mjd_string_tdb(ticks: int, ndigits: int = 16) -> str:
             q = 0
             day += 1
     return f"{day + EPOCH_MJD}.{q:0{ndigits}d}"
+
+
+def ticks_to_mjd_string_tdb(ticks: int, ndigits: int = 16) -> str:
+    """One tick value -> decimal MJD string with ndigits fractional digits."""
+    total = int(ticks) + 43200 * TICKS_PER_SEC_INT
+    return _total_ticks_to_mjd_string(total, ndigits)

@@ -1,0 +1,140 @@
+"""Bayesian timing + ensemble MCMC.
+
+Oracles: sampling a known Gaussian recovers its moments; the timing
+posterior's spread matches the WLS covariance (the likelihood is nearly
+Gaussian for a linear model); determinism with a fixed key (reference:
+tests/test_determinism.py strategy).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pint_tpu.bayesian import BayesianTiming, NormalPrior, UniformPrior
+from pint_tpu.fitter import WLSFitter
+from pint_tpu.models import get_model
+from pint_tpu.sampler import EnsembleSampler, run_mcmc
+from pint_tpu.simulation import make_fake_toas_uniform
+
+PAR = """
+PSR FAKE
+RAJ 05:00:00
+DECJ 20:00:00
+F0 100.0 1
+F1 -1e-15 1
+PEPOCH 55000
+DM 10.0 1
+TZRMJD 55000
+TZRFRQ 1400
+TZRSITE gbt
+"""
+
+
+class TestSampler:
+    def test_gaussian_moments(self):
+        """Sample a 3d Gaussian; recover mean and covariance."""
+        mu = jnp.array([1.0, -2.0, 0.5])
+        sig = jnp.array([0.5, 2.0, 1.0])
+
+        def lnpost(x):
+            return -0.5 * jnp.sum(((x - mu) / sig) ** 2)
+
+        key = jax.random.PRNGKey(42)
+        x0 = mu + 0.1 * jax.random.normal(key, (64, 3))
+        chain, lnp, acc = run_mcmc(lnpost, x0, 1500, key=key)
+        flat = np.asarray(chain[500:]).reshape(-1, 3)
+        assert 0.1 < acc < 0.9
+        np.testing.assert_allclose(flat.mean(axis=0), np.asarray(mu),
+                                   atol=0.15)
+        np.testing.assert_allclose(flat.std(axis=0), np.asarray(sig),
+                                   rtol=0.15)
+
+    def test_deterministic(self):
+        def lnpost(x):
+            return -0.5 * jnp.sum(x**2)
+
+        key = jax.random.PRNGKey(7)
+        x0 = jax.random.normal(key, (16, 2))
+        c1, _, _ = run_mcmc(lnpost, x0, 100, key=key)
+        c2, _, _ = run_mcmc(lnpost, x0, 100, key=key)
+        np.testing.assert_array_equal(np.asarray(c1), np.asarray(c2))
+
+    def test_odd_walkers_rejected(self):
+        with pytest.raises(ValueError, match="even"):
+            run_mcmc(lambda x: 0.0, jnp.zeros((7, 2)), 10)
+
+
+class TestBayesianTiming:
+    @pytest.fixture(scope="class")
+    def fitted(self):
+        m = get_model(PAR)
+        toas = make_fake_toas_uniform(
+            54000, 56000, 100, m,
+            freq_mhz=np.where(np.arange(100) % 2 == 0, 1400.0, 800.0),
+            obs="gbt", error_us=1.0, add_noise=True,
+            rng=np.random.default_rng(11),
+        )
+        f = WLSFitter(toas, m)
+        f.fit_toas()
+        return m, toas, f
+
+    def test_lnposterior_finite_and_peaked(self, fitted):
+        m, toas, f = fitted
+        bt = BayesianTiming(m, toas)
+        v0 = jnp.asarray(bt.start_vector())
+        lnp0 = float(jax.jit(bt.lnposterior)(v0))
+        assert np.isfinite(lnp0)
+        # moving 5 sigma away in F0 must lower the posterior
+        dv = np.zeros(bt.nparams)
+        dv[bt.param_names.index("F0")] = 5 * m.params["F0"].uncertainty
+        lnp5 = float(bt.lnposterior(v0 + dv))
+        assert lnp5 < lnp0
+
+    def test_gradient_available(self, fitted):
+        """jax.grad of the posterior — the HMC enabler the reference
+        lacks (emcee is derivative-free)."""
+        m, toas, f = fitted
+        bt = BayesianTiming(m, toas)
+        g = jax.grad(bt.lnposterior)(jnp.asarray(bt.start_vector()))
+        assert np.all(np.isfinite(np.asarray(g)))
+
+    def test_prior_transform_roundtrip(self, fitted):
+        m, toas, f = fitted
+        bt = BayesianTiming(m, toas)
+        vec = bt.prior_transform(jnp.full(bt.nparams, 0.5))
+        # mid-cube = prior center = current values for uniform priors
+        np.testing.assert_allclose(
+            np.asarray(vec), bt.start_vector(), rtol=1e-12
+        )
+
+    def test_explicit_priors(self, fitted):
+        m, toas, f = fitted
+        pri = {n: NormalPrior(float(m.values[n]), 1.0)
+               for n in m.free_params}
+        bt = BayesianTiming(m, toas, priors=pri)
+        u = bt.prior_transform(jnp.full(bt.nparams, 0.975))
+        # 97.5th percentile of N(mu, 1) is mu + 1.96
+        np.testing.assert_allclose(
+            np.asarray(u) - bt.start_vector(), 1.9599, atol=1e-3
+        )
+
+    def test_posterior_width_matches_wls(self, fitted):
+        """Posterior sigma ~ WLS uncertainty for the linear model."""
+        m, toas, f = fitted
+        bt = BayesianTiming(m, toas)
+        flat, s = bt.sample(nwalkers=32, nsteps=600, seed=3)
+        i = bt.param_names.index("F0")
+        post_sig = flat[:, i].std()
+        wls_sig = m.params["F0"].uncertainty
+        assert 0.5 < post_sig / wls_sig < 2.0
+
+    def test_requires_priors_without_uncertainty(self):
+        m = get_model(PAR)
+        toas = make_fake_toas_uniform(
+            54500, 55500, 30, m, freq_mhz=np.full(30, 1400.0), obs="gbt",
+            error_us=1.0,
+        )
+        with pytest.raises(ValueError, match="prior"):
+            BayesianTiming(m, toas)

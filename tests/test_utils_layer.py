@@ -1,0 +1,217 @@
+"""Derived quantities, polycos, random models, binary conversion.
+
+Oracles: textbook closed forms evaluated by hand (derived quantities),
+the model's own jitted phase (polycos must reproduce it to sub-1e-6
+turns inside a segment), covariance-consistent spread (random models),
+and round-trip identity of residuals under binary re-parameterization
+(the conversion changes coordinates, not physics).
+"""
+
+import numpy as np
+import pytest
+
+import pint_tpu.derived_quantities as dq
+from pint_tpu.binaryconvert import convert_binary
+from pint_tpu.fitter import WLSFitter
+from pint_tpu.models import get_model
+from pint_tpu.polycos import Polycos, generate_polycos
+from pint_tpu.residuals import Residuals
+from pint_tpu.simulation import (
+    calculate_random_models,
+    make_fake_toas_uniform,
+)
+
+
+class TestDerivedQuantities:
+    def test_p_f_roundtrip(self):
+        f, fd = 100.0, -1e-15
+        p, pd = dq.p_to_f(f, fd)
+        assert p == pytest.approx(0.01)
+        assert pd == pytest.approx(1e-19)
+        f2, fd2 = dq.p_to_f(p, pd)
+        assert (f2, fd2) == (pytest.approx(f), pytest.approx(fd))
+
+    def test_characteristic_age(self):
+        # tau = -f / (2 fdot) for n=3: 100/(2e-15) s ~ 1.58 Gyr
+        age = dq.pulsar_age_yr(100.0, -1e-15)
+        assert age == pytest.approx(5e16 / (365.25 * 86400), rel=1e-12)
+
+    def test_bfield(self):
+        b = dq.pulsar_B_gauss(100.0, -1e-15)
+        assert b == pytest.approx(3.2e19 * np.sqrt(1e-21), rel=1e-12)
+
+    def test_mass_function_double_pulsar(self):
+        # J0737-3039A-ish: PB=0.102 d, A1=1.415 ls -> f ~ 0.29 Msun
+        f = dq.mass_funct(0.10225 * 86400.0, 1.415032)
+        assert f == pytest.approx(0.29097, rel=1e-3)
+
+    def test_companion_mass_inverts_mass_funct2(self):
+        mp, mc, i = 1.4, 0.3, np.deg2rad(60.0)
+        # build PB/A1 consistent with these masses
+        pb = 10.0 * 86400.0
+        x = dq.a1sini(mp, mc, pb) * np.sin(i)
+        got = dq.companion_mass(pb, x, i_rad=i, mp=mp)
+        assert got == pytest.approx(mc, rel=1e-10)
+
+    def test_gr_pk_parameters_hulse_taylor(self):
+        """B1913+16: PBDOT ~ -2.40e-12, OMDOT ~ 4.22 deg/yr."""
+        mp, mc = 1.441, 1.387
+        pb = 27906.98
+        e = 0.6171
+        assert dq.pbdot(mp, mc, pb, e) == pytest.approx(-2.40e-12,
+                                                        rel=2e-2)
+        assert dq.omdot_deg_per_yr(mp, mc, pb, e) == pytest.approx(
+            4.226, rel=2e-2
+        )
+        mtot = dq.omdot_to_mtot(
+            dq.omdot_deg_per_yr(mp, mc, pb, e), pb, e
+        )
+        assert mtot == pytest.approx(mp + mc, rel=1e-10)
+
+
+PAR = """
+PSR FAKE
+RAJ 05:00:00
+DECJ 20:00:00
+F0 100.0 1
+F1 -1e-15 1
+PEPOCH 55000
+DM 10.0 1
+TZRMJD 55000
+TZRFRQ 1400
+TZRSITE gbt
+"""
+
+
+class TestPolycos:
+    def test_matches_model_phase(self):
+        m = get_model(PAR)
+        pcs = generate_polycos(m, 54999.0, 54999.5, "gbt",
+                               segment_length_min=60.0, ncoeff=12)
+        # evaluate at fresh times through the full model; binary
+        # day-fractions are exact in BOTH the f64 MJD fed to the polyco
+        # and the integer tick fed to the model, so the comparison
+        # isolates the polynomial error from f64-MJD representation
+        # noise (~0.2 us at MJD 55000, the tempo-format floor)
+        from pint_tpu.toa import TOA, TOAs
+
+        den = 2**22
+        fracs = np.linspace(0.013, 0.48, 40)
+        nums = (fracs * den).astype(np.int64)
+        test_mjds = 54999.0 + nums / den
+        toa_list = [
+            TOA(54999, int(num), den, 1.0, 1400.0, "gbt", {}, "t")
+            for num in nums
+        ]
+        toas = TOAs(toa_list, ephem="builtin")
+        prep = m.prepare(toas)
+        n_ref, f_ref = prep.phase()
+        n_p, f_p = pcs.eval_abs_phase(test_mjds)
+        dphi = (np.asarray(n_p) - np.asarray(n_ref)) + (
+            np.asarray(f_p) - np.asarray(f_ref)
+        )
+        assert np.max(np.abs(dphi)) < 1e-6  # reference accuracy target
+
+    def test_freq_close_to_f0(self):
+        m = get_model(PAR)
+        pcs = generate_polycos(m, 54999.0, 54999.2, "gbt")
+        f = pcs.eval_spin_freq(54999.1)
+        # apparent freq differs from F0 by Doppler ~ 1e-4 fractional
+        assert abs(f[0] / 100.0 - 1) < 1e-3
+
+    def test_io_roundtrip(self, tmp_path):
+        m = get_model(PAR)
+        pcs = generate_polycos(m, 54999.0, 54999.3, "gbt")
+        path = tmp_path / "polyco.dat"
+        pcs.write_polyco_file(path)
+        back = Polycos.read_polyco_file(path)
+        t = 54999.123
+        n1, f1 = pcs.eval_abs_phase(t)
+        n2, f2 = back.eval_abs_phase(t)
+        assert n1[0] == n2[0]
+        assert f1[0] == pytest.approx(f2[0], abs=2e-9)
+
+    def test_uncovered_raises(self):
+        m = get_model(PAR)
+        pcs = generate_polycos(m, 54999.0, 54999.1, "gbt")
+        with pytest.raises(ValueError, match="not covered"):
+            pcs.eval_abs_phase(55100.0)
+
+
+class TestRandomModels:
+    def test_spread_tracks_covariance(self):
+        m = get_model(PAR)
+        toas = make_fake_toas_uniform(
+            54000, 56000, 100, m,
+            freq_mhz=np.where(np.arange(100) % 2 == 0, 1400.0, 800.0),
+            obs="gbt", error_us=1.0, add_noise=True,
+            rng=np.random.default_rng(5),
+        )
+        f = WLSFitter(toas, m)
+        f.fit_toas()
+        d = calculate_random_models(f, toas, n_models=200,
+                                    rng=np.random.default_rng(1))
+        assert d.shape == (200, 100)
+        # the spread of sampled-model residuals should be of order the
+        # TOA uncertainty (parameters are constrained by these data)
+        spread = d.std(axis=0)
+        assert 0.05e-6 < np.median(spread) < 5e-6
+
+
+BPAR = PAR + """BINARY ELL1
+PB 5.741 1
+A1 3.3667 1
+TASC 54900.1
+EPS1 1.2e-5 1 1e-8
+EPS2 -3.4e-6 1 1e-8
+M2 0.25
+SINI 0.97
+"""
+
+
+class TestBinaryConvert:
+    def test_ell1_to_dd_and_back(self):
+        m = get_model(BPAR)
+        mdd = convert_binary(m, "DD")
+        assert mdd.meta["BINARY"] == "DD"
+        ecc = np.hypot(1.2e-5, 3.4e-6)
+        assert mdd.values["ECC"] == pytest.approx(ecc, rel=1e-12)
+        om = np.arctan2(1.2e-5, -3.4e-6)
+        assert mdd.values["OM"] == pytest.approx(om, rel=1e-12)
+        # uncertainties propagated through the jacobian
+        assert mdd.params["ECC"].uncertainty == pytest.approx(
+            1e-8 * np.hypot(1.2e-5, -3.4e-6) / ecc, rel=0.3
+        )
+        back = convert_binary(mdd, "ELL1")
+        assert back.values["EPS1"] == pytest.approx(1.2e-5, rel=1e-10)
+        assert back.values["EPS2"] == pytest.approx(-3.4e-6, rel=1e-10)
+
+    def test_residuals_invariant(self):
+        m = get_model(BPAR)
+        toas = make_fake_toas_uniform(
+            54000, 56000, 80, m, freq_mhz=np.full(80, 1400.0), obs="gbt",
+            error_us=1.0,
+        )
+        r0 = Residuals(toas, m).time_resids
+        mdd = convert_binary(m, "DD")
+        r1 = Residuals(toas, mdd).time_resids
+        # ELL1 is a small-ecc approximation of DD: agreement to
+        # O(ecc^2 * PB / 2pi) ~ (1.25e-5)^2 * 79000 s ~ 12 ns
+        assert np.max(np.abs(r1 - r0)) < 5e-8
+
+    def test_sini_shapmax(self):
+        m = get_model(BPAR)
+        mdds = convert_binary(convert_binary(m, "DD"), "DDS")
+        assert mdds.values["SHAPMAX"] == pytest.approx(
+            -np.log(1 - 0.97), rel=1e-12
+        )
+        mdd2 = convert_binary(mdds, "DD")
+        assert mdd2.values["SINI"] == pytest.approx(0.97, rel=1e-12)
+
+    def test_orthometric(self):
+        m = get_model(BPAR)
+        mh = convert_binary(m, "ELL1H")
+        cosi = np.sqrt(1 - 0.97**2)
+        stigma = 0.97 / (1 + cosi)
+        h3 = 4.925490947e-6 * 0.25 * stigma**3
+        assert mh.values["H3"] == pytest.approx(h3, rel=1e-9)
