@@ -1,0 +1,176 @@
+"""Minimal FITS binary-table reader (pure numpy, no astropy).
+
+Counterpart of the reference's use of ``astropy.io.fits`` for photon
+event files (reference: src/pint/fits_utils.py:1-127
+``read_fits_event_mjds_tuples``, src/pint/event_toas.py).  Implements
+exactly the subset the photon path needs: 2880-byte header blocks,
+keyword cards, and BINTABLE extensions with scalar numeric columns
+(big-endian, as the standard requires).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["FitsHDU", "read_fits", "read_events"]
+
+_BLOCK = 2880
+_CARD = 80
+
+#: TFORM letter -> numpy big-endian dtype
+_TFORM = {
+    "L": "i1", "B": "u1", "I": ">i2", "J": ">i4", "K": ">i8",
+    "E": ">f4", "D": ">f8",
+}
+
+
+class FitsHDU:
+    def __init__(self, header, data=None, columns=None):
+        self.header = header
+        self.data = data  # dict column name -> array (tables)
+        self.columns = columns or []
+
+    @property
+    def name(self):
+        return str(self.header.get("EXTNAME", "")).strip()
+
+
+def _parse_header(chunk_iter):
+    """Consume header blocks; return (header dict, bytes consumed)."""
+    header = {}
+    nbytes = 0
+    done = False
+    while not done:
+        block = next(chunk_iter)
+        nbytes += _BLOCK
+        for i in range(0, _BLOCK, _CARD):
+            card = block[i:i + _CARD].decode("ascii", errors="replace")
+            key = card[:8].strip()
+            if key == "END":
+                done = True
+                break
+            if not key or card[8] != "=":
+                continue
+            val = card[10:]
+            # strip inline comment (respect quoted strings)
+            if val.lstrip().startswith("'"):
+                s = val.lstrip()[1:]
+                out = []
+                j = 0
+                while j < len(s):
+                    if s[j] == "'":
+                        if j + 1 < len(s) and s[j + 1] == "'":
+                            out.append("'")
+                            j += 2
+                            continue
+                        break
+                    out.append(s[j])
+                    j += 1
+                header[key] = "".join(out).rstrip()
+                continue
+            val = val.split("/")[0].strip()
+            if val in ("T", "F"):
+                header[key] = val == "T"
+            else:
+                try:
+                    header[key] = int(val)
+                except ValueError:
+                    try:
+                        header[key] = float(val)
+                    except ValueError:
+                        header[key] = val
+    return header, nbytes
+
+
+def read_fits(path):
+    """Read all HDUs; table HDUs get a {column: ndarray} data dict."""
+    with open(path, "rb") as f:
+        raw = f.read()
+    hdus = []
+    pos = 0
+
+    def blocks():
+        nonlocal pos
+        while pos < len(raw):
+            b = raw[pos:pos + _BLOCK]
+            pos += _BLOCK
+            yield b
+
+    it = blocks()
+    while pos < len(raw):
+        try:
+            header, _ = _parse_header(it)
+        except StopIteration:
+            break
+        bitpix = abs(int(header.get("BITPIX", 8)))
+        naxes = [
+            int(header.get(f"NAXIS{i + 1}", 0))
+            for i in range(int(header.get("NAXIS", 0)))
+        ]
+        datasize = (
+            bitpix // 8 * int(np.prod(naxes)) if naxes else 0
+        ) * max(1, int(header.get("GCOUNT", 1)))
+        datasize += int(header.get("PCOUNT", 0)) * bitpix // 8
+        data = None
+        columns = []
+        if header.get("XTENSION", "").startswith("BINTABLE") and naxes:
+            row_bytes, nrows = naxes[0], naxes[1]
+            table_raw = raw[pos:pos + row_bytes * nrows]
+            ncols = int(header.get("TFIELDS", 0))
+            data = {}
+            offset = 0
+            for c in range(1, ncols + 1):
+                tform = str(header.get(f"TFORM{c}", "")).strip()
+                ttype = str(header.get(f"TTYPE{c}", f"COL{c}")).strip()
+                # repeat count + letter (e.g. '1D', 'D', '2E')
+                rep = ""
+                j = 0
+                while j < len(tform) and tform[j].isdigit():
+                    rep += tform[j]
+                    j += 1
+                letter = tform[j:j + 1]
+                repeat = int(rep) if rep else 1
+                columns.append(ttype)
+                if letter in _TFORM:
+                    dt = np.dtype(_TFORM[letter])
+                    width = dt.itemsize * repeat
+                    arr = np.ndarray(
+                        (nrows, repeat), dtype=dt,
+                        buffer=table_raw,
+                        offset=offset,
+                        strides=(row_bytes, dt.itemsize),
+                    )
+                    arr = arr.astype(dt.newbyteorder("="))
+                    data[ttype] = arr[:, 0] if repeat == 1 else arr
+                elif letter == "A":
+                    width = repeat
+                    arr = np.ndarray(
+                        (nrows,), dtype=f"S{repeat}",
+                        buffer=table_raw, offset=offset,
+                        strides=(row_bytes,),
+                    )
+                    data[ttype] = np.char.decode(arr, "ascii")
+                else:
+                    raise ValueError(
+                        f"unsupported TFORM {tform!r} for {ttype}"
+                    )
+                offset += width
+        # skip data (padded to block size)
+        pos += (datasize + _BLOCK - 1) // _BLOCK * _BLOCK
+        hdus.append(FitsHDU(header, data, columns))
+    return hdus
+
+
+def read_events(path, extname="EVENTS", columns=None):
+    """(header, {column: array}) of the named table extension."""
+    for hdu in read_fits(path):
+        if hdu.data is not None and hdu.name.upper() == extname.upper():
+            if columns:
+                missing = [c for c in columns if c not in hdu.data]
+                if missing:
+                    raise KeyError(
+                        f"columns {missing} not in {extname} "
+                        f"(has {list(hdu.data)})"
+                    )
+            return hdu.header, hdu.data
+    raise KeyError(f"no {extname} extension in {path}")

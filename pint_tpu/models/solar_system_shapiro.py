@@ -51,10 +51,12 @@ class SolarSystemShapiro(DelayComponent):
         return {"PLANET_SHAPIRO": 0.0}
 
     def prepare(self, toas, model):
-        return {
-            "planets": bool(model.values.get("PLANET_SHAPIRO", 0.0))
-            and toas.planets
-        }
+        # the on/off decision must be shape-encoded (static under both
+        # jit AND vmap-over-pulsars): an empty planet-index tuple means
+        # sun only.  A python bool in ctx would be stacked/traced by the
+        # PTA batch path.
+        on = bool(model.values.get("PLANET_SHAPIRO", 0.0)) and toas.planets
+        return {"planet_idx": tuple(range(len(_PLANET_T))) if on else ()}
 
     def delay(self, values, batch, ctx, delay_accum):
         # psr direction from the astrometry component's parameters: the
@@ -62,9 +64,8 @@ class SolarSystemShapiro(DelayComponent):
         # vector from RAJ/DECJ (or ELONG/ELAT) present in values.
         n = _psr_dir_from_values(values)
         d = _obj_shapiro(batch.obs_sun_pos, n, T_SUN_S)
-        if ctx["planets"]:
-            for i, t_obj in enumerate(_PLANET_T):
-                d = d + _obj_shapiro(batch.planet_pos[i], n, t_obj)
+        for i in ctx["planet_idx"]:
+            d = d + _obj_shapiro(batch.planet_pos[i], n, _PLANET_T[i])
         return d
 
 

@@ -1,0 +1,11 @@
+"""Multi-pulsar / multi-device parallelism.
+
+The domain's parallel axes (SURVEY section 2.9) are embarrassingly
+parallel: pulsars, chi^2-grid points, MCMC walkers.  This package maps
+the pulsar axis onto a ``jax.sharding.Mesh`` — the PTA-scale analogue
+of data parallelism — with the TOA axis as the inner (vectorized)
+dimension; XLA inserts the collectives for the normal-equation
+reductions.
+"""
+
+from pint_tpu.parallel.pta import PTABatch, pulsar_mesh  # noqa: F401

@@ -1,0 +1,43 @@
+"""Phase-fold Fermi LAT photons with weights (reference:
+src/pint/scripts/fermiphase.py)."""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="fermiphase")
+    p.add_argument("eventfile")
+    p.add_argument("parfile")
+    p.add_argument("--weightcol", default="WEIGHT")
+    p.add_argument("--outphases", default=None)
+    args = p.parse_args(argv)
+
+    from pint_tpu.event_toas import load_Fermi_TOAs
+    from pint_tpu.eventstats import hmw, hm, sf_hm, sig2sigma
+    from pint_tpu.models import get_model
+
+    model = get_model(args.parfile)
+    toas = load_Fermi_TOAs(args.eventfile, weightcolumn=args.weightcol,
+                           ephem=model.meta.get("EPHEM", "builtin"))
+    prepared = model.prepare(toas)
+    _, frac = prepared.phase()
+    phases = np.asarray(frac) % 1.0
+    wf = toas.get_flag_values("weight", default=None, astype=float)
+    if any(w is not None for w in wf):
+        weights = np.array([1.0 if w is None else w for w in wf])
+        h = hmw(phases, weights)
+    else:
+        h = hm(phases)
+    print(f"Htest: {h:.2f} (sf {sf_hm(h):.3g}, "
+          f"~{sig2sigma(max(sf_hm(h), 1e-300)):.1f} sigma)")
+    if args.outphases:
+        np.save(args.outphases, phases)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,72 @@
+"""Compute model phases for photon events + pulsation tests
+(reference: src/pint/scripts/photonphase.py)."""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="photonphase",
+        description="Phase-fold photon events with a timing model",
+    )
+    p.add_argument("eventfile")
+    p.add_argument("parfile")
+    p.add_argument("--mission", default="nicer")
+    p.add_argument("--extname", default="EVENTS")
+    p.add_argument("--maxh", type=int, default=20,
+                   help="max harmonics for the H-test")
+    p.add_argument("--outphases", default=None,
+                   help="write phases to this .npy")
+    p.add_argument("--polycos", action="store_true",
+                   help="use generated polycos instead of exact phases")
+    args = p.parse_args(argv)
+
+    from pint_tpu.event_toas import load_event_TOAs
+    from pint_tpu.eventstats import hm, hmw, sf_hm, sig2sigma
+    from pint_tpu.models import get_model
+
+    model = get_model(args.parfile)
+    toas = load_event_TOAs(args.eventfile, args.mission,
+                           extname=args.extname,
+                           ephem=model.meta.get("EPHEM", "builtin"))
+    print(f"Read {len(toas)} events")
+    if args.polycos:
+        if not all(o == "barycenter" for o in toas.obs_names):
+            raise SystemExit(
+                "--polycos requires barycentered events (TIMEREF="
+                "SOLARSYSTEM): polycos are evaluated at the recorded "
+                "MJD label, which for geocentric events omits the "
+                "Roemer delay entirely — use the exact path instead"
+            )
+        from pint_tpu.polycos import generate_polycos
+
+        mjds = toas.mjd_float
+        pcs = generate_polycos(model, mjds.min() - 0.05,
+                               mjds.max() + 0.05, "@")
+        phases = pcs.eval_phase(mjds) % 1.0
+    else:
+        prepared = model.prepare(toas)
+        _, frac = prepared.phase()
+        phases = np.asarray(frac) % 1.0
+    wf = toas.get_flag_values("weight", default=None, astype=float)
+    weights = (
+        np.array([1.0 if w is None else w for w in wf])
+        if any(w is not None for w in wf) else None
+    )
+    h = hm(phases, m=args.maxh) if weights is None else \
+        hmw(phases, weights, m=args.maxh)
+    sf = sf_hm(h)
+    print(f"Htest: {h:.2f} (sf {sf:.3g}, "
+          f"~{sig2sigma(max(sf, 1e-300)):.1f} sigma)")
+    if args.outphases:
+        np.save(args.outphases, phases)
+        print(f"wrote {args.outphases}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

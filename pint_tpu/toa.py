@@ -320,11 +320,30 @@ class TOAs:
         ticks = np.empty(n, dtype=np.int64)
         for i, t in enumerate(toa_list):
             obs = get_observatory(self.obs_names[i])
-            if obs.is_barycenter:
-                # already TDB at the SSB; TIME-command offsets still apply
+            scale = t.flags.get("timescale", "utc").lower()
+            if scale not in ("utc", "tt", "tdb"):
+                raise ValueError(
+                    f"TOA {i}: unsupported -timescale {scale!r} "
+                    "(utc|tt|tdb) — e.g. TIMESYS=TAI event files must "
+                    "be converted first; silently treating it as UTC "
+                    "would shift times by the ~37 s leap-second total"
+                )
+            if obs.is_barycenter or scale == "tdb":
+                # already in the TDB scale (barycentered data, or photon
+                # events with TIMESYS=TDB); TIME offsets still apply
                 ticks[i] = mjd_to_ticks_tdb(
                     t.mjd_day, t.frac_num, t.frac_den
                 ) + int(round(clock[i] * 2**32))
+            elif scale == "tt":
+                # photon-event TT (e.g. NICER MET): only the small
+                # TDB-TT harmonic term remains
+                from pint_tpu.time.scales import tdb_minus_tt_seconds
+
+                tt = mjd_to_ticks_tdb(t.mjd_day, t.frac_num, t.frac_den)
+                dtdb = float(tdb_minus_tt_seconds(tt / 2**32))
+                ticks[i] = tt + int(round(
+                    (dtdb + clock[i]) * 2**32
+                ))
             else:
                 ticks[i] = mjd_to_ticks_utc(
                     t.mjd_day, t.frac_num, t.frac_den,
