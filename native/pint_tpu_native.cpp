@@ -1,0 +1,148 @@
+// Native host-side ingest kernels for pint_tpu.
+//
+// The reference framework is pure Python on native dependencies
+// (SURVEY section 2.9); its TOA-ingest hot loop — per-line Python
+// parsing — costs 5.38 s of the 15.97 s bench_load_TOAs baseline
+// (reference profiling/README.txt:42-50).  This library provides the
+// TPU build's native runtime pieces:
+//
+//   1. tempo2 .tim line parsing to exact (day, frac_num, 10^k) integer
+//      triples + error/freq doubles (the exact-decimal split that
+//      pint_tpu/time/mjd.py does per line in Python),
+//   2. batched Chebyshev evaluation for SPK ephemeris segments
+//      (position + derivative), the jplephem-replacement hot loop.
+//
+// Exposed with a plain C ABI for ctypes (no pybind11 in the image).
+// Build: make -C native   (g++ -O3 -shared -fPIC)
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <cctype>
+#include <cmath>
+
+extern "C" {
+
+// Parse one tempo2 TOA data line: "name freq mjd err site -flags...".
+// Returns 0 on success.  Outputs: day, frac_num, frac_den (10^k,
+// k = fractional digits, capped at 18), err_us, freq_mhz; site copied
+// into site_out (max 15 chars + NUL); flag substring start offset into
+// flags_off (or -1).
+static int parse_tempo2_line(const char* line, int64_t* day,
+                             int64_t* frac_num, int64_t* frac_den,
+                             double* err_us, double* freq_mhz,
+                             char* site_out, int32_t* flags_off) {
+    const char* p = line;
+    auto skip_ws = [&]() { while (*p == ' ' || *p == '\t') ++p; };
+    auto skip_tok = [&]() { while (*p && *p != ' ' && *p != '\t') ++p; };
+
+    skip_ws();
+    if (!*p) return 1;
+    skip_tok();            // name (unused here; Python keeps it)
+    skip_ws();
+    char* end;
+    *freq_mhz = strtod(p, &end);
+    if (end == p) return 2;
+    p = end;
+    skip_ws();
+    // exact MJD split: integer part, then fractional digits as int64
+    int64_t d = 0;
+    bool any = false;
+    while (isdigit((unsigned char)*p)) {
+        d = d * 10 + (*p - '0');
+        ++p;
+        any = true;
+    }
+    if (!any) return 3;
+    int64_t num = 0, den = 1;
+    if (*p == '.') {
+        ++p;
+        int k = 0;
+        while (isdigit((unsigned char)*p)) {
+            if (k < 18) {
+                num = num * 10 + (*p - '0');
+                den *= 10;
+                ++k;
+            }
+            ++p;
+        }
+    }
+    *day = d;
+    *frac_num = num;
+    *frac_den = den;
+    skip_ws();
+    *err_us = strtod(p, &end);
+    if (end == p) return 4;
+    p = end;
+    skip_ws();
+    int i = 0;
+    while (*p && *p != ' ' && *p != '\t' && i < 15) site_out[i++] = *p++;
+    site_out[i] = '\0';
+    if (i == 0) return 5;
+    skip_ws();
+    *flags_off = *p ? (int32_t)(p - line) : -1;
+    return 0;
+}
+
+// Parse n lines (pointers + lengths are implied by NUL-terminated
+// strings packed back to back? No: we take an array of offsets into one
+// buffer).  lines: the whole file text; offs: n+1 offsets delimiting
+// each line.  Outputs are n-sized arrays; status[i] nonzero marks a
+// line the caller must handle in Python (commands, other formats).
+void parse_tim_lines(const char* text, const int64_t* offs, int64_t n,
+                     int64_t* day, int64_t* frac_num, int64_t* frac_den,
+                     double* err_us, double* freq_mhz, char* sites,
+                     int32_t* flags_off, int32_t* status) {
+    char buf[4096];
+    for (int64_t i = 0; i < n; ++i) {
+        int64_t len = offs[i + 1] - offs[i];
+        if (len <= 0 || len >= (int64_t)sizeof(buf)) {
+            status[i] = 100;
+            continue;
+        }
+        memcpy(buf, text + offs[i], (size_t)len);
+        buf[len] = '\0';
+        while (len > 0 && (buf[len - 1] == '\n' || buf[len - 1] == '\r'))
+            buf[--len] = '\0';
+        status[i] = parse_tempo2_line(
+            buf, day + i, frac_num + i, frac_den + i, err_us + i,
+            freq_mhz + i, sites + 16 * i, flags_off + i);
+    }
+}
+
+// Batched Chebyshev evaluation for SPK type-2/3 segments.
+// coeffs: (nrec, ncomp, ncoef) C-contiguous; mids/radii: (nrec,);
+// rec_idx: (nt,) record index per time; s: (nt,) scaled time in
+// [-1, 1]; out_pos: (nt, ncomp); out_vel: (nt, ncomp) (d/ds * 1/radius
+// applied, i.e. true time derivative).  Clenshaw recurrence.
+void spk_chebyshev_eval(const double* coeffs, const double* radii,
+                        int64_t nrec, int64_t ncomp, int64_t ncoef,
+                        const int64_t* rec_idx, const double* s,
+                        int64_t nt, double* out_pos, double* out_vel) {
+    for (int64_t t = 0; t < nt; ++t) {
+        const int64_t r = rec_idx[t];
+        const double x = s[t];
+        const double two_x = 2.0 * x;
+        const double inv_rad = 1.0 / radii[r];
+        for (int64_t c = 0; c < ncomp; ++c) {
+            const double* a = coeffs + (r * ncomp + c) * ncoef;
+            // Clenshaw for value and derivative simultaneously
+            double b0 = 0.0, b1 = 0.0;    // value recurrence
+            double d0 = 0.0, d1 = 0.0;    // derivative recurrence
+            for (int64_t k = ncoef - 1; k >= 1; --k) {
+                double b2 = b1;
+                b1 = b0;
+                b0 = two_x * b1 - b2 + a[k];
+                double d2 = d1;
+                d1 = d0;
+                d0 = two_x * d1 - d2 + 2.0 * b1;
+            }
+            out_pos[t * ncomp + c] = x * b0 - b1 + a[0];
+            out_vel[t * ncomp + c] = (b0 + x * d0 - d1) * inv_rad;
+        }
+    }
+}
+
+int pint_tpu_native_abi_version() { return 1; }
+
+}  // extern "C"

@@ -73,6 +73,19 @@ class _Segment:
         m = mid[idx]
         r = radius[idx]
         x = (et - m) / r
+        # native Clenshaw fast path (the jplephem-replacement hot loop;
+        # pure-numpy fallback below)
+        try:
+            from pint_tpu.native import spk_chebyshev_native
+
+            out = spk_chebyshev_native(coeffs, radius, idx, x)
+        except Exception:
+            out = None
+        if out is not None:
+            pos_all, dpos_all = out
+            if self.data_type == 2:
+                return pos_all, dpos_all
+            return pos_all[:, 0:3], pos_all[:, 3:6]
         c = coeffs[idx]  # (nt, ncomp, ncoef)
         ncoef = c.shape[-1]
         # Chebyshev via recurrence; also derivative polynomials

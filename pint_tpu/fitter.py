@@ -117,6 +117,34 @@ class Fitter:
     def print_summary(self):
         print(self.get_summary())
 
+    def ftest(self, unfreeze, maxiter=6):
+        """F-test for adding parameters (reference: Fitter.ftest,
+        fitter.py:619): refit a copy of the model with ``unfreeze``
+        additionally free; returns {'p': chance probability,
+        'chi2': new chi2, 'dof': new dof, 'fitter': the new fitter}.
+        Small p favors keeping the extra parameters."""
+        from pint_tpu.models import get_model
+        from pint_tpu.utils import FTest
+
+        chi2_1 = float(self.resids.chi2)
+        dof_1 = self.resids.dof
+        m2 = get_model(self.model.as_parfile())
+        params = m2.params
+        for name in unfreeze:
+            if name not in params:
+                raise KeyError(f"unknown parameter {name}")
+            params[name].frozen = False
+        f2 = type(self)(self.toas, m2)
+        f2.fit_toas(maxiter=maxiter)
+        chi2_2 = float(f2.resids.chi2)
+        dof_2 = f2.resids.dof
+        return {
+            "p": FTest(chi2_1, dof_1, chi2_2, dof_2),
+            "chi2": chi2_2,
+            "dof": dof_2,
+            "fitter": f2,
+        }
+
     # -- shared machinery -----------------------------------------------------
     def _retrace(self):
         """(Re)build the jitted step for the current free-param set.

@@ -1,0 +1,174 @@
+"""Levenberg-Marquardt and derivative-free Powell fitters.
+
+Counterparts of the reference LMFitter / PowellFitter (reference:
+src/pint/fitter.py:2642 LMFitter — explicit LM damping on the GLS
+normal equations; :1902 PowellFitter — scipy Powell on the chi^2
+closure ``minimize_func`` :794).
+
+TPU redesign: the damped normal-equation solve at a given lambda is one
+jitted function; the lambda-adaptation loop stays in Python (few
+iterations, negligible).  Powell drives the jitted chi^2 directly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.fitter import Fitter
+
+__all__ = ["LMFitter", "PowellFitter"]
+
+
+class LMFitter(Fitter):
+    """Levenberg-Marquardt on the whitened (noise-augmented) system.
+
+    lambda adaptation follows the reference LMFitter: accept a step
+    that lowers chi^2 and divide lambda by `down`; otherwise multiply
+    by `up` and retry (fitter.py:2642-2765).
+    """
+
+    lambda0 = 1e-3
+    up = 10.0
+    down = 10.0
+    max_tries = 12
+
+    def __init__(self, toas, model, residuals=None):
+        super().__init__(toas, model, residuals)
+        self._retrace()
+
+    def _retrace(self):
+        # base _retrace jits self._step, which LM replaces wholesale
+        self._traced_free = tuple(self.model.free_timing_params)
+        self._lm_jit = jax.jit(self._lm_solve)
+        self._chi2_vec_jit = jax.jit(self._chi2_of_vec)
+
+    def _chi2_of_vec(self, vec, base_values):
+        values = self._merged(base_values, vec)
+        return self.resids.chi2_fn(values)
+
+    def _lm_solve(self, vec, base_values, lam):
+        """One damped step at fixed lambda: (J^T W J + lam diag) d =
+        -J^T W r on the whitened residuals."""
+        resid_fn = self._resid_fn_of(base_values)
+        values = self._merged(base_values, vec)
+        sigma = self.resids.sigma_fn(values)
+        r = resid_fn(vec)
+        J = jax.jacfwd(resid_fn)(vec)
+        w = 1.0 / sigma
+        rw = r * w
+        Jw = J * w[:, None]
+        A = Jw.T @ Jw
+        g = Jw.T @ rw
+        damped = A + lam * jnp.diag(jnp.diag(A))
+        # eigh solve (TPU-safe; see linalg.gls_normal_solve)
+        norm = jnp.sqrt(jnp.diag(damped))
+        norm = jnp.where(norm == 0, 1.0, norm)
+        dn = damped / jnp.outer(norm, norm)
+        ww, Q = jnp.linalg.eigh(dn)
+        w_inv = jnp.where(ww > 1e-16 * jnp.max(ww), 1.0 / ww, 0.0)
+        dpar = -(Q @ (w_inv * (Q.T @ (g / norm)))) / norm
+        # covariance from the undamped system
+        An = A / jnp.outer(norm, norm)
+        wa, Qa = jnp.linalg.eigh(An)
+        wa_inv = jnp.where(wa > 1e-16 * jnp.max(wa), 1.0 / wa, 0.0)
+        cov = (Qa * wa_inv[None, :]) @ Qa.T / jnp.outer(norm, norm)
+        chi2 = jnp.sum(rw * rw)
+        return dpar, chi2, cov
+
+    def fit_toas(self, maxiter=20, min_chi2_decrease=1e-2):
+        if not self.model.free_timing_params:
+            raise ValueError("no free timing parameters to fit")
+        if tuple(self.model.free_timing_params) != getattr(
+                self, "_traced_free", ()):
+            self._retrace()
+        vec = jnp.array(
+            [self.model.values[k] for k in self._traced_free],
+            dtype=jnp.float64,
+        )
+        base = self.prepared._values_pytree()
+        lam = self.lambda0
+        cov = None
+        self.converged = False
+        for _ in range(maxiter):
+            dpar, chi2_old, cov = self._lm_jit(vec, base, lam)
+            accepted = False
+            for _try in range(self.max_tries):
+                chi2_new = float(
+                    self._chi2_vec_jit(vec + dpar, base)
+                )
+                if chi2_new < float(chi2_old):
+                    vec = vec + dpar
+                    lam = max(lam / self.down, 1e-12)
+                    accepted = True
+                    break
+                lam = lam * self.up
+                dpar, chi2_old, cov = self._lm_jit(vec, base, lam)
+            if not accepted:
+                self.converged = True
+                break
+            if float(chi2_old) - chi2_new < min_chi2_decrease:
+                self.converged = True
+                break
+        vec_np = np.asarray(vec)
+        errs = np.sqrt(np.clip(np.diag(np.asarray(cov)), 0, None))
+        params = self.model.params
+        for i, name in enumerate(self._traced_free):
+            self.model.values[name] = float(vec_np[i])
+            params[name].uncertainty = float(errs[i])
+        self.covariance = np.asarray(cov)
+        self._update_fit_meta()
+        return float(self.resids.chi2)
+
+
+class PowellFitter(Fitter):
+    """Derivative-free Powell minimization of chi^2 (reference
+    PowellFitter, fitter.py:1902) — the escape hatch when the problem
+    is too nonlinear for Gauss-Newton steps."""
+
+    def __init__(self, toas, model, residuals=None):
+        super().__init__(toas, model, residuals)
+        self._retrace()
+
+    def _retrace(self):
+        self._traced_free = tuple(self.model.free_timing_params)
+        self._chi2_jit = jax.jit(
+            lambda vec, base: self.resids.chi2_fn(
+                self._merged(base, vec)
+            )
+        )
+
+    def fit_toas(self, maxiter=2000):
+        from scipy.optimize import minimize
+
+        if not self.model.free_timing_params:
+            raise ValueError("no free timing parameters to fit")
+        if tuple(self.model.free_timing_params) != getattr(
+                self, "_traced_free", ()):
+            self._retrace()
+        base = self.prepared._values_pytree()
+        x0 = np.array(
+            [self.model.values[k] for k in self._traced_free],
+            dtype=np.float64,
+        )
+        # scale the search by par uncertainties when available (Powell
+        # is scale-sensitive; F1 ~ 1e-15 in raw units)
+        scales = np.array([
+            self.model.params[k].uncertainty or max(abs(v), 1e-12)
+            for k, v in zip(self._traced_free, x0)
+        ])
+
+        def fun(z):
+            return float(self._chi2_jit(jnp.asarray(x0 + z * scales),
+                                        base))
+
+        res = minimize(fun, np.zeros_like(x0), method="Powell",
+                       options={"maxiter": maxiter, "xtol": 1e-10})
+        vec = x0 + res.x * scales
+        for i, name in enumerate(self._traced_free):
+            self.model.values[name] = float(vec[i])
+        self.converged = bool(res.success)
+        self.covariance = None
+        self._update_fit_meta()
+        return float(self.resids.chi2)

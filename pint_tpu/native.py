@@ -1,0 +1,139 @@
+"""ctypes bridge to the native (C++) ingest kernels.
+
+The reference keeps its native surface in dependencies (numpy
+longdouble, erfa, LAPACK — SURVEY section 2.9); the TPU build's own
+native runtime lives in ``native/pint_tpu_native.cpp``: exact tempo2
+.tim line parsing and batched SPK Chebyshev evaluation.  Loaded lazily
+via ctypes (no pybind11 in the image); built on demand with make/g++;
+every caller has a pure-Python fallback, so the library is an
+accelerator, never a requirement.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import warnings
+
+import numpy as np
+
+__all__ = ["get_lib", "parse_tim_lines_native", "spk_chebyshev_native"]
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libpint_tpu_native.so")
+
+_lib = None
+_tried = False
+
+
+def _build():
+    try:
+        subprocess.run(
+            ["make", "-C", _NATIVE_DIR, "-s"],
+            check=True, capture_output=True, timeout=120,
+        )
+        return True
+    except Exception as e:  # g++/make missing or failing: fall back
+        warnings.warn(f"native ingest build failed ({e}); using the "
+                      "pure-Python path")
+        return False
+
+
+def get_lib():
+    """The loaded native library, building it on first use; None if
+    unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if not os.path.exists(_LIB_PATH) and os.path.isdir(_NATIVE_DIR):
+        if not _build():
+            return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    if lib.pint_tpu_native_abi_version() != 1:
+        warnings.warn("native library ABI mismatch; rebuilding")
+        if not _build():
+            return None
+        lib = ctypes.CDLL(_LIB_PATH)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    f64p = ctypes.POINTER(ctypes.c_double)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.parse_tim_lines.argtypes = [
+        ctypes.c_char_p, i64p, ctypes.c_int64, i64p, i64p, i64p,
+        f64p, f64p, ctypes.c_char_p, i32p, i32p,
+    ]
+    lib.parse_tim_lines.restype = None
+    lib.spk_chebyshev_eval.argtypes = [
+        f64p, f64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        i64p, f64p, ctypes.c_int64, f64p, f64p,
+    ]
+    lib.spk_chebyshev_eval.restype = None
+    _lib = lib
+    return _lib
+
+
+def _ptr(a, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def parse_tim_lines_native(text: bytes, offsets: np.ndarray):
+    """Parse tempo2 data lines in one native call.
+
+    text: the raw file bytes; offsets: (n+1,) int64 line-start offsets.
+    Returns dict of arrays + per-line status (nonzero = python
+    fallback needed), or None if the library is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(offsets) - 1
+    day = np.zeros(n, dtype=np.int64)
+    num = np.zeros(n, dtype=np.int64)
+    den = np.zeros(n, dtype=np.int64)
+    err = np.zeros(n, dtype=np.float64)
+    freq = np.zeros(n, dtype=np.float64)
+    sites = np.zeros(n, dtype="S16")
+    flags_off = np.zeros(n, dtype=np.int32)
+    status = np.zeros(n, dtype=np.int32)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    lib.parse_tim_lines(
+        text, _ptr(offsets, ctypes.c_int64), n,
+        _ptr(day, ctypes.c_int64), _ptr(num, ctypes.c_int64),
+        _ptr(den, ctypes.c_int64), _ptr(err, ctypes.c_double),
+        _ptr(freq, ctypes.c_double),
+        sites.ctypes.data_as(ctypes.c_char_p),
+        _ptr(flags_off, ctypes.c_int32), _ptr(status, ctypes.c_int32),
+    )
+    return {
+        "day": day, "frac_num": num, "frac_den": den, "err_us": err,
+        "freq_mhz": freq, "sites": sites, "flags_off": flags_off,
+        "status": status,
+    }
+
+
+def spk_chebyshev_native(coeffs, radii, rec_idx, s):
+    """(pos, d/dt) for stacked Chebyshev records; None if the library
+    is unavailable.  Shapes: coeffs (nrec, ncomp, ncoef) C-contiguous,
+    radii (nrec,), rec_idx (nt,) int64, s (nt,) scaled times."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    coeffs = np.ascontiguousarray(coeffs, dtype=np.float64)
+    radii = np.ascontiguousarray(radii, dtype=np.float64)
+    rec_idx = np.ascontiguousarray(rec_idx, dtype=np.int64)
+    s = np.ascontiguousarray(s, dtype=np.float64)
+    nrec, ncomp, ncoef = coeffs.shape
+    nt = s.shape[0]
+    pos = np.zeros((nt, ncomp), dtype=np.float64)
+    vel = np.zeros((nt, ncomp), dtype=np.float64)
+    lib.spk_chebyshev_eval(
+        _ptr(coeffs, ctypes.c_double), _ptr(radii, ctypes.c_double),
+        nrec, ncomp, ncoef, _ptr(rec_idx, ctypes.c_int64),
+        _ptr(s, ctypes.c_double), nt, _ptr(pos, ctypes.c_double),
+        _ptr(vel, ctypes.c_double),
+    )
+    return pos, vel

@@ -189,88 +189,148 @@ def read_tim(path, _depth=0):
     return toas
 
 
+def _parse_flags(s: str) -> dict:
+    """Parse the '-key value' tail of a tempo2 TOA line."""
+    parts = s.split()
+    flags = {}
+    i = 0
+    while i < len(parts):
+        tok = parts[i]
+        if tok.startswith("-") and not _is_number(tok):
+            key = tok.lstrip("-")
+            if i + 1 < len(parts):
+                flags[key] = parts[i + 1]
+                i += 2
+            else:
+                flags[key] = ""
+                i += 1
+        else:
+            i += 1
+    return flags
+
+
 def _read_tim_into(path, toas, state, depth):
     if depth > 5:
         raise ValueError("INCLUDE nesting too deep")
-    with open(path) as f:
-        for raw in f:
-            line = raw.rstrip("\n")
-            if not line.strip():
-                continue
-            fmt = _toa_line_format(line, state["fmt_tempo2"])
-            if fmt in ("Blank", "Comment"):
-                continue
-            if fmt == "Command":
-                parts = line.split()
-                cmd = parts[0].upper()
-                arg = parts[1] if len(parts) > 1 else None
-                if cmd == "FORMAT":
-                    state["fmt_tempo2"] = arg == "1"
-                elif cmd == "MODE":
-                    pass  # MODE 1 (errors in us) is the only supported mode
-                elif cmd == "TIME":
-                    state["time_offset_s"] += float(arg or 0.0)
-                elif cmd == "EFAC":
-                    state["efac"] = float(arg or 1.0)
-                elif cmd == "EQUAD":
-                    state["equad_us"] = float(arg or 0.0)
-                elif cmd == "EMAX":
-                    state["emax"] = float(arg)
-                elif cmd == "EMIN":
-                    state["emin"] = float(arg)
-                elif cmd == "FMAX":
-                    state["fmax"] = float(arg)
-                elif cmd == "FMIN":
-                    state["fmin"] = float(arg)
-                elif cmd in ("PHASE", "PHA1", "PHA2"):
-                    state["phase"] += float(arg or 0.0)
-                elif cmd == "JUMP":
-                    if state["jump"]:
-                        state["jump"] = 0
-                    else:
-                        state["njumps"] += 1
-                        state["jump"] = state["njumps"]
-                elif cmd == "SKIP":
-                    state["skip"] = True
-                elif cmd == "NOSKIP":
-                    state["skip"] = False
-                elif cmd == "INFO":
-                    state["info"] = arg
-                elif cmd == "INCLUDE":
-                    sub = os.path.join(os.path.dirname(str(path)), arg)
-                    _read_tim_into(sub, toas, state, depth + 1)
-                elif cmd == "END":
-                    return
-                continue
-            if state["skip"]:
-                continue
+    with open(path, "rb") as fb:
+        text = fb.read()
+    raw_lines = text.decode(errors="replace").split("\n")
+    # native batch parse of every line (tempo2 data lines come back
+    # with status 0; commands/other formats fall through to Python)
+    native = None
+    try:
+        from pint_tpu.native import parse_tim_lines_native
+
+        offs = np.zeros(len(raw_lines) + 1, dtype=np.int64)
+        pos = 0
+        for i, ln in enumerate(raw_lines):
+            offs[i] = pos
+            pos += len(ln.encode(errors="replace")) + 1
+        offs[-1] = pos
+        # pad so the final line's +1 newline slot is in bounds; the C
+        # side strips trailing newlines itself
+        native = parse_tim_lines_native(text + b"\n", offs)
+    except Exception:
+        native = None
+    for lineno, raw in enumerate(raw_lines):
+        line = raw.rstrip("\r")
+        if not line.strip():
+            continue
+        fmt = _toa_line_format(line, state["fmt_tempo2"])
+        if fmt in ("Blank", "Comment"):
+            continue
+        if fmt == "Command":
+            parts = line.split()
+            cmd = parts[0].upper()
+            arg = parts[1] if len(parts) > 1 else None
+            if cmd == "FORMAT":
+                state["fmt_tempo2"] = arg == "1"
+            elif cmd == "MODE":
+                pass  # MODE 1 (errors in us) is the only supported mode
+            elif cmd == "TIME":
+                state["time_offset_s"] += float(arg or 0.0)
+            elif cmd == "EFAC":
+                state["efac"] = float(arg or 1.0)
+            elif cmd == "EQUAD":
+                state["equad_us"] = float(arg or 0.0)
+            elif cmd == "EMAX":
+                state["emax"] = float(arg)
+            elif cmd == "EMIN":
+                state["emin"] = float(arg)
+            elif cmd == "FMAX":
+                state["fmax"] = float(arg)
+            elif cmd == "FMIN":
+                state["fmin"] = float(arg)
+            elif cmd in ("PHASE", "PHA1", "PHA2"):
+                state["phase"] += float(arg or 0.0)
+            elif cmd == "JUMP":
+                if state["jump"]:
+                    state["jump"] = 0
+                else:
+                    state["njumps"] += 1
+                    state["jump"] = state["njumps"]
+            elif cmd == "SKIP":
+                state["skip"] = True
+            elif cmd == "NOSKIP":
+                state["skip"] = False
+            elif cmd == "INFO":
+                state["info"] = arg
+            elif cmd == "INCLUDE":
+                sub = os.path.join(os.path.dirname(str(path)), arg)
+                _read_tim_into(sub, toas, state, depth + 1)
+            elif cmd == "END":
+                return
+            continue
+        if state["skip"]:
+            continue
+        if (
+            fmt == "Tempo2"
+            and native is not None
+            and native["status"][lineno] == 0
+        ):
+            # native fast path: exact integer MJD split done in C; only
+            # the name token and flag substring touch Python
+            fo = int(native["flags_off"][lineno])
+            toa = TOA(
+                int(native["day"][lineno]),
+                int(native["frac_num"][lineno]),
+                int(native["frac_den"][lineno]),
+                float(native["err_us"][lineno]),
+                float(native["freq_mhz"][lineno]),
+                native["sites"][lineno].decode(),
+                _parse_flags(line[fo:]) if fo >= 0 else {},
+                line.split(None, 1)[0],
+            )
+        else:
             try:
                 toa = _parse_line(line, fmt)
             except (ValueError, IndexError) as e:
-                warnings.warn(f"skipping unparseable TOA line {line!r}: {e}")
-                continue
-            if state["emax"] is not None and toa.error_us > state["emax"]:
-                continue
-            if state["emin"] is not None and toa.error_us < state["emin"]:
-                continue
-            if state["fmax"] is not None and toa.freq_mhz > state["fmax"]:
-                continue
-            if state["fmin"] is not None and toa.freq_mhz < state["fmin"]:
-                continue
-            toa.error_us = toa.error_us * state["efac"]
-            if state["equad_us"]:
-                toa.error_us = float(
-                    np.hypot(toa.error_us, state["equad_us"])
+                warnings.warn(
+                    f"skipping unparseable TOA line {line!r}: {e}"
                 )
-            if state["time_offset_s"]:
-                toa.flags["to"] = repr(state["time_offset_s"])
-            if state["phase"]:
-                toa.flags["padd"] = repr(state["phase"])
-            if state["jump"]:
-                toa.flags["tim_jump"] = str(state["jump"])
-            if state["info"]:
-                toa.flags.setdefault("info", state["info"])
-            toas.append(toa)
+                continue
+        if state["emax"] is not None and toa.error_us > state["emax"]:
+            continue
+        if state["emin"] is not None and toa.error_us < state["emin"]:
+            continue
+        if state["fmax"] is not None and toa.freq_mhz > state["fmax"]:
+            continue
+        if state["fmin"] is not None and toa.freq_mhz < state["fmin"]:
+            continue
+        toa.error_us = toa.error_us * state["efac"]
+        if state["equad_us"]:
+            toa.error_us = float(
+                np.hypot(toa.error_us, state["equad_us"])
+            )
+        if state["time_offset_s"]:
+            toa.flags["to"] = repr(state["time_offset_s"])
+        if state["phase"]:
+            toa.flags["padd"] = repr(state["phase"])
+        if state["jump"]:
+            toa.flags["tim_jump"] = str(state["jump"])
+        if state["info"]:
+            toa.flags.setdefault("info", state["info"])
+        toas.append(toa)
 
 
 # --- host container ---------------------------------------------------------

@@ -1,0 +1,79 @@
+"""Statistical helpers: F-test, information criteria, weighted means,
+Taylor-Horner evaluation.
+
+Counterpart of the reference's utils grab-bag statistics (reference:
+src/pint/utils.py:2123 ``FTest``, :2912 ``akaike_information_
+criterion``, :2967 ``bayesian_information_criterion``, :2002
+``weighted_mean``, :419 ``taylor_horner``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["FTest", "akaike_information_criterion",
+           "bayesian_information_criterion", "weighted_mean",
+           "taylor_horner", "taylor_horner_deriv"]
+
+
+def FTest(chi2_simple, dof_simple, chi2_complex, dof_complex):
+    """Probability that the chi^2 improvement of the more-complex model
+    is by chance (reference utils.FTest): small values favor keeping
+    the extra parameters.  Returns NaN if the complex model is not an
+    improvement in reduced terms."""
+    from scipy.stats import f as fdist
+
+    delta_chi2 = chi2_simple - chi2_complex
+    delta_dof = dof_simple - dof_complex
+    if delta_dof <= 0 or dof_complex <= 0:
+        raise ValueError("complex model must have fewer dof")
+    if delta_chi2 <= 0:
+        return 1.0
+    F = (delta_chi2 / delta_dof) / (chi2_complex / dof_complex)
+    return float(fdist.sf(F, delta_dof, dof_complex))
+
+
+def akaike_information_criterion(lnlike, n_params):
+    """AIC = 2k - 2 lnL (reference utils.py:2912)."""
+    return 2.0 * n_params - 2.0 * lnlike
+
+
+def bayesian_information_criterion(lnlike, n_params, n_data):
+    """BIC = k ln N - 2 lnL (reference utils.py:2967)."""
+    return n_params * np.log(n_data) - 2.0 * lnlike
+
+
+def weighted_mean(data, errors=None, sdev=False):
+    """(mean, error_on_mean[, weighted stdev]) with 1/sigma^2 weights
+    (reference utils.weighted_mean)."""
+    data = np.asarray(data, dtype=np.float64)
+    if errors is None:
+        w = np.ones_like(data)
+    else:
+        w = 1.0 / np.asarray(errors, dtype=np.float64) ** 2
+    wsum = w.sum()
+    mean = np.sum(data * w) / wsum
+    err = np.sqrt(1.0 / wsum)
+    if not sdev:
+        return mean, err
+    var = np.sum(w * (data - mean) ** 2) / wsum
+    return mean, err, np.sqrt(var)
+
+
+def taylor_horner(x, coeffs):
+    """sum_k c_k x^k / k! by Horner's rule (reference
+    utils.taylor_horner: taylor_horner(2.0, [10,3,4,12]) = 40.0)."""
+    out = 0.0
+    fact = float(len(coeffs))
+    for c in coeffs[::-1]:
+        out = out * x / fact + c
+        fact -= 1.0
+    return out
+
+
+def taylor_horner_deriv(x, coeffs, deriv_order=1):
+    """deriv_order-th derivative of taylor_horner (reference
+    utils.taylor_horner_deriv)."""
+    if deriv_order == 0:
+        return taylor_horner(x, coeffs)
+    return taylor_horner(x, list(coeffs[deriv_order:]))

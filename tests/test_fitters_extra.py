@@ -1,0 +1,151 @@
+"""LM and Powell fitters, F-test, stats helpers.
+
+Oracles: agreement with the WLS fitter on the same linearizable
+problem (same optimum, different algorithm), hand-checked Horner
+values, and the F-test's known behavior on nested models (reference:
+test_fitter_compare.py strategy).
+"""
+
+import numpy as np
+import pytest
+
+from pint_tpu.fitter import WLSFitter
+from pint_tpu.lmfitter import LMFitter, PowellFitter
+from pint_tpu.models import get_model
+from pint_tpu.residuals import Residuals
+from pint_tpu.simulation import make_fake_toas_uniform
+from pint_tpu.utils import (
+    FTest,
+    akaike_information_criterion,
+    taylor_horner,
+    taylor_horner_deriv,
+    weighted_mean,
+)
+
+PAR = """
+PSR FAKE
+RAJ 05:00:00 1
+DECJ 20:00:00 1
+F0 100.0 1
+F1 -1e-15 1
+PEPOCH 55000
+DM 10.0 1
+TZRMJD 55000
+TZRFRQ 1400
+TZRSITE gbt
+"""
+
+
+def _toas(m, n=120, seed=0):
+    return make_fake_toas_uniform(
+        54000, 56000, n, m,
+        freq_mhz=np.where(np.arange(n) % 2 == 0, 1400.0, 800.0),
+        obs="gbt", error_us=1.0, add_noise=True,
+        rng=np.random.default_rng(seed),
+    )
+
+
+class TestLM:
+    def test_matches_wls_optimum(self):
+        m1 = get_model(PAR)
+        toas = _toas(m1)
+        m1.values["DM"] += 1e-3
+        m1.values["F0"] += 1e-10
+        start = dict(m1.values)
+        f1 = WLSFitter(toas, m1)
+        chi2_wls = f1.fit_toas(maxiter=8)
+
+        m2 = get_model(PAR)
+        m2.values.update(start)
+        f2 = LMFitter(toas, m2)
+        chi2_lm = f2.fit_toas(maxiter=30)
+        assert chi2_lm == pytest.approx(chi2_wls, rel=1e-6)
+        for k in ("DM", "F0", "F1"):
+            assert m2.values[k] == pytest.approx(
+                m1.values[k], rel=1e-9, abs=1e-20
+            ), k
+            # uncertainties from the undamped normal matrix match WLS
+            assert m2.params[k].uncertainty == pytest.approx(
+                m1.params[k].uncertainty, rel=1e-3
+            )
+
+    def test_lm_survives_bad_start(self):
+        """A start where plain Gauss-Newton overshoots: LM's damping
+        still walks downhill."""
+        m = get_model(PAR)
+        toas = _toas(m, seed=3)
+        m.values["DM"] += 0.05  # large but unwrapped offset
+        f = LMFitter(toas, m)
+        chi2 = f.fit_toas(maxiter=40)
+        r = Residuals(toas, m)
+        assert r.reduced_chi2 < 2.0
+
+
+class TestPowell:
+    def test_reaches_wls_solution(self):
+        m1 = get_model(PAR)
+        toas = _toas(m1, seed=5)
+        m1.values["DM"] += 5e-4
+        start = dict(m1.values)
+        f1 = WLSFitter(toas, m1)
+        chi2_wls = f1.fit_toas(maxiter=8)
+        # Powell needs uncertainties for scaling: seed them from WLS
+        uncs = {k: m1.params[k].uncertainty for k in m1.free_params}
+
+        m2 = get_model(PAR)
+        m2.values.update(start)
+        for k, u in uncs.items():
+            m2.params[k].uncertainty = u
+        f2 = PowellFitter(toas, m2)
+        chi2_p = f2.fit_toas()
+        assert chi2_p < chi2_wls * 1.05
+
+
+class TestFtest:
+    def test_needed_param_significant(self):
+        """Data generated WITH F1; fitting without it then adding it
+        back must be strongly favored."""
+        # keep the F1-induced drift under half a turn over the span so
+        # the F1-less base fit is wrap-free (quadratic signal ~ 0.2
+        # turns >> the us-level errors: decisively significant)
+        m = get_model(PAR.replace("F1 -1e-15", "F1 -5e-17"))
+        toas = _toas(m, n=150, seed=7)
+        m.params["F1"].frozen = True
+        m.values["F1"] = 0.0
+        f = WLSFitter(toas, m)
+        f.fit_toas(maxiter=6)
+        out = f.ftest(["F1"])
+        assert out["p"] < 1e-6
+        assert out["dof"] == f.resids.dof - 1
+
+    def test_useless_param_not_significant(self):
+        m = get_model(PAR)
+        toas = _toas(m, n=150, seed=8)
+        m.values["DM"] += 1e-4
+        f = WLSFitter(toas, m)
+        f.fit_toas(maxiter=6)
+        out = f.ftest(["PMRA"])  # no PM injected
+        assert out["p"] > 1e-3
+
+
+class TestStatsHelpers:
+    def test_taylor_horner(self):
+        assert taylor_horner(2.0, [10.0, 3.0, 4.0, 12.0]) == \
+            pytest.approx(40.0)
+        assert taylor_horner_deriv(2.0, [10.0, 3.0, 4.0, 12.0]) == \
+            pytest.approx(3.0 + 4.0 * 2 + 12.0 * 4 / 2)
+
+    def test_weighted_mean(self):
+        m, e = weighted_mean([1.0, 3.0], errors=[1.0, 1.0])
+        assert m == pytest.approx(2.0)
+        assert e == pytest.approx(1.0 / np.sqrt(2.0))
+
+    def test_ftest_function(self):
+        # chi2 improvement exactly at expectation: p ~ 0.32
+        p = FTest(101.0, 100, 100.0, 99)
+        assert 0.2 < p < 0.5
+        with pytest.raises(ValueError):
+            FTest(100.0, 99, 100.0, 100)
+
+    def test_aic(self):
+        assert akaike_information_criterion(-10.0, 3) == 26.0

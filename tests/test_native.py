@@ -1,0 +1,132 @@
+"""Native (C++) ingest kernels vs their pure-Python oracles.
+
+Oracles: the Python parser itself (the native path must produce
+bit-identical TOA tuples) and numpy Chebyshev evaluation (identical to
+1 ulp-ish).  Skips cleanly when the toolchain is unavailable.
+"""
+
+import numpy as np
+import pytest
+
+from pint_tpu.native import (
+    get_lib,
+    parse_tim_lines_native,
+    spk_chebyshev_native,
+)
+
+pytestmark = pytest.mark.skipif(
+    get_lib() is None, reason="native library unavailable (no g++?)"
+)
+
+TIM = """FORMAT 1
+C a comment line
+fake.ff 1400.000000 55000.1234567890123 1.500 gbt -fe Rcvr_800 -be GUPPI
+fake.ff 800.000000 55010.9999999999999 2.000 ao -pn 12
+TIME 1.5
+fake.ff 1400.000000 55020.5 0.800 gbt
+"""
+
+
+class TestNativeTimParse:
+    def test_matches_python_parser(self, tmp_path):
+        from pint_tpu.toa import read_tim
+
+        p = tmp_path / "t.tim"
+        p.write_text(TIM)
+        toas = read_tim(str(p))
+        assert len(toas) == 3
+        t0 = toas[0]
+        assert (t0.mjd_day, t0.frac_num, t0.frac_den) == (
+            55000, 1234567890123, 10**13
+        )
+        assert t0.error_us == 1.5
+        assert t0.freq_mhz == 1400.0
+        assert t0.obs == "gbt"
+        assert t0.flags == {"fe": "Rcvr_800", "be": "GUPPI"}
+        t1 = toas[1]
+        assert (t1.mjd_day, t1.frac_num, t1.frac_den) == (
+            55010, 9999999999999, 10**13
+        )
+        assert t1.obs == "ao"
+        # TIME command applies only to the third TOA
+        assert "to" not in t0.flags
+        assert toas[2].flags["to"] == repr(1.5)
+
+    def test_raw_batch_api(self):
+        text = b"x 1400.0 55000.5 1.0 gbt -a b\n"
+        offs = np.array([0, len(text)], dtype=np.int64)
+        out = parse_tim_lines_native(text, offs)
+        assert out["status"][0] == 0
+        assert out["day"][0] == 55000
+        assert out["frac_num"][0] == 5
+        assert out["frac_den"][0] == 10
+        assert out["sites"][0] == b"gbt"
+
+    def test_command_line_rejected(self):
+        text = b"FORMAT 1\n"
+        offs = np.array([0, len(text)], dtype=np.int64)
+        out = parse_tim_lines_native(text, offs)
+        # 'FORMAT' parses as name, '1' as freq, then no MJD digits
+        assert out["status"][0] != 0
+
+
+class TestNativeChebyshev:
+    def test_matches_numpy(self):
+        rng = np.random.default_rng(0)
+        nrec, ncomp, ncoef, nt = 5, 3, 11, 200
+        coeffs = rng.standard_normal((nrec, ncomp, ncoef))
+        radii = rng.uniform(1e5, 1e6, nrec)
+        idx = rng.integers(0, nrec, nt)
+        s = rng.uniform(-1.0, 1.0, nt)
+        pos, vel = spk_chebyshev_native(coeffs, radii, idx, s)
+        # numpy oracle
+        T = np.zeros((ncoef, nt))
+        U = np.zeros((ncoef, nt))
+        T[0] = 1.0
+        T[1] = s
+        U[1] = 1.0
+        for k in range(2, ncoef):
+            T[k] = 2 * s * T[k - 1] - T[k - 2]
+            U[k] = 2 * s * U[k - 1] + 2 * T[k - 1] - U[k - 2]
+        c = coeffs[idx]
+        pos_ref = np.einsum("tck,kt->tc", c, T)
+        vel_ref = np.einsum("tck,kt->tc", c, U) / radii[idx][:, None]
+        np.testing.assert_allclose(pos, pos_ref, rtol=1e-12)
+        np.testing.assert_allclose(vel, vel_ref, rtol=1e-10, atol=1e-18)
+
+    def test_spk_eval_native_matches_python(self, tmp_path,
+                                            monkeypatch):
+        """A synthetic SPK segment evaluated through _Segment.eval with
+        and without the native fast path gives identical posvel."""
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "tephem", "tests/test_ephem.py"
+        )
+        tephem = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(tephem)
+
+        rng = np.random.default_rng(1)
+        ncoef = 8
+        rec = np.zeros((1, 2 + 3 * ncoef))
+        rec[0, 0] = 50000.0  # mid
+        rec[0, 1] = 50000.0  # radius
+        rec[0, 2:] = 0.1 * rng.standard_normal(3 * ncoef)
+        p = tmp_path / "n.bsp"
+        tephem._write_synthetic_spk(
+            str(p), [(10, 0, 2, 0.0, 100000.0, rec)]
+        )
+        from pint_tpu.ephem.spk import SPKEphemeris
+
+        eph = SPKEphemeris(str(p))
+        et = np.linspace(100.0, 99000.0, 64)
+        seg = eph.segments[0]
+        pos_n, vel_n = seg.eval(et)
+        # force the pure-python path
+        import pint_tpu.native as native_mod
+
+        monkeypatch.setattr(native_mod, "get_lib", lambda: None)
+        pos_p, vel_p = seg.eval(et)
+        np.testing.assert_allclose(pos_n, pos_p, rtol=1e-13)
+        np.testing.assert_allclose(vel_n, vel_p, rtol=1e-11,
+                                   atol=1e-20)
