@@ -103,14 +103,10 @@ def load_event_TOAs(path, mission, weights=None, extname="EVENTS",
     err_us = errors_us if errors_us is not None else \
         _MISSION_ERR_US.get(mission.lower(), 1.0)
 
-    # exact second splitting: photon times are f64 MET seconds; keep
-    # 1 ns resolution through the integer path
-    met = time[keep] + timezero
     toa_list = []
     widx = np.flatnonzero(keep)
-    for j, t in enumerate(met):
-        total_ns = int(round((reff * 86400.0 + t) * 1e9))
-        day_extra, ns = divmod(total_ns, 86400 * 10**9)
+    for j, t in enumerate(time[keep]):
+        day_extra, ns = met_to_day_ns(reff, float(t), timezero)
         flags = {"timescale": scale, "mission": mission}
         if weights is not None:
             flags["weight"] = repr(float(weights[widx[j]]))
@@ -120,6 +116,25 @@ def load_event_TOAs(path, mission, weights=None, extname="EVENTS",
         )
     return TOAs(toa_list, ephem=ephem, planets=planets,
                 include_clock=False)
+
+
+def met_to_day_ns(reff: float, t: float, timezero: float = 0.0):
+    """(extra_days, ns_of_day) for MET second ``t`` past MJDREF
+    fraction ``reff``, at sub-ns resolution.
+
+    Never forms a ~1e18 ns value in float64 (2^53 quantizes that to
+    ~128 ns): each addend is split into (integer, fractional) seconds
+    with divmod so every float that gets scaled to ns stays well inside
+    the exact-integer f64 range."""
+    ref_ns = int(round(reff * 86400.0 * 1e9))
+    tz_int, tz_frac = divmod(float(timezero), 1.0)
+    t_int, t_frac = divmod(float(t), 1.0)
+    total_ns = (
+        ref_ns
+        + (int(t_int) + int(tz_int)) * 10**9
+        + int(round((t_frac + tz_frac) * 1e9))
+    )
+    return divmod(total_ns, 86400 * 10**9)
 
 
 def load_fits_TOAs(path, mission="generic", **kw):

@@ -35,9 +35,7 @@ def _build():
             check=True, capture_output=True, timeout=120,
         )
         return True
-    except Exception as e:  # g++/make missing or failing: fall back
-        warnings.warn(f"native ingest build failed ({e}); using the "
-                      "pure-Python path")
+    except Exception:  # g++/make missing or failing
         return False
 
 
@@ -48,18 +46,27 @@ def get_lib():
     if _lib is not None or _tried:
         return _lib
     _tried = True
-    if not os.path.exists(_LIB_PATH) and os.path.isdir(_NATIVE_DIR):
-        if not _build():
+    if os.path.isdir(_NATIVE_DIR):
+        # Always run make: a no-op when up to date, and it rebuilds a
+        # stale .so when pint_tpu_native.cpp changed (the library is
+        # never committed to version control).
+        built = _build()
+        if not os.path.exists(_LIB_PATH):
+            if not built:
+                warnings.warn("native ingest build failed (no g++/make?); "
+                              "using the pure-Python path")
             return None
     try:
         lib = ctypes.CDLL(_LIB_PATH)
     except OSError:
         return None
     if lib.pint_tpu_native_abi_version() != 1:
-        warnings.warn("native library ABI mismatch; rebuilding")
-        if not _build():
-            return None
-        lib = ctypes.CDLL(_LIB_PATH)
+        # Do NOT re-dlopen here: dlopen on the same path returns the
+        # already-loaded stale handle, so a rebuilt library would never
+        # actually be picked up in-process.
+        warnings.warn("native library ABI mismatch; "
+                      "using the pure-Python path")
+        return None
     i64p = ctypes.POINTER(ctypes.c_int64)
     f64p = ctypes.POINTER(ctypes.c_double)
     i32p = ctypes.POINTER(ctypes.c_int32)

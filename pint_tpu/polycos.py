@@ -135,7 +135,13 @@ class Polycos:
                     f"{e.tmid_mjd:20.11f}{0.0:21.6f} {0.0:6.3f}"
                     f"{0.0:7.3f}\n"
                 )
-                rph = f"{e.rphase_int:d}.{int(round(e.rphase_frac * 1e9)):09d}"
+                # carry: frac >= 0.9999999995 rounds to 10^9, which must
+                # increment the integer part (a 10-digit fraction field
+                # would read back as 0.1 — a ~0.9-turn error)
+                rph_i, rph_f9 = e.rphase_int, int(round(e.rphase_frac * 1e9))
+                if rph_f9 >= 10**9:
+                    rph_i, rph_f9 = rph_i + 1, rph_f9 - 10**9
+                rph = f"{rph_i:d}.{rph_f9:09d}"
                 f.write(
                     f"{rph:<24s}{e.f0:18.12f} {e.obs_code:>4s}"
                     f"{e.mjdspan_min:10.1f}{e.ncoeff:5d}"

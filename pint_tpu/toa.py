@@ -221,12 +221,15 @@ def _read_tim_into(path, toas, state, depth):
     try:
         from pint_tpu.native import parse_tim_lines_native
 
-        offs = np.zeros(len(raw_lines) + 1, dtype=np.int64)
-        pos = 0
-        for i, ln in enumerate(raw_lines):
-            offs[i] = pos
-            pos += len(ln.encode(errors="replace")) + 1
-        offs[-1] = pos
+        # Offsets are computed on the raw *bytes* (never on re-encoded
+        # decoded text: a non-UTF-8 byte decodes to U+FFFD which would
+        # re-encode as 3 bytes and silently shift every later line).
+        nl = np.flatnonzero(np.frombuffer(text, np.uint8) == 0x0A)
+        offs = np.concatenate((
+            [0], nl + 1, [len(text) + 1]
+        )).astype(np.int64)
+        if len(offs) - 1 != len(raw_lines):  # paranoia: fall to Python
+            raise ValueError("line count mismatch")
         # pad so the final line's +1 newline slot is in bounds; the C
         # side strips trailing newlines itself
         native = parse_tim_lines_native(text + b"\n", offs)
