@@ -54,6 +54,14 @@ class Ephemeris:
 
     name = "abstract"
 
+    @property
+    def identity(self) -> str:
+        """Provenance string for cache invalidation: which concrete
+        dataset actually served the positions (a requested kernel name
+        can silently resolve to the builtin fallback — a prepared-TOA
+        cache must notice when that changes)."""
+        return type(self).__name__
+
     #: bodies every backend must serve
     BODIES = (
         "sun",
@@ -81,7 +89,9 @@ def get_ephemeris(name: str = "builtin") -> Ephemeris:
     key = (name or "builtin").lower()
     if key in _cache:
         return _cache[key]
-    if key in ("builtin", "analytic", "none", ""):
+    if key in ("builtin", "compiled", "none", ""):
+        eph = _builtin()
+    elif key == "analytic":
         from pint_tpu.ephem.analytic import AnalyticEphemeris
 
         eph = AnalyticEphemeris()
@@ -90,20 +100,46 @@ def get_ephemeris(name: str = "builtin") -> Ephemeris:
         if path is None:
             import warnings
 
-            warnings.warn(
-                f"ephemeris '{name}' not found locally; falling back to the "
-                "builtin analytic ephemeris (absolute accuracy ~1e-5 AU). "
-                "Place the kernel at $PINT_TPU_EPHEM_DIR/<name>.bsp for "
-                "JPL accuracy."
-            )
-            from pint_tpu.ephem.analytic import AnalyticEphemeris
-
             # do NOT cache the fallback under the kernel's name — a kernel
             # dropped into place later in the process must take effect
-            return AnalyticEphemeris()
+            eph = _builtin()
+            detail = (
+                "the builtin compiled ephemeris (see ACCURACY.md for "
+                "its measured error budget)"
+                if type(eph).__name__ == "CompiledEphemeris"
+                else "the builtin analytic mean-element ephemeris "
+                     "(~1e-5 AU, ~ms-level Roemer error)"
+            )
+            warnings.warn(
+                f"ephemeris '{name}' not found locally; falling back to "
+                f"{detail}. Place the kernel at "
+                "$PINT_TPU_EPHEM_DIR/<name>.bsp for JPL accuracy."
+            )
+            return eph
         from pint_tpu.ephem.spk import SPKEphemeris
 
         eph = SPKEphemeris(path)
+    _cache[key] = eph
+    return eph
+
+
+def _builtin() -> Ephemeris:
+    """The best available built-in: compiled Chebyshev (numerically
+    integrated perturbations) when the data file is present, else the
+    pure mean-element analytic fallback.  Memoized per resolved data
+    path (the 1.4 MB npz must not be re-read on every call) while still
+    honoring a mid-process $PINT_TPU_EPHEM_BUILTIN switch."""
+    from pint_tpu.ephem.compiled import CompiledEphemeris, data_path
+
+    key = ("__builtin__", data_path())
+    if key in _cache:
+        return _cache[key]
+    try:
+        eph = CompiledEphemeris()
+    except (FileNotFoundError, OSError):
+        from pint_tpu.ephem.analytic import AnalyticEphemeris
+
+        eph = AnalyticEphemeris()
     _cache[key] = eph
     return eph
 

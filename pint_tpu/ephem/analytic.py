@@ -145,13 +145,40 @@ def _moon_geocentric_au(T):
 
 
 _ECL = OBLIQUITY_J2000_ARCSEC / 3600.0 * _DEG
-_ECL_TO_EQ = np.array(
+_MEAN_EQ_J2000 = np.array(
     [
         [1.0, 0.0, 0.0],
         [0.0, np.cos(_ECL), -np.sin(_ECL)],
         [0.0, np.sin(_ECL), np.cos(_ECL)],
     ]
 )
+
+
+def _rot(axis, angle_rad):
+    c, s = np.cos(angle_rad), np.sin(angle_rad)
+    if axis == 1:
+        return np.array([[1, 0, 0], [0, c, s], [0, -s, c]], dtype=float)
+    if axis == 2:
+        return np.array([[c, 0, -s], [0, 1, 0], [s, 0, c]], dtype=float)
+    return np.array([[c, s, 0], [-s, c, 0], [0, 0, 1]], dtype=float)
+
+
+# IAU 2006 frame bias (xi0 = -0.0166170", eta0 = -0.0068192",
+# dalpha0 = -0.01460"): B = R1(-eta0) R2(xi0) R3(dalpha0) takes ICRS
+# vectors to the mean equator/equinox of J2000 (SOFA bp00 'rb'); we
+# need the opposite direction (mean-J2000 -> ICRS), i.e. B^T.  DE
+# ephemerides and tempo2 work in ICRS; without this ~17 mas rotation
+# Earth's position is off by up to ~8e-8 AU (~40 us of Roemer delay).
+_MAS = _DEG / 3600.0e3
+_FRAME_BIAS_ICRS_TO_J2000 = (
+    _rot(1, -(-6.8192 * _MAS))
+    @ _rot(2, (-16.6170 * _MAS))
+    @ _rot(3, (-14.60 * _MAS))
+)
+
+#: ecliptic-J2000 -> ICRS (equatorial) rotation used by every built-in
+#: ephemeris backend
+_ECL_TO_EQ = _FRAME_BIAS_ICRS_TO_J2000.T @ _MEAN_EQ_J2000
 
 
 class AnalyticEphemeris(Ephemeris):

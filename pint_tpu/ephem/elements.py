@@ -1,0 +1,122 @@
+"""Orbital-element machinery for the built-in ephemeris.
+
+Equinoctial elements (a, h, k, p, q, lam) are used everywhere instead of
+classical Keplerian sets: they are nonsingular at e=0 and i=0 (the EMB
+orbit has i ~ 5e-7 rad in ecliptic J2000, where the classical node is
+undefined).  Definitions (Broucke & Cefola 1972, standard):
+
+    h = e sin(varpi)        k = e cos(varpi)
+    p = tan(i/2) sin(Om)    q = tan(i/2) cos(Om)
+    lam = mean longitude L = M + varpi
+
+All functions are vectorized over leading axes; units are AU / days /
+radians; mu is GM in AU^3/day^2.
+
+Replaces (TPU-natively, no astropy) the role jplephem+astropy play in the
+reference's solar_system_ephemerides.py; the generator in
+tools/build_ephemeris.py uses these to splice numerically integrated
+perturbations onto published mean elements.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "GM_SUN_AU3_DAY2", "C_AU_DAY",
+    "equinoctial_to_posvel", "posvel_to_equinoctial",
+    "classical_to_equinoctial", "wrap_angle_diff",
+]
+
+# Gaussian gravitational constant squared: GM_sun in AU^3/day^2
+GM_SUN_AU3_DAY2 = 0.01720209894846**2
+# speed of light [AU/day]
+C_AU_DAY = 173.144632674
+
+_TWOPI = 2.0 * np.pi
+
+
+def classical_to_equinoctial(a, e, i, L, varpi, Om):
+    """(a,e,i,L,varpi,Om) [rad] -> (a,h,k,p,q,lam)."""
+    h = e * np.sin(varpi)
+    k = e * np.cos(varpi)
+    t2 = np.tan(i / 2.0)
+    p = t2 * np.sin(Om)
+    q = t2 * np.cos(Om)
+    return np.stack(np.broadcast_arrays(a, h, k, p, q, L), axis=-1)
+
+
+def _solve_gen_kepler(lam, h, k, iters=12):
+    """Generalized Kepler: lam = F + h cos F - k sin F; solve for F."""
+    F = lam
+    for _ in range(iters):
+        f = F + h * np.cos(F) - k * np.sin(F) - lam
+        fp = 1.0 - h * np.sin(F) - k * np.cos(F)
+        F = F - f / fp
+    return F
+
+
+def _equinoctial_frame(p, q):
+    """Unit vectors f,g of the equinoctial frame (orbit plane)."""
+    s2 = 1.0 + p * p + q * q
+    f = np.stack([(1 - p * p + q * q), 2 * p * q, -2 * p], axis=-1) / s2[..., None]
+    g = np.stack([2 * p * q, (1 + p * p - q * q), 2 * q], axis=-1) / s2[..., None]
+    return f, g
+
+
+def equinoctial_to_posvel(el, mu=GM_SUN_AU3_DAY2):
+    """el (...,6) = (a,h,k,p,q,lam) -> (pos (...,3), vel (...,3))."""
+    a, h, k, p, q, lam = np.moveaxis(np.asarray(el, np.float64), -1, 0)
+    F = _solve_gen_kepler(lam, h, k)
+    b = 1.0 / (1.0 + np.sqrt(1.0 - h * h - k * k))
+    sF, cF = np.sin(F), np.cos(F)
+    X = a * ((1.0 - h * h * b) * cF + h * k * b * sF - k)
+    Y = a * ((1.0 - k * k * b) * sF + h * k * b * cF - h)
+    n = np.sqrt(mu / a**3)
+    r = a * (1.0 - h * sF - k * cF)
+    dX = a * n * a / r * (-(1.0 - h * h * b) * sF + h * k * b * cF)
+    dY = a * n * a / r * ((1.0 - k * k * b) * cF - h * k * b * sF)
+    f, g = _equinoctial_frame(p, q)
+    pos = X[..., None] * f + Y[..., None] * g
+    vel = dX[..., None] * f + dY[..., None] * g
+    return pos, vel
+
+
+def posvel_to_equinoctial(pos, vel, mu=GM_SUN_AU3_DAY2):
+    """Cartesian (...,3),( ...,3) -> equinoctial (...,6). Inverse of
+    :func:`equinoctial_to_posvel` (round-trip tested to ~1e-13)."""
+    pos = np.asarray(pos, np.float64)
+    vel = np.asarray(vel, np.float64)
+    r = np.linalg.norm(pos, axis=-1)
+    v2 = np.sum(vel * vel, axis=-1)
+    a = 1.0 / (2.0 / r - v2 / mu)
+    W = np.cross(pos, vel)
+    Wn = W / np.linalg.norm(W, axis=-1, keepdims=True)
+    wx, wy, wz = np.moveaxis(Wn, -1, 0)
+    denom = 1.0 + wz
+    p = wx / denom
+    q = -wy / denom
+    # eccentricity vector
+    evec = np.cross(vel, W) / mu[..., None] if np.ndim(mu) else \
+        np.cross(vel, W) / mu
+    evec = evec - pos / r[..., None]
+    f, g = _equinoctial_frame(p, q)
+    k = np.sum(evec * f, axis=-1)
+    h = np.sum(evec * g, axis=-1)
+    # eccentric longitude F from position components in the f,g frame
+    X = np.sum(pos * f, axis=-1)
+    Y = np.sum(pos * g, axis=-1)
+    b = 1.0 / (1.0 + np.sqrt(1.0 - h * h - k * k))
+    # invert the linear (X,Y) <-> (cF,sF) relations
+    cF = k + ((1.0 - k * k * b) * X - h * k * b * Y) / (
+        a * np.sqrt(1.0 - h * h - k * k))
+    sF = h + ((1.0 - h * h * b) * Y - h * k * b * X) / (
+        a * np.sqrt(1.0 - h * h - k * k))
+    F = np.arctan2(sF, cF)
+    lam = F + h * np.cos(F) - k * np.sin(F)
+    return np.stack([a, h, k, p, q, lam], axis=-1)
+
+
+def wrap_angle_diff(x):
+    """Wrap to (-pi, pi]."""
+    return (np.asarray(x) + np.pi) % _TWOPI - np.pi

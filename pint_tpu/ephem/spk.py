@@ -12,6 +12,8 @@ since J2000 — exactly the framework's native time coordinate.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 _KM_PER_LS = 299792.458
@@ -111,8 +113,14 @@ class _Segment:
 class SPKEphemeris:
     """Reader/evaluator for a JPL SPK kernel; posvel in light-seconds."""
 
+    @property
+    def identity(self) -> str:
+        return self._identity
+
     def __init__(self, path):
         self.name = path
+        st = os.stat(path)
+        self._identity = f"spk:{path}:{st.st_mtime_ns}:{st.st_size}"
         with open(path, "rb") as f:
             data = f.read()
         locfmt = data[88:96]

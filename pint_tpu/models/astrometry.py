@@ -96,14 +96,36 @@ class AstrometryEquatorial(AstrometryBase):
         return _unit_vector(ra_t, dec_t)
 
 
-_ECL_RAD = np.deg2rad(OBLIQUITY_J2000_ARCSEC / 3600.0)
-_EQ_FROM_ECL = jnp.asarray(
-    [
-        [1.0, 0.0, 0.0],
-        [0.0, np.cos(_ECL_RAD), -np.sin(_ECL_RAD)],
-        [0.0, np.sin(_ECL_RAD), np.cos(_ECL_RAD)],
-    ]
-)
+#: Obliquity of the ecliptic, arcseconds, by par-file ``ECL`` label.
+#: Published IAU/IERS constants (same set the reference ships as
+#: runtime data ecliptic.dat and resolves in pulsar_ecliptic.py):
+#: IAU1976 from Lieske (1977); IERS1992/DE403 from IERS TN 21 p.19;
+#: IERS2003 from IERS TN 32 p.19 (tempo2's default); IERS2010/IAU2005
+#: from IERS TN 36 p.19 / IAU 2006 Resolution 1.
+OBLIQUITY_ARCSEC = {
+    "IAU1976": 84381.448,
+    "IERS1992": 84381.412,
+    "DE403": 84381.412,
+    "IERS2003": 84381.4059,
+    "IERS2010": 84381.406,
+    "IAU2005": 84381.406,
+    "DEFAULT": OBLIQUITY_J2000_ARCSEC,
+}
+
+
+def eq_from_ecl_matrix(obliquity_arcsec: float) -> np.ndarray:
+    """Rotation matrix taking ecliptic-J2000 vectors to equatorial."""
+    ecl = np.deg2rad(obliquity_arcsec / 3600.0)
+    return np.array(
+        [
+            [1.0, 0.0, 0.0],
+            [0.0, np.cos(ecl), -np.sin(ecl)],
+            [0.0, np.sin(ecl), np.cos(ecl)],
+        ]
+    )
+
+
+_EQ_FROM_ECL = jnp.asarray(eq_from_ecl_matrix(OBLIQUITY_J2000_ARCSEC))
 
 
 class AstrometryEcliptic(AstrometryBase):
@@ -127,6 +149,28 @@ class AstrometryEcliptic(AstrometryBase):
         self.add_param(Param("PX", units="mas", description="Parallax"))
         self.add_param(Param("POSEPOCH", kind="mjd", fittable=False,
                              description="Epoch of position"))
+        #: par ``ECL`` obliquity selection (reference pulsar_ecliptic.py
+        #: + ecliptic.dat); resolved to a static rotation matrix
+        self.ecl_name = "IERS2010"
+
+    def consume_parfile(self, pardict, model):
+        consumed = set()
+        if "ECL" in pardict and pardict["ECL"][0]:
+            name = pardict["ECL"][0][0].upper()
+            if name not in OBLIQUITY_ARCSEC:
+                raise ValueError(
+                    f"unknown ECL obliquity {name!r}; known: "
+                    f"{sorted(OBLIQUITY_ARCSEC)}"
+                )
+            self.ecl_name = name
+            model.meta["ECL"] = name
+            consumed.add("ECL")
+        return consumed
+
+    @property
+    def eq_from_ecl(self):
+        return jnp.asarray(
+            eq_from_ecl_matrix(OBLIQUITY_ARCSEC[self.ecl_name]))
 
     def build_params(self, pardict):
         pass
@@ -144,7 +188,7 @@ class AstrometryEcliptic(AstrometryBase):
         )
         lat_t = lat + values["PMELAT"] * _MASYR * dt
         necl = _unit_vector(lon_t, lat_t)
-        return necl @ _EQ_FROM_ECL.T
+        return necl @ self.eq_from_ecl.T
 
 
 def psr_dir_static(model) -> np.ndarray:
@@ -169,7 +213,11 @@ def psr_dir_static(model) -> np.ndarray:
             [np.cos(lat) * np.cos(lon), np.cos(lat) * np.sin(lon),
              np.sin(lat)]
         )
-        return np.asarray(_EQ_FROM_ECL) @ necl
+        if model.has_component("AstrometryEcliptic"):
+            mat = np.asarray(model.component("AstrometryEcliptic").eq_from_ecl)
+        else:
+            mat = np.asarray(_EQ_FROM_ECL)
+        return mat @ necl
     raise ValueError("model has no astrometry (RAJ/DECJ or ELONG/ELAT)")
 
 

@@ -276,9 +276,8 @@ class BinaryDDK(BinaryDD):
         if astrom is None:
             raise ValueError("DDK requires an astrometry component")
         if self.ecliptic:
-            from pint_tpu.models.astrometry import _EQ_FROM_ECL
-
-            obs = obs @ np.asarray(_EQ_FROM_ECL)  # ICRS -> ecliptic
+            # ICRS -> ecliptic with the model's ECL obliquity selection
+            obs = obs @ np.asarray(astrom.eq_from_ecl)
             lon = model.values["ELONG"]
             lat = model.values["ELAT"]
             self._pm_names = ("PMELONG", "PMELAT")

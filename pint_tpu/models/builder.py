@@ -66,7 +66,7 @@ _META_KEYS = {
     "T2CMETHOD", "DILATEFREQ", "NTOA", "TRES",
     "CHI2", "CHI2R", "TZRSITE", "INFO", "BINARY", "START", "FINISH",
     "DMDATA", "MODE", "EPHVER", "NITS",
-    "IBOOT", "DMX",
+    "IBOOT", "DMX", "TRACK",
 }
 
 #: parameter-name aliases -> canonical (reference: each Param's aliases +

@@ -9,6 +9,7 @@ in time units (GM/c^3).
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
 from pint_tpu import (
     AU_LS,
@@ -56,27 +57,38 @@ class SolarSystemShapiro(DelayComponent):
         # sun only.  A python bool in ctx would be stacked/traced by the
         # PTA batch path.
         on = bool(model.values.get("PLANET_SHAPIRO", 0.0)) and toas.planets
-        return {"planet_idx": tuple(range(len(_PLANET_T))) if on else ()}
+        ctx = {"planet_idx": tuple(range(len(_PLANET_T))) if on else ()}
+        # honor the model's ECL obliquity selection for ecliptic
+        # coordinates.  ALWAYS present (default matrix for equatorial
+        # models) so the PTA batch path stacks a uniform ctx structure
+        # across mixed ecliptic/equatorial pulsar sets.
+        from pint_tpu.models.astrometry import _EQ_FROM_ECL
+
+        if model.has_component("AstrometryEcliptic"):
+            ctx["eq_from_ecl"] = np.asarray(
+                model.component("AstrometryEcliptic").eq_from_ecl)
+        else:
+            ctx["eq_from_ecl"] = np.asarray(_EQ_FROM_ECL)
+        return ctx
 
     def delay(self, values, batch, ctx, delay_accum):
         # psr direction from the astrometry component's parameters: the
         # chain gives us only accumulated delay, so recompute the unit
         # vector from RAJ/DECJ (or ELONG/ELAT) present in values.
-        n = _psr_dir_from_values(values)
+        n = _psr_dir_from_values(values, ctx.get("eq_from_ecl"))
         d = _obj_shapiro(batch.obs_sun_pos, n, T_SUN_S)
         for i in ctx["planet_idx"]:
             d = d + _obj_shapiro(batch.planet_pos[i], n, _PLANET_T[i])
         return d
 
 
-def _psr_dir_from_values(values):
+def _psr_dir_from_values(values, eq_from_ecl=None):
     """Pulsar unit vector (no PM propagation — Shapiro is insensitive at
     the sub-ns level to mas-scale position changes)."""
-    import numpy as np
-
     from pint_tpu.models.astrometry import _EQ_FROM_ECL, _unit_vector
 
     if "RAJ" in values:
         return _unit_vector(values["RAJ"], values["DECJ"])
     necl = _unit_vector(values["ELONG"], values["ELAT"])
-    return necl @ _EQ_FROM_ECL.T
+    mat = _EQ_FROM_ECL if eq_from_ecl is None else jnp.asarray(eq_from_ecl)
+    return necl @ mat.T

@@ -66,6 +66,12 @@ def _pad_batch(batch, n_max):
     )
 
 
+#: ctx keys holding fixed-shape per-model constants (never TOA-axis
+#: arrays); they must not be padded even when a dimension happens to
+#: equal the TOA count (e.g. a (3,3) rotation matrix with n=3 TOAs)
+_STATIC_SHAPE_CTX_KEYS = {"eq_from_ecl"}
+
+
 def _pad_ctx(ctx_map, n, n_max):
     """Pad prepare()-time arrays whose trailing/leading axis is the TOA
     axis.  Non-array entries (static python values) pass through."""
@@ -73,7 +79,7 @@ def _pad_ctx(ctx_map, n, n_max):
     for comp, ctx in ctx_map.items():
         c = {}
         for k, v in ctx.items():
-            if not hasattr(v, "shape"):
+            if not hasattr(v, "shape") or k in _STATIC_SHAPE_CTX_KEYS:
                 c[k] = v
                 continue
             v = jnp.asarray(v)

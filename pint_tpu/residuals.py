@@ -42,7 +42,8 @@ def weighted_mean_phase(frac, weights):
 class Residuals:
     """Residuals bound to (toas, model); evaluation is jit-compiled."""
 
-    def __init__(self, toas, model, subtract_mean=None, track_mode="nearest"):
+    def __init__(self, toas, model, subtract_mean=None, track_mode=None,
+                 use_weighted_mean=True):
         self.toas = toas
         if isinstance(model, TimingModel):
             self.prepared = model.prepare(toas)
@@ -52,18 +53,67 @@ class Residuals:
         if subtract_mean is None:
             subtract_mean = not self.model.has_component("PhaseOffset")
         self.subtract_mean = subtract_mean
-        if track_mode not in ("nearest", "pulse_number"):
+        self.use_weighted_mean = use_weighted_mean
+        # track-mode resolution (reference residuals.py:133-149):
+        # explicit arg > par TRACK -2/0 > presence of complete -pn flags
+        pn = toas.get_pulse_numbers() if hasattr(
+            toas, "get_pulse_numbers") else None
+        if track_mode is None or track_mode == "auto":
+            track = self.model.meta.get("TRACK", "")
+            if track == "-2":
+                track_mode = "use_pulse_numbers"
+            elif track == "0":
+                track_mode = "nearest"
+            elif pn is not None and not np.any(np.isnan(pn)):
+                track_mode = "use_pulse_numbers"
+            else:
+                track_mode = "nearest"
+        if track_mode == "pulse_number":  # accept both spellings
+            track_mode = "use_pulse_numbers"
+        if track_mode not in ("nearest", "use_pulse_numbers"):
             raise ValueError(f"unknown track_mode {track_mode!r}")
-        if track_mode == "pulse_number":
-            raise NotImplementedError(
-                "pulse_number tracking lands with the pulse-number column "
-                "(-pn flags / track_pulse_numbers) milestone"
-            )
+        if track_mode == "use_pulse_numbers":
+            if pn is None:
+                raise ValueError(
+                    "track_mode requires pulse numbers but the TOAs "
+                    "carry no -pn flags (use toas.compute_pulse_numbers)"
+                )
+            if np.any(np.isnan(pn)):
+                raise ValueError("Pulse numbers are missing on some TOAs")
+            self._pulse_numbers = jnp.asarray(pn, dtype=jnp.int64)
+        else:
+            self._pulse_numbers = None
+        dpn = (toas.get_delta_pulse_numbers() if hasattr(
+            toas, "get_delta_pulse_numbers") else np.zeros(0))
+        self._delta_pn = (jnp.asarray(dpn) if np.any(dpn != 0.0)
+                          else None)
         self.track_mode = track_mode
-        self._phase_resids_jit = jax.jit(self.phase_resids_fn)
-        self._time_resids_jit = jax.jit(self.time_resids_fn)
-        self._chi2_jit = jax.jit(self.chi2_fn)
-        self._lnlike_jit = jax.jit(self.lnlikelihood_fn)
+        # jit wrappers are built lazily on first use: a 14-component GLS
+        # model costs tens of seconds of XLA compile per function on
+        # CPU, and most callers touch only one of the four
+        self._jit_cache: dict = {}
+
+    def _jitted(self, name, fn):
+        got = self._jit_cache.get(name)
+        if got is None:
+            got = self._jit_cache[name] = jax.jit(fn)
+        return got
+
+    @property
+    def _phase_resids_jit(self):
+        return self._jitted("phase", self.phase_resids_fn)
+
+    @property
+    def _time_resids_jit(self):
+        return self._jitted("time", self.time_resids_fn)
+
+    @property
+    def _chi2_jit(self):
+        return self._jitted("chi2", self.chi2_fn)
+
+    @property
+    def _lnlike_jit(self):
+        return self._jitted("lnlike", self.lnlikelihood_fn)
 
     # -- pure functions (values pytree -> arrays), jit-safe ------------------
     def sigma_fn(self, values):
@@ -71,11 +121,27 @@ class Residuals:
         return self.prepared.scaled_sigma_fn(values)
 
     def phase_resids_fn(self, values):
-        _, frac = self.prepared._phase_raw(values)
-        resid = frac
+        n, frac = self.prepared._phase_raw(values)
+        if self._pulse_numbers is not None:
+            # TRACK -2 semantics (reference residuals.py:368-392):
+            # residual = absolute model phase - assigned pulse number;
+            # integer arithmetic first so 4e11-turn counts stay exact
+            resid = (n - self._pulse_numbers).astype(jnp.float64) + frac
+            if self._delta_pn is not None:
+                resid = resid + self._delta_pn
+        else:
+            resid = frac
+            if self._delta_pn is not None:
+                # PHASE commands shift the phase before the nearest-
+                # integer assignment (reference residuals.py:394-406)
+                resid = resid + self._delta_pn
+                resid = resid - jnp.round(resid)
         if self.subtract_mean:
-            w = 1.0 / self.sigma_fn(values) ** 2
-            resid = resid - weighted_mean_phase(resid, w)
+            if self.use_weighted_mean:
+                w = 1.0 / self.sigma_fn(values) ** 2
+                resid = resid - weighted_mean_phase(resid, w)
+            else:
+                resid = resid - jnp.mean(resid)
         return resid
 
     def time_resids_fn(self, values):

@@ -22,7 +22,11 @@ def zero_residuals(toas: TOAs, model, iterations=2):
     """Shift TOA ticks so model residuals vanish (phase inversion by
     Newton iteration; 2 passes reach sub-ns like the reference)."""
     for _ in range(iterations):
-        r = Residuals(toas, model, subtract_mean=False)
+        # track_mode pinned: fake TOAs never carry -pn flags, and a
+        # TRACK -2 par must not make simulation crash (the reference
+        # pins nearest in its simulation path too)
+        r = Residuals(toas, model, subtract_mean=False,
+                      track_mode="nearest")
         resid_sec = r.time_resids
         toas.ticks = toas.ticks - np.round(resid_sec * 2**32).astype(np.int64)
         toas._compute_posvels()
@@ -116,7 +120,7 @@ def calculate_random_models(fitter, toas, n_models=100, rng=None,
     draws = center + rng.standard_normal((n_models, len(names))) @ L.T
 
     prepared = model.prepare(toas)
-    r = Residuals(toas, prepared)
+    r = Residuals(toas, prepared, track_mode="nearest")
     base = prepared._values_pytree()
 
     def resid_of(vec):

@@ -102,12 +102,37 @@ _FB_TERMS = np.array(
 )
 
 
+_COMPILED_TDBTT: dict = {}  # data-file path -> CompiledEphemeris | None
+
+
 def tdb_minus_tt_seconds(tt_sec_since_j2000):
     """TDB-TT [s] for float64 TT seconds since MJD 51544.5 (J2000) TT.
 
-    Truncated harmonic series, ~2 us absolute accuracy (see module doc).
-    Computed in float64 — the result is < 2 ms, so f64 is ample.
+    Prefers the compiled numerical time ephemeris (integral of the
+    geocentric time-dilation rate, ~tens of ns vs tempo2 — see
+    tools/build_ephemeris.py) when its table covers the epoch; falls
+    back to the truncated harmonic series (~2 us) otherwise.  Keyed by
+    the resolved data path so $PINT_TPU_EPHEM_BUILTIN switches datasets
+    mid-process (the calibration tooling relies on that).
     """
+    try:
+        from pint_tpu.ephem.compiled import CompiledEphemeris, data_path
+
+        key = data_path()
+        if key not in _COMPILED_TDBTT:
+            try:
+                eph = CompiledEphemeris(key)
+                _COMPILED_TDBTT[key] = eph if "tdbtt" in eph._seg else None
+            except Exception:
+                _COMPILED_TDBTT[key] = None
+        table = _COMPILED_TDBTT[key]
+    except Exception:
+        table = None
+    if table is not None:
+        try:
+            return table.tdb_minus_tt(tt_sec_since_j2000)
+        except ValueError:
+            pass  # epoch outside the compiled span: harmonic fallback
     t_millennia = np.asarray(tt_sec_since_j2000, dtype=np.float64) / (
         86400.0 * 365250.0
     )

@@ -443,6 +443,36 @@ class TOAs:
     def get_flag_values(self, flag, default=None, astype=str):
         return [astype(f[flag]) if flag in f else default for f in self.flags]
 
+    # -- pulse numbers (reference: toa.py:1709 get_pulse_numbers,
+    # :1984 compute_pulse_numbers, delta_pulse_number column :1272) ----------
+    def get_pulse_numbers(self):
+        """Per-TOA absolute pulse numbers from ``-pn`` flags (float64,
+        NaN where absent), or None when no TOA carries one."""
+        if not any("pn" in f for f in self.flags):
+            return None
+        return np.array(
+            [float(f["pn"]) if "pn" in f else np.nan for f in self.flags]
+        )
+
+    def get_delta_pulse_numbers(self):
+        """Accumulated PHASE-command / ``-padd`` phase offsets (turns),
+        zero where absent."""
+        return np.array([float(f.get("padd", 0.0)) for f in self.flags])
+
+    def compute_pulse_numbers(self, model):
+        """Assign ``-pn`` flags = nearest-integer absolute pulse number
+        under ``model`` (reference toa.py:1984): the anchor for
+        TRACK -2 style phase-connected fitting."""
+        from pint_tpu.residuals import Residuals
+
+        r = Residuals(self, model, subtract_mean=False,
+                      track_mode="nearest")
+        n, frac = r.prepared._phase_jit(r._values())
+        pn = np.asarray(n, dtype=np.int64)
+        for f, p in zip(self.flags, pn):
+            f["pn"] = repr(int(p))
+        return pn
+
     def wideband_dm_data(self):
         """Measured wideband DM data from ``-pp_dm``/``-pp_dme`` flags
         (reference: WidebandDMResiduals.get_dm_data, residuals.py:128).
@@ -464,6 +494,81 @@ class TOAs:
                 "would silently poison the wideband fit"
             )
         return dm, dme, valid
+
+    # -- selection / merging (reference: toa.py:1384 __getitem__,
+    # :2699 merge_TOAs) ------------------------------------------------------
+    def __getitem__(self, index):
+        """Sub-TOAs by int, slice, boolean mask, or integer array —
+        without re-running ingest (the prepared arrays are sliced)."""
+        n = len(self)
+        if isinstance(index, (int, np.integer)):
+            if not -n <= index < n:
+                raise IndexError(index)
+            idx = np.array([index % n])
+        elif isinstance(index, slice):
+            idx = np.arange(n)[index]
+        else:
+            idx = np.asarray(index)
+            if idx.dtype == bool:
+                if idx.shape != (n,):
+                    raise IndexError(
+                        f"boolean mask of shape {idx.shape} against "
+                        f"{n} TOAs")
+                idx = np.flatnonzero(idx)
+            else:
+                idx = idx.astype(np.int64)
+        return self._sliced(idx)
+
+    def _sliced(self, idx):
+        new = object.__new__(TOAs)
+        new.ephem = self.ephem
+        new.planets = self.planets
+        new.flags = [dict(self.flags[i]) for i in idx]
+        new.names = [self.names[i] for i in idx]
+        for arr in ("error_us", "freq_mhz", "mjd_float", "clock_sec",
+                    "ticks", "ssb_obs_pos", "ssb_obs_vel", "obs_sun_pos"):
+            setattr(new, arr, getattr(self, arr)[idx])
+        new.obs_names = [self.obs_names[i] for i in idx]
+        obs_unique = sorted(set(new.obs_names))
+        new.obs_index = np.array(
+            [obs_unique.index(o) for o in new.obs_names], dtype=np.int64)
+        new.obs_list = obs_unique
+        new.planet_pos = {b: p[idx] for b, p in self.planet_pos.items()}
+        return new
+
+    @classmethod
+    def merge(cls, toas_list):
+        """Concatenate prepared TOAs objects (reference merge_TOAs,
+        toa.py:2699).  All inputs must share ephem/planets settings."""
+        if not toas_list:
+            raise ValueError("nothing to merge")
+        first = toas_list[0]
+        for t in toas_list[1:]:
+            if t.ephem != first.ephem or t.planets != first.planets:
+                raise ValueError(
+                    "cannot merge TOAs prepared with different "
+                    f"ephem/planets settings: {t.ephem}/{t.planets} vs "
+                    f"{first.ephem}/{first.planets}")
+        new = object.__new__(cls)
+        new.ephem = first.ephem
+        new.planets = first.planets
+        new.flags = [dict(f) for t in toas_list for f in t.flags]
+        new.names = [x for t in toas_list for x in t.names]
+        for arr in ("error_us", "freq_mhz", "mjd_float", "clock_sec",
+                    "ticks", "ssb_obs_pos", "ssb_obs_vel", "obs_sun_pos"):
+            setattr(new, arr, np.concatenate(
+                [getattr(t, arr) for t in toas_list]))
+        new.obs_names = [x for t in toas_list for x in t.obs_names]
+        obs_unique = sorted(set(new.obs_names))
+        new.obs_index = np.array(
+            [obs_unique.index(o) for o in new.obs_names], dtype=np.int64)
+        new.obs_list = obs_unique
+        new.planet_pos = {}
+        if first.planets:
+            for b in first.planet_pos:
+                new.planet_pos[b] = np.concatenate(
+                    [t.planet_pos[b] for t in toas_list])
+        return new
 
     def to_batch(self) -> "TOABatch":
         planets = (
@@ -508,13 +613,120 @@ class TOABatch(NamedTuple):
         return int(self.ticks.shape[0])
 
 
-def get_TOAs(timfile, ephem="builtin", planets=False, include_clock=True)\
-        -> TOAs:
-    """Parse + prepare TOAs from a .tim file (reference: toa.py:109)."""
-    return TOAs(
+#: bump when the prepared-array layout changes (invalidates caches)
+_CACHE_VERSION = 1
+
+
+def _tim_hash(timfile, _depth=0):
+    """SHA256 over the tim file bytes and any INCLUDEd files."""
+    import hashlib
+
+    h = hashlib.sha256()
+    with open(timfile, "rb") as f:
+        data = f.read()
+    h.update(data)
+    if _depth < 5:
+        base = os.path.dirname(os.path.abspath(timfile))
+        for ln in data.decode(errors="replace").splitlines():
+            parts = ln.split()
+            if len(parts) >= 2 and parts[0].upper() == "INCLUDE":
+                inc = os.path.join(base, parts[1])
+                if os.path.exists(inc):
+                    h.update(_tim_hash(inc, _depth + 1).encode())
+    return h.hexdigest()
+
+
+def save_cache(toas: TOAs, path, src_hash=""):
+    """Write the prepared arrays to an npz cache (reference:
+    toa.py:373 save_pickle — here a hash-validated npz instead of a
+    version-fragile pickle)."""
+    import json
+
+    np.savez_compressed(
+        path,
+        meta=json.dumps({
+            "version": _CACHE_VERSION, "ephem": toas.ephem,
+            "planets": toas.planets, "src_hash": src_hash,
+            "flags": toas.flags, "names": toas.names,
+            "obs_names": toas.obs_names,
+        }),
+        error_us=toas.error_us, freq_mhz=toas.freq_mhz,
+        mjd_float=toas.mjd_float, clock_sec=toas.clock_sec,
+        ticks=toas.ticks, ssb_obs_pos=toas.ssb_obs_pos,
+        ssb_obs_vel=toas.ssb_obs_vel, obs_sun_pos=toas.obs_sun_pos,
+        **{f"planet_{b}": p for b, p in toas.planet_pos.items()},
+    )
+
+
+def load_cache(path, src_hash="", ephem=None, planets=None):
+    """Load a prepared-TOAs cache; returns None when stale/invalid
+    (wrong file hash, cache version, or prepare settings) — mirroring
+    the reference's hash check (toa.py:1856 check_hashes)."""
+    import json
+
+    try:
+        z = np.load(path, allow_pickle=False)
+        meta = json.loads(str(z["meta"]))
+    except Exception:
+        return None
+    if (meta.get("version") != _CACHE_VERSION
+            or (src_hash and meta.get("src_hash") != src_hash)
+            or (ephem is not None and meta.get("ephem") != ephem)
+            or (planets is not None and meta.get("planets") != planets)):
+        return None
+    new = object.__new__(TOAs)
+    new.ephem = meta["ephem"]
+    new.planets = meta["planets"]
+    new.flags = [dict(f) for f in meta["flags"]]
+    new.names = list(meta["names"])
+    new.obs_names = list(meta["obs_names"])
+    for arr in ("error_us", "freq_mhz", "mjd_float", "clock_sec",
+                "ticks", "ssb_obs_pos", "ssb_obs_vel", "obs_sun_pos"):
+        setattr(new, arr, z[arr])
+    obs_unique = sorted(set(new.obs_names))
+    new.obs_index = np.array(
+        [obs_unique.index(o) for o in new.obs_names], dtype=np.int64)
+    new.obs_list = obs_unique
+    new.planet_pos = {
+        k[len("planet_"):]: z[k] for k in z.files if k.startswith("planet_")
+    }
+    return new
+
+
+def get_TOAs(timfile, ephem="builtin", planets=False, include_clock=True,
+             use_cache=False) -> TOAs:
+    """Parse + prepare TOAs from a .tim file (reference: toa.py:109).
+
+    use_cache: True reads/writes ``<timfile>.pint_tpu_cache.npz``,
+    validated against a SHA256 of the tim file (incl. INCLUDEs), the
+    cache layout version, and the prepare settings — a stale cache is
+    silently rebuilt (reference pickle path, toa.py:333-402)."""
+    cache_path = str(timfile) + ".pint_tpu_cache.npz"
+    src_hash = ""
+    if use_cache:
+        # the resolved ephemeris identity is part of the hash: a
+        # requested kernel that silently fell back to the builtin (or a
+        # kernel/data file installed or updated later) must invalidate
+        # the cached positions
+        from pint_tpu.ephem import get_ephemeris
+
+        eph_id = get_ephemeris(ephem).identity
+        src_hash = (_tim_hash(timfile)
+                    + f"|clock={bool(include_clock)}|eph={eph_id}")
+        cached = load_cache(cache_path, src_hash=src_hash, ephem=ephem,
+                            planets=planets)
+        if cached is not None:
+            return cached
+    toas = TOAs(
         read_tim(timfile), ephem=ephem, planets=planets,
         include_clock=include_clock,
     )
+    if use_cache:
+        try:
+            save_cache(toas, cache_path, src_hash=src_hash)
+        except OSError:
+            pass  # read-only data dir: caching is best-effort
+    return toas
 
 
 def format_toa_line(mjd_str, error_us, freq_mhz, obs_code, flags=None,

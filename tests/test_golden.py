@@ -1,0 +1,190 @@
+"""Golden-file comparisons against tempo2 (the reference's core
+correctness strategy, SURVEY §4 oracle 1; reference
+tests/test_B1855_9yrs.py:25-46) plus the DE405 3D Earth-position
+fixture.
+
+Bounds are the measured round-3 levels from ACCURACY.md (builtin
+calibrated ephemeris, no JPL kernel available in this environment) —
+they exist to pin the achieved accuracy and fail loudly on regression.
+The wrap-saturated sets (see ACCURACY.md "wrap plateau") are asserted
+at their plateau; J2145/NGC6440E (P ~ 16 ms) and the 3D fixture are the
+genuine unwrapped accuracy assertions.
+
+Set PINT_TPU_FULL_GOLDEN=1 to also run the large (slow) datasets.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+REFDATA = "/root/reference/tests/datafile"
+T2DIR = "/root/reference/tempo2Test"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REFDATA), reason="reference datafiles not mounted")
+
+FULL = os.environ.get("PINT_TPU_FULL_GOLDEN") == "1"
+
+
+def _golden_rms(par, tim, golden):
+    from pint_tpu.models.builder import get_model_and_toas
+    from pint_tpu.residuals import Residuals
+
+    model, toas = get_model_and_toas(
+        os.path.join(REFDATA, par), os.path.join(REFDATA, tim))
+    r = Residuals(toas, model, subtract_mean=True, use_weighted_mean=False,
+                  track_mode="nearest")
+    ours = np.asarray(r.time_resids, np.float64)
+    t2 = np.genfromtxt(os.path.join(REFDATA, golden), skip_header=1,
+                       unpack=True)
+    if t2.ndim > 1:
+        t2 = t2[0]
+    d = ours - t2
+    d -= d.mean()
+    return float(np.sqrt(np.mean(d**2)))
+
+
+class TestEarth3DFixture:
+    """tempo2 DE405 geocenter positions, 730 daily epochs 2002-2004."""
+
+    @classmethod
+    def setup_class(cls):
+        import sys
+
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from tools.ephem_vs_tempo2 import load_truth
+
+        cls.mjd, cls.tdb_sec, cls.truth, cls.tt2tdb = load_truth()
+
+    def test_earth_position_fast_structure(self):
+        """Annual + fast error < 100 us per axis after removing the
+        slow (quasi-constant, phase-mean-absorbed) part."""
+        from pint_tpu.ephem import get_ephemeris
+
+        eph = get_ephemeris("builtin")
+        d = eph.posvel_ssb("earth", self.tdb_sec).pos - self.truth
+        t = self.tdb_sec / 86400.0
+        t = t - t.mean()
+        A = np.stack([np.ones_like(t), t / 1000, (t / 1000) ** 2], 1)
+        for ax in range(3):
+            resid = d[:, ax] - A @ np.linalg.lstsq(A, d[:, ax],
+                                                   rcond=None)[0]
+            assert resid.std() < 100e-6, f"axis {ax}: {resid.std()}"
+
+    def test_earth_annual_error_calibrated(self):
+        """The dominant pre-calibration term (~3 ms annual) stays
+        below 50 us in the calibration window."""
+        from pint_tpu.ephem import get_ephemeris
+
+        eph = get_ephemeris("builtin")
+        d = eph.posvel_ssb("earth", self.tdb_sec).pos - self.truth
+        t = self.tdb_sec / 86400.0
+        t = t - t.mean()
+        w = 2 * np.pi / 365.25
+        A = np.stack([np.ones_like(t), t / 1000, (t / 1000) ** 2,
+                      np.sin(w * t), np.cos(w * t)], 1)
+        for ax in range(3):
+            c = np.linalg.lstsq(A, d[:, ax], rcond=None)[0]
+            assert np.hypot(c[3], c[4]) < 50e-6
+
+    def test_tdb_minus_tt_vs_tempo2(self):
+        from pint_tpu.time.scales import tdb_minus_tt_seconds
+
+        ours = np.asarray(tdb_minus_tt_seconds(
+            (self.mjd - 51544.5) * 86400.0 + 64.184))
+        dd = ours - self.tt2tdb
+        assert (dd - dd.mean()).std() < 500e-9
+        assert abs(dd.mean()) < 2e-6
+
+
+class TestGoldenResiduals:
+    """End-to-end prefit residuals vs tempo2 golden files.  Bounds =
+    measured levels + margin (ACCURACY.md); the slow-period sets are
+    the unwrapped (informative) ones."""
+
+    def test_ngc6440e_prefit(self):
+        """P=16 ms: unwrapped.  Bound covers calibration residual plus
+        the pulsar's own spin noise in the raw rms."""
+        from pint_tpu.models.builder import get_model_and_toas
+        from pint_tpu.residuals import Residuals
+
+        model, toas = get_model_and_toas(
+            os.path.join(REFDATA, "NGC6440E.par"),
+            os.path.join(REFDATA, "NGC6440E.tim"))
+        r = Residuals(toas, model, subtract_mean=True,
+                      use_weighted_mean=False)
+        assert np.std(np.asarray(r.time_resids)) < 2.5e-3
+
+    def test_j2145_prefit(self):
+        from pint_tpu.models.builder import get_model_and_toas
+        from pint_tpu.residuals import Residuals
+
+        model, toas = get_model_and_toas(
+            os.path.join(REFDATA, "2145_swfit.par"),
+            os.path.join(REFDATA, "2145_swfit.tim"))
+        r = Residuals(toas, model, subtract_mean=True,
+                      use_weighted_mean=False)
+        assert np.std(np.asarray(r.time_resids)) < 8e-4
+
+    def test_b1953(self):
+        rms = _golden_rms("B1953+29_NANOGrav_dfg+12_TAI_FB90.par",
+                          "B1953+29_NANOGrav_dfg+12.tim",
+                          "B1953+29_NANOGrav_dfg+12_TAI_FB90.par"
+                          ".tempo2_test")
+        assert rms < 1.6e-3  # wrap plateau P/sqrt(12) = 1.77 ms
+
+    def test_j1744(self):
+        rms = _golden_rms("J1744-1134.basic.par",
+                          "J1744-1134.Rcvr1_2.GASP.8y.x.tim",
+                          "J1744-1134.basic.par.tempo2_test")
+        assert rms < 2.0e-3
+
+    @pytest.mark.skipif(not FULL, reason="set PINT_TPU_FULL_GOLDEN=1")
+    def test_j1853_below_plateau(self):
+        """The one fast-MSP set whose disagreement is now below its
+        wrap plateau — a genuine (unwrapped) end-to-end bound."""
+        rms = _golden_rms("J1853+1303_NANOGrav_11yv0.gls.par",
+                          "J1853+1303_NANOGrav_11yv0.tim",
+                          "J1853+1303_NANOGrav_11yv0.gls.par.tempo2_test")
+        assert rms < 6e-4
+
+    @pytest.mark.skipif(not FULL, reason="set PINT_TPU_FULL_GOLDEN=1")
+    def test_b1855_9y(self):
+        rms = _golden_rms("B1855+09_NANOGrav_9yv1.gls.par",
+                          "B1855+09_NANOGrav_9yv1.tim",
+                          "B1855+09_NANOGrav_9yv1.gls.par.tempo2_test")
+        assert rms < 2.6e-3
+
+    def test_b1855_intra_session_agreement(self):
+        """The pipeline-correctness assertion: within observing
+        sessions (smooth ephemeris error constant, wraps cancel) we
+        agree with tempo2 at the microsecond level — site rotation, DM,
+        clocks and the delay chain are sound (ACCURACY.md)."""
+        if not FULL:
+            pytest.skip("covered by the full run; heavy dataset")
+        from pint_tpu.models.builder import get_model_and_toas
+        from pint_tpu.residuals import Residuals
+
+        model, toas = get_model_and_toas(
+            os.path.join(REFDATA, "B1855+09_NANOGrav_9yv1.gls.par"),
+            os.path.join(REFDATA, "B1855+09_NANOGrav_9yv1.tim"))
+        r = Residuals(toas, model, subtract_mean=True,
+                      use_weighted_mean=False, track_mode="nearest")
+        t2 = np.genfromtxt(
+            os.path.join(
+                REFDATA, "B1855+09_NANOGrav_9yv1.gls.par.tempo2_test"),
+            skip_header=1, unpack=True)
+        if t2.ndim > 1:
+            t2 = t2[0]
+        d = np.asarray(r.time_resids) - t2
+        day = np.round(toas.mjd_float).astype(int)
+        parts = []
+        for u in np.unique(day):
+            m = day == u
+            if m.sum() >= 6:
+                parts.append(d[m] - d[m].mean())
+        assert parts, "no multi-TOA sessions found"
+        intra = np.concatenate(parts)
+        assert intra.std() < 5e-6

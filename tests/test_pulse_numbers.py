@@ -1,0 +1,182 @@
+"""Pulse-number tracking (reference residuals.py:368-392, TRACK
+-2/0 selection :133-149, toa.py pulse numbers :1709/:1984).
+
+The key behavioral test: across a long gap, an F0 error accumulates
+more than half a turn of phase.  Nearest-integer tracking silently
+reassigns pulses (wrapped, bounded residuals — phase connection lost);
+pulse-number tracking exposes the true, unbounded phase drift and lets
+a fit recover the injected F0 error exactly.
+"""
+
+import numpy as np
+import pytest
+
+from pint_tpu.models.builder import get_model
+from pint_tpu.residuals import Residuals
+from pint_tpu.simulation import make_fake_toas_uniform
+from pint_tpu.toa import read_tim, write_tim
+
+PAR = """PSR  J0000+0000
+RAJ 05:00:00.0
+DECJ 15:00:00.0
+F0 100.0 1
+F1 0.0
+PEPOCH 54100
+DM 10.0
+TZRMJD 54100
+TZRSITE @
+TZRFRQ 1400
+EPHEM builtin
+UNITS TDB
+"""
+
+
+@pytest.fixture(scope="module")
+def model(tmp_path_factory):
+    p = tmp_path_factory.mktemp("pn") / "pn.par"
+    p.write_text(PAR)
+    return get_model(str(p))
+
+
+@pytest.fixture(scope="module")
+def gap_toas(model):
+    """Two dense clusters separated by a 300-day gap."""
+    a = make_fake_toas_uniform(54000, 54030, 20, model, obs="@",
+                               error_us=1.0)
+    b = make_fake_toas_uniform(54330, 54360, 20, model, obs="@",
+                               error_us=1.0)
+    # merge by re-reading a combined tim (exercises IO too)
+    import tempfile, os
+
+    d = tempfile.mkdtemp()
+    pa, pb = os.path.join(d, "a.tim"), os.path.join(d, "b.tim")
+    write_tim(a, pa)
+    write_tim(b, pb)
+    with open(os.path.join(d, "ab.tim"), "w") as f:
+        f.write("FORMAT 1\n")
+        for pth in (pa, pb):
+            for ln in open(pth):
+                if not ln.startswith("FORMAT"):
+                    f.write(ln)
+    from pint_tpu.toa import get_TOAs
+
+    return get_TOAs(os.path.join(d, "ab.tim"), ephem="builtin")
+
+
+class TestComputeAndCarry:
+    def test_compute_assigns_pn_flags(self, model, gap_toas):
+        pn = gap_toas.compute_pulse_numbers(model)
+        got = gap_toas.get_pulse_numbers()
+        assert got is not None and not np.any(np.isnan(got))
+        assert np.array_equal(got.astype(np.int64), pn)
+        # zero residuals => tracked and nearest agree
+        r_pn = Residuals(gap_toas, model, track_mode="use_pulse_numbers")
+        assert np.max(np.abs(r_pn.phase_resids)) < 1e-6
+
+    def test_pn_flags_roundtrip_tim(self, model, gap_toas, tmp_path):
+        gap_toas.compute_pulse_numbers(model)
+        path = str(tmp_path / "pn.tim")
+        write_tim(gap_toas, path)
+        toas = read_tim(path)
+        assert all("pn" in t.flags for t in toas)
+
+
+class TestTrackingSemantics:
+    def test_gap_misassignment_vs_tracking(self, model, gap_toas):
+        gap_toas.compute_pulse_numbers(model)
+        # perturb F0 so the 300-d gap accumulates ~2.6 turns of error
+        vals = dict(model.values)
+        df0 = 1e-7
+        vals["F0"] = vals["F0"] + df0
+
+        r_near = Residuals(gap_toas, model, subtract_mean=False,
+                           track_mode="nearest")
+        r_pn = Residuals(gap_toas, model, subtract_mean=False,
+                         track_mode="use_pulse_numbers")
+        near = np.asarray(r_near._phase_resids_jit(r_near._values(vals)))
+        track = np.asarray(r_pn._phase_resids_jit(r_pn._values(vals)))
+        # nearest: wrapped into half a turn, gap swallowed silently
+        assert np.max(np.abs(near)) <= 0.5
+        # tracking: the true phase drift is exposed, > 2 turns
+        assert np.max(np.abs(track)) > 2.0
+        # and it is exactly the predicted linear drift
+        t_sec = gap_toas.ticks / 2**32
+        tzr = (54100.0 - 51544.5) * 86400.0
+        pred = df0 * (t_sec - tzr)
+        assert np.max(np.abs(track - pred)) < 1e-3
+
+    def test_fit_recovers_f0_across_gap(self, model, gap_toas):
+        """WLS with pulse-number residuals recovers an F0 error whose
+        gap drift would defeat nearest-integer assignment."""
+        import copy
+
+        from pint_tpu.fitter import WLSFitter
+
+        gap_toas.compute_pulse_numbers(model)
+        wrong = copy.deepcopy(model)
+        wrong["F0"] = wrong.values["F0"] + 1e-7
+        f = WLSFitter(
+            gap_toas, wrong,
+            residuals=Residuals(gap_toas, wrong,
+                                track_mode="use_pulse_numbers"),
+        )
+        f.fit_toas()
+        assert abs(f.model.values["F0"] - 100.0) < 1e-11
+
+
+class TestTrackSelection:
+    def test_track_minus2_selects_pulse_numbers(self, model, gap_toas,
+                                                tmp_path):
+        gap_toas.compute_pulse_numbers(model)
+        p = tmp_path / "t2.par"
+        p.write_text(PAR + "TRACK -2\n")
+        m2 = get_model(str(p))
+        r = Residuals(gap_toas, m2)
+        assert r.track_mode == "use_pulse_numbers"
+
+    def test_track_minus2_without_pn_raises(self, model, tmp_path):
+        toas = make_fake_toas_uniform(54000, 54010, 5, model, obs="@")
+        p = tmp_path / "t3.par"
+        p.write_text(PAR + "TRACK -2\n")
+        m2 = get_model(str(p))
+        with pytest.raises(ValueError, match="pulse numbers"):
+            Residuals(toas, m2, track_mode=None)
+
+    def test_complete_pn_flags_auto_select(self, model, gap_toas):
+        gap_toas.compute_pulse_numbers(model)
+        r = Residuals(gap_toas, model)
+        assert r.track_mode == "use_pulse_numbers"
+
+    def test_track_zero_forces_nearest(self, model, gap_toas, tmp_path):
+        gap_toas.compute_pulse_numbers(model)
+        p = tmp_path / "t4.par"
+        p.write_text(PAR + "TRACK 0\n")
+        m2 = get_model(str(p))
+        r = Residuals(gap_toas, m2)
+        assert r.track_mode == "nearest"
+
+
+class TestPhaseCommands:
+    def test_phase_command_delta(self, model, tmp_path):
+        toas0 = make_fake_toas_uniform(54000, 54010, 6, model, obs="@",
+                                       error_us=1.0)
+        path = str(tmp_path / "ph.tim")
+        write_tim(toas0, path)
+        lines = open(path).read().splitlines()
+        # insert PHASE 0.25 before the last three TOAs
+        data_idx = [i for i, ln in enumerate(lines)
+                    if ln and not ln.startswith(("FORMAT", "C ", "MODE"))]
+        ins = data_idx[3]
+        lines.insert(ins, "PHASE 0.25")
+        p2 = str(tmp_path / "ph2.tim")
+        open(p2, "w").write("\n".join(lines) + "\n")
+        from pint_tpu.toa import get_TOAs
+
+        toas = get_TOAs(p2, ephem="builtin")
+        dpn = toas.get_delta_pulse_numbers()
+        assert np.allclose(dpn[:3], 0.0) and np.allclose(dpn[3:], 0.25)
+        r = Residuals(toas, model, subtract_mean=False,
+                      track_mode="nearest")
+        resid = r.phase_resids
+        assert np.allclose(resid[:3], 0.0, atol=1e-6)
+        assert np.allclose(resid[3:], 0.25, atol=1e-6)

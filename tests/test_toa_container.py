@@ -1,0 +1,147 @@
+"""TOAs container parity: selection (__getitem__), merge, and the
+hash-validated prepared-array cache (reference toa.py:1384, :2699,
+:333-402; test intent mirrors reference test_toa_indexing.py /
+test_toa_pickle.py)."""
+
+import numpy as np
+import pytest
+
+from pint_tpu.models.builder import get_model
+from pint_tpu.residuals import Residuals
+from pint_tpu.simulation import make_fake_toas_uniform
+from pint_tpu.toa import TOAs, get_TOAs, write_tim
+
+PAR = """PSR J0000+0000
+RAJ 05:00:00.0
+DECJ 15:00:00.0
+F0 100.0 1
+F1 0.0
+PEPOCH 54100
+DM 10.0
+TZRMJD 54100
+TZRSITE @
+TZRFRQ 1400
+EPHEM builtin
+UNITS TDB
+"""
+
+
+@pytest.fixture(scope="module")
+def model(tmp_path_factory):
+    p = tmp_path_factory.mktemp("cont") / "m.par"
+    p.write_text(PAR)
+    return get_model(str(p))
+
+
+@pytest.fixture(scope="module")
+def toas(model):
+    t = make_fake_toas_uniform(54000, 54100, 25, model, obs="gbt",
+                               error_us=1.0)
+    for i, f in enumerate(t.flags):
+        f["idx"] = str(i)
+    return t
+
+
+class TestGetitem:
+    def test_slice(self, toas):
+        sub = toas[5:15]
+        assert len(sub) == 10
+        assert np.array_equal(sub.ticks, toas.ticks[5:15])
+        assert sub.flags[0]["idx"] == "5"
+
+    def test_bool_mask(self, toas):
+        mask = toas.mjd_float > 54050
+        sub = toas[mask]
+        assert len(sub) == mask.sum()
+        assert np.all(sub.mjd_float > 54050)
+
+    def test_int_and_array(self, toas):
+        one = toas[3]
+        assert len(one) == 1 and one.flags[0]["idx"] == "3"
+        sub = toas[np.array([2, 4, 8])]
+        assert [f["idx"] for f in sub.flags] == ["2", "4", "8"]
+
+    def test_flags_are_copies(self, toas):
+        sub = toas[0:2]
+        sub.flags[0]["idx"] = "changed"
+        assert toas.flags[0]["idx"] == "0"
+
+    def test_selection_residuals_match(self, model, toas):
+        mask = toas.mjd_float > 54050
+        r_full = Residuals(toas, model, subtract_mean=False)
+        r_sub = Residuals(toas[mask], model, subtract_mean=False)
+        assert np.allclose(r_full.time_resids[mask], r_sub.time_resids,
+                           atol=1e-12)
+
+    def test_bad_index(self, toas):
+        with pytest.raises(IndexError):
+            toas[len(toas)]
+        with pytest.raises(IndexError):
+            toas[np.ones(3, dtype=bool)]
+
+
+class TestMerge:
+    def test_merge_roundtrip(self, model, toas):
+        a, b = toas[:10], toas[10:]
+        merged = TOAs.merge([a, b])
+        assert len(merged) == len(toas)
+        assert np.array_equal(merged.ticks, toas.ticks)
+        assert merged.obs_list == toas.obs_list
+        r0 = Residuals(toas, model, subtract_mean=False).time_resids
+        r1 = Residuals(merged, model, subtract_mean=False).time_resids
+        assert np.allclose(r0, r1, atol=1e-12)
+
+    def test_merge_different_obs(self, model):
+        a = make_fake_toas_uniform(54000, 54010, 5, model, obs="gbt")
+        b = make_fake_toas_uniform(54020, 54030, 5, model, obs="ao")
+        m = TOAs.merge([a, b])
+        assert set(m.obs_list) >= {"gbt", "arecibo"} or len(m.obs_list) == 2
+        assert len(m) == 10
+
+    def test_merge_mismatched_settings_raises(self, model):
+        a = make_fake_toas_uniform(54000, 54010, 5, model, obs="gbt")
+        b = make_fake_toas_uniform(54000, 54010, 5, model, obs="gbt")
+        b.ephem = "other"
+        with pytest.raises(ValueError, match="different"):
+            TOAs.merge([a, b])
+
+
+class TestCache:
+    def test_cache_roundtrip_and_invalidation(self, model, toas,
+                                              tmp_path):
+        tim = tmp_path / "c.tim"
+        write_tim(toas, str(tim))
+        t1 = get_TOAs(str(tim), ephem="builtin", use_cache=True)
+        cache = tmp_path / "c.tim.pint_tpu_cache.npz"
+        assert cache.exists()
+        t2 = get_TOAs(str(tim), ephem="builtin", use_cache=True)
+        assert np.array_equal(t1.ticks, t2.ticks)
+        assert t1.flags == t2.flags
+        assert np.array_equal(t1.ssb_obs_pos, t2.ssb_obs_pos)
+        # touching the tim invalidates the cache (hash mismatch)
+        content = tim.read_text()
+        tim.write_text(content.replace("FORMAT 1", "FORMAT 1\nC edited"))
+        import pint_tpu.toa as toamod
+
+        seen = {}
+        orig = toamod.read_tim
+
+        def spy(path, *a, **k):
+            seen["reparsed"] = True
+            return orig(path, *a, **k)
+
+        toamod.read_tim = spy
+        try:
+            t3 = get_TOAs(str(tim), ephem="builtin", use_cache=True)
+        finally:
+            toamod.read_tim = orig
+        assert seen.get("reparsed"), "stale cache was not rebuilt"
+        assert np.array_equal(t1.ticks, t3.ticks)
+
+    def test_cache_respects_settings(self, model, toas, tmp_path):
+        tim = tmp_path / "d.tim"
+        write_tim(toas, str(tim))
+        get_TOAs(str(tim), ephem="builtin", use_cache=True)
+        # different prepare settings must not hit the cache
+        t = get_TOAs(str(tim), ephem="analytic", use_cache=True)
+        assert t.ephem == "analytic"

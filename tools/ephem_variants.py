@@ -1,0 +1,67 @@
+"""Controlled experiments isolating which correction drives golden
+residual disagreement.  Builds npz variants to /tmp and runs selected
+golden sets against each via the PINT_TPU_EPHEM_BUILTIN override."""
+
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import tools.build_ephemeris as be  # noqa: E402
+
+SETS = ["B1855_9y", "J1744_basic", "J0613_FB90"]
+GIANTS = ("jupiter", "saturn", "uranus", "neptune")
+
+
+def build_variant(name, sysm, zero_giants=False, zero_cal=False):
+    out = f"/tmp/ephem_{name}.npz"
+    saved_trend = {b: v.copy() for b, v in sysm.trend.items()}
+    saved_off = dict(sysm.el_offset)
+    if zero_giants:
+        # pure Standish Kepler for the giants: keep trend removal
+        # equal to the full signal by zeroing the periodic part -> use
+        # a huge trick: set trend to fit d exactly? simplest: monkey-
+        # patch helio_positions per-body via flag
+        sysm.zero_periodic = set(GIANTS)
+    else:
+        sysm.zero_periodic = set()
+    if zero_cal:
+        sysm.el_offset = {}
+    be.build_to(out, sysm)
+    sysm.trend = saved_trend
+    sysm.el_offset = saved_off
+    sysm.zero_periodic = set()
+    return out
+
+
+def run_golden(npz, sets=SETS):
+    env = dict(os.environ)
+    env["PINT_TPU_EPHEM_BUILTIN"] = npz
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, "tools/golden_compare.py", *sets],
+        capture_output=True, text=True, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    for ln in r.stdout.splitlines():
+        if "rms" in ln or "FAILED" in ln:
+            print("   ", ln.strip())
+
+
+def main():
+    print("integrating ...", flush=True)
+    dense = be.integrate()
+    sysm = be.CorrectedSystem(dense)
+    be.calibrate_emb(sysm)
+    for name, kw in [("full", {}), ("nocal", {"zero_cal": True}),
+                     ("kepler_giants", {"zero_giants": True})]:
+        print(f"== variant {name}", flush=True)
+        npz = build_variant(name, sysm, **kw)
+        run_golden(npz)
+
+
+if __name__ == "__main__":
+    main()

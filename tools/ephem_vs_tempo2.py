@@ -1,0 +1,64 @@
+"""Measure the built-in ephemeris directly against tempo2's DE405 Earth
+positions (/root/reference/tempo2Test/T2output.dat: 730 daily epochs of
+barycentric geocenter position in light-seconds, ICRS, 2002-2004, plus
+tempo2's tt2tdb).  This is the only absolute solar-system ground truth
+available in this environment; tools/golden_compare.py measures the
+end-to-end projection of the same error onto pulsar directions.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+T2DIR = "/root/reference/tempo2Test"
+
+
+def load_truth():
+    mjd_utc = []
+    with open(os.path.join(T2DIR, "J0000+0000.tim")) as f:
+        for ln in f:
+            parts = ln.split()
+            if len(parts) > 3 and parts[0] != "FORMAT":
+                mjd_utc.append(float(parts[2]))
+    dat = np.loadtxt(os.path.join(T2DIR, "T2output.dat"))
+    earth_ls = dat[:, 0:3]
+    tt2tdb = dat[:, 3]
+    mjd_utc = np.array(mjd_utc)
+    assert len(mjd_utc) == len(dat)
+    # UTC -> TT: TAI-UTC = 32 s across 1999-2005 (no leap in window)
+    tt_sec_j2000 = (mjd_utc - 51544.5) * 86400.0 + (32.0 + 32.184)
+    tdb_sec = tt_sec_j2000 + tt2tdb
+    return mjd_utc, tdb_sec, earth_ls, tt2tdb
+
+
+def main():
+    from pint_tpu.ephem import get_ephemeris
+
+    mjd, tdb_sec, truth, tt2tdb = load_truth()
+    for name in ("builtin", "analytic"):
+        eph = get_ephemeris(name)
+        ours = eph.posvel_ssb("earth", tdb_sec).pos  # (n,3) light-s
+        d = ours - truth
+        rms = np.sqrt((d**2).sum(1).mean())
+        print(f"{name:>9s}: 3D rms={rms*1e6:9.2f} us  "
+              f"per-axis rms [us] = "
+              + " ".join(f"{x*1e6:8.2f}" for x in d.std(axis=0))
+              + "  mean [us] = "
+              + " ".join(f"{x*1e6:8.2f}" for x in d.mean(axis=0)))
+    # our tt2tdb vs tempo2's
+    from pint_tpu.time.scales import tdb_minus_tt_seconds
+
+    ours_tt2tdb = np.asarray(tdb_minus_tt_seconds(
+        (mjd - 51544.5) * 86400.0 + 64.184))
+    dd = (ours_tt2tdb - tt2tdb) * 1e9
+    print(f"tt2tdb diff: rms={dd.std():.1f} ns  mean={dd.mean():.1f} ns "
+          f"max={np.abs(dd).max():.1f} ns")
+    return mjd, tdb_sec, truth
+
+
+if __name__ == "__main__":
+    main()

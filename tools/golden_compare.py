@@ -1,0 +1,102 @@
+"""Golden-file comparison harness vs tempo2/tempo reference residuals.
+
+Mirrors the reference's core correctness strategy (SURVEY §4 oracle 1;
+reference tests/test_B1855_9yrs.py:25-46): compute prefit residuals with
+an unweighted mean subtraction and compare against the committed
+tempo2 `general2 pre` output (`*.tempo2_test` / `*.tempo_test` files,
+first column, seconds).
+
+Usage:
+    python tools/golden_compare.py            # all known sets
+    python tools/golden_compare.py B1855_9y   # one set
+
+Prints one line per dataset: RMS / max of the raw difference and of the
+mean-removed difference (a constant offset is unobservable: both
+pipelines subtract their own phase mean).
+"""
+
+import os
+import sys
+
+# force CPU: the env ships JAX_PLATFORMS=axon (TPU tunnel), which is
+# both slower to compile and flaky for long host-side comparisons; a
+# setdefault would NOT override it
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+REFDATA = "/root/reference/tests/datafile"
+
+# name -> (par, tim) under the reference datafile dir.  All golden files
+# were produced by tempo2 general2 "pre" (seconds), one header line.
+GOLDEN_SETS = {
+    "B1855_9y": ("B1855+09_NANOGrav_9yv1.gls.par.tempo2_test",
+                 "B1855+09_NANOGrav_9yv1.gls.par",
+                 "B1855+09_NANOGrav_9yv1.tim"),
+    "B1855_dfg_FB90": ("B1855+09_NANOGrav_dfg+12_TAI_FB90.par.tempo2_test",
+                       "B1855+09_NANOGrav_dfg+12_TAI_FB90.par",
+                       "B1855+09_NANOGrav_dfg+12.tim"),
+    "B1953_FB90": ("B1953+29_NANOGrav_dfg+12_TAI_FB90.par.tempo2_test",
+                   "B1953+29_NANOGrav_dfg+12_TAI_FB90.par",
+                   "B1953+29_NANOGrav_dfg+12.tim"),
+    "J0613_FB90": ("J0613-0200_NANOGrav_dfg+12_TAI_FB90.par.tempo2_test",
+                   "J0613-0200_NANOGrav_dfg+12_TAI_FB90.par",
+                   "J0613-0200_NANOGrav_dfg+12.tim"),
+    "J0023_11y": ("J0023+0923_NANOGrav_11yv0.gls.par.tempo2_test",
+                  "J0023+0923_NANOGrav_11yv0.gls.par",
+                  "J0023+0923_NANOGrav_11yv0.tim"),
+    "J1744_basic": ("J1744-1134.basic.par.tempo2_test",
+                    "J1744-1134.basic.par",
+                    "J1744-1134.Rcvr1_2.GASP.8y.x.tim"),
+    "J1853_11y": ("J1853+1303_NANOGrav_11yv0.gls.par.tempo2_test",
+                  "J1853+1303_NANOGrav_11yv0.gls.par",
+                  "J1853+1303_NANOGrav_11yv0.tim"),
+}
+
+
+def compare_one(name, verbose=True):
+    golden, par, tim = GOLDEN_SETS[name]
+    from pint_tpu.models.builder import get_model_and_toas
+    from pint_tpu.residuals import Residuals
+
+    model, toas = get_model_and_toas(
+        os.path.join(REFDATA, par), os.path.join(REFDATA, tim)
+    )
+    r = Residuals(toas, model, subtract_mean=True, use_weighted_mean=False)
+    ours = np.asarray(r.time_resids, dtype=np.float64)
+    t2 = np.genfromtxt(os.path.join(REFDATA, golden), skip_header=1,
+                       unpack=True)
+    if t2.ndim > 1:  # extra general2 columns: residuals are column 0
+        t2 = t2[0]
+    if len(t2) != len(ours):
+        raise ValueError(f"{name}: {len(ours)} TOAs vs {len(t2)} golden")
+    d = ours - t2
+    dm = d - d.mean()
+    out = {
+        "n": len(d),
+        "rms_raw": float(np.sqrt(np.mean(d**2))),
+        "max_raw": float(np.max(np.abs(d))),
+        "rms": float(np.sqrt(np.mean(dm**2))),
+        "max": float(np.max(np.abs(dm))),
+    }
+    if verbose:
+        print(f"{name:>16s}: n={out['n']:5d}  "
+              f"|d-mean| rms={out['rms']:.3e} max={out['max']:.3e}   "
+              f"raw rms={out['rms_raw']:.3e} s")
+    return out
+
+
+def main(argv):
+    names = argv[1:] or list(GOLDEN_SETS)
+    results = {}
+    for name in names:
+        try:
+            results[name] = compare_one(name)
+        except Exception as e:
+            print(f"{name:>16s}: FAILED - {type(e).__name__}: {e}")
+    return results
+
+
+if __name__ == "__main__":
+    main(sys.argv)
