@@ -90,7 +90,10 @@ def get_ephemeris(name: str = "builtin") -> Ephemeris:
     if key in _cache:
         return _cache[key]
     if key in ("builtin", "compiled", "none", ""):
-        eph = _builtin()
+        # _builtin memoizes per resolved data path itself (so a
+        # mid-process $PINT_TPU_EPHEM_BUILTIN switch takes effect);
+        # do not double-cache under the name
+        return _builtin()
     elif key == "analytic":
         from pint_tpu.ephem.analytic import AnalyticEphemeris
 
@@ -139,7 +142,8 @@ def _builtin() -> Ephemeris:
     except (FileNotFoundError, OSError):
         from pint_tpu.ephem.analytic import AnalyticEphemeris
 
-        eph = AnalyticEphemeris()
+        # NOT cached: a data file installed later must take effect
+        return AnalyticEphemeris()
     _cache[key] = eph
     return eph
 

@@ -1,0 +1,124 @@
+"""Logging subsystem: leveled, deduplicating, env-controllable.
+
+Behavioral counterpart of the reference's loguru-based setup
+(reference: src/pint/logging.py — dedup/once filters, verbosity
+control, $LOGURU_LEVEL env), on the stdlib ``logging`` module so it
+composes with host applications:
+
+- ``log`` — the package logger (``pint_tpu``); modules do
+  ``from pint_tpu.logging import log`` and use ``log.info`` etc.
+- ``setup(level=..., dedup=True)`` — install a console handler; the
+  level falls back to ``$PINT_TPU_LOG`` (default WARNING).
+- ``DedupFilter`` — suppresses repeats of the same message beyond
+  ``max_repeats`` (the reference's dedup filter); ``log_once`` is the
+  hard once-only helper.
+- ``capture_warnings(True)`` — routes ``warnings.warn`` through the
+  logger so library warnings obey the same verbosity/dedup policy
+  (the reference forwards warnings into loguru the same way).
+"""
+
+from __future__ import annotations
+
+import logging as _logging
+import os
+import warnings as _warnings
+
+__all__ = ["log", "setup", "log_once", "DedupFilter", "capture_warnings"]
+
+log = _logging.getLogger("pint_tpu")
+
+
+class DedupFilter(_logging.Filter):
+    """Allow each distinct (level, message) only ``max_repeats`` times
+    (reference logging.py dedup behavior)."""
+
+    def __init__(self, max_repeats=1):
+        super().__init__()
+        self.max_repeats = max_repeats
+        self._counts: dict = {}
+
+    def filter(self, record):
+        key = (record.levelno, record.getMessage())
+        n = self._counts.get(key, 0)
+        self._counts[key] = n + 1
+        # annotate the last allowed emission — but only when something
+        # was actually repeated (max_repeats == 1 means silent dedup)
+        if n + 1 == self.max_repeats and self.max_repeats > 1:
+            record.msg = f"{record.getMessage()} [further repeats hidden]"
+            record.args = ()
+        return n < self.max_repeats
+
+
+_handler = None
+_dedup = None
+
+
+def setup(level=None, dedup=True, max_repeats=1, stream=None):
+    """Install (or reconfigure) the console handler.
+
+    level: int or name; default $PINT_TPU_LOG or WARNING.
+    Returns the package logger."""
+    global _handler, _dedup
+    if level is None:
+        level = os.environ.get("PINT_TPU_LOG", "WARNING")
+    if isinstance(level, str):
+        level = getattr(_logging, level.upper())
+    if _handler is None:
+        _handler = _logging.StreamHandler(stream)
+        _handler.setFormatter(_logging.Formatter(
+            "%(levelname)s (%(name)s): %(message)s"))
+        log.addHandler(_handler)
+    elif stream is not None:
+        _handler.setStream(stream)
+    if _dedup is not None:
+        _handler.removeFilter(_dedup)
+        _dedup = None
+    if dedup:
+        _dedup = DedupFilter(max_repeats=max_repeats)
+        _handler.addFilter(_dedup)
+    log.setLevel(level)
+    return log
+
+
+_once_seen: set = set()
+
+
+def log_once(level, msg, *args):
+    """Emit a message exactly once per process (the reference's
+    ``log.log(..., once=True)`` pattern)."""
+    key = (level, msg)
+    if key in _once_seen:
+        return
+    _once_seen.add(key)
+    log.log(level if isinstance(level, int)
+            else getattr(_logging, str(level).upper()), msg, *args)
+
+
+def capture_warnings(enable=True):
+    """Route warnings.warn through the package logger (and back)."""
+    _logging.captureWarnings(enable)
+    pywarn = _logging.getLogger("py.warnings")
+    if enable:
+        for h in log.handlers:
+            if h not in pywarn.handlers:
+                pywarn.addHandler(h)
+    else:
+        for h in list(pywarn.handlers):
+            pywarn.removeHandler(h)
+
+
+def get_verbosity_args(parser):
+    """Attach the reference-style -v/-q CLI verbosity flags."""
+    parser.add_argument("-v", "--verbose", action="count", default=0,
+                        help="increase logging verbosity (-v, -vv)")
+    parser.add_argument("-q", "--quiet", action="count", default=0,
+                        help="decrease logging verbosity")
+    return parser
+
+
+def apply_verbosity(args):
+    """Map parsed -v/-q counts onto a logging level and install it."""
+    base = _logging.WARNING
+    level = base - 10 * getattr(args, "verbose", 0) \
+        + 10 * getattr(args, "quiet", 0)
+    return setup(level=max(_logging.DEBUG, min(_logging.CRITICAL, level)))

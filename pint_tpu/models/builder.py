@@ -308,6 +308,14 @@ def get_model_and_toas(parfile, timfile, **kw):
         model.meta.get("PLANET_SHAPIRO", "N").upper() in ("Y", "1", "TRUE")
     ) or bool(model.values.get("PLANET_SHAPIRO", 0.0))
     ephem = model.meta.get("EPHEM", "builtin")
+    # honor the par CLK realization: TT(BIPMxxxx) requests the BIPM
+    # offsets (applied when tai2tt data is available; see
+    # obs.clock.find_bipm_correction), TT(TAI)/UNCORR do not
+    clk = (model.meta.get("CLK") or model.meta.get("CLOCK") or "").upper()
+    if "BIPM" in clk and "include_bipm" not in kw:
+        kw["include_bipm"] = True
+        kw.setdefault("bipm_version",
+                      clk.replace("TT(", "").replace(")", ""))
     toas = get_TOAs(timfile, ephem=ephem, planets=planets,
                     **kw)
     return model, toas

@@ -182,6 +182,39 @@ _BUILTIN_SITES = {
 _builtin_loaded = False
 
 
+def export_all_clock_files(directory):
+    """Write every registered observatory's resolved clock chain into
+    ``directory`` as tempo2-format files (reference:
+    topo_obs.py:425 export_all_clock_files) — a reproducibility
+    snapshot of the clock data a run actually used.  Returns the list
+    of written paths."""
+    import os
+
+    from pint_tpu.obs.clock import ClockFile, find_clock_chain
+
+    _ensure_builtin()
+    os.makedirs(directory, exist_ok=True)
+    written = []
+    seen = set()
+    for obs in Observatory._registry.values():
+        if id(obs) in seen or not isinstance(obs, TopoObs):
+            continue
+        seen.add(id(obs))
+        chain = find_clock_chain(obs)
+        if not chain:
+            continue
+        # one merged site->UTC file per observatory, always tempo2
+        # format under a .clk name so the snapshot re-reads correctly
+        merged = chain[0] if len(chain) == 1 else ClockFile.merge(chain)
+        out = os.path.join(directory, f"{obs.name}2utc.clk")
+        merged.write_tempo2(
+            out, hdr_from=obs.name.upper(), hdr_to="UTC",
+            comments="exported by pint_tpu (merged chain: "
+                     + ", ".join(c.name or "?" for c in chain) + ")")
+        written.append(out)
+    return written
+
+
 def _ensure_builtin():
     global _builtin_loaded
     if _builtin_loaded:

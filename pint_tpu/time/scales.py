@@ -116,15 +116,16 @@ def tdb_minus_tt_seconds(tt_sec_since_j2000):
     mid-process (the calibration tooling relies on that).
     """
     try:
-        from pint_tpu.ephem.compiled import CompiledEphemeris, data_path
+        from pint_tpu.ephem import _builtin
+        from pint_tpu.ephem.compiled import data_path
 
         key = data_path()
         if key not in _COMPILED_TDBTT:
-            try:
-                eph = CompiledEphemeris(key)
-                _COMPILED_TDBTT[key] = eph if "tdbtt" in eph._seg else None
-            except Exception:
-                _COMPILED_TDBTT[key] = None
+            # reuse the memoized builtin provider (one npz load and one
+            # in-memory table set per path, shared with positions)
+            eph = _builtin()
+            _COMPILED_TDBTT[key] = (
+                eph if "tdbtt" in getattr(eph, "_seg", {}) else None)
         table = _COMPILED_TDBTT[key]
     except Exception:
         table = None

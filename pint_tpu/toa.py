@@ -343,7 +343,8 @@ class TOAs:
     """Host-side TOA table (struct of numpy arrays + python flag dicts)."""
 
     def __init__(self, toa_list, ephem="builtin", planets=False,
-                 include_clock=True):
+                 include_clock=True, include_bipm=False,
+                 bipm_version="BIPM2019"):
         if not toa_list:
             raise ValueError("no TOAs")
         self.ephem = ephem
@@ -373,6 +374,24 @@ class TOAs:
                 m = self.obs_index == io
                 if not obs.is_barycenter:
                     clock[m] = obs.clock_corrections_sec(mjd_float[m])
+        # TT(BIPMxxxx) realization offsets ride the same additive path
+        # (reference: bipm_correction, observatory/__init__.py:253)
+        if include_bipm:
+            from pint_tpu.obs.clock import find_bipm_correction
+
+            bipm = find_bipm_correction(bipm_version)
+            if bipm is None:
+                warnings.warn(
+                    f"CLK TT({bipm_version}) requested but no "
+                    "tai2tt_bipmXXXX.clk data found in "
+                    "$PINT_TPU_CLOCK_DIR; using TT(TAI) (the BIPM "
+                    "realization differs by ~27 us + slow drift)"
+                )
+            else:
+                topo = np.array([
+                    not get_observatory(o).is_barycenter
+                    for o in self.obs_names])
+                clock[topo] += bipm.evaluate_sec(mjd_float[topo])
         # TIME command offsets ride the clock path too
         for i, fl in enumerate(self.flags):
             if "to" in fl:
@@ -694,6 +713,7 @@ def load_cache(path, src_hash="", ephem=None, planets=None):
 
 
 def get_TOAs(timfile, ephem="builtin", planets=False, include_clock=True,
+             include_bipm=False, bipm_version="BIPM2019",
              use_cache=False) -> TOAs:
     """Parse + prepare TOAs from a .tim file (reference: toa.py:109).
 
@@ -710,16 +730,21 @@ def get_TOAs(timfile, ephem="builtin", planets=False, include_clock=True,
         # the cached positions
         from pint_tpu.ephem import get_ephemeris
 
+        from pint_tpu.obs.clock import clock_data_identity
+
         eph_id = get_ephemeris(ephem).identity
         src_hash = (_tim_hash(timfile)
-                    + f"|clock={bool(include_clock)}|eph={eph_id}")
+                    + f"|clock={bool(include_clock)}|eph={eph_id}"
+                    + f"|bipm={bipm_version if include_bipm else ''}"
+                    + f"|clkdata={clock_data_identity()}")
         cached = load_cache(cache_path, src_hash=src_hash, ephem=ephem,
                             planets=planets)
         if cached is not None:
             return cached
     toas = TOAs(
         read_tim(timfile), ephem=ephem, planets=planets,
-        include_clock=include_clock,
+        include_clock=include_clock, include_bipm=include_bipm,
+        bipm_version=bipm_version,
     )
     if use_cache:
         try:

@@ -1,0 +1,214 @@
+"""Clock writers/merge/GlobalClockFile, BIPM realization plumbing, and
+the logging subsystem (reference clock_file.py:295,355,781;
+observatory/__init__.py:70,253; logging.py)."""
+
+import io
+import logging as pylogging
+import os
+
+import numpy as np
+import pytest
+
+from pint_tpu.obs.clock import (
+    ClockFile,
+    GlobalClockFile,
+    find_bipm_correction,
+    find_clock_chain,
+    find_clock_file,
+)
+
+
+class TestWriters:
+    def test_tempo2_roundtrip(self, tmp_path):
+        cf = ClockFile([50000.0, 50010.0, 50020.0],
+                       [1e-6, 2e-6, -3e-6], name="x")
+        p = str(tmp_path / "x2gps.clk")
+        cf.write_tempo2(p, hdr_from="X", hdr_to="GPS", comments="test")
+        back = ClockFile.read_tempo2(p)
+        assert np.allclose(back.mjds, cf.mjds)
+        assert np.allclose(back.offsets, cf.offsets, atol=1e-18)
+
+    def test_tempo_roundtrip(self, tmp_path):
+        cf = ClockFile([50000.0, 50010.0], [1.5e-6, -2.25e-6])
+        p = str(tmp_path / "time_x.dat")
+        cf.write_tempo(p, site_code="1")
+        back = ClockFile.read_tempo(p, site_code="1")
+        assert np.allclose(back.mjds, cf.mjds)
+        assert np.allclose(back.offsets, cf.offsets, atol=1e-10)
+
+    def test_reference_wsrt_file_parses(self):
+        ref = "/root/reference/tests/datafile/wsrt2gps.clk"
+        if not os.path.exists(ref):
+            pytest.skip("reference data not mounted")
+        cf = ClockFile.read_tempo2(ref)
+        assert len(cf.mjds) > 10
+        assert np.all(np.abs(cf.offsets) < 1e-3)
+
+
+class TestMerge:
+    def test_sum_of_chains(self):
+        a = ClockFile([50000, 50010, 50020], [1e-6, 1e-6, 1e-6])
+        b = ClockFile([50000, 50005, 50020], [0.0, 5e-6, 5e-6])
+        m = ClockFile.merge([a, b])
+        assert np.isclose(m.evaluate_sec(50005.0), 1e-6 + 5e-6)
+        assert np.isclose(m.evaluate_sec(50015.0),
+                          a.evaluate_sec(50015.0) + b.evaluate_sec(50015.0))
+
+    def test_trim_to_intersection(self):
+        a = ClockFile([50000, 50020], [1e-6, 1e-6])
+        b = ClockFile([50010, 50030], [2e-6, 2e-6])
+        m = ClockFile.merge([a, b], trim=True)
+        assert m.mjds[0] >= 50010 and m.mjds[-1] <= 50020
+
+    def test_discontinuity_preserved(self):
+        a = ClockFile([50000, 50010, 50010, 50020],
+                      [0.0, 0.0, 4e-6, 4e-6])
+        b = ClockFile([50000, 50020], [1e-6, 1e-6])
+        m = ClockFile.merge([a, b])
+        assert np.isclose(m.evaluate_sec(50009.999), 1e-6, atol=1e-8)
+        assert np.isclose(m.evaluate_sec(50010.001), 5e-6, atol=1e-8)
+
+
+class TestGlobalClockFile:
+    def test_refresh_on_mtime_change(self, tmp_path):
+        p = tmp_path / "site2gps.clk"
+        p.write_text("# SITE GPS\n50000.0 1e-6\n50010.0 1e-6\n")
+        g = GlobalClockFile(str(p), fmt="tempo2")
+        assert np.isclose(g.evaluate_sec(50005.0), 1e-6)
+        os.utime(p, ns=(1, 1))  # force distinct mtime
+        p.write_text("# SITE GPS\n50000.0 2e-6\n50010.0 2e-6\n")
+        assert np.isclose(g.evaluate_sec(50005.0), 2e-6)
+
+
+class TestBIPM:
+    def _write_bipm(self, d, year, val):
+        """Real tai2tt_bipm*.clk files tabulate TT(BIPM) - TAI
+        (~32.1843 s); val is the ~27 us realization offset."""
+        full = 32.184 + val
+        (d / f"tai2tt_bipm{year}.clk").write_text(
+            f"# TAI TT(BIPM{year})\n40000.0 {full!r}\n60000.0 {full!r}\n")
+
+    def test_find_exact_and_fallback(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PINT_TPU_CLOCK_DIR", str(tmp_path))
+        self._write_bipm(tmp_path, 2017, 27.6e-6)
+        self._write_bipm(tmp_path, 2015, 27.0e-6)
+        cf = find_bipm_correction("BIPM2017")
+        assert np.isclose(cf.evaluate_sec(55000.0), 27.6e-6)
+        # a newer request falls back to the latest available
+        cf = find_bipm_correction("TT(BIPM2019)")
+        assert np.isclose(cf.evaluate_sec(55000.0), 27.6e-6)
+        # an older request never uses a newer realization
+        cf = find_bipm_correction("BIPM2015")
+        assert np.isclose(cf.evaluate_sec(55000.0), 27.0e-6)
+        assert find_bipm_correction("BIPM2014") is None
+
+    def test_bipm_applied_to_ticks(self, tmp_path, monkeypatch):
+        from pint_tpu.toa import TOA, TOAs
+
+        monkeypatch.setenv("PINT_TPU_CLOCK_DIR", str(tmp_path))
+        self._write_bipm(tmp_path, 2017, 27.6e-6)
+        t = [TOA(55000, 0, 1, 1.0, 1400.0, "gbt", {}, "x")]
+        plain = TOAs(list(t), include_clock=True)
+        bipm = TOAs(list(t), include_clock=True, include_bipm=True,
+                    bipm_version="BIPM2017")
+        dt = (bipm.ticks[0] - plain.ticks[0]) / 2**32
+        assert np.isclose(dt, 27.6e-6, atol=1e-9)
+
+    def test_par_clk_requests_bipm(self, tmp_path, monkeypatch):
+        """CLK TT(BIPM2017) in the par is honored end to end."""
+        import warnings as W
+
+        from pint_tpu.models.builder import get_model_and_toas
+        from pint_tpu.simulation import make_fake_toas_uniform
+        from pint_tpu.models.builder import get_model
+        from pint_tpu.toa import write_tim
+
+        par = tmp_path / "b.par"
+        par.write_text(
+            "PSR J0\nRAJ 05:00:00\nDECJ 15:00:00\nF0 100 1\n"
+            "PEPOCH 54100\nDM 10\nUNITS TDB\nCLK TT(BIPM2017)\n"
+            "EPHEM builtin\n")
+        m = get_model(str(par))
+        toas = make_fake_toas_uniform(54000, 54010, 4, m, obs="gbt")
+        tim = tmp_path / "b.tim"
+        write_tim(toas, str(tim))
+        monkeypatch.setenv("PINT_TPU_CLOCK_DIR", str(tmp_path))
+        self._write_bipm(tmp_path, 2017, 27.6e-6)
+        m1, t1 = get_model_and_toas(str(par), str(tim))
+        m2, t2 = get_model_and_toas(str(par), str(tim),
+                                    include_bipm=False)
+        dt = (t1.ticks - t2.ticks) / 2**32
+        assert np.allclose(dt, 27.6e-6, atol=1e-9)
+        # and without the data file, a loud warning
+        monkeypatch.setenv("PINT_TPU_CLOCK_DIR", str(tmp_path / "none"))
+        with W.catch_warnings(record=True) as rec:
+            W.simplefilter("always")
+            get_model_and_toas(str(par), str(tim))
+        assert any("BIPM" in str(w.message) for w in rec)
+
+
+class TestExportClockFiles:
+    def test_export_roundtrip(self, tmp_path, monkeypatch):
+        src = tmp_path / "src"
+        src.mkdir()
+        (src / "gbt2gps.clk").write_text(
+            "# GBT GPS\n50000.0 1e-6\n60000.0 1e-6\n")
+        monkeypatch.setenv("PINT_TPU_CLOCK_DIR", str(src))
+        from pint_tpu.obs import export_all_clock_files
+
+        out = tmp_path / "exported"
+        written = export_all_clock_files(str(out))
+        assert any(p.endswith("gbt2utc.clk") for p in written)
+        cf = ClockFile.read_tempo2(
+            [p for p in written if p.endswith("gbt2utc.clk")][0])
+        assert np.isclose(cf.evaluate_sec(55000.0), 1e-6)
+
+
+class TestLogging:
+    def test_dedup_and_levels(self):
+        from pint_tpu.logging import DedupFilter, setup, log
+
+        buf = io.StringIO()
+        setup(level="INFO", dedup=True, max_repeats=2, stream=buf)
+        for _ in range(5):
+            log.warning("repeated message")
+        out = buf.getvalue()
+        assert out.count("repeated message") == 2
+        assert "further repeats hidden" in out
+        buf2 = io.StringIO()
+        setup(level="ERROR", dedup=False, stream=buf2)
+        log.warning("should be hidden")
+        assert buf2.getvalue() == ""
+
+    def test_log_once(self):
+        from pint_tpu.logging import log_once, setup, log
+
+        buf = io.StringIO()
+        setup(level="INFO", dedup=False, stream=buf)
+        for _ in range(3):
+            log_once("info", "exactly once %d", 7)
+        assert buf.getvalue().count("exactly once 7") == 1
+
+    def test_env_level(self, monkeypatch):
+        from pint_tpu.logging import setup, log
+
+        monkeypatch.setenv("PINT_TPU_LOG", "DEBUG")
+        setup(dedup=False)
+        assert log.level == pylogging.DEBUG
+        setup(level="WARNING")  # restore
+
+    def test_verbosity_args(self):
+        import argparse
+
+        from pint_tpu.logging import apply_verbosity, get_verbosity_args
+
+        ap = get_verbosity_args(argparse.ArgumentParser())
+        args = ap.parse_args(["-vv"])
+        lg = apply_verbosity(args)
+        assert lg.level == pylogging.DEBUG
+        args = ap.parse_args(["-q"])
+        lg = apply_verbosity(args)
+        assert lg.level == pylogging.ERROR
+        from pint_tpu.logging import setup
+
+        setup(level="WARNING")
