@@ -103,6 +103,10 @@ class BayesianTiming:
             if name in priors:
                 self.priors[name] = priors[name]
                 continue
+            pprior = getattr(params[name], "prior", None)
+            if pprior is not None:
+                self.priors[name] = pprior
+                continue
             unc = params[name].uncertainty
             val = float(self.model.values[name])
             if not unc:

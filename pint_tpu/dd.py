@@ -8,9 +8,20 @@ to <1e-4 turns).  The reference package solves this with ``numpy.longdouble``
 ``split``/``day_frac``).  Here the same idea is taken further: every
 precision-critical quantity is an unevaluated sum of two float64s
 ``hi + lo`` with ``|lo| <= ulp(hi)/2``, giving ~32 significant digits —
-more than longdouble — and it runs on the MXU-adjacent vector units of any
-accelerator that implements IEEE float64 (XLA:TPU emulates correctly-rounded
-f64; XLA does not re-associate floats, so the error terms survive jit).
+more than longdouble.
+
+**Backend validity (measured; see TPU_PRECISION.md):** error-free
+transformations require correctly-rounded IEEE f64 arithmetic.  That
+holds on the CPU backend (XLA does not re-associate floats, so the
+error terms survive jit) — dd arithmetic is fully accurate there, and
+it is the longdouble-replacement used in tests and host-side oracles.
+On TPU, f64 is *emulated at ~49-bit effective precision* (adds measured
+up to 16 ulps off correctly rounded), which silently breaks the Dekker/
+Knuth error terms: dd degrades to ~1e-16 relative on TPU and MUST NOT
+be trusted beyond plain f64 there.  That is why the on-device
+precision-critical path (F0*t phase accumulation) is exact int64 fixed
+point instead — see :mod:`pint_tpu.fixedpoint`, whose module docstring
+states the same division of labor.
 
 Algorithms are the classical error-free transformations (Dekker 1971,
 Knuth TAOCP v2, Shewchuk 1997) as used in the QD library of Hida, Li &

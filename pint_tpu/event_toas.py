@@ -62,11 +62,14 @@ def _mjdref(header):
 
 def load_event_TOAs(path, mission, weights=None, extname="EVENTS",
                     energy_range_kev=None, errors_us=None,
-                    ephem="builtin", planets=False):
+                    ephem="builtin", planets=False, orbfile=None):
     """Read photon events into a TOAs object.
 
     weights: None | array | column name (e.g. Fermi 'WEIGHT'); stored as
     ``-weight`` flags for the photon-likelihood fitters.
+    orbfile: FPorbit/FT2 spacecraft orbit file — registers an orbiting
+    observatory (reference satellite_obs.py) so spacecraft-local event
+    times use real orbital geometry instead of the geocenter.
     """
     header, data = read_events(path, extname=extname)
     time = np.asarray(data["TIME"], dtype=np.float64)
@@ -80,11 +83,17 @@ def load_event_TOAs(path, mission, weights=None, extname="EVENTS",
     elif timeref in ("GEOCENTRIC", "GEOCENTER"):
         obs = "geocenter"
         scale = timesys.lower()
+    elif orbfile is not None:
+        from pint_tpu.obs.satellite import get_satellite_observatory
+
+        get_satellite_observatory(mission, orbfile)
+        obs = mission.lower()
+        scale = timesys.lower()
     else:
         warnings.warn(
             f"event file TIMEREF={timeref!r} (spacecraft-local times); "
-            "treating as geocentric — barycenter the events for "
-            "absolute timing"
+            "treating as geocentric — pass an orbit file (orbfile=/"
+            "--orbfile) or barycenter the events for absolute timing"
         )
         obs = "geocenter"
         scale = timesys.lower()

@@ -152,3 +152,36 @@ def renorm_phase(n, frac):
     # floor(frac + 0.5), not round(): half-to-even would leave frac == +0.5
     shift = jnp.floor(frac + 0.5)
     return n + shift.astype(jnp.int64), frac - shift
+
+
+def backend_f64_is_ieee(backend=None):
+    """Cheap runtime selftest: does the active backend's f64 support
+    error-free transformations (i.e. correctly-rounded IEEE adds)?
+
+    True on real-IEEE backends (CPU), False on TPU's ~49-bit f64
+    emulation (measured; TPU_PRECISION.md).  Gates whether dd
+    arithmetic (pint_tpu.dd) may be trusted beyond plain f64 on this
+    device."""
+    import numpy as np
+
+    def probe(a, b):
+        s = a + b
+        bb = s - a
+        err = (a - (s - bb)) + (b - bb)  # Knuth two_sum error term
+        return s, err
+
+    jprobe = jax.jit(probe, backend=backend)
+    # pairs whose exact sum needs > 53 bits: the error term is nonzero
+    # under IEEE and must reconstruct the exact value
+    a = jnp.float64(1.0)
+    b = jnp.float64(1e-17)
+    s, err = jprobe(a, b)
+    # exact: s = 1.0, err = 1e-17 under correct rounding
+    ok = (float(s) == 1.0) and (float(err) == 1e-17)
+    # a second, adversarial pair
+    a2 = jnp.float64(4e11)
+    b2 = jnp.float64(-1.2345678901234567e-5)
+    s2, e2 = jprobe(a2, b2)
+    exact = np.float64(4e11) + np.float64(-1.2345678901234567e-5)
+    ok &= float(s2) == float(exact)
+    return bool(ok)

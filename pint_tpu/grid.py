@@ -121,3 +121,28 @@ def grid_chisq(toas, model, param_names, param_arrays, n_steps=3,
         toas, model, param_names, mesh, n_steps=n_steps, chunk=chunk
     )
     return chi2.reshape([len(a) for a in axes])
+
+
+def grid_chisq_derived(toas, model, param_names, parfuncs, grid_arrays,
+                       n_steps=3, chunk=None):
+    """chi^2 over a grid of *derived* coordinates (reference:
+    gridutils.grid_chisq_derived, gridutils.py:392).
+
+    param_names: the real model parameters held fixed per point;
+    parfuncs: same-length list of callables mapping the grid coordinate
+    tuple -> that parameter's value (e.g. grid over (Mtot, q) while the
+    model is fit in (M2, SINI)); grid_arrays: 1-D axes of the derived
+    coordinates.
+
+    Returns (chi2 shaped like the mesh, param_values (npoints, k))."""
+    axes = [np.asarray(a, dtype=np.float64) for a in grid_arrays]
+    mesh = np.array(list(itertools.product(*axes)))
+    # derived coords -> concrete parameter values per point (host side:
+    # arbitrary python/numpy functions are allowed, like the reference)
+    pvals = np.stack(
+        [np.asarray([f(*pt) for pt in mesh], dtype=np.float64)
+         for f in parfuncs], axis=1)
+    chi2, _ = grid_chisq_vectorized(
+        toas, model, list(param_names), pvals, n_steps=n_steps,
+        chunk=chunk)
+    return (np.asarray(chi2).reshape([len(a) for a in axes]), pvals)

@@ -46,14 +46,23 @@ class LMFitter(Fitter):
 
     def _chi2_of_vec(self, vec, base_values):
         values = self._merged(base_values, vec)
-        return self.resids.chi2_fn(values)
+        resid_fn = self._lm_resid_fn(base_values)
+        r = resid_fn(vec)
+        return jnp.sum((r / self._lm_sigma(values)) ** 2)
+
+    # hooks the wideband subclass overrides with the stacked system
+    def _lm_resid_fn(self, base_values):
+        return self._resid_fn_of(base_values)
+
+    def _lm_sigma(self, values):
+        return self.resids.sigma_fn(values)
 
     def _lm_solve(self, vec, base_values, lam):
         """One damped step at fixed lambda: (J^T W J + lam diag) d =
         -J^T W r on the whitened residuals."""
-        resid_fn = self._resid_fn_of(base_values)
+        resid_fn = self._lm_resid_fn(base_values)
         values = self._merged(base_values, vec)
-        sigma = self.resids.sigma_fn(values)
+        sigma = self._lm_sigma(values)
         r = resid_fn(vec)
         J = jax.jacfwd(resid_fn)(vec)
         w = 1.0 / sigma
@@ -172,3 +181,36 @@ class PowellFitter(Fitter):
         self.covariance = None
         self._update_fit_meta()
         return float(self.resids.chi2)
+
+class WidebandLMFitter(LMFitter):
+    """Levenberg-Marquardt on the wideband stacked [time; DM] system
+    (reference: WidebandLMFitter, fitter.py:2766)."""
+
+    def __init__(self, toas, model, residuals=None):
+        from pint_tpu.residuals import WidebandTOAResiduals
+
+        if residuals is None:
+            residuals = WidebandTOAResiduals(toas, model)
+        super().__init__(toas, model, residuals=residuals)
+
+    def _lm_resid_fn(self, base_values):
+        free = self._traced_free
+        toa_r = self.resids.toa
+        dm_r = self.resids.dm
+
+        def resid_fn(v):
+            values = dict(base_values)
+            for i, name in enumerate(free):
+                values[name] = v[i]
+            return jnp.concatenate(
+                [toa_r.time_resids_fn(values),
+                 dm_r.dm_resids_fn(values)]
+            )
+
+        return resid_fn
+
+    def _lm_sigma(self, values):
+        return jnp.concatenate(
+            [self.resids.toa.sigma_fn(values),
+             self.resids.dm.sigma_fn(values)]
+        )

@@ -21,7 +21,7 @@ import numpy as np
 from pint_tpu.bayesian import UniformPrior
 from pint_tpu.sampler import EnsembleSampler
 
-__all__ = ["MCMCFitter"]
+__all__ = ["MCMCFitter", "CompositeMCMCFitter"]
 
 
 class MCMCFitter:
@@ -53,6 +53,13 @@ class MCMCFitter:
         for name in self.param_names:
             if name in priors:
                 self.priors[name] = priors[name]
+                continue
+            # per-parameter priors attached on the Param itself win
+            # over the uncertainty-derived default (reference: each
+            # Parameter carries a Prior object)
+            pprior = getattr(model.params[name], "prior", None)
+            if pprior is not None:
+                self.priors[name] = pprior
                 continue
             unc = model.params[name].uncertainty
             val = float(model.values[name])
@@ -112,6 +119,24 @@ class MCMCFitter:
         return lnp + lnl
 
     # -- driver ---------------------------------------------------------------
+    def lnlike_only(self, vec):
+        """Photon likelihood without the prior terms (used by the
+        composite multi-dataset fitter, which counts priors once)."""
+        values = dict(self._base)
+        for i, name in enumerate(self.param_names):
+            values[name] = vec[i]
+        phi = self._phases_fn(values)
+        if self._n_template:
+            f = self._density(phi, vec[self.nparams:])
+        elif self._binned:
+            f = self._density(phi)
+        else:
+            f = self._density(phi, jnp.asarray(self.template.params))
+        if self.weights is None:
+            return jnp.sum(jnp.log(jnp.maximum(f, 1e-300)))
+        return jnp.sum(jnp.log(jnp.maximum(
+            self.weights * f + (1.0 - self.weights), 1e-300)))
+
     def fit_toas(self, nwalkers=32, nsteps=500, seed=0, burn_frac=0.25):
         """Run the ensemble sampler; set model values to the
         max-posterior sample (reference MCMCFitter.fit_toas maxpost).
@@ -138,6 +163,64 @@ class MCMCFitter:
             self.model.values[name] = float(best[i])
         if self._n_template:
             self.template.params = np.asarray(best[self.nparams:])
+        burn = int(burn_frac * nsteps)
+        flat = s.flatchain(burn=burn)
+        params = self.model.params
+        for i, name in enumerate(self.param_names):
+            params[name].uncertainty = float(flat[:, i].std())
+        self.sampler = s
+        return lnp
+
+
+class CompositeMCMCFitter:
+    """Joint photon-likelihood MCMC over several event datasets sharing
+    one timing model (reference: the composite fitter behind
+    event_optimize_multiple).  Each dataset carries its own template
+    and photon weights; the timing parameters (and their priors,
+    counted once) are common."""
+
+    def __init__(self, toas_list, model, templates, weights_list=None,
+                 priors=None, width_sigma=10.0):
+        if weights_list is None:
+            weights_list = [None] * len(toas_list)
+        if len(templates) != len(toas_list):
+            raise ValueError("one template per dataset required")
+        self.model = model
+        self.fitters = [
+            MCMCFitter(t, model, tpl, weights=w, priors=priors,
+                       width_sigma=width_sigma)
+            for t, tpl, w in zip(toas_list, templates, weights_list)
+        ]
+        f0 = self.fitters[0]
+        self.param_names = f0.param_names
+        self.nparams = f0.nparams
+        self.priors = f0.priors
+
+    def lnposterior(self, vec):
+        lnp = 0.0
+        for i, name in enumerate(self.param_names):
+            lnp = lnp + self.priors[name].lnpdf(vec[i])
+        for f in self.fitters:
+            lnp = lnp + f.lnlike_only(vec)
+        return lnp
+
+    def fit_toas(self, nwalkers=32, nsteps=500, seed=0, burn_frac=0.25):
+        center = np.array(
+            [self.model.values[n] for n in self.param_names])
+        scales = []
+        for name in self.param_names:
+            p = self.priors[name]
+            scales.append(
+                (p.hi - p.lo) / 100.0 if isinstance(p, UniformPrior)
+                else p.sigma
+            )
+        s = EnsembleSampler(self.lnposterior, nwalkers=nwalkers,
+                            seed=seed)
+        x0 = s.initial_ball(center, np.array(scales))
+        s.run_mcmc(x0, nsteps)
+        best, lnp = s.max_posterior()
+        for i, name in enumerate(self.param_names):
+            self.model.values[name] = float(best[i])
         burn = int(burn_frac * nsteps)
         flat = s.flatchain(burn=burn)
         params = self.model.params

@@ -248,3 +248,123 @@ def bary_freq_mhz(toas, model) -> np.ndarray:
     except AttributeError:
         pass
     return bf
+
+
+# --- frame conversion with covariance (reference: timing_model.py
+# as_ECL:2961 / as_ICRS:3011, astrometry.py:651-669) -----------------------
+
+def _dir_and_pm(lon, lat, pmlon, pmlat):
+    """Unit vector + proper-motion velocity vector from spherical
+    coords (pmlon carries the cos(lat) convention, mas/yr)."""
+    cl, sl = jnp.cos(lon), jnp.sin(lon)
+    cb, sb = jnp.cos(lat), jnp.sin(lat)
+    n = jnp.array([cb * cl, cb * sl, sb])
+    e_lon = jnp.array([-sl, cl, 0.0])
+    e_lat = jnp.array([-sb * cl, -sb * sl, cb])
+    v = pmlon * e_lon + pmlat * e_lat
+    return n, v
+
+
+def _sph_from_dir(n, v):
+    lon = jnp.arctan2(n[1], n[0])
+    lat = jnp.arcsin(jnp.clip(n[2], -1.0, 1.0))
+    cl, sl = jnp.cos(lon), jnp.sin(lon)
+    cb, sb = jnp.cos(lat), jnp.sin(lat)
+    e_lon = jnp.array([-sl, cl, 0.0])
+    e_lat = jnp.array([-sb * cl, -sb * sl, cb])
+    return lon % (2.0 * jnp.pi), lat, v @ e_lon, v @ e_lat
+
+
+def _convert4(params, mat):
+    """(lon, lat, pmlon, pmlat) rotated by mat (3,3)."""
+    n, v = _dir_and_pm(*params)
+    return jnp.stack(_sph_from_dir(mat @ n, mat @ v))
+
+
+def model_as_ECL(model, ecl="IERS2010"):
+    """A copy of the model with equatorial astrometry converted to
+    ecliptic (or the ecliptic re-referenced to another obliquity),
+    uncertainties propagated through the exact rotation jacobian
+    (reference: TimingModel.as_ECL, timing_model.py:2961)."""
+    import copy
+
+    import jax
+
+    out = copy.deepcopy(model)
+    mat = jnp.asarray(eq_from_ecl_matrix(OBLIQUITY_ARCSEC[ecl.upper()]))
+    if out.has_component("AstrometryEcliptic"):
+        comp = out.component("AstrometryEcliptic")
+        if comp.ecl_name == ecl.upper():
+            return out
+        old = jnp.asarray(comp.eq_from_ecl)
+        rot = mat.T @ old  # old-ecl -> icrs -> new-ecl
+        src = ("ELONG", "ELAT", "PMELONG", "PMELAT")
+        dst = src
+    else:
+        comp_old = out.component("AstrometryEquatorial")
+        rot = mat.T  # icrs -> ecl
+        src = ("RAJ", "DECJ", "PMRA", "PMDEC")
+        dst = ("ELONG", "ELAT", "PMELONG", "PMELAT")
+        from pint_tpu.models.astrometry import AstrometryEcliptic
+
+        comp = AstrometryEcliptic()
+        comp.build_params({})
+        # carry PX/POSEPOCH state
+        out.components = [
+            c if type(c).__name__ != "AstrometryEquatorial" else comp
+            for c in out.components
+        ]
+    comp.ecl_name = ecl.upper()
+    out.meta["ECL"] = ecl.upper()
+    _apply_frame_rotation(out, model, rot, src, dst)
+    return out
+
+
+def model_as_ICRS(model):
+    """A copy of the model with ecliptic astrometry converted to
+    equatorial (reference: TimingModel.as_ICRS, timing_model.py:3011)."""
+    import copy
+
+    out = copy.deepcopy(model)
+    if out.has_component("AstrometryEquatorial"):
+        return out
+    comp_old = out.component("AstrometryEcliptic")
+    rot = jnp.asarray(comp_old.eq_from_ecl)  # ecl -> icrs
+    new = AstrometryEquatorial()
+    new.build_params({})
+    out.components = [
+        c if type(c).__name__ != "AstrometryEcliptic" else new
+        for c in out.components
+    ]
+    out.meta.pop("ECL", None)
+    _apply_frame_rotation(out, model, rot,
+                          ("ELONG", "ELAT", "PMELONG", "PMELAT"),
+                          ("RAJ", "DECJ", "PMRA", "PMDEC"))
+    return out
+
+
+def _apply_frame_rotation(out, model, rot, src, dst):
+    import jax
+
+    vals = jnp.array([float(model.values[k]) for k in src])
+    new_vals = _convert4(vals, rot)
+    J = jax.jacfwd(lambda p: _convert4(p, rot))(vals)
+    sig = np.array([
+        float(model.params[k].uncertainty or 0.0) for k in src
+    ])
+    # angle params are radians internally, PMs mas/yr — the jacobian is
+    # in internal units throughout, so a diagonal input covariance
+    # propagates directly
+    cov = np.asarray(J) @ np.diag(sig**2) @ np.asarray(J).T
+    for k in src:
+        if k not in dst:
+            out.values.pop(k, None)
+    for i, k in enumerate(dst):
+        out.values[k] = float(new_vals[i])
+        if k in out.params:
+            out.params[k].uncertainty = float(np.sqrt(max(cov[i, i],
+                                                          0.0)))
+    # PX / POSEPOCH are frame-invariant: carry them over
+    for k in ("PX", "POSEPOCH"):
+        if k in model.values:
+            out.values[k] = model.values[k]

@@ -15,7 +15,7 @@ Families land in submodules: ``ell1`` (ELL1/ELL1H/ELL1k), ``bt`` (BT),
 
 from pint_tpu.models.binary.base import BinaryComponent, get_binary_class
 from pint_tpu.models.binary.ell1 import BinaryELL1, BinaryELL1H, BinaryELL1k  # noqa: F401
-from pint_tpu.models.binary.bt import BinaryBT  # noqa: F401
+from pint_tpu.models.binary.bt import BinaryBT, BinaryBTPiecewise  # noqa: F401
 from pint_tpu.models.binary.dd import (  # noqa: F401
     BinaryDD,
     BinaryDDGR,

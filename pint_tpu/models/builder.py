@@ -151,12 +151,14 @@ def choose_components(pardict) -> List[type]:
     return chosen
 
 
-def get_model(parfile, allow_tcb=False) -> TimingModel:
+def get_model(parfile, allow_tcb=False, allow_T2=False) -> TimingModel:
     """Build a TimingModel from a par file (path or text).
 
     ``allow_tcb=True`` converts a ``UNITS TCB`` par to TDB on the fly
     (approximate — re-fit afterwards; reference: model_builder allow_tcb
-    + tcb_conversion.convert_tcb_tdb)."""
+    + tcb_conversion.convert_tcb_tdb).  ``allow_T2=True`` maps a Tempo2
+    ``BINARY T2`` par onto the best-covering concrete binary model
+    (reference allow_T2 / guess_binary_model)."""
     if allow_tcb:
         if os.path.exists(str(parfile)) and "\n" not in str(parfile):
             with open(parfile) as f:
@@ -190,7 +192,19 @@ def get_model(parfile, allow_tcb=False) -> TimingModel:
     if "BINARY" in pardict:
         from pint_tpu.models.binary import get_binary_class
 
-        get_binary_class(pardict["BINARY"][0][0])  # raises if unknown
+        bname = pardict["BINARY"][0][0]
+        if bname.upper() == "T2":
+            if not allow_T2:
+                raise NotImplementedError(
+                    "BINARY T2 is a Tempo2 meta-model; pass allow_T2="
+                    "True (or run t2binary2pint) to map it onto the "
+                    "best concrete model")
+            pardict, chosen_name = convert_t2_binary(pardict)
+            warnings.warn(
+                f"BINARY T2 mapped onto {chosen_name} "
+                "(reference guess_binary_model semantics)")
+        else:
+            get_binary_class(bname)  # raises if unknown
 
     # mask-parameter selectors must exist before component instantiation
     mask_keys = list(_MASK_KEYS) + [
@@ -298,6 +312,58 @@ def get_model(parfile, allow_tcb=False) -> TimingModel:
     ):
         raise ValueError("par file lacks F0 (no spindown model)")
     return model
+
+
+#: priority order for T2 binary-model guessing (reference
+#: model_builder.py:40 _binary_model_priority)
+_BINARY_PRIORITY = ["BT", "ELL1", "ELL1H", "ELL1K", "DD", "DDK",
+                    "DDGR", "DDS", "DDH"]
+
+
+def guess_binary_model(pardict):
+    """Priority-ordered candidate binary models for a Tempo2 ``BINARY
+    T2`` par (reference: guess_binary_model, model_builder.py:970):
+    every model whose parameter set covers the par's binary-looking
+    parameters, best guess first."""
+    from pint_tpu.models.binary import get_binary_class
+    from pint_tpu.models.component import BINARY_MODELS
+
+    model_params = {}
+    all_binary_params = set()
+    for name in _BINARY_PRIORITY:
+        if name not in BINARY_MODELS:
+            continue
+        comp = get_binary_class(name)()
+        comp.build_params(pardict)  # params materialize lazily
+        names = set()
+        for p in comp.params:
+            names.add(p.name)
+            names.update(a.upper() for a in p.aliases)
+        # FBn / orbital-frequency family and common tempo2 extras
+        names.update(f"FB{i}" for i in range(10))
+        if "KIN" in names:
+            names.add("SINI")  # tempo2 T2+KIN convention
+        model_params[name] = names
+        all_binary_params |= names
+    in_par = {k for k in pardict if k in all_binary_params}
+    ranked = [name for name in _BINARY_PRIORITY
+              if name in model_params
+              and not (in_par - model_params[name])]
+    if not ranked:
+        raise ValueError(
+            "no implemented binary model covers the par's binary "
+            f"parameters {sorted(in_par)}")
+    return ranked
+
+
+def convert_t2_binary(pardict):
+    """Rewrite a ``BINARY T2`` par dict to the best concrete model
+    (reference: the allow_T2 path of ModelBuilder.choose_binary_model).
+    Returns (new_pardict, chosen_model_name)."""
+    chosen = guess_binary_model(pardict)[0]
+    out = dict(pardict)
+    out["BINARY"] = [[chosen]]
+    return out, chosen
 
 
 def get_model_and_toas(parfile, timfile, **kw):

@@ -120,6 +120,10 @@ class Param:
     uncertainty: Optional[float] = None
     #: raw par-file string (kept for exact round-trip of unfit params)
     raw: Optional[str] = None
+    #: optional per-parameter prior (an object with lnpdf(x), e.g.
+    #: bayesian.UniformPrior/NormalPrior; reference: each Parameter
+    #: carries a Prior used by BayesianTiming and MCMC walker init)
+    prior: Optional[object] = None
 
     def parse(self, s: str) -> float:
         if self.kind == "angle":
@@ -155,3 +159,52 @@ class Param:
             return "Y" if value else "N"
         return repr(value / self.scale) if self.scale != 1.0 else f"{value:.{ndigits}g}"
 
+
+
+class funcParameter:
+    """Read-only derived parameter (reference: parameter.py:2373
+    funcParameter): computed on demand from other model values.
+
+    func(*vals) -> float, with ``depends`` naming the source params.
+    Attach with ``model.add_func_param(...)`` and read through
+    ``model.func_value(name)`` (or the attribute-style accessor the
+    model exposes)."""
+
+    def __init__(self, name, func, depends, description="", units=""):
+        self.name = name
+        self.func = func
+        self.depends = tuple(depends)
+        self.description = description
+        self.units = units
+        self.frozen = True
+        self.fittable = False
+
+    def value(self, model):
+        return self.func(*(model.values[d] for d in self.depends))
+
+
+class pairParameter:
+    """A two-component parameter (reference: parameter.py:2196
+    pairParameter, e.g. WAVEn sine/cosine pairs): parsed/written as two
+    tokens, stored as component values ``NAME_A``/``NAME_B`` in the
+    model values dict."""
+
+    def __init__(self, name, description="", units=""):
+        self.name = name
+        self.description = description
+        self.units = units
+        self.frozen = True
+        self.fittable = False
+
+    @property
+    def component_names(self):
+        return (f"{self.name}_A", f"{self.name}_B")
+
+    def parse_pair(self, tokens):
+        a = float(str(tokens[0]).upper().replace("D", "E"))
+        b = float(str(tokens[1]).upper().replace("D", "E")) \
+            if len(tokens) > 1 else 0.0
+        return a, b
+
+    def format_pair(self, a, b):
+        return f"{a!r} {b!r}"

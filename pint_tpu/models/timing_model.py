@@ -169,11 +169,40 @@ class TimingModel:
             raise KeyError(name)
         self.values[name] = float(value)
 
+    # -- derived (func) parameters (reference funcParameter) -----------------
+    def add_func_param(self, func_param):
+        """Register a read-only derived parameter (an instance of
+        pint_tpu.models.parameter.funcParameter)."""
+        if not hasattr(self, "_func_params"):
+            self._func_params = {}
+        self._func_params[func_param.name] = func_param
+
+    def func_value(self, name):
+        return self._func_params[name].value(self)
+
+    @property
+    def func_params(self):
+        return dict(getattr(self, "_func_params", {}))
+
     # -- preparation ---------------------------------------------------------
     def prepare(self, toas) -> "PreparedModel":
         return PreparedModel(self, toas)
 
     # -- output --------------------------------------------------------------
+    def as_ECL(self, ecl="IERS2010"):
+        """Copy with astrometry in ecliptic coordinates (covariance-
+        propagated; reference timing_model.py:2961)."""
+        from pint_tpu.models.astrometry import model_as_ECL
+
+        return model_as_ECL(self, ecl)
+
+    def as_ICRS(self):
+        """Copy with astrometry in equatorial coordinates (reference
+        timing_model.py:3011)."""
+        from pint_tpu.models.astrometry import model_as_ICRS
+
+        return model_as_ICRS(self)
+
     def as_parfile(self) -> str:
         from pint_tpu.models.builder import model_to_parfile
 
@@ -246,6 +275,16 @@ class PreparedModel:
         self.ctx = {
             type(c).__name__: c.prepare(toas, model) for c in model.components
         }
+        # heterogeneous-PTA superset gating: components added only to
+        # align structures across pulsars get a 0.0 gate (their shared
+        # parameter names — PB/A1/T0... — would otherwise make them
+        # active); every component carries the key so the batched ctx
+        # structure is uniform (pint_tpu.parallel.pta superset)
+        inert = getattr(model, "_superset_inert", None)
+        if inert is not None:
+            for name, c_ctx in self.ctx.items():
+                c_ctx["__gate__"] = jnp.float64(
+                    0.0 if name in inert else 1.0)
         # TZR reference: a single synthetic TOA evaluated through the SAME
         # chain — but with its OWN prepare-time ctx (masks, dt_ticks, ...);
         # reusing the data ctx would silently evaluate TZR with data-TOA
@@ -261,6 +300,10 @@ class PreparedModel:
                         type(cc).__name__: cc.prepare(tzr_toas, model)
                         for cc in model.components
                     }
+                    if inert is not None:
+                        for name, c_ctx in self.tzr_ctx.items():
+                            c_ctx["__gate__"] = jnp.float64(
+                                0.0 if name in inert else 1.0)
         # correlated-noise bases are static per dataset; stack them once
         # (reference: noise_model_designmatrix, timing_model.py:1690)
         self._noise_basis_comps = []
@@ -334,7 +377,10 @@ class PreparedModel:
         total = jnp.zeros(batch.ticks.shape, dtype=jnp.float64)
         for c in self.model.delay_components:
             ctx = ctx_map[type(c).__name__]
-            total = total + c.delay(values, batch, ctx, total)
+            d = c.delay(values, batch, ctx, total)
+            if "__gate__" in ctx:
+                d = d * ctx["__gate__"]
+            total = total + d
         return total
 
     def _phase_sum(self, values, batch, ctx_map):
@@ -344,11 +390,18 @@ class PreparedModel:
         for c in self.model.phase_components:
             ctx = ctx_map[type(c).__name__]
             ph = c.phase(values, batch, ctx, delay)
+            gate = ctx.get("__gate__")
             if isinstance(ph, tuple):
-                n = n + ph[0]
-                frac = frac + ph[1]
+                if gate is not None:
+                    # int part cannot be float-gated; superset-added
+                    # phase components contribute (0, 0) when inert
+                    n = n + jnp.where(gate > 0, ph[0], 0)
+                    frac = frac + ph[1] * gate
+                else:
+                    n = n + ph[0]
+                    frac = frac + ph[1]
             else:
-                frac = frac + ph
+                frac = frac + (ph if gate is None else ph * gate)
         return n, frac
 
     def _phase_raw(self, values):

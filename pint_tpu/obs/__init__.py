@@ -19,6 +19,7 @@ import warnings
 
 import numpy as np
 
+from pint_tpu import C_M_PER_S
 from pint_tpu.ephem import PosVel, get_ephemeris
 from pint_tpu.obs.erot import gcrs_posvel_from_itrf
 
@@ -131,6 +132,45 @@ class GeocenterObs(Observatory):
         return body_posvel_ssb("earth", ticks, ephem)
 
 
+class T2SpacecraftObs(Observatory):
+    """Spacecraft with per-TOA GCRS position given by tempo2-convention
+    TOA flags: -telx/-tely/-telz [km], -vx/-vy/-vz [km/s] (reference:
+    special_locations.py:161).  No GPS/site clock chain is assumed."""
+
+    #: TOAs passes per-TOA flag dicts into posvel_ssb
+    needs_flags = True
+
+    def clock_corrections_sec(self, utc_mjd_float):
+        return np.zeros_like(np.asarray(utc_mjd_float, np.float64))
+
+    def posvel_gcrs(self, ticks, flags):
+        def col(key, what):
+            try:
+                return np.array([float(f[key]) for f in flags])
+            except KeyError:
+                raise ValueError(
+                    f"TOA lines for '{self.name}' need -telx/-tely/-telz "
+                    f"(GCRS km) and -vx/-vy/-vz (km/s) flags; missing "
+                    f"-{key} ({what})")
+
+        km = 1000.0 / C_M_PER_S  # km -> light-seconds
+        pos = np.stack([col(k, "position") for k in
+                        ("telx", "tely", "telz")], axis=-1) * km
+        vel = np.stack([col(k, "velocity") for k in
+                        ("vx", "vy", "vz")], axis=-1) * km
+        return PosVel(pos, vel)
+
+    def posvel_ssb(self, ticks, ephem="builtin", flags=None) -> PosVel:
+        from pint_tpu.ephem import body_posvel_ssb
+
+        if flags is None:
+            raise ValueError(
+                "T2SpacecraftObs needs the per-TOA flags to resolve its "
+                "position")
+        earth = body_posvel_ssb("earth", ticks, ephem)
+        return earth + self.posvel_gcrs(ticks, flags)
+
+
 def get_observatory(name) -> Observatory:
     """Resolve an observatory by name / alias / tempo code / ITOA code."""
     _ensure_builtin()
@@ -224,6 +264,7 @@ def _ensure_builtin():
         TopoObs(name, xyz, tempo_code=tcode, itoa_code=icode, aliases=aliases)
     BarycenterObs("barycenter", aliases=("@", "bat", "ssb"))
     GeocenterObs("geocenter", aliases=("coe", "0"), itoa_code="GC")
+    T2SpacecraftObs("stl_geo", aliases=("spacecraft", "stl"))
     override = os.environ.get("PINT_TPU_OBS")
     if override:
         with open(override) as f:

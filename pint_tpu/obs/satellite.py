@@ -1,0 +1,140 @@
+"""Orbiting observatories: spacecraft position from orbit FITS files.
+
+Counterpart of the reference's satellite_obs.py (SatelliteObs at :283,
+load_FPorbit/load_FT2/load_nustar_orbit): the photon pipeline
+(photonphase/fermiphase) needs the spacecraft's GCRS position at each
+event time.  Supported products:
+
+- FPorbit (RXTE/NICER/NuSTAR-style): binary table ``ORBIT``/``XTE_PE``
+  with Time (MET s, TT) and X/Y/Z [m] (+ optional Vx/Vy/Vz [m/s]);
+- Fermi FT2: binary table ``SC_DATA`` with START and SC_POSITION [m]
+  (velocities derived by differentiation, like the reference).
+
+Positions are spline-interpolated (cubic, scipy) at the TOA epochs;
+requests farther than ``maxextrap_min`` from the nearest tabulated
+point are an error (reference maxextrap semantics)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pint_tpu import C_M_PER_S
+from pint_tpu.ephem import PosVel, body_posvel_ssb
+from pint_tpu.fits import read_fits
+from pint_tpu.obs import Observatory
+from pint_tpu.time.scales import tdb_minus_tt_seconds
+
+_MJD_J2000 = 51544.5
+
+
+def _mjdref_days(header):
+    if "MJDREFI" in header:
+        return float(header["MJDREFI"]) + float(header.get("MJDREFF", 0.0))
+    return float(header.get("MJDREF", 0.0))
+
+
+def load_orbit(path):
+    """(mjd_tt, pos_m (n,3), vel_mps (n,3)) from an FPorbit or FT2
+    file (reference: load_FPorbit satellite_obs.py, load_FT2)."""
+    hdus = read_fits(path)
+    orbit = None
+    for h in hdus[1:]:
+        if h.name.upper() in ("ORBIT", "XTE_PE", "SC_DATA", "PREFILTER"):
+            orbit = h
+            break
+    if orbit is None and len(hdus) > 1 and hdus[1].data:
+        orbit = hdus[1]
+    if orbit is None or not orbit.data:
+        raise ValueError(f"{path}: no orbit table found")
+    hdr = orbit.header
+    ref = _mjdref_days(hdr)
+    tz = float(hdr.get("TIMEZERO", 0.0))
+    cols = {k.upper(): k for k in orbit.data}
+    if "SC_POSITION" in cols:  # Fermi FT2
+        t = np.asarray(orbit.data[cols["START"]], np.float64)
+        pos = np.asarray(orbit.data[cols["SC_POSITION"]], np.float64)
+        mjd_tt = ref + (t + tz) / 86400.0
+        # FT2 has no velocity columns: differentiate (reference does
+        # the same for FT2 products)
+        tsec = (mjd_tt - mjd_tt[0]) * 86400.0
+        vel = np.gradient(pos, tsec, axis=0)
+    else:
+        t = np.asarray(orbit.data[cols["TIME"]], np.float64)
+        pos = np.stack([np.asarray(orbit.data[cols[c]], np.float64)
+                        for c in ("X", "Y", "Z")], axis=1)
+        mjd_tt = ref + (t + tz) / 86400.0
+        if "VX" in cols:
+            vel = np.stack([np.asarray(orbit.data[cols[c]], np.float64)
+                            for c in ("VX", "VY", "VZ")], axis=1)
+        else:
+            tsec = (mjd_tt - mjd_tt[0]) * 86400.0
+            vel = np.gradient(pos, tsec, axis=0)
+    order = np.argsort(mjd_tt, kind="stable")
+    return mjd_tt[order], pos[order], vel[order]
+
+
+class SatelliteObs(Observatory):
+    """An orbiting observatory (reference SatelliteObs,
+    satellite_obs.py:283).  Event times are TT at the spacecraft."""
+
+    is_barycenter = False
+
+    def __init__(self, name, orbit_file, maxextrap_min=2.0, aliases=(),
+                 **kw):
+        super().__init__(name, aliases=aliases, **kw)
+        self.orbit_file = orbit_file
+        mjd_tt, pos, vel = load_orbit(orbit_file)
+        self._mjd_tt = mjd_tt
+        from scipy.interpolate import InterpolatedUnivariateSpline
+
+        self._splines = [
+            InterpolatedUnivariateSpline(mjd_tt, pos[:, i],
+                                         ext="extrapolate")
+            for i in range(3)
+        ]
+        self._vsplines = [
+            InterpolatedUnivariateSpline(mjd_tt, vel[:, i],
+                                         ext="extrapolate")
+            for i in range(3)
+        ]
+        self.maxextrap_min = maxextrap_min
+
+    def _check_bounds(self, mjd_tt):
+        """Reject epochs farther than maxextrap from tabulated points
+        (reference _check_bounds, satellite_obs.py:341)."""
+        idx = np.clip(np.searchsorted(self._mjd_tt, mjd_tt), 1,
+                      len(self._mjd_tt) - 1)
+        near = np.minimum(np.abs(mjd_tt - self._mjd_tt[idx - 1]),
+                          np.abs(self._mjd_tt[idx] - mjd_tt))
+        worst = float(np.max(near)) * 1440.0
+        if worst > self.maxextrap_min:
+            raise ValueError(
+                f"satellite {self.name}: epochs up to {worst:.2f} min "
+                f"from the nearest orbit point (> maxextrap "
+                f"{self.maxextrap_min} min) — supply a matching orbit "
+                "file")
+
+    def posvel_gcrs(self, ticks):
+        tdb_sec = np.atleast_1d(np.asarray(ticks)).astype(np.float64) \
+            / 2**32
+        tt_sec = tdb_sec - tdb_minus_tt_seconds(tdb_sec)
+        mjd_tt = _MJD_J2000 + tt_sec / 86400.0
+        self._check_bounds(mjd_tt)
+        pos = np.stack([s(mjd_tt) for s in self._splines], axis=-1)
+        vel = np.stack([s(mjd_tt) for s in self._vsplines], axis=-1)
+        return PosVel(pos / C_M_PER_S, vel / C_M_PER_S)
+
+    def posvel_ssb(self, ticks, ephem="builtin") -> PosVel:
+        earth = body_posvel_ssb("earth", ticks, ephem)
+        return earth + self.posvel_gcrs(ticks)
+
+
+def get_satellite_observatory(name, orbit_file, overwrite=True, **kw):
+    """Create + register an orbiting observatory (reference:
+    get_satellite_observatory, satellite_obs.py)."""
+    from pint_tpu.obs import Observatory
+
+    key = str(name).lower()
+    if not overwrite and key in Observatory._registry:
+        raise ValueError(f"observatory {name} already registered")
+    return SatelliteObs(key, orbit_file, **kw)

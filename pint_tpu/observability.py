@@ -1,0 +1,69 @@
+"""Named-stage timing + profiling harness (reference: the profiling/
+directory's high_level_benchmark.py extracts named hot stages via
+pstats; plus SURVEY §5 metrics/observability gap).
+
+- ``stages = StageTimer(); with stages("Update Resids"): ...`` collects
+  wall times per named stage (cumulative over repeats);
+- ``stages.report()`` prints the reference-benchmark-style table;
+- ``trace(dir)`` context manager wraps ``jax.profiler.trace`` so the
+  XLA-level profile (TensorBoard format) lands next to the named-stage
+  numbers.
+
+Device-side work is asynchronous: StageTimer calls
+``jax.block_until_ready`` on the value you pass to ``tick`` (or
+relies on the with-block's own sync) so the walls mean what they say.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import OrderedDict
+
+__all__ = ["StageTimer", "trace"]
+
+
+class StageTimer:
+    def __init__(self):
+        self.totals: "OrderedDict[str, float]" = OrderedDict()
+        self.counts: "OrderedDict[str, int]" = OrderedDict()
+
+    @contextlib.contextmanager
+    def __call__(self, name, sync=None):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            if sync is not None:
+                import jax
+
+                jax.block_until_ready(sync)
+            dt = time.perf_counter() - t0
+            self.totals[name] = self.totals.get(name, 0.0) + dt
+            self.counts[name] = self.counts.get(name, 0) + 1
+
+    def report(self, file=None):
+        lines = [f"{'Stage':<28s} {'Total [s]':>10s} {'Calls':>6s} "
+                 f"{'Per call [s]':>13s}"]
+        for name, tot in self.totals.items():
+            n = self.counts[name]
+            lines.append(f"{name:<28s} {tot:>10.3f} {n:>6d} "
+                         f"{tot / n:>13.4f}")
+        out = "\n".join(lines)
+        print(out, file=file)
+        return out
+
+    def as_dict(self):
+        return dict(self.totals)
+
+
+@contextlib.contextmanager
+def trace(log_dir):
+    """XLA-level profile (TensorBoard trace) around a block."""
+    import jax
+
+    jax.profiler.start_trace(str(log_dir))
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -98,27 +98,61 @@ def _pad_ctx(ctx_map, n, n_max):
     return out
 
 
+def _pad_to_shape(arr, shape):
+    """Zero-pad an array up to the target shape (every axis)."""
+    arr = jnp.asarray(arr)
+    if tuple(arr.shape) == tuple(shape):
+        return arr
+    pads = [(0, t - s) for s, t in zip(arr.shape, shape)]
+    return jnp.pad(arr, pads)
+
+
 def _stack_ctxs(ctxs):
     """Split component ctx dicts into (stacked array part, static
     part).  Array leaves gain a leading pulsar axis; non-array leaves
     (tuples, ints — static jit structure) must agree across pulsars and
-    stay python values, closed over rather than vmapped."""
+    stay python values, closed over rather than vmapped.
+
+    Array leaves whose shapes differ across pulsars (heterogeneous
+    noise structure: ECORR epoch counts, Fourier mode counts, mask
+    lists) are zero-padded to the per-key elementwise maximum shape —
+    zero rows/columns are inert in every mask/basis use."""
     arrays = {}
     static = {}
     for comp in ctxs[0]:
         a, s = {}, {}
         for k, v0 in ctxs[0][comp].items():
             vals = [c[comp][k] for c in ctxs]
+            if hasattr(v0, "shape") and not isinstance(
+                    v0, (tuple, int, float, bool)):
+                shapes = [tuple(np.shape(v)) for v in vals]
+                if len(set(len(sh) for sh in shapes)) == 1 \
+                        and len(set(shapes)) > 1:
+                    target = tuple(max(sh[i] for sh in shapes)
+                                   for i in range(len(shapes[0])))
+                    vals = [_pad_to_shape(v, target) for v in vals]
             if hasattr(v0, "shape") and getattr(v0, "ndim", 0) >= 0 \
                     and not isinstance(v0, (tuple, int, float, bool)):
                 a[k] = jnp.stack([jnp.asarray(v) for v in vals])
             else:
-                if any(v != v0 for v in vals[1:]):
-                    raise ValueError(
-                        f"static ctx entry {comp}.{k} differs across "
-                        f"pulsars ({set(map(repr, vals))}) — the batch "
-                        "requires identical static structure"
-                    )
+                def _differs(a, b):
+                    if a != a and b != b:  # NaN == NaN here (e.g. the
+                        return False       # TZR PLRedNoise df sentinel)
+                    return a != b
+
+                if any(_differs(v, v0) for v in vals[1:]):
+                    # static entries that differ per pulsar are used
+                    # only by host-side weights()/basis() construction
+                    # (ECORR epoch 'counts', red-noise 'df'); the
+                    # batched trace never reads them — drop the key so
+                    # a trace that DOES need it fails loudly
+                    import warnings
+
+                    warnings.warn(
+                        f"per-pulsar static ctx entry {comp}.{k} "
+                        "dropped from the batched ctx (host-side "
+                        "noise-basis metadata)")
+                    continue
                 s[k] = v0
         arrays[comp] = a
         static[comp] = s
@@ -132,6 +166,95 @@ def _merge_ctx(arrays, static):
     }
 
 
+#: placeholder values for parameters whose neutral default would divide
+#: by zero, produce NaN, or inject variance when a superset component
+#: is inert.  Log-amplitude noise params MUST go to a deeply negative
+#: value: 0.0 would mean amplitude 10^0 and flood the GLS with ~1e12 s^2
+#: of spurious red-noise variance (the __gate__ mechanism covers only
+#: delay/phase contributions, not noise bases).
+_SUPERSET_PLACEHOLDERS = {
+    "PB": 365.25, "T0": 0.0, "TASC": 0.0,
+    "TNREDAMP": -100.0, "TNDMAMP": -100.0, "TNCHROMAMP": -100.0,
+}
+
+
+def make_superset_models(pairs):
+    """Rebuild every (model, toas) pair onto the union of component
+    classes (SURVEY §7 hard part #3): a pulsar missing a component gets
+    it with *neutral* values (A1=0 binary contributes zero delay, zero
+    glitch amplitudes, empty masks...), all its parameters frozen, so
+    an ELL1 + DD + isolated mix traces as ONE jit program.
+
+    DDK is excluded (its Kopeikin geometry needs real astrometry and
+    cannot be made inert by zeroing)."""
+    import copy
+
+    # donors: one representative instance per component class — copied
+    # (not re-built) so per-instance config (glitch indices, FB terms,
+    # mask selects) and therefore the values-pytree KEYS are identical
+    # across every pulsar in the batch
+    donors: dict = {}
+    order: List = []
+    for model, _ in pairs:
+        for c in model.components:
+            cls = type(c)
+            if cls.__name__ == "BinaryDDK":
+                raise ValueError(
+                    "BinaryDDK cannot participate in a heterogeneous "
+                    "superset (Kopeikin terms are not neutralizable)")
+            if cls not in order:
+                order.append(cls)
+                donors[cls] = c
+            elif len(c.params) > len(donors[cls].params):
+                donors[cls] = c  # widest family wins
+    out = []
+    for model, toas in pairs:
+        model = copy.deepcopy(model)
+        have = {type(c) for c in model.components}
+        inert = set()
+        for cls in order:
+            if cls in have:
+                # same class but a narrower family than the donor
+                # (fewer glitches, fewer FB terms) still needs key
+                # alignment: add the donor's missing params, frozen,
+                # at neutral values
+                mine = model.component(cls.__name__)
+                mine_names = {p.name for p in mine.params}
+                for p in donors[cls].params:
+                    if p.name not in mine_names:
+                        q = copy.deepcopy(p)
+                        q.frozen = True
+                        mine.add_param(q)
+                        model.values.setdefault(
+                            p.name,
+                            _SUPERSET_PLACEHOLDERS.get(p.name, 0.0))
+                continue
+            comp = copy.deepcopy(donors[cls])
+            model.add_component(comp)  # fills values with defaults
+            inert.add(cls.__name__)
+            for p in comp.params:
+                p.frozen = True
+                cur = model.values.get(p.name, np.nan)
+                if cur != cur:  # NaN default (e.g. PB) -> placeholder
+                    model.values[p.name] = _SUPERSET_PLACEHOLDERS.get(
+                        p.name, 0.0)
+        # added components must be INERT despite sharing parameter
+        # names (PB/A1/...) with the pulsar's real binary: prepare()
+        # attaches a 0/1 gate per component (timing_model.py)
+        model._superset_inert = inert
+        # deterministic order: same-category ties (two binary families)
+        # would otherwise keep per-model insertion order and defeat the
+        # identical-structure requirement
+        from pint_tpu.models.timing_model import DEFAULT_ORDER
+
+        cat_order = {cat: i for i, cat in enumerate(DEFAULT_ORDER)}
+        model.components.sort(
+            key=lambda c: (cat_order.get(c.category, 99),
+                           type(c).__name__))
+        out.append((model, toas))
+    return out
+
+
 class PTABatch:
     """A batch of independently-fit pulsars evaluated as one program.
 
@@ -139,34 +262,47 @@ class PTABatch:
     component structure and the same free-parameter name list.
     """
 
-    def __init__(self, pairs: Sequence[Tuple]):
+    def __init__(self, pairs: Sequence[Tuple], heterogeneous=True):
         if not pairs:
             raise ValueError("empty PTA batch")
+        # structural identity = component classes AND parameter names:
+        # two pulsars can share classes but differ in family widths
+        # (glitch counts, FB terms) — those need superset alignment too
+        structs = {
+            (tuple(type(c).__name__ for c in model.components),
+             tuple(sorted(model.params)))
+            for model, _ in pairs
+        }
+        if len(structs) != 1:
+            if not heterogeneous:
+                raise ValueError(
+                    "PTA batch needs identical component structure per "
+                    f"pulsar; got {len(structs)} distinct structures — "
+                    "pass heterogeneous=True for automatic superset "
+                    "construction"
+                )
+            pairs = make_superset_models(pairs)
         self.prepareds: List[PreparedModel] = []
         self.resids: List[Residuals] = []
         for model, toas in pairs:
             prep = model.prepare(toas)
             self.prepareds.append(prep)
-            self.resids.append(Residuals(toas, prep))
-        names0 = tuple(self.prepareds[0].model.free_params)
-        structs = {
-            tuple(type(c).__name__
-                  for c in p.model.components)
-            for p in self.prepareds
-        }
-        if len(structs) != 1:
-            raise ValueError(
-                "PTA batch needs identical component structure per "
-                f"pulsar; got {structs} — build the pars from a common "
-                "superset (SURVEY hard part #3)"
-            )
+            self.resids.append(Residuals(toas, prep,
+                                         track_mode="nearest"))
+        # free parameters: the union across pulsars, with a per-pulsar
+        # 0/1 mask; a parameter outside a pulsar's own free list stays
+        # pinned at that pulsar's value (its design column is exactly
+        # zero, so the SVD-thresholded solve ignores it)
+        union: List[str] = []
         for p in self.prepareds:
-            if tuple(p.model.free_params) != names0:
-                raise ValueError(
-                    "PTA batch needs identical free-parameter lists; "
-                    f"{p.model.name} differs"
-                )
-        self.free_names = list(names0)
+            for n in p.model.free_params:
+                if n not in union:
+                    union.append(n)
+        self.free_names = union
+        self.free_mask = jnp.asarray(np.array([
+            [1.0 if n in p.model.free_params else 0.0 for n in union]
+            for p in self.prepareds
+        ]))
         self.n_pulsars = len(self.prepareds)
         self.n_max = max(
             p.batch.ticks.shape[0] for p in self.prepareds
@@ -204,9 +340,10 @@ class PTABatch:
         self.valid = (
             jnp.arange(self.n_max)[None, :] < self.n_toas[:, None]
         )
-        self.values0 = jnp.stack(
-            [p.values_to_vector() for p in self.prepareds]
-        )
+        self.values0 = jnp.asarray(np.array([
+            [float(p.model.values[n]) for n in self.free_names]
+            for p in self.prepareds
+        ]))
         self._full_values = [
             p._values_pytree() for p in self.prepareds
         ]
@@ -217,11 +354,14 @@ class PTABatch:
 
     # -- single-pulsar pure functions (vmapped below) -------------------------
     def _resid_one(self, vec, base_values, batch, ctx, tzr_batch,
-                   tzr_ctx, valid):
+                   tzr_ctx, valid, free_mask):
         p0 = self.prepareds[0]
         values = dict(base_values)
         for i, name in enumerate(self.free_names):
-            values[name] = vec[i]
+            # masked-out params stay pinned at this pulsar's own value,
+            # making their design columns exactly zero
+            values[name] = jnp.where(free_mask[i], vec[i],
+                                     base_values[name])
         ctx = _merge_ctx(ctx, self.static_ctx)
         n, frac = p0._phase_sum(values, batch, ctx)
         if tzr_batch is not None:
@@ -253,7 +393,7 @@ class PTABatch:
         return sigma
 
     def _fit_one(self, vec0, base_values, batch, ctx, tzr_batch,
-                 tzr_ctx, valid, maxiter):
+                 tzr_ctx, valid, free_mask, maxiter):
         merged = _merge_ctx(ctx, self.static_ctx)
         values0 = dict(base_values)
         for i, name in enumerate(self.free_names):
@@ -263,7 +403,8 @@ class PTABatch:
 
         def resid_fn(v):
             return self._resid_one(
-                v, base_values, batch, ctx, tzr_batch, tzr_ctx, valid
+                v, base_values, batch, ctx, tzr_batch, tzr_ctx, valid,
+                free_mask,
             )
 
         def body(carry, _):
@@ -277,41 +418,90 @@ class PTABatch:
         _, chi2, _, cov = wls_gn_solve(resid_fn, vec, err)
         return vec, chi2, cov
 
-    # -- public API -----------------------------------------------------------
-    def residuals(self, values=None):
-        """(n_pulsars, n_max) padded time residuals, zero where
-        invalid."""
-        vals = self.values0 if values is None else values
-        f = jax.vmap(self._resid_one,
-                     in_axes=(0, 0, 0, 0,
-                              0 if self.tzr_batch is not None else None,
-                              0 if self.tzr_ctx is not None else None,
-                              0))
-        return f(vals, self.base_values, self.batch, self.ctx,
-                 self.tzr_batch, self.tzr_ctx, self.valid)
+    def _gather_noise(self):
+        """Static per-pulsar noise bases for the batched GLS path:
+        (U (k, n_max, nb_max+1), phi (k, nb_max+1)) — each pulsar's
+        low-rank basis at its CURRENT noise-parameter values, plus the
+        mean-offset ones-column (reference residuals.py:583-585), all
+        zero-padded to common shape (zero columns with zero weight are
+        inert; gls_normal_solve floors phi)."""
+        from pint_tpu.residuals import MEAN_OFFSET_WEIGHT
 
-    def fit_wls(self, maxiter=3, mesh=None):
-        """Batched WLS Gauss-Newton fit of every pulsar; returns
-        (fitted_values (k, P), chi2 (k,), cov (k, P, P)).
+        Us, phis = [], []
+        for p in self.prepareds:
+            n_p = p.batch.ticks.shape[0]
+            U = np.asarray(p.noise_basis, dtype=np.float64)
+            phi = np.asarray(
+                p.noise_weights_fn(p._values_pytree()), dtype=np.float64)
+            U = np.concatenate([U, np.ones((n_p, 1))], axis=1)
+            phi = np.concatenate([phi, [MEAN_OFFSET_WEIGHT]])
+            Us.append(U)
+            phis.append(phi)
+        nb_max = max(u.shape[1] for u in Us)
+        U_pad = np.zeros((self.n_pulsars, self.n_max, nb_max))
+        phi_pad = np.zeros((self.n_pulsars, nb_max))
+        for k, (u, ph) in enumerate(zip(Us, phis)):
+            U_pad[k, : u.shape[0], : u.shape[1]] = u
+            phi_pad[k, : len(ph)] = ph
+        return jnp.asarray(U_pad), jnp.asarray(phi_pad)
 
-        With a mesh, the pulsar axis is sharded over devices
-        (NamedSharding) — the multi-chip path the driver dry-runs."""
+    def _fit_one_gls(self, vec0, base_values, batch, ctx, tzr_batch,
+                     tzr_ctx, valid, free_mask, U, phi, maxiter):
+        from pint_tpu.linalg import gls_normal_solve
+
+        merged = _merge_ctx(ctx, self.static_ctx)
+        values0 = dict(base_values)
+        for i, name in enumerate(self.free_names):
+            values0[name] = vec0[i]
+        sigma = self._sigma_one(values0, batch, merged)
+        err = jnp.where(valid, sigma, 1e30)
+
+        def resid_fn(v):
+            return self._resid_one(
+                v, base_values, batch, ctx, tzr_batch, tzr_ctx, valid,
+                free_mask,
+            )
+
+        def body(carry, _):
+            vec, _ = carry
+            r = resid_fn(vec)
+            J = jax.jacfwd(resid_fn)(vec)
+            dpar, cov, _, chi2 = gls_normal_solve(r, J, err, U, phi)
+            return (vec + dpar, chi2), None
+
+        (vec, _), _ = jax.lax.scan(
+            body, (vec0, jnp.float64(0.0)), None, length=maxiter
+        )
+        r = resid_fn(vec)
+        J = jax.jacfwd(resid_fn)(vec)
+        _, cov, ncoef, chi2 = gls_normal_solve(r, J, err, U, phi)
+        return vec, chi2, cov
+
+    def fit_gls(self, maxiter=3, mesh=None):
+        """Batched GLS fit: every pulsar's timing parameters against
+        its own correlated-noise covariance (ECORR / red-noise bases at
+        the current noise values), the whole PTA as one XLA program —
+        replacing the reference's per-pulsar GLSFitter process fan-out
+        (gridutils.py:166-391).  Sharding semantics match fit_wls."""
+        U, phi = self._gather_noise()
         fit = jax.vmap(
-            lambda v, b, bt, c, tb, tc, m: self._fit_one(
-                v, b, bt, c, tb, tc, m, maxiter
+            lambda v, b, bt, c, tb, tc, m, fm, uu, ph: self._fit_one_gls(
+                v, b, bt, c, tb, tc, m, fm, uu, ph, maxiter
             ),
             in_axes=(0, 0, 0, 0,
                      0 if self.tzr_batch is not None else None,
                      0 if self.tzr_ctx is not None else None,
-                     0),
+                     0, 0, 0, 0),
         )
-        args = (self.values0, self.base_values, self.batch, self.ctx,
-                self.tzr_batch, self.tzr_ctx, self.valid)
-        if mesh is None:
-            out = jax.jit(
-                lambda *a: fit(*a)
-            )(*args)
-        else:
+        return self._run_batched(
+            fit, (self.values0, self.base_values, self.batch, self.ctx,
+                  self.tzr_batch, self.tzr_ctx, self.valid,
+                  self.free_mask, U, phi), mesh)
+
+    def _run_batched(self, fit, args, mesh):
+        """jit (optionally mesh-sharded over the pulsar axis), run, and
+        write fitted values back (only genuinely-free params)."""
+        if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             shard = NamedSharding(mesh, P("pulsar"))
@@ -329,14 +519,47 @@ class PTABatch:
             args = tuple(
                 shard_tree(a) if a is not None else None for a in args
             )
-            out = jax.jit(lambda *a: fit(*a))(*args)
-        vec, chi2, cov = out
-        # write back per-pulsar values
+        vec, chi2, cov = jax.jit(lambda *a: fit(*a))(*args)
         vec_np = np.asarray(vec)
         for k, p in enumerate(self.prepareds):
             for i, name in enumerate(self.free_names):
-                p.model.values[name] = float(vec_np[k, i])
+                if float(self.free_mask[k, i]):
+                    p.model.values[name] = float(vec_np[k, i])
         return vec, chi2, cov
+
+    # -- public API -----------------------------------------------------------
+    def residuals(self, values=None):
+        """(n_pulsars, n_max) padded time residuals, zero where
+        invalid."""
+        vals = self.values0 if values is None else values
+        f = jax.vmap(self._resid_one,
+                     in_axes=(0, 0, 0, 0,
+                              0 if self.tzr_batch is not None else None,
+                              0 if self.tzr_ctx is not None else None,
+                              0, 0))
+        return f(vals, self.base_values, self.batch, self.ctx,
+                 self.tzr_batch, self.tzr_ctx, self.valid,
+                 self.free_mask)
+
+    def fit_wls(self, maxiter=3, mesh=None):
+        """Batched WLS Gauss-Newton fit of every pulsar; returns
+        (fitted_values (k, P), chi2 (k,), cov (k, P, P)).
+
+        With a mesh, the pulsar axis is sharded over devices
+        (NamedSharding) — the multi-chip path the driver dry-runs."""
+        fit = jax.vmap(
+            lambda v, b, bt, c, tb, tc, m, fm: self._fit_one(
+                v, b, bt, c, tb, tc, m, fm, maxiter
+            ),
+            in_axes=(0, 0, 0, 0,
+                     0 if self.tzr_batch is not None else None,
+                     0 if self.tzr_ctx is not None else None,
+                     0, 0),
+        )
+        return self._run_batched(
+            fit, (self.values0, self.base_values, self.batch, self.ctx,
+                  self.tzr_batch, self.tzr_ctx, self.valid,
+                  self.free_mask), mesh)
 
     @property
     def dof(self):

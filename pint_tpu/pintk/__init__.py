@@ -1,0 +1,6 @@
+"""Interactive fitting GUI (reference: src/pint/pintk/).
+
+:mod:`pint_tpu.pintk.pulsar` is the headless state wrapper;
+:mod:`pint_tpu.pintk.plk` is the Tk shell around it."""
+
+from pint_tpu.pintk.pulsar import Pulsar  # noqa: F401

@@ -1,0 +1,164 @@
+"""Tk "plk"-style interactive fitting panel (reference:
+src/pint/pintk/plk.py, 1707 LoC Tk widget).
+
+Layout: matplotlib residual canvas (pre/post fit), parameter fit-flag
+checkboxes, x-axis selector, and action buttons (Fit, Reset, Random
+models, Delete selection, Jump selection, Write par/tim).  All state
+operations live in :class:`pint_tpu.pintk.pulsar.Pulsar`, so the GUI is
+a thin shell (and the logic is testable headlessly)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class PlkWidget:
+    def __init__(self, root, pulsar):
+        import tkinter as tk
+        from matplotlib.backends.backend_tkagg import (
+            FigureCanvasTkAgg,
+            NavigationToolbar2Tk,
+        )
+        from matplotlib.figure import Figure
+
+        self.tk = tk
+        self.root = root
+        self.psr = pulsar
+        self.selected = np.zeros(len(pulsar.all_toas), dtype=bool)
+
+        main = tk.Frame(root)
+        main.pack(fill="both", expand=True)
+
+        # left: parameter panel
+        left = tk.Frame(main)
+        left.pack(side="left", fill="y")
+        tk.Label(left, text="Fit parameters").pack()
+        self.fit_vars = {}
+        for name, par in pulsar.model.params.items():
+            if not par.fittable:
+                continue
+            v = tk.BooleanVar(value=not par.frozen)
+            tk.Checkbutton(left, text=name, variable=v,
+                           command=self._sync_fit_flags).pack(anchor="w")
+            self.fit_vars[name] = v
+
+        # right: canvas + controls
+        right = tk.Frame(main)
+        right.pack(side="right", fill="both", expand=True)
+        self.fig = Figure(figsize=(9, 5))
+        self.ax = self.fig.add_subplot(111)
+        self.canvas = FigureCanvasTkAgg(self.fig, master=right)
+        self.canvas.get_tk_widget().pack(fill="both", expand=True)
+        NavigationToolbar2Tk(self.canvas, right)
+        self.canvas.mpl_connect("button_press_event", self._on_click)
+
+        ctrl = tk.Frame(right)
+        ctrl.pack(fill="x")
+        self.xaxis = tk.StringVar(value="mjd")
+        tk.OptionMenu(ctrl, self.xaxis, "mjd", "year", "serial",
+                      "orbital phase",
+                      command=lambda *_: self.update_plot()).pack(
+            side="left")
+        for label, cmd in [
+            ("Fit", self.do_fit), ("Reset", self.do_reset),
+            ("Random models", self.do_random),
+            ("Delete selected", self.do_delete),
+            ("Jump selected", self.do_jump),
+            ("Write par", self.do_write_par),
+        ]:
+            tk.Button(ctrl, text=label, command=cmd).pack(side="left")
+        self.status = tk.Label(right, anchor="w")
+        self.status.pack(fill="x")
+        self.update_plot()
+
+    # -- actions ---------------------------------------------------------------
+    def _sync_fit_flags(self):
+        for name, v in self.fit_vars.items():
+            self.psr.set_fit_flag(name, v.get())
+
+    def do_fit(self):
+        self._sync_fit_flags()
+        f = self.psr.fit()
+        r = self.psr.postfit_resids()
+        self.status.config(
+            text=f"chi2 = {r.chi2:.2f} / dof {r.dof} ; "
+                 f"wrms = {r.rms_weighted()*1e6:.3f} us")
+        self.update_plot()
+
+    def do_reset(self):
+        self.psr.reset_model()
+        self.update_plot()
+
+    def do_random(self):
+        if not self.psr.fitted:
+            self.status.config(text="fit first")
+            return
+        spread = self.psr.random_models(16)
+        x = self.psr.xaxis(self.xaxis.get())
+        order = np.argsort(x)
+        for row in np.asarray(spread):
+            self.ax.plot(x[order], row[order] * 1e6, alpha=0.2,
+                         color="gray", zorder=0)
+        self.canvas.draw_idle()
+
+    def do_delete(self):
+        idx = np.flatnonzero(self.selected)
+        if idx.size:
+            self.psr.delete_toas(idx)
+            self.selected = np.zeros(len(self.psr.all_toas), dtype=bool)
+            self.update_plot()
+
+    def do_jump(self):
+        idx = np.flatnonzero(self.selected)
+        if idx.size:
+            name = self.psr.add_jump(idx)
+            self.status.config(text=f"added {name}")
+            self.update_plot()
+
+    def do_write_par(self):
+        from tkinter import filedialog
+
+        path = filedialog.asksaveasfilename(defaultextension=".par")
+        if path:
+            self.psr.write_par(path)
+            self.status.config(text=f"wrote {path}")
+
+    def _on_click(self, event):
+        if event.inaxes is not self.ax or event.xdata is None:
+            return
+        x = self.psr.xaxis(self.xaxis.get())
+        i = int(np.argmin(np.abs(x - event.xdata)))
+        full = np.flatnonzero(~self.psr.deleted)[i]
+        self.selected[full] = not self.selected[full]
+        self.update_plot()
+
+    # -- drawing ----------------------------------------------------------------
+    def update_plot(self):
+        self.ax.clear()
+        r = (self.psr.postfit_resids() if self.psr.fitted
+             else self.psr.prefit_resids())
+        x = self.psr.xaxis(self.xaxis.get())
+        res = np.asarray(r.time_resids) * 1e6
+        err = np.asarray(r.scaled_errors) * 1e6
+        self.ax.errorbar(x, res, yerr=err, fmt=".", ms=4)
+        sel = self.selected[~self.psr.deleted]
+        if sel.any():
+            self.ax.plot(x[sel], res[sel], "o", mfc="none", mec="red")
+        self.ax.set_xlabel(self.xaxis.get())
+        self.ax.set_ylabel("residual [us]")
+        self.ax.set_title(
+            ("post-fit" if self.psr.fitted else "pre-fit")
+            + f"  ({len(res)} TOAs)")
+        self.canvas.draw_idle()
+
+
+def run(parfile, timfile, ephem=None):
+    import tkinter as tk
+
+    from pint_tpu.pintk.pulsar import Pulsar
+
+    psr = Pulsar(parfile, timfile, ephem=ephem)
+    root = tk.Tk()
+    root.title(f"pintk (pint_tpu): {parfile}")
+    PlkWidget(root, psr)
+    root.mainloop()

@@ -1,0 +1,149 @@
+"""GUI-facing pulsar state wrapper (reference: src/pint/pintk/pulsar.py
+— the Tk plk panel drives this object, and so can scripts/tests,
+headlessly).
+
+Holds (parfile, timfile) -> model/TOAs/fit state and exposes the
+operations the plk-style interface needs: fit, reset, delete/restore
+TOAs, toggle parameter fit flags, add/remove phase jumps on a TOA
+selection, random-model envelopes, and residual views (pre/post fit,
+vs MJD / orbital phase / serial)."""
+
+from __future__ import annotations
+
+import copy
+
+import numpy as np
+
+from pint_tpu.fitter import Fitter
+from pint_tpu.models.builder import (
+    get_model,
+    get_model_and_toas,
+    model_to_parfile,
+)
+from pint_tpu.residuals import Residuals
+
+
+class Pulsar:
+    def __init__(self, parfile, timfile, ephem=None):
+        self.parfile = parfile
+        self.timfile = timfile
+        kw = {}
+        if ephem is not None:
+            kw["ephem"] = ephem
+        self.model_init, self.all_toas = get_model_and_toas(
+            parfile, timfile, **kw)
+        self.model = copy.deepcopy(self.model_init)
+        self.deleted = np.zeros(len(self.all_toas), dtype=bool)
+        self.fitter = None
+        self.fitted = False
+        self._postfit = None
+
+    # -- selection ------------------------------------------------------------
+    @property
+    def selected_toas(self):
+        if not self.deleted.any():
+            return self.all_toas
+        return self.all_toas[~self.deleted]
+
+    def delete_toas(self, indices):
+        """Mark TOAs deleted (indices into the full set)."""
+        self.deleted[np.asarray(indices, dtype=int)] = True
+        self.fitted = False
+
+    def restore_all(self):
+        self.deleted[:] = False
+        self.fitted = False
+
+    # -- parameters -----------------------------------------------------------
+    def fit_params(self):
+        return list(self.model.free_params)
+
+    def set_fit_flag(self, name, fit: bool):
+        self.model.params[name].frozen = not fit
+
+    # -- jumps (reference pulsar.py add_phase_jump analogue) ------------------
+    def add_jump(self, indices):
+        """JUMP the selected TOAs via a per-TOA flag selector (the GUI
+        convention: reference timing_model.py:1727 jump_flags_to_params
+        wires -gui_jump flags into a JUMP maskParameter)."""
+        from pint_tpu.models.jump import PhaseJump
+
+        indices = np.asarray(indices, dtype=int)
+        if not self.model.has_component("PhaseJump"):
+            self.model.add_component(PhaseJump())
+        comp = self.model.component("PhaseJump")
+        njump = 1 + len(comp.selects)
+        flagval = str(njump)
+        for i in indices:
+            self.all_toas.flags[i]["gui_jump"] = flagval
+        from pint_tpu.models.parameter import Param
+
+        sel = ("flag", "gui_jump", flagval)
+        comp.selects = comp.selects + (sel,)
+        name = f"JUMP{njump}"
+        comp.add_param(Param(name, units="s", select=sel, frozen=False,
+                             description="GUI phase jump"))
+        self.model.values[name] = 0.0
+        self.fitted = False
+        return name
+
+    # -- fitting ---------------------------------------------------------------
+    def fit(self, downhill=True):
+        toas = self.selected_toas
+        self.fitter = Fitter.auto(toas, self.model, downhill=downhill)
+        self.fitter.fit_toas()
+        self.model = self.fitter.model
+        self._postfit = Residuals(toas, self.model)
+        self.fitted = True
+        return self.fitter
+
+    def reset_model(self):
+        self.model = copy.deepcopy(self.model_init)
+        self.fitted = False
+
+    def write_par(self, path):
+        with open(path, "w") as f:
+            f.write(model_to_parfile(self.model))
+
+    def write_tim(self, path):
+        from pint_tpu.toa import write_tim
+
+        write_tim(self.all_toas, path)
+
+    # -- residual views ---------------------------------------------------------
+    def prefit_resids(self):
+        return Residuals(self.selected_toas, self.model_init)
+
+    def postfit_resids(self):
+        if not self.fitted:
+            raise ValueError("not fitted yet")
+        return self._postfit
+
+    def xaxis(self, kind="mjd"):
+        toas = self.selected_toas
+        if kind == "mjd":
+            return np.asarray(toas.mjd_float)
+        if kind == "serial":
+            return np.arange(len(toas), dtype=float)
+        if kind == "orbital phase":
+            vals = self.model.values
+            if "PB" in vals:
+                pb = float(vals["PB"])
+                t0 = float(vals.get("T0", vals.get("TASC", 0.0)))
+                # T0/TASC are stored as seconds since J2000 internally
+                sec = toas.ticks / 2**32
+                return ((sec - t0) / (pb * 86400.0)) % 1.0
+            raise ValueError("model has no binary component")
+        if kind == "year":
+            return 2000.0 + (np.asarray(toas.mjd_float) - 51544.5) / 365.25
+        raise ValueError(f"unknown x-axis {kind!r}")
+
+    def random_models(self, n=16):
+        """Residual spread envelope from the post-fit covariance
+        (reference pintk random models panel / random_models.py)."""
+        from pint_tpu.simulation import calculate_random_models
+
+        if not self.fitted:
+            raise ValueError("fit first")
+        return calculate_random_models(self.fitter, self.selected_toas,
+                                       n_models=n)

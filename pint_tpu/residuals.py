@@ -216,6 +216,45 @@ class Residuals:
     def reduced_chi2(self):
         return self.chi2 / self.dof
 
+    def ecorr_average(self, use_noise_model=True):
+        """Epoch-averaged residuals using the ECORR time binning
+        (reference: residuals.py:842).
+
+        Returns {"mjds", "freqs", "time_resids", "errors", "indices"}
+        with one entry per ECORR epoch; with use_noise_model the
+        weights use the scaled uncertainties and the ECORR variance is
+        added to the averaged errors."""
+        comp = None
+        for c in self.model.noise_components:
+            if getattr(c, "category", "") == "ecorr_noise":
+                comp = c
+        if comp is None or not comp.selects:
+            raise ValueError("ECORR not present in noise model")
+        ctx = self.prepared.ctx[type(comp).__name__]
+        U = np.asarray(ctx["basis"])  # (N, n_epochs) 0/1
+        values = self._values()
+        ecorr_err2 = np.asarray(comp.weights(values, ctx))
+        if use_noise_model:
+            err = np.asarray(self._jitted("sigma", self.sigma_fn)(values))
+        else:
+            err = np.asarray(self.toas.error_us) * 1e-6
+            ecorr_err2 = ecorr_err2 * 0.0
+        wt = 1.0 / err**2
+        a_norm = U.T @ wt
+
+        def wtsum(x):
+            return (U.T @ (wt * np.asarray(x))) / a_norm
+
+        return {
+            "mjds": wtsum(self.toas.mjd_float),
+            "freqs": wtsum(np.where(np.isfinite(self.toas.freq_mhz),
+                                    self.toas.freq_mhz, 0.0)),
+            "time_resids": wtsum(self.time_resids),
+            "errors": np.sqrt(1.0 / a_norm + ecorr_err2),
+            "indices": [np.flatnonzero(U[:, j]).tolist()
+                        for j in range(U.shape[1])],
+        }
+
     def rms_weighted(self):
         """Weighted RMS of time residuals [s]."""
         r = self.time_resids

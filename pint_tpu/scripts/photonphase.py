@@ -17,6 +17,9 @@ def main(argv=None):
     p.add_argument("parfile")
     p.add_argument("--mission", default="nicer")
     p.add_argument("--extname", default="EVENTS")
+    p.add_argument("--orbfile", default=None,
+                   help="FPorbit/FT2 spacecraft orbit file: use real "
+                        "orbital geometry instead of the geocenter")
     p.add_argument("--maxh", type=int, default=20,
                    help="max harmonics for the H-test")
     p.add_argument("--outphases", default=None,
@@ -32,7 +35,8 @@ def main(argv=None):
     model = get_model(args.parfile)
     toas = load_event_TOAs(args.eventfile, args.mission,
                            extname=args.extname,
-                           ephem=model.meta.get("EPHEM", "builtin"))
+                           ephem=model.meta.get("EPHEM", "builtin"),
+                           orbfile=args.orbfile)
     print(f"Read {len(toas)} events")
     if args.polycos:
         if not all(o == "barycenter" for o in toas.obs_names):

@@ -1,0 +1,27 @@
+"""Tk interface for pulsar timing (reference: src/pint/scripts/pintk.py)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="pintk", description="Interactive pulsar-timing GUI")
+    p.add_argument("parfile")
+    p.add_argument("timfile")
+    p.add_argument("--ephem", default=None)
+    args = p.parse_args(argv)
+    if not os.environ.get("DISPLAY") and os.name != "nt":
+        raise SystemExit(
+            "pintk needs a display ($DISPLAY is not set). The same "
+            "operations are scriptable via pint_tpu.pintk.Pulsar.")
+    from pint_tpu.pintk.plk import run
+
+    run(args.parfile, args.timfile, ephem=args.ephem)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,46 @@
+"""Convert a Tempo2 ``BINARY T2`` par file to a concrete binary model
+(reference: src/pint/scripts/t2binary2pint.py driving
+guess_binary_model / convert_binary_params_dict)."""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="t2binary2pint",
+        description="Map a Tempo2 T2 binary par file onto the "
+                    "best-covering concrete binary model",
+    )
+    p.add_argument("input_par")
+    p.add_argument("output_par")
+    p.add_argument("--list", action="store_true",
+                   help="only list the candidate models, best first")
+    args = p.parse_args(argv)
+
+    from pint_tpu.models.builder import (
+        get_model,
+        guess_binary_model,
+        model_to_parfile,
+        parse_parfile,
+    )
+
+    pardict = parse_parfile(open(args.input_par).read())
+    binary = (pardict.get("BINARY", [[""]])[0] or [""])[0].upper()
+    if binary != "T2":
+        raise SystemExit(f"BINARY is {binary or '(absent)'}, not T2 — "
+                         "nothing to convert")
+    candidates = guess_binary_model(pardict)
+    print("candidate models (best first):", ", ".join(candidates))
+    if args.list:
+        return 0
+    model = get_model(args.input_par, allow_T2=True)
+    with open(args.output_par, "w") as f:
+        f.write(model_to_parfile(model))
+    print(f"wrote {args.output_par} (BINARY {candidates[0]})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -26,7 +26,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["LCGaussian", "LCLorentzian", "LCTemplate", "LCFitter"]
+__all__ = [
+    "LCGaussian", "LCLorentzian", "LCVonMises", "LCTopHat",
+    "LCHarmonic", "LCGaussian2", "LCLorentzian2",
+    "LCTemplate", "LCFitter", "NormAngles",
+    "LCEGaussian", "LCETemplate", "LCEFitter",
+]
 
 #: wraps to include in the wrapped-gaussian sum: exp(-(1/2)(k/sigma)^2)
 #: is < 1e-12 for |k| > 2 at sigma <= 0.3, the widest sane peak
@@ -74,6 +79,171 @@ class LCLorentzian:
 
     def init_params(self):
         return [self.gamma, self.loc]
+
+
+@dataclass
+class LCVonMises:
+    """Von Mises (circular normal) peak: concentration kappa, location
+    loc (reference lcprimitives LCVonMises).  Naturally periodic — no
+    wrap sum needed: f = exp(kappa cos(2 pi (phi-loc))) / I0(kappa)."""
+
+    kappa: float = 100.0
+    loc: float = 0.5
+
+    n_params = 2
+
+    def density(self, phi, p):
+        from jax.scipy.special import i0e
+
+        kappa, loc = p[0], p[1]
+        ang = 2.0 * jnp.pi * (jnp.asarray(phi) - loc)
+        # exp(k cos a)/I0(k) = exp(k (cos a - 1)) / i0e(k)
+        return jnp.exp(kappa * (jnp.cos(ang) - 1.0)) / i0e(kappa)
+
+    def init_params(self):
+        return [self.kappa, self.loc]
+
+
+@dataclass
+class LCTopHat:
+    """Top hat of full width ``width`` (turns) centered at loc
+    (reference lcprimitives LCTopHat)."""
+
+    width: float = 0.1
+    loc: float = 0.5
+
+    n_params = 2
+
+    def density(self, phi, p):
+        width, loc = p[0], p[1]
+        d = jnp.abs((jnp.asarray(phi) - loc + 0.5) % 1.0 - 0.5)
+        return jnp.where(d <= width / 2.0, 1.0 / width, 0.0)
+
+    def init_params(self):
+        return [self.width, self.loc]
+
+
+@dataclass
+class LCHarmonic:
+    """Pure cosine harmonic of fixed order: f = 1 + cos(2 pi n
+    (phi - loc)) (reference lcprimitives LCHarmonic)."""
+
+    order: int = 1
+    loc: float = 0.0
+
+    n_params = 1
+
+    def density(self, phi, p):
+        loc = p[0]
+        return 1.0 + jnp.cos(2.0 * jnp.pi * self.order
+                             * (jnp.asarray(phi) - loc))
+
+    def init_params(self):
+        return [self.loc]
+
+
+def _two_sided(core_density):
+    """Two-sided wrapper: width1 left of the peak, width2 right —
+    normalized (the two half-profiles each carry weight 1/2)."""
+
+    def density(phi, loc, w1, w2):
+        d = (jnp.asarray(phi) - loc + 0.5) % 1.0 - 0.5  # [-0.5, 0.5)
+        left = core_density(d, w1)
+        right = core_density(d, w2)
+        # each half-density integrates to 1/2 of its symmetric form
+        return jnp.where(d < 0, 2.0 * w1 / (w1 + w2) * left,
+                         2.0 * w2 / (w1 + w2) * right)
+
+    return density
+
+
+@dataclass
+class LCGaussian2:
+    """Two-sided wrapped Gaussian: sigma1 (leading), sigma2 (trailing)
+    (reference lcprimitives LCGaussian2)."""
+
+    sigma1: float = 0.03
+    sigma2: float = 0.03
+    loc: float = 0.5
+
+    n_params = 3
+
+    def density(self, phi, p):
+        s1, s2, loc = p[0], p[1], p[2]
+
+        def core(d, s):
+            k = jnp.arange(-_NWRAP, _NWRAP + 1)
+            z = (d[..., None] + k[None, :]) / s
+            return jnp.sum(jnp.exp(-0.5 * z**2), axis=-1) / (
+                s * jnp.sqrt(2.0 * jnp.pi))
+
+        return _two_sided(core)(phi, loc, s1, s2)
+
+    def init_params(self):
+        return [self.sigma1, self.sigma2, self.loc]
+
+
+@dataclass
+class LCLorentzian2:
+    """Two-sided wrapped Lorentzian: gamma1/gamma2 HWHM (reference
+    lcprimitives LCLorentzian2)."""
+
+    gamma1: float = 0.03
+    gamma2: float = 0.03
+    loc: float = 0.5
+
+    n_params = 3
+
+    def density(self, phi, p):
+        g1, g2, loc = p[0], p[1], p[2]
+        two_pi = 2.0 * jnp.pi
+
+        def core(d, g):
+            return jnp.sinh(two_pi * g) / (
+                jnp.cosh(two_pi * g) - jnp.cos(two_pi * d))
+
+        return _two_sided(core)(phi, loc, g1, g2)
+
+    def init_params(self):
+        return [self.gamma1, self.gamma2, self.loc]
+
+
+class NormAngles:
+    """Constrained normalization parameterization (reference
+    lcnorm.py NormAngles): k component amplitudes expressed through
+    angles so that every norm is in (0,1) and their sum stays < 1 for
+    any unconstrained angle values — the fitter can then move freely
+    without a barrier."""
+
+    def __init__(self, k):
+        self.k = k
+
+    def to_norms(self, angles):
+        """angles (k,) -> norms (k,): norm_i = sin^2(a_0) *
+        prod_{j<i} cos^2(a_j) * sin^2(a_i) style stick-breaking."""
+        angles = jnp.asarray(angles)
+        total = jnp.sin(angles[0]) ** 2  # total pulsed fraction
+        rest = angles[1:]
+        parts = []
+        remaining = total
+        for i in range(self.k - 1):
+            frac = jnp.sin(rest[i]) ** 2
+            parts.append(remaining * frac)
+            remaining = remaining * (1.0 - frac)
+        parts.append(remaining)
+        return jnp.stack(parts)
+
+    def from_norms(self, norms):
+        norms = np.asarray(norms, dtype=np.float64)
+        total = norms.sum()
+        angles = [np.arcsin(np.sqrt(np.clip(total, 1e-9, 1 - 1e-9)))]
+        remaining = total
+        for i in range(self.k - 1):
+            frac = norms[i] / max(remaining, 1e-12)
+            angles.append(np.arcsin(np.sqrt(np.clip(frac, 1e-9,
+                                                    1 - 1e-9))))
+            remaining -= norms[i]
+        return np.array(angles)
 
 
 class LCTemplate:
@@ -201,3 +371,124 @@ class LCFitter:
             return np.sqrt(np.clip(np.diag(cov), 0, None))
         except np.linalg.LinAlgError:
             return np.full(self.template.n_params, np.nan)
+
+
+# --- energy-dependent templates (reference: lceprimitives.py /
+# lcetemplate — primitive parameters evolve with photon energy) -------------
+
+@dataclass
+class LCEGaussian:
+    """Wrapped Gaussian whose width and location evolve linearly in
+    log10(E/E0) (reference lceprimitives LCEGaussian):
+    sigma(E) = sigma + dsigma*x, loc(E) = loc + dloc*x,
+    x = log10(E) - log10(E0)."""
+
+    sigma: float = 0.03
+    dsigma: float = 0.0
+    loc: float = 0.5
+    dloc: float = 0.0
+    log10_e0: float = 2.0  # 100 MeV in the Fermi convention
+
+    n_params = 4
+
+    def density(self, phi, p, log10_en):
+        x = jnp.asarray(log10_en) - self.log10_e0
+        sigma = jnp.maximum(p[0] + p[1] * x, 1e-4)
+        loc = p[2] + p[3] * x
+        k = jnp.arange(-_NWRAP, _NWRAP + 1)
+        z = (jnp.asarray(phi)[..., None] - loc[..., None]
+             + k[None, :]) / sigma[..., None]
+        return jnp.sum(jnp.exp(-0.5 * z**2), axis=-1) / (
+            sigma * jnp.sqrt(2.0 * jnp.pi))
+
+    def init_params(self):
+        return [self.sigma, self.dsigma, self.loc, self.dloc]
+
+
+class LCETemplate:
+    """Energy-dependent mixture: density(phi, params, log10_en).
+    Norms are energy-independent (the reference's lcenorm energy
+    evolution can ride the same pattern)."""
+
+    def __init__(self, primitives, norms=None):
+        self.primitives = list(primitives)
+        k = len(self.primitives)
+        if norms is None:
+            norms = [0.5 / k] * k
+        self.params = np.array(
+            list(norms)
+            + [v for p in self.primitives for v in p.init_params()],
+            dtype=np.float64,
+        )
+
+    @property
+    def n_params(self):
+        return len(self.params)
+
+    def _split(self, params):
+        k = len(self.primitives)
+        out, i = [], k
+        for p in self.primitives:
+            out.append(params[i:i + p.n_params])
+            i += p.n_params
+        return params[:k], out
+
+    def density(self, phi, log10_en, params=None):
+        params = jnp.asarray(self.params if params is None else params)
+        norms, pp = self._split(params)
+        out = 1.0 - jnp.sum(norms)
+        for p, q, n in zip(self.primitives, pp, jnp.atleast_1d(norms)):
+            out = out + n * p.density(jnp.asarray(phi), q,
+                                      jnp.asarray(log10_en))
+        return out
+
+
+class LCEFitter:
+    """ML fitting of an energy-dependent template (reference
+    lcfitters with lceprimitives)."""
+
+    def __init__(self, template: LCETemplate, phases, log10_ens,
+                 weights=None):
+        self.template = template
+        self.phases = np.asarray(phases, np.float64) % 1.0
+        self.log10_ens = np.asarray(log10_ens, np.float64)
+        self.weights = weights
+        phi = jnp.asarray(self.phases)
+        en = jnp.asarray(self.log10_ens)
+        w = None if weights is None else jnp.asarray(weights)
+
+        def lnlike(params):
+            f = template.density(phi, en, params)
+            if w is None:
+                return jnp.sum(jnp.log(jnp.maximum(f, 1e-300)))
+            return jnp.sum(jnp.log(jnp.maximum(w * f + (1.0 - w),
+                                               1e-300)))
+
+        self._lnlike = lnlike
+        self._val_grad = jax.jit(jax.value_and_grad(
+            lambda p: -lnlike(p)))
+
+    def lnlike(self, params=None):
+        p = self.template.params if params is None else params
+        return float(self._lnlike(jnp.asarray(p)))
+
+    def fit(self, maxiter=200):
+        from scipy.optimize import minimize
+
+        k = len(self.template.primitives)
+        x0 = np.array(self.template.params)
+        bounds = [(1e-4, 1.0)] * k + [(None, None)] * (len(x0) - k)
+        barrier = jax.jit(jax.value_and_grad(
+            lambda p: 1e8 * jnp.maximum(jnp.sum(p[:k]) - 0.995,
+                                        0.0) ** 2))
+
+        def fun(x):
+            xj = jnp.asarray(x)
+            v, g = self._val_grad(xj)
+            vb, gb = barrier(xj)
+            return float(v + vb), np.asarray(g + gb, np.float64)
+
+        res = minimize(fun, x0, jac=True, method="L-BFGS-B",
+                       bounds=bounds, options={"maxiter": maxiter})
+        self.template.params = np.asarray(res.x)
+        return self.template.params, -float(res.fun)

@@ -448,7 +448,12 @@ class TOAs:
         for io, oname in enumerate(self.obs_list):
             obs = get_observatory(oname)
             m = self.obs_index == io
-            pv = obs.posvel_ssb(self.ticks[m], ephem=self.ephem)
+            if getattr(obs, "needs_flags", False):
+                fl = [f for f, take in zip(self.flags, m) if take]
+                pv = obs.posvel_ssb(self.ticks[m], ephem=self.ephem,
+                                    flags=fl)
+            else:
+                pv = obs.posvel_ssb(self.ticks[m], ephem=self.ephem)
             self.ssb_obs_pos[m] = pv.pos
             self.ssb_obs_vel[m] = pv.vel
         sun = body_posvel_ssb("sun", self.ticks, self.ephem)

@@ -77,3 +77,144 @@ def taylor_horner_deriv(x, coeffs, deriv_order=1):
     if deriv_order == 0:
         return taylor_horner(x, coeffs)
     return taylor_horner(x, list(coeffs[deriv_order:]))
+
+
+# --- DMX helpers (reference: utils.py:786 dmx_ranges, :1083 dmxparse) ------
+
+def dmx_ranges(toas, max_width_days=15.0, min_toas=1):
+    """Construct DMX bin edges covering the TOAs (reference
+    utils.py:786): greedy left-to-right windows of at most
+    ``max_width_days`` containing at least ``min_toas`` TOAs.
+
+    Returns a list of (mjd_lo, mjd_hi) pairs."""
+    mjds = np.sort(np.asarray(toas.mjd_float, dtype=np.float64))
+    ranges = []
+    i = 0
+    while i < len(mjds):
+        lo = mjds[i]
+        j = i
+        while j + 1 < len(mjds) and mjds[j + 1] - lo <= max_width_days:
+            j += 1
+        if (j - i + 1) >= min_toas:
+            ranges.append((lo - 1e-3, mjds[j] + 1e-3))
+        i = j + 1
+    return ranges
+
+
+def add_dmx_ranges(model, ranges):
+    """Attach a DispersionDMX component (or extend it) with the given
+    (mjd_lo, mjd_hi) ranges; DMX_#### start at zero, free."""
+    from pint_tpu.models.dispersion import DispersionDMX
+
+    old_params = {}
+    if model.has_component("DispersionDMX"):
+        comp = model.component("DispersionDMX")
+        old_params = {p.name: p for p in comp.params}
+        start = max(comp.indices, default=0) + 1
+        idx = list(comp.indices) + list(
+            range(start, start + len(ranges)))
+        model.remove_component("DispersionDMX")
+    else:
+        start = 1
+        idx = list(range(1, 1 + len(ranges)))
+    comp = DispersionDMX(indices=idx)
+    # rebuilding must not silently freeze previously-free DMX bins or
+    # drop their fitted uncertainties: carry the old Param state over
+    for p in comp.params:
+        old = old_params.get(p.name)
+        if old is not None:
+            p.frozen = old.frozen
+            p.uncertainty = old.uncertainty
+    model.add_component(comp)
+    for k, (lo, hi) in enumerate(ranges, start=start):
+        model.values[f"DMX_{k:04d}"] = 0.0
+        model.values[f"DMXR1_{k:04d}"] = (lo - 51544.5) * 86400.0
+        model.values[f"DMXR2_{k:04d}"] = (hi - 51544.5) * 86400.0
+        model.params[f"DMX_{k:04d}"].frozen = False
+    return model
+
+
+def dmxparse(fitter):
+    """Summarize fitted DMX values (reference: utils.py:1083 dmxparse):
+    {dmxs, dmx_verrs, dmxeps (MJD mid), r1s, r2s, dmx_mean,
+    dmx_mean_sub} with the weighted mean subtracted in dmx_mean_sub."""
+    model = fitter.model
+    comp = model.component("DispersionDMX")
+    idx = sorted(comp.indices)
+    vals = np.array([model.values[f"DMX_{i:04d}"] for i in idx])
+    errs = np.array([
+        model.params[f"DMX_{i:04d}"].uncertainty or np.nan for i in idx
+    ])
+    r1 = np.array([model.values[f"DMXR1_{i:04d}"] for i in idx])
+    r2 = np.array([model.values[f"DMXR2_{i:04d}"] for i in idx])
+    w = 1.0 / np.where(np.isfinite(errs) & (errs > 0), errs, np.inf)**2
+    mean = (np.sum(vals * w) / np.sum(w)) if np.any(w > 0) else vals.mean()
+    return {
+        "dmxs": vals,
+        "dmx_verrs": errs,
+        "dmxeps": 51544.5 + (r1 + r2) / 2.0 / 86400.0,
+        "r1s": 51544.5 + r1 / 86400.0,
+        "r2s": 51544.5 + r2 / 86400.0,
+        "dmx_mean": float(mean),
+        "dmx_mean_sub": vals - mean,
+    }
+
+
+# --- WaveX setup/translation helpers (reference: utils.py:1457-2001) -------
+
+def wavex_setup(model, t_span_days, n_freqs, family="WX"):
+    """Attach a WaveX-family component with n_freqs harmonics of
+    1/t_span (reference wavex_setup/dmwavex_setup): WXFREQ_000k set,
+    WXSIN/WXCOS zeroed and free.  family: WX | DMWX | CMWX."""
+    from pint_tpu.models.wavex import CMWaveX, DMWaveX, WaveX
+
+    cls = {"WX": WaveX, "DMWX": DMWaveX, "CMWX": CMWaveX}[family]
+    if model.has_component(cls.__name__):
+        raise ValueError(f"{cls.__name__} already present")
+    base_f = 1.0 / t_span_days  # WaveX freqs are 1/day
+    comp = cls(indices=tuple(range(1, n_freqs + 1)))
+    model.add_component(comp)
+    for k in range(1, n_freqs + 1):
+        model.values[f"{family}FREQ_{k:04d}"] = k * base_f
+        model.values[f"{family}SIN_{k:04d}"] = 0.0
+        model.values[f"{family}COS_{k:04d}"] = 0.0
+        model.params[f"{family}SIN_{k:04d}"].frozen = False
+        model.params[f"{family}COS_{k:04d}"].frozen = False
+    return model
+
+
+def translate_wave_to_wavex(model):
+    """Convert a legacy Wave component to WaveX (reference:
+    utils.py translate_wave_to_wavex): WAVEkA/WAVEkB sinusoids at
+    k*WAVE_OM become WXSIN/WXCOS terms.
+
+    Wave is a *phase* component (turns); WaveX is an achromatic delay
+    [s]: delay = phase / F0, and the sine/cosine roles map directly."""
+    from pint_tpu.models.wave import Wave
+
+    wave = model.component("Wave")
+    om = float(model.values["WAVE_OM"])  # rad/day
+    n = wave.num_terms
+    epoch = model.values.get("WAVEEPOCH", np.nan)
+    if epoch != epoch:
+        epoch = model.values.get("PEPOCH", 0.0)
+    model.remove_component("Wave")
+    from pint_tpu.models.wavex import WaveX
+
+    comp = WaveX(indices=tuple(range(1, n + 1)))
+    model.add_component(comp)
+    # matching epochs makes the translation exact (both series use
+    # tau = t - epoch): freq_k = k*WAVE_OM/(2 pi) [1/day]
+    model.values["WXEPOCH"] = epoch
+    for k in range(1, n + 1):
+        a = float(model.values.get(f"WAVE{k}A", 0.0))
+        b = float(model.values.get(f"WAVE{k}B", 0.0))
+        model.values[f"WXFREQ_{k:04d}"] = k * om / (2.0 * np.pi)
+        # wave PHASE = F0*(a sin + b cos); a WaveX DELAY d contributes
+        # phase -F0*d, so the amplitudes flip sign
+        model.values[f"WXSIN_{k:04d}"] = -a
+        model.values[f"WXCOS_{k:04d}"] = -b
+        model.values.pop(f"WAVE{k}A", None)
+        model.values.pop(f"WAVE{k}B", None)
+    model.values.pop("WAVE_OM", None)
+    return model

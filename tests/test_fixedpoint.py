@@ -95,3 +95,11 @@ def test_seconds_ticks_roundtrip():
     ticks = fp.seconds_to_ticks_f64(jnp.asarray(sec))
     back = fp.ticks_to_seconds(ticks)
     np.testing.assert_allclose(np.asarray(back), sec, atol=1.0 / 2**32)
+
+
+def test_backend_f64_selftest_cpu():
+    """The runtime gate that decides whether dd arithmetic is valid on
+    the active backend (TPU_PRECISION.md item 5): CPU is real IEEE."""
+    from pint_tpu.fixedpoint import backend_f64_is_ieee
+
+    assert backend_f64_is_ieee() is True

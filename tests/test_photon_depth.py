@@ -1,0 +1,242 @@
+"""Photon-path depth: satellite observatories (orbit FITS),
+T2SpacecraftObs, extended template zoo (incl. energy dependence),
+composite MCMC, and T2 binary conversion (reference satellite_obs.py,
+special_locations.py:161, templates/, event_optimize_multiple,
+t2binary2pint)."""
+
+import os
+
+import numpy as np
+import pytest
+
+REFDATA = "/root/reference/tests/datafile"
+
+
+class TestSatelliteObs:
+    def test_fporbit_real_file(self):
+        """Parse the real RXTE FPorbit file shipped with the reference
+        tests and interpolate a low-Earth-orbit-sized position."""
+        path = os.path.join(REFDATA, "FPorbit_Day6223")
+        if not os.path.exists(path):
+            pytest.skip("reference data not mounted")
+        from pint_tpu.obs.satellite import load_orbit
+
+        mjd_tt, pos, vel = load_orbit(path)
+        assert len(mjd_tt) > 100
+        r = np.linalg.norm(pos, axis=1)
+        # LEO: geocentric distance ~ 6.7-7.1e6 m
+        assert 6.5e6 < r.mean() < 7.5e6
+        v = np.linalg.norm(vel, axis=1)
+        assert 6e3 < v.mean() < 9e3  # ~7.5 km/s
+
+    def test_satellite_posvel_ssb(self):
+        path = os.path.join(REFDATA, "FPorbit_Day6223")
+        if not os.path.exists(path):
+            pytest.skip("reference data not mounted")
+        from pint_tpu.obs.satellite import get_satellite_observatory
+        from pint_tpu.ephem import body_posvel_ssb
+
+        obs = get_satellite_observatory("testsat", path)
+        t0 = (float(obs._mjd_tt[10]) - 51544.5) * 86400.0
+        ticks = np.array([int(t0 * 2**32)])
+        pv = obs.posvel_ssb(ticks)
+        earth = body_posvel_ssb("earth", ticks)
+        d = np.linalg.norm((pv.pos - earth.pos)) * 299792458.0
+        assert 6.5e6 < d < 7.5e6  # spacecraft is in LEO, not at SSB
+
+    def test_maxextrap_guard(self):
+        path = os.path.join(REFDATA, "FPorbit_Day6223")
+        if not os.path.exists(path):
+            pytest.skip("reference data not mounted")
+        from pint_tpu.obs.satellite import SatelliteObs
+
+        obs = SatelliteObs("testsat2", path, maxextrap_min=2.0)
+        far = (float(obs._mjd_tt[-1]) + 1.0 - 51544.5) * 86400.0
+        with pytest.raises(ValueError, match="maxextrap"):
+            obs.posvel_gcrs(np.array([int(far * 2**32)]))
+
+
+class TestT2SpacecraftObs:
+    def test_flags_drive_position(self, tmp_path):
+        from pint_tpu.toa import get_TOAs
+
+        tim = tmp_path / "sc.tim"
+        tim.write_text(
+            "FORMAT 1\n"
+            "sc 1400.0 55000.1 1.0 stl_geo -telx 7000.0 -tely 0.0 "
+            "-telz 0.0 -vx 0.0 -vy 7.5 -vz 0.0\n"
+            "sc 1400.0 55000.2 1.0 stl_geo -telx 0.0 -tely 7000.0 "
+            "-telz 0.0 -vx -7.5 -vy 0.0 -vz 0.0\n"
+        )
+        toas = get_TOAs(str(tim))
+        from pint_tpu.ephem import body_posvel_ssb
+
+        earth = body_posvel_ssb("earth", toas.ticks).pos
+        d = (toas.ssb_obs_pos - earth) * 299792.458  # km
+        assert np.allclose(d[0], [7000.0, 0.0, 0.0], atol=1e-6)
+        assert np.allclose(d[1], [0.0, 7000.0, 0.0], atol=1e-6)
+
+    def test_missing_flags_raise(self, tmp_path):
+        from pint_tpu.toa import get_TOAs
+
+        tim = tmp_path / "bad.tim"
+        tim.write_text("FORMAT 1\nsc 1400.0 55000.1 1.0 stl_geo\n")
+        with pytest.raises(ValueError, match="telx"):
+            get_TOAs(str(tim))
+
+
+class TestTemplateZoo:
+    def _check_normalized(self, prim, params=None):
+        phi = np.linspace(0, 1, 20001)[:-1]
+        p = np.asarray(params if params is not None
+                       else prim.init_params())
+        dens = np.asarray(prim.density(phi, p))
+        integral = dens.mean()  # uniform grid over one turn
+        assert np.isclose(integral, 1.0, atol=2e-3), integral
+
+    def test_von_mises(self):
+        from pint_tpu.templates import LCVonMises
+
+        self._check_normalized(LCVonMises(kappa=50.0, loc=0.3))
+
+    def test_top_hat(self):
+        from pint_tpu.templates import LCTopHat
+
+        self._check_normalized(LCTopHat(width=0.2, loc=0.9))
+
+    def test_harmonic(self):
+        from pint_tpu.templates import LCHarmonic
+
+        self._check_normalized(LCHarmonic(order=2, loc=0.1))
+
+    def test_two_sided_gaussian(self):
+        from pint_tpu.templates import LCGaussian2
+
+        prim = LCGaussian2(sigma1=0.02, sigma2=0.06, loc=0.5)
+        self._check_normalized(prim)
+        # asymmetry: at 0.06 turns from the peak the narrow (3 sigma1)
+        # side has fallen off, the broad (1 sigma2) side has not
+        p = np.asarray(prim.init_params())
+        left = float(prim.density(np.array([0.44]), p)[0])
+        right = float(prim.density(np.array([0.56]), p)[0])
+        assert right > 10.0 * left
+        # continuous at the peak
+        eps = 1e-6
+        lo = float(prim.density(np.array([0.5 - eps]), p)[0])
+        hi = float(prim.density(np.array([0.5 + eps]), p)[0])
+        assert np.isclose(lo, hi, rtol=1e-3)
+
+    def test_two_sided_lorentzian(self):
+        from pint_tpu.templates import LCLorentzian2
+
+        self._check_normalized(
+            LCLorentzian2(gamma1=0.02, gamma2=0.05, loc=0.4))
+
+    def test_norm_angles_roundtrip(self):
+        from pint_tpu.templates import NormAngles
+
+        na = NormAngles(3)
+        norms = np.array([0.2, 0.3, 0.1])
+        back = np.asarray(na.to_norms(na.from_norms(norms)))
+        assert np.allclose(back, norms, atol=1e-6)
+        # any angles -> valid simplex
+        rng = np.random.default_rng(0)
+        for _ in range(20):
+            n = np.asarray(na.to_norms(rng.uniform(-3, 3, 3)))
+            assert np.all(n >= 0) and n.sum() <= 1.0 + 1e-9
+
+    def test_energy_dependent_recovery(self):
+        from pint_tpu.templates import LCEFitter, LCEGaussian, LCETemplate
+
+        rng = np.random.default_rng(1)
+        n = 4000
+        log10_en = rng.uniform(2.0, 4.0, n)
+        x = log10_en - 2.0
+        true_loc = 0.5 + 0.05 * x
+        true_sig = 0.05 - 0.01 * x
+        phases = (rng.standard_normal(n) * true_sig + true_loc) % 1.0
+        tpl = LCETemplate([LCEGaussian(sigma=0.06, dsigma=0.0, loc=0.45,
+                                       dloc=0.0)], norms=[0.99])
+        f = LCEFitter(tpl, phases, log10_en)
+        params, lnl = f.fit()
+        # params: [norm, sigma, dsigma, loc, dloc]
+        assert abs(params[3] - 0.5) < 0.02
+        assert abs(params[4] - 0.05) < 0.02
+        assert abs(params[2] - (-0.01)) < 0.01
+
+
+class TestCompositeMCMC:
+    def test_two_datasets_beat_one(self, tmp_path):
+        """The joint fitter recovers F0 from two small photon datasets."""
+        from pint_tpu.mcmc_fitter import CompositeMCMCFitter
+        from pint_tpu.models.builder import get_model
+        from pint_tpu.simulation import make_fake_toas_uniform
+        from pint_tpu.templates import LCGaussian, LCTemplate
+        from pint_tpu.toa import TOA, TOAs
+
+        par = (
+            "PSR J0\nRAJ 05:00:00\nDECJ 15:00:00\nF0 10.0 1\n"
+            "PEPOCH 54100\nDM 10\nUNITS TDB\nTZRMJD 54100\nTZRSITE @\n"
+            "TZRFRQ 0\nEPHEM builtin\n")
+        pp = tmp_path / "c.par"
+        pp.write_text(par)
+        model = get_model(str(pp))
+        model.params["F0"].uncertainty = 2e-9
+        rng = np.random.default_rng(2)
+
+        def photon_toas(t0):
+            # photons drawn from a gaussian pulse at phase 0.5
+            mjd = t0 + rng.uniform(0, 0.2, 300)
+            frac_phase = (rng.standard_normal(300) * 0.04 + 0.5) % 1.0
+            # place photons at times whose model phase matches
+            sec = (mjd - 54100.0) * 86400.0
+            nphase = np.floor(sec * 10.0)
+            tsec = (nphase + frac_phase) / 10.0
+            mjd_exact = 54100.0 + tsec / 86400.0
+            toas = [TOA(int(m), int((m % 1.0) * 86400 * 10**9) , 86400 * 10**9,
+                        1.0, 0.0, "@", {"timescale": "tdb"}, "ph")
+                    for m in mjd_exact]
+            return TOAs(toas, ephem="builtin")
+
+        t1, t2 = photon_toas(54100.0), photon_toas(54200.0)
+        tpl = LCTemplate([LCGaussian(sigma=0.04, loc=0.5)], norms=[0.95])
+        f = CompositeMCMCFitter([t1, t2], model, [tpl, tpl])
+        lnp = f.fit_toas(nwalkers=16, nsteps=120, seed=3)
+        assert np.isfinite(lnp)
+        assert abs(model.values["F0"] - 10.0) < 5e-9
+
+
+class TestT2Binary:
+    PAR = ("PSR J1\nRAJ 05:00:00\nDECJ 15:00:00\nF0 200 1\n"
+           "PEPOCH 54100\nDM 10\nUNITS TDB\nBINARY T2\n"
+           "PB 10.0\nA1 5.0\nT0 54000\nECC 0.1\nOM 90\n")
+
+    def test_guess_and_convert(self, tmp_path):
+        from pint_tpu.models.builder import get_model, guess_binary_model, parse_parfile
+
+        cands = guess_binary_model(parse_parfile(self.PAR))
+        assert cands[0] == "BT"
+        p = tmp_path / "t2.par"
+        p.write_text(self.PAR)
+        with pytest.raises(NotImplementedError, match="T2"):
+            get_model(str(p))
+        with pytest.warns(UserWarning, match="mapped onto"):
+            m = get_model(str(p), allow_T2=True)
+        assert any(type(c).__name__ == "BinaryBT" for c in m.components)
+
+    def test_t2_ell1(self):
+        from pint_tpu.models.builder import guess_binary_model, parse_parfile
+
+        par = ("PSR J1\nF0 200 1\nPEPOCH 54100\nBINARY T2\n"
+               "PB 10.0\nA1 5.0\nTASC 54000\nEPS1 1e-5\nEPS2 2e-5\n")
+        assert guess_binary_model(parse_parfile(par))[0].startswith("ELL1")
+
+    def test_script(self, tmp_path):
+        from pint_tpu.scripts.t2binary2pint import main
+
+        p = tmp_path / "in.par"
+        p.write_text(self.PAR)
+        out = tmp_path / "out.par"
+        main([str(p), str(out)])
+        text = out.read_text()
+        assert "BINARY" in text and "BT" in text

@@ -1,0 +1,92 @@
+"""pintk state wrapper (headless; reference pintk/pulsar.py) and the
+GUI entry point's display guard (reference test_pintk.py skips without
+$DISPLAY the same way)."""
+
+import os
+
+import numpy as np
+import pytest
+
+REFDATA = "/root/reference/tests/datafile"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REFDATA), reason="reference data not mounted")
+
+
+@pytest.fixture(scope="module")
+def psr():
+    from pint_tpu.pintk.pulsar import Pulsar
+
+    return Pulsar(os.path.join(REFDATA, "NGC6440E.par"),
+                  os.path.join(REFDATA, "NGC6440E.tim"))
+
+
+class TestPulsarWrapper:
+    def test_load_and_prefit(self, psr):
+        r = psr.prefit_resids()
+        assert len(np.asarray(r.time_resids)) == len(psr.all_toas)
+
+    def test_fit_improves(self, psr):
+        pre = psr.prefit_resids().chi2
+        psr.fit()
+        post = psr.postfit_resids().chi2
+        assert post < pre
+
+    def test_xaxes(self, psr):
+        n = len(psr.selected_toas)
+        for kind in ("mjd", "serial", "year"):
+            assert psr.xaxis(kind).shape == (n,)
+        with pytest.raises(ValueError):
+            psr.xaxis("orbital phase")  # isolated pulsar
+
+    def test_delete_restore(self, psr):
+        n = len(psr.all_toas)
+        psr.delete_toas([0, 1, 2])
+        assert len(psr.selected_toas) == n - 3
+        psr.restore_all()
+        assert len(psr.selected_toas) == n
+
+    def test_fit_flags(self, psr):
+        psr.set_fit_flag("DM", False)
+        assert "DM" not in psr.fit_params()
+        psr.set_fit_flag("DM", True)
+        assert "DM" in psr.fit_params()
+
+    def test_jump_and_random(self, psr):
+        name = psr.add_jump([0, 1, 2, 3, 4])
+        assert name.startswith("JUMP")
+        psr.fit()
+        spread = psr.random_models(4)
+        assert np.asarray(spread).shape[0] == 4
+        # the jump parameter actually moved the fit
+        assert name in psr.model.values
+
+    def test_write_par(self, psr, tmp_path):
+        p = tmp_path / "out.par"
+        psr.write_par(str(p))
+        assert "F0" in p.read_text()
+
+
+class TestGuiGuard:
+    def test_headless_exit(self, monkeypatch):
+        from pint_tpu.scripts.pintk import main
+
+        monkeypatch.delenv("DISPLAY", raising=False)
+        with pytest.raises(SystemExit, match="display"):
+            main([os.path.join(REFDATA, "NGC6440E.par"),
+                  os.path.join(REFDATA, "NGC6440E.tim")])
+
+    @pytest.mark.skipif(not os.environ.get("DISPLAY"),
+                        reason="no display")
+    def test_widget_builds(self):
+        import tkinter as tk
+
+        from pint_tpu.pintk.plk import PlkWidget
+        from pint_tpu.pintk.pulsar import Pulsar
+
+        psr = Pulsar(os.path.join(REFDATA, "NGC6440E.par"),
+                     os.path.join(REFDATA, "NGC6440E.tim"))
+        root = tk.Tk()
+        w = PlkWidget(root, psr)
+        w.update_plot()
+        root.destroy()

@@ -128,7 +128,7 @@ class TestPTABatch:
                 m.params["DM"].uncertainty, rel=1e-6
             )
 
-    def test_mismatched_structure_rejected(self):
+    def test_mismatched_structure_rejected_when_homogeneous(self):
         pairs = _make_pta(2)
         par = PAR_TEMPLATE.format(i=9, ra="09:00:00", f0=55.0,
                                   dm=5.0) + "GLEP_1 55000\nGLF0_1 0\n"
@@ -138,7 +138,10 @@ class TestPTABatch:
             obs="gbt", error_us=1.0,
         )
         with pytest.raises(ValueError, match="component structure"):
-            PTABatch(pairs + [(m, toas)])
+            PTABatch(pairs + [(m, toas)], heterogeneous=False)
+        # with heterogeneous batching the same mix is accepted
+        batch = PTABatch(pairs + [(m, toas)])
+        assert batch.n_pulsars == 3
 
     def test_sharded_fit_matches_unsharded(self):
         pairs = _make_pta(8, seed=20)
@@ -156,3 +159,217 @@ class TestPTABatch:
                                    np.asarray(chi21), rtol=1e-10)
         np.testing.assert_allclose(np.asarray(vec0),
                                    np.asarray(vec1), rtol=1e-10)
+
+
+BINARY_ELL1_EXTRA = """BINARY ELL1
+PB 12.5 1
+A1 9.2 1
+TASC 55000.5 1
+EPS1 1e-5 1
+EPS2 -2e-5 1
+"""
+
+BINARY_DD_EXTRA = """BINARY DD
+PB 8.3 1
+A1 6.1 1
+T0 55000.2 1
+ECC 0.17 1
+OM 110.0 1
+"""
+
+NOISE_EXTRA = """EFAC -f L-wide 1.1
+EQUAD -f L-wide 0.4
+ECORR -f L-wide 0.6
+TNRedAmp -13.0
+TNRedGam 3.0
+TNRedC 10
+"""
+
+
+def _make_hetero_pta(seed=0, with_noise=False):
+    """An isolated + ELL1 + DD mix (SURVEY §7 hard part #3)."""
+    pairs = []
+    extras = ["", BINARY_ELL1_EXTRA, BINARY_DD_EXTRA]
+    for i, extra in enumerate(extras):
+        par = PAR_TEMPLATE.format(
+            i=i, ra=f"{6 + i}:30:00", f0=80.0 + 21.0 * i,
+            dm=12.0 + 2.0 * i,
+        ) + extra + (NOISE_EXTRA if with_noise else "")
+        m = get_model(par)
+        n = 60 + 15 * i
+        toas = make_fake_toas_uniform(
+            54000, 56000, n, m,
+            freq_mhz=np.where(np.arange(n) % 2 == 0, 1400.0, 800.0),
+            obs="gbt", error_us=1.0,
+            add_noise=True, rng=np.random.default_rng(seed + i),
+            flags={"f": "L-wide"} if with_noise else None,
+        )
+        pairs.append((m, toas))
+    return pairs
+
+
+class TestHeterogeneousPTA:
+    def test_superset_residuals_match_single(self):
+        pairs = _make_hetero_pta()
+        batch = PTABatch(pairs)
+        r = np.asarray(batch.residuals())
+        for k, (m, toas) in enumerate(pairs):
+            single = Residuals(toas, m).time_resids
+            n = len(toas)
+            assert np.allclose(r[k, :n], np.asarray(single), atol=2e-10)
+
+    def test_superset_fit_matches_single_wls(self):
+        pairs = _make_hetero_pta(seed=7)
+        for m, _ in pairs:
+            m.values["F0"] += 3e-11  # perturb so the fit has work
+        batch = PTABatch(pairs)
+        vec, chi2, _ = batch.fit_wls(maxiter=3)
+        for k, (m0, toas) in enumerate(_make_hetero_pta(seed=7)):
+            m0.values["F0"] += 3e-11
+            f = WLSFitter(toas, m0)
+            f.fit_toas()
+            i_f0 = batch.free_names.index("F0")
+            assert np.isclose(float(np.asarray(vec)[k, i_f0]),
+                              float(f.model.values["F0"]),
+                              rtol=0, atol=5e-10)
+
+    def test_masked_params_do_not_move(self):
+        pairs = _make_hetero_pta(seed=3)
+        batch = PTABatch(pairs)
+        i_pb = batch.free_names.index("PB")
+        pb_before = float(batch.values0[0, i_pb])  # isolated pulsar
+        batch.fit_wls(maxiter=2)
+        # the isolated pulsar's placeholder PB must be untouched
+        assert float(batch.prepareds[0].model.values["PB"]) == pb_before
+
+
+class TestBatchedGLS:
+    def test_gls_matches_single_glsfitter(self):
+        from pint_tpu.fitter import GLSFitter
+
+        pairs = _make_hetero_pta(seed=11, with_noise=True)
+        for m, _ in pairs:
+            m.values["F0"] += 2e-11
+        batch = PTABatch(pairs)
+        vec, chi2, _ = batch.fit_gls(maxiter=3)
+        i_f0 = batch.free_names.index("F0")
+        for k, (m0, toas) in enumerate(
+                _make_hetero_pta(seed=11, with_noise=True)):
+            m0.values["F0"] += 2e-11
+            f = GLSFitter(toas, m0)
+            f.fit_toas(maxiter=3)
+            assert np.isclose(float(np.asarray(vec)[k, i_f0]),
+                              float(f.model.values["F0"]),
+                              rtol=0, atol=5e-10)
+
+    def test_gls_sharded_matches_unsharded(self):
+        from pint_tpu.parallel import pulsar_mesh
+
+        if len(jax.devices()) < 2:
+            pytest.skip("needs the multi-device CPU mesh")
+        pairs = _make_hetero_pta(seed=5, with_noise=True)
+        # pad the pulsar count to the device count with clones
+        while len(pairs) < len(jax.devices()):
+            m, t = pairs[len(pairs) % 3]
+            import copy
+
+            pairs.append((copy.deepcopy(m), t))
+        batch = PTABatch(pairs)
+        vec0, chi0, _ = batch.fit_gls(maxiter=2)
+        batch2 = PTABatch(pairs)
+        vec1, chi1, _ = batch2.fit_gls(maxiter=2, mesh=pulsar_mesh())
+        # eigh is not bit-identical across sharding layouts; agreement
+        # to ~1e-6 relative is layout noise, not a math difference.
+        # Weakly-constrained directions amplify that noise, so the
+        # strict comparison targets the well-determined params.
+        assert np.allclose(np.asarray(chi0), np.asarray(chi1),
+                           rtol=1e-6)
+        for name in ("F0", "DM", "F1"):
+            j = batch.free_names.index(name)
+            assert np.allclose(np.asarray(vec0)[:, j],
+                               np.asarray(vec1)[:, j], rtol=1e-9), name
+
+
+class TestHeterogeneousNoiseStructure:
+    def test_different_ecorr_epoch_counts(self):
+        """Pulsars with different numbers of ECORR observing epochs
+        (the universal real-PTA case) must batch and fit."""
+        pairs = []
+        for i, ndays in enumerate((3, 5)):
+            par = PAR_TEMPLATE.format(
+                i=i, ra=f"{7 + i}:00:00", f0=90.0 + 13.0 * i,
+                dm=11.0 + i,
+            ) + "EFAC -f L 1.1\nECORR -f L 0.5\n"
+            m = get_model(par)
+            # clustered TOAs -> real ECORR epochs, counts differ
+            mjds = np.concatenate(
+                [54000.0 + 30 * d + np.arange(3) * 2e-6
+                 for d in range(ndays)])
+            from pint_tpu.toa import TOA, TOAs
+            from pint_tpu.simulation import zero_residuals
+
+            tl = [TOA(int(x), int((x % 1.0) * 10**12), 10**12, 1.0,
+                      1400.0 if j % 2 else 800.0, "gbt", {"f": "L"}, "t")
+                  for j, x in enumerate(mjds)]
+            toas = TOAs(tl, ephem="builtin")
+            zero_residuals(toas, m)
+            m.values["DM"] += 1e-4
+            pairs.append((m, toas))
+        batch = PTABatch(pairs)
+        vec, chi2, _ = batch.fit_gls(maxiter=2)
+        assert np.all(np.isfinite(np.asarray(chi2)))
+
+    def test_superset_rednoise_stays_inert(self):
+        """A pulsar WITHOUT red noise mixed with one WITH it must not
+        inherit 10^0-amplitude spurious variance."""
+        par_plain = PAR_TEMPLATE.format(i=0, ra="06:00:00", f0=77.0,
+                                        dm=9.0)
+        par_red = PAR_TEMPLATE.format(i=1, ra="07:00:00", f0=88.0,
+                                      dm=10.0) + \
+            "TNRedAmp -13.0\nTNRedGam 3.0\nTNRedC 8\n"
+        pairs = []
+        for par, seed in ((par_plain, 0), (par_red, 1)):
+            m = get_model(par)
+            n = 40
+            toas = make_fake_toas_uniform(
+                54000, 56000, n, m,
+                freq_mhz=np.where(np.arange(n) % 2 == 0, 1400.0, 800.0),
+                obs="gbt", error_us=1.0, add_noise=True,
+                rng=np.random.default_rng(seed))
+            pairs.append((m, toas))
+        batch = PTABatch(pairs)
+        U, phi = batch._gather_noise()
+        phi = np.asarray(phi)
+        # pulsar 0 (superset-added red noise): every weight must be
+        # negligible except the mean-offset column
+        spurious = phi[0][phi[0] < 1e20]
+        assert np.all(spurious < 1e-30)
+        # and the fit recovers sane params
+        vec, chi2, _ = batch.fit_gls(maxiter=2)
+        assert np.all(np.isfinite(np.asarray(chi2)))
+
+    def test_same_class_different_glitch_counts(self):
+        """Same component classes, different family widths (1 vs 2
+        glitches) must superset-align instead of KeyError-ing."""
+        base = PAR_TEMPLATE.format(i=0, ra="08:00:00", f0=66.0, dm=8.0)
+        par1 = base + "GLEP_1 55000\nGLF0_1 1e-9 1\n"
+        par2 = (PAR_TEMPLATE.format(i=1, ra="09:00:00", f0=67.0, dm=8.5)
+                + "GLEP_1 54800\nGLF0_1 1e-9 1\n"
+                + "GLEP_2 55500\nGLF0_2 2e-9 1\n")
+        pairs = []
+        for par, seed in ((par1, 4), (par2, 5)):
+            m = get_model(par)
+            n = 40
+            toas = make_fake_toas_uniform(
+                54000, 56000, n, m,
+                freq_mhz=np.where(np.arange(n) % 2 == 0, 1400.0, 800.0),
+                obs="gbt", error_us=1.0, add_noise=True,
+                rng=np.random.default_rng(seed))
+            pairs.append((m, toas))
+        batch = PTABatch(pairs)
+        assert "GLF0_2" in batch.free_names
+        # pulsar 0 must not fit (or move) the glitch it doesn't have
+        j = batch.free_names.index("GLF0_2")
+        assert float(batch.free_mask[0, j]) == 0.0
+        vec, chi2, _ = batch.fit_wls(maxiter=2)
+        assert np.all(np.isfinite(np.asarray(chi2)))

@@ -215,3 +215,84 @@ class TestBinaryConvert:
         stigma = 0.97 / (1 + cosi)
         h3 = 4.925490947e-6 * 0.25 * stigma**3
         assert mh.values["H3"] == pytest.approx(h3, rel=1e-9)
+
+
+class TestDMXHelpers:
+    def test_dmx_ranges_and_parse(self):
+        from pint_tpu.models.builder import get_model
+        from pint_tpu.simulation import make_fake_toas_uniform
+        from pint_tpu.utils import add_dmx_ranges, dmx_ranges, dmxparse
+        from pint_tpu.fitter import WLSFitter
+
+        par = ("PSR J0\nRAJ 05:00:00\nDECJ 15:00:00\nF0 100 1\n"
+               "PEPOCH 54100\nDM 10 1\nTZRMJD 54100\nTZRSITE @\n"
+               "TZRFRQ 1400\nUNITS TDB\n")
+        m = get_model(par)
+        toas = make_fake_toas_uniform(
+            54000, 54120, 40, m, obs="@", error_us=1.0, add_noise=True,
+            freq_mhz=np.where(np.arange(40) % 2 == 0, 1400.0, 800.0))
+        ranges = dmx_ranges(toas, max_width_days=15.0)
+        assert len(ranges) >= 6
+        add_dmx_ranges(m, ranges)
+        assert m.has_component("DispersionDMX")
+        f = WLSFitter(toas, m)
+        f.fit_toas(maxiter=3)
+        out = dmxparse(f)
+        assert len(out["dmxs"]) == len(ranges)
+        assert np.all(np.isfinite(out["dmx_mean_sub"]))
+        assert np.all(out["r2s"] > out["r1s"])
+
+
+class TestWaveXHelpers:
+    def test_wavex_setup(self):
+        from pint_tpu.models.builder import get_model
+        from pint_tpu.utils import wavex_setup
+
+        par = ("PSR J0\nRAJ 05:00:00\nDECJ 15:00:00\nF0 100 1\n"
+               "PEPOCH 54100\nDM 10\nUNITS TDB\n")
+        m = get_model(par)
+        wavex_setup(m, 500.0, 4)
+        assert m.has_component("WaveX")
+        assert np.isclose(m.values["WXFREQ_0002"], 2.0 / 500.0)
+        assert "WXSIN_0003" in m.free_params
+
+    def test_translate_wave_exact(self):
+        from pint_tpu.models.builder import get_model
+        from pint_tpu.residuals import Residuals
+        from pint_tpu.simulation import make_fake_toas_uniform
+        from pint_tpu.utils import translate_wave_to_wavex
+
+        par = ("PSR J0\nRAJ 05:00:00\nDECJ 15:00:00\nF0 100 1\n"
+               "PEPOCH 54100\nDM 10\nTZRMJD 54100\nTZRSITE @\n"
+               "TZRFRQ 1400\nUNITS TDB\nWAVEEPOCH 54100\n"
+               "WAVE_OM 0.01\nWAVE1 1e-6 2e-6\nWAVE2 -5e-7 1e-7\n")
+        m = get_model(par)
+        toas = make_fake_toas_uniform(54000, 54400, 30, m, obs="@",
+                                      error_us=1.0)
+        r1 = np.asarray(Residuals(toas, m, subtract_mean=False,
+                                  track_mode="nearest").time_resids)
+        m2 = translate_wave_to_wavex(get_model(par))
+        assert m2.has_component("WaveX")
+        r2 = np.asarray(Residuals(toas, m2, subtract_mean=False,
+                                  track_mode="nearest").time_resids)
+        assert np.max(np.abs(r1 - r2)) < 1e-9
+
+
+class TestObservability:
+    def test_stage_timer(self):
+        import io
+
+        from pint_tpu.observability import StageTimer
+
+        st = StageTimer()
+        with st("stage A"):
+            x = sum(range(1000))
+        with st("stage A"):
+            pass
+        with st("stage B"):
+            pass
+        assert st.counts["stage A"] == 2
+        buf = io.StringIO()
+        rep = st.report(file=buf)
+        assert "stage A" in rep and "stage B" in rep
+        assert st.as_dict()["stage A"] >= 0.0
