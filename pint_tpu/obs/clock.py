@@ -227,28 +227,18 @@ class GlobalClockFile(ClockFile):
 
 
 def _clock_dirs():
-    dirs = []
-    env = os.environ.get("PINT_TPU_CLOCK_DIR")
-    if env:
-        dirs.append(env)
-    dirs.append("clock")
-    return [d for d in dirs if os.path.isdir(d)]
+    from pint_tpu.obs.datadirs import search_dirs
+
+    return search_dirs("PINT_TPU_CLOCK_DIR", "clock")
 
 
 def clock_data_identity():
     """Provenance string over every file in the clock search dirs
     (name, mtime, size) — part of the prepared-TOA cache hash so an
     installed or updated clock/BIPM file invalidates cached ticks."""
-    parts = []
-    for d in _clock_dirs():
-        for f in sorted(os.listdir(d)):
-            p = os.path.join(d, f)
-            try:
-                st = os.stat(p)
-            except OSError:
-                continue
-            parts.append(f"{f}:{st.st_mtime_ns}:{st.st_size}")
-    return ";".join(parts)
+    from pint_tpu.obs.datadirs import data_identity
+
+    return data_identity(_clock_dirs())
 
 
 def find_clock_file(filename, fmt=None, site_code=None):

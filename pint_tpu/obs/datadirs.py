@@ -1,0 +1,41 @@
+"""Shared runtime-data directory discovery + provenance.
+
+Clock files ($PINT_TPU_CLOCK_DIR / ./clock) and IERS EOP files
+($PINT_TPU_IERS_DIR / ./iers) use the same two-location search and the
+same (name, mtime, size) provenance string that feeds the prepared-TOA
+cache hash (reference analogue: the astropy download cache +
+``check_hashes`` at src/pint/toa.py:1856; here data is local-only).
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["search_dirs", "data_identity"]
+
+
+def search_dirs(env_var, default_subdir):
+    """Existing directories to search: $env_var (if set) then
+    ./default_subdir."""
+    dirs = []
+    env = os.environ.get(env_var)
+    if env:
+        dirs.append(env)
+    dirs.append(default_subdir)
+    return [d for d in dirs if os.path.isdir(d)]
+
+
+def data_identity(dirs):
+    """Provenance string over every file in ``dirs`` (name, mtime,
+    size) — changing, adding, or removing any file changes the string,
+    which invalidates prepared-TOA caches hashed over it."""
+    parts = []
+    for d in dirs:
+        for f in sorted(os.listdir(d)):
+            p = os.path.join(d, f)
+            try:
+                st = os.stat(p)
+            except OSError:
+                continue
+            parts.append(f"{f}:{st.st_mtime_ns}:{st.st_size}")
+    return ";".join(parts)

@@ -10,10 +10,13 @@ equinox-based rotation is implemented directly:
 - Precession: IAU 2006 zeta_A/z_A/theta_A polynomials (Capitaine et al.).
 - Nutation: leading IAU 2000 terms (9 largest; truncation ~ few mas,
   i.e. centimeters of site position — far below other builtin-path terms).
-- Polar motion: neglected (~10 m of site position ~ 30 ns Roemer worst
-  case); UT1 ~ UTC (|UT1-UTC| < 0.9 s -> up to ~420 m east-west ~ 1.4 us).
-  Both are IERS-data-driven and pluggable later; documented in
-  ACCURACY.md.  For simulate->fit self-consistency they cancel exactly.
+- Polar motion + UT1-UTC: read from standard IERS products installed in
+  $PINT_TPU_IERS_DIR (pint_tpu/obs/iers.py): W(xp, yp) is applied ahead
+  of R3(-GAST) and UT1 = UTC + dUT1 feeds the rotation angle.  With no
+  data installed both are zero (~10 m of site position ~ 30 ns Roemer
+  worst case from polar motion; |UT1-UTC| < 0.9 s -> up to ~420 m
+  east-west ~ 1.4 us), documented in ACCURACY.md.  For simulate->fit
+  self-consistency the zero-EOP path cancels exactly.
 
 Host-side numpy (ingest path, runs once per dataset).
 """
@@ -150,14 +153,30 @@ def gast_radians(T, ut1_jd_frac_days):
     return era + gmst_minus_era + eqeq
 
 
-def _ut1_days_from_ticks(ticks):
-    """Approximate UT1 (~UTC) days since J2000 from TDB ticks."""
+def _utc_days_from_ticks(ticks):
+    """UTC days since J2000 from TDB ticks."""
     tdb_sec = np.asarray(ticks, np.float64) / 2**32
     # invert TDB -> TT -> TAI -> UTC; iterate leap lookup once via day guess
     tt_sec = tdb_sec - tdb_minus_tt_seconds(tdb_sec)
     day_guess = np.floor(tt_sec / 86400.0 + 51544.5).astype(np.int64)
     utc_sec = tt_sec - TT_MINUS_TAI - tai_minus_utc(day_guess)
+    # the TT-based day guess is ~69 s ahead of UTC: within the last
+    # minute of a day preceding a leap-second insertion it lands on the
+    # wrong day; one refinement with the UTC-based day settles it
+    day = np.floor(utc_sec / 86400.0 + 51544.5).astype(np.int64)
+    utc_sec = tt_sec - TT_MINUS_TAI - tai_minus_utc(day)
     return utc_sec / 86400.0
+
+
+def polar_motion_matrix(xp_as, yp_as, T):
+    """W = R3(-s') R2(xp) R1(yp): ITRF -> terrestrial intermediate frame
+    (IERS 2010 conventions eq. 5.3).  Orientation check (tested): the
+    ITRF pole (0,0,1) maps to ~(-xp, +yp, 1) in the intermediate frame,
+    i.e. the CIP sits at (+xp, -yp) in ITRF coordinates."""
+    sp = -0.000047 * T * _AS  # TIO locator s' (-47 uas/century)
+    return _R3(-sp) @ _R2(np.asarray(xp_as, np.float64) * _AS) @ _R1(
+        np.asarray(yp_as, np.float64) * _AS
+    )
 
 
 def gcrs_posvel_from_itrf(itrf_xyz_m, ticks):
@@ -166,23 +185,35 @@ def gcrs_posvel_from_itrf(itrf_xyz_m, ticks):
     itrf_xyz_m: (3,) ITRF coordinates in meters; ticks: (...,) int64.
     """
     from pint_tpu.ephem import PosVel
+    from pint_tpu.obs.iers import get_eop
 
     ticks = np.atleast_1d(np.asarray(ticks))
     T = _julian_centuries_tt(ticks.astype(np.float64) / 2**32)
-    ut1_d = _ut1_days_from_ticks(ticks)
-    gast = gast_radians(T, ut1_d)
-    PN = precession_matrix(T) @ nutation_matrix(T)
+    utc_d = _utc_days_from_ticks(ticks)
 
     r = np.asarray(itrf_xyz_m, np.float64) / C_M_PER_S  # light-seconds
+    eop = get_eop()
+    if eop is not None:
+        xp, yp, dut1 = eop.at(utc_d + 51544.5)
+        W = polar_motion_matrix(xp, yp, T)
+        rw = np.einsum("...ij,j->...i", W, r)
+        r0, r1, r2 = rw[..., 0], rw[..., 1], rw[..., 2]
+        ut1_d = utc_d + dut1 / 86400.0
+    else:
+        r0, r1, r2 = r[0], r[1], r[2]
+        ut1_d = utc_d
+
+    gast = gast_radians(T, ut1_d)
+    PN = precession_matrix(T) @ nutation_matrix(T)
     cg, sg = np.cos(gast), np.sin(gast)
     # R3(-GAST) r
     rot = np.stack(
-        [cg * r[0] - sg * r[1], sg * r[0] + cg * r[1], np.broadcast_to(r[2], cg.shape)],
+        [cg * r0 - sg * r1, sg * r0 + cg * r1, np.broadcast_to(r2, cg.shape)],
         axis=-1,
     )
     omega = _TURN * _ERA_RATE / 86400.0  # rad/s
     vot = np.stack(
-        [(-sg * r[0] - cg * r[1]) * omega, (cg * r[0] - sg * r[1]) * omega,
+        [(-sg * r0 - cg * r1) * omega, (cg * r0 - sg * r1) * omega,
          np.zeros_like(cg)],
         axis=-1,
     )

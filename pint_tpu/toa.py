@@ -736,12 +736,14 @@ def get_TOAs(timfile, ephem="builtin", planets=False, include_clock=True,
         from pint_tpu.ephem import get_ephemeris
 
         from pint_tpu.obs.clock import clock_data_identity
+        from pint_tpu.obs.iers import eop_data_identity
 
         eph_id = get_ephemeris(ephem).identity
         src_hash = (_tim_hash(timfile)
                     + f"|clock={bool(include_clock)}|eph={eph_id}"
                     + f"|bipm={bipm_version if include_bipm else ''}"
-                    + f"|clkdata={clock_data_identity()}")
+                    + f"|clkdata={clock_data_identity()}"
+                    + f"|eopdata={eop_data_identity()}")
         cached = load_cache(cache_path, src_hash=src_hash, ephem=ephem,
                             planets=planets)
         if cached is not None:

@@ -1,0 +1,190 @@
+"""IERS EOP tables (pint_tpu/obs/iers.py) and their effect on the
+ITRF->GCRS chain (erot.py).
+
+Mirrors the intent of the reference's reliance on astropy IERS data in
+erfautils (reference: src/pint/erfautils.py:1-85): polar motion and
+UT1-UTC come from standard IERS products, here installed via
+$PINT_TPU_IERS_DIR instead of a network cache.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import pint_tpu.obs.iers as iers
+from pint_tpu.obs.erot import gcrs_posvel_from_itrf, polar_motion_matrix
+from pint_tpu.obs.iers import EOPTable
+from pint_tpu import C_M_PER_S
+
+
+def _finals_line(year, month, day, mjd, xp, yp, dut1):
+    """One fixed-width finals2000A row (only the fields we parse)."""
+    line = [" "] * 80
+    line[0:2] = f"{year % 100:02d}"
+    line[2:4] = f"{month:02d}"
+    line[4:6] = f"{day:02d}"
+    line[7:15] = f"{mjd:8.2f}"
+    line[16] = "I"
+    line[18:27] = f"{xp:9.6f}"
+    line[27:36] = f"{0.000020:9.6f}"
+    line[37:46] = f"{yp:9.6f}"
+    line[46:55] = f"{0.000020:9.6f}"
+    line[57] = "I"
+    line[58:68] = f"{dut1:10.7f}"
+    return "".join(line)
+
+
+def test_finals2000a_parse(tmp_path):
+    p = tmp_path / "finals2000A.all"
+    rows = [
+        _finals_line(2020, 1, 1, 58849.0, 0.076577, 0.282336, -0.1772359),
+        _finals_line(2020, 1, 2, 58850.0, 0.074878, 0.281397, -0.1778669),
+        # prediction row without values must be skipped
+        "2001 3 58851.00 P" + " " * 60,
+    ]
+    p.write_text("\n".join(rows) + "\n")
+    t = EOPTable.from_file(str(p))
+    assert t.mjd.size == 2
+    np.testing.assert_allclose(t.xp, [0.076577, 0.074878])
+    np.testing.assert_allclose(t.yp, [0.282336, 0.281397])
+    np.testing.assert_allclose(t.dut1, [-0.1772359, -0.1778669])
+    xp, yp, dut1 = t.at(58849.5)
+    np.testing.assert_allclose(xp, (0.076577 + 0.074878) / 2)
+    np.testing.assert_allclose(dut1, (-0.1772359 - 0.1778669) / 2)
+
+
+def test_eopc04_parse(tmp_path):
+    p = tmp_path / "eopc04_IAU2000.62-now"
+    p.write_text(
+        "# yr mo dy mjd xp yp ut1-utc lod dpsi deps ...\n"
+        "FORMAT HEADER junk\n"
+        "2020   1   1  58849   0.076577   0.282336  -0.1772359   0.0004  0 0\n"
+        "2020   1   2  58850   0.074878   0.281397  -0.1778669   0.0004  0 0\n"
+    )
+    t = EOPTable.from_file(str(p))
+    assert t.mjd.size == 2
+    np.testing.assert_allclose(t.yp[0], 0.282336)
+
+
+def test_eopc04_v2_hour_column(tmp_path):
+    """The 2023+ C04 layout has an hour column before the MJD
+    (yr mo dy hh MJD xp yp UT1-UTC ...)."""
+    p = tmp_path / "eopc04.1962-now"
+    p.write_text(
+        "2020   1   1  12  58849.5   0.076577   0.282336  -0.1772359  0.0004\n"
+        "2020   1   2  12  58850.5   0.074878   0.281397  -0.1778669  0.0004\n"
+    )
+    t = EOPTable.from_file(str(p))
+    assert t.mjd.size == 2
+    np.testing.assert_allclose(t.mjd, [58849.5, 58850.5])
+    np.testing.assert_allclose(t.xp, [0.076577, 0.074878])
+    np.testing.assert_allclose(t.dut1, [-0.1772359, -0.1778669])
+
+
+def test_pre_1972_rows_dropped():
+    """C04 starts in 1962; pre-leap-era rows must be dropped, not
+    abort the whole table."""
+    t = EOPTable([37665.0, 58849.0, 58850.0], [0, 0.1, 0.1],
+                 [0, 0.2, 0.2], [0.0, -0.17, -0.18])
+    assert t.mjd.size == 2
+    assert t.mjd[0] == 58849.0
+    with pytest.raises(ValueError):
+        EOPTable([37665.0], [0.0], [0.0], [0.0])
+
+
+def test_simple_parse_and_clamp(tmp_path):
+    p = tmp_path / "eop.dat"
+    p.write_text("# mjd xp yp dut1\n58849 0.1 0.2 -0.17\n58850 0.1 0.2 -0.18\n")
+    t = EOPTable.from_file(str(p))
+    # out-of-range queries clamp to end values (in continuous UT1-TAI;
+    # no leap seconds occur near this table so dut1 clamps directly)
+    xp, yp, dut1 = t.at(58900.0)
+    np.testing.assert_allclose(dut1, -0.18)
+    xp, yp, dut1 = t.at(58800.0)
+    np.testing.assert_allclose(dut1, -0.17)
+
+
+def test_leap_second_interpolation():
+    """UT1-UTC steps by +1 s across a leap second (2016-12-31 ->
+    2017-01-01, MJD 57753 -> 57754); interpolation must happen in the
+    continuous UT1-TAI, not smear the step."""
+    # dut1 jumps from -0.59 to +0.41 at the leap boundary
+    t = EOPTable([57753.0, 57754.0], [0.1, 0.1], [0.2, 0.2], [-0.59, 0.41])
+    # just before midnight: still on the pre-leap realization
+    _, _, dut1 = t.at(57753.999)
+    assert abs(dut1 - (-0.59)) < 2e-3  # continuous UT1-TAI drifts ~us/day
+    _, _, dut1 = t.at(57754.001)
+    assert abs(dut1 - 0.41) < 2e-3
+
+
+def test_polar_motion_orientation():
+    """The ITRF pole maps to ~(-xp, +yp, 1) in the intermediate frame."""
+    W = polar_motion_matrix(0.2, 0.3, 0.0)  # arcsec
+    out = W @ np.array([0.0, 0.0, 1.0])
+    asrad = np.pi / (180 * 3600)
+    np.testing.assert_allclose(out[0], -0.2 * asrad, rtol=1e-6)
+    np.testing.assert_allclose(out[1], 0.3 * asrad, rtol=1e-6)
+    np.testing.assert_allclose(out[2], 1.0, rtol=1e-9)
+
+
+@pytest.fixture
+def eop_dir(tmp_path, monkeypatch):
+    d = tmp_path / "iers"
+    d.mkdir()
+    monkeypatch.setenv("PINT_TPU_IERS_DIR", str(d))
+    iers._cached = None
+    yield d
+    iers._cached = None
+
+
+def test_get_eop_and_identity(eop_dir):
+    assert iers.get_eop() is None
+    ident0 = iers.eop_data_identity()
+    (eop_dir / "eop.dat").write_text("58849 0.1 0.2 -0.17\n58850 0.1 0.2 -0.18\n")
+    assert iers.eop_data_identity() != ident0
+    t = iers.get_eop()
+    assert t is not None and t.mjd.size == 2
+
+
+def test_dut1_shifts_site_position(eop_dir):
+    """UT1-UTC = +0.5 s rotates the site east by omega*R_eq*cos(lat)*0.5s."""
+    itrf = np.array([6378137.0, 0.0, 0.0])  # equator, Greenwich
+    ticks = np.array([int(((58849.6 - 51544.5) * 86400.0 + 69.184) * 2**32)],
+                     np.int64)
+    iers._cached = None
+    pv0 = gcrs_posvel_from_itrf(itrf, ticks)
+    (eop_dir / "eop.dat").write_text("58840 0.0 0.0 0.5\n58860 0.0 0.0 0.5\n")
+    iers._cached = None
+    pv1 = gcrs_posvel_from_itrf(itrf, ticks)
+    dr = np.linalg.norm((pv1.pos - pv0.pos)) * C_M_PER_S
+    expect = 2 * np.pi * 1.00273781191135448 / 86400.0 * 6378137.0 * 0.5
+    np.testing.assert_allclose(dr, expect, rtol=1e-4)
+
+
+def test_polar_motion_shifts_pole_station(eop_dir):
+    """A station at the pole moves by ~R*sqrt(xp^2+yp^2) when polar
+    motion is applied; an equatorial station's |shift| is much smaller."""
+    asrad = np.pi / (180 * 3600)
+    itrf_pole = np.array([0.0, 0.0, 6356752.0])
+    ticks = np.array([int(((58849.6 - 51544.5) * 86400.0 + 69.184) * 2**32)],
+                     np.int64)
+    iers._cached = None
+    pv0 = gcrs_posvel_from_itrf(itrf_pole, ticks)
+    (eop_dir / "eop.dat").write_text("58840 0.2 0.3 0.0\n58860 0.2 0.3 0.0\n")
+    iers._cached = None
+    pv1 = gcrs_posvel_from_itrf(itrf_pole, ticks)
+    dr = np.linalg.norm(pv1.pos - pv0.pos) * C_M_PER_S
+    expect = 6356752.0 * np.hypot(0.2, 0.3) * asrad
+    np.testing.assert_allclose(dr, expect, rtol=1e-3)
+
+
+def test_toa_cache_invalidated_by_eop(eop_dir, tmp_path):
+    """Installing an EOP file changes the prepared-TOA cache hash."""
+    from pint_tpu.obs.iers import eop_data_identity
+
+    a = eop_data_identity()
+    (eop_dir / "finals2000A.all").write_text(
+        _finals_line(2020, 1, 1, 58849.0, 0.07, 0.28, -0.17) + "\n"
+    )
+    assert eop_data_identity() != a
