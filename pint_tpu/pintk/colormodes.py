@@ -1,0 +1,149 @@
+"""TOA coloring modes for the plk panel (reference:
+src/pint/pintk/colormodes.py — DefaultMode, FreqMode, ObsMode,
+NameMode, JumpMode).
+
+Headless: each mode maps the current Pulsar state to one matplotlib
+color per (non-deleted) TOA plus a legend dict, so modes are unit
+testable without Tk.  Register new modes by subclassing
+:class:`ColorMode`; the plk widget lists ``COLOR_MODES`` by name.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["ColorMode", "COLOR_MODES", "get_color_mode"]
+
+# a colorblind-reasonable cycle for categorical modes
+_CYCLE = [
+    "#0072B2", "#D55E00", "#009E73", "#CC79A7", "#E69F00",
+    "#56B4E9", "#F0E442", "#8B4513", "#666666", "#9400D3",
+]
+
+
+class ColorMode:
+    """Base: subclasses implement ``colors(pulsar) -> (colors, legend)``
+    where ``colors`` is a list of color strings aligned with
+    ``pulsar.selected_toas`` and ``legend`` maps label -> color."""
+
+    name = "base"
+
+    def colors(self, pulsar):
+        raise NotImplementedError
+
+
+class DefaultMode(ColorMode):
+    """All TOAs one color (pre-fit grey, post-fit blue like the
+    reference's default look)."""
+
+    name = "default"
+
+    def colors(self, pulsar):
+        c = "#0072B2" if pulsar.fitted else "#666666"
+        n = len(pulsar.selected_toas)
+        return [c] * n, {"TOAs": c}
+
+
+class _CategoricalMode(ColorMode):
+    """Color by a per-TOA category string."""
+
+    def categories(self, pulsar):
+        raise NotImplementedError
+
+    def colors(self, pulsar):
+        cats = self.categories(pulsar)
+        labels = sorted(set(cats))
+        cmap = {lab: _CYCLE[i % len(_CYCLE)] for i, lab in enumerate(labels)}
+        return [cmap[c] for c in cats], cmap
+
+
+class ObsMode(_CategoricalMode):
+    """One color per observatory."""
+
+    name = "obs"
+
+    def categories(self, pulsar):
+        return [str(o) for o in pulsar.selected_toas.obs_names]
+
+
+class NameMode(_CategoricalMode):
+    """One color per ``-name`` flag value (data-file / backend name)."""
+
+    name = "name"
+
+    def categories(self, pulsar):
+        return [str(f.get("name", f.get("f", "unflagged")))
+                for f in pulsar.selected_toas.flags]
+
+
+class JumpMode(_CategoricalMode):
+    """Color the TOAs under each JUMP selector; un-jumped TOAs grey."""
+
+    name = "jump"
+
+    def categories(self, pulsar):
+        from pint_tpu.models.component import mask_from_select
+
+        toas = pulsar.selected_toas
+        cats = ["no jump"] * len(toas)
+        model = pulsar.model
+        for comp_name in ("PhaseJump", "DelayJump"):
+            if not model.has_component(comp_name):
+                continue
+            comp = model.component(comp_name)
+            for i, sel in enumerate(comp.selects, start=1):
+                mask = np.asarray(mask_from_select(sel, toas))
+                for j in np.flatnonzero(mask):
+                    cats[int(j)] = f"JUMP{i}"
+        return cats
+
+    def colors(self, pulsar):
+        cats = self.categories(pulsar)
+        labels = sorted(set(cats) - {"no jump"})
+        cmap = {lab: _CYCLE[i % len(_CYCLE)] for i, lab in enumerate(labels)}
+        cmap["no jump"] = "#bbbbbb"
+        return [cmap[c] for c in cats], cmap
+
+
+class FreqMode(ColorMode):
+    """Color by radio-frequency band (reference FreqMode bands)."""
+
+    name = "freq"
+
+    #: (upper edge MHz, label, color) — evaluated in order
+    BANDS = [
+        (300.0, "<300 MHz", "#9400D3"),
+        (500.0, "300-500 MHz", "#0072B2"),
+        (1000.0, "500-1000 MHz", "#009E73"),
+        (1800.0, "1000-1800 MHz", "#E69F00"),
+        (3000.0, "1800-3000 MHz", "#D55E00"),
+        (np.inf, ">3000 MHz", "#CC79A7"),
+    ]
+
+    def colors(self, pulsar):
+        freqs = np.asarray(pulsar.selected_toas.freq_mhz, np.float64)
+        out = []
+        used = {}
+        for f in freqs:
+            for hi, label, color in self.BANDS:
+                if f < hi:
+                    out.append(color)
+                    used[label] = color
+                    break
+            else:  # inf frequency (barycentered photon TOAs)
+                out.append("#666666")
+                used["infinite"] = "#666666"
+        return out, used
+
+
+COLOR_MODES = {m.name: m for m in
+               (DefaultMode(), FreqMode(), ObsMode(), NameMode(), JumpMode())}
+
+
+def get_color_mode(name):
+    try:
+        return COLOR_MODES[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown color mode {name!r}; have {sorted(COLOR_MODES)}"
+        ) from None

@@ -1,0 +1,113 @@
+"""Par-file editor pane (reference: src/pint/pintk/paredit.py).
+
+The reference couples a Tk Text widget to the Pulsar state; here the
+text-editing state machine is a headless :class:`ParEditor` (testable)
+and :class:`ParWidget` is the thin Tk shell around it.
+
+Semantics match the reference: the editor holds par text seeded from
+the current model; Apply re-parses the text into a fresh model and
+swaps it into the Pulsar (keeping TOAs); Reset re-seeds from the
+model; Open/Write do file IO.
+"""
+
+from __future__ import annotations
+
+import copy
+
+
+class ParEditor:
+    """Headless par-text editing core."""
+
+    def __init__(self, pulsar):
+        self.psr = pulsar
+        self.text = ""
+        self.reset()
+
+    def reset(self):
+        """Seed the buffer from the Pulsar's current model."""
+        from pint_tpu.models.builder import model_to_parfile
+
+        self.text = model_to_parfile(self.psr.model)
+
+    def apply(self):
+        """Parse the buffer into a model and swap it into the Pulsar.
+        Raises on parse errors, leaving the Pulsar untouched."""
+        from pint_tpu.models.builder import get_model
+
+        model = get_model(self.text)
+        self.psr.model = model
+        self.psr.model_init = copy.deepcopy(model)
+        self.psr.fitted = False
+        return model
+
+    def load(self, path):
+        with open(path, "r") as f:
+            self.text = f.read()
+
+    def write(self, path):
+        with open(path, "w") as f:
+            f.write(self.text)
+
+
+class ParWidget:
+    """Tk shell: Text pane + Apply/Reset/Open/Write buttons."""
+
+    def __init__(self, parent, pulsar, on_apply=None):
+        import tkinter as tk
+        from tkinter import filedialog
+
+        self.editor = ParEditor(pulsar)
+        self.on_apply = on_apply
+        self._filedialog = filedialog
+
+        frame = tk.Frame(parent)
+        frame.pack(fill="both", expand=True)
+        self.textbox = tk.Text(frame, width=60)
+        self.textbox.pack(fill="both", expand=True)
+        self.textbox.insert("1.0", self.editor.text)
+        ctrl = tk.Frame(frame)
+        ctrl.pack(fill="x")
+        for label, cmd in [
+            ("Apply", self.do_apply), ("Reset", self.do_reset),
+            ("Open par...", self.do_open), ("Write par...", self.do_write),
+        ]:
+            tk.Button(ctrl, text=label, command=cmd).pack(side="left")
+        self.status = tk.Label(frame, anchor="w")
+        self.status.pack(fill="x")
+
+    def _sync_from_box(self):
+        self.editor.text = self.textbox.get("1.0", "end-1c")
+
+    def _sync_to_box(self):
+        self.textbox.delete("1.0", "end")
+        self.textbox.insert("1.0", self.editor.text)
+
+    def do_apply(self):
+        self._sync_from_box()
+        try:
+            self.editor.apply()
+        except Exception as e:  # surface parse errors in the status bar
+            self.status.config(text=f"par error: {e}")
+            return
+        self.status.config(text="applied")
+        if self.on_apply:
+            self.on_apply()
+
+    def do_reset(self):
+        self.editor.reset()
+        self._sync_to_box()
+        self.status.config(text="reset from model")
+
+    def do_open(self):
+        path = self._filedialog.askopenfilename(
+            filetypes=[("par files", "*.par"), ("all", "*")])
+        if path:
+            self.editor.load(path)
+            self._sync_to_box()
+
+    def do_write(self):
+        self._sync_from_box()
+        path = self._filedialog.asksaveasfilename(defaultextension=".par")
+        if path:
+            self.editor.write(path)
+            self.status.config(text=f"wrote {path}")

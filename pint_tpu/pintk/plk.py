@@ -1,46 +1,67 @@
 """Tk "plk"-style interactive fitting panel (reference:
 src/pint/pintk/plk.py, 1707 LoC Tk widget).
 
-Layout: matplotlib residual canvas (pre/post fit), parameter fit-flag
-checkboxes, x-axis selector, and action buttons (Fit, Reset, Random
-models, Delete selection, Jump selection, Write par/tim).  All state
-operations live in :class:`pint_tpu.pintk.pulsar.Pulsar`, so the GUI is
-a thin shell (and the logic is testable headlessly)."""
+Layout: a notebook with the plk canvas plus par/tim editor tabs
+(paredit.py / timedit.py); the plk tab holds a matplotlib residual
+canvas (pre/post fit), parameter fit-flag checkboxes, x-axis and
+color-mode selectors (colormodes.py), fit-method menu, and action
+buttons (Fit, Reset, Undo, Random models, Delete, Jump, phase wraps,
+Write par/tim).  All state operations live in
+:class:`pint_tpu.pintk.pulsar.Pulsar`, so the GUI is a thin shell and
+the logic is testable headlessly.
+
+Key bindings (reference plk helpstring analogues):
+  f fit · r reset · u undo · d delete selection · j jump selection ·
+  + / - add ±1 phase wrap to selection · c clear selection
+"""
 
 from __future__ import annotations
 
 import numpy as np
 
+from pint_tpu.pintk.colormodes import COLOR_MODES, get_color_mode
+
 
 class PlkWidget:
     def __init__(self, root, pulsar):
         import tkinter as tk
+        from tkinter import ttk
         from matplotlib.backends.backend_tkagg import (
             FigureCanvasTkAgg,
             NavigationToolbar2Tk,
         )
         from matplotlib.figure import Figure
+        from matplotlib.widgets import RectangleSelector
 
         self.tk = tk
         self.root = root
         self.psr = pulsar
         self.selected = np.zeros(len(pulsar.all_toas), dtype=bool)
 
-        main = tk.Frame(root)
-        main.pack(fill="both", expand=True)
+        notebook = ttk.Notebook(root)
+        notebook.pack(fill="both", expand=True)
+        main = tk.Frame(notebook)
+        notebook.add(main, text="plk")
+
+        # par / tim editor tabs (lazy import keeps plk usable alone)
+        from pint_tpu.pintk.paredit import ParWidget
+        from pint_tpu.pintk.timedit import TimWidget
+
+        partab = tk.Frame(notebook)
+        notebook.add(partab, text="par")
+        self.paredit = ParWidget(partab, pulsar, on_apply=self.on_model_change)
+        timtab = tk.Frame(notebook)
+        notebook.add(timtab, text="tim")
+        self.timedit = TimWidget(timtab, pulsar, on_apply=self.on_toas_change)
 
         # left: parameter panel
         left = tk.Frame(main)
         left.pack(side="left", fill="y")
         tk.Label(left, text="Fit parameters").pack()
+        self.param_frame = tk.Frame(left)
+        self.param_frame.pack(fill="y")
         self.fit_vars = {}
-        for name, par in pulsar.model.params.items():
-            if not par.fittable:
-                continue
-            v = tk.BooleanVar(value=not par.frozen)
-            tk.Checkbutton(left, text=name, variable=v,
-                           command=self._sync_fit_flags).pack(anchor="w")
-            self.fit_vars[name] = v
+        self._build_param_panel()
 
         # right: canvas + controls
         right = tk.Frame(main)
@@ -51,24 +72,59 @@ class PlkWidget:
         self.canvas.get_tk_widget().pack(fill="both", expand=True)
         NavigationToolbar2Tk(self.canvas, right)
         self.canvas.mpl_connect("button_press_event", self._on_click)
+        self.canvas.mpl_connect("key_press_event", self._on_key)
+        self.box = RectangleSelector(
+            self.ax, self._on_box, useblit=True, button=[3],
+            minspanx=1e-12, minspany=1e-12)
 
         ctrl = tk.Frame(right)
         ctrl.pack(fill="x")
         self.xaxis = tk.StringVar(value="mjd")
-        tk.OptionMenu(ctrl, self.xaxis, "mjd", "year", "serial",
-                      "orbital phase",
-                      command=lambda *_: self.update_plot()).pack(
-            side="left")
+        tk.OptionMenu(ctrl, self.xaxis, *self.psr.XAXIS_CHOICES,
+                      command=lambda *_: self.update_plot()).pack(side="left")
+        self.colormode = tk.StringVar(value="default")
+        tk.OptionMenu(ctrl, self.colormode, *sorted(COLOR_MODES),
+                      command=lambda *_: self.update_plot()).pack(side="left")
+        self.fitmethod = tk.StringVar(value="auto")
+        tk.OptionMenu(ctrl, self.fitmethod,
+                      *self.psr.FIT_METHODS).pack(side="left")
         for label, cmd in [
             ("Fit", self.do_fit), ("Reset", self.do_reset),
+            ("Undo", self.do_undo),
             ("Random models", self.do_random),
             ("Delete selected", self.do_delete),
             ("Jump selected", self.do_jump),
+            ("Wrap +1", lambda: self.do_wrap(+1)),
+            ("Wrap -1", lambda: self.do_wrap(-1)),
             ("Write par", self.do_write_par),
+            ("Write tim", self.do_write_tim),
         ]:
             tk.Button(ctrl, text=label, command=cmd).pack(side="left")
         self.status = tk.Label(right, anchor="w")
         self.status.pack(fill="x")
+        self.update_plot()
+
+    # -- panel builders --------------------------------------------------------
+    def _build_param_panel(self):
+        for w in self.param_frame.winfo_children():
+            w.destroy()
+        self.fit_vars = {}
+        for name, par in self.psr.model.params.items():
+            if not par.fittable:
+                continue
+            v = self.tk.BooleanVar(value=not par.frozen)
+            self.tk.Checkbutton(self.param_frame, text=name, variable=v,
+                                command=self._sync_fit_flags).pack(anchor="w")
+            self.fit_vars[name] = v
+
+    def on_model_change(self):
+        """Par editor applied a new model."""
+        self._build_param_panel()
+        self.update_plot()
+
+    def on_toas_change(self):
+        """Tim editor applied a new TOA set."""
+        self.selected = np.zeros(len(self.psr.all_toas), dtype=bool)
         self.update_plot()
 
     # -- actions ---------------------------------------------------------------
@@ -78,7 +134,7 @@ class PlkWidget:
 
     def do_fit(self):
         self._sync_fit_flags()
-        f = self.psr.fit()
+        self.psr.fit(method=self.fitmethod.get())
         r = self.psr.postfit_resids()
         self.status.config(
             text=f"chi2 = {r.chi2:.2f} / dof {r.dof} ; "
@@ -87,6 +143,12 @@ class PlkWidget:
 
     def do_reset(self):
         self.psr.reset_model()
+        self.update_plot()
+
+    def do_undo(self):
+        kind = self.psr.undo()
+        self.status.config(text=f"undid {kind}" if kind else "nothing to undo")
+        self.selected = np.zeros(len(self.psr.all_toas), dtype=bool)
         self.update_plot()
 
     def do_random(self):
@@ -115,6 +177,13 @@ class PlkWidget:
             self.status.config(text=f"added {name}")
             self.update_plot()
 
+    def do_wrap(self, sign):
+        idx = np.flatnonzero(self.selected)
+        if idx.size:
+            self.psr.add_phase_wrap(idx, sign)
+            self.status.config(text=f"phase wrap {sign:+d} on {idx.size} TOAs")
+            self.update_plot()
+
     def do_write_par(self):
         from tkinter import filedialog
 
@@ -123,14 +192,59 @@ class PlkWidget:
             self.psr.write_par(path)
             self.status.config(text=f"wrote {path}")
 
+    def do_write_tim(self):
+        from tkinter import filedialog
+
+        path = filedialog.asksaveasfilename(defaultextension=".tim")
+        if path:
+            self.psr.write_tim(path)
+            self.status.config(text=f"wrote {path}")
+
+    # -- selection -------------------------------------------------------------
+    def _visible_to_full(self, vis_idx):
+        return np.flatnonzero(~self.psr.deleted)[vis_idx]
+
     def _on_click(self, event):
         if event.inaxes is not self.ax or event.xdata is None:
             return
         x = self.psr.xaxis(self.xaxis.get())
         i = int(np.argmin(np.abs(x - event.xdata)))
-        full = np.flatnonzero(~self.psr.deleted)[i]
+        full = self._visible_to_full(i)
         self.selected[full] = not self.selected[full]
         self.update_plot()
+
+    def _on_box(self, eclick, erelease):
+        """Right-drag box selection (reference plk area select)."""
+        x = self.psr.xaxis(self.xaxis.get())
+        r = (self.psr.postfit_resids() if self.psr.fitted
+             else self.psr.prefit_resids())
+        res = np.asarray(r.time_resids) * 1e6
+        x0, x1 = sorted((eclick.xdata, erelease.xdata))
+        y0, y1 = sorted((eclick.ydata, erelease.ydata))
+        inside = (x >= x0) & (x <= x1) & (res >= y0) & (res <= y1)
+        if inside.any():
+            self.selected[self._visible_to_full(np.flatnonzero(inside))] = True
+            self.update_plot()
+
+    def _on_key(self, event):
+        key = (event.key or "").lower()
+        if key == "f":
+            self.do_fit()
+        elif key == "r":
+            self.do_reset()
+        elif key == "u":
+            self.do_undo()
+        elif key == "d":
+            self.do_delete()
+        elif key == "j":
+            self.do_jump()
+        elif key in ("+", "="):
+            self.do_wrap(+1)
+        elif key == "-":
+            self.do_wrap(-1)
+        elif key == "c":
+            self.selected[:] = False
+            self.update_plot()
 
     # -- drawing ----------------------------------------------------------------
     def update_plot(self):
@@ -140,10 +254,22 @@ class PlkWidget:
         x = self.psr.xaxis(self.xaxis.get())
         res = np.asarray(r.time_resids) * 1e6
         err = np.asarray(r.scaled_errors) * 1e6
-        self.ax.errorbar(x, res, yerr=err, fmt=".", ms=4)
+        colors, legend = get_color_mode(self.colormode.get()).colors(self.psr)
+        self.ax.errorbar(x, res, yerr=err, fmt="none", ecolor="#cccccc",
+                         zorder=1)
+        self.ax.scatter(x, res, c=colors, s=16, zorder=2)
+        if len(legend) > 1:
+            import matplotlib.lines as mlines
+
+            self.ax.legend(
+                handles=[mlines.Line2D([], [], color=c, marker="o", ls="",
+                                       label=lab)
+                         for lab, c in sorted(legend.items())],
+                loc="best", fontsize=8)
         sel = self.selected[~self.psr.deleted]
         if sel.any():
-            self.ax.plot(x[sel], res[sel], "o", mfc="none", mec="red")
+            self.ax.plot(x[sel], res[sel], "o", mfc="none", mec="red",
+                         ms=9, zorder=3)
         self.ax.set_xlabel(self.xaxis.get())
         self.ax.set_ylabel("residual [us]")
         self.ax.set_title(

@@ -37,6 +37,7 @@ class Pulsar:
         self.fitter = None
         self.fitted = False
         self._postfit = None
+        self._undo_stack = []
 
     # -- selection ------------------------------------------------------------
     @property
@@ -46,12 +47,47 @@ class Pulsar:
         return self.all_toas[~self.deleted]
 
     def delete_toas(self, indices):
-        """Mark TOAs deleted (indices into the full set)."""
+        """Mark TOAs deleted (indices into the full set); undoable."""
+        self._undo_stack.append(("deleted", self.deleted.copy()))
         self.deleted[np.asarray(indices, dtype=int)] = True
         self.fitted = False
 
     def restore_all(self):
+        self._undo_stack.append(("deleted", self.deleted.copy()))
         self.deleted[:] = False
+        self.fitted = False
+
+    def undo(self):
+        """Undo the most recent deletion / restore / phase wrap
+        (reference plk 'u' key behavior).  Returns a description of
+        what was undone, or None if the stack is empty."""
+        if not self._undo_stack:
+            return None
+        kind, state = self._undo_stack.pop()
+        if kind == "deleted":
+            self.deleted = state
+        elif kind == "padd":
+            for i, old in state:
+                if old is None:
+                    self.all_toas.flags[i].pop("padd", None)
+                else:
+                    self.all_toas.flags[i]["padd"] = old
+        self.fitted = False
+        return kind
+
+    # -- phase wraps (reference pulsar.py add_phase_wrap: integer turns
+    # via the delta_pulse_number column; here the -padd flag, which
+    # Residuals folds into the phase assignment) -------------------------
+    def add_phase_wrap(self, indices, wrap):
+        """Add ``wrap`` (signed integer) turns to the selected TOAs
+        (indices into the full set); undoable."""
+        indices = np.asarray(indices, dtype=int)
+        prior = []
+        for i in indices:
+            f = self.all_toas.flags[i]
+            prior.append((int(i), f.get("padd")))
+            f["padd"] = repr(float(f.get("padd", 0.0)) + float(wrap))
+        self._undo_stack.append(("padd", prior))
         self.fitted = False
 
     # -- parameters -----------------------------------------------------------
@@ -88,9 +124,34 @@ class Pulsar:
         return name
 
     # -- fitting ---------------------------------------------------------------
-    def fit(self, downhill=True):
+    #: fit-method menu entries (reference plk fitter selector)
+    FIT_METHODS = ("auto", "wls", "gls", "downhill wls", "downhill gls")
+
+    def fit(self, downhill=True, method="auto"):
+        """Fit the non-deleted TOAs.  ``method`` is one of
+        ``FIT_METHODS``: 'auto' (reference Fitter.auto dispatch) or an
+        explicitly chosen fitter."""
         toas = self.selected_toas
-        self.fitter = Fitter.auto(toas, self.model, downhill=downhill)
+        if method == "auto":
+            self.fitter = Fitter.auto(toas, self.model, downhill=downhill)
+        elif method == "wls":
+            from pint_tpu.fitter import WLSFitter
+
+            self.fitter = WLSFitter(toas, self.model)
+        elif method == "gls":
+            from pint_tpu.fitter import GLSFitter
+
+            self.fitter = GLSFitter(toas, self.model)
+        elif method == "downhill wls":
+            from pint_tpu.downhill import DownhillWLSFitter
+
+            self.fitter = DownhillWLSFitter(toas, self.model)
+        elif method == "downhill gls":
+            from pint_tpu.downhill import DownhillGLSFitter
+
+            self.fitter = DownhillGLSFitter(toas, self.model)
+        else:
+            raise ValueError(f"unknown fit method {method!r}")
         self.fitter.fit_toas()
         self.model = self.fitter.model
         self._postfit = Residuals(toas, self.model)
@@ -136,7 +197,25 @@ class Pulsar:
             raise ValueError("model has no binary component")
         if kind == "year":
             return 2000.0 + (np.asarray(toas.mjd_float) - 51544.5) / 365.25
+        if kind == "day of year":
+            # true calendar day-of-year (host-side; GUI axis only)
+            import datetime
+
+            mjd = np.asarray(toas.mjd_float)
+            base = datetime.date(1858, 11, 17).toordinal()  # MJD 0
+            doy = np.array([
+                float(datetime.date.fromordinal(base + d).timetuple().tm_yday)
+                for d in np.floor(mjd).astype(int)
+            ])
+            return doy + (mjd - np.floor(mjd))
+        if kind == "frequency":
+            return np.asarray(toas.freq_mhz)
+        if kind == "TOA error":
+            return np.asarray(toas.error_us)
         raise ValueError(f"unknown x-axis {kind!r}")
+
+    XAXIS_CHOICES = ("mjd", "year", "day of year", "serial",
+                     "orbital phase", "frequency", "TOA error")
 
     def random_models(self, n=16):
         """Residual spread envelope from the post-fit covariance

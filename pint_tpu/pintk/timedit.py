@@ -1,0 +1,130 @@
+"""Tim-file editor pane (reference: src/pint/pintk/timedit.py).
+
+Same split as paredit: headless :class:`TimEditor` core + thin Tk
+:class:`TimWidget`.  Apply re-reads the edited tim text into a fresh
+TOAs set (same ephemeris settings as the Pulsar's current TOAs) and
+swaps it in, resetting deletions and fit state.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+import numpy as np
+
+
+class TimEditor:
+    """Headless tim-text editing core."""
+
+    def __init__(self, pulsar):
+        self.psr = pulsar
+        self.text = ""
+        self.reset()
+
+    def reset(self):
+        """Seed the buffer from the Pulsar's tim file on disk (the
+        reference seeds from the file, not the in-memory TOAs, so
+        comments and commands survive)."""
+        with open(self.psr.timfile, "r") as f:
+            self.text = f.read()
+
+    def apply(self):
+        """Re-read the buffer into TOAs and swap into the Pulsar."""
+        from pint_tpu.toa import get_TOAs
+
+        old = self.psr.all_toas
+        with tempfile.NamedTemporaryFile(
+            "w", suffix=".tim", delete=False,
+            dir=os.path.dirname(os.path.abspath(self.psr.timfile)) or None,
+        ) as f:
+            f.write(self.text)
+            tmp = f.name
+        try:
+            toas = get_TOAs(tmp, ephem=old.ephem, planets=old.planets,
+                            include_clock=old.include_clock,
+                            include_bipm=old.include_bipm,
+                            bipm_version=old.bipm_version,
+                            use_cache=False)
+        finally:
+            os.unlink(tmp)
+        self.psr.all_toas = toas
+        self.psr.deleted = np.zeros(len(toas), dtype=bool)
+        # undo entries index the old TOA set; they cannot survive a swap
+        self.psr._undo_stack.clear()
+        self.psr.fitted = False
+        return toas
+
+    def load(self, path):
+        with open(path, "r") as f:
+            self.text = f.read()
+
+    def write(self, path):
+        with open(path, "w") as f:
+            f.write(self.text)
+
+
+class TimWidget:
+    """Tk shell: Text pane + Apply/Reset/Open/Write buttons."""
+
+    def __init__(self, parent, pulsar, on_apply=None):
+        import tkinter as tk
+        from tkinter import filedialog
+
+        self.editor = TimEditor(pulsar)
+        self.on_apply = on_apply
+        self._filedialog = filedialog
+
+        frame = tk.Frame(parent)
+        frame.pack(fill="both", expand=True)
+        self.textbox = tk.Text(frame, width=80)
+        self.textbox.pack(fill="both", expand=True)
+        self.textbox.insert("1.0", self.editor.text)
+        ctrl = tk.Frame(frame)
+        ctrl.pack(fill="x")
+        for label, cmd in [
+            ("Apply", self.do_apply), ("Reset", self.do_reset),
+            ("Open tim...", self.do_open), ("Write tim...", self.do_write),
+        ]:
+            tk.Button(ctrl, text=label, command=cmd).pack(side="left")
+        self.status = tk.Label(frame, anchor="w")
+        self.status.pack(fill="x")
+
+    def _sync_from_box(self):
+        self.editor.text = self.textbox.get("1.0", "end-1c")
+
+    def _sync_to_box(self):
+        self.textbox.delete("1.0", "end")
+        self.textbox.insert("1.0", self.editor.text)
+
+    def do_apply(self):
+        self._sync_from_box()
+        try:
+            self.editor.apply()
+        except Exception as e:
+            self.status.config(text=f"tim error: {e}")
+            return
+        self.status.config(text=f"applied ({len(self.psr_toas())} TOAs)")
+        if self.on_apply:
+            self.on_apply()
+
+    def psr_toas(self):
+        return self.editor.psr.all_toas
+
+    def do_reset(self):
+        self.editor.reset()
+        self._sync_to_box()
+
+    def do_open(self):
+        path = self._filedialog.askopenfilename(
+            filetypes=[("tim files", "*.tim"), ("all", "*")])
+        if path:
+            self.editor.load(path)
+            self._sync_to_box()
+
+    def do_write(self):
+        self._sync_from_box()
+        path = self._filedialog.asksaveasfilename(defaultextension=".tim")
+        if path:
+            self.editor.write(path)
+            self.status.config(text=f"wrote {path}")

@@ -340,6 +340,11 @@ def _read_tim_into(path, toas, state, depth):
 
 
 class TOAs:
+    # class-level defaults for objects revived via object.__new__ paths
+    # (slicing/merge/cache); __init__ and get_TOAs set the real values
+    include_clock = True
+    include_bipm = False
+    bipm_version = "BIPM2019"
     """Host-side TOA table (struct of numpy arrays + python flag dicts)."""
 
     def __init__(self, toa_list, ephem="builtin", planets=False,
@@ -349,6 +354,11 @@ class TOAs:
             raise ValueError("no TOAs")
         self.ephem = ephem
         self.planets = planets
+        # retained so re-reads (e.g. pintk's tim editor) can reproduce
+        # the same clock/BIPM preparation
+        self.include_clock = include_clock
+        self.include_bipm = include_bipm
+        self.bipm_version = bipm_version
         n = len(toa_list)
         self.flags = [dict(t.flags) for t in toa_list]
         self.names = [t.name for t in toa_list]
@@ -547,6 +557,9 @@ class TOAs:
         new = object.__new__(TOAs)
         new.ephem = self.ephem
         new.planets = self.planets
+        new.include_clock = self.include_clock
+        new.include_bipm = self.include_bipm
+        new.bipm_version = self.bipm_version
         new.flags = [dict(self.flags[i]) for i in idx]
         new.names = [self.names[i] for i in idx]
         for arr in ("error_us", "freq_mhz", "mjd_float", "clock_sec",
@@ -576,6 +589,9 @@ class TOAs:
         new = object.__new__(cls)
         new.ephem = first.ephem
         new.planets = first.planets
+        new.include_clock = first.include_clock
+        new.include_bipm = first.include_bipm
+        new.bipm_version = first.bipm_version
         new.flags = [dict(f) for t in toas_list for f in t.flags]
         new.names = [x for t in toas_list for x in t.names]
         for arr in ("error_us", "freq_mhz", "mjd_float", "clock_sec",
@@ -747,6 +763,11 @@ def get_TOAs(timfile, ephem="builtin", planets=False, include_clock=True,
         cached = load_cache(cache_path, src_hash=src_hash, ephem=ephem,
                             planets=planets)
         if cached is not None:
+            # the src_hash covered these settings; re-attach them so
+            # re-reads (pintk tim editor) reproduce the preparation
+            cached.include_clock = include_clock
+            cached.include_bipm = include_bipm
+            cached.bipm_version = bipm_version
             return cached
     toas = TOAs(
         read_tim(timfile), ephem=ephem, planets=planets,

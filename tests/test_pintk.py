@@ -66,6 +66,147 @@ class TestPulsarWrapper:
         psr.write_par(str(p))
         assert "F0" in p.read_text()
 
+    def test_undo_delete(self, psr):
+        n = len(psr.selected_toas)
+        psr.delete_toas([5, 6])
+        assert len(psr.selected_toas) == n - 2
+        assert psr.undo() == "deleted"
+        assert len(psr.selected_toas) == n
+
+    def test_phase_wrap_shifts_residual(self, psr):
+        """+1 turn on a TOA moves its pulse-number-tracked phase
+        residual by one turn and is undoable (reference pintk
+        pulsar.py add_phase_wrap; like the reference, integer wraps are
+        invisible in 'nearest' mode — the int part is discarded — so
+        the test tracks pulse numbers)."""
+        from pint_tpu.residuals import Residuals
+
+        psr.reset_model()
+        psr.all_toas.compute_pulse_numbers(psr.model)
+        kw = dict(subtract_mean=False, track_mode="use_pulse_numbers")
+        p0 = np.asarray(Residuals(psr.selected_toas, psr.model,
+                                  **kw).phase_resids).copy()
+        psr.add_phase_wrap([0], +1)
+        p1 = np.asarray(Residuals(psr.selected_toas, psr.model,
+                                  **kw).phase_resids)
+        np.testing.assert_allclose(p1[0] - p0[0], 1.0, atol=1e-9)
+        np.testing.assert_allclose(p1[1:], p0[1:], atol=1e-12)
+        assert psr.undo() == "padd"
+        p2 = np.asarray(Residuals(psr.selected_toas, psr.model,
+                                  **kw).phase_resids)
+        np.testing.assert_allclose(p2, p0, atol=1e-12)
+        for f in psr.all_toas.flags:
+            f.pop("pn", None)
+
+    def test_fit_methods(self, psr):
+        psr.reset_model()
+        f = psr.fit(method="wls")
+        assert type(f).__name__ == "WLSFitter"
+        f = psr.fit(method="downhill wls")
+        assert type(f).__name__ == "DownhillWLSFitter"
+        with pytest.raises(ValueError):
+            psr.fit(method="bogus")
+
+    def test_day_of_year_axis(self, psr):
+        doy = psr.xaxis("day of year")
+        assert np.all((doy >= 1.0) & (doy < 367.0))
+        # spot check: MJD 53478 = 2005-04-18 = day 108
+        i = int(np.argmin(np.abs(np.asarray(
+            psr.selected_toas.mjd_float) - 53478.2858714192189)))
+        assert abs(doy[i] - (108 + 0.2858714192189)) < 1e-6
+
+
+class TestColorModes:
+    def test_default_and_freq(self, psr):
+        from pint_tpu.pintk.colormodes import get_color_mode
+
+        n = len(psr.selected_toas)
+        colors, legend = get_color_mode("default").colors(psr)
+        assert len(colors) == n and len(set(colors)) == 1
+        colors, legend = get_color_mode("freq").colors(psr)
+        assert len(colors) == n
+        # NGC6440E is single-band (1.4-2 GHz): one legend entry
+        assert len(legend) >= 1
+
+    def test_obs_mode(self, psr):
+        from pint_tpu.pintk.colormodes import get_color_mode
+
+        colors, legend = get_color_mode("obs").colors(psr)
+        assert set(legend) == set(psr.selected_toas.obs_names)
+
+    def test_jump_mode_colors_jumped_toas(self, psr):
+        from pint_tpu.pintk.colormodes import get_color_mode
+
+        # psr has a JUMP from test_jump_and_random (module-scoped)
+        colors, legend = get_color_mode("jump").colors(psr)
+        assert "no jump" in legend
+        if any(lab.startswith("JUMP") for lab in legend):
+            jcolor = next(c for lab, c in legend.items()
+                          if lab.startswith("JUMP"))
+            assert jcolor in colors
+
+    def test_unknown_mode(self, psr):
+        from pint_tpu.pintk.colormodes import get_color_mode
+
+        with pytest.raises(ValueError):
+            get_color_mode("nope")
+
+
+class TestEditors:
+    def test_par_editor_roundtrip(self, tmp_path):
+        from pint_tpu.pintk.pulsar import Pulsar
+        from pint_tpu.pintk.paredit import ParEditor
+
+        psr = Pulsar(os.path.join(REFDATA, "NGC6440E.par"),
+                     os.path.join(REFDATA, "NGC6440E.tim"))
+        ed = ParEditor(psr)
+        assert "F0" in ed.text
+        # edit F0 in the buffer and apply: the model must pick it up
+        old_f0 = float(psr.model.values["F0"])
+        lines = []
+        for line in ed.text.splitlines():
+            if line.split() and line.split()[0] == "F0":
+                toks = line.split()
+                toks[1] = repr(old_f0 + 1e-7)
+                line = "  ".join(toks)
+            lines.append(line)
+        ed.text = "\n".join(lines)
+        ed.apply()
+        assert abs(float(psr.model.values["F0"]) - (old_f0 + 1e-7)) < 1e-12
+        # bad text raises and leaves the model as-is
+        ed.text = "F0 not_a_number\n"
+        with pytest.raises(Exception):
+            ed.apply()
+        assert abs(float(psr.model.values["F0"]) - (old_f0 + 1e-7)) < 1e-12
+
+    def test_tim_editor_apply(self):
+        from pint_tpu.pintk.pulsar import Pulsar
+        from pint_tpu.pintk.timedit import TimEditor
+
+        psr = Pulsar(os.path.join(REFDATA, "NGC6440E.par"),
+                     os.path.join(REFDATA, "NGC6440E.tim"))
+        n0 = len(psr.all_toas)
+        ed = TimEditor(psr)
+        # drop the last TOA line from the buffer
+        lines = [ln for ln in ed.text.splitlines()]
+        # find the last data-looking line (tempo1 MODE-1 TOA rows have
+        # >=4 tokens and start with a numeric site code)
+        for i in range(len(lines) - 1, -1, -1):
+            toks = lines[i].split()
+            if len(toks) >= 4 and toks[0].isdigit():
+                del lines[i]
+                break
+        # stale undo entries must not survive the TOA-set swap
+        psr.delete_toas([0])
+        ed.text = "\n".join(lines) + "\n"
+        ed.apply()
+        assert len(psr.all_toas) == n0 - 1
+        assert len(psr.deleted) == n0 - 1
+        assert psr.undo() is None
+        # the re-read preserves the clock/BIPM preparation settings
+        assert psr.all_toas.include_clock == True  # noqa: E712
+        assert psr.all_toas.bipm_version == "BIPM2019"
+
 
 class TestGuiGuard:
     def test_headless_exit(self, monkeypatch):
