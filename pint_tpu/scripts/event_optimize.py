@@ -18,6 +18,10 @@ def main(argv=None):
     p.add_argument("--weightcol", default=None,
                    help="photon-weight column (default WEIGHT for "
                    "fermi, none otherwise)")
+    p.add_argument("--template", default=None,
+                   help="template file (# gauss / # fourier / # kernel "
+                   "header, reference prim_io formats); default: fit a "
+                   "--ngauss gaussian seed template to the folded phases")
     p.add_argument("--ngauss", type=int, default=2,
                    help="gaussian components for the seed template")
     p.add_argument("--nwalkers", type=int, default=32)
@@ -30,7 +34,12 @@ def main(argv=None):
     from pint_tpu.event_toas import load_event_TOAs
     from pint_tpu.mcmc_fitter import MCMCFitter
     from pint_tpu.models import get_model
-    from pint_tpu.templates import LCFitter, LCGaussian, LCTemplate
+    from pint_tpu.templates import (
+        LCFitter,
+        LCGaussian,
+        LCTemplate,
+        read_template,
+    )
 
     model = get_model(args.parfile)
     weightcol = args.weightcol or (
@@ -40,15 +49,18 @@ def main(argv=None):
                            weights=weightcol,
                            ephem=model.meta.get("EPHEM", "builtin"))
     print(f"Read {len(toas)} events")
-    prepared = model.prepare(toas)
-    _, frac = prepared.phase()
-    phases = np.asarray(frac) % 1.0
-    # seed template from the folded profile at the initial parameters
-    template = LCTemplate(
-        [LCGaussian(sigma=0.05, loc=(i + 0.5) / args.ngauss)
-         for i in range(args.ngauss)]
-    )
-    LCFitter(template, phases).fit()
+    if args.template:
+        template = read_template(args.template)
+    else:
+        # seed template from the folded profile at the initial parameters
+        prepared = model.prepare(toas)
+        _, frac = prepared.phase()
+        phases = np.asarray(frac) % 1.0
+        template = LCTemplate(
+            [LCGaussian(sigma=0.05, loc=(i + 0.5) / args.ngauss)
+             for i in range(args.ngauss)]
+        )
+        LCFitter(template, phases).fit()
     fitter = MCMCFitter(toas, model, template,
                         fit_template=args.fit_template)
     lnp = fitter.fit_toas(nwalkers=args.nwalkers, nsteps=args.nsteps,

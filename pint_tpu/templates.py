@@ -29,9 +29,18 @@ import numpy as np
 __all__ = [
     "LCGaussian", "LCLorentzian", "LCVonMises", "LCTopHat",
     "LCHarmonic", "LCGaussian2", "LCLorentzian2",
+    "LCEmpiricalFourier", "LCKernelDensity",
     "LCTemplate", "LCFitter", "NormAngles",
     "LCEGaussian", "LCETemplate", "LCEFitter",
+    "read_template", "write_template", "prof_string",
+    "read_gaussfitfile", "convert_primitive",
 ]
+
+#: FWHM = _FWHM_SIGMA * sigma for a Gaussian
+_FWHM_SIGMA = 2.3548200450309493
+
+#: numpy 2 renamed trapz; support both (jax floor allows numpy 1.x)
+_trapezoid = getattr(np, "trapezoid", None) or np.trapz
 
 #: wraps to include in the wrapped-gaussian sum: exp(-(1/2)(k/sigma)^2)
 #: is < 1e-12 for |k| > 2 at sigma <= 0.3, the widest sane peak
@@ -46,6 +55,7 @@ class LCGaussian:
     loc: float = 0.5
 
     n_params = 2
+    loc_index = 1
 
     def density(self, phi, p):
         sigma, loc = p[0], p[1]
@@ -58,6 +68,9 @@ class LCGaussian:
     def init_params(self):
         return [self.sigma, self.loc]
 
+    def param_bounds(self):
+        return [(1e-3, 0.5), (None, None)]
+
 
 @dataclass
 class LCLorentzian:
@@ -69,6 +82,7 @@ class LCLorentzian:
     loc: float = 0.5
 
     n_params = 2
+    loc_index = 1
 
     def density(self, phi, p):
         g, loc = p[0], p[1]
@@ -79,6 +93,9 @@ class LCLorentzian:
 
     def init_params(self):
         return [self.gamma, self.loc]
+
+    def param_bounds(self):
+        return [(1e-3, 0.5), (None, None)]
 
 
 @dataclass
@@ -91,6 +108,7 @@ class LCVonMises:
     loc: float = 0.5
 
     n_params = 2
+    loc_index = 1
 
     def density(self, phi, p):
         from jax.scipy.special import i0e
@@ -103,6 +121,9 @@ class LCVonMises:
     def init_params(self):
         return [self.kappa, self.loc]
 
+    def param_bounds(self):
+        return [(1e-1, 1e7), (None, None)]
+
 
 @dataclass
 class LCTopHat:
@@ -113,6 +134,7 @@ class LCTopHat:
     loc: float = 0.5
 
     n_params = 2
+    loc_index = 1
 
     def density(self, phi, p):
         width, loc = p[0], p[1]
@@ -121,6 +143,9 @@ class LCTopHat:
 
     def init_params(self):
         return [self.width, self.loc]
+
+    def param_bounds(self):
+        return [(1e-3, 1.0), (None, None)]
 
 
 @dataclass
@@ -132,6 +157,7 @@ class LCHarmonic:
     loc: float = 0.0
 
     n_params = 1
+    loc_index = 0
 
     def density(self, phi, p):
         loc = p[0]
@@ -140,6 +166,9 @@ class LCHarmonic:
 
     def init_params(self):
         return [self.loc]
+
+    def param_bounds(self):
+        return [(None, None)]
 
 
 def _two_sided(core_density):
@@ -167,6 +196,7 @@ class LCGaussian2:
     loc: float = 0.5
 
     n_params = 3
+    loc_index = 2
 
     def density(self, phi, p):
         s1, s2, loc = p[0], p[1], p[2]
@@ -182,6 +212,9 @@ class LCGaussian2:
     def init_params(self):
         return [self.sigma1, self.sigma2, self.loc]
 
+    def param_bounds(self):
+        return [(1e-3, 0.5), (1e-3, 0.5), (None, None)]
+
 
 @dataclass
 class LCLorentzian2:
@@ -193,6 +226,7 @@ class LCLorentzian2:
     loc: float = 0.5
 
     n_params = 3
+    loc_index = 2
 
     def density(self, phi, p):
         g1, g2, loc = p[0], p[1], p[2]
@@ -206,6 +240,132 @@ class LCLorentzian2:
 
     def init_params(self):
         return [self.gamma1, self.gamma2, self.loc]
+
+    def param_bounds(self):
+        return [(1e-3, 0.5), (1e-3, 0.5), (None, None)]
+
+
+class LCEmpiricalFourier:
+    """Non-parametric Fourier light curve (reference lcprimitives
+    LCEmpiricalFourier, :1361): harmonic coefficients measured from a
+    photon phase sample (or read from a ``# fourier`` file); the single
+    fit parameter is an overall phase shift, applied via the shift
+    theorem.  Density = 1 + 2 sum_k (a_k cos + b_k sin), which
+    integrates to 1 over a turn by construction.
+
+    Like the reference, it stands alone: use it as the only primitive
+    of a template with norm 1 (the background is already inside the
+    empirical coefficients).
+    """
+
+    shift: float = 0.0
+    n_params = 1
+    loc_index = 0
+
+    def __init__(self, phases=None, input_file=None, nharm=20):
+        self.nharm = int(nharm)
+        self.shift = 0.0
+        self.alphas = np.zeros(self.nharm)
+        self.betas = np.zeros(self.nharm)
+        if input_file is not None:
+            self.from_file(input_file)
+        if phases is not None:
+            self.from_phases(phases)
+
+    def from_phases(self, phases):
+        phases = np.asarray(phases, np.float64) % 1.0
+        k = np.arange(1, self.nharm + 1) * 2.0 * np.pi
+        self.alphas = np.cos(k[:, None] * phases[None, :]).mean(axis=1)
+        self.betas = np.sin(k[:, None] * phases[None, :]).mean(axis=1)
+
+    def from_file(self, path):
+        rows = []
+        with open(path, "r") as f:
+            for line in f:
+                if "#" in line:
+                    continue
+                toks = line.split()
+                if len(toks) == 2:
+                    try:
+                        rows.append((float(toks[0]), float(toks[1])))
+                    except ValueError:
+                        pass
+        if not rows:
+            raise ValueError(f"no fourier coefficients in {path}")
+        self.alphas = np.array([r[0] for r in rows])
+        self.betas = np.array([r[1] for r in rows])
+        self.nharm = len(rows)
+
+    def to_file(self, path):
+        with open(path, "w") as f:
+            f.write("# fourier\n")
+            for a, b in zip(self.alphas, self.betas):
+                f.write(f"{float(a)!r}\t{float(b)!r}\n")
+
+    def density(self, phi, p):
+        shift = p[0]
+        k = jnp.arange(1, self.nharm + 1) * 2.0 * jnp.pi
+        c, s = jnp.cos(k * shift), jnp.sin(k * shift)
+        a = c * self.alphas - s * self.betas
+        b = s * self.alphas + c * self.betas
+        ph = jnp.asarray(phi)[..., None] * k
+        return 1.0 + 2.0 * jnp.sum(a * jnp.cos(ph) + b * jnp.sin(ph),
+                                   axis=-1)
+
+    def init_params(self):
+        return [self.shift]
+
+    def param_bounds(self):
+        return [(None, None)]
+
+
+class LCKernelDensity:
+    """Non-parametric kernel-density light curve (reference
+    lcprimitives LCKernelDensity, :1456): a wrapped-Gaussian KDE of a
+    photon phase sample, pre-evaluated on a phase grid and linearly
+    interpolated on device; the single fit parameter is an overall
+    shift.  Stands alone like LCEmpiricalFourier."""
+
+    n_params = 1
+    loc_index = 0
+
+    def __init__(self, phases=None, bw=None, resolution=0.001):
+        self.shift = 0.0
+        self.resolution = float(resolution)
+        self.bw = bw
+        self.grid = np.linspace(0.0, 1.0,
+                                int(round(1.0 / self.resolution)) + 1)
+        self.vals = np.ones_like(self.grid)
+        if phases is not None:
+            self.from_phases(phases)
+
+    def from_phases(self, phases):
+        phases = np.asarray(phases, np.float64) % 1.0
+        n = len(phases)
+        # Silverman-style circular bandwidth when not given
+        bw = self.bw if self.bw is not None else 1.06 * min(
+            np.std(phases), 0.2) * n ** (-0.2)
+        bw = max(float(bw), 1e-3)
+        self.bw = bw
+        # wrapped-Gaussian KDE on the grid (host-side, once)
+        d = self.grid[:, None] - phases[None, :]
+        acc = np.zeros(len(self.grid))
+        for k in (-1, 0, 1):
+            acc += np.exp(-0.5 * ((d + k) / bw) ** 2).sum(axis=1)
+        vals = acc / (n * bw * np.sqrt(2 * np.pi))
+        # enforce exact unit integral on the trapezoid grid
+        self.vals = vals / _trapezoid(vals, self.grid)
+
+    def density(self, phi, p):
+        ph = (jnp.asarray(phi) - p[0]) % 1.0
+        return jnp.interp(ph, jnp.asarray(self.grid),
+                          jnp.asarray(self.vals))
+
+    def init_params(self):
+        return [self.shift]
+
+    def param_bounds(self):
+        return [(None, None)]
 
 
 class NormAngles:
@@ -333,7 +493,7 @@ class LCFitter:
         x0 = np.array(self.template.params)
         bounds = [(1e-4, 1.0)] * k
         for p in self.template.primitives:
-            bounds += [(1e-3, 0.5), (None, None)]  # width, location
+            bounds += p.param_bounds()
 
         # soft barrier keeping sum(norms) < 1 (a negative uniform
         # background is unphysical and its log-clamp has zero gradient,
@@ -352,10 +512,9 @@ class LCFitter:
                        bounds=bounds, options={"maxiter": maxiter})
         self.template.params = np.asarray(res.x)
         # wrap peak locations into [0, 1)
-        norms, _ = self.template._split(self.template.params)
-        i = k + 1
+        i = k
         for p in self.template.primitives:
-            self.template.params[i] %= 1.0
+            self.template.params[i + p.loc_index] %= 1.0
             i += p.n_params
         return self.template.params, -float(res.fun)
 
@@ -441,6 +600,137 @@ class LCETemplate:
             out = out + n * p.density(jnp.asarray(phi), q,
                                       jnp.asarray(log10_en))
         return out
+
+
+# --- template file IO (reference: lctemplate.py:1009 prim_io,
+# :609 prof_string; scripts/event_optimize.py:33 read_gaussfitfile) ----------
+
+def prof_string(template: LCTemplate) -> str:
+    """pygaussfit-compatible text for a gaussian-mixture template
+    (reference lctemplate prof_string: phas/fwhm/ampl rows + const)."""
+    k = len(template.primitives)
+    norms, prim_params = template._split(np.asarray(template.params))
+    lines = []
+    total = 0.0
+    for i, (prim, pp) in enumerate(zip(template.primitives, prim_params),
+                                   start=1):
+        if isinstance(prim, LCGaussian):
+            width, loc = _FWHM_SIGMA * pp[0], pp[1]
+        elif isinstance(prim, LCLorentzian):
+            width, loc = 2.0 * pp[0], pp[1]
+        elif isinstance(prim, LCVonMises):
+            # FWHM of exp(k(cos a - 1)): cos a = 1 + ln(1/2)/k
+            width, loc = (np.arccos(max(1.0 - np.log(2.0) / pp[0], -1.0))
+                          / np.pi, pp[1])
+        else:
+            raise ValueError(
+                f"prof_string supports gaussian-like primitives, not "
+                f"{type(prim).__name__}")
+        ampl = float(norms[i - 1])
+        total += ampl
+        lines += [f"phas{i} = {loc % 1.0:.5f} +/- 0.00000",
+                  f"fwhm{i} = {width:.5f} +/- 0.00000",
+                  f"ampl{i} = {ampl:.5f} +/- 0.00000"]
+    dashes = "-" * 25
+    return "\n".join([dashes, f"const = {1.0 - total:.5f} +/- 0.00000"]
+                     + lines + [dashes])
+
+
+def write_template(template: LCTemplate, path):
+    """Write a ``# gauss`` template file readable by read_template
+    (reference lcfitters write_template)."""
+    with open(path, "w") as f:
+        f.write("# gauss\n")
+        f.write(prof_string(template) + "\n")
+
+
+def read_template(path) -> LCTemplate:
+    """Read a template file into an LCTemplate (reference prim_io):
+    header line says ``gauss`` (phas/fwhm/ampl rows), ``fourier``
+    (alpha beta rows), or ``kernel`` (raw photon phases, one per
+    line)."""
+    with open(path, "r") as f:
+        lines = [ln.strip() for ln in f if ln.strip()]
+    if not lines:
+        raise ValueError(f"empty template file {path}")
+    label, body = lines[0].lower(), lines[1:]
+    toks = [ln.split() for ln in body]
+    if "gauss" in label:
+        # two-pass: collect all rows by peak index first, so row order
+        # (phas/fwhm/ampl interleaved or grouped) cannot matter
+        locs, fwhms, ampls = {}, {}, {}
+        for tok in toks:
+            if not tok or "=" not in tok:
+                continue
+            key, val = tok[0].lower(), float(tok[2])
+            if key.startswith("phas"):
+                locs[int(key[4:] or 1)] = val
+            elif key.startswith("fwhm"):
+                fwhms[int(key[4:] or 1)] = val
+            elif key.startswith("ampl"):
+                ampls[int(key[4:] or 1)] = val
+        if not fwhms or sorted(fwhms) != sorted(locs) \
+                or sorted(fwhms) != sorted(ampls):
+            raise ValueError(
+                f"unbalanced gauss template in {path}: peaks "
+                f"{sorted(locs)} / widths {sorted(fwhms)} / "
+                f"amplitudes {sorted(ampls)}")
+        idx = sorted(fwhms)
+        prims = [LCGaussian(sigma=fwhms[i] / _FWHM_SIGMA, loc=locs[i])
+                 for i in idx]
+        return LCTemplate(prims, norms=[ampls[i] for i in idx])
+    if "fourier" in label:
+        rows = []
+        for t in toks:
+            if len(t) == 2:
+                try:
+                    rows.append((float(t[0]), float(t[1])))
+                except ValueError:
+                    pass
+        if not rows:
+            raise ValueError(f"no fourier coefficients in {path}")
+        prim = LCEmpiricalFourier(nharm=len(rows))
+        prim.alphas = np.array([r[0] for r in rows])
+        prim.betas = np.array([r[1] for r in rows])
+        return LCTemplate([prim], norms=[1.0])
+    if "kernel" in label:
+        phases = [float(t[0]) for t in toks if t]
+        prim = LCKernelDensity(phases=phases)
+        return LCTemplate([prim], norms=[1.0])
+    raise ValueError(f"unrecognized template format header {label!r}")
+
+
+def read_gaussfitfile(path, proflen):
+    """Binned profile (length ``proflen``, unit mean) from a
+    pygaussfit.py output file (reference
+    scripts/event_optimize.py:33) — the binned-template path of
+    MCMCFitter consumes exactly this array."""
+    tmpl = read_template(path)
+    grid = (np.arange(proflen) + 0.5) / proflen
+    return np.asarray(tmpl.density(grid))
+
+
+def convert_primitive(prim, ptype=LCLorentzian):
+    """Convert one peak to another kind, preserving location and FWHM
+    (reference lcprimitives convert_primitive:1607)."""
+    if isinstance(prim, LCGaussian):
+        fwhm, loc = _FWHM_SIGMA * prim.sigma, prim.loc
+    elif isinstance(prim, LCLorentzian):
+        fwhm, loc = 2.0 * prim.gamma, prim.loc
+    elif isinstance(prim, LCVonMises):
+        fwhm = np.arccos(max(1.0 - np.log(2.0) / prim.kappa, -1.0)) / np.pi
+        loc = prim.loc
+    else:
+        raise ValueError(f"cannot convert {type(prim).__name__}")
+    if ptype is LCGaussian:
+        return LCGaussian(sigma=fwhm / _FWHM_SIGMA, loc=loc)
+    if ptype is LCLorentzian:
+        return LCLorentzian(gamma=fwhm / 2.0, loc=loc)
+    if ptype is LCVonMises:
+        half = np.cos(np.pi * fwhm)
+        return LCVonMises(kappa=np.log(2.0) / max(1.0 - half, 1e-12),
+                          loc=loc)
+    raise ValueError(f"cannot convert to {ptype}")
 
 
 class LCEFitter:

@@ -165,6 +165,131 @@ class TestTemplateZoo:
         assert abs(params[2] - (-0.01)) < 0.01
 
 
+class TestTemplateIO:
+    def _sample_phases(self, rng, n=4000):
+        """Photons from a 2-gaussian profile + background."""
+        comp = rng.random(n)
+        ph = np.where(
+            comp < 0.4, rng.normal(0.3, 0.02, n),
+            np.where(comp < 0.7, rng.normal(0.7, 0.05, n), rng.random(n)),
+        )
+        return ph % 1.0
+
+    def test_gauss_roundtrip(self, tmp_path):
+        from pint_tpu.templates import (
+            LCGaussian, LCTemplate, read_template, write_template)
+
+        t = LCTemplate([LCGaussian(sigma=0.02, loc=0.3),
+                        LCGaussian(sigma=0.05, loc=0.7)],
+                       norms=[0.4, 0.3])
+        p = tmp_path / "t.gauss"
+        write_template(t, str(p))
+        t2 = read_template(str(p))
+        grid = np.linspace(0, 1, 101)
+        np.testing.assert_allclose(np.asarray(t2.density(grid)),
+                                   np.asarray(t.density(grid)), atol=2e-3)
+
+    def test_fourier_file_and_density(self, tmp_path):
+        from pint_tpu.templates import (
+            LCEmpiricalFourier, LCTemplate, read_template)
+
+        rng = np.random.default_rng(1)
+        ph = self._sample_phases(rng)
+        prim = LCEmpiricalFourier(phases=ph, nharm=12)
+        p = tmp_path / "t.fourier"
+        prim.to_file(str(p))
+        t = read_template(str(p))
+        grid = np.linspace(0, 1, 201)
+        d = np.asarray(t.density(grid))
+        # integrates to ~1 and peaks near the true peaks
+        np.testing.assert_allclose(np.trapezoid(d, grid), 1.0, atol=1e-6)
+        assert abs(grid[np.argmax(d)] - 0.3) < 0.05
+        # shift parameter moves the profile
+        d2 = np.asarray(t.density(grid, params=np.array([1.0, 0.1])))
+        assert abs(grid[np.argmax(d2)] % 1.0 - 0.4) < 0.05
+
+    def test_kernel_density(self, tmp_path):
+        from pint_tpu.templates import read_template
+
+        rng = np.random.default_rng(2)
+        ph = self._sample_phases(rng)
+        p = tmp_path / "t.kernel"
+        p.write_text("# kernel\n" + "\n".join(repr(float(x)) for x in ph)
+                     + "\n")
+        t = read_template(str(p))
+        grid = np.linspace(0, 1, 201)
+        d = np.asarray(t.density(grid))
+        np.testing.assert_allclose(np.trapezoid(d, grid), 1.0, atol=0.02)
+        assert abs(grid[np.argmax(d)] - 0.3) < 0.05
+
+    def test_read_gaussfitfile_binned(self, tmp_path):
+        from pint_tpu.templates import (
+            LCGaussian, LCTemplate, read_gaussfitfile, write_template)
+
+        t = LCTemplate([LCGaussian(sigma=0.03, loc=0.5)], norms=[0.6])
+        p = tmp_path / "t.gauss"
+        write_template(t, str(p))
+        prof = read_gaussfitfile(str(p), 64)
+        assert prof.shape == (64,)
+        # bin centers at (i+0.5)/64: the 0.5 peak straddles bins 31/32
+        assert np.argmax(prof) in (31, 32)
+        np.testing.assert_allclose(prof.mean(), 1.0, rtol=1e-3)
+
+    def test_fit_nonparametric_shift(self, tmp_path):
+        """LCFitter can fit the single shift parameter of an empirical-
+        Fourier template (regression: per-primitive bounds)."""
+        from pint_tpu.templates import (
+            LCEmpiricalFourier, LCFitter, LCTemplate)
+
+        rng = np.random.default_rng(3)
+        ph = self._sample_phases(rng)
+        prim = LCEmpiricalFourier(phases=(ph + 0.07) % 1.0, nharm=10)
+        t = LCTemplate([prim], norms=[1.0])
+        f = LCFitter(t, ph)
+        params, lnl = f.fit()
+        # density(phi, shift) = base(phi - shift), so undoing a
+        # template trained 0.07 ahead needs shift = -0.07 (mod 1)
+        assert abs((params[1] + 0.07 + 0.5) % 1.0 - 0.5) < 0.02
+
+    def test_fit_two_sided(self):
+        """3-param primitives get correctly sized bounds (regression)."""
+        from pint_tpu.templates import LCFitter, LCGaussian2, LCTemplate
+
+        rng = np.random.default_rng(4)
+        n = 3000
+        raw = rng.normal(0.0, 1.0, n)
+        ph = (0.4 + np.where(raw < 0, raw * 0.02, raw * 0.06)) % 1.0
+        t = LCTemplate([LCGaussian2(sigma1=0.03, sigma2=0.03, loc=0.45)],
+                       norms=[0.9])
+        params, lnl = LCFitter(t, ph).fit()
+        assert abs(params[3] - 0.4) < 0.02  # loc
+        assert params[1] < params[2]  # sigma1 < sigma2 recovered
+
+    def test_convert_primitive(self):
+        from pint_tpu.templates import (
+            LCGaussian, LCLorentzian, LCVonMises, convert_primitive)
+
+        g = LCGaussian(sigma=0.02, loc=0.4)
+        l = convert_primitive(g, LCLorentzian)
+        assert abs(l.loc - 0.4) < 1e-12
+        assert abs(2.0 * l.gamma - 2.3548200450309493 * g.sigma) < 1e-12
+        v = convert_primitive(g, LCVonMises)
+        g2 = convert_primitive(v, LCGaussian)
+        np.testing.assert_allclose(g2.sigma, g.sigma, rtol=1e-3)
+
+    def test_bad_files(self, tmp_path):
+        from pint_tpu.templates import read_template
+
+        p = tmp_path / "bad.txt"
+        p.write_text("# mystery\n1 2 3\n")
+        with pytest.raises(ValueError):
+            read_template(str(p))
+        p2 = tmp_path / "empty.gauss"
+        p2.write_text("")
+        with pytest.raises(ValueError):
+            read_template(str(p2))
+
+
 class TestCompositeMCMC:
     def test_two_datasets_beat_one(self, tmp_path):
         """The joint fitter recovers F0 from two small photon datasets."""
