@@ -90,6 +90,11 @@ def fourier_basis(t_s, nmodes: int, tspan_s=None) -> Tuple[np.ndarray, np.ndarra
     (reference: create_fourier_design_matrix, noise_model.py:861)."""
     t_s = np.asarray(t_s, dtype=np.float64)
     T = tspan_s if tspan_s is not None else t_s.max() - t_s.min()
+    # degenerate span (single-epoch TOAs, or superset-padded inert
+    # noise blocks): any finite span gives a finite basis, and the
+    # inert/deeply-suppressed weights zero out the contribution
+    if not np.isfinite(T) or T <= 0.0:
+        T = 86400.0
     freqs = rednoise_freqs(T, nmodes)
     F = np.zeros((len(t_s), 2 * nmodes))
     F[:, ::2] = np.sin(2 * np.pi * t_s[:, None] * freqs[::2])

@@ -1,0 +1,26 @@
+"""Model frame-conversion helpers (reference: src/pint/modelutils.py
+``model_ecliptic_to_equatorial:60`` / ``model_equatorial_to_ecliptic:8``)
+— thin, logged wrappers over ``TimingModel.as_ICRS``/``as_ECL``
+(pint_tpu/models/timing_model.py), kept for reference API parity."""
+
+from __future__ import annotations
+
+from pint_tpu.logging import log
+
+
+def model_ecliptic_to_equatorial(model, force=False):
+    """Return an ICRS (equatorial) version of an ecliptic-frame model;
+    pass through (with a log message) when already equatorial."""
+    if model.has_component("AstrometryEquatorial") and not force:
+        log.info("model is already equatorial; returning unchanged")
+        return model
+    return model.as_ICRS()
+
+
+def model_equatorial_to_ecliptic(model, ecl="IERS2010", force=False):
+    """Return an ecliptic-frame version of an equatorial model; pass
+    through (with a log message) when already ecliptic."""
+    if model.has_component("AstrometryEcliptic") and not force:
+        log.info("model is already ecliptic; returning unchanged")
+        return model
+    return model.as_ECL(ecl=ecl)

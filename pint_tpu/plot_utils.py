@@ -1,0 +1,124 @@
+"""Plotting helpers (reference: src/pint/plot_utils.py — phaseogram:11,
+phaseogram_binned:98, plot_priors:225).
+
+Matplotlib figures built from plain arrays; all functions accept
+``axes=None``/``plotfile=None`` so they are usable headlessly (Agg) and
+from the photon scripts (photonphase/fermiphase ``--plot``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["phaseogram", "phaseogram_binned", "plot_priors"]
+
+
+def _doubled(phases):
+    """Phases twice over [0, 2) — the standard two-cycle display."""
+    p = np.asarray(phases, np.float64) % 1.0
+    return np.concatenate([p, p + 1.0])
+
+
+def phaseogram(mjds, phases, weights=None, title=None, bins=100,
+               rotate=0.0, size=5, alpha=0.25, width=6, maxphs=2.0,
+               plotfile=None, axes=None):
+    """Scatter phaseogram: photon phase (x, two cycles) vs time (y),
+    with the summed profile histogram on top (reference phaseogram)."""
+    import matplotlib.pyplot as plt
+
+    mjds = np.asarray(mjds, np.float64)
+    ph = (_doubled(np.asarray(phases) + rotate))
+    yy = np.concatenate([mjds, mjds])
+    ww = None if weights is None else np.concatenate(
+        [np.asarray(weights)] * 2)
+
+    if axes is None:
+        fig, (ax1, ax2) = plt.subplots(
+            2, 1, sharex=True, figsize=(width, 8),
+            gridspec_kw={"height_ratios": [1, 3]})
+    else:
+        ax1, ax2 = axes
+        fig = ax1.figure
+    ax1.hist(ph, bins=2 * bins, range=(0, maxphs), weights=ww,
+             histtype="step", color="k")
+    ax1.set_ylabel("counts" if weights is None else "weighted counts")
+    if title:
+        ax1.set_title(title)
+    ax2.scatter(ph, yy, s=size, c="k" if ww is None else ww,
+                alpha=alpha, marker=".")
+    ax2.set_xlim(0, maxphs)
+    ax2.set_xlabel("pulse phase")
+    ax2.set_ylabel("MJD")
+    if plotfile is not None:
+        fig.savefig(plotfile)
+        plt.close(fig)
+    return fig
+
+
+def phaseogram_binned(mjds, phases, weights=None, title=None, bins=64,
+                      rotate=0.0, ntime=32, plotfile=None, axes=None):
+    """2-D binned phaseogram (time rows x phase columns) plus summed
+    profile (reference phaseogram_binned)."""
+    import matplotlib.pyplot as plt
+
+    mjds = np.asarray(mjds, np.float64)
+    ph = (np.asarray(phases, np.float64) + rotate) % 1.0
+    w = None if weights is None else np.asarray(weights, np.float64)
+    ph2, t2 = _doubled(ph), np.concatenate([mjds, mjds])
+    w2 = None if w is None else np.concatenate([w, w])
+    H, xe, ye = np.histogram2d(
+        t2, ph2, bins=[ntime, 2 * bins],
+        range=[[mjds.min(), mjds.max()], [0, 2]], weights=w2)
+
+    if axes is None:
+        fig, (ax1, ax2) = plt.subplots(
+            2, 1, sharex=True, figsize=(6, 8),
+            gridspec_kw={"height_ratios": [1, 3]})
+    else:
+        ax1, ax2 = axes
+        fig = ax1.figure
+    prof = H.sum(axis=0)
+    centers = 0.5 * (ye[:-1] + ye[1:])
+    ax1.step(centers, prof, where="mid", color="k")
+    ax1.set_ylabel("counts" if weights is None else "weighted counts")
+    if title:
+        ax1.set_title(title)
+    ax2.imshow(H, origin="lower", aspect="auto",
+               extent=[0, 2, mjds.min(), mjds.max()], cmap="Greys")
+    ax2.set_xlabel("pulse phase")
+    ax2.set_ylabel("MJD")
+    if plotfile is not None:
+        fig.savefig(plotfile)
+        plt.close(fig)
+    return fig
+
+
+def plot_priors(model, chains, burnin=0, bins=100, scale=False,
+                plotfile=None):
+    """Posterior histograms per fitted parameter with the prior pdf
+    overplotted where a parameter carries one (reference plot_priors;
+    priors live on Param.prior, pint_tpu/models/parameter.py)."""
+    import matplotlib.pyplot as plt
+
+    names = list(chains.keys())
+    fig, axs = plt.subplots(len(names), figsize=(8, 2.5 * len(names)),
+                            squeeze=False)
+    for ax, name in zip(axs[:, 0], names):
+        samples = np.asarray(chains[name])[burnin:]
+        counts, edges, _ = ax.hist(samples, bins=bins, density=True,
+                                   histtype="step", color="k",
+                                   label="posterior")
+        par = model.params.get(name)
+        prior = getattr(par, "prior", None) if par is not None else None
+        if prior is not None and hasattr(prior, "lnpdf"):
+            x = np.linspace(edges[0], edges[-1], 400)
+            pdf = np.exp([float(prior.lnpdf(v)) for v in x])
+            if scale:
+                pdf *= counts.max() / max(pdf.max(), 1e-300)
+            ax.plot(x, pdf, color="C0", label="prior")
+        ax.set_ylabel(name)
+        ax.legend(loc="best", fontsize=8)
+    if plotfile is not None:
+        fig.savefig(plotfile)
+        plt.close(fig)
+    return fig

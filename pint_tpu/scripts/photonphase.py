@@ -24,6 +24,10 @@ def main(argv=None):
                    help="max harmonics for the H-test")
     p.add_argument("--outphases", default=None,
                    help="write phases to this .npy")
+    p.add_argument("--plotfile", default=None,
+                   help="write a phaseogram to this image file")
+    p.add_argument("--binned", action="store_true",
+                   help="binned (2-D histogram) phaseogram style")
     p.add_argument("--polycos", action="store_true",
                    help="use generated polycos instead of exact phases")
     args = p.parse_args(argv)
@@ -69,6 +73,17 @@ def main(argv=None):
     if args.outphases:
         np.save(args.outphases, phases)
         print(f"wrote {args.outphases}")
+    if args.plotfile:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        from pint_tpu.plot_utils import phaseogram, phaseogram_binned
+
+        plot = phaseogram_binned if args.binned else phaseogram
+        plot(toas.mjd_float, phases, weights=weights,
+             title=f"{args.eventfile}  H={h:.1f}",
+             plotfile=args.plotfile)
+        print(f"wrote {args.plotfile}")
     return 0
 
 

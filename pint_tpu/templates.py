@@ -495,11 +495,14 @@ class LCFitter:
         for p in self.template.primitives:
             bounds += p.param_bounds()
 
-        # soft barrier keeping sum(norms) < 1 (a negative uniform
+        # soft barrier keeping sum(norms) <= 1 (a negative uniform
         # background is unphysical and its log-clamp has zero gradient,
-        # so L-BFGS could otherwise settle there with k >= 2 peaks)
+        # so L-BFGS could otherwise settle there with k >= 2 peaks).
+        # Exactly 1 is legitimate — standalone empirical templates
+        # (fourier/kernel) carry their background inside the density —
+        # so the penalty is zero at and below 1 and unbiased there.
         barrier = jax.jit(jax.value_and_grad(
-            lambda p: 1e8 * jnp.maximum(jnp.sum(p[:k]) - 0.995, 0.0) ** 2
+            lambda p: 1e10 * jnp.maximum(jnp.sum(p[:k]) - 1.0, 0.0) ** 2
         ))
 
         def fun(x):
@@ -605,27 +608,30 @@ class LCETemplate:
 # --- template file IO (reference: lctemplate.py:1009 prim_io,
 # :609 prof_string; scripts/event_optimize.py:33 read_gaussfitfile) ----------
 
+def _fwhm_loc(kind, width_param, loc):
+    """(FWHM, loc) of a peaked primitive from its width parameter —
+    shared between prof_string and convert_primitive."""
+    if kind is LCGaussian:
+        return _FWHM_SIGMA * width_param, loc
+    if kind is LCLorentzian:
+        return 2.0 * width_param, loc
+    if kind is LCVonMises:
+        # FWHM of exp(k(cos a - 1)): cos a = 1 + ln(1/2)/k
+        return (np.arccos(max(1.0 - np.log(2.0) / width_param, -1.0))
+                / np.pi, loc)
+    raise ValueError(
+        f"need a gaussian-like peaked primitive, not {kind.__name__}")
+
+
 def prof_string(template: LCTemplate) -> str:
     """pygaussfit-compatible text for a gaussian-mixture template
     (reference lctemplate prof_string: phas/fwhm/ampl rows + const)."""
-    k = len(template.primitives)
     norms, prim_params = template._split(np.asarray(template.params))
     lines = []
     total = 0.0
     for i, (prim, pp) in enumerate(zip(template.primitives, prim_params),
                                    start=1):
-        if isinstance(prim, LCGaussian):
-            width, loc = _FWHM_SIGMA * pp[0], pp[1]
-        elif isinstance(prim, LCLorentzian):
-            width, loc = 2.0 * pp[0], pp[1]
-        elif isinstance(prim, LCVonMises):
-            # FWHM of exp(k(cos a - 1)): cos a = 1 + ln(1/2)/k
-            width, loc = (np.arccos(max(1.0 - np.log(2.0) / pp[0], -1.0))
-                          / np.pi, pp[1])
-        else:
-            raise ValueError(
-                f"prof_string supports gaussian-like primitives, not "
-                f"{type(prim).__name__}")
+        width, loc = _fwhm_loc(type(prim), pp[0], pp[1])
         ampl = float(norms[i - 1])
         total += ampl
         lines += [f"phas{i} = {loc % 1.0:.5f} +/- 0.00000",
@@ -680,19 +686,8 @@ def read_template(path) -> LCTemplate:
                  for i in idx]
         return LCTemplate(prims, norms=[ampls[i] for i in idx])
     if "fourier" in label:
-        rows = []
-        for t in toks:
-            if len(t) == 2:
-                try:
-                    rows.append((float(t[0]), float(t[1])))
-                except ValueError:
-                    pass
-        if not rows:
-            raise ValueError(f"no fourier coefficients in {path}")
-        prim = LCEmpiricalFourier(nharm=len(rows))
-        prim.alphas = np.array([r[0] for r in rows])
-        prim.betas = np.array([r[1] for r in rows])
-        return LCTemplate([prim], norms=[1.0])
+        return LCTemplate([LCEmpiricalFourier(input_file=path)],
+                          norms=[1.0])
     if "kernel" in label:
         phases = [float(t[0]) for t in toks if t]
         prim = LCKernelDensity(phases=phases)
@@ -713,15 +708,8 @@ def read_gaussfitfile(path, proflen):
 def convert_primitive(prim, ptype=LCLorentzian):
     """Convert one peak to another kind, preserving location and FWHM
     (reference lcprimitives convert_primitive:1607)."""
-    if isinstance(prim, LCGaussian):
-        fwhm, loc = _FWHM_SIGMA * prim.sigma, prim.loc
-    elif isinstance(prim, LCLorentzian):
-        fwhm, loc = 2.0 * prim.gamma, prim.loc
-    elif isinstance(prim, LCVonMises):
-        fwhm = np.arccos(max(1.0 - np.log(2.0) / prim.kappa, -1.0)) / np.pi
-        loc = prim.loc
-    else:
-        raise ValueError(f"cannot convert {type(prim).__name__}")
+    width_param = prim.init_params()[0]
+    fwhm, loc = _fwhm_loc(type(prim), width_param, prim.loc)
     if ptype is LCGaussian:
         return LCGaussian(sigma=fwhm / _FWHM_SIGMA, loc=loc)
     if ptype is LCLorentzian:
@@ -769,8 +757,8 @@ class LCEFitter:
         x0 = np.array(self.template.params)
         bounds = [(1e-4, 1.0)] * k + [(None, None)] * (len(x0) - k)
         barrier = jax.jit(jax.value_and_grad(
-            lambda p: 1e8 * jnp.maximum(jnp.sum(p[:k]) - 0.995,
-                                        0.0) ** 2))
+            lambda p: 1e10 * jnp.maximum(jnp.sum(p[:k]) - 1.0,
+                                         0.0) ** 2))
 
         def fun(x):
             xj = jnp.asarray(x)

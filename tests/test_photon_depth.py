@@ -9,6 +9,8 @@ import os
 import numpy as np
 import pytest
 
+from pint_tpu.templates import _trapezoid
+
 REFDATA = "/root/reference/tests/datafile"
 
 
@@ -202,7 +204,7 @@ class TestTemplateIO:
         grid = np.linspace(0, 1, 201)
         d = np.asarray(t.density(grid))
         # integrates to ~1 and peaks near the true peaks
-        np.testing.assert_allclose(np.trapezoid(d, grid), 1.0, atol=1e-6)
+        np.testing.assert_allclose(_trapezoid(d, grid), 1.0, atol=1e-6)
         assert abs(grid[np.argmax(d)] - 0.3) < 0.05
         # shift parameter moves the profile
         d2 = np.asarray(t.density(grid, params=np.array([1.0, 0.1])))
@@ -219,7 +221,7 @@ class TestTemplateIO:
         t = read_template(str(p))
         grid = np.linspace(0, 1, 201)
         d = np.asarray(t.density(grid))
-        np.testing.assert_allclose(np.trapezoid(d, grid), 1.0, atol=0.02)
+        np.testing.assert_allclose(_trapezoid(d, grid), 1.0, atol=0.02)
         assert abs(grid[np.argmax(d)] - 0.3) < 0.05
 
     def test_read_gaussfitfile_binned(self, tmp_path):

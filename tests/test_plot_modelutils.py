@@ -1,0 +1,65 @@
+"""plot_utils (Agg-rendered) and modelutils frame-conversion wrappers
+(reference: plot_utils.py, modelutils.py)."""
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import numpy as np
+import pytest
+
+
+class TestPhaseograms:
+    def test_phaseogram_scatter(self, tmp_path):
+        from pint_tpu.plot_utils import phaseogram
+
+        rng = np.random.default_rng(0)
+        mjds = np.sort(rng.uniform(55000, 55100, 500))
+        ph = rng.normal(0.4, 0.05, 500) % 1.0
+        out = tmp_path / "pg.png"
+        fig = phaseogram(mjds, ph, title="test", plotfile=str(out))
+        assert out.exists() and out.stat().st_size > 0
+
+    def test_phaseogram_binned_weighted(self, tmp_path):
+        from pint_tpu.plot_utils import phaseogram_binned
+
+        rng = np.random.default_rng(1)
+        mjds = np.sort(rng.uniform(55000, 55100, 800))
+        ph = rng.normal(0.6, 0.04, 800) % 1.0
+        w = rng.uniform(0.1, 1.0, 800)
+        out = tmp_path / "pgb.png"
+        phaseogram_binned(mjds, ph, weights=w, plotfile=str(out))
+        assert out.exists() and out.stat().st_size > 0
+
+    def test_plot_priors(self, tmp_path):
+        from pint_tpu.models.builder import get_model
+        from pint_tpu.plot_utils import plot_priors
+
+        m = get_model("/root/reference/tests/datafile/NGC6440E.par")
+        rng = np.random.default_rng(2)
+        chains = {"F0": rng.normal(61.485, 1e-9, 400),
+                  "DM": rng.normal(223.9, 0.1, 400)}
+        out = tmp_path / "priors.png"
+        plot_priors(m, chains, burnin=50, plotfile=str(out))
+        assert out.exists() and out.stat().st_size > 0
+
+
+class TestModelUtils:
+    def test_equatorial_to_ecliptic_and_back(self):
+        from pint_tpu.modelutils import (
+            model_ecliptic_to_equatorial,
+            model_equatorial_to_ecliptic,
+        )
+        from pint_tpu.models.builder import get_model
+
+        m = get_model("/root/reference/tests/datafile/NGC6440E.par")
+        assert m.has_component("AstrometryEquatorial")
+        # pass-through when already equatorial
+        assert model_ecliptic_to_equatorial(m) is m
+        ecl = model_equatorial_to_ecliptic(m)
+        assert ecl.has_component("AstrometryEcliptic")
+        back = model_ecliptic_to_equatorial(ecl)
+        assert back.has_component("AstrometryEquatorial")
+        for a, b, tol in (("RAJ", "RAJ", 1e-10), ("DECJ", "DECJ", 1e-10)):
+            np.testing.assert_allclose(float(back.values[a]),
+                                       float(m.values[b]), atol=tol)
