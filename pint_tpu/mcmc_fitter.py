@@ -21,7 +21,8 @@ import numpy as np
 from pint_tpu.bayesian import UniformPrior
 from pint_tpu.sampler import EnsembleSampler
 
-__all__ = ["MCMCFitter", "CompositeMCMCFitter"]
+__all__ = ["MCMCFitter", "MCMCFitterAnalyticTemplate",
+           "MCMCFitterBinnedTemplate", "CompositeMCMCFitter"]
 
 
 class MCMCFitter:
@@ -170,6 +171,32 @@ class MCMCFitter:
             params[name].uncertainty = float(flat[:, i].std())
         self.sampler = s
         return lnp
+
+
+class MCMCFitterAnalyticTemplate(MCMCFitter):
+    """Named variant requiring an analytic LCTemplate (reference
+    MCMCFitterAnalyticTemplate) — MCMCFitter auto-detects, this class
+    just validates the intent at construction."""
+
+    def __init__(self, toas, model, template, **kw):
+        if isinstance(template, (list, np.ndarray, jnp.ndarray)):
+            raise TypeError(
+                "MCMCFitterAnalyticTemplate needs an LCTemplate; use "
+                "MCMCFitterBinnedTemplate for binned profiles")
+        super().__init__(toas, model, template, **kw)
+
+
+class MCMCFitterBinnedTemplate(MCMCFitter):
+    """Named variant requiring a binned profile array (reference
+    MCMCFitterBinnedTemplate)."""
+
+    def __init__(self, toas, model, template, **kw):
+        if not isinstance(template, (list, np.ndarray, jnp.ndarray)):
+            raise TypeError(
+                "MCMCFitterBinnedTemplate needs an array of bin "
+                "heights; use MCMCFitterAnalyticTemplate for "
+                "LCTemplate objects")
+        super().__init__(toas, model, template, **kw)
 
 
 class CompositeMCMCFitter:

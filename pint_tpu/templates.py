@@ -467,6 +467,18 @@ class LCTemplate:
         return lnlike
 
 
+def _norm_barrier(k):
+    """Soft barrier keeping sum(norms) <= 1 (a negative uniform
+    background is unphysical and its log-clamp has zero gradient, so
+    L-BFGS could otherwise settle there with k >= 2 peaks).  Exactly 1
+    is legitimate — standalone empirical templates (fourier/kernel)
+    carry their background inside the density — so the penalty is zero
+    at and below 1 and unbiased there.  Shared by LCFitter/LCEFitter."""
+    return jax.jit(jax.value_and_grad(
+        lambda p: 1e10 * jnp.maximum(jnp.sum(p[:k]) - 1.0, 0.0) ** 2
+    ))
+
+
 class LCFitter:
     """Maximum-likelihood template fitting with autodiff gradients
     (reference: lcfitters.py:1-1085)."""
@@ -495,15 +507,7 @@ class LCFitter:
         for p in self.template.primitives:
             bounds += p.param_bounds()
 
-        # soft barrier keeping sum(norms) <= 1 (a negative uniform
-        # background is unphysical and its log-clamp has zero gradient,
-        # so L-BFGS could otherwise settle there with k >= 2 peaks).
-        # Exactly 1 is legitimate — standalone empirical templates
-        # (fourier/kernel) carry their background inside the density —
-        # so the penalty is zero at and below 1 and unbiased there.
-        barrier = jax.jit(jax.value_and_grad(
-            lambda p: 1e10 * jnp.maximum(jnp.sum(p[:k]) - 1.0, 0.0) ** 2
-        ))
+        barrier = _norm_barrier(k)
 
         def fun(x):
             xj = jnp.asarray(x)
@@ -708,8 +712,9 @@ def read_gaussfitfile(path, proflen):
 def convert_primitive(prim, ptype=LCLorentzian):
     """Convert one peak to another kind, preserving location and FWHM
     (reference lcprimitives convert_primitive:1607)."""
-    width_param = prim.init_params()[0]
-    fwhm, loc = _fwhm_loc(type(prim), width_param, prim.loc)
+    if type(prim) not in (LCGaussian, LCLorentzian, LCVonMises):
+        raise ValueError(f"cannot convert {type(prim).__name__}")
+    fwhm, loc = _fwhm_loc(type(prim), prim.init_params()[0], prim.loc)
     if ptype is LCGaussian:
         return LCGaussian(sigma=fwhm / _FWHM_SIGMA, loc=loc)
     if ptype is LCLorentzian:
@@ -756,9 +761,7 @@ class LCEFitter:
         k = len(self.template.primitives)
         x0 = np.array(self.template.params)
         bounds = [(1e-4, 1.0)] * k + [(None, None)] * (len(x0) - k)
-        barrier = jax.jit(jax.value_and_grad(
-            lambda p: 1e10 * jnp.maximum(jnp.sum(p[:k]) - 1.0,
-                                         0.0) ** 2))
+        barrier = _norm_barrier(k)
 
         def fun(x):
             xj = jnp.asarray(x)

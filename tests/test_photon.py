@@ -190,6 +190,29 @@ class TestEventTOAs:
 
 
 class TestMCMCFitter:
+    def test_named_variants_validate_template_kind(self, tmp_path):
+        """Reference API parity: MCMCFitterAnalyticTemplate /
+        MCMCFitterBinnedTemplate enforce their template kind."""
+        from pint_tpu.mcmc_fitter import (
+            MCMCFitterAnalyticTemplate,
+            MCMCFitterBinnedTemplate,
+        )
+        from pint_tpu.templates import LCGaussian, LCTemplate
+
+        m, toas, _ = _make_event_toas(tmp_path, n=50)
+        for name in m.free_params:
+            m.params[name].uncertainty = m.params[name].uncertainty or 1e-9
+        tmpl = LCTemplate([LCGaussian(sigma=0.05, loc=0.5)])
+        binned = np.ones(32)
+        with pytest.raises(TypeError):
+            MCMCFitterAnalyticTemplate(toas, m, binned)
+        with pytest.raises(TypeError):
+            MCMCFitterBinnedTemplate(toas, m, tmpl)
+        f = MCMCFitterAnalyticTemplate(toas, m, tmpl)
+        assert not f._binned
+        f = MCMCFitterBinnedTemplate(toas, m, binned)
+        assert f._binned
+
     def test_f0_recovery(self, tmp_path):
         """Photons drawn pulsed under a shifted F0; the photon-domain
         MCMC pulls F0 back (reference: event_optimize tests)."""
