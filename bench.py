@@ -252,7 +252,17 @@ def bench_pta(jnp, backend):
     }), flush=True)
 
 
-def main():
+#: run order: proven-cheapest compile first, heaviest (GLS) last, so a
+#: mid-run backend loss still leaves the earlier metrics recorded
+_METRICS = {
+    "wls_grid": bench_wls_grid,
+    "mcmc": bench_mcmc,
+    "pta": bench_pta,
+    "gls": bench_gls,
+}
+
+
+def _force_cpu_if_requested():
     import os
 
     if os.environ.get("PINT_TPU_BENCH_CPU"):  # debug/smoke escape hatch
@@ -264,21 +274,113 @@ def main():
             jax.clear_backends()
         except Exception:
             pass
+
+
+def _run_one(name):
+    """Child-process entry: run a single metric inline."""
+    _force_cpu_if_requested()
     import jax
     import jax.numpy as jnp
 
     import pint_tpu  # noqa: F401  (x64)
 
-    backend = jax.default_backend()
+    try:
+        _METRICS[name](jnp, jax.default_backend())
+        return 0
+    except Exception as e:
+        print(json.dumps({
+            "metric": name, "value": None,
+            "unit": f"FAILED: {type(e).__name__}: {e}",
+            "vs_baseline": None,
+        }), flush=True)
+        return 1
+
+
+def _probe_backend(timeout_s):
+    """Jit a trivial function in a subprocess: detects a hung TPU
+    tunnel (known axon failure mode: even trivial jit blocks forever
+    with no error) without hanging the bench itself.  Returns
+    (ok, detail) where detail distinguishes a timeout from a broken
+    environment (and carries the probe's stderr tail)."""
+    import subprocess
+
+    code = ("import os\n"
+            "if os.environ.get('PINT_TPU_BENCH_CPU'):\n"
+            "    os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+            "import jax, jax.numpy as jnp\n"
+            "if os.environ.get('PINT_TPU_BENCH_CPU'):\n"
+            "    jax.config.update('jax_platforms', 'cpu')\n"
+            "jax.jit(lambda x: x * 2)(jnp.ones(8))\n"
+            "print(jax.default_backend())\n")
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True,
+                           timeout=timeout_s)
+        if r.returncode == 0:
+            return True, ""
+        return False, ("probe exited rc=%d: %s"
+                       % (r.returncode, r.stderr.strip()[-300:]))
+    except subprocess.TimeoutExpired:
+        return False, ("probe timed out after %.0fs (hung device "
+                       "tunnel)" % timeout_s)
+
+
+def main():
+    """Parent: one subprocess per metric with a hard timeout, so a hung
+    backend (or a pathological compile) can never swallow the whole
+    suite — every metric emits exactly one JSON line."""
+    import os
+    import subprocess
+
+    if len(sys.argv) >= 3 and sys.argv[1] == "--metric":
+        return _run_one(sys.argv[2])
+
+    per_metric_s = float(os.environ.get(
+        "PINT_TPU_BENCH_METRIC_TIMEOUT", "600"))
+    probe_s = float(os.environ.get("PINT_TPU_BENCH_PROBE_TIMEOUT", "120"))
+
+    alive, detail = _probe_backend(probe_s)
+    if not alive:
+        print(f"bench: backend probe failed ({detail}); retrying once",
+              file=sys.stderr, flush=True)
+        time.sleep(30)
+        alive, detail = _probe_backend(probe_s)
+
     failures = 0
-    for fn in (bench_gls, bench_wls_grid, bench_mcmc, bench_pta):
-        try:
-            fn(jnp, backend)
-        except Exception as e:  # a broken metric must not hide the rest
+    for name in _METRICS:
+        if not alive:
             failures += 1
             print(json.dumps({
-                "metric": fn.__name__, "value": None,
-                "unit": f"FAILED: {type(e).__name__}: {e}",
+                "metric": name, "value": None,
+                "unit": f"FAILED: backend probe failed: {detail}",
+                "vs_baseline": None,
+            }), flush=True)
+            continue
+        print(f"bench: running {name} (timeout {per_metric_s:.0f}s)",
+              file=sys.stderr, flush=True)
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--metric", name],
+                timeout=per_metric_s)
+            if r.returncode != 0:
+                failures += 1
+                if r.returncode not in (0, 1):
+                    # killed by signal / hard abort: the child never
+                    # got to print its FAILED line — keep the
+                    # one-line-per-metric contract here
+                    print(json.dumps({
+                        "metric": name, "value": None,
+                        "unit": "FAILED: metric child died rc="
+                                f"{r.returncode} (signal/abort)",
+                        "vs_baseline": None,
+                    }), flush=True)
+        except subprocess.TimeoutExpired:
+            failures += 1
+            print(json.dumps({
+                "metric": name, "value": None,
+                "unit": f"FAILED: exceeded {per_metric_s:.0f}s metric "
+                        "timeout (hung backend or pathological compile)",
                 "vs_baseline": None,
             }), flush=True)
     return 1 if failures else 0

@@ -60,7 +60,11 @@ def _mjdref(header):
     return int(ref), ref - int(ref)
 
 
-def load_event_TOAs(path, mission, weights=None, extname="EVENTS",
+#: missions whose event extension is not named EVENTS
+_MISSION_EXTNAME = {"rxte": "XTE_SE"}
+
+
+def load_event_TOAs(path, mission, weights=None, extname=None,
                     energy_range_kev=None, errors_us=None,
                     ephem="builtin", planets=False, orbfile=None):
     """Read photon events into a TOAs object.
@@ -71,6 +75,8 @@ def load_event_TOAs(path, mission, weights=None, extname="EVENTS",
     observatory (reference satellite_obs.py) so spacecraft-local event
     times use real orbital geometry instead of the geocenter.
     """
+    if extname is None:
+        extname = _MISSION_EXTNAME.get(mission.lower(), "EVENTS")
     header, data = read_events(path, extname=extname)
     time = np.asarray(data["TIME"], dtype=np.float64)
     timezero = float(header.get("TIMEZERO", 0.0))

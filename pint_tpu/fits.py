@@ -150,6 +150,16 @@ def read_fits(path):
                         strides=(row_bytes,),
                     )
                     data[ttype] = np.char.decode(arr, "ascii")
+                elif letter == "X":
+                    # bit array (e.g. Fermi FT1 EVENT_CLASS '32X'):
+                    # ceil(repeat/8) bytes per row, kept as raw uint8
+                    width = (repeat + 7) // 8
+                    arr = np.ndarray(
+                        (nrows, width), dtype=np.uint8,
+                        buffer=table_raw, offset=offset,
+                        strides=(row_bytes, 1),
+                    )
+                    data[ttype] = arr.copy()
                 else:
                     raise ValueError(
                         f"unsupported TFORM {tform!r} for {ttype}"

@@ -16,7 +16,9 @@ def main(argv=None):
     p.add_argument("eventfile")
     p.add_argument("parfile")
     p.add_argument("--mission", default="nicer")
-    p.add_argument("--extname", default="EVENTS")
+    p.add_argument("--extname", default=None,
+                   help="events extension (default: per-mission, "
+                        "usually EVENTS)")
     p.add_argument("--orbfile", default=None,
                    help="FPorbit/FT2 spacecraft orbit file: use real "
                         "orbital geometry instead of the geocenter")
@@ -28,6 +30,10 @@ def main(argv=None):
                    help="write a phaseogram to this image file")
     p.add_argument("--binned", action="store_true",
                    help="binned (2-D histogram) phaseogram style")
+    p.add_argument("--minMJD", type=float, default=None,
+                   help="keep only events at/after this MJD")
+    p.add_argument("--maxMJD", type=float, default=None,
+                   help="keep only events at/before this MJD")
     p.add_argument("--polycos", action="store_true",
                    help="use generated polycos instead of exact phases")
     args = p.parse_args(argv)
@@ -37,11 +43,28 @@ def main(argv=None):
     from pint_tpu.models import get_model
 
     model = get_model(args.parfile)
+    if "TZRMJD" not in model.values and "TZRMJD" not in model.meta:
+        raise ValueError(
+            "photon phases need an absolute reference: the par file "
+            "must carry TZRMJD/TZRSITE/TZRFRQ (AbsPhase; reference "
+            "photonphase raises the same way)")
     toas = load_event_TOAs(args.eventfile, args.mission,
                            extname=args.extname,
                            ephem=model.meta.get("EPHEM", "builtin"),
                            orbfile=args.orbfile)
     print(f"Read {len(toas)} events")
+    if args.minMJD is not None or args.maxMJD is not None:
+        mf = np.asarray(toas.mjd_float)
+        keep = np.ones(len(toas), dtype=bool)
+        if args.minMJD is not None:
+            keep &= mf >= args.minMJD
+        if args.maxMJD is not None:
+            keep &= mf <= args.maxMJD
+        if not keep.any():
+            raise SystemExit(
+                f"no events in MJD range [{args.minMJD}, {args.maxMJD}]")
+        toas = toas[keep]
+        print(f"Kept {len(toas)} events in [{args.minMJD}, {args.maxMJD}]")
     if args.polycos:
         if not all(o == "barycenter" for o in toas.obs_names):
             raise SystemExit(

@@ -807,7 +807,9 @@ def write_tim(toas: TOAs, path, include_info=True):
 
     lines = []
     if include_info:
-        lines.append("C Created by pint_tpu write_tim")
+        from pint_tpu.utils import info_string
+
+        lines.append(info_string(prefix_string="C "))
     lines.append("FORMAT 1")
     for i in range(len(toas)):
         obs = get_observatory(toas.obs_names[i])

@@ -13,7 +13,40 @@ import numpy as np
 
 __all__ = ["FTest", "akaike_information_criterion",
            "bayesian_information_criterion", "weighted_mean",
-           "taylor_horner", "taylor_horner_deriv"]
+           "taylor_horner", "taylor_horner_deriv", "info_string"]
+
+
+def info_string(prefix_string="# ", comment=None, detailed=False):
+    """Provenance string for output files: creation date, package
+    version, user, host, OS (reference: utils.py:2314 info_string;
+    gitpython/astropy extras replaced by the stdlib equivalents)."""
+    import datetime
+    import getpass
+    import platform
+
+    try:
+        user = getpass.getuser()
+    except (KeyError, OSError):  # no passwd entry (containers/CI)
+        user = "unknown"
+    lines = [
+        f"Created: {datetime.datetime.now().isoformat()}",
+        "pint_tpu: 0.1.0",
+        f"User: {user}",
+        f"Host: {platform.node()}",
+        f"OS: {platform.platform()}",
+    ]
+    if detailed:
+        import sys
+
+        import jax
+
+        lines += [f"Python: {sys.version.split()[0]}",
+                  f"jax: {jax.__version__}",
+                  f"numpy: {np.__version__}",
+                  f"backend: {jax.default_backend()}"]
+    if comment:
+        lines += [f"Comment: {c}" for c in str(comment).splitlines()]
+    return "\n".join(prefix_string + ln for ln in lines)
 
 
 def FTest(chi2_simple, dof_simple, chi2_complex, dof_complex):
