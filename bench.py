@@ -293,7 +293,10 @@ def _run_one(name):
             "unit": f"FAILED: {type(e).__name__}: {e}",
             "vs_baseline": None,
         }), flush=True)
-        return 1
+        # sentinel: "failed but the JSON line was printed" — any other
+        # nonzero (unhandled import error rc=1, signal death rc<0)
+        # means the parent must print the line itself
+        return 3
 
 
 def _probe_backend(timeout_s):
@@ -365,14 +368,15 @@ def main():
                 timeout=per_metric_s)
             if r.returncode != 0:
                 failures += 1
-                if r.returncode not in (0, 1):
-                    # killed by signal / hard abort: the child never
-                    # got to print its FAILED line — keep the
-                    # one-line-per-metric contract here
+                if r.returncode != 3:
+                    # not the printed-its-own-line sentinel: import
+                    # failure (rc=1), signal death (rc<0), or other
+                    # hard abort — keep the one-line-per-metric
+                    # contract here
                     print(json.dumps({
                         "metric": name, "value": None,
                         "unit": "FAILED: metric child died rc="
-                                f"{r.returncode} (signal/abort)",
+                                f"{r.returncode} before reporting",
                         "vs_baseline": None,
                     }), flush=True)
         except subprocess.TimeoutExpired:

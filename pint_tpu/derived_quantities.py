@@ -20,7 +20,7 @@ __all__ = [
     "pulsar_B_gauss", "pulsar_B_lightcyl_gauss", "mass_funct",
     "mass_funct2", "pulsar_mass", "companion_mass", "pbdot", "gamma",
     "omdot_deg_per_yr", "sini", "omdot_to_mtot", "a1sini",
-    "shklovskii_factor", "dispersion_slope",
+    "shklovskii_factor", "dispersion_slope", "orbital_phase",
 ]
 
 _SECS_PER_YEAR = 365.25 * 86400.0
@@ -192,3 +192,22 @@ def dispersion_slope(dm):
     from pint_tpu import DM_CONST
 
     return DM_CONST * dm * 1e12
+
+
+def orbital_phase(model, ticks):
+    """Mean orbital phase in [0, 1) at TDB ticks (reference:
+    photonphase --addorbphase / pintk orbital-phase view): the mean
+    anomaly fraction from T0 (or TASC for ELL1-family models), with the
+    orbital frequency from PB or FB0.  Raises ValueError when the model
+    has no binary component."""
+    vals = model.values
+    t0 = vals.get("T0", vals.get("TASC"))
+    if t0 is None or not ("PB" in vals or "FB0" in vals):
+        raise ValueError(
+            "orbital phase needs a binary model (T0/TASC and PB/FB0)")
+    # internal units: PB seconds (Param scale converts par-file days),
+    # FB0 Hz, T0/TASC seconds since J2000
+    fb = (float(vals["FB0"]) if "FB0" in vals
+          else 1.0 / float(vals["PB"]))
+    sec = np.asarray(ticks, np.float64) / 2**32
+    return ((sec - float(t0)) * fb) % 1.0

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["FitsHDU", "read_fits", "read_events"]
+__all__ = ["FitsHDU", "read_fits", "read_events", "write_events"]
 
 _BLOCK = 2880
 _CARD = 80
@@ -184,3 +184,60 @@ def read_events(path, extname="EVENTS", columns=None):
                     )
             return hdu.header, hdu.data
     raise KeyError(f"no {extname} extension in {path}")
+
+
+def write_events(path, time_s, mjdref=(56000, 0.0), timesys="TDB",
+                 timeref="SOLARSYSTEM", extra_cols=None,
+                 extname="EVENTS", extra_header=None, timezero=0.0):
+    """Minimal standards-compliant event-FITS writer: empty primary HDU
+    + one BINTABLE with a TIME column (f64 MET seconds) and optional
+    extra f64 columns (reference analogue: photonphase --outfile, which
+    writes PULSE_PHASE/ORBIT_PHASE columns via astropy.io.fits;
+    scripts/photonphase.py:90).  extra_header: additional scalar cards
+    for the table header."""
+
+    def card(key, val, quote=False):
+        if quote:
+            v = f"'{val}'"
+        elif isinstance(val, bool):
+            v = "T" if val else "F"
+        else:
+            v = str(val)
+        return f"{key:<8s}= {v:>20s}{'':50s}"[:80].encode()
+
+    def block(cards):
+        data = b"".join(cards) + b"END" + b" " * 77
+        return data + b" " * ((-len(data)) % _BLOCK)
+
+    primary = block([
+        card("SIMPLE", True), card("BITPIX", 8), card("NAXIS", 0),
+    ])
+    cols = [("TIME", np.asarray(time_s, dtype=">f8"))]
+    for name, arr in (extra_cols or {}).items():
+        cols.append((name, np.asarray(arr, dtype=">f8")))
+    nrows = len(time_s)
+    row_bytes = 8 * len(cols)
+    cards = [
+        card("XTENSION", "BINTABLE", quote=True),
+        card("BITPIX", 8), card("NAXIS", 2),
+        card("NAXIS1", row_bytes), card("NAXIS2", nrows),
+        card("PCOUNT", 0), card("GCOUNT", 1),
+        card("TFIELDS", len(cols)),
+        card("EXTNAME", extname, quote=True),
+        card("MJDREFI", mjdref[0]), card("MJDREFF", mjdref[1]),
+        card("TIMESYS", timesys, quote=True),
+        card("TIMEREF", timeref, quote=True),
+        card("TIMEZERO", float(timezero)),
+    ]
+    for key, val in (extra_header or {}).items():
+        cards.append(card(key, val, quote=isinstance(val, str)))
+    for i, (name, _) in enumerate(cols, start=1):
+        cards.append(card(f"TTYPE{i}", name, quote=True))
+        cards.append(card(f"TFORM{i}", "D", quote=True))
+    table = np.empty((nrows, len(cols)), dtype=">f8")
+    for i, (_, arr) in enumerate(cols):
+        table[:, i] = arr
+    raw = table.tobytes()
+    raw += b"\x00" * ((-len(raw)) % _BLOCK)
+    with open(path, "wb") as f:
+        f.write(primary + block(cards) + raw)

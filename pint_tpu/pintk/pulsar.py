@@ -187,14 +187,9 @@ class Pulsar:
         if kind == "serial":
             return np.arange(len(toas), dtype=float)
         if kind == "orbital phase":
-            vals = self.model.values
-            if "PB" in vals:
-                pb = float(vals["PB"])
-                t0 = float(vals.get("T0", vals.get("TASC", 0.0)))
-                # T0/TASC are stored as seconds since J2000 internally
-                sec = toas.ticks / 2**32
-                return ((sec - t0) / (pb * 86400.0)) % 1.0
-            raise ValueError("model has no binary component")
+            from pint_tpu.derived_quantities import orbital_phase
+
+            return orbital_phase(self.model, toas.ticks)
         if kind == "year":
             return 2000.0 + (np.asarray(toas.mjd_float) - 51544.5) / 365.25
         if kind == "day of year":

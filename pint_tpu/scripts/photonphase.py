@@ -26,6 +26,11 @@ def main(argv=None):
                    help="max harmonics for the H-test")
     p.add_argument("--outphases", default=None,
                    help="write phases to this .npy")
+    p.add_argument("--outfile", default=None,
+                   help="write an events FITS with a PULSE_PHASE column")
+    p.add_argument("--addorbphase", action="store_true",
+                   help="also write an ORBIT_PHASE column (needs a "
+                        "binary model)")
     p.add_argument("--plotfile", default=None,
                    help="write a phaseogram to this image file")
     p.add_argument("--binned", action="store_true",
@@ -53,9 +58,9 @@ def main(argv=None):
                            ephem=model.meta.get("EPHEM", "builtin"),
                            orbfile=args.orbfile)
     print(f"Read {len(toas)} events")
+    keep = np.ones(len(toas), dtype=bool)
     if args.minMJD is not None or args.maxMJD is not None:
         mf = np.asarray(toas.mjd_float)
-        keep = np.ones(len(toas), dtype=bool)
         if args.minMJD is not None:
             keep &= mf >= args.minMJD
         if args.maxMJD is not None:
@@ -96,6 +101,31 @@ def main(argv=None):
     if args.outphases:
         np.save(args.outphases, phases)
         print(f"wrote {args.outphases}")
+    orb_ph = None
+    if args.addorbphase:
+        from pint_tpu.derived_quantities import orbital_phase
+
+        # raises ValueError without a binary model (reference
+        # test_OrbPhase_exception semantics), outfile or not
+        orb_ph = orbital_phase(model, toas.ticks)
+    if args.outfile:
+        from pint_tpu.fits import read_events as _re, write_events
+        from pint_tpu.event_toas import _MISSION_EXTNAME, _mjdref
+
+        hdr, dat = _re(args.eventfile, extname=args.extname or
+                       _MISSION_EXTNAME.get(args.mission.lower(),
+                                            "EVENTS"))
+        met = np.asarray(dat["TIME"], np.float64)[keep]
+        extra = {"PULSE_PHASE": phases}
+        if orb_ph is not None:
+            extra["ORBIT_PHASE"] = orb_ph
+        refi, reff = _mjdref(hdr)
+        write_events(args.outfile, met, mjdref=(refi, reff),
+                     timesys=str(hdr.get("TIMESYS", "TT")),
+                     timeref=str(hdr.get("TIMEREF", "LOCAL")),
+                     timezero=float(hdr.get("TIMEZERO", 0.0)),
+                     extra_cols=extra)
+        print(f"wrote {args.outfile}")
     if args.plotfile:
         import matplotlib
 

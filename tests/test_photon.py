@@ -19,52 +19,12 @@ from pint_tpu.templates import LCFitter, LCGaussian, LCLorentzian, \
 def write_events_fits(path, time_s, mjdref=(56000, 0.000777),
                       timesys="TDB", timeref="SOLARSYSTEM",
                       extra_cols=None):
-    """Minimal standards-compliant FITS: empty primary + EVENTS
-    BINTABLE with a TIME column (f64) and optional extras."""
+    """Thin wrapper over the library writer (pint_tpu.fits.write_events)
+    keeping this module's historical default MJDREF."""
+    from pint_tpu.fits import write_events
 
-    def card(key, val, quote=False):
-        if quote:
-            v = f"'{val}'"
-        elif isinstance(val, bool):
-            v = "T" if val else "F"
-        else:
-            v = str(val)
-        return f"{key:<8s}= {v:>20s}{'':50s}"[:80].encode()
-
-    def block(cards):
-        data = b"".join(cards) + b"END" + b" " * 77
-        return data + b" " * ((-len(data)) % 2880)
-
-    primary = block([
-        card("SIMPLE", True), card("BITPIX", 8), card("NAXIS", 0),
-    ])
-    cols = [("TIME", np.asarray(time_s, dtype=">f8"))]
-    for name, arr in (extra_cols or {}).items():
-        cols.append((name, np.asarray(arr, dtype=">f8")))
-    nrows = len(time_s)
-    row_bytes = 8 * len(cols)
-    cards = [
-        card("XTENSION", "BINTABLE", quote=True),
-        card("BITPIX", 8), card("NAXIS", 2),
-        card("NAXIS1", row_bytes), card("NAXIS2", nrows),
-        card("PCOUNT", 0), card("GCOUNT", 1),
-        card("TFIELDS", len(cols)),
-        card("EXTNAME", "EVENTS", quote=True),
-        card("MJDREFI", mjdref[0]), card("MJDREFF", mjdref[1]),
-        card("TIMESYS", timesys, quote=True),
-        card("TIMEREF", timeref, quote=True),
-        card("TIMEZERO", 0.0),
-    ]
-    for i, (name, _) in enumerate(cols, start=1):
-        cards.append(card(f"TTYPE{i}", name, quote=True))
-        cards.append(card(f"TFORM{i}", "D", quote=True))
-    table = np.empty((nrows, len(cols)), dtype=">f8")
-    for i, (_, arr) in enumerate(cols):
-        table[:, i] = arr
-    raw = table.tobytes()
-    raw += b"\x00" * ((-len(raw)) % 2880)
-    with open(path, "wb") as f:
-        f.write(primary + block(cards) + raw)
+    write_events(path, time_s, mjdref=mjdref, timesys=timesys,
+                 timeref=timeref, extra_cols=extra_cols)
 
 
 class TestFitsReader:

@@ -67,6 +67,46 @@ def test_nicer_topo_orbit_golden(capsys):
     assert abs(h - 183.21) < 1.0
 
 
+def test_orbphase_column(tmp_path, capsys):
+    """--addorbphase writes an ORBIT_PHASE column for the J0218 binary
+    (reference test_OrbPhase_column)."""
+    from pint_tpu.fits import read_events
+    from pint_tpu.scripts.photonphase import main
+
+    out = tmp_path / "orb.fits"
+    assert main([
+        os.path.join(REFDATA, "J0218_nicer_2070030405_cleanfilt_cut_bary.evt"),
+        os.path.join(REFDATA, "PSR_J0218+4232.par"),
+        "--mission", "nicer", "--addorbphase",
+        "--outfile", str(out),
+    ]) == 0
+    hdr, dat = read_events(str(out))
+    assert "PULSE_PHASE" in dat and "ORBIT_PHASE" in dat
+    op = np.asarray(dat["ORBIT_PHASE"])
+    t = np.asarray(dat["TIME"], np.float64)
+    assert np.all((op >= 0.0) & (op < 1.0))
+    # phases must advance at 1/PB: the observation spans
+    # (t_max - t_min)/PB of the 2.03-day orbit (regression: PB is
+    # stored in seconds internally — a day/second mixup gives a
+    # near-zero or absurd spread)
+    pb_s = 2.0288461 * 86400.0
+    expect_span = (t.max() - t.min()) / pb_s
+    span = np.ptp(op)
+    if expect_span < 0.5:  # no wrap expected
+        assert abs(span - expect_span) < 0.1 * max(expect_span, 0.01)
+
+
+def test_orbphase_exception():
+    """--addorbphase without a binary model raises (reference
+    test_OrbPhase_exception)."""
+    from pint_tpu.scripts.photonphase import main
+
+    with pytest.raises(ValueError, match="binary"):
+        main([os.path.join(REFDATA, "ngc300nicer_bary.evt"),
+              os.path.join(REFDATA, "ngc300nicer.par"),
+              "--mission", "nicer", "--addorbphase"])
+
+
 def test_absphase_required():
     """A par without TZR* raises ValueError (reference
     test_AbsPhase_exception)."""
