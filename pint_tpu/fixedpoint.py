@@ -132,8 +132,24 @@ def phase_f0_t(f0, t_ticks):
     f0: float64 Hz (quantized internally to 2^-52 Hz, exact for any IEEE
     f64 value >= 1.0 Hz); t_ticks: int64 ticks since the reference epoch.
     Returns (n: int64 integer turns, frac: float64 in [-0.5, 0.5)).
+
+    Out-of-range inputs POISON the result with NaN frac instead of
+    silently wrapping: the fixed-point representation holds f0 < 2^11 Hz
+    (freq_to_fix is a 2^52-scaled int64) and |F0*t| < ~2^43 turns (the
+    128-bit product carries 84 fraction bits).  Without the guard a
+    garbage F0 (e.g. a diverged fit step or a wild grid point) wraps
+    modulo 2^64 and can come back as a *perfect-looking* phase - chi2 0
+    at a nonsense parameter value.
     """
-    return phase_f0_t_raw(freq_to_fix(f0), t_ticks)
+    n, frac = phase_f0_t_raw(freq_to_fix(f0), t_ticks)
+    expect = f0 * ticks_to_seconds(t_ticks)
+    bad = (
+        ~jnp.isfinite(expect)
+        | (jnp.abs(expect) >= float(2**43))
+        | (f0 <= 0.0)
+        | (f0 >= 2048.0)
+    )
+    return jnp.where(bad, 0, n), jnp.where(bad, jnp.nan, frac)
 
 
 @phase_f0_t.defjvp

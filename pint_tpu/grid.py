@@ -19,7 +19,9 @@ import numpy as np
 
 from pint_tpu.residuals import Residuals
 
-__all__ = ["grid_chisq", "grid_chisq_vectorized", "make_grid_fn"]
+__all__ = ["grid_chisq", "grid_chisq_vectorized", "make_grid_fn",
+           "grid_chisq_tuple", "grid_chisq_derived",
+           "grid_chisq_derived_tuple"]
 
 
 def _make_fit_one(prepared, resids, grid_params, fit_params, n_steps):
@@ -111,10 +113,30 @@ def grid_chisq_vectorized(
     return np.asarray(chi2), np.asarray(fitted)
 
 
+def grid_chisq_tuple(toas, model, param_names, points, n_steps=3,
+                     chunk=None):
+    """chi^2 at an explicit list of parameter tuples instead of a dense
+    mesh (reference: gridutils.tuple_chisq, gridutils.py:588) — e.g.
+    the points of a Monte-Carlo scan or a confidence contour.
+
+    Failure semantics (reference WrappedFitter, gridutils.py:52-114,
+    which swallows per-point fit exceptions in the process pool): here
+    every point runs inside one vmapped XLA program, so a pathological
+    point cannot raise — a diverged refit or unphysical parameter
+    combination yields NaN/inf chi2 for that point only, which is the
+    same contract (inspect and mask downstream).
+
+    Returns (chi2 (npoints,), fitted free params (npoints, nfree))."""
+    return grid_chisq_vectorized(
+        toas, model, list(param_names), np.asarray(points, np.float64),
+        n_steps=n_steps, chunk=chunk)
+
+
 def grid_chisq(toas, model, param_names, param_arrays, n_steps=3,
                chunk=None):
     """Dense mesh grid like the reference API: param_arrays are 1-D axes;
-    returns chi2 with shape (len(axis1), len(axis2), ...)."""
+    returns chi2 with shape (len(axis1), len(axis2), ...).  Per-point
+    failure semantics: see grid_chisq_tuple."""
     axes = [np.asarray(a, dtype=np.float64) for a in param_arrays]
     mesh = np.array(list(itertools.product(*axes)))
     chi2, _ = grid_chisq_vectorized(
@@ -137,12 +159,22 @@ def grid_chisq_derived(toas, model, param_names, parfuncs, grid_arrays,
     Returns (chi2 shaped like the mesh, param_values (npoints, k))."""
     axes = [np.asarray(a, dtype=np.float64) for a in grid_arrays]
     mesh = np.array(list(itertools.product(*axes)))
-    # derived coords -> concrete parameter values per point (host side:
-    # arbitrary python/numpy functions are allowed, like the reference)
+    chi2, pvals = grid_chisq_derived_tuple(
+        toas, model, param_names, parfuncs, mesh, n_steps=n_steps,
+        chunk=chunk)
+    return chi2.reshape([len(a) for a in axes]), pvals
+
+
+def grid_chisq_derived_tuple(toas, model, param_names, parfuncs, points,
+                             n_steps=3, chunk=None):
+    """Derived-coordinate chi^2 at an explicit list of coordinate
+    tuples (reference: gridutils.tuple_chisq_derived, gridutils.py:773).
+    Returns (chi2 (npoints,), param_values (npoints, k))."""
+    pts = np.asarray(points, np.float64)
     pvals = np.stack(
-        [np.asarray([f(*pt) for pt in mesh], dtype=np.float64)
+        [np.asarray([f(*pt) for pt in pts], dtype=np.float64)
          for f in parfuncs], axis=1)
     chi2, _ = grid_chisq_vectorized(
         toas, model, list(param_names), pvals, n_steps=n_steps,
         chunk=chunk)
-    return (np.asarray(chi2).reshape([len(a) for a in axes]), pvals)
+    return np.asarray(chi2), pvals

@@ -103,3 +103,26 @@ def test_backend_f64_selftest_cpu():
     from pint_tpu.fixedpoint import backend_f64_is_ieee
 
     assert backend_f64_is_ieee() is True
+
+
+def test_overflow_poisons_nan():
+    """Out-of-range F0*t poisons frac with NaN instead of wrapping
+    (regression: a wild grid point wrapped mod 2^64 to a perfect-looking
+    phase and chi2 = 0)."""
+    import jax.numpy as jnp
+
+    from pint_tpu.fixedpoint import phase_f0_t, seconds_to_ticks_f64
+
+    t = seconds_to_ticks_f64(6e8)
+    # sane value stays finite
+    n, frac = phase_f0_t(700.0, t)
+    assert np.isfinite(float(frac))
+    for bad_f0 in (1e30, 5000.0, -1.0, np.nan):
+        n, frac = phase_f0_t(jnp.float64(bad_f0), t)
+        assert np.isnan(float(frac)), bad_f0
+    # within the representable tick range (|t| < 2^31 s) the turn
+    # capacity cannot overflow: 2048 Hz * 2^31 s = 2^42 < 2^43 turns,
+    # so the f0 bound alone is sufficient — the largest in-range
+    # product stays finite
+    n, frac = phase_f0_t(2047.0, seconds_to_ticks_f64(2.0**31 - 1))
+    assert np.isfinite(float(frac))

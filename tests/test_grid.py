@@ -63,3 +63,26 @@ def test_chunked_grid_matches():
         toas, m, ["F0", "F1"], pts, n_steps=2, chunk=4
     )
     np.testing.assert_allclose(c1, c2, rtol=1e-12)
+
+
+def test_tuple_variants():
+    """grid_chisq_tuple / grid_chisq_derived_tuple (reference
+    gridutils.py:588,773): explicit point lists, incl. derived
+    coordinates mapped through parfuncs."""
+    from pint_tpu.grid import grid_chisq_derived_tuple, grid_chisq_tuple
+
+    m, toas = _setup()
+    f0 = float(m.values["F0"])
+    f1 = float(m.values["F1"])
+    pts = [(f0, f1), (f0 + 2e-13, f1), (f0, f1 * 1.01)]
+    chi2, fitted = grid_chisq_tuple(toas, m, ["F0", "F1"], pts, n_steps=2)
+    assert chi2.shape == (3,)
+    assert chi2[0] <= chi2[1] + 1e-6  # truth at least as good
+    # derived: grid over dF0 offsets in units of 1e-13
+    chi2d, pvals = grid_chisq_derived_tuple(
+        toas, m, ["F0", "F1"],
+        [lambda k: f0 + k * 1e-13, lambda k: f1],
+        [(0.0,), (2.0,)], n_steps=2)
+    np.testing.assert_allclose(chi2d[0], chi2[0], rtol=1e-10)
+    np.testing.assert_allclose(chi2d[1], chi2[1], rtol=1e-10)
+    np.testing.assert_allclose(pvals[1, 0], f0 + 2e-13)
