@@ -24,8 +24,14 @@ def main(argv=None):
                    "--ngauss gaussian seed template to the folded phases")
     p.add_argument("--ngauss", type=int, default=2,
                    help="gaussian components for the seed template")
+    p.add_argument("--minWeight", type=float, default=0.0,
+                   help="drop photons with -weight below this "
+                        "(reference event_optimize minWeight)")
     p.add_argument("--nwalkers", type=int, default=32)
     p.add_argument("--nsteps", type=int, default=500)
+    p.add_argument("--burnin", type=int, default=None,
+                   help="steps discarded before uncertainty estimation "
+                        "(default nsteps/4)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--fit-template", action="store_true")
     p.add_argument("-o", "--outpar", default=None)
@@ -49,6 +55,11 @@ def main(argv=None):
                            weights=weightcol,
                            ephem=model.meta.get("EPHEM", "builtin"))
     print(f"Read {len(toas)} events")
+    if args.minWeight > 0.0:
+        w = np.array(toas.get_flag_values("weight", default=1.0,
+                                          astype=float))
+        toas = toas[w >= args.minWeight]
+        print(f"Kept {len(toas)} events with weight >= {args.minWeight}")
     if args.template:
         template = read_template(args.template)
     else:
@@ -63,8 +74,15 @@ def main(argv=None):
         LCFitter(template, phases).fit()
     fitter = MCMCFitter(toas, model, template,
                         fit_template=args.fit_template)
+    if args.nsteps <= 0:
+        raise SystemExit("--nsteps must be positive")
+    if args.burnin is not None and not 0 <= args.burnin < args.nsteps:
+        raise SystemExit(
+            f"--burnin must be in [0, nsteps={args.nsteps})")
+    burn_frac = (args.burnin / args.nsteps if args.burnin is not None
+                 else 0.25)
     lnp = fitter.fit_toas(nwalkers=args.nwalkers, nsteps=args.nsteps,
-                          seed=args.seed)
+                          seed=args.seed, burn_frac=burn_frac)
     print(f"max-posterior lnL = {lnp:.2f}")
     for name in fitter.param_names:
         print(f"  {name} = {model.values[name]!r} "

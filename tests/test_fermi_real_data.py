@@ -91,8 +91,8 @@ def test_event_optimize_real_data(tmp_path, fermi_toas):
     out = tmp_path / "out.par"
     rc = main([FT1, PAR, "--mission", "fermi",
                "--weightcol", "PSRJ0030+0451",
-               "--template", TEMPLATE,
-               "--nwalkers", "10", "--nsteps", "50",
+               "--template", TEMPLATE, "--minWeight", "0.9",
+               "--nwalkers", "10", "--nsteps", "50", "--burnin", "10",
                "-o", str(out)])
     assert rc == 0
     text = out.read_text()
