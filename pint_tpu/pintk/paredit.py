@@ -38,6 +38,7 @@ class ParEditor:
         self.psr.model = model
         self.psr.model_init = copy.deepcopy(model)
         self.psr.fitted = False
+        self.psr._bump()
         return model
 
     def load(self, path):

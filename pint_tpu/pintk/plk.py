@@ -82,6 +82,9 @@ class PlkWidget:
         self.xaxis = tk.StringVar(value="mjd")
         tk.OptionMenu(ctrl, self.xaxis, *self.psr.XAXIS_CHOICES,
                       command=lambda *_: self.update_plot()).pack(side="left")
+        self.yaxis = tk.StringVar(value="residual (us)")
+        tk.OptionMenu(ctrl, self.yaxis, *self.psr.YAXIS_CHOICES,
+                      command=lambda *_: self.update_plot()).pack(side="left")
         self.colormode = tk.StringVar(value="default")
         tk.OptionMenu(ctrl, self.colormode, *sorted(COLOR_MODES),
                       command=lambda *_: self.update_plot()).pack(side="left")
@@ -155,6 +158,10 @@ class PlkWidget:
         if not self.psr.fitted:
             self.status.config(text="fit first")
             return
+        if self.yaxis.get() != "residual (us)":
+            self.status.config(
+                text="random-model envelopes draw in residual (us) view")
+            return
         spread = self.psr.random_models(16)
         x = self.psr.xaxis(self.xaxis.get())
         order = np.argsort(x)
@@ -216,9 +223,7 @@ class PlkWidget:
     def _on_box(self, eclick, erelease):
         """Right-drag box selection (reference plk area select)."""
         x = self.psr.xaxis(self.xaxis.get())
-        r = (self.psr.postfit_resids() if self.psr.fitted
-             else self.psr.prefit_resids())
-        res = np.asarray(r.time_resids) * 1e6
+        res, _, _ = self.psr.yvals(self.yaxis.get())
         x0, x1 = sorted((eclick.xdata, erelease.xdata))
         y0, y1 = sorted((eclick.ydata, erelease.ydata))
         inside = (x >= x0) & (x <= x1) & (res >= y0) & (res <= y1)
@@ -249,14 +254,12 @@ class PlkWidget:
     # -- drawing ----------------------------------------------------------------
     def update_plot(self):
         self.ax.clear()
-        r = (self.psr.postfit_resids() if self.psr.fitted
-             else self.psr.prefit_resids())
         x = self.psr.xaxis(self.xaxis.get())
-        res = np.asarray(r.time_resids) * 1e6
-        err = np.asarray(r.scaled_errors) * 1e6
+        res, err, ylabel = self.psr.yvals(self.yaxis.get())
         colors, legend = get_color_mode(self.colormode.get()).colors(self.psr)
-        self.ax.errorbar(x, res, yerr=err, fmt="none", ecolor="#cccccc",
-                         zorder=1)
+        if err is not None:
+            self.ax.errorbar(x, res, yerr=err, fmt="none",
+                             ecolor="#cccccc", zorder=1)
         self.ax.scatter(x, res, c=colors, s=16, zorder=2)
         if len(legend) > 1:
             import matplotlib.lines as mlines
@@ -271,7 +274,7 @@ class PlkWidget:
             self.ax.plot(x[sel], res[sel], "o", mfc="none", mec="red",
                          ms=9, zorder=3)
         self.ax.set_xlabel(self.xaxis.get())
-        self.ax.set_ylabel("residual [us]")
+        self.ax.set_ylabel(ylabel)
         self.ax.set_title(
             ("post-fit" if self.psr.fitted else "pre-fit")
             + f"  ({len(res)} TOAs)")

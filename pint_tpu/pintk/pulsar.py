@@ -38,6 +38,13 @@ class Pulsar:
         self.fitted = False
         self._postfit = None
         self._undo_stack = []
+        #: bumped on every state mutation; keys the prefit-residuals
+        #: memo so GUI redraws don't re-prepare/re-jit the model
+        self._state_version = 0
+        self._prefit_cache = None
+
+    def _bump(self):
+        self._state_version += 1
 
     # -- selection ------------------------------------------------------------
     @property
@@ -51,11 +58,13 @@ class Pulsar:
         self._undo_stack.append(("deleted", self.deleted.copy()))
         self.deleted[np.asarray(indices, dtype=int)] = True
         self.fitted = False
+        self._bump()
 
     def restore_all(self):
         self._undo_stack.append(("deleted", self.deleted.copy()))
         self.deleted[:] = False
         self.fitted = False
+        self._bump()
 
     def undo(self):
         """Undo the most recent deletion / restore / phase wrap
@@ -73,6 +82,7 @@ class Pulsar:
                 else:
                     self.all_toas.flags[i]["padd"] = old
         self.fitted = False
+        self._bump()
         return kind
 
     # -- phase wraps (reference pulsar.py add_phase_wrap: integer turns
@@ -89,6 +99,7 @@ class Pulsar:
             f["padd"] = repr(float(f.get("padd", 0.0)) + float(wrap))
         self._undo_stack.append(("padd", prior))
         self.fitted = False
+        self._bump()
 
     # -- parameters -----------------------------------------------------------
     def fit_params(self):
@@ -121,6 +132,7 @@ class Pulsar:
                              description="GUI phase jump"))
         self.model.values[name] = 0.0
         self.fitted = False
+        self._bump()
         return name
 
     # -- fitting ---------------------------------------------------------------
@@ -156,11 +168,13 @@ class Pulsar:
         self.model = self.fitter.model
         self._postfit = Residuals(toas, self.model)
         self.fitted = True
+        self._bump()
         return self.fitter
 
     def reset_model(self):
         self.model = copy.deepcopy(self.model_init)
         self.fitted = False
+        self._bump()
 
     def write_par(self, path):
         with open(path, "w") as f:
@@ -173,12 +187,26 @@ class Pulsar:
 
     # -- residual views ---------------------------------------------------------
     def prefit_resids(self):
-        return Residuals(self.selected_toas, self.model_init)
+        """Pre-fit residuals, memoized on the state version (redraws
+        would otherwise re-prepare + re-jit the model every time)."""
+        if (self._prefit_cache is None
+                or self._prefit_cache[0] != self._state_version):
+            self._prefit_cache = (
+                self._state_version,
+                Residuals(self.selected_toas, self.model_init),
+            )
+        return self._prefit_cache[1]
 
     def postfit_resids(self):
         if not self.fitted:
             raise ValueError("not fitted yet")
         return self._postfit
+
+    def active_resids(self):
+        """The residuals the GUI is displaying: post-fit when fitted,
+        else pre-fit — all y-axis views derive from this one object so
+        they cannot mix models."""
+        return self.postfit_resids() if self.fitted else self.prefit_resids()
 
     def xaxis(self, kind="mjd"):
         toas = self.selected_toas
@@ -211,6 +239,30 @@ class Pulsar:
 
     XAXIS_CHOICES = ("mjd", "year", "day of year", "serial",
                      "orbital phase", "frequency", "TOA error")
+
+    YAXIS_CHOICES = ("residual (us)", "residual (phase)", "pulse number")
+
+    def yvals(self, kind="residual (us)"):
+        """(values, errors-or-None, label) for the plk y axis
+        (reference plk y-axis choices).  All views derive from
+        ``active_resids()`` — one Residuals object, one model."""
+        r = self.active_resids()
+        if kind == "residual (us)":
+            res = np.asarray(r.time_resids) * 1e6
+            return res, np.asarray(r.scaled_errors) * 1e6, "residual [us]"
+        if kind == "residual (phase)":
+            res = np.asarray(r.phase_resids)
+            f0 = float(r.model.values["F0"])
+            return (res, np.asarray(r.scaled_errors) * f0,
+                    "residual [turns]")
+        if kind == "pulse number":
+            # same model + cached jit as the residual views; -padd
+            # wraps shift the displayed counts like they shift phase
+            n, _ = r.prepared.phase()
+            n = np.asarray(n, dtype=np.float64)
+            dpn = self.selected_toas.get_delta_pulse_numbers()
+            return n + dpn, None, "pulse number"
+        raise ValueError(f"unknown y-axis {kind!r}")
 
     def random_models(self, n=16):
         """Residual spread envelope from the post-fit covariance

@@ -53,6 +53,7 @@ class TimEditor:
         # undo entries index the old TOA set; they cannot survive a swap
         self.psr._undo_stack.clear()
         self.psr.fitted = False
+        self.psr._bump()
         return toas
 
     def load(self, path):

@@ -107,6 +107,29 @@ class TestPulsarWrapper:
         with pytest.raises(ValueError):
             psr.fit(method="bogus")
 
+    def test_yaxis_views(self, psr):
+        psr.reset_model()
+        n = len(psr.selected_toas)
+        res_us, err_us, lab = psr.yvals("residual (us)")
+        res_ph, err_ph, _ = psr.yvals("residual (phase)")
+        assert res_us.shape == (n,) and lab == "residual [us]"
+        f0 = float(psr.model.values["F0"])
+        np.testing.assert_allclose(res_ph, res_us * 1e-6 * f0, rtol=2e-2,
+                                   atol=1e-6)
+        pn, none_err, _ = psr.yvals("pulse number")
+        assert none_err is None
+        # pulse counts advance at ~F0: span ~ F0 * (t_max - t_min)
+        mjd = np.asarray(psr.selected_toas.mjd_float)
+        expect = f0 * (mjd.max() - mjd.min()) * 86400.0
+        assert abs(np.ptp(pn) - expect) < 1e-3 * expect
+        # -padd wraps shift the displayed counts
+        psr.add_phase_wrap([0], +3)
+        pn2, _, _ = psr.yvals("pulse number")
+        np.testing.assert_allclose(pn2[0] - pn[0], 3.0, atol=1e-9)
+        psr.undo()
+        with pytest.raises(ValueError):
+            psr.yvals("nope")
+
     def test_day_of_year_axis(self, psr):
         doy = psr.xaxis("day of year")
         assert np.all((doy >= 1.0) & (doy < 367.0))
