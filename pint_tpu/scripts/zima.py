@@ -23,26 +23,70 @@ def main(argv=None):
     p.add_argument("--error", type=float, default=1.0,
                    help="TOA uncertainty [us]")
     p.add_argument("--addnoise", action="store_true")
+    p.add_argument("--addcorrnoise", action="store_true",
+                   help="add a correlated-noise realization from the "
+                        "model's ECORR/red/DM noise components")
     p.add_argument("--wideband", action="store_true")
     p.add_argument("--dmerror", type=float, default=1e-4)
+    p.add_argument("--inputtim", default=None,
+                   help="simulate at this tim file's epochs/freqs/"
+                        "errors instead of a uniform span")
+    p.add_argument("--fuzzdays", type=float, default=0.0,
+                   help="jitter the uniform spacing by N(0, fuzzdays)")
+    p.add_argument("--multifreq", action="store_true",
+                   help="one TOA per --freq value at every epoch")
+    p.add_argument("--plot", default=None, metavar="FILE",
+                   help="write a residual plot of the simulated TOAs")
     p.add_argument("--seed", type=int, default=None)
     args = p.parse_args(argv)
 
     from pint_tpu.models import get_model
-    from pint_tpu.simulation import make_fake_toas_uniform
+    from pint_tpu.simulation import (
+        make_fake_toas_fromtim,
+        make_fake_toas_uniform,
+    )
     from pint_tpu.toa import write_tim
 
     model = get_model(args.parfile)
-    freqs = np.array(args.freq)[np.arange(args.ntoa) % len(args.freq)]
-    toas = make_fake_toas_uniform(
-        args.startMJD, args.startMJD + args.duration, args.ntoa, model,
-        freq_mhz=freqs, obs=args.obs, error_us=args.error,
-        add_noise=args.addnoise, wideband=args.wideband,
-        dm_error=args.dmerror,
-        rng=np.random.default_rng(args.seed),
-    )
+    rng = np.random.default_rng(args.seed)
+    if args.inputtim:
+        toas = make_fake_toas_fromtim(
+            args.inputtim, model, add_noise=args.addnoise,
+            wideband=args.wideband, dm_error=args.dmerror,
+            add_correlated=args.addcorrnoise, rng=rng,
+        )
+    else:
+        freqs = (np.asarray(args.freq) if args.multifreq else
+                 np.array(args.freq)[np.arange(args.ntoa) % len(args.freq)])
+        toas = make_fake_toas_uniform(
+            args.startMJD, args.startMJD + args.duration, args.ntoa,
+            model, freq_mhz=freqs, obs=args.obs, error_us=args.error,
+            add_noise=args.addnoise, wideband=args.wideband,
+            dm_error=args.dmerror, fuzz_days=args.fuzzdays,
+            multifreq=args.multifreq, add_correlated=args.addcorrnoise,
+            rng=rng,
+        )
     write_tim(toas, args.timfile)
     print(f"wrote {len(toas)} simulated TOAs to {args.timfile}")
+    if args.plot:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        from pint_tpu.residuals import Residuals
+
+        # nearest tracking pinned: fake TOAs carry no -pn flags, and a
+        # TRACK -2 par must not crash the plot (same as zero_residuals)
+        r = Residuals(toas, model, track_mode="nearest")
+        fig, ax = plt.subplots()
+        ax.errorbar(np.asarray(toas.mjd_float),
+                    np.asarray(r.time_resids) * 1e6,
+                    yerr=np.asarray(r.scaled_errors) * 1e6, fmt=".")
+        ax.set_xlabel("MJD")
+        ax.set_ylabel("residual [us]")
+        fig.savefig(args.plot)
+        print(f"wrote {args.plot}")
     return 0
 
 

@@ -14,8 +14,9 @@ import numpy as np
 from pint_tpu.residuals import Residuals
 from pint_tpu.toa import TOA, TOAs
 
-__all__ = ["make_fake_toas_uniform", "zero_residuals",
-           "calculate_random_models"]
+__all__ = ["make_fake_toas_uniform", "make_fake_toas_fromMJDs",
+           "make_fake_toas_fromtim", "add_correlated_noise",
+           "zero_residuals", "calculate_random_models"]
 
 
 def zero_residuals(toas: TOAs, model, iterations=2):
@@ -46,6 +47,9 @@ def make_fake_toas_uniform(
     wideband=False,
     dm_error=1e-4,
     flags=None,
+    fuzz_days=0.0,
+    multifreq=False,
+    add_correlated=False,
 ):
     """Evenly-spaced TOAs with zero residuals under ``model``
     (+ optional white noise scaled by the TOA errors).  ``flags`` is an
@@ -55,8 +59,45 @@ def make_fake_toas_uniform(
     ``wideband=True`` attaches ``-pp_dm``/``-pp_dme`` flags carrying the
     model's total DM (+ noise when add_noise) with uncertainty
     ``dm_error`` [pc cm^-3] (reference: update_fake_dms,
-    simulation.py:183)."""
+    simulation.py:183).  ``fuzz_days`` jitters the even spacing
+    (reference zima --fuzzdays); ``multifreq=True`` emits one TOA per
+    frequency at every epoch instead of cycling (reference zima
+    --multifreq); ``add_correlated=True`` adds a realization of the
+    model's correlated-noise components (reference
+    make_fake_toas_uniform add_correlated_noise path)."""
     mjds = np.linspace(float(start_mjd), float(end_mjd), int(ntoas))
+    if fuzz_days:
+        rng = rng or np.random.default_rng(0)
+        fuzz = rng.normal(0.0, float(fuzz_days), int(ntoas))
+        mjds = np.sort(np.clip(mjds + fuzz, float(start_mjd),
+                               float(end_mjd)))
+    if multifreq:
+        nf = np.atleast_1d(np.asarray(freq_mhz, np.float64)).size
+        mjds = np.repeat(mjds, nf)
+        freq_mhz = np.tile(np.atleast_1d(np.asarray(freq_mhz)), int(ntoas))
+    return make_fake_toas_fromMJDs(
+        mjds, model, freq_mhz=freq_mhz, obs=obs, error_us=error_us,
+        add_noise=add_noise, rng=rng, wideband=wideband,
+        dm_error=dm_error, flags=flags, add_correlated=add_correlated)
+
+
+def make_fake_toas_fromMJDs(
+    mjds,
+    model,
+    freq_mhz=1400.0,
+    obs="@",
+    error_us=1.0,
+    add_noise=False,
+    rng=None,
+    wideband=False,
+    dm_error=1e-4,
+    flags=None,
+    add_correlated=False,
+):
+    """Zero-residual TOAs at explicit MJDs (reference:
+    make_fake_toas_fromMJDs, simulation.py:353)."""
+    mjds = np.asarray(mjds, dtype=np.float64)
+    ntoas = len(mjds)
     freqs = np.broadcast_to(np.asarray(freq_mhz, dtype=np.float64), (ntoas,))
     flags = dict(flags or {})
     toa_list = []
@@ -72,9 +113,18 @@ def make_fake_toas_uniform(
     toas = TOAs(toa_list, ephem=model.meta.get("EPHEM", "builtin"),
                 planets=planets)
     zero_residuals(toas, model)
+    return _apply_noise_products(toas, model, add_noise, wideband,
+                                 dm_error, add_correlated, rng)
+
+
+def _apply_noise_products(toas, model, add_noise, wideband, dm_error,
+                          add_correlated, rng):
+    """Shared fake-TOA post-processing: white noise (scaled by each
+    TOA's own error), wideband -pp_dm/-pp_dme flags, correlated
+    realization."""
     if add_noise:
         rng = rng or np.random.default_rng(0)
-        noise = rng.standard_normal(int(ntoas)) * error_us * 1e-6
+        noise = rng.standard_normal(len(toas)) * toas.error_us * 1e-6
         toas.ticks = toas.ticks + np.round(noise * 2**32).astype(np.int64)
         toas._compute_posvels()
     if wideband:
@@ -84,11 +134,54 @@ def make_fake_toas_uniform(
         )
         if add_noise:
             rng = rng or np.random.default_rng(0)
-            dm = dm + rng.standard_normal(int(ntoas)) * dm_error
+            dm = dm + rng.standard_normal(len(toas)) * dm_error
         for i, f in enumerate(toas.flags):
             f["pp_dm"] = repr(float(dm[i]))
             f["pp_dme"] = repr(float(dm_error))
+    if add_correlated:
+        add_correlated_noise(toas, model, rng=rng)
     return toas
+
+
+def add_correlated_noise(toas: TOAs, model, rng=None):
+    """Add one realization of the model's correlated-noise components
+    (ECORR / red / DM noise) to the TOA ticks (reference:
+    simulation.py add_correlated_noise): draw c = U @ (sqrt(phi) * z)
+    with z ~ N(0, 1) over the noise basis U and weights phi.  Raises
+    when the model has no correlated components (like the reference) —
+    a silent no-op would let --addcorrnoise lie about its output."""
+    if not model.has_correlated_errors:
+        raise ValueError(
+            "add_correlated_noise: the model has no correlated-noise "
+            "components (ECORR / red / DM / chromatic noise)")
+    r = Residuals(toas, model, subtract_mean=False,
+                  track_mode="nearest")
+    values = r._values()
+    U = np.asarray(r.prepared.noise_basis)
+    phi = np.asarray(r.prepared.noise_weights_fn(values))
+    rng = rng or np.random.default_rng(0)
+    z = rng.standard_normal(U.shape[1])
+    noise_sec = U @ (np.sqrt(np.maximum(phi, 0.0)) * z)
+    toas.ticks = toas.ticks + np.round(
+        noise_sec * 2**32).astype(np.int64)
+    toas._compute_posvels()
+    return toas
+
+
+def make_fake_toas_fromtim(timfile, model, add_noise=False, rng=None,
+                           wideband=False, dm_error=1e-4,
+                           add_correlated=False):
+    """Zero-residual TOAs at the epochs/frequencies/errors/observatories
+    of an existing tim file (reference: make_fake_toas_fromtim,
+    simulation.py:481) — the standard way to simulate a dataset with a
+    real observing cadence."""
+    from pint_tpu.toa import get_TOAs
+
+    toas = get_TOAs(timfile, ephem=model.meta.get("EPHEM", "builtin"),
+                    planets=bool(model.values.get("PLANET_SHAPIRO", 0.0)))
+    zero_residuals(toas, model)
+    return _apply_noise_products(toas, model, add_noise, wideband,
+                                 dm_error, add_correlated, rng)
 
 
 def calculate_random_models(fitter, toas, n_models=100, rng=None,

@@ -149,3 +149,62 @@ class TestScripts:
         out = capsys.readouterr().out
         assert r"\begin{table}" in out
         assert "Characteristic age" in out
+
+
+def test_zima_inputtim_fuzz_corrnoise(tmp_path):
+    """zima --inputtim/--fuzzdays/--multifreq/--addcorrnoise/--plot
+    (reference zima options) drive the new simulation paths."""
+    import numpy as np
+
+    from pint_tpu.scripts.zima import main as zima
+
+    par = tmp_path / "m.par"
+    par.write_text(
+        "PSR FAKE\nRAJ 05:00:00\nDECJ 20:00:00\nF0 100.0 1\n"
+        "PEPOCH 56000\nDM 10.0\nTZRMJD 56000\nTZRFRQ 1400\nTZRSITE @\n"
+        "EFAC -f fake 1.0\nECORR -f fake 0.5\n"
+        "TNRedAmp -13.5\nTNRedGam 3.0\nTNRedC 10\n"
+    )
+    t1 = tmp_path / "a.tim"
+    assert zima([str(par), str(t1), "--ntoa", "30", "--fuzzdays", "0.5",
+                 "--multifreq", "--freq", "800", "1400",
+                 "--addnoise", "--addcorrnoise", "--seed", "7",
+                 "--plot", str(tmp_path / "r.png")]) == 0
+    text = t1.read_text()
+    assert len([ln for ln in text.splitlines()
+                if ln and not ln.startswith(("FORMAT", "C ", "MODE"))]) == 60
+    assert (tmp_path / "r.png").stat().st_size > 0
+    # resimulate at the same epochs
+    t2 = tmp_path / "b.tim"
+    assert zima([str(par), str(t2), "--inputtim", str(t1)]) == 0
+    from pint_tpu.toa import get_TOAs
+    from pint_tpu.residuals import Residuals
+    from pint_tpu.models import get_model
+
+    m = get_model(str(par))
+    toas = get_TOAs(str(t2))
+    assert len(toas) == 60
+    r = Residuals(toas, m, subtract_mean=False, track_mode="nearest")
+    assert np.max(np.abs(np.asarray(r.time_resids))) < 5e-9  # zeroed
+
+
+def test_add_correlated_noise_has_structure():
+    """The correlated realization is dominated by the red-noise basis:
+    neighboring-TOA differences are much smaller than the overall
+    spread (a white realization would have comparable scatter)."""
+    import numpy as np
+
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import add_correlated_noise, make_fake_toas_uniform
+
+    par = ("PSR FAKE\nRAJ 05:00:00\nDECJ 20:00:00\nF0 100.0\n"
+           "PEPOCH 56000\nDM 10.0\nTZRMJD 56000\nTZRFRQ 1400\nTZRSITE @\n"
+           "TNRedAmp -12.0\nTNRedGam 5.0\nTNRedC 20\n")
+    m = get_model(par)
+    toas = make_fake_toas_uniform(56000, 57000, 200, m, error_us=0.01)
+    ticks0 = toas.ticks.copy()
+    add_correlated_noise(toas, m, rng=np.random.default_rng(5))
+    dt = (toas.ticks - ticks0) / 2**32
+    assert np.std(dt) > 1e-8  # a visible realization
+    rough = np.std(np.diff(dt)) / np.std(dt)
+    assert rough < 0.5  # smooth (steep red spectrum), not white
