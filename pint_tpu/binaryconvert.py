@@ -14,6 +14,7 @@ input covariance would drop in for free.
 
 from __future__ import annotations
 
+import warnings
 from typing import Dict
 
 import jax
@@ -107,11 +108,18 @@ def _get(model, name, default=0.0):
         if name in model.params else None
 
 
-def convert_binary(model: TimingModel, output: str) -> TimingModel:
+def convert_binary(model: TimingModel, output: str, nharms=None,
+                   use_stigma=False, kom_deg=None) -> TimingModel:
     """Return a new TimingModel with the binary component converted to
     the ``output`` parameterization (reference: convert_binary,
     binaryconvert.py:544).  Conversion is done at the par level: the
-    non-binary part round-trips untouched."""
+    non-binary part round-trips untouched.
+
+    ELL1H extras (reference NHARMS/useSTIGMA args): ``nharms`` emits an
+    NHARMS line; ``use_stigma=True`` emits STIGMA instead of H4.
+    DDK extra: ``kom_deg`` supplies the longitude of the ascending node
+    (not derivable from any other parameterization); KIN is derived
+    from SINI."""
     output = output.upper()
     current = model.meta.get("BINARY", "").upper()
     if not current:
@@ -124,7 +132,7 @@ def convert_binary(model: TimingModel, output: str) -> TimingModel:
     strip = {
         "BINARY", "ECC", "OM", "T0", "TASC", "EPS1", "EPS2", "EPS1DOT",
         "EPS2DOT", "EDOT", "OMDOT", "M2", "SINI", "SHAPMAX", "H3", "H4",
-        "STIGMA", "NHARMS", "LNEDOT", "MTOT",
+        "STIGMA", "NHARMS", "LNEDOT", "MTOT", "KIN", "KOM", "K96",
     }
     for line in model.as_parfile().splitlines():
         key = line.split()[0].upper() if line.split() else ""
@@ -222,31 +230,21 @@ def convert_binary(model: TimingModel, output: str) -> TimingModel:
     ortho_out = output in ("ELL1H", "DDH")
     m2, um2 = _get(model, "M2")
     sini, usini = _get(model, "SINI")
-    if output == "DDS":
-        if sini > 0:
-            out, uncs = _propagate(_sini_to_shapmax, [sini], [usini])
-            emit("SHAPMAX", out[0], uncs[0], "SINI" in fitset)
-        if m2 != 0:
-            emit("M2", m2, um2, "M2" in fitset)
-    elif current == "DDS" and not ortho_out:
+    sini_fit = "SINI" in fitset
+    if current == "DDK":
+        # DDK carries the inclination as KIN (radians internally)
+        kin, ukin = _get(model, "KIN")
+        if kin != 0:
+            sini = float(np.sin(kin))
+            usini = (abs(np.cos(kin)) * ukin) if ukin else None
+            sini_fit = "KIN" in fitset
+    elif current == "DDS":
         shapmax, ush = _get(model, "SHAPMAX")
         if shapmax != 0:
             out, uncs = _propagate(_shapmax_to_sini, [shapmax], [ush])
-            emit("SINI", out[0], uncs[0], "SHAPMAX" in fitset)
-        if m2 != 0:
-            emit("M2", m2, um2, "M2" in fitset)
-    elif ortho_out and not ortho_in:
-        if m2 != 0 and sini != 0:
-            out, uncs = _propagate(
-                _m2sini_to_orthometric, [m2, sini], [um2, usini]
-            )
-            h3, h4, stigma = out
-            emit("H3", h3, uncs[0], "M2" in fitset)
-            if output == "ELL1H":
-                emit("H4", h4, uncs[1], "SINI" in fitset)
-            else:
-                emit("STIGMA", stigma, uncs[2], "SINI" in fitset)
-    elif ortho_in and not ortho_out:
+            sini, usini = out[0], uncs[0]
+            sini_fit = "SHAPMAX" in fitset
+    elif ortho_in:
         h3, uh3 = _get(model, "H3")
         stigma, ust = _get(model, "STIGMA")
         if stigma == 0.0:
@@ -257,17 +255,51 @@ def convert_binary(model: TimingModel, output: str) -> TimingModel:
             out, uncs = _propagate(
                 _orthometric_to_m2sini, [h3, stigma], [uh3, ust]
             )
-            emit("M2", out[0], uncs[0], "H3" in fitset)
-            emit("SINI", out[1], uncs[1], "STIGMA" in fitset)
+            m2, um2 = out[0], uncs[0]
+            sini, usini = out[1], uncs[1]
+            sini_fit = "STIGMA" in fitset or "H4" in fitset
+
+    # (m2, sini) now hold the effective Shapiro pair whatever the input
+    # parameterization; emit the output's own representation
+    if output == "DDS":
+        if sini > 0:
+            out, uncs = _propagate(_sini_to_shapmax, [sini], [usini])
+            emit("SHAPMAX", out[0], uncs[0], sini_fit)
+        if m2 != 0:
+            emit("M2", m2, um2, "M2" in fitset)
+    elif ortho_out:
+        if m2 != 0 and sini != 0:
+            out, uncs = _propagate(
+                _m2sini_to_orthometric, [m2, sini], [um2, usini]
+            )
+            h3, h4, stigma = out
+            emit("H3", h3, uncs[0], "M2" in fitset)
+            if output == "ELL1H" and not use_stigma:
+                emit("H4", h4, uncs[1], sini_fit)
+            else:
+                emit("STIGMA", stigma, uncs[2], sini_fit)
+        if output == "ELL1H" and nharms is not None:
+            par_lines.append(f"NHARMS {int(nharms)}")
+    elif output == "DDK":
+        if m2 != 0:
+            emit("M2", m2, um2, "M2" in fitset)
+        # KIN from the effective SINI (DT92 convention); KOM is not
+        # derivable from any other parameterization
+        if sini != 0:
+            kin = np.degrees(np.arcsin(min(sini, 1.0)))
+            emit("KIN", kin, None, sini_fit)
+        if kom_deg is None:
+            warnings.warn(
+                "convert_binary: DDK needs KOM (ascending-node "
+                "longitude), which no other parameterization carries; "
+                "writing KOM 0 — supply kom_deg/--kom for real use")
+        emit("KOM", float(kom_deg) if kom_deg is not None else 0.0,
+             None, False)
     else:
         if m2 != 0:
             emit("M2", m2, um2, "M2" in fitset)
-        if sini != 0 and output not in ("DDGR",):
-            emit("SINI", sini, usini, "SINI" in fitset)
-        for name in ("H3", "H4", "STIGMA", "SHAPMAX"):
-            v, u = _get(model, name)
-            if v != 0:
-                emit(name, v, u, name in fitset)
+        if sini != 0 and output != "DDGR":
+            emit("SINI", sini, usini, sini_fit)
 
     if output == "DDGR" and "MTOT" in model.values:
         v, u = _get(model, "MTOT")

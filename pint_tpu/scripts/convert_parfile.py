@@ -15,6 +15,12 @@ def main(argv=None):
                    help="output par (default stdout)")
     p.add_argument("--binary", default=None,
                    help="convert binary model (e.g. ELL1, DD, DDS)")
+    p.add_argument("--nharms", type=int, default=None,
+                   help="NHARMS to write (ELL1H output only)")
+    p.add_argument("--usestigma", action="store_true",
+                   help="emit STIGMA instead of H4 (ELL1H output only)")
+    p.add_argument("--kom", type=float, default=None,
+                   help="longitude of ascending node [deg] (DDK output)")
     p.add_argument("--allow-tcb", action="store_true")
     args = p.parse_args(argv)
 
@@ -24,7 +30,9 @@ def main(argv=None):
     if args.binary:
         from pint_tpu.binaryconvert import convert_binary
 
-        model = convert_binary(model, args.binary)
+        model = convert_binary(model, args.binary, nharms=args.nharms,
+                               use_stigma=args.usestigma,
+                               kom_deg=args.kom)
     text = model.as_parfile()
     if args.out:
         with open(args.out, "w") as f:

@@ -296,3 +296,43 @@ class TestObservability:
         rep = st.report(file=buf)
         assert "stage A" in rep and "stage B" in rep
         assert st.as_dict()["stage A"] >= 0.0
+
+
+def test_convert_binary_options():
+    """ELL1H nharms/use_stigma and DDK KIN/KOM emission (reference
+    convert_binary NHARMS/useSTIGMA/KOM arguments)."""
+    import numpy as np
+
+    from pint_tpu.binaryconvert import convert_binary
+    from pint_tpu.models import get_model
+
+    par = ("PSR FAKE\nRAJ 05:00:00\nDECJ 20:00:00\nF0 100.0\nPEPOCH 56000\n"
+           "DM 10.0\nTZRMJD 56000\nTZRFRQ 1400\nTZRSITE @\nBINARY ELL1\n"
+           "PB 10.0\nA1 5.0\nTASC 56000.0\nEPS1 1e-6\nEPS2 2e-6\n"
+           "M2 0.3\nSINI 0.95\n")
+    m = get_model(par)
+    h = convert_binary(m, "ELL1H", nharms=7, use_stigma=True)
+    assert h.meta["BINARY"] == "ELL1H"
+    assert "STIGMA" in h.values and "H4" not in h.values
+    assert int(float(h.values.get("NHARMS", h.meta.get("NHARMS", 0)))) == 7
+    d = convert_binary(get_model(par), "DD")
+    k = convert_binary(d, "DDK", kom_deg=42.0)
+    assert "KIN" in k.values and "KOM" in k.values
+    np.testing.assert_allclose(np.degrees(float(k.values["KOM"])), 42.0,
+                               atol=1e-9)
+    np.testing.assert_allclose(np.degrees(float(k.values["KIN"])),
+                               np.degrees(np.arcsin(0.95)), rtol=1e-6)
+    assert "SINI" not in k.values or float(k.values["SINI"]) == 0.0
+    # DDK -> DD: KIN maps back to SINI, no KIN/KOM leakage
+    back = convert_binary(k, "DD")
+    assert "KIN" not in back.values and "KOM" not in back.values
+    np.testing.assert_allclose(float(back.values["SINI"]), 0.95,
+                               rtol=1e-6)
+    # orthometric -> DDK goes through the effective (M2, SINI)
+    k2 = convert_binary(h, "DDK", kom_deg=10.0)
+    np.testing.assert_allclose(np.degrees(float(k2.values["KIN"])),
+                               np.degrees(np.arcsin(0.95)), rtol=1e-4)
+    # DDK without kom warns and writes 0
+    with pytest.warns(UserWarning, match="KOM"):
+        k3 = convert_binary(d, "DDK")
+    assert float(k3.values["KOM"]) == 0.0
