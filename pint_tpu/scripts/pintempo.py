@@ -22,33 +22,47 @@ def main(argv=None):
     p.add_argument("--plotfile", default=None,
                    help="write a pre/post-fit residual plot (png)")
     p.add_argument("--allow-tcb", action="store_true")
+    p.add_argument("--profile", action="store_true",
+                   help="print a named-stage wall-time table (reference "
+                        "profiling/high_level_benchmark.py stages)")
     args = p.parse_args(argv)
 
     from pint_tpu.fitter import Fitter, GLSFitter
     from pint_tpu.models import get_model
+    from pint_tpu.observability import StageTimer
     from pint_tpu.residuals import Residuals
     from pint_tpu.toa import get_TOAs
 
-    model = get_model(args.parfile, allow_tcb=args.allow_tcb)
+    stages = StageTimer()
+    with stages("Construct model"):
+        model = get_model(args.parfile, allow_tcb=args.allow_tcb)
     planets = model.meta.get("PLANET_SHAPIRO", "N").upper() in ("Y", "1")
-    toas = get_TOAs(args.timfile, ephem=model.meta.get("EPHEM", "builtin"),
-                    planets=planets)
+    with stages("Load TOAs"):
+        toas = get_TOAs(args.timfile,
+                        ephem=model.meta.get("EPHEM", "builtin"),
+                        planets=planets)
     print(f"Read {len(toas)} TOAs; model "
           f"{model.meta.get('PSR', args.parfile)}")
-    r_pre = Residuals(toas, model)
+    with stages("Prefit residuals"):
+        r_pre = Residuals(toas, model)
+        chi2_pre = float(r_pre.chi2)
     print(f"Prefit  RMS {r_pre.rms_weighted() * 1e6:12.4f} us  "
-          f"chi2 {r_pre.chi2:.2f}")
+          f"chi2 {chi2_pre:.2f}")
     if args.fit:
         fitter = (GLSFitter(toas, model) if args.gls
                   else Fitter.auto(toas, model))
-        fitter.fit_toas()
+        with stages("Fit"):
+            fitter.fit_toas()
         print(fitter.get_summary())
     if args.plotfile:
-        _plot(toas, model, r_pre, args.plotfile)
+        with stages("Plot"):
+            _plot(toas, model, r_pre, args.plotfile)
     if args.outfile:
         with open(args.outfile, "w") as f:
             f.write(model.as_parfile())
         print(f"wrote {args.outfile}")
+    if args.profile:
+        stages.report()
     return 0
 
 

@@ -208,3 +208,13 @@ def test_add_correlated_noise_has_structure():
     assert np.std(dt) > 1e-8  # a visible realization
     rough = np.std(np.diff(dt)) / np.std(dt)
     assert rough < 0.5  # smooth (steep red spectrum), not white
+
+
+def test_pintempo_profile(capsys):
+    from pint_tpu.scripts.pintempo import main as pintempo
+
+    assert pintempo(["/root/reference/tests/datafile/NGC6440E.par",
+                     "/root/reference/tests/datafile/NGC6440E.tim",
+                     "--profile"]) == 0
+    out = capsys.readouterr().out
+    assert "Stage" in out and "Fit" in out and "Load TOAs" in out
