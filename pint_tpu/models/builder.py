@@ -384,6 +384,10 @@ def get_model_and_toas(parfile, timfile, **kw):
                       clk.replace("TT(", "").replace(")", ""))
     toas = get_TOAs(timfile, ephem=ephem, planets=planets,
                     **kw)
+    # tim-file JUMP command pairs became -tim_jump flags at parse time;
+    # materialize JUMP parameters for them (reference get_model_and_toas
+    # behavior via jump_flags_to_params)
+    model.jump_flags_to_params(toas)
     return model, toas
 
 

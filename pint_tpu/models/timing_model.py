@@ -189,6 +189,77 @@ class TimingModel:
         return PreparedModel(self, toas)
 
     # -- output --------------------------------------------------------------
+    def jump_flags_to_params(self, toas):
+        """Materialize JUMP parameters for ``-tim_jump``/``-gui_jump``
+        flag values that no existing JUMP selects (reference:
+        timing_model.py:1727 jump_flags_to_params — tim-file JUMP
+        command pairs become flags at parse time, and the user expects
+        them to act as fitted JUMPs even without par-file lines).
+
+        Returns the list of JUMP parameter names added (empty when all
+        flag values are already covered)."""
+        from pint_tpu.models.jump import PhaseJump
+        from pint_tpu.models.parameter import Param
+
+        flag_vals = []
+        for flag in ("tim_jump", "gui_jump"):
+            for f in toas.flags:
+                if flag in f and (flag, str(f[flag])) not in flag_vals:
+                    flag_vals.append((flag, str(f[flag])))
+        if not flag_vals:
+            return []
+        if not self.has_component("PhaseJump"):
+            self.add_component(PhaseJump())
+        comp = self.component("PhaseJump")
+        covered = {(s[1], str(s[2])) for s in comp.selects
+                   if s and s[0] == "flag"}
+        added = []
+        for flag, val in flag_vals:
+            if (flag, val) in covered:
+                continue
+            n = len(comp.selects) + 1
+            sel = ("flag", flag, val)
+            comp.selects = comp.selects + (sel,)
+            name = f"JUMP{n}"
+            comp.add_param(Param(name, units="s", select=sel,
+                                 frozen=False,
+                                 description=f"Jump from -{flag} {val}"))
+            self.values[name] = 0.0
+            added.append(name)
+        return added
+
+    def delete_jump_and_flags(self, toas, jump_num):
+        """Remove JUMP<jump_num> from the PhaseJump component and strip
+        its selecting flag from the TOAs; remaining jumps are
+        renumbered densely (reference: timing_model.py:1804
+        delete_jump_and_flags, the pintk helper)."""
+        comp = self.component("PhaseJump")
+        idx = int(jump_num) - 1
+        if not 0 <= idx < len(comp.selects):
+            raise ValueError(f"no JUMP{jump_num} to delete")
+        sel = comp.selects[idx]
+        if toas is not None and sel[0] == "flag":
+            for f in toas.flags:
+                if str(f.get(sel[1], "")) == str(sel[2]):
+                    del f[sel[1]]
+        selects = list(comp.selects)
+        del selects[idx]
+        old_params = [p for p in comp.params
+                      if not p.name.startswith("JUMP")]
+        jump_params = [p for p in comp.params if p.name.startswith("JUMP")]
+        del jump_params[idx]
+        # renumber densely: JUMP params are positional in the fold
+        vals = [self.values.pop(f"JUMP{i+1}", 0.0)
+                for i in range(len(comp.selects))]
+        del vals[idx]
+        comp.selects = tuple(selects)
+        comp.params = old_params
+        for i, (p, v) in enumerate(zip(jump_params, vals), start=1):
+            p.name = f"JUMP{i}"
+            p.select = selects[i - 1]
+            comp.params.append(p)
+            self.values[f"JUMP{i}"] = v
+
     def as_ECL(self, ecl="IERS2010"):
         """Copy with astrometry in ecliptic coordinates (covariance-
         propagated; reference timing_model.py:2961)."""

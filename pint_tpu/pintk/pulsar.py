@@ -110,30 +110,35 @@ class Pulsar:
 
     # -- jumps (reference pulsar.py add_phase_jump analogue) ------------------
     def add_jump(self, indices):
-        """JUMP the selected TOAs via a per-TOA flag selector (the GUI
-        convention: reference timing_model.py:1727 jump_flags_to_params
-        wires -gui_jump flags into a JUMP maskParameter)."""
-        from pint_tpu.models.jump import PhaseJump
-
+        """JUMP the selected TOAs via a per-TOA flag selector, then
+        materialize the parameter through the shared
+        ``TimingModel.jump_flags_to_params`` (reference
+        timing_model.py:1727).  The flag value is one past the largest
+        in use — values survive jump deletion, so reusing
+        ``len(selects)+1`` after a delete would collide with a live
+        flag and silently merge two jumps."""
         indices = np.asarray(indices, dtype=int)
-        if not self.model.has_component("PhaseJump"):
-            self.model.add_component(PhaseJump())
-        comp = self.model.component("PhaseJump")
-        njump = 1 + len(comp.selects)
-        flagval = str(njump)
+        used = set()
+        for f in self.all_toas.flags:
+            if "gui_jump" in f:
+                try:
+                    used.add(int(str(f["gui_jump"])))
+                except ValueError:
+                    pass
+        if self.model.has_component("PhaseJump"):
+            for s in self.model.component("PhaseJump").selects:
+                if s and s[0] == "flag" and s[1] == "gui_jump":
+                    try:
+                        used.add(int(str(s[2])))
+                    except ValueError:
+                        pass
+        flagval = str(max(used, default=0) + 1)
         for i in indices:
             self.all_toas.flags[i]["gui_jump"] = flagval
-        from pint_tpu.models.parameter import Param
-
-        sel = ("flag", "gui_jump", flagval)
-        comp.selects = comp.selects + (sel,)
-        name = f"JUMP{njump}"
-        comp.add_param(Param(name, units="s", select=sel, frozen=False,
-                             description="GUI phase jump"))
-        self.model.values[name] = 0.0
+        added = self.model.jump_flags_to_params(self.all_toas)
         self.fitted = False
         self._bump()
-        return name
+        return added[-1]
 
     # -- fitting ---------------------------------------------------------------
     #: fit-method menu entries (reference plk fitter selector)

@@ -254,3 +254,26 @@ class TestGuiGuard:
         w = PlkWidget(root, psr)
         w.update_plot()
         root.destroy()
+
+
+def test_jump_flag_values_survive_deletion():
+    """Regression: after deleting a GUI jump, a new jump must not reuse
+    a gui_jump flag value still present on other TOAs (which would
+    silently merge the two jumps)."""
+    from pint_tpu.pintk.pulsar import Pulsar
+
+    psr = Pulsar(os.path.join(REFDATA, "NGC6440E.par"),
+                 os.path.join(REFDATA, "NGC6440E.tim"))
+    n1 = psr.add_jump([0, 1])
+    n2 = psr.add_jump([2, 3])
+    psr.model.delete_jump_and_flags(psr.all_toas, 1)
+    n3 = psr.add_jump([4, 5])
+    comp = psr.model.component("PhaseJump")
+    sels = [s for s in comp.selects if s[0] == "flag"]
+    # all selects distinct, and no select's flag value matches two
+    # different TOA groups
+    assert len(set(sels)) == len(sels) == 2
+    vals = [str(f.get("gui_jump")) for f in psr.all_toas.flags]
+    for s in sels:
+        group = {i for i, v in enumerate(vals) if v == str(s[2])}
+        assert group in ({2, 3}, {4, 5})

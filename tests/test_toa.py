@@ -130,3 +130,48 @@ def test_zero_freq_becomes_inf(tmp_path):
     p.write_text("FORMAT 1\nx 0.0 55000.5 1.0 @\n")
     t = get_TOAs(str(p))
     assert np.isinf(t.freq_mhz[0])
+
+
+def test_tim_jump_flags_to_params(tmp_path):
+    """Tim-file JUMP command pairs materialize as fitted JUMP params
+    (reference timing_model.py:1727 jump_flags_to_params), and
+    delete_jump_and_flags removes one and renumbers."""
+    import numpy as np
+
+    from pint_tpu.models.builder import get_model_and_toas
+    from pint_tpu.residuals import Residuals
+
+    par = tmp_path / "m.par"
+    par.write_text(
+        "PSR FAKE\nRAJ 05:00:00\nDECJ 20:00:00\nF0 100.0 1\nPEPOCH 56000\n"
+        "DM 10.0\nTZRMJD 56000\nTZRFRQ 1400\nTZRSITE @\n")
+    tim = tmp_path / "m.tim"
+    tim.write_text(
+        "FORMAT 1\n"
+        "a 1400.0 56000.1 1.0 @\n"
+        "JUMP\n"
+        "b 1400.0 56000.2 1.0 @\n"
+        "c 1400.0 56000.3 1.0 @\n"
+        "JUMP\n"
+        "d 1400.0 56000.4 1.0 @\n"
+        "JUMP\n"
+        "e 1400.0 56000.5 1.0 @\n"
+        "JUMP\n")
+    m, toas = get_model_and_toas(str(par), str(tim), use_cache=False)
+    assert m.has_component("PhaseJump")
+    comp = m.component("PhaseJump")
+    assert len(comp.selects) == 2
+    assert "JUMP1" in m.free_params and "JUMP2" in m.free_params
+    # jumps actually act on the selected TOAs
+    m.values["JUMP1"] = 5e-4
+    r = Residuals(toas, m, subtract_mean=False, track_mode="nearest")
+    res = np.asarray(r.time_resids)
+    assert abs(res[1] - res[0]) > 4e-4  # jumped block shifted
+    # delete the first jump: flags stripped, JUMP2 renumbers to JUMP1
+    m.delete_jump_and_flags(toas, 1)
+    assert len(m.component("PhaseJump").selects) == 1
+    assert "JUMP2" not in m.params and "JUMP1" in m.params
+    assert not any("tim_jump" in f and f["tim_jump"] == "1"
+                   for f in toas.flags)
+    # re-running materializes nothing new for covered values
+    assert m.jump_flags_to_params(toas) == []
