@@ -138,10 +138,18 @@ class MCMCFitter:
         return jnp.sum(jnp.log(jnp.maximum(
             self.weights * f + (1.0 - self.weights), 1e-300)))
 
-    def fit_toas(self, nwalkers=32, nsteps=500, seed=0, burn_frac=0.25):
+    def fit_toas(self, nwalkers=32, nsteps=500, seed=0, burn_frac=0.25,
+                 autocorr=False, burnin=None):
         """Run the ensemble sampler; set model values to the
         max-posterior sample (reference MCMCFitter.fit_toas maxpost).
-        Returns the max-posterior lnL."""
+        Returns the max-posterior lnL.
+
+        ``autocorr=True`` samples in chunks until the emcee
+        convergence criterion is met (chain > 50 tau, tau stable to
+        10%%) with ``nsteps`` as the cap (reference event_optimize
+        run_sampler_autocorr); the default burn-in is then
+        ``5 * max(tau)`` rather than a fraction of the cap.
+        ``burnin`` (absolute steps) overrides either default."""
         ndim = self.nparams + self._n_template
         center = np.array(
             [self.model.values[n] for n in self.param_names]
@@ -158,13 +166,24 @@ class MCMCFitter:
         s = EnsembleSampler(self.lnposterior, nwalkers=nwalkers,
                             seed=seed)
         x0 = s.initial_ball(center, np.array(scales))
-        s.run_mcmc(x0, nsteps)
+        if autocorr:
+            _, self.converged, self.tau = s.run_mcmc_autocorr(
+                x0, chunk=max(50, nsteps // 10), maxsteps=nsteps)
+            chain_len = int(np.asarray(s.chain).shape[0])
+            burn = (int(burnin) if burnin is not None
+                    else int(min(5 * np.max(self.tau), chain_len // 2))
+                    if np.all(np.isfinite(self.tau)) else chain_len // 4)
+        else:
+            s.run_mcmc(x0, nsteps)
+            chain_len = int(nsteps)
+            burn = (int(burnin) if burnin is not None
+                    else int(burn_frac * nsteps))
         best, lnp = s.max_posterior()
         for i, name in enumerate(self.param_names):
             self.model.values[name] = float(best[i])
         if self._n_template:
             self.template.params = np.asarray(best[self.nparams:])
-        burn = int(burn_frac * nsteps)
+        burn = min(burn, max(chain_len - 1, 0))
         flat = s.flatchain(burn=burn)
         params = self.model.params
         for i, name in enumerate(self.param_names):

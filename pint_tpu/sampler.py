@@ -20,7 +20,34 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["run_mcmc", "EnsembleSampler"]
+__all__ = ["run_mcmc", "EnsembleSampler", "integrated_autocorr_time"]
+
+
+def integrated_autocorr_time(chain, c=5.0):
+    """Per-parameter integrated autocorrelation time tau of an MCMC
+    chain (nsteps, nwalkers, ndim), emcee's estimator: mean
+    walker-averaged autocorrelation function, FFT-computed, with
+    Sokal's adaptive window (smallest M with M >= c * tau(M)).
+    (Reference path: event_optimize's run_sampler_autocorr drives
+    emcee's get_autocorr_time; here the estimator is owned natively.)"""
+    x = np.asarray(chain, np.float64)
+    nsteps, nwalkers, ndim = x.shape
+    taus = np.empty(ndim)
+    for d in range(ndim):
+        y = x[:, :, d] - x[:, :, d].mean(axis=0, keepdims=True)
+        n2 = 1 << (2 * nsteps - 1).bit_length()
+        f = np.fft.rfft(y, n=n2, axis=0)
+        acf = np.fft.irfft(f * np.conjugate(f), n=n2, axis=0)[:nsteps]
+        acf = acf.mean(axis=1)
+        if acf[0] <= 0:
+            taus[d] = np.inf
+            continue
+        rho = acf / acf[0]
+        cumsum = 2.0 * np.cumsum(rho) - 1.0  # tau(M) = 1 + 2 sum_1^M rho
+        window = np.arange(len(cumsum)) >= c * cumsum
+        m = np.argmax(window) if window.any() else len(cumsum) - 1
+        taus[d] = max(cumsum[m], 1e-12)
+    return taus
 
 
 def _stretch_half(key, active, other, lnp_active, lnpost_v, a):
@@ -111,6 +138,49 @@ class EnsembleSampler:
             self.lnpost, x0, int(nsteps), key=sub, thin=thin
         )
         return self.chain
+
+    def run_mcmc_autocorr(self, x0, chunk=100, maxsteps=5000,
+                          tau_factor=50.0, rtol=0.1):
+        """Run in chunks until converged by the emcee criterion
+        (reference: event_optimize run_sampler_autocorr): stop when the
+        chain is longer than ``tau_factor`` integrated autocorrelation
+        times AND tau changed by < ``rtol`` between chunks; give up at
+        exactly ``maxsteps``.  No thinning — tau must be measured in
+        raw steps.  Returns (chain, converged, tau)."""
+        chains = []
+        lnprobs = []
+        accs = []
+        tau_prev = None
+        tau = np.array([np.inf])
+        converged = False
+        x = x0
+        total = 0
+        while total < maxsteps:
+            step = int(min(chunk, maxsteps - total))
+            self.key, sub = jax.random.split(self.key)
+            chain, lnprob, acc = run_mcmc(self.lnpost, x, step, key=sub)
+            chains.append(np.asarray(chain))
+            lnprobs.append(np.asarray(lnprob))
+            accs.append((float(np.mean(np.asarray(acc))), step))
+            x = chain[-1]
+            total += step
+            full = np.concatenate(chains, axis=0)
+            tau = integrated_autocorr_time(full)
+            if (np.all(np.isfinite(tau))
+                    and total > tau_factor * np.max(tau)
+                    and tau_prev is not None
+                    and np.all(np.abs(tau - tau_prev)
+                               < rtol * np.maximum(tau, 1e-12))):
+                converged = True
+                break
+            tau_prev = tau
+        self.chain = jnp.asarray(np.concatenate(chains, axis=0))
+        self.lnprob = jnp.asarray(np.concatenate(lnprobs, axis=0))
+        # whole-run mean acceptance (chunk-length weighted), matching
+        # run_mcmc's whole-chain semantics
+        self.acceptance = (sum(a * n for a, n in accs)
+                           / sum(n for _, n in accs))
+        return self.chain, converged, tau
 
     def flatchain(self, burn=0):
         c = np.asarray(self.chain[burn:])

@@ -32,6 +32,11 @@ def main(argv=None):
     p.add_argument("--burnin", type=int, default=None,
                    help="steps discarded before uncertainty estimation "
                         "(default nsteps/4)")
+    p.add_argument("--autocorr", action="store_true",
+                   help="sample in chunks until the emcee convergence "
+                        "criterion (chain > 50 tau, tau stable), with "
+                        "--nsteps as the cap (reference "
+                        "run_sampler_autocorr)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--fit-template", action="store_true")
     p.add_argument("-o", "--outpar", default=None)
@@ -79,10 +84,13 @@ def main(argv=None):
     if args.burnin is not None and not 0 <= args.burnin < args.nsteps:
         raise SystemExit(
             f"--burnin must be in [0, nsteps={args.nsteps})")
-    burn_frac = (args.burnin / args.nsteps if args.burnin is not None
-                 else 0.25)
     lnp = fitter.fit_toas(nwalkers=args.nwalkers, nsteps=args.nsteps,
-                          seed=args.seed, burn_frac=burn_frac)
+                          seed=args.seed, burnin=args.burnin,
+                          autocorr=args.autocorr)
+    if args.autocorr:
+        print("converged:", fitter.converged,
+              "tau:", np.array2string(np.asarray(fitter.tau),
+                                      precision=1))
     print(f"max-posterior lnL = {lnp:.2f}")
     for name in fitter.param_names:
         print(f"  {name} = {model.values[name]!r} "

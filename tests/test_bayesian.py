@@ -138,3 +138,46 @@ class TestBayesianTiming:
         )
         with pytest.raises(ValueError, match="prior"):
             BayesianTiming(m, toas)
+
+
+class TestAutocorr:
+    def test_tau_white_vs_correlated(self):
+        """White chains have tau ~ 1; an AR(1) chain with rho=0.95 has
+        tau ~ (1+rho)/(1-rho) ~ 39."""
+        import numpy as np
+
+        from pint_tpu.sampler import integrated_autocorr_time
+
+        rng = np.random.default_rng(0)
+        white = rng.standard_normal((4000, 8, 1))
+        tau_w = integrated_autocorr_time(white)
+        assert abs(tau_w[0] - 1.0) < 0.3
+        rho = 0.95
+        ar = np.empty((4000, 8, 1))
+        ar[0] = rng.standard_normal((8, 1))
+        for t in range(1, 4000):
+            ar[t] = rho * ar[t - 1] + np.sqrt(1 - rho**2) * \
+                rng.standard_normal((8, 1))
+        tau_c = integrated_autocorr_time(ar)
+        expect = (1 + rho) / (1 - rho)
+        assert 0.5 * expect < tau_c[0] < 2.0 * expect
+
+    def test_run_mcmc_autocorr_converges_gaussian(self):
+        """A 2-D Gaussian posterior converges quickly under the emcee
+        criterion and the samples recover the target variance."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from pint_tpu.sampler import EnsembleSampler
+
+        def lnpost(x):
+            return -0.5 * jnp.sum(x**2, axis=-1)
+
+        s = EnsembleSampler(lnpost, nwalkers=32, seed=1)
+        x0 = s.initial_ball(np.zeros(2), np.ones(2) * 0.5)
+        chain, converged, tau = s.run_mcmc_autocorr(
+            x0, chunk=200, maxsteps=4000)
+        assert converged
+        flat = s.flatchain(burn=int(5 * np.max(tau)))
+        assert abs(flat[:, 0].std() - 1.0) < 0.1
+        assert abs(flat[:, 1].std() - 1.0) < 0.1
