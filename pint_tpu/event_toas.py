@@ -53,11 +53,16 @@ def _pi_to_kev(mission, pi):
     )
 
 
-def _mjdref(header):
+def mjdref_from_header(header):
+    """(integer MJD, fractional day) reference epoch from an event-FITS
+    header (MJDREFI/MJDREFF or combined MJDREF)."""
     if "MJDREFI" in header:
         return int(header["MJDREFI"]), float(header.get("MJDREFF", 0.0))
     ref = float(header.get("MJDREF", 0.0))
     return int(ref), ref - int(ref)
+
+
+_mjdref = mjdref_from_header  # internal callers
 
 
 #: missions whose event extension is not named EVENTS
@@ -181,8 +186,13 @@ def get_IXPE_TOAs(path, **kw):
 
 
 def load_Fermi_TOAs(path, weightcolumn="WEIGHT", **kw):
-    """Fermi LAT photons with weights (reference fermi_toas.py)."""
+    """Fermi LAT photons with weights (reference fermi_toas.py).
+    A missing weight column degrades to unweighted photons LOUDLY — a
+    typo'd column name must not silently drop the weighting."""
     try:
         return load_event_TOAs(path, "fermi", weights=weightcolumn, **kw)
     except KeyError:
+        warnings.warn(
+            f"weight column {weightcolumn!r} not found in {path}; "
+            "loading UNWEIGHTED photons")
         return load_event_TOAs(path, "fermi", **kw)

@@ -48,9 +48,17 @@ def sf_z2m(z2, m=2):
     return float(chi2.sf(z2, 2 * m))
 
 
-def sf_hm(h):
+def sf_hm(h, m=20):
     """H-test survival function, exp(-0.398405 H) (de Jager &
-    Buesching 2010; reference eventstats.sf_hm)."""
+    Buesching 2010; reference eventstats.sf_hm).  The calibration was
+    derived for the standard m=20 harmonic search; other m warn and
+    use the same formula as an approximation."""
+    if m != 20:
+        import warnings
+
+        warnings.warn(
+            "sf_hm's exp(-0.398405 H) null calibration is for the "
+            f"m=20 H-test; m={m} significance is approximate")
     return float(np.exp(-0.398405 * h))
 
 

@@ -183,7 +183,14 @@ class MCMCFitter:
             self.model.values[name] = float(best[i])
         if self._n_template:
             self.template.params = np.asarray(best[self.nparams:])
-        burn = min(burn, max(chain_len - 1, 0))
+        if burn >= chain_len:
+            import warnings
+
+            warnings.warn(
+                f"burn-in {burn} >= chain length {chain_len} (autocorr "
+                "run converged early?); using chain_len//2 so the "
+                "uncertainty sample stays meaningful")
+            burn = chain_len // 2
         flat = s.flatchain(burn=burn)
         params = self.model.params
         for i, name in enumerate(self.param_names):

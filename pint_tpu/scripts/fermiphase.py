@@ -13,7 +13,19 @@ def main(argv=None):
     p.add_argument("eventfile")
     p.add_argument("parfile")
     p.add_argument("--weightcol", default="WEIGHT")
-    p.add_argument("--outphases", default=None)
+    p.add_argument("--minWeight", type=float, default=0.0,
+                   help="drop photons below this weight")
+    p.add_argument("--maxh", type=int, default=20,
+                   help="max harmonics for the H-test")
+    p.add_argument("--outphases", default=None,
+                   help="write phases to this .npy")
+    p.add_argument("--outfile", default=None,
+                   help="write a phased events FITS carrying "
+                        "TIME/PULSE_PHASE/WEIGHT columns (a compact "
+                        "product, not a full FT1 copy — the reference "
+                        "--addphase appends in place)")
+    p.add_argument("--plotfile", default=None,
+                   help="write a phaseogram image")
     args = p.parse_args(argv)
 
     from pint_tpu.event_toas import load_Fermi_TOAs
@@ -23,19 +35,56 @@ def main(argv=None):
     model = get_model(args.parfile)
     toas = load_Fermi_TOAs(args.eventfile, weightcolumn=args.weightcol,
                            ephem=model.meta.get("EPHEM", "builtin"))
+    print(f"Read {len(toas)} events")
+    keep = np.ones(len(toas), dtype=bool)
+    if args.minWeight > 0.0:
+        w = np.array(toas.get_flag_values("weight", default=1.0,
+                                          astype=float))
+        keep = w >= args.minWeight
+        toas = toas[keep]
+        print(f"Kept {len(toas)} events with weight >= {args.minWeight}")
     prepared = model.prepare(toas)
     _, frac = prepared.phase()
     phases = np.asarray(frac) % 1.0
     wf = toas.get_flag_values("weight", default=None, astype=float)
+    weights = None
     if any(w is not None for w in wf):
         weights = np.array([1.0 if w is None else w for w in wf])
-        h = hmw(phases, weights)
+        h = hmw(phases, weights, m=args.maxh)
     else:
-        h = hm(phases)
-    print(f"Htest: {h:.2f} (sf {sf_hm(h):.3g}, "
-          f"~{sig2sigma(max(sf_hm(h), 1e-300)):.1f} sigma)")
+        h = hm(phases, m=args.maxh)
+    sf = sf_hm(h, m=args.maxh)
+    print(f"Htest: {h:.2f} (sf {sf:.3g}, "
+          f"~{sig2sigma(max(sf, 1e-300)):.1f} sigma)")
     if args.outphases:
         np.save(args.outphases, phases)
+        print(f"wrote {args.outphases}")
+    if args.outfile:
+        from pint_tpu.event_toas import mjdref_from_header
+        from pint_tpu.fits import read_events, write_events
+
+        hdr, dat = read_events(args.eventfile)
+        met = np.asarray(dat["TIME"], np.float64)[keep]
+        refi, reff = mjdref_from_header(hdr)
+        extra = {"PULSE_PHASE": phases}
+        if weights is not None:
+            extra["WEIGHT"] = weights
+        write_events(args.outfile, met, mjdref=(refi, reff),
+                     timesys=str(hdr.get("TIMESYS", "TT")),
+                     timeref=str(hdr.get("TIMEREF", "LOCAL")),
+                     timezero=float(hdr.get("TIMEZERO", 0.0)),
+                     extra_cols=extra)
+        print(f"wrote {args.outfile}")
+    if args.plotfile:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        from pint_tpu.plot_utils import phaseogram
+
+        phaseogram(toas.mjd_float, phases, weights=weights,
+                   title=f"{args.eventfile}  H={h:.1f}",
+                   plotfile=args.plotfile)
+        print(f"wrote {args.plotfile}")
     return 0
 
 

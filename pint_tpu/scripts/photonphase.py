@@ -27,7 +27,10 @@ def main(argv=None):
     p.add_argument("--outphases", default=None,
                    help="write phases to this .npy")
     p.add_argument("--outfile", default=None,
-                   help="write an events FITS with a PULSE_PHASE column")
+                   help="write a phased events FITS carrying "
+                        "TIME/PULSE_PHASE(/ORBIT_PHASE) columns (a "
+                        "compact product, not a full copy of the "
+                        "input's columns)")
     p.add_argument("--addorbphase", action="store_true",
                    help="also write an ORBIT_PHASE column (needs a "
                         "binary model)")
@@ -95,7 +98,7 @@ def main(argv=None):
     )
     h = hm(phases, m=args.maxh) if weights is None else \
         hmw(phases, weights, m=args.maxh)
-    sf = sf_hm(h)
+    sf = sf_hm(h, m=args.maxh)
     print(f"Htest: {h:.2f} (sf {sf:.3g}, "
           f"~{sig2sigma(max(sf, 1e-300)):.1f} sigma)")
     if args.outphases:
@@ -110,7 +113,7 @@ def main(argv=None):
         orb_ph = orbital_phase(model, toas.ticks)
     if args.outfile:
         from pint_tpu.fits import read_events as _re, write_events
-        from pint_tpu.event_toas import _MISSION_EXTNAME, _mjdref
+        from pint_tpu.event_toas import _MISSION_EXTNAME, mjdref_from_header
 
         hdr, dat = _re(args.eventfile, extname=args.extname or
                        _MISSION_EXTNAME.get(args.mission.lower(),
@@ -119,7 +122,7 @@ def main(argv=None):
         extra = {"PULSE_PHASE": phases}
         if orb_ph is not None:
             extra["ORBIT_PHASE"] = orb_ph
-        refi, reff = _mjdref(hdr)
+        refi, reff = mjdref_from_header(hdr)
         write_events(args.outfile, met, mjdref=(refi, reff),
                      timesys=str(hdr.get("TIMESYS", "TT")),
                      timeref=str(hdr.get("TIMEREF", "LOCAL")),

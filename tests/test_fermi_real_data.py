@@ -82,6 +82,28 @@ def test_template_file_real(fermi_toas):
     assert np.all(dens > -1e-9)
 
 
+def test_fermiphase_real_data(tmp_path, capsys):
+    """fermiphase end-to-end on the real FT1 file: weighted H-test,
+    minWeight filter, PULSE_PHASE output file, phaseogram (reference
+    test_fermiphase)."""
+    from pint_tpu.fits import read_events
+    from pint_tpu.scripts.fermiphase import main
+
+    out = tmp_path / "phased.fits"
+    png = tmp_path / "pg.png"
+    rc = main([FT1, PAR, "--weightcol", "PSRJ0030+0451",
+               "--minWeight", "0.5",
+               "--outfile", str(out), "--plotfile", str(png)])
+    assert rc == 0
+    txt = capsys.readouterr().out
+    assert "Htest" in txt
+    hdr, dat = read_events(str(out))
+    assert "PULSE_PHASE" in dat and "WEIGHT" in dat
+    ph = np.asarray(dat["PULSE_PHASE"])
+    assert np.all((ph >= 0) & (ph < 1))
+    assert png.stat().st_size > 0
+
+
 def test_event_optimize_real_data(tmp_path, fermi_toas):
     """Mirror of the reference test_event_optimize test_result: run the
     MCMC script on the real files and check it fits F0 and writes the
