@@ -212,3 +212,39 @@ class TestLogging:
         from pint_tpu.logging import setup
 
         setup(level="WARNING")
+
+class TestDatacheck:
+    def test_report_no_data(self, monkeypatch, tmp_path):
+        monkeypatch.delenv("PINT_TPU_CLOCK_DIR", raising=False)
+        monkeypatch.delenv("PINT_TPU_IERS_DIR", raising=False)
+        monkeypatch.chdir(tmp_path)  # no ./clock, ./iers
+        from pint_tpu.datacheck import datacheck_report
+
+        text = "\n".join(datacheck_report())
+        assert "Ephemeris" in text
+        assert "no JPL kernel" in text  # builtin must NOT read as a kernel
+        assert "site clocks assumed perfect" in text
+        assert "none (CLK TT(BIPM" in text
+        assert "UT1=UTC" in text
+        assert "f64 semantics" in text
+
+    def test_report_with_data(self, monkeypatch, tmp_path):
+        clock = tmp_path / "clock"
+        clock.mkdir()
+        # a minimal tempo2-style gbt clock file and an EOP table
+        (clock / "gbt2gps.clk").write_text(
+            "# UTC(GBT) UTC(GPS)\n50000.0 0.0\n60000.0 1e-6\n")
+        iers = tmp_path / "iers"
+        iers.mkdir()
+        (iers / "eop.dat").write_text("58849 0.1 0.2 -0.17\n"
+                                      "58850 0.1 0.2 -0.18\n")
+        monkeypatch.setenv("PINT_TPU_CLOCK_DIR", str(clock))
+        monkeypatch.setenv("PINT_TPU_IERS_DIR", str(iers))
+        import pint_tpu.obs.iers as iers_mod
+
+        iers_mod._cached = None
+        from pint_tpu.datacheck import datacheck_report
+
+        text = "\n".join(datacheck_report())
+        iers_mod._cached = None
+        assert "polar motion + UT1 active" in text
