@@ -145,3 +145,30 @@ class TestCache:
         # different prepare settings must not hit the cache
         t = get_TOAs(str(tim), ephem="analytic", use_cache=True)
         assert t.ephem == "analytic"
+
+
+def test_shuffled_tim_same_fit(tmp_path):
+    """Fit results are invariant under TOA order in the tim file
+    (reference test_toa_shuffle intent)."""
+    import numpy as np
+
+    from pint_tpu.fitter import WLSFitter
+    from pint_tpu.models.builder import get_model_and_toas
+
+    src = open("/root/reference/tests/datafile/NGC6440E.tim").read()
+    lines = src.splitlines()
+    head = [l for l in lines if not (l.split() and l.split()[0].isdigit())]
+    rows = [l for l in lines if l.split() and l.split()[0].isdigit()]
+    order = np.random.default_rng(3).permutation(len(rows))
+    shuf = tmp_path / "shuf.tim"
+    shuf.write_text("\n".join(head + [rows[i] for i in order]) + "\n")
+    par = "/root/reference/tests/datafile/NGC6440E.par"
+    m1, t1 = get_model_and_toas(
+        par, "/root/reference/tests/datafile/NGC6440E.tim",
+        use_cache=False)
+    m2, t2 = get_model_and_toas(par, str(shuf), use_cache=False)
+    c1 = WLSFitter(t1, m1).fit_toas()
+    c2 = WLSFitter(t2, m2).fit_toas()
+    np.testing.assert_allclose(float(c1), float(c2), rtol=1e-12)
+    np.testing.assert_allclose(float(m1.values["F0"]),
+                               float(m2.values["F0"]), rtol=0, atol=0)
