@@ -189,6 +189,77 @@ class TimingModel:
         return PreparedModel(self, toas)
 
     # -- output --------------------------------------------------------------
+    def get_derived_params(self, rms_us=None, ntoas=None,
+                           returndict=False):
+        """Text report of derived quantities from the fitted model
+        (reference: timing_model.py:3055 get_derived_params): period
+        and derivatives, characteristic age, surface/light-cylinder B,
+        Edot, and for binaries the mass function, minimum/median
+        companion mass, and the ELL1 applicability check
+        (asini/c * ecc^2 vs the timing precision)."""
+        import numpy as np
+
+        from pint_tpu import derived_quantities as dq
+
+        vals = self.values
+        out = {}
+        lines = ["Derived Parameters:"]
+        f0 = float(vals.get("F0", 0.0))
+        if f0 > 0:
+            p = 1.0 / f0
+            out["P (s)"] = p
+            lines.append(f"Period = {p:.12g} s")
+            f1 = float(vals.get("F1", 0.0))
+            if f1:
+                pdot = -f1 / f0**2
+                out["Pdot (s/s)"] = pdot
+                lines.append(f"Pdot = {pdot:.6g}")
+                if f1 < 0:
+                    age = dq.pulsar_age_yr(f0, f1)
+                    bsurf = dq.pulsar_B_gauss(f0, f1)
+                    blc = dq.pulsar_B_lightcyl_gauss(f0, f1)
+                    edot = dq.pulsar_edot(f0, f1)
+                    out.update({"tau_c (yr)": age, "B_surf (G)": bsurf,
+                                "B_LC (G)": blc, "Edot (erg/s)": edot})
+                    lines += [
+                        f"Characteristic age = {age:.4g} yr (braking n=3)",
+                        f"Surface B field = {bsurf:.4g} G",
+                        f"Magnetic field at light cylinder = {blc:.4g} G",
+                        f"Spindown Edot = {edot:.4g} erg/s (I=1e45)",
+                    ]
+        if "PB" in vals or "FB0" in vals:
+            pb_s = (float(vals["PB"]) if "PB" in vals
+                    else 1.0 / float(vals["FB0"]))
+            a1 = float(vals.get("A1", 0.0))
+            out["PB (d)"] = pb_s / 86400.0
+            lines.append(f"Binary period PB = {pb_s / 86400.0:.10g} d")
+            if a1 > 0:
+                mf = dq.mass_funct(pb_s, a1)
+                out["Mass function (Msun)"] = mf
+                lines.append(f"Mass function = {mf:.6g} Msun")
+                mcmin = dq.companion_mass(pb_s, a1, i_rad=np.pi / 2,
+                                          mp=1.4)
+                mcmed = dq.companion_mass(pb_s, a1,
+                                          i_rad=np.radians(60.0), mp=1.4)
+                out["Mc,min (Msun)"] = mcmin
+                out["Mc,median (Msun)"] = mcmed
+                lines.append(
+                    f"Min / median companion mass (Mp=1.4) = "
+                    f"{mcmin:.4g} / {mcmed:.4g} Msun")
+            if "EPS1" in vals and rms_us is not None and ntoas:
+                ecc = float(np.hypot(vals.get("EPS1", 0.0),
+                                     vals.get("EPS2", 0.0)))
+                limit = a1 * ecc**2 * 1e6  # us
+                ok = limit < rms_us / np.sqrt(float(ntoas))
+                out["ELL1 ok"] = ok
+                lines.append(
+                    "ELL1 applicability: asini/c * ecc^2 = "
+                    f"{limit:.3g} us {'<' if ok else '>!'} "
+                    f"rms/sqrt(N) = {rms_us / np.sqrt(float(ntoas)):.3g}"
+                    " us")
+        text = "\n".join(lines)
+        return (text, out) if returndict else text
+
     def d_phase_d_toa(self, toas, dt_s=2.0):
         """Instantaneous topocentric spin frequency [Hz] at each TOA
         (reference: timing_model.py d_phase_d_toa — the numerical

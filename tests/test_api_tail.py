@@ -242,3 +242,26 @@ class TestDerivedGrid:
         # minimum at the true F0 (center of the axis)
         imin = np.unravel_index(np.argmin(chi2), chi2.shape)
         assert imin[0] == 2
+
+
+def test_get_derived_params_report():
+    """TimingModel.get_derived_params (reference timing_model.py:3055):
+    known B1855+09 astrophysics comes out right."""
+    import numpy as np
+
+    from pint_tpu.models import get_model
+
+    m = get_model("/root/reference/tests/datafile/"
+                  "B1855+09_NANOGrav_12yv3.wb.gls.par")
+    text, d = m.get_derived_params(rms_us=1.0, ntoas=313,
+                                   returndict=True)
+    np.testing.assert_allclose(d["P (s)"], 5.362e-3, rtol=1e-3)
+    np.testing.assert_allclose(d["tau_c (yr)"], 4.76e9, rtol=0.01)
+    np.testing.assert_allclose(d["B_surf (G)"], 3.1e8, rtol=0.02)
+    np.testing.assert_allclose(d["Mc,min (Msun)"], 0.247, rtol=0.01)
+    assert d["ELL1 ok"] is True or d["ELL1 ok"] == True  # noqa: E712
+    assert "Characteristic age" in text and "Mass function" in text
+    # isolated pulsar: no binary block
+    m2 = get_model("/root/reference/tests/datafile/NGC6440E.par")
+    t2 = m2.get_derived_params()
+    assert "Mass function" not in t2 and "Period" in t2
