@@ -54,6 +54,8 @@ def main(argv=None):
         with stages("Fit"):
             fitter.fit_toas()
         print(fitter.get_summary())
+        rms_us = fitter.resids.rms_weighted() * 1e6
+        print(model.get_derived_params(rms_us=rms_us, ntoas=len(toas)))
     if args.plotfile:
         with stages("Plot"):
             _plot(toas, model, r_pre, args.plotfile)
