@@ -121,3 +121,25 @@ class TestParWriting:
         f.fit_toas()
         par = m.as_parfile()
         assert "NTOA" in par and "CHI2" in par and "TRES" in par
+
+def test_reference_par_sweep_roundtrip():
+    """Every par file in the reference test tree loads and round-trips
+    through as_parfile -> get_model (TCB pars via allow_tcb)."""
+    import glob
+    import warnings
+
+    from pint_tpu.models import get_model
+
+    pars = sorted(glob.glob("/root/reference/tests/datafile/*.par"))
+    assert len(pars) >= 50
+    failures = []
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for p in pars:
+            try:
+                m = get_model(p, allow_tcb=True)
+                get_model(m.as_parfile())
+            except Exception as e:
+                failures.append((p.rsplit("/", 1)[-1],
+                                 f"{type(e).__name__}: {e}"))
+    assert not failures, failures
