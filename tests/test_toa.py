@@ -175,3 +175,25 @@ def test_tim_jump_flags_to_params(tmp_path):
                    for f in toas.flags)
     # re-running materializes nothing new for covered values
     assert m.jump_flags_to_params(toas) == []
+
+
+def test_reference_tim_sweep():
+    """Every tim file in the reference test tree parses to >= 1 TOA
+    (tempo1/tempo2/ITOA dialects, commands, INCLUDEs)."""
+    import glob
+    import warnings
+
+    from pint_tpu.toa import read_tim
+
+    tims = sorted(glob.glob("/root/reference/tests/datafile/*.tim"))
+    assert len(tims) >= 30
+    failures = []
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for p in tims:
+            try:
+                assert len(read_tim(p)) > 0
+            except Exception as e:
+                failures.append((p.rsplit("/", 1)[-1],
+                                 f"{type(e).__name__}: {e}"))
+    assert not failures, failures
