@@ -311,6 +311,18 @@ def get_model(parfile, allow_tcb=False, allow_T2=False) -> TimingModel:
         model.values.get("F0", np.nan)
     ):
         raise ValueError("par file lacks F0 (no spindown model)")
+    # sanity: astrometry needs a complete position — a par carrying
+    # ELONG without ELAT (or RAJ without DECJ) would otherwise produce
+    # silently-NaN residuals (reference: MissingParameter from
+    # Astrometry.validate)
+    for a, b in (("RAJ", "DECJ"), ("ELONG", "ELAT")):
+        have_a = not np.isnan(model.values.get(a, np.nan))
+        have_b = not np.isnan(model.values.get(b, np.nan))
+        if have_a != have_b:
+            missing = b if have_a else a
+            raise ValueError(
+                f"par file sets {a if have_a else b} but not {missing}: "
+                "incomplete sky position")
     return model
 
 

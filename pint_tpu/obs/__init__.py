@@ -192,6 +192,9 @@ def get_observatory(name) -> Observatory:
 
 _BUILTIN_SITES = {
     "gbt": ([882589.289, -4924872.368, 3943729.418], "1", "GB", ()),
+    # fake telescope for the IPTA data challenge (reference
+    # observatories.json "AXIS", imported from TEMPO2 observatories.dat)
+    "axis": ([6378138.0, 0.0, 0.0], None, None, ("axi",)),
     "quabbin": ([1430913.350, -4495711.384, 4278113.975], "2", "QU", ()),
     "arecibo": ([2390487.080, -5564731.357, 1994720.633], "3", "AO", ("aoutc",)),
     "hobart": ([-3950077.96, 2522377.31, -4311667.52], "4", "HO", ()),

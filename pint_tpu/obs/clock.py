@@ -277,9 +277,16 @@ def find_clock_chain(obs):
     for d in _clock_dirs():
         site_files = [
             (os.path.join(d, f"{obs.name}2gps.clk"), "tempo2", None),
-            (os.path.join(d, f"time_{obs.name}.dat"), "tempo", obs.tempo_code),
-            (os.path.join(d, f"time.dat"), "tempo", obs.tempo_code),
         ]
+        if obs.tempo_code:
+            # generic tempo files are keyed by site code: a site
+            # without one (e.g. the IPTA-MDC fake 'axis') must not
+            # absorb every site's entries via an unfiltered read
+            site_files += [
+                (os.path.join(d, f"time_{obs.name}.dat"), "tempo",
+                 obs.tempo_code),
+                (os.path.join(d, "time.dat"), "tempo", obs.tempo_code),
+            ]
         for path, fmt, site in site_files:
             if os.path.exists(path):
                 chain.append(GlobalClockFile(path, fmt=fmt,

@@ -132,14 +132,43 @@ def test_reference_par_sweep_roundtrip():
 
     pars = sorted(glob.glob("/root/reference/tests/datafile/*.par"))
     assert len(pars) >= 50
+    # reference validation fixtures that are SUPPOSED to be rejected
+    expected_bad = {
+        # ELONG present, ELAT commented out: incomplete sky position
+        "J1744-1134.basic.ecliptic.par",
+    }
     failures = []
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")
         for p in pars:
+            name = p.rsplit("/", 1)[-1]
             try:
                 m = get_model(p, allow_tcb=True)
                 get_model(m.as_parfile())
+                if name in expected_bad:
+                    failures.append((name, "accepted but should raise"))
             except Exception as e:
-                failures.append((p.rsplit("/", 1)[-1],
-                                 f"{type(e).__name__}: {e}"))
+                if name not in expected_bad:
+                    failures.append((name, f"{type(e).__name__}: {e}"))
+                elif "incomplete sky position" not in str(e):
+                    failures.append(
+                        (name, f"wrong rejection: {type(e).__name__}: {e}"))
     assert not failures, failures
+
+
+def test_incomplete_position_raises():
+    """ELONG without ELAT (or RAJ without DECJ) raises instead of
+    producing silently-NaN residuals (regression: the reference
+    J1744 'basic.ecliptic' validation fixture)."""
+    import pytest
+
+    from pint_tpu.models import get_model
+
+    base = ("PSR T\nF0 100.0\nPEPOCH 56000\nDM 10\n"
+            "TZRMJD 56000\nTZRFRQ 1400\nTZRSITE @\n")
+    with pytest.raises(ValueError, match="ELAT"):
+        get_model(base + "ELONG 10\n")
+    with pytest.raises(ValueError, match="DECJ"):
+        get_model(base + "RAJ 05:00:00\n")
+    # complete positions still fine
+    get_model(base + "ELONG 10\nELAT 30\n")
