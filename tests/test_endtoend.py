@@ -7,6 +7,8 @@ Oracles (SURVEY section 4 strategy, adapted for a no-astropy world):
 - zero_residuals convergence (sub-ns)
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -185,3 +187,43 @@ class TestJumps:
         f = WLSFitter(toas, m)
         f.fit_toas()
         assert abs(m.values["JUMP1"] - truth) < 1e-7
+
+
+@pytest.mark.skipif(
+    os.environ.get("PINT_TPU_FULL_GOLDEN") != "1",
+    reason="several-minute sweep; set PINT_TPU_FULL_GOLDEN=1")
+def test_full_chain_pair_sweep():
+    """Residuals run to a finite chi2 for every matched par/tim pair in
+    the reference test tree (the sweep that surfaced the AXIS
+    observatory and incomplete-position findings)."""
+    import glob
+    import warnings
+
+    import numpy as np
+
+    from pint_tpu.models.builder import get_model_and_toas
+    from pint_tpu.residuals import Residuals
+
+    D = "/root/reference/tests/datafile/"
+    tims = {os.path.basename(t): t for t in glob.glob(D + "*.tim")}
+    skip = {"J0030+0451.mdc1.par", "J1744-1134.basic.ecliptic.par"}
+    failures = []
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for p in sorted(glob.glob(D + "*.par")):
+            stem = os.path.basename(p)
+            if stem in skip:
+                continue
+            best, bl = None, 0
+            for name, t in tims.items():
+                l = len(os.path.commonprefix([stem, name]))
+                if l > bl:
+                    best, bl = t, l
+            if not best or bl < 8:
+                continue
+            try:
+                m, toas = get_model_and_toas(p, best, use_cache=False)
+                assert np.isfinite(float(Residuals(toas, m).chi2))
+            except Exception as e:
+                failures.append((stem, f"{type(e).__name__}: {e}"))
+    assert not failures, failures
