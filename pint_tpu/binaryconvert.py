@@ -109,7 +109,8 @@ def _get(model, name, default=0.0):
 
 
 def convert_binary(model: TimingModel, output: str, nharms=None,
-                   use_stigma=False, kom_deg=None) -> TimingModel:
+                   use_stigma=False, kom_deg=None,
+                   lossy=False) -> TimingModel:
     """Return a new TimingModel with the binary component converted to
     the ``output`` parameterization (reference: convert_binary,
     binaryconvert.py:544).  Conversion is done at the par level: the
@@ -119,7 +120,14 @@ def convert_binary(model: TimingModel, output: str, nharms=None,
     NHARMS line; ``use_stigma=True`` emits STIGMA instead of H4.
     DDK extra: ``kom_deg`` supplies the longitude of the ascending node
     (not derivable from any other parameterization); KIN is derived
-    from SINI."""
+    from SINI.
+
+    A conversion that would *drop physics* — a parameter the input
+    binary engine models but the output one cannot represent (e.g.
+    DD->ELL1 sheds GAMMA/DR/DTH/A0/B0) — raises ``ValueError`` unless
+    ``lossy=True``, matching the reference's refuse-to-shed semantics
+    (binaryconvert.py:544 raises on non-representable conversions)
+    rather than silently demoting parameters to metadata."""
     output = output.upper()
     current = model.meta.get("BINARY", "").upper()
     if not current:
@@ -307,4 +315,32 @@ def convert_binary(model: TimingModel, output: str, nharms=None,
 
     from pint_tpu.models.builder import get_model
 
-    return get_model("\n".join(par_lines) + "\n")
+    new = get_model("\n".join(par_lines) + "\n")
+
+    # physics the input engine modeled but the output engine cannot
+    # represent lands in __unknown__ metadata on the re-parse; that is
+    # a silent loss of signal, not a parameterization change
+    def _had_physics(name):
+        # a zero-valued, frozen parameter is absent physics (engines
+        # register e.g. GAMMA/DR/DTH at 0.0 by default) — dropping it
+        # loses nothing; a nonzero value or an actively-fit one does
+        v = model.values.get(name, np.nan)
+        if isinstance(v, float) and (np.isnan(v) or v == 0.0):
+            return name in fitset
+        return True
+
+    dropped = sorted(
+        k for k in new.meta.get("__unknown__", {})
+        if k in model.params and _had_physics(k)
+    )
+    if dropped:
+        msg = (
+            f"converting {current} -> {output} drops parameters the "
+            f"{output} engine cannot represent: {dropped}"
+        )
+        if not lossy:
+            raise ValueError(
+                msg + " — pass lossy=True (convert_parfile: --lossy) "
+                "to shed them deliberately")
+        warnings.warn(msg + " (lossy=True: carried as metadata only)")
+    return new

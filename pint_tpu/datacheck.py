@@ -20,7 +20,7 @@ __all__ = ["datacheck_report", "main"]
 
 
 def datacheck_report(ephem="builtin", sites=("gbt", "ao", "jb", "pks",
-                                             "vla", "meerkat")):
+                                             "vla", "meerkat", "wsrt")):
     """Return the diagnostic as a list of text lines."""
     lines = []
 
@@ -41,7 +41,23 @@ def datacheck_report(ephem="builtin", sites=("gbt", "ao", "jb", "pks",
 
     dirs = _clock_dirs()
     lines.append(f"Clock search dirs: {dirs or 'none (set $PINT_TPU_CLOCK_DIR)'}")
-    n_found = 0
+
+    def _is_placeholder(path):
+        """Bundled zero-assumption files self-identify in their header
+        (tools/make_runtime_data.py writes the marker)."""
+        try:
+            with open(path) as f:
+                for _ in range(6):
+                    line = f.readline()
+                    if not line.startswith("#"):
+                        break
+                    if "PLACEHOLDER-ZERO" in line or "APPROXIMATE" in line:
+                        return True
+        except OSError:
+            pass
+        return False
+
+    n_real = n_placeholder = n_missing = n_error = 0
     for site in sites:
         try:
             obs = get_observatory(site)
@@ -51,18 +67,43 @@ def datacheck_report(ephem="builtin", sites=("gbt", "ao", "jb", "pks",
             chain = find_clock_chain(obs)
         except Exception as e:  # surface, never hide, a broken file
             lines.append(f"  {site}: ERROR {type(e).__name__}: {e}")
-            n_found += 1
+            n_error += 1
             continue
-        files = [getattr(c, "filename", "?") for c in (chain or [])]
-        if files:
-            n_found += 1
-            lines.append(f"  {site}: {', '.join(map(str, files))}")
-    if n_found == 0:
+        files = [str(getattr(c, "filename", "?")) for c in (chain or [])]
+        if not files:
+            n_missing += 1
+            continue
+        tagged = [
+            f + (" [placeholder-zero]" if _is_placeholder(f) else "")
+            for f in files
+        ]
+        # classify the site by its *site* file (first chain link); the
+        # GPS->UTC link is a shared <=50 ns term either way
+        if _is_placeholder(files[0]):
+            n_placeholder += 1
+        else:
+            n_real += 1
+        lines.append(f"  {site}: {', '.join(tagged)}")
+    n_checked = n_real + n_placeholder + n_missing + n_error
+    if n_real + n_placeholder + n_error == 0:
         lines.append(
             "  -> no site clock files: site clocks assumed perfect "
             "(~0.1-1 us dropped)")
-    bipm_files = [f for d in dirs for f in sorted(os.listdir(d))
-                  if f.startswith("tai2tt_bipm")]
+    else:
+        lines.append(
+            f"  -> clock chain complete for {n_real + n_placeholder}"
+            f"/{n_checked} sites checked "
+            f"({n_real} real tabulation(s), {n_placeholder} documented "
+            "placeholder-zero (~0.1-1 us bound; drop real files into "
+            "$PINT_TPU_CLOCK_DIR to supersede)"
+            + (f", {n_error} BROKEN file(s) — see ERROR lines above"
+               if n_error else "") + ")")
+    bipm_files = [
+        f + (" [approx-constant]" if _is_placeholder(os.path.join(d, f))
+             else "")
+        for d in dirs for f in sorted(os.listdir(d))
+        if f.startswith("tai2tt_bipm")
+    ]
     lines.append(
         "BIPM realization: "
         + (f"available ({', '.join(bipm_files)})" if bipm_files

@@ -134,8 +134,13 @@ def load_event_TOAs(path, mission, weights=None, extname=None,
             TOA(refi + int(day_extra), ns, 86400 * 10**9,
                 err_us, 0.0, obs, flags, mission)
         )
-    return TOAs(toa_list, ephem=ephem, planets=planets,
-                include_clock=False)
+    out = TOAs(toa_list, ephem=ephem, planets=planets,
+               include_clock=False)
+    # original FITS row index per kept TOA, so downstream writers
+    # (photonphase/fermiphase --outfile) can index the raw event table
+    # without assuming this loader kept every row in order
+    out.fits_rows = widx
+    return out
 
 
 def met_to_day_ns(reff: float, t: float, timezero: float = 0.0):

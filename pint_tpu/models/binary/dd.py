@@ -246,6 +246,12 @@ class BinaryDDK(BinaryDD):
 
     binary_name = "DDK"
 
+    #: values forced when this component is added as an INERT member of
+    #: a heterogeneous-PTA superset (parallel.pta): the gate zeroes its
+    #: delay, but KIN=0 would put NaN (0/tan(0), 1/sin(0)) into the
+    #: traced graph, and gate * NaN = NaN
+    neutral_overrides = {"KIN": 1.0}
+
     def build_params(self, pardict):
         super().build_params(pardict)
         self.params = [p for p in self.params if p.name != "SINI"]
@@ -269,12 +275,20 @@ class BinaryDDK(BinaryDD):
         # observatory SSB position [ls] and pulsar unit vector, in the
         # astrometry frame (Kopeikin 1995 Eq. 15-16 geometry)
         obs = np.asarray(toas.ssb_obs_pos, dtype=np.float64)
+        # the astrometry frame is the HOST model's ACTIVE astrometry
+        # component — not the par this instance was built from (as a
+        # superset donor this component is copied onto pulsars in
+        # either frame, and a superset can hold both astrometry
+        # classes, one inert; parallel.pta)
+        inert = getattr(model, "_superset_inert", ()) or ()
         astrom = None
         for c in model.components:
-            if c.category == "astrometry":
+            if c.category == "astrometry" and (
+                    astrom is None or type(c).__name__ not in inert):
                 astrom = c
         if astrom is None:
             raise ValueError("DDK requires an astrometry component")
+        self.ecliptic = "Ecliptic" in type(astrom).__name__
         if self.ecliptic:
             # ICRS -> ecliptic with the model's ECL obliquity selection
             obs = obs @ np.asarray(astrom.eq_from_ecl)

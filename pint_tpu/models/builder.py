@@ -378,13 +378,21 @@ def convert_t2_binary(pardict):
     return out, chosen
 
 
+def planets_requested(model) -> bool:
+    """Whether the par requests planet Shapiro delays.  PLANET_SHAPIRO
+    may land in meta (bare par keyword spelling) OR as the registered
+    bool parameter in model.values — the one definition every TOA
+    loader must use (reference: model.PLANET_SHAPIRO.value)."""
+    return bool(
+        model.meta.get("PLANET_SHAPIRO", "N").upper() in ("Y", "1", "TRUE")
+    ) or bool(model.values.get("PLANET_SHAPIRO", 0.0))
+
+
 def get_model_and_toas(parfile, timfile, **kw):
     from pint_tpu.toa import get_TOAs
 
     model = get_model(parfile)
-    planets = bool(
-        model.meta.get("PLANET_SHAPIRO", "N").upper() in ("Y", "1", "TRUE")
-    ) or bool(model.values.get("PLANET_SHAPIRO", 0.0))
+    planets = planets_requested(model)
     ephem = model.meta.get("EPHEM", "builtin")
     # honor the par CLK realization: TT(BIPMxxxx) requests the BIPM
     # offsets (applied when tai2tt data is available; see

@@ -104,7 +104,18 @@ class TimingModel:
     @property
     def params(self) -> Dict[str, "Param"]:
         out = {}
-        for c in self.components:
+        comps = self.components
+        inert = getattr(self, "_superset_inert", None)
+        if inert:
+            # superset-inert members carry frozen copies of params
+            # whose names collide with a real component's (PB/A1/...
+            # across binary families): the REAL component's Param must
+            # win the dict slot or the pulsar silently loses that
+            # parameter's fit freedom (parallel.pta superset)
+            comps = sorted(
+                comps,
+                key=lambda c: 0 if type(c).__name__ in inert else 1)
+        for c in comps:
             for p in c.params:
                 out[p.name] = p
         return out
@@ -426,6 +437,24 @@ class TimingModel:
         return "\n".join(rows)
 
 
+def gated_dm_sum(model, values, batch, ctx_map):
+    """Sum of every component's ``dm_value`` contribution [pc cm^-3],
+    with superset-inert members zeroed via their prepare-time
+    ``__gate__`` (one definition shared by PreparedModel.total_dm_fn
+    and the batched PTA wideband path, so DM gating semantics cannot
+    drift between them)."""
+    dm = jnp.zeros(batch.ticks.shape, dtype=jnp.float64)
+    for c in model.components:
+        f = getattr(c, "dm_value", None)
+        if f is not None:
+            ctx = ctx_map[type(c).__name__]
+            contrib = f(values, batch, ctx)
+            if "__gate__" in ctx:
+                contrib = contrib * ctx["__gate__"]
+            dm = dm + contrib
+    return dm
+
+
 class PreparedModel:
     """Model bound to a dataset: static ctx captured, pure fns jitted.
 
@@ -522,12 +551,7 @@ class PreparedModel:
         """Modeled DM [pc cm^-3] at each TOA: the sum of every
         component's ``dm_value`` contribution (reference:
         TimingModel.total_dm via dm_value_funcs)."""
-        dm = jnp.zeros(self.batch.ticks.shape, dtype=jnp.float64)
-        for c in self.model.components:
-            f = getattr(c, "dm_value", None)
-            if f is not None:
-                dm = dm + f(values, self.batch, self.ctx[type(c).__name__])
-        return dm
+        return gated_dm_sum(self.model, values, self.batch, self.ctx)
 
     def scaled_dm_sigma_fn(self, values, dm_sigma):
         """Wideband DM uncertainties after DMEFAC/DMEQUAD scaling

@@ -70,6 +70,11 @@ class ClockFile:
                     continue
                 mjds.append(mjd)
                 offs.append(off)
+        if not mjds:
+            raise ValueError(
+                f"clock file {path}: no parseable 'MJD offset' rows — "
+                "a present-but-garbage file must not silently mean "
+                "zero corrections")
         return cls(mjds, offs, name=os.path.basename(path), limits=limits)
 
     @classmethod
@@ -229,7 +234,8 @@ class GlobalClockFile(ClockFile):
 def _clock_dirs():
     from pint_tpu.obs.datadirs import search_dirs
 
-    return search_dirs("PINT_TPU_CLOCK_DIR", "clock")
+    return search_dirs("PINT_TPU_CLOCK_DIR", "clock",
+                       include_builtin=True)
 
 
 def clock_data_identity():
@@ -292,11 +298,15 @@ def find_clock_chain(obs):
                 chain.append(GlobalClockFile(path, fmt=fmt,
                                              site_code=site))
                 break
-        gps = os.path.join(d, "gps2utc.clk")
-        if chain and os.path.exists(gps):
-            chain.append(GlobalClockFile(gps, fmt="tempo2"))
         if chain:
             break
+    if chain:
+        # GPS->UTC may live in a different search dir than the site
+        # file (e.g. a user site file in ./clock over the bundled
+        # gps2utc.clk): search all dirs
+        gps = find_clock_file("gps2utc.clk", fmt="tempo2")
+        if gps is not None:
+            chain.append(gps)
     return chain
 
 

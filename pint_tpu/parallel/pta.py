@@ -183,10 +183,12 @@ def make_superset_models(pairs):
     classes (SURVEY §7 hard part #3): a pulsar missing a component gets
     it with *neutral* values (A1=0 binary contributes zero delay, zero
     glitch amplitudes, empty masks...), all its parameters frozen, so
-    an ELL1 + DD + isolated mix traces as ONE jit program.
+    an ELL1 + DD + DDK + isolated mix traces as ONE jit program.
 
-    DDK is excluded (its Kopeikin geometry needs real astrometry and
-    cannot be made inert by zeroing)."""
+    Components whose neutral value would be singular (DDK: 0/tan(KIN)
+    at KIN=0) declare ``neutral_overrides`` — the prepare-time 0/1 gate
+    zeroes their delay, but the traced graph must stay NaN-free since
+    gate * NaN = NaN."""
     import copy
 
     # donors: one representative instance per component class — copied
@@ -198,10 +200,6 @@ def make_superset_models(pairs):
     for model, _ in pairs:
         for c in model.components:
             cls = type(c)
-            if cls.__name__ == "BinaryDDK":
-                raise ValueError(
-                    "BinaryDDK cannot participate in a heterogeneous "
-                    "superset (Kopeikin terms are not neutralizable)")
             if cls not in order:
                 order.append(cls)
                 donors[cls] = c
@@ -238,6 +236,11 @@ def make_superset_models(pairs):
                 if cur != cur:  # NaN default (e.g. PB) -> placeholder
                     model.values[p.name] = _SUPERSET_PLACEHOLDERS.get(
                         p.name, 0.0)
+            # singular-at-zero neutrals (DDK KIN): the gate zeroes the
+            # delay but NaN would survive gate multiplication
+            for name, val in getattr(comp, "neutral_overrides",
+                                     {}).items():
+                model.values[name] = val
         # added components must be INERT despite sharing parameter
         # names (PB/A1/...) with the pulsar's real binary: prepare()
         # attaches a 0/1 gate per component (timing_model.py)
@@ -476,6 +479,116 @@ class PTABatch:
         J = jax.jacfwd(resid_fn)(vec)
         _, cov, ncoef, chi2 = gls_normal_solve(r, J, err, U, phi)
         return vec, chi2, cov
+
+    # -- wideband (stacked TOA + DM) path -------------------------------------
+    def _gather_dm(self):
+        """Padded wideband DM measurements: (dm (k, n_max), dme
+        (k, n_max), dm_valid (k, n_max)).  A narrowband pulsar
+        contributes an all-invalid row, so mixed batches fit its time
+        block only — mirroring WidebandTOAFitter vs plain GLS per
+        pulsar (reference fitter.py:2292-2640)."""
+        dms = np.zeros((self.n_pulsars, self.n_max))
+        dmes = np.ones((self.n_pulsars, self.n_max))
+        dmv = np.zeros((self.n_pulsars, self.n_max), dtype=bool)
+        for k, p in enumerate(self.prepareds):
+            toas = self.resids[k].toas
+            dm, dme, valid = toas.wideband_dm_data()
+            n_p = len(dm)
+            dms[k, :n_p] = np.where(valid, dm, 0.0)
+            dmes[k, :n_p] = np.where(valid, dme, 1.0)
+            dmv[k, :n_p] = valid
+        return (jnp.asarray(dms), jnp.asarray(dmes), jnp.asarray(dmv))
+
+    def _dm_resid_one(self, values, batch, ctx, dm_data, dm_valid):
+        """Measured-minus-model DM for one pulsar, zero where there is
+        no measurement (pure-function form of
+        WidebandDMResiduals.dm_resids_fn over the padded batch)."""
+        from pint_tpu.models.timing_model import gated_dm_sum
+
+        model_dm = gated_dm_sum(self.prepareds[0].model, values, batch,
+                                ctx)
+        return jnp.where(dm_valid, dm_data - model_dm, 0.0)
+
+    def _dm_sigma_one(self, values, ctx, dm_error):
+        """DMEFAC/DMEQUAD-scaled DM uncertainties for one pulsar."""
+        p0 = self.prepareds[0]
+        sig = dm_error
+        for c in p0.model.noise_components:
+            f = getattr(c, "scaled_dm_sigma", None)
+            if f is not None:
+                sig = f(values, ctx[type(c).__name__], sig)
+        return sig
+
+    def _fit_one_wb(self, vec0, base_values, batch, ctx, tzr_batch,
+                    tzr_ctx, valid, free_mask, U, phi, dm_data,
+                    dm_error, dm_valid, maxiter):
+        """One pulsar's wideband GLS fit: stacked [time; DM] residual
+        with the correlated-noise basis acting on the time block only
+        (zero rows under the DM block), same normal equations as
+        _fit_one_gls."""
+        from pint_tpu.linalg import gls_normal_solve
+
+        merged = _merge_ctx(ctx, self.static_ctx)
+        values0 = dict(base_values)
+        for i, name in enumerate(self.free_names):
+            values0[name] = vec0[i]
+        sigma_t = self._sigma_one(values0, batch, merged)
+        err_t = jnp.where(valid, sigma_t, 1e30)
+        sigma_dm = self._dm_sigma_one(values0, merged, dm_error)
+        err_dm = jnp.where(dm_valid, sigma_dm, 1e30)
+        err = jnp.concatenate([err_t, err_dm])
+        U_wb = jnp.concatenate(
+            [U, jnp.zeros((dm_data.shape[0], U.shape[1]))], axis=0)
+
+        def resid_fn(v):
+            values = dict(base_values)
+            for i, name in enumerate(self.free_names):
+                values[name] = jnp.where(free_mask[i], v[i],
+                                         base_values[name])
+            r_t = self._resid_one(
+                v, base_values, batch, ctx, tzr_batch, tzr_ctx, valid,
+                free_mask)
+            r_dm = self._dm_resid_one(values, batch, merged, dm_data,
+                                      dm_valid)
+            return jnp.concatenate([r_t, r_dm])
+
+        def body(carry, _):
+            vec, _ = carry
+            r = resid_fn(vec)
+            J = jax.jacfwd(resid_fn)(vec)
+            dpar, cov, _, chi2 = gls_normal_solve(r, J, err, U_wb, phi)
+            return (vec + dpar, chi2), None
+
+        (vec, _), _ = jax.lax.scan(
+            body, (vec0, jnp.float64(0.0)), None, length=maxiter
+        )
+        r = resid_fn(vec)
+        J = jax.jacfwd(resid_fn)(vec)
+        _, cov, _, chi2 = gls_normal_solve(r, J, err, U_wb, phi)
+        return vec, chi2, cov
+
+    def fit_wideband(self, maxiter=3, mesh=None):
+        """Batched wideband fit: stacked [time; DM] residuals per
+        pulsar, the whole (possibly mixed narrowband+wideband) PTA as
+        one XLA program — the batched counterpart of
+        WidebandTOAFitter (reference fitter.py:2292-2640).  Sharding
+        semantics match fit_wls."""
+        U, phi = self._gather_noise()
+        dm_data, dm_error, dm_valid = self._gather_dm()
+        fit = jax.vmap(
+            lambda v, b, bt, c, tb, tc, m, fm, uu, ph, dd, de, dv:
+            self._fit_one_wb(v, b, bt, c, tb, tc, m, fm, uu, ph,
+                             dd, de, dv, maxiter),
+            in_axes=(0, 0, 0, 0,
+                     0 if self.tzr_batch is not None else None,
+                     0 if self.tzr_ctx is not None else None,
+                     0, 0, 0, 0, 0, 0, 0),
+        )
+        return self._run_batched(
+            fit, (self.values0, self.base_values, self.batch, self.ctx,
+                  self.tzr_batch, self.tzr_ctx, self.valid,
+                  self.free_mask, U, phi, dm_data, dm_error, dm_valid),
+            mesh)
 
     def fit_gls(self, maxiter=3, mesh=None):
         """Batched GLS fit: every pulsar's timing parameters against

@@ -87,11 +87,15 @@ class JumpMode(_CategoricalMode):
         toas = pulsar.selected_toas
         cats = ["no jump"] * len(toas)
         model = pulsar.model
+        # index runs across components so a PhaseJump and a DelayJump
+        # never share a legend label (and therefore a color category)
+        i = 0
         for comp_name in ("PhaseJump", "DelayJump"):
             if not model.has_component(comp_name):
                 continue
             comp = model.component(comp_name)
-            for i, sel in enumerate(comp.selects, start=1):
+            for sel in comp.selects:
+                i += 1
                 mask = np.asarray(mask_from_select(sel, toas))
                 for j in np.flatnonzero(mask):
                     cats[int(j)] = f"JUMP{i}"

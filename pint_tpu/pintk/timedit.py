@@ -34,9 +34,14 @@ class TimEditor:
         from pint_tpu.toa import get_TOAs
 
         old = self.psr.all_toas
+        # prefer the source tim's directory so relative INCLUDEs still
+        # resolve, but fall back to the system temp dir when that
+        # directory is read-only (e.g. a mounted data tree)
+        tim_dir = os.path.dirname(os.path.abspath(self.psr.timfile)) or None
+        if tim_dir is not None and not os.access(tim_dir, os.W_OK):
+            tim_dir = None
         with tempfile.NamedTemporaryFile(
-            "w", suffix=".tim", delete=False,
-            dir=os.path.dirname(os.path.abspath(self.psr.timfile)) or None,
+            "w", suffix=".tim", delete=False, dir=tim_dir,
         ) as f:
             f.write(self.text)
             tmp = f.name

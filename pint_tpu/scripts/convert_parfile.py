@@ -21,6 +21,10 @@ def main(argv=None):
                    help="emit STIGMA instead of H4 (ELL1H output only)")
     p.add_argument("--kom", type=float, default=None,
                    help="longitude of ascending node [deg] (DDK output)")
+    p.add_argument("--lossy", action="store_true",
+                   help="allow a binary conversion that sheds physics "
+                        "the target engine cannot represent (e.g. "
+                        "DD->ELL1 drops GAMMA/DR/DTH/A0/B0)")
     p.add_argument("--allow-tcb", action="store_true")
     args = p.parse_args(argv)
 
@@ -32,7 +36,7 @@ def main(argv=None):
 
         model = convert_binary(model, args.binary, nharms=args.nharms,
                                use_stigma=args.usestigma,
-                               kom_deg=args.kom)
+                               kom_deg=args.kom, lossy=args.lossy)
     text = model.as_parfile()
     if args.out:
         with open(args.out, "w") as f:

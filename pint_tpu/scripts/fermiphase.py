@@ -36,12 +36,16 @@ def main(argv=None):
     toas = load_Fermi_TOAs(args.eventfile, weightcolumn=args.weightcol,
                            ephem=model.meta.get("EPHEM", "builtin"))
     print(f"Read {len(toas)} events")
-    keep = np.ones(len(toas), dtype=bool)
+    # original FITS row per TOA (the loader may filter/reorder rows);
+    # --outfile indexes the raw event table through this
+    fits_rows = np.asarray(getattr(toas, "fits_rows",
+                                   np.arange(len(toas))))
     if args.minWeight > 0.0:
         w = np.array(toas.get_flag_values("weight", default=1.0,
                                           astype=float))
         keep = w >= args.minWeight
         toas = toas[keep]
+        fits_rows = fits_rows[keep]
         print(f"Kept {len(toas)} events with weight >= {args.minWeight}")
     prepared = model.prepare(toas)
     _, frac = prepared.phase()
@@ -64,7 +68,7 @@ def main(argv=None):
         from pint_tpu.fits import read_events, write_events
 
         hdr, dat = read_events(args.eventfile)
-        met = np.asarray(dat["TIME"], np.float64)[keep]
+        met = np.asarray(dat["TIME"], np.float64)[fits_rows]
         refi, reff = mjdref_from_header(hdr)
         extra = {"PULSE_PHASE": phases}
         if weights is not None:

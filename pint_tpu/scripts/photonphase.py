@@ -61,8 +61,13 @@ def main(argv=None):
                            ephem=model.meta.get("EPHEM", "builtin"),
                            orbfile=args.orbfile)
     print(f"Read {len(toas)} events")
-    keep = np.ones(len(toas), dtype=bool)
+    # original FITS row per TOA (the loader may filter/reorder rows);
+    # --outfile indexes the raw event table through this, never with a
+    # len(toas)-sized boolean mask
+    fits_rows = np.asarray(getattr(toas, "fits_rows",
+                                   np.arange(len(toas))))
     if args.minMJD is not None or args.maxMJD is not None:
+        keep = np.ones(len(toas), dtype=bool)
         mf = np.asarray(toas.mjd_float)
         if args.minMJD is not None:
             keep &= mf >= args.minMJD
@@ -72,6 +77,7 @@ def main(argv=None):
             raise SystemExit(
                 f"no events in MJD range [{args.minMJD}, {args.maxMJD}]")
         toas = toas[keep]
+        fits_rows = fits_rows[keep]
         print(f"Kept {len(toas)} events in [{args.minMJD}, {args.maxMJD}]")
     if args.polycos:
         if not all(o == "barycenter" for o in toas.obs_names):
@@ -118,7 +124,7 @@ def main(argv=None):
         hdr, dat = _re(args.eventfile, extname=args.extname or
                        _MISSION_EXTNAME.get(args.mission.lower(),
                                             "EVENTS"))
-        met = np.asarray(dat["TIME"], np.float64)[keep]
+        met = np.asarray(dat["TIME"], np.float64)[fits_rows]
         extra = {"PULSE_PHASE": phases}
         if orb_ph is not None:
             extra["ORBIT_PHASE"] = orb_ph

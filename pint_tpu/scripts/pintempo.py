@@ -36,7 +36,9 @@ def main(argv=None):
     stages = StageTimer()
     with stages("Construct model"):
         model = get_model(args.parfile, allow_tcb=args.allow_tcb)
-    planets = model.meta.get("PLANET_SHAPIRO", "N").upper() in ("Y", "1")
+    from pint_tpu.models.builder import planets_requested
+
+    planets = planets_requested(model)
     with stages("Load TOAs"):
         toas = get_TOAs(args.timfile,
                         ephem=model.meta.get("EPHEM", "builtin"),

@@ -109,9 +109,10 @@ def make_fake_toas_fromMJDs(
             TOA(day, num, 10**12, float(error_us), float(f), obs,
                 dict(flags), "fake")
         )
-    planets = bool(model.values.get("PLANET_SHAPIRO", 0.0))
+    from pint_tpu.models.builder import planets_requested
+
     toas = TOAs(toa_list, ephem=model.meta.get("EPHEM", "builtin"),
-                planets=planets)
+                planets=planets_requested(model))
     zero_residuals(toas, model)
     return _apply_noise_products(toas, model, add_noise, wideband,
                                  dm_error, add_correlated, rng)

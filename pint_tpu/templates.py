@@ -31,7 +31,7 @@ __all__ = [
     "LCHarmonic", "LCGaussian2", "LCLorentzian2",
     "LCEmpiricalFourier", "LCKernelDensity",
     "LCTemplate", "LCFitter", "NormAngles",
-    "LCEGaussian", "LCETemplate", "LCEFitter",
+    "LCEGaussian", "LCETemplate", "LCEFitter", "ENormAngles",
     "read_template", "write_template", "prof_string",
     "read_gaussfitfile", "convert_primitive",
 ]
@@ -571,18 +571,73 @@ class LCEGaussian:
         return [self.sigma, self.dsigma, self.loc, self.dloc]
 
 
-class LCETemplate:
-    """Energy-dependent mixture: density(phi, params, log10_en).
-    Norms are energy-independent (the reference's lcenorm energy
-    evolution can ride the same pattern)."""
+class ENormAngles:
+    """Energy-dependent constrained normalizations (reference:
+    lcenorm.py ENormAngles): the NormAngles stick-breaking angles
+    evolve linearly in x = log10(E) - log10(E0), so every component
+    amplitude stays in (0,1) and their sum stays < 1 at EVERY photon
+    energy for any unconstrained parameter values.
 
-    def __init__(self, primitives, norms=None):
-        self.primitives = list(primitives)
-        k = len(self.primitives)
+    Parameter layout: [a_1..a_k, da_1..da_k] (angles, slopes)."""
+
+    def __init__(self, k, log10_e0=2.0):
+        self.k = k
+        self.log10_e0 = log10_e0
+        self._base = NormAngles(k)
+
+    @property
+    def n_params(self):
+        return 2 * self.k
+
+    def to_norms(self, p, log10_en):
+        """p (2k,), log10_en (nphot,) -> norms (nphot, k)."""
+        p = jnp.asarray(p)
+        x = jnp.asarray(log10_en) - self.log10_e0
+        angles = p[None, : self.k] + x[:, None] * p[None, self.k:]
+        total = jnp.sin(angles[:, 0]) ** 2
+        rest = angles[:, 1:]
+        parts = []
+        remaining = total
+        for i in range(self.k - 1):
+            frac = jnp.sin(rest[:, i]) ** 2
+            parts.append(remaining * frac)
+            remaining = remaining * (1.0 - frac)
+        parts.append(remaining)
+        return jnp.stack(parts, axis=-1)
+
+    def init_params(self, norms=None):
+        """Angles reproducing ``norms`` at E0, zero energy slopes."""
         if norms is None:
-            norms = [0.5 / k] * k
+            norms = [0.5 / self.k] * self.k
+        return list(self._base.from_norms(np.asarray(norms))) \
+            + [0.0] * self.k
+
+
+class LCETemplate:
+    """Energy-dependent mixture: density(phi, log10_en, params).
+
+    With ``enorms`` (an :class:`ENormAngles`), component amplitudes
+    evolve with photon energy too (reference lcenorm.py); otherwise
+    norms are energy-independent scalars.  Parameter layout:
+    [norm block, prim1 params, prim2 params, ...] where the norm block
+    is either k plain norms or the 2k ENormAngles (angle, slope)
+    parameters."""
+
+    def __init__(self, primitives, norms=None, enorms=None):
+        self.primitives = list(primitives)
+        self.enorms = enorms
+        k = len(self.primitives)
+        if enorms is not None:
+            if enorms.k != k:
+                raise ValueError(
+                    f"ENormAngles has k={enorms.k} but "
+                    f"{k} primitives")
+            norm_block = enorms.init_params(norms)
+        else:
+            norm_block = list(norms) if norms is not None \
+                else [0.5 / k] * k
         self.params = np.array(
-            list(norms)
+            list(norm_block)
             + [v for p in self.primitives for v in p.init_params()],
             dtype=np.float64,
         )
@@ -591,17 +646,30 @@ class LCETemplate:
     def n_params(self):
         return len(self.params)
 
+    @property
+    def _n_norm(self):
+        return (self.enorms.n_params if self.enorms is not None
+                else len(self.primitives))
+
     def _split(self, params):
-        k = len(self.primitives)
-        out, i = [], k
+        nn = self._n_norm
+        out, i = [], nn
         for p in self.primitives:
             out.append(params[i:i + p.n_params])
             i += p.n_params
-        return params[:k], out
+        return params[:nn], out
 
     def density(self, phi, log10_en, params=None):
         params = jnp.asarray(self.params if params is None else params)
-        norms, pp = self._split(params)
+        norm_block, pp = self._split(params)
+        if self.enorms is not None:
+            norms = self.enorms.to_norms(norm_block, log10_en)
+            out = 1.0 - jnp.sum(norms, axis=-1)
+            for i, (p, q) in enumerate(zip(self.primitives, pp)):
+                out = out + norms[:, i] * p.density(
+                    jnp.asarray(phi), q, jnp.asarray(log10_en))
+            return out
+        norms = norm_block
         out = 1.0 - jnp.sum(norms)
         for p, q, n in zip(self.primitives, pp, jnp.atleast_1d(norms)):
             out = out + n * p.density(jnp.asarray(phi), q,
@@ -758,14 +826,23 @@ class LCEFitter:
     def fit(self, maxiter=200):
         from scipy.optimize import minimize
 
-        k = len(self.template.primitives)
+        nn = self.template._n_norm
         x0 = np.array(self.template.params)
-        bounds = [(1e-4, 1.0)] * k + [(None, None)] * (len(x0) - k)
-        barrier = _norm_barrier(k)
+        if self.template.enorms is not None:
+            # ENormAngles: unconstrained angles/slopes, simplex valid
+            # at every energy by construction — no bounds, no barrier
+            bounds = [(None, None)] * len(x0)
+            barrier = None
+        else:
+            bounds = [(1e-4, 1.0)] * nn \
+                + [(None, None)] * (len(x0) - nn)
+            barrier = _norm_barrier(nn)
 
         def fun(x):
             xj = jnp.asarray(x)
             v, g = self._val_grad(xj)
+            if barrier is None:
+                return float(v), np.asarray(g, np.float64)
             vb, gb = barrier(xj)
             return float(v + vb), np.asarray(g + gb, np.float64)
 

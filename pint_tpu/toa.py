@@ -340,12 +340,13 @@ def _read_tim_into(path, toas, state, depth):
 
 
 class TOAs:
+    """Host-side TOA table (struct of numpy arrays + python flag dicts)."""
+
     # class-level defaults for objects revived via object.__new__ paths
     # (slicing/merge/cache); __init__ and get_TOAs set the real values
     include_clock = True
     include_bipm = False
     bipm_version = "BIPM2019"
-    """Host-side TOA table (struct of numpy arrays + python flag dicts)."""
 
     def __init__(self, toa_list, ephem="builtin", planets=False,
                  include_clock=True, include_bipm=False,

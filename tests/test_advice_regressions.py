@@ -123,3 +123,137 @@ class TestPolycoRphaseCarry:
         total_out = b.rphase_int + b.rphase_frac
         assert abs(total_out - total_in) < 1e-8
         assert b.rphase_int == 12346
+
+
+# --- round-3 advisor findings ----------------------------------------------
+
+
+class TestRound3Advice:
+    def test_toas_docstring_survives_attribute_defaults(self):
+        """(r3-2) class docstring must be the first statement, not a
+        stray string after the class-level defaults."""
+        from pint_tpu.toa import TOAs
+
+        assert TOAs.__doc__ and "TOA table" in TOAs.__doc__
+
+    def test_pintempo_planet_shapiro_from_values(self, tmp_path):
+        """(r3-1) PLANET_SHAPIRO parsed as a registered bool parameter
+        (model.values) must still trigger planet posvels in pintempo."""
+        from pint_tpu.models import get_model
+        from pint_tpu.simulation import make_fake_toas_uniform
+        from pint_tpu.toa import write_tim
+
+        par = (
+            "PSR FAKE\nRAJ 05:00:00\nDECJ 10:00:00\n"
+            "F0 100.0 1\nPEPOCH 55000\nDM 10\nPLANET_SHAPIRO Y\n"
+            "TZRMJD 55000\nTZRSITE @\nTZRFRQ 1400\n"
+            "UNITS TDB\nEPHEM builtin\n"
+        )
+        model = get_model(par)
+        # precondition of the bug: the keyword lands in values, not meta
+        assert "PLANET_SHAPIRO" not in model.meta
+        assert bool(model.values.get("PLANET_SHAPIRO", 0.0))
+
+        toas = make_fake_toas_uniform(54990, 55010, 6, model, obs="gbt")
+        parfile = tmp_path / "fake.par"
+        timfile = tmp_path / "fake.tim"
+        parfile.write_text(par)
+        write_tim(toas, str(timfile))
+
+        from pint_tpu.scripts import pintempo
+
+        pintempo.main([str(parfile), str(timfile), "--nofit"])
+
+    def test_jump_labels_unique_across_components(self):
+        """(r3-3) a PhaseJump and a DelayJump must not share a legend
+        label (and so a color category) in pintk's jump color mode."""
+        from pint_tpu.models import get_model
+        from pint_tpu.pintk.colormodes import JumpMode
+        from pint_tpu.pintk.pulsar import Pulsar
+        from pint_tpu.simulation import make_fake_toas_uniform
+        from pint_tpu.toa import write_tim
+
+        par = (
+            "PSR FAKE\nRAJ 05:00:00\nDECJ 10:00:00\n"
+            "F0 100.0 1\nPEPOCH 55000\nDM 10\n"
+            "TZRMJD 55000\nTZRSITE @\nTZRFRQ 1400\n"
+            "JUMP -f A 1e-6 1\n"
+            "JUMP -f B 2e-6 1\n"
+            "UNITS TDB\nEPHEM builtin\n"
+        )
+        model = get_model(par)
+        comps = [c for c in ("PhaseJump", "DelayJump")
+                 if model.has_component(c)]
+        toas = make_fake_toas_uniform(
+            54990, 55010, 8, model, obs="gbt",
+            flags={"f": "A"})
+        for i in range(4, 8):
+            toas.flags[i]["f"] = "B"
+
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as d:
+            parfile = os.path.join(d, "fake.par")
+            timfile = os.path.join(d, "fake.tim")
+            with open(parfile, "w") as f:
+                f.write(par)
+            write_tim(toas, timfile)
+            psr = Pulsar(parfile, timfile)
+            cats = JumpMode().categories(psr)
+        labels = sorted(set(cats) - {"no jump"})
+        # two selectors => two distinct labels, regardless of which
+        # component(s) they landed in
+        assert len(labels) == 2, (labels, comps)
+
+    def test_timedit_apply_readonly_tim_dir(self, tmp_path):
+        """(r3-4) TimEditor.apply must fall back to the system temp dir
+        when the tim file's directory is not writable."""
+        from pint_tpu.models import get_model
+        from pint_tpu.pintk.pulsar import Pulsar
+        from pint_tpu.pintk.timedit import TimEditor
+        from pint_tpu.simulation import make_fake_toas_uniform
+        from pint_tpu.toa import write_tim
+
+        par = (
+            "PSR FAKE\nRAJ 05:00:00\nDECJ 10:00:00\n"
+            "F0 100.0 1\nPEPOCH 55000\nDM 10\n"
+            "TZRMJD 55000\nTZRSITE @\nTZRFRQ 1400\n"
+            "UNITS TDB\nEPHEM builtin\n"
+        )
+        model = get_model(par)
+        toas = make_fake_toas_uniform(54990, 55010, 6, model, obs="gbt")
+        d = tmp_path / "data"
+        d.mkdir()
+        parfile = d / "fake.par"
+        timfile = d / "fake.tim"
+        parfile.write_text(par)
+        write_tim(toas, str(timfile))
+        psr = Pulsar(str(parfile), str(timfile))
+        ed = TimEditor(psr)
+        os.chmod(d, 0o555)  # read-only directory
+        try:
+            if os.access(d, os.W_OK):  # running as root: chmod no-op
+                pytest.skip("cannot make directory read-only here")
+            ed.apply()
+        finally:
+            os.chmod(d, 0o755)
+        assert len(psr.all_toas) == 6
+
+    def test_event_loader_exposes_fits_rows(self, tmp_path):
+        """(r3-5) load_event_TOAs must expose original FITS row indices
+        so --outfile writers never misalign after loader-side filters
+        (e.g. an energy cut)."""
+        from pint_tpu.event_toas import load_event_TOAs
+        from pint_tpu.fits import write_events
+
+        path = str(tmp_path / "evt.fits")
+        met = np.array([100.0, 200.0, 300.0, 400.0])
+        pi = np.array([10.0, 500.0, 20.0, 600.0])
+        write_events(path, met, mjdref=(55000, 0.0), timesys="TT",
+                     timeref="LOCAL", extra_cols={"PI": pi})
+        toas = load_event_TOAs(path, "nicer",
+                               energy_range_kev=(0.0, 3.0))
+        rows = np.asarray(toas.fits_rows)
+        # NICER PI -> keV is PI/100: rows 0 and 2 survive a 0-3 keV cut
+        assert list(rows) == [0, 2]
+        assert len(toas) == 2

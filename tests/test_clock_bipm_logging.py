@@ -90,6 +90,9 @@ class TestBIPM:
 
     def test_find_exact_and_fallback(self, tmp_path, monkeypatch):
         monkeypatch.setenv("PINT_TPU_CLOCK_DIR", str(tmp_path))
+        # fallback semantics need a controlled set of realizations:
+        # hide the bundled tai2tt files
+        monkeypatch.setenv("PINT_TPU_NO_BUILTIN_DATA", "1")
         self._write_bipm(tmp_path, 2017, 27.6e-6)
         self._write_bipm(tmp_path, 2015, 27.0e-6)
         cf = find_bipm_correction("BIPM2017")
@@ -139,8 +142,10 @@ class TestBIPM:
                                     include_bipm=False)
         dt = (t1.ticks - t2.ticks) / 2**32
         assert np.allclose(dt, 27.6e-6, atol=1e-9)
-        # and without the data file, a loud warning
+        # and without the data file, a loud warning (bundled runtime
+        # data would otherwise satisfy the request)
         monkeypatch.setenv("PINT_TPU_CLOCK_DIR", str(tmp_path / "none"))
+        monkeypatch.setenv("PINT_TPU_NO_BUILTIN_DATA", "1")
         with W.catch_warnings(record=True) as rec:
             W.simplefilter("always")
             get_model_and_toas(str(par), str(tim))
@@ -217,6 +222,7 @@ class TestDatacheck:
     def test_report_no_data(self, monkeypatch, tmp_path):
         monkeypatch.delenv("PINT_TPU_CLOCK_DIR", raising=False)
         monkeypatch.delenv("PINT_TPU_IERS_DIR", raising=False)
+        monkeypatch.setenv("PINT_TPU_NO_BUILTIN_DATA", "1")
         monkeypatch.chdir(tmp_path)  # no ./clock, ./iers
         from pint_tpu.datacheck import datacheck_report
 

@@ -1,0 +1,102 @@
+"""Stratified full-chain sweep: one real reference par/tim pair per
+component family, in the DEFAULT suite.
+
+The exhaustive matched-pair sweep stays behind PINT_TPU_FULL_GOLDEN=1
+(test_endtoend.py); this slice keeps every family end-to-end-tested on
+real data files on every run, so the strongest correctness evidence
+cannot rot between full runs.  Families (VERDICT round-3 item 4):
+isolated, ELL1+red-noise GLS, DD, DDK, wideband, glitch/prefix, DMX,
+red-noise GLS, WAVE, IFUNC.
+
+Reference data: /root/reference/tests/datafile (same pairs the
+reference's own test_B1855.py / test_ddk.py / test_wideband.py use).
+"""
+
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+D = "/root/reference/tests/datafile"
+
+#: (family, par, tim) — one per component family
+FAMILIES = [
+    ("isolated", "NGC6440E.par", "NGC6440E.tim"),
+    ("ell1_gls", "J0023+0923_NANOGrav_11yv0.gls.par",
+     "J0023+0923_NANOGrav_11yv0.tim"),
+    ("dd", "B1855+09_NANOGrav_dfg+12_modified_DD.par",
+     "B1855+09_NANOGrav_dfg+12.tim"),
+    ("ddk", "J1713+0747_NANOGrav_11yv0_short.gls.par",
+     "J1713+0747_NANOGrav_11yv0_short.tim"),
+    ("wideband", "B1855+09_NANOGrav_12yv3.wb.gls.par",
+     "B1855+09_NANOGrav_12yv3.wb.tim"),
+    ("glitch_prefix", "prefixtest.par", "prefixtest.tim"),
+    ("dmx", "B1855+09_NANOGrav_dfg+12_DMX.par",
+     "B1855+09_NANOGrav_dfg+12.tim"),
+    ("rednoise_gls", "B1855+09_NANOGrav_9yv1.gls.par",
+     "B1855+09_NANOGrav_9yv1.tim"),
+    ("wave", "vela_wave.par", "vela_wave.tim"),
+    ("ifunc", "j0007_ifunc.par", "j0007_ifunc.tim"),
+]
+
+
+def _load(par, tim):
+    from pint_tpu.models.builder import get_model_and_toas
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return get_model_and_toas(os.path.join(D, par),
+                                  os.path.join(D, tim))
+
+
+@pytest.mark.parametrize("family,par,tim",
+                         FAMILIES, ids=[f[0] for f in FAMILIES])
+def test_family_end_to_end(family, par, tim):
+    """Load real par+tim, compute residuals, finite chi2, and a
+    sane weighted RMS (below the model's wrap plateau ~ P/sqrt(12),
+    loose enough for prefit residuals on every dataset)."""
+    from pint_tpu.residuals import Residuals
+
+    m, toas = _load(par, tim)
+    r = Residuals(toas, m, subtract_mean=True,
+                  use_weighted_mean=False, track_mode="nearest")
+    chi2 = float(r.chi2)
+    assert np.isfinite(chi2) and chi2 > 0
+    p0 = 1.0 / float(m.values["F0"])
+    assert np.std(np.asarray(r.time_resids)) < p0  # < one turn
+
+
+def test_family_fits_converge():
+    """One real fit per fitter class across the families: WLS
+    (isolated), GLS (red-noise), wideband (TOA+DM)."""
+    from pint_tpu.fitter import Fitter, GLSFitter
+
+    m, toas = _load("NGC6440E.par", "NGC6440E.tim")
+    f = Fitter.auto(toas, m)
+    f.fit_toas()
+    assert f.resids.rms_weighted() < 100e-6  # reference walkthrough ~us
+
+    m, toas = _load("J0023+0923_NANOGrav_11yv0.gls.par",
+                    "J0023+0923_NANOGrav_11yv0.tim")
+    f = GLSFitter(toas, m)
+    f.fit_toas(maxiter=2)
+    assert np.isfinite(float(f.resids.chi2))
+
+    # wideband: the builtin-ephemeris ms-scale systematic makes the
+    # raw GN step diverge along the Shapiro degeneracy on this real
+    # 12.5-yr set, so use the step-controlled downhill variant (the
+    # reference grew its Downhill family for the same reason,
+    # fitter.py:1069)
+    from pint_tpu.downhill import WidebandDownhillFitter
+
+    m, toas = _load("B1855+09_NANOGrav_12yv3.wb.gls.par",
+                    "B1855+09_NANOGrav_12yv3.wb.tim")
+    from pint_tpu.residuals import WidebandTOAResiduals
+
+    chi2_pre = float(WidebandTOAResiduals(toas, m).chi2)
+    f = WidebandDownhillFitter(toas, m)
+    f.fit_toas(maxiter=5)
+    chi2_post = float(f.resids.chi2)
+    assert np.isfinite(chi2_post) and chi2_post < chi2_pre
+    assert 0.0 < float(m.values["SINI"]) <= 1.0

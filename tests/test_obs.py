@@ -153,6 +153,7 @@ class TestClockFile:
 
     def test_noclock_warns_once(self, tmp_path, monkeypatch):
         monkeypatch.chdir(tmp_path)
+        monkeypatch.setenv("PINT_TPU_NO_BUILTIN_DATA", "1")
         obs = get_observatory("effelsberg")
         obs._clock_chain = None
         obs._warned_noclock = False

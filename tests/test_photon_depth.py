@@ -367,3 +367,55 @@ class TestT2Binary:
         main([str(p), str(out)])
         text = out.read_text()
         assert "BINARY" in text and "BT" in text
+
+
+class TestEnergyDependentNorms:
+    """ENormAngles (reference lcenorm.py): component amplitudes evolve
+    with photon energy while staying a valid simplex at EVERY energy."""
+
+    def test_simplex_at_every_energy(self):
+        from pint_tpu.templates import ENormAngles
+
+        en = ENormAngles(3)
+        rng = np.random.default_rng(2)
+        p = rng.uniform(-2, 2, 6)
+        log10_en = rng.uniform(1.0, 5.0, 200)
+        norms = np.asarray(en.to_norms(p, log10_en))
+        assert norms.shape == (200, 3)
+        assert np.all(norms >= 0)
+        assert np.all(norms.sum(axis=1) <= 1.0 + 1e-9)
+
+    def test_init_params_reproduce_norms_at_e0(self):
+        from pint_tpu.templates import ENormAngles
+
+        en = ENormAngles(2, log10_e0=2.0)
+        p = np.array(en.init_params([0.3, 0.4]))
+        norms = np.asarray(en.to_norms(p, np.array([2.0])))
+        assert np.allclose(norms[0], [0.3, 0.4], atol=1e-6)
+
+    def test_energy_evolving_norm_recovery(self):
+        """Simulate a pulse whose pulsed fraction GROWS with energy;
+        the ENormAngles fit must recover an increasing amplitude."""
+        from pint_tpu.templates import (
+            ENormAngles, LCEFitter, LCEGaussian, LCETemplate)
+
+        rng = np.random.default_rng(3)
+        n = 6000
+        log10_en = rng.uniform(2.0, 4.0, n)
+        x = log10_en - 2.0
+        pulsed_frac = 0.3 + 0.25 * x / 2.0  # 0.3 at E0 -> 0.55
+        is_pulsed = rng.random(n) < pulsed_frac
+        phases = np.where(is_pulsed,
+                          rng.normal(0.5, 0.04, n), rng.random(n)) % 1.0
+        tpl = LCETemplate(
+            [LCEGaussian(sigma=0.05, dsigma=0.0, loc=0.48, dloc=0.0)],
+            norms=[0.4], enorms=ENormAngles(1))
+        f = LCEFitter(tpl, phases, log10_en)
+        params, lnl = f.fit()
+        norms_lo = float(np.asarray(
+            tpl.enorms.to_norms(params[:2], np.array([2.0])))[0, 0])
+        norms_hi = float(np.asarray(
+            tpl.enorms.to_norms(params[:2], np.array([4.0])))[0, 0])
+        assert abs(norms_lo - 0.3) < 0.06
+        assert abs(norms_hi - 0.55) < 0.08
+        assert norms_hi > norms_lo + 0.1

@@ -373,3 +373,109 @@ class TestHeterogeneousNoiseStructure:
         assert float(batch.free_mask[0, j]) == 0.0
         vec, chi2, _ = batch.fit_wls(maxiter=2)
         assert np.all(np.isfinite(np.asarray(chi2)))
+
+
+def _mixed_pairs(n, seed=0, with_noise=False):
+    """n pulsars cycling isolated / ELL1 / DD / DDK / wideband-DMX —
+    the component mix of a real PTA array (VERDICT r3 item 5)."""
+    noise = ("EFAC -f L 1.1\nEQUAD -f L 0.4\n"
+             "TNRedAmp -13.5\nTNRedGam 3.3\nTNRedC 10\n"
+             if with_noise else "")
+    bins = [
+        "",
+        "BINARY ELL1\nPB 12.5 1\nA1 9.2 1\nTASC 54500.5 1\n"
+        "EPS1 1e-5 1\nEPS2 -2e-5 1\n",
+        "BINARY DD\nPB 8.3 1\nA1 6.1 1\nT0 54500.2 1\nECC 0.17 1\n"
+        "OM 110.0 1\n",
+        "BINARY DDK\nPB 67.8 1\nA1 32.3 1\nT0 54500.2 1\nECC 0.07 1\n"
+        "OM 176.0 1\nKIN 71.7\nKOM 90.0\nM2 0.28\nPMRA -2.0 1\n"
+        "PMDEC -3.0 1\nPX 0.9 1\n",
+        "DMDATA 1\n",
+    ]
+    pairs, kinds = [], []
+    for i in range(n):
+        kind = i % len(bins)
+        par = (PAR_TEMPLATE.format(
+            i=i, ra=f"{(5 + i) % 24:02d}:00:00", f0=100.0 + 17.0 * i,
+            dm=10.0 + 1.5 * i) + bins[kind] + noise)
+        m = get_model(par)
+        ntoa = 40
+        toas = make_fake_toas_uniform(
+            54000, 56000, ntoa, m,
+            freq_mhz=np.where(np.arange(ntoa) % 2 == 0, 1400.0, 800.0),
+            obs="gbt", error_us=1.0, add_noise=True,
+            rng=np.random.default_rng(seed + i),
+            wideband=(kind == 4), dm_error=2e-4,
+            flags={"f": "L"})
+        pairs.append((m, toas))
+        kinds.append(kind)
+    return pairs, kinds
+
+
+class TestMixedArrayBatch:
+    """A real-array-shaped batch: 32 pulsars mixing isolated, ELL1,
+    DD, DDK and wideband members, fit as ONE program on the 8-virtual-
+    device mesh (VERDICT round-3 item 5 'done' criterion).  Built once
+    (class-scoped) — superset construction + the vmapped compile
+    dominate the cost."""
+
+    @pytest.fixture(scope="class")
+    def batch32(self):
+        pairs, kinds = _mixed_pairs(32, seed=7)
+        batch = PTABatch(pairs)
+        vec, chi2, cov = batch.fit_wideband(maxiter=2,
+                                            mesh=pulsar_mesh())
+        return pairs, kinds, batch, np.asarray(chi2)
+
+    def test_mesh_fit_finite(self, batch32):
+        pairs, kinds, batch, chi2 = batch32
+        assert chi2.shape == (32,)
+        assert np.all(np.isfinite(chi2))
+
+    def test_matches_single_pulsar_fitters(self, batch32):
+        """isolated / DDK / wideband members agree with their
+        single-pulsar fitters."""
+        from pint_tpu.fitter import WLSFitter, WidebandTOAFitter
+
+        pairs, kinds, batch, chi2 = batch32
+        for k in (0, 3, 4):  # isolated, DDK, wideband
+            m, toas = pairs[k]
+            m2 = get_model(m.as_parfile())
+            f = (WidebandTOAFitter(toas, m2) if kinds[k] == 4
+                 else WLSFitter(toas, m2))
+            f.fit_toas(maxiter=2)
+            single = float(f.resids.chi2)
+            assert np.isclose(chi2[k], single, rtol=5e-3), (
+                kinds[k], chi2[k], single)
+            if kinds[k] == 4:  # wideband: parameters too
+                assert np.isclose(
+                    batch.prepareds[k].model.values["DM"],
+                    m2.values["DM"], rtol=1e-8)
+
+    def test_ddk_kopeikin_active_in_batch(self, batch32):
+        """The DDK pulsar's Kopeikin terms must be LIVE in the batch
+        (gate=1), not neutralized: zeroing PX must change its batched
+        residuals."""
+        pairs, kinds, batch, chi2 = batch32
+        k = kinds.index(3)
+        vals0 = np.asarray(batch.values0)
+        r0 = np.asarray(batch.residuals(jax.numpy.asarray(vals0)))[k]
+        j = batch.free_names.index("PX")
+        vals2 = vals0.copy()
+        vals2[k, j] = 0.0
+        r1 = np.asarray(batch.residuals(jax.numpy.asarray(vals2)))[k]
+        assert np.max(np.abs(r1 - r0)) > 1e-10
+
+    def test_inert_ddk_is_nan_free_and_gated(self, batch32):
+        """Pulsars WITHOUT DDK get the inert copy: residuals finite,
+        KIN pinned at the non-singular neutral override."""
+        pairs, kinds, batch, chi2 = batch32
+        r = np.asarray(batch.residuals())
+        assert np.all(np.isfinite(r))
+        k = kinds.index(2)  # a DD pulsar (inert DDK member)
+        m = batch.prepareds[k].model
+        assert "BinaryDDK" in getattr(m, "_superset_inert", set())
+        assert float(m.values["KIN"]) == 1.0  # neutral_overrides
+        # KIN is frozen everywhere (no fit flag in any par), so it must
+        # not appear in the batch's free-parameter union at all
+        assert "KIN" not in batch.free_names

@@ -1,0 +1,151 @@
+"""Bundled runtime data (pint_tpu/data/runtime): the default
+configuration must run warning-free with a complete clock chain, apply
+the BIPM realization requested by a par CLK line, and remain
+overridable ($PINT_TPU_CLOCK_DIR / ./clock take priority;
+$PINT_TPU_NO_BUILTIN_DATA disables the bundle for missing-data tests).
+
+Reference analogue: src/pint/data/runtime/ package data plus the
+global_clock_corrections.py download cache (zero-egress here, so the
+bundle ships placeholders with documented error bounds — see
+tools/make_runtime_data.py).
+"""
+
+import os
+import warnings as W
+
+import numpy as np
+import pytest
+
+REF = "/root/reference/tests/datafile"
+B1855_PAR = os.path.join(REF, "B1855+09_NANOGrav_9yv1.gls.par")
+B1855_TIM = os.path.join(REF, "B1855+09_NANOGrav_9yv1.tim")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_clock_chains(monkeypatch):
+    """Obs instances cache clock chains; these tests flip data
+    visibility, so reset the caches around each test."""
+    from pint_tpu.obs import Observatory
+
+    def reset():
+        for obs in set(Observatory._registry.values()):
+            obs._clock_chain = None
+            obs._warned_noclock = False
+
+    reset()
+    yield
+    reset()
+
+
+class TestBundledChain:
+    def test_builtin_dir_exists_and_lists(self):
+        from pint_tpu.obs.datadirs import builtin_runtime_dir
+
+        d = builtin_runtime_dir()
+        files = os.listdir(d)
+        assert "gps2utc.clk" in files
+        assert "wsrt2gps.clk" in files
+        assert any(f.startswith("tai2tt_bipm") for f in files)
+
+    def test_default_chain_is_warning_free(self, monkeypatch, tmp_path):
+        monkeypatch.chdir(tmp_path)  # no ./clock override
+        monkeypatch.delenv("PINT_TPU_CLOCK_DIR", raising=False)
+        from pint_tpu.obs import get_observatory
+
+        obs = get_observatory("gbt")
+        with W.catch_warnings():
+            W.simplefilter("error")  # any warning fails
+            v = obs.clock_corrections_sec(np.array([55000.0]))
+        assert np.all(v == 0.0)  # placeholder-zero, documented
+
+    def test_wsrt_real_tabulation_nonzero(self, monkeypatch, tmp_path):
+        monkeypatch.chdir(tmp_path)
+        monkeypatch.delenv("PINT_TPU_CLOCK_DIR", raising=False)
+        from pint_tpu.obs import get_observatory
+
+        obs = get_observatory("wsrt")
+        v = obs.clock_corrections_sec(np.array([51200.0]))
+        # the real WSRT->GPS table is ~0.1-1 us in 1999
+        assert 1e-8 < abs(float(v[0])) < 5e-6
+
+    def test_no_builtin_escape_hatch(self, monkeypatch, tmp_path):
+        monkeypatch.chdir(tmp_path)
+        monkeypatch.delenv("PINT_TPU_CLOCK_DIR", raising=False)
+        monkeypatch.setenv("PINT_TPU_NO_BUILTIN_DATA", "1")
+        from pint_tpu.obs import get_observatory
+
+        obs = get_observatory("gbt")
+        with pytest.warns(UserWarning, match="no clock files"):
+            obs.clock_corrections_sec(np.array([55000.0]))
+
+    def test_user_dir_overrides_builtin(self, monkeypatch, tmp_path):
+        clock = tmp_path / "clock"
+        clock.mkdir()
+        (clock / "gbt2gps.clk").write_text(
+            "# UTC(GBT) UTC(GPS)\n50000.0 3.0e-6\n60000.0 3.0e-6\n")
+        monkeypatch.setenv("PINT_TPU_CLOCK_DIR", str(clock))
+        from pint_tpu.obs import get_observatory
+
+        obs = get_observatory("gbt")
+        v = obs.clock_corrections_sec(np.array([55000.0]))
+        # user site file (3 us) + bundled gps2utc (0) — not the
+        # bundled gbt placeholder
+        assert np.allclose(v, 3.0e-6)
+
+    def test_datacheck_reports_complete(self, monkeypatch, tmp_path):
+        monkeypatch.chdir(tmp_path)
+        monkeypatch.delenv("PINT_TPU_CLOCK_DIR", raising=False)
+        from pint_tpu.datacheck import datacheck_report
+
+        text = "\n".join(datacheck_report())
+        assert "clock chain complete" in text
+        assert "placeholder-zero" in text  # honesty marker
+        assert "1 real tabulation" in text  # wsrt
+        assert "BIPM realization: available" in text
+
+
+class TestBipmEndToEnd:
+    def test_b1855_par_clk_bipm2019_applied(self, monkeypatch, tmp_path):
+        """The real B1855 9yv1 par carries ``CLK TT(BIPM2019)``
+        (reference test_B1855.py dataset); with the bundled
+        tai2tt_bipm2019.clk the realization offset (~27.667 us) must
+        enter the TOA ticks by default."""
+        monkeypatch.chdir(tmp_path)
+        monkeypatch.delenv("PINT_TPU_CLOCK_DIR", raising=False)
+        from pint_tpu.models.builder import get_model_and_toas
+
+        with W.catch_warnings(record=True) as rec:
+            W.simplefilter("always")
+            m1, t1 = get_model_and_toas(B1855_PAR, B1855_TIM,
+                                        use_cache=False)
+        assert not any("no clock files" in str(w.message) for w in rec)
+        assert not any("BIPM" in str(w.message)
+                       and "not found" in str(w.message) for w in rec)
+        _, t0 = get_model_and_toas(B1855_PAR, B1855_TIM,
+                                   include_bipm=False, use_cache=False)
+        dt = np.asarray(t1.ticks - t0.ticks, dtype=np.float64) / 2**32
+        assert np.allclose(dt, 27.667e-6, atol=5e-9)
+
+
+class TestBipmConstants:
+    def test_bundled_bipm_value(self, monkeypatch, tmp_path):
+        """find_bipm_correction must surface the 27.667 us realization
+        offset (file value minus exact TT-TAI = 32.184 s)."""
+        monkeypatch.chdir(tmp_path)
+        monkeypatch.delenv("PINT_TPU_CLOCK_DIR", raising=False)
+        from pint_tpu.obs.clock import find_bipm_correction
+
+        for version in ("BIPM2019", "BIPM2017", "TT(BIPM2021)"):
+            cf = find_bipm_correction(version)
+            assert cf is not None, version
+            v = cf.evaluate_sec(np.array([55000.0]))
+            assert np.allclose(v, 27.667e-6, atol=1e-12)
+
+    def test_bipm2020_falls_back_to_2019(self, monkeypatch, tmp_path):
+        monkeypatch.chdir(tmp_path)
+        monkeypatch.delenv("PINT_TPU_CLOCK_DIR", raising=False)
+        from pint_tpu.obs.clock import find_bipm_correction
+
+        cf = find_bipm_correction("BIPM2020")
+        assert cf is not None
+        assert "2019" in os.path.basename(cf.filename)

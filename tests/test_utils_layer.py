@@ -336,3 +336,38 @@ def test_convert_binary_options():
     with pytest.warns(UserWarning, match="KOM"):
         k3 = convert_binary(d, "DDK")
     assert float(k3.values["KOM"]) == 0.0
+
+
+class TestLossyBinaryConvert:
+    """DD->ELL1 sheds GAMMA/DR/DTH/A0/B0 (the ELL1 engine has no such
+    terms): convert_binary must refuse unless lossy=True (reference
+    binaryconvert.py raises on non-representable conversions)."""
+
+    DDPAR = PAR + """BINARY DD
+PB 5.741 1
+A1 3.3667 1
+T0 54900.1
+ECC 0.0071 1
+OM 110.0 1
+GAMMA 2.1e-4
+M2 0.25
+SINI 0.97
+"""
+
+    def test_raises_by_default(self):
+        m = get_model(self.DDPAR)
+        with pytest.raises(ValueError, match="GAMMA"):
+            convert_binary(m, "ELL1")
+
+    def test_lossy_escape_hatch_warns_and_sheds(self):
+        m = get_model(self.DDPAR)
+        with pytest.warns(UserWarning, match="drops parameters"):
+            mell = convert_binary(m, "ELL1", lossy=True)
+        assert mell.meta["BINARY"] == "ELL1"
+        assert "GAMMA" in mell.meta.get("__unknown__", {})
+
+    def test_lossless_conversion_unaffected(self):
+        m = get_model(self.DDPAR)
+        # DD -> DDS keeps GAMMA: no error without lossy
+        mdds = convert_binary(m, "DDS")
+        assert mdds.values["GAMMA"] == pytest.approx(2.1e-4, rel=1e-10)

@@ -1,0 +1,135 @@
+"""Generate pint_tpu/data/runtime/ — the in-package clock/BIPM chain.
+
+This environment is zero-egress: the IPTA clock-corrections repository
+and BIPM Circular T are unreachable, so the shipped files are built
+from (a) the one real clock tabulation available in the environment
+(the reference test tree's WSRT->GPS file, a data table, not code) and
+(b) published physical constants/bounds written out explicitly:
+
+- ``gps2utc.clk``: UTC - UTC(GPS).  BIPM Circular T keeps this below
+  ~1 us before 1995 and below ~50 ns after; with no tabulation
+  available it is shipped as zero WITH that error bound in the header.
+- ``tai2tt_bipmYYYY.clk``: TT(BIPMyy) - TAI.  The realization offset
+  from TT(TAI) = TAI + 32.184 s is ~27.667 us, drifting < ~0.5 us over
+  1995-2025 (BIPM annual TT(BIPM) computations); shipped as the
+  constant 32.184 s + 27.667 us.  This converts a 27.7 us systematic
+  (ignoring the realization entirely, the pre-round-4 behavior when no
+  file was present) into a sub-us one.
+- ``<site>2gps.clk``: site clock vs GPS.  Real tabulations exist only
+  in the (unreachable) IPTA repo; shipped as PLACEHOLDER-ZERO files so
+  the assumption is a *documented data statement* (visible to
+  ``datacheck``, replaceable by dropping in real files of the same
+  name) instead of a code fallback, with the historical |site-GPS| ~
+  0.1-1 us bound in each header.
+
+Reference analogue: src/pint/observatory/global_clock_corrections.py
+downloads these same names at runtime; src/pint/data/runtime/ ships
+static runtime data in-package.
+
+Run from the repo root: ``python tools/make_runtime_data.py``.
+"""
+
+import os
+import shutil
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "pint_tpu", "data", "runtime")
+
+WSRT_SRC = "/root/reference/tests/datafile/wsrt2gps.clk"
+
+#: canonical observatory names that get placeholder site->GPS files
+#: (wsrt gets the real file above)
+PLACEHOLDER_SITES = [
+    "gbt", "arecibo", "jodrell", "parkes", "effelsberg", "nancay",
+    "gmrt", "vla", "fast", "meerkat", "chime",
+]
+
+#: full-coverage span: GPS epoch (MJD 44244, 1980-01-06) .. 2026
+SPAN = (44244.0, 61000.0)
+
+TT_MINUS_TAI = 32.184
+#: TT(BIPM) - TT(TAI) realization offset, seconds (see module docstring)
+BIPM_REALIZATION_OFFSET = 27.667e-6
+BIPM_YEARS = [2015, 2017, 2019, 2021]
+
+
+def _write_clk(path, hdr_from, hdr_to, rows, comments):
+    with open(path, "w") as f:
+        f.write(f"# {hdr_from} {hdr_to}\n")
+        for ln in comments:
+            f.write(f"# {ln}\n")
+        for mjd, off in rows:
+            f.write(f"{mjd:.2f} {off:.12e}\n")
+
+
+def main():
+    os.makedirs(OUT, exist_ok=True)
+
+    # 1. the one real tabulation available: WSRT -> GPS (data table
+    #    from the reference test tree, provenance-stamped)
+    dst = os.path.join(OUT, "wsrt2gps.clk")
+    with open(WSRT_SRC) as src, open(dst, "w") as out:
+        out.write("# provenance: reference tests/datafile/wsrt2gps.clk "
+                  "(real WSRT->GPS tabulation; a data table bundled "
+                  "per-verdict, not code)\n")
+        shutil.copyfileobj(src, out)
+
+    # 2. GPS -> UTC: zero, with the Circular T bound documented
+    _write_clk(
+        os.path.join(OUT, "gps2utc.clk"), "UTC(GPS)", "UTC",
+        [(SPAN[0], 0.0), (SPAN[1], 0.0)],
+        ["PLACEHOLDER-ZERO: no BIPM Circular T tabulation available in "
+         "the build environment (zero egress).",
+         "Error bound of the zero assumption: |UTC-UTC(GPS)| < ~1 us "
+         "before MJD 49700 (1995), < ~50 ns after.",
+         "Replace with a real gps2utc.clk (same name, any search dir) "
+         "to remove this term from the error budget."])
+
+    # 3. TT(BIPMyy) - TAI realization files
+    for yr in BIPM_YEARS:
+        _write_clk(
+            os.path.join(OUT, f"tai2tt_bipm{yr}.clk"),
+            "TAI", f"TT(BIPM{yr})",
+            [(43144.0, TT_MINUS_TAI + BIPM_REALIZATION_OFFSET),
+             (SPAN[1], TT_MINUS_TAI + BIPM_REALIZATION_OFFSET)],
+            [f"APPROXIMATE: constant TT(BIPM{yr}) - TAI = 32.184 s + "
+             "27.667 us (published realization offset).",
+             "The true tabulation drifts < ~0.5 us over 1995-2025; "
+             "using the constant bounds the error at that level "
+             "(vs 27.7 us when the realization is ignored).",
+             "Replace with the real BIPM tabulation to remove the "
+             "drift term."])
+
+    # 4. per-site placeholders
+    for site in PLACEHOLDER_SITES:
+        _write_clk(
+            os.path.join(OUT, f"{site}2gps.clk"),
+            site.upper(), "UTC(GPS)",
+            [(SPAN[0], 0.0), (SPAN[1], 0.0)],
+            ["PLACEHOLDER-ZERO: no site-clock tabulation available in "
+             "the build environment (the IPTA clock-corrections repo "
+             "is unreachable; zero egress).",
+             "Error bound of the zero assumption: |site-GPS| ~ 0.1-1 "
+             "us historically for this class of site clock.",
+             f"Replace with the real {site}2gps.clk to remove this "
+             "term from the error budget."])
+
+    readme = os.path.join(OUT, "README.md")
+    with open(readme, "w") as f:
+        f.write(
+            "# Bundled runtime clock data\n\n"
+            "Generated by `tools/make_runtime_data.py` (see its "
+            "docstring for provenance and error bounds).  This "
+            "directory is the *last* entry in the clock search path: "
+            "`$PINT_TPU_CLOCK_DIR` and `./clock` both override it, so "
+            "dropping real tabulations in either place (same "
+            "filenames) supersedes everything here.\n\n"
+            "Files marked PLACEHOLDER-ZERO in their header are "
+            "documented zero-assumptions with error bounds, not real "
+            "tabulations; `datacheck` reports them separately.\n")
+    n = len(os.listdir(OUT))
+    print(f"wrote {n} files to {OUT}")
+
+
+if __name__ == "__main__":
+    main()
