@@ -109,35 +109,120 @@ def _helio_ecliptic_au(body, T):
     return np.stack([x, y, z], axis=-1)
 
 
-def _moon_geocentric_au(T):
-    """Geocentric ecliptic lunar position [AU], truncated Meeus series
-    (~0.1 deg; enters only via the 4670-km EMB->Earth offset)."""
-    d = T * 36525.0  # days since J2000
-    Lp = (218.3164477 + 13.17639648 * d) * _DEG  # mean longitude
-    D = (297.8501921 + 12.19074912 * d) * _DEG  # mean elongation
-    Mp = (134.9633964 + 13.06499295 * d) * _DEG  # moon mean anomaly
-    Ms = (357.5291092 + 0.98560028 * d) * _DEG  # sun mean anomaly
-    F = (93.2720950 + 13.22935024 * d) * _DEG  # argument of latitude
+#: Meeus ch. 47 main-problem series (ELP-2000/82 truncation), terms
+#: with |longitude| >= ~0.003 deg / |distance| >= ~8 km / |latitude| >=
+#: ~0.004 deg.  Columns: (D, Ms, Mp, F, lon_deg, r_km) and
+#: (D, Ms, Mp, F, lat_deg).  Terms with Ms get the eccentricity factor
+#: E**|Ms|.  Published physical tabulation (same status as the Niell /
+#: Standish tables elsewhere in the tree).
+_MOON_LR = np.array([
+    (0, 0, 1, 0, 6.288774, -20905.355),
+    (2, 0, -1, 0, 1.274027, -3699.111),
+    (2, 0, 0, 0, 0.658314, -2955.968),
+    (0, 0, 2, 0, 0.213618, -569.925),
+    (0, 1, 0, 0, -0.185116, 48.888),
+    (0, 0, 0, 2, -0.114332, -3.149),
+    (2, 0, -2, 0, 0.058793, 246.158),
+    (2, -1, -1, 0, 0.057066, -152.138),
+    (2, 0, 1, 0, 0.053322, -170.733),
+    (2, -1, 0, 0, 0.045758, -204.586),
+    (0, 1, -1, 0, -0.040923, -129.620),
+    (1, 0, 0, 0, -0.034720, 108.743),
+    (0, 1, 1, 0, -0.030383, 104.755),
+    (2, 0, 0, -2, 0.015327, 10.321),
+    (0, 0, 1, 2, -0.012528, 0.0),
+    (0, 0, 1, -2, 0.010980, 79.661),
+    (4, 0, -1, 0, 0.010675, -34.782),
+    (0, 0, 3, 0, 0.010034, -23.210),
+    (4, 0, -2, 0, 0.008548, -21.636),
+    (2, 1, -1, 0, -0.007888, 24.208),
+    (2, 1, 0, 0, -0.006766, 30.824),
+    (1, 0, -1, 0, -0.005163, -8.379),
+    (1, 1, 0, 0, 0.004987, -16.675),
+    (2, -1, 1, 0, 0.004036, -12.831),
+    (2, 0, 2, 0, 0.003994, -10.445),
+    (4, 0, 0, 0, 0.003861, -11.650),
+    (2, 0, -3, 0, 0.003665, 14.403),
+    (0, 1, -2, 0, -0.002689, -7.003),
+    (2, 0, -1, 2, -0.002602, 0.0),
+    (2, -1, -2, 0, 0.002390, 10.056),
+    (1, 0, 1, 0, -0.002348, 6.322),
+    (2, -2, 0, 0, 0.002236, -9.884),
+])
 
-    lon = Lp + _DEG * (
-        6.288774 * np.sin(Mp)
-        + 1.274027 * np.sin(2 * D - Mp)
-        + 0.658314 * np.sin(2 * D)
-        + 0.213618 * np.sin(2 * Mp)
-        - 0.185116 * np.sin(Ms)
-        - 0.114332 * np.sin(2 * F)
-    )
-    lat = _DEG * (
-        5.128122 * np.sin(F)
-        + 0.280602 * np.sin(Mp + F)
-        + 0.277693 * np.sin(Mp - F)
-    )
-    r_km = (
-        385000.56
-        - 20905.355 * np.cos(Mp)
-        - 3699.111 * np.cos(2 * D - Mp)
-        - 2955.968 * np.cos(2 * D)
-    )
+_MOON_B = np.array([
+    (0, 0, 0, 1, 5.128122),
+    (0, 0, 1, 1, 0.280602),
+    (0, 0, 1, -1, 0.277693),
+    (2, 0, 0, -1, 0.173237),
+    (2, 0, -1, 1, 0.055413),
+    (2, 0, -1, -1, 0.046271),
+    (2, 0, 0, 1, 0.032573),
+    (0, 0, 2, 1, 0.017198),
+    (2, 0, 1, -1, 0.009266),
+    (0, 0, 2, -1, 0.008822),
+    (2, -1, 0, -1, 0.008216),
+    (2, 0, -2, -1, 0.004324),
+    (2, 0, 1, 1, 0.004200),
+])
+
+
+def _moon_geocentric_au(T):
+    """Geocentric ecliptic-of-date lunar position [AU], Meeus ch. 47
+    truncation of ELP-2000/82 (~0.003 deg / ~10 km; enters only via the
+    4670-km EMB->Earth offset, so this bounds that term at ~2-4 km,
+    sub-10-us of Roemer delay).  T is julian centuries TDB."""
+    T = np.asarray(T, dtype=np.float64)
+    # mean arguments with the full T-polynomials (Meeus 47.1-47.5)
+    Lp = (218.3164477 + 481267.88123421 * T - 0.0015786 * T**2
+          + T**3 / 538841.0 - T**4 / 65194000.0) * _DEG
+    D = (297.8501921 + 445267.1114034 * T - 0.0018819 * T**2
+         + T**3 / 545868.0 - T**4 / 113065000.0) * _DEG
+    Mp = (134.9633964 + 477198.8675055 * T + 0.0087414 * T**2
+          + T**3 / 69699.0 - T**4 / 14712000.0) * _DEG
+    Ms = (357.5291092 + 35999.0502909 * T - 0.0001536 * T**2
+          + T**3 / 24490000.0) * _DEG
+    F = (93.2720950 + 483202.0175233 * T - 0.0036539 * T**2
+         - T**3 / 3526000.0 + T**4 / 863310000.0) * _DEG
+    # eccentricity-of-Earth factor for solar-anomaly terms (47.6)
+    E = 1.0 - 0.002516 * T - 0.0000074 * T**2
+
+    shape = T.shape
+    lon = np.zeros(shape)
+    r_km = np.full(shape, 385000.56)
+    for cD, cMs, cMp, cF, sl, cr in _MOON_LR:
+        arg = cD * D + cMs * Ms + cMp * Mp + cF * F
+        ef = E ** abs(cMs)
+        lon = lon + sl * ef * np.sin(arg)
+        r_km = r_km + cr * ef * np.cos(arg)
+    lat = np.zeros(shape)
+    for cD, cMs, cMp, cF, sb in _MOON_B:
+        arg = cD * D + cMs * Ms + cMp * Mp + cF * F
+        lat = lat + sb * E ** abs(cMs) * np.sin(arg)
+    # planetary additives (Venus A1, Jupiter A2, A3; Meeus p. 338)
+    A1 = (119.75 + 131.849 * T) * _DEG
+    A2 = (53.09 + 479264.290 * T) * _DEG
+    A3 = (313.45 + 481266.484 * T) * _DEG
+    lon = lon + (0.003958 * np.sin(A1)
+                 + 0.001962 * np.sin(Lp - F)
+                 + 0.000318 * np.sin(A2))
+    lat = lat + (-0.002235 * np.sin(Lp)
+                 + 0.000382 * np.sin(A3)
+                 + 0.000175 * np.sin(A1 - F)
+                 + 0.000175 * np.sin(A1 + F)
+                 + 0.000127 * np.sin(Lp - Mp)
+                 - 0.000115 * np.sin(Lp + Mp))
+
+    lon = Lp + lon * _DEG
+    lat = lat * _DEG
+    # Meeus arguments are mean-equinox-OF-DATE; reduce longitude to the
+    # J2000 ecliptic frame the rest of the chain uses (general
+    # precession p = 5029.0966"/cy; at T=0.15 the 0.2 deg of-date
+    # offset rotates the 4670-km EMB->Earth arm by ~17 km ~ 57 us of
+    # Roemer delay — the dominant pre-round-4 monthly error term).
+    # Ecliptic-pole motion (~47"/cy) moves latitude by < 0.1 km: ignored.
+    lon = lon - (5029.0966 * T + 1.11113 * T**2
+                 - 0.000006 * T**3) / 3600.0 * _DEG
     r_au = r_km / 149597870.7
     cl, sl = np.cos(lon), np.sin(lon)
     cb, sb = np.cos(lat), np.sin(lat)

@@ -157,7 +157,16 @@ class Param:
             return ticks_to_mjd_string_tdb(int(round(value * 2**32)), 12)
         if self.kind == "bool":
             return "Y" if value else "N"
-        return repr(value / self.scale) if self.scale != 1.0 else f"{value:.{ndigits}g}"
+        if self.scale != 1.0:
+            # float() first: repr of a numpy-2 scalar is
+            # 'np.float64(...)', which no par parser reads back
+            return repr(float(value) / self.scale)
+        if ndigits >= 15:
+            # shortest round-trip repr: %.15g drops the last 1-2
+            # significant bits (an F0 would come back changed after
+            # as_parfile -> get_model; caught by the fuzz harness)
+            return repr(float(value))
+        return f"{value:.{ndigits}g}"
 
 
 

@@ -38,7 +38,14 @@ def main(argv=None):
                         "--nsteps as the cap (reference "
                         "run_sampler_autocorr)")
     p.add_argument("--seed", type=int, default=0)
-    p.add_argument("--fit-template", action="store_true")
+    p.add_argument("--fit-template", action="store_true",
+                   help="sample template parameters jointly with the "
+                        "timing parameters (reference MCMCFitter "
+                        "template fitkeys)")
+    p.add_argument("--outtemplate", default=None,
+                   help="write the post-fit template here (with "
+                        "--fit-template: the jointly-sampled "
+                        "max-posterior template)")
     p.add_argument("-o", "--outpar", default=None)
     args = p.parse_args(argv)
 
@@ -99,6 +106,11 @@ def main(argv=None):
         with open(args.outpar, "w") as f:
             f.write(model.as_parfile())
         print(f"wrote {args.outpar}")
+    if args.outtemplate:
+        from pint_tpu.templates import write_template
+
+        write_template(template, args.outtemplate)
+        print(f"wrote {args.outtemplate}")
     return 0
 
 

@@ -787,7 +787,9 @@ def format_toa_line(mjd_str, error_us, freq_mhz, obs_code, flags=None,
                     name="unk"):
     """One tempo2-format TOA line (reference: toa.py:566)."""
     freq = 0.0 if not np.isfinite(freq_mhz) else freq_mhz
-    line = f"{name} {freq:.6f} {mjd_str} {error_us:.3f} {obs_code}"
+    # error at full precision (%.3f silently truncated sub-ns
+    # uncertainties, e.g. 1.0625 -> 1.062; caught by the fuzz harness)
+    line = f"{name} {freq:.6f} {mjd_str} {error_us:.10g} {obs_code}"
     for k, v in (flags or {}).items():
         line += f" -{k} {v}" if v != "" else f" -{k}"
     return line

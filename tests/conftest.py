@@ -35,3 +35,19 @@ import numpy as _np
 assert _np.finfo(_np.longdouble).eps < 2e-19, (
     "tests need an extended-precision numpy.longdouble as oracle"
 )
+
+# Hypothesis profiles (reference conftest.py:17-33): "ci" is the
+# derandomized fixed-seed default so the suite is reproducible;
+# "fuzzing" turns the property tests into a x1000 fuzz harness
+# (HYPOTHESIS_PROFILE=fuzzing python -m pytest tests/test_fuzz.py).
+try:
+    import hypothesis
+
+    hypothesis.settings.register_profile(
+        "ci", deadline=None, print_blob=True, derandomize=True)
+    hypothesis.settings.register_profile(
+        "fuzzing", deadline=None, print_blob=True, max_examples=1000)
+    hypothesis.settings.load_profile(
+        os.environ.get("HYPOTHESIS_PROFILE", "ci"))
+except ImportError:  # tests/test_fuzz.py self-skips
+    pass

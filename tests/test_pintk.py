@@ -255,6 +255,43 @@ class TestGuiGuard:
         w.update_plot()
         root.destroy()
 
+    @pytest.mark.skipif(not os.environ.get("DISPLAY"),
+                        reason="no display (run under Xvfb to cover "
+                               "the widget layer)")
+    def test_widget_callbacks_and_canvas(self):
+        """Drive the fit/jump/wrap/undo callbacks through the real Tk
+        widget and render one canvas frame (VERDICT r3 item 9; the
+        headless pulsar.py logic is covered elsewhere — this exercises
+        the widget wiring itself)."""
+        import tkinter as tk
+
+        from pint_tpu.pintk.plk import PlkWidget
+        from pint_tpu.pintk.pulsar import Pulsar
+
+        psr = Pulsar(os.path.join(REFDATA, "NGC6440E.par"),
+                     os.path.join(REFDATA, "NGC6440E.tim"))
+        root = tk.Tk()
+        w = PlkWidget(root, psr)
+        try:
+            w.do_fit()
+            assert psr.fitted
+            chi2_fit = float(psr.postfit_resids().chi2)
+            # jump the first few TOAs, refit, undo twice
+            w.selected[:] = False
+            w.selected[:4] = True
+            w.do_jump()
+            assert psr.model.has_component("PhaseJump")
+            w.do_wrap(+1)
+            w.do_wrap(-1)
+            w.do_undo()
+            w.do_reset()
+            assert not psr.fitted
+            w.update_plot()
+            w.canvas.draw()  # one real rendered frame
+            assert chi2_fit > 0
+        finally:
+            root.destroy()
+
 
 def test_jump_flag_values_survive_deletion():
     """Regression: after deleting a GUI jump, a new jump must not reuse

@@ -169,6 +169,9 @@ def test_shuffled_tim_same_fit(tmp_path):
     m2, t2 = get_model_and_toas(par, str(shuf), use_cache=False)
     c1 = WLSFitter(t1, m1).fit_toas()
     c2 = WLSFitter(t2, m2).fit_toas()
-    np.testing.assert_allclose(float(c1), float(c2), rtol=1e-12)
+    # chi2 is assembled through f64 reductions whose order follows the
+    # TOA order, so permutation invariance holds to reduction-rounding
+    # (observed ~1e-11 rel), not bit-exactly
+    np.testing.assert_allclose(float(c1), float(c2), rtol=1e-9)
     np.testing.assert_allclose(float(m1.values["F0"]),
                                float(m2.values["F0"]), rtol=0, atol=0)
