@@ -37,6 +37,9 @@ class PlkWidget:
         self.root = root
         self.psr = pulsar
         self.selected = np.zeros(len(pulsar.all_toas), dtype=bool)
+        #: 'z' toggles: the right-drag box zooms instead of selecting
+        self.zoom_mode = False
+        self._zoom_lims = None  # (xlim, ylim) or None = autoscale
 
         notebook = ttk.Notebook(root)
         notebook.pack(fill="both", expand=True)
@@ -109,16 +112,23 @@ class PlkWidget:
 
     # -- panel builders --------------------------------------------------------
     def _build_param_panel(self):
+        from pint_tpu.pintk.pulsar import grouped_fit_params
+
         for w in self.param_frame.winfo_children():
             w.destroy()
         self.fit_vars = {}
-        for name, par in self.psr.model.params.items():
-            if not par.fittable:
-                continue
-            v = self.tk.BooleanVar(value=not par.frozen)
-            self.tk.Checkbutton(self.param_frame, text=name, variable=v,
-                                command=self._sync_fit_flags).pack(anchor="w")
-            self.fit_vars[name] = v
+        for comp_name, names in grouped_fit_params(self.psr.model):
+            self.tk.Label(self.param_frame, text=comp_name,
+                          font=("TkDefaultFont", 9, "bold")
+                          ).pack(anchor="w")
+            for name in names:
+                par = self.psr.model.params[name]
+                v = self.tk.BooleanVar(value=not par.frozen)
+                self.tk.Checkbutton(
+                    self.param_frame, text=name, variable=v,
+                    command=self._sync_fit_flags).pack(anchor="w",
+                                                       padx=12)
+                self.fit_vars[name] = v
 
     def on_model_change(self):
         """Par editor applied a new model."""
@@ -221,15 +231,39 @@ class PlkWidget:
         self.update_plot()
 
     def _on_box(self, eclick, erelease):
-        """Right-drag box selection (reference plk area select)."""
-        x = self.psr.xaxis(self.xaxis.get())
-        res, _, _ = self.psr.yvals(self.yaxis.get())
+        """Right-drag box: selection, or zoom when zoom mode is on
+        ('z'; reference plk zoom-area)."""
         x0, x1 = sorted((eclick.xdata, erelease.xdata))
         y0, y1 = sorted((eclick.ydata, erelease.ydata))
+        if self.zoom_mode:
+            self._zoom_lims = ((x0, x1), (y0, y1))
+            self.update_plot()
+            return
+        x = self.psr.xaxis(self.xaxis.get())
+        res, _, _ = self.psr.yvals(self.yaxis.get())
         inside = (x >= x0) & (x <= x1) & (res >= y0) & (res <= y1)
         if inside.any():
             self.selected[self._visible_to_full(np.flatnonzero(inside))] = True
             self.update_plot()
+
+    HELP_TEXT = """plk key bindings (reference pintk helpPopup):
+  f  fit          r  reset        u  undo
+  d  delete sel   j  jump sel     c  clear selection
+  +/- wrap sel by one turn
+  z  toggle zoom mode (right-drag box zooms)
+  o  zoom out (autoscale)
+  h  this help
+Mouse: left-click select TOA, right-drag box select/zoom."""
+
+    def do_help(self):
+        from tkinter import messagebox
+
+        messagebox.showinfo("pintk help", self.HELP_TEXT,
+                            parent=self.root)
+
+    def do_zoom_reset(self):
+        self._zoom_lims = None
+        self.update_plot()
 
     def _on_key(self, event):
         key = (event.key or "").lower()
@@ -250,6 +284,14 @@ class PlkWidget:
         elif key == "c":
             self.selected[:] = False
             self.update_plot()
+        elif key == "z":
+            self.zoom_mode = not self.zoom_mode
+            self.status.config(
+                text=f"zoom mode {'ON' if self.zoom_mode else 'off'}")
+        elif key == "o":
+            self.do_zoom_reset()
+        elif key == "h":
+            self.do_help()
 
     # -- drawing ----------------------------------------------------------------
     def update_plot(self):
@@ -278,6 +320,9 @@ class PlkWidget:
         self.ax.set_title(
             ("post-fit" if self.psr.fitted else "pre-fit")
             + f"  ({len(res)} TOAs)")
+        if self._zoom_lims is not None:
+            self.ax.set_xlim(*self._zoom_lims[0])
+            self.ax.set_ylim(*self._zoom_lims[1])
         self.canvas.draw_idle()
 
 

@@ -278,3 +278,22 @@ class Pulsar:
             raise ValueError("fit first")
         return calculate_random_models(self.fitter, self.selected_toas,
                                        n_models=n)
+
+
+def grouped_fit_params(model):
+    """Fittable parameters grouped by owning component, in component
+    order: [(component_name, [param, ...]), ...] (reference pintk
+    groups the fit checkboxes per component).  A parameter owned by
+    several components (superset name collisions) appears only under
+    the one whose Param object wins ``model.params``."""
+    owner = model.params  # name -> winning Param object
+    groups = []
+    seen = set()
+    for comp in model.components:
+        names = [p.name for p in comp.params
+                 if p.fittable and owner.get(p.name) is p
+                 and p.name not in seen]
+        if names:
+            seen.update(names)
+            groups.append((type(comp).__name__, names))
+    return groups

@@ -28,7 +28,8 @@ import numpy as np
 
 __all__ = [
     "LCGaussian", "LCLorentzian", "LCVonMises", "LCTopHat",
-    "LCHarmonic", "LCGaussian2", "LCLorentzian2",
+    "LCHarmonic", "LCGaussian2", "LCLorentzian2", "LCSkewGaussian",
+    "LCKing",
     "LCEmpiricalFourier", "LCKernelDensity",
     "LCTemplate", "LCFitter", "NormAngles",
     "LCEGaussian", "LCETemplate", "LCEFitter", "ENormAngles",
@@ -243,6 +244,75 @@ class LCLorentzian2:
 
     def param_bounds(self):
         return [(1e-3, 0.5), (1e-3, 0.5), (None, None)]
+
+
+@dataclass
+class LCSkewGaussian:
+    """Wrapped skew-normal peak (reference lcprimitives
+    LCSkewGaussian, :858): density 2 phi(z) Phi(shape * z) / sigma
+    with z = (x - loc)/sigma, wrapped over +-_NWRAP turns.  shape=0
+    reduces exactly to LCGaussian; sign(shape) sets the skew
+    direction."""
+
+    sigma: float = 0.03
+    shape: float = 2.0
+    loc: float = 0.5
+
+    n_params = 3
+    loc_index = 2
+
+    def density(self, phi, p):
+        from jax.scipy.stats import norm
+
+        sigma, shape, loc = p[0], p[1], p[2]
+        k = jnp.arange(-_NWRAP, _NWRAP + 1)
+        z = (jnp.asarray(phi)[..., None] - loc + k[None, :]) / sigma
+        f = 2.0 * norm.pdf(z) * norm.cdf(shape * z) / sigma
+        return jnp.sum(f, axis=-1)
+
+    def init_params(self):
+        return [self.sigma, self.shape, self.loc]
+
+    def param_bounds(self):
+        return [(1e-3, 0.5), (-30.0, 30.0), (None, None)]
+
+
+@dataclass
+class LCKing:
+    """Wrapped King-function (modified-Lorentzian) peak (reference
+    lcprimitives LCKing, :1250 — the XMM/Chandra PSF radial profile
+    restricted to 1D): f(x) = N (1 + x^2/(2 sigma^2 gamma))^(-gamma),
+    gamma > 1, normalized over the real line then wrapped.
+
+    N = Gamma(gamma) / (Gamma(gamma-1/2) sqrt(2 pi gamma) sigma)
+    normalizes the unwrapped profile (student-t with nu = 2 gamma - 1
+    in disguise), so the wrap sum integrates to 1 per turn."""
+
+    sigma: float = 0.03
+    gamma: float = 3.0
+    loc: float = 0.5
+
+    n_params = 3
+    loc_index = 2
+
+    def density(self, phi, p):
+        from jax.scipy.special import gammaln
+
+        sigma, gamma, loc = p[0], p[1], p[2]
+        norm = jnp.exp(gammaln(gamma) - gammaln(gamma - 0.5)) / (
+            jnp.sqrt(2.0 * jnp.pi * gamma) * sigma)
+        # power-law tails fall much slower than gaussian: widen the
+        # wrap sum accordingly
+        k = jnp.arange(-3 * _NWRAP, 3 * _NWRAP + 1)
+        z = (jnp.asarray(phi)[..., None] - loc + k[None, :]) / sigma
+        f = norm * (1.0 + z**2 / (2.0 * gamma)) ** (-gamma)
+        return jnp.sum(f, axis=-1)
+
+    def init_params(self):
+        return [self.sigma, self.gamma, self.loc]
+
+    def param_bounds(self):
+        return [(1e-3, 0.5), (1.01, 50.0), (None, None)]
 
 
 class LCEmpiricalFourier:

@@ -419,3 +419,72 @@ class TestEnergyDependentNorms:
         assert abs(norms_lo - 0.3) < 0.06
         assert abs(norms_hi - 0.55) < 0.08
         assert norms_hi > norms_lo + 0.1
+
+
+class TestNewPrimitives:
+    """LCSkewGaussian / LCKing (reference lcprimitives :858/:1250) —
+    the last two primitive kinds from the reference zoo."""
+
+    def _check_normalized(self, prim, p=None):
+        grid = np.linspace(0.0, 1.0, 4001)
+        params = np.array(p if p is not None else prim.init_params())
+        f = np.asarray(prim.density(grid, params))
+        integral = np.trapezoid(f, grid) if hasattr(np, "trapezoid") \
+            else np.trapz(f, grid)
+        assert abs(integral - 1.0) < 2e-3, integral
+        assert np.all(f >= 0)
+
+    def test_skew_gaussian_normalized(self):
+        from pint_tpu.templates import LCSkewGaussian
+
+        self._check_normalized(LCSkewGaussian(sigma=0.04, shape=3.0,
+                                              loc=0.4))
+
+    def test_skew_zero_reduces_to_gaussian(self):
+        from pint_tpu.templates import LCGaussian, LCSkewGaussian
+
+        grid = np.linspace(0, 1, 501)
+        g = np.asarray(LCGaussian().density(grid,
+                                            np.array([0.05, 0.5])))
+        s = np.asarray(LCSkewGaussian().density(
+            grid, np.array([0.05, 0.0, 0.5])))
+        np.testing.assert_allclose(s, g, rtol=1e-10)
+
+    def test_skew_direction(self):
+        """Positive shape skews the tail to the right of the mode."""
+        from pint_tpu.templates import LCSkewGaussian
+
+        grid = np.linspace(0, 1, 2001)
+        f = np.asarray(LCSkewGaussian().density(
+            grid, np.array([0.05, 4.0, 0.5])))
+        mode = grid[np.argmax(f)]
+        mean = float(np.sum(grid * f) / np.sum(f))
+        assert mean > mode  # right-skewed
+
+    def test_king_normalized_and_heavy_tailed(self):
+        from pint_tpu.templates import LCGaussian, LCKing
+
+        self._check_normalized(LCKing(sigma=0.02, gamma=2.0, loc=0.5))
+        grid = np.linspace(0, 1, 2001)
+        k = np.asarray(LCKing().density(grid,
+                                        np.array([0.03, 2.0, 0.5])))
+        g = np.asarray(LCGaussian().density(grid,
+                                            np.array([0.03, 0.5])))
+        # same core width scale, fatter tails than the gaussian
+        far = np.abs(grid - 0.5) > 0.2
+        assert np.all(k[far] > g[far])
+
+    def test_fit_recovers_skew(self):
+        from pint_tpu.templates import (
+            LCFitter, LCSkewGaussian, LCTemplate)
+        from scipy.stats import skewnorm
+
+        rng = np.random.default_rng(5)
+        ph = skewnorm.rvs(4.0, loc=0.45, scale=0.05, size=6000,
+                          random_state=rng) % 1.0
+        tpl = LCTemplate([LCSkewGaussian(sigma=0.04, shape=1.0,
+                                         loc=0.5)], norms=[0.99])
+        LCFitter(tpl, ph).fit()
+        _, (pp,) = tpl._split(tpl.params)
+        assert 1.5 < pp[1] < 12.0  # strongly right-skewed recovered
+        assert abs(pp[2] - 0.45) < 0.05

@@ -314,3 +314,31 @@ def test_jump_flag_values_survive_deletion():
     for s in sels:
         group = {i for i, v in enumerate(vals) if v == str(s[2])}
         assert group in ({2, 3}, {4, 5})
+
+
+class TestGroupedParams:
+    def test_grouping_covers_all_fittable_once(self):
+        from pint_tpu.models import get_model
+        from pint_tpu.pintk.pulsar import grouped_fit_params
+
+        par = (
+            "PSR FAKE\nRAJ 05:00:00 1\nDECJ 10:00:00 1\n"
+            "F0 100.0 1\nF1 -1e-15 1\nPEPOCH 55000\nDM 10 1\n"
+            "BINARY ELL1\nPB 12.5 1\nA1 9.2 1\nTASC 55000.5 1\n"
+            "EPS1 1e-5 1\nEPS2 -2e-5 1\n"
+            "TZRMJD 55000\nTZRSITE @\nTZRFRQ 1400\n"
+            "UNITS TDB\nEPHEM builtin\n"
+        )
+        m = get_model(par)
+        groups = grouped_fit_params(m)
+        comp_names = [g[0] for g in groups]
+        assert "Spindown" in comp_names
+        assert any("ELL1" in c for c in comp_names)
+        flat = [n for _, names in groups for n in names]
+        assert len(flat) == len(set(flat))  # no duplicates
+        fittable = {n for n, p in m.params.items() if p.fittable}
+        assert set(flat) == fittable  # complete
+        # grouping follows component membership
+        gd = dict(groups)
+        assert "F0" in gd["Spindown"]
+        assert "PB" in gd[[c for c in comp_names if "ELL1" in c][0]]

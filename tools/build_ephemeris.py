@@ -196,6 +196,38 @@ def integrate():
     return dense
 
 
+#: hat-basis knots for the windowed element-correction spline
+#: (round 4): piecewise-linear deviations over the constrained epoch
+#: range, frozen (clamped) outside it like the rate/quad terms.
+#: 8 knots over 2002-2020 ~ one every 2.7 yr — coarse enough that the
+#: anchors (fixture 2002-04, NGC6440E 05-07, B1953 06-09, J1853 11-16,
+#: J2145 19-20) see every knot, with second-difference smoothness
+#: priors bridging the 2008-11 / 2016-19 gaps.
+SPLINE_KNOTS = np.linspace(900.0, 7600.0, 8)
+
+
+def _hat_basis(k, t_day):
+    """Value of hat (piecewise-linear) basis function k at t_day,
+    clamped to the knot span (constant extrapolation outside)."""
+    knots = SPLINE_KNOTS
+    t = np.clip(np.asarray(t_day, np.float64), knots[0], knots[-1])
+    x = knots[k]
+    out = np.zeros_like(t)
+    if k > 0:
+        left = knots[k - 1]
+        m = (t >= left) & (t <= x)
+        out[m] = (t[m] - left) / (x - left)
+    else:
+        out[t <= x] = 1.0
+    if k < len(knots) - 1:
+        right = knots[k + 1]
+        m = (t > x) & (t <= right)
+        out[m] = 1.0 - (t[m] - x) / (right - x)
+    else:
+        out[t >= x] = 1.0
+    return out
+
+
 class CorrectedSystem:
     """Heliocentric positions = mean elements + detrended integrated
     periodic perturbations (step 2-4 of the module docstring)."""
@@ -214,6 +246,9 @@ class CorrectedSystem:
         self.el_rate = {}
         #: quadratic element drifts, per RATE_UNIT_DAYS^2
         self.el_quad = {}
+        #: windowed hat-spline element deviations, (len(SPLINE_KNOTS),
+        #: 6) per body; filled by calibrate_joint()
+        self.el_spline = {}
         t = np.arange(SPAN_LO_D + 2.0, SPAN_HI_D - 2.0, fit_step_d)
         Y = dense(t)
         n = len(BODIES)
@@ -260,6 +295,12 @@ class CorrectedSystem:
                     per = per + rate[None, :] * tc
                 if quad is not None:
                     per = per + quad[None, :] * tc**2
+            spl = self.el_spline.get(b)
+            if spl is not None:
+                B = np.stack(
+                    [_hat_basis(k, t_day)
+                     for k in range(len(SPLINE_KNOTS))], axis=1)
+                per = per + B @ spl
             pos, _ = equinoctial_to_posvel(st + per)
             out[b] = pos
         for b in RAILS:
@@ -466,13 +507,15 @@ _EMB_PRIOR = (3e-6, 1e-5, 1e-5, 3e-6, 3e-6, 2e-5)
 CAL_PARAMS = (
     [("emb", "off", j, _EMB_PRIOR[j]) for j in range(6)]
     + [("emb", "rate", j, _EMB_PRIOR[j]) for j in range(6)]
-    # curvature of the table-vs-truth element difference: h, k, lam
-    # (an along-track quadratic produces the measured linearly-growing
-    # annual-signature Roemer error; a/p/q curvature is not observable
-    # at this level; 3x-loosened quad priors were tried in round 4 and
-    # changed nothing — the prior is not the binding constraint on
-    # J1853's remaining ~107 us t^2 term)
-    + [("emb", "quad", j, _EMB_PRIOR[j]) for j in (1, 2, 5)]
+    # windowed hat-spline deviations in h, k, lam replace the former
+    # quad terms (round 4): the golden-diff anchors measure the
+    # element drift *locally* in time, and the t^2 basis could not
+    # represent the measured structure (3x-loosened quad priors
+    # changed nothing — the basis, not the prior, was the constraint).
+    # Second-difference smoothness rows (calibrate_joint) bridge the
+    # unanchored 2008-11 / 2016-19 gaps.
+    + [("emb", f"spl{k}", j, _EMB_PRIOR[j])
+       for k in range(len(SPLINE_KNOTS)) for j in (1, 2, 5)]
 )
 
 
@@ -592,7 +635,9 @@ def _sens_time_factor(kind, t_day):
         return tc
     if kind == "quad":
         return tc**2
-    return np.ones_like(t_day)
+    if kind.startswith("spl"):
+        return _hat_basis(int(kind[3:]), t_day)
+    return np.ones_like(np.asarray(t_day))
 
 
 def calibrate_joint(sysm, workdir="/tmp", n_iter=8, n_pre=2):
@@ -689,15 +734,36 @@ def calibrate_joint(sysm, workdir="/tmp", n_iter=8, n_pre=2):
             blocks_y.append((y_ax - Q @ (Q.T @ y_ax)) / SIG_FIX)
         blocks_A.append(np.diag(1.0 / prior))
         blocks_y.append(np.zeros(npar))
+        # second-difference smoothness rows across the spline knots of
+        # each element: the anchors leave 2008-11 / 2016-19 unmeasured,
+        # and uncoupled hats would kink back to zero there
+        idx = {(kind, j): ip
+               for ip, (body, kind, j, _p) in enumerate(CAL_PARAMS)}
+        nk = len(SPLINE_KNOTS)
+        cur_spl = sysm.el_spline.get("emb")
+        for j in (1, 2, 5):
+            sig_smooth = 0.5 * _EMB_PRIOR[j]
+            for k in range(1, nk - 1):
+                row = np.zeros(npar)
+                row[idx[(f"spl{k-1}", j)]] = 1.0 / sig_smooth
+                row[idx[(f"spl{k}", j)]] = -2.0 / sig_smooth
+                row[idx[(f"spl{k+1}", j)]] = 1.0 / sig_smooth
+                # target: drive the ACCUMULATED second difference to
+                # zero (the solve is for a step on top of cur_spl)
+                cur2 = 0.0 if cur_spl is None else (
+                    cur_spl[k - 1, j] - 2.0 * cur_spl[k, j]
+                    + cur_spl[k + 1, j])
+                blocks_A.append(row[None, :])
+                blocks_y.append(np.array([-cur2 / sig_smooth]))
         A_all = np.vstack(blocks_A)
         y_all = np.concatenate(blocks_y)
-        # non-EMB columns (if any are ever re-added to CAL_PARAMS) are
-        # staged with the anchors: their years-scale signatures are
-        # near-degenerate under the short wrap-immune blocks alone and
-        # produce wild early steps.  With today's emb-only CAL_PARAMS
-        # the mask is all-True and this is a no-op.
-        active = np.array([body == "emb" or it >= n_pre
-                           for body, _k, _j, _p in CAL_PARAMS])
+        # local-in-time (spline) and non-EMB columns are staged with
+        # the anchors: their signatures are near-degenerate under the
+        # short wrap-immune blocks alone and produce wild early steps
+        active = np.array([
+            (body == "emb" and not kind.startswith("spl"))
+            or it >= n_pre
+            for body, kind, _j, _p in CAL_PARAMS])
         sol = np.linalg.lstsq(A_all[:, active], y_all, rcond=None)[0]
         x = np.zeros(npar)
         x[active] = sol
@@ -709,6 +775,12 @@ def calibrate_joint(sysm, workdir="/tmp", n_iter=8, n_pre=2):
         if step_units > cap:
             x = x * (cap / step_units)
         for ip, (body, kind, j, _p) in enumerate(CAL_PARAMS):
+            if kind.startswith("spl"):
+                if body not in sysm.el_spline:
+                    sysm.el_spline[body] = np.zeros(
+                        (len(SPLINE_KNOTS), 6))
+                sysm.el_spline[body][int(kind[3:]), j] += x[ip]
+                continue
             store = {"off": sysm.el_offset, "rate": sysm.el_rate,
                      "quad": sysm.el_quad}[kind]
             if body not in store:
@@ -734,6 +806,11 @@ def calibrate_joint(sysm, workdir="/tmp", n_iter=8, n_pre=2):
             if body in store:
                 print(f"    {body} {label}: "
                       + " ".join(f"{v:+.2e}" for v in store[body]))
+        if body in sysm.el_spline:
+            for k in range(len(SPLINE_KNOTS)):
+                print(f"    {body} spl{k}: "
+                      + " ".join(f"{v:+.2e}"
+                                 for v in sysm.el_spline[body][k]))
 
 
 def build(out_path, calibrate="joint"):
