@@ -1,0 +1,55 @@
+"""Example: photon-domain analysis — H-test and template fitting.
+
+Simulates a two-peak gamma-ray pulse profile with an energy-dependent
+peak location, detects the pulsation, and fits an energy-dependent
+template (the reference's lcfitters/lceprimitives workflow).
+
+Run: python docs/examples/photon_template_fit.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))  # repo-root run not required
+
+import numpy as np
+
+
+def main():
+    from pint_tpu.eventstats import hm
+    from pint_tpu.templates import (
+        LCEFitter, LCEGaussian, LCETemplate, LCFitter, LCGaussian,
+        LCTemplate)
+
+    rng = np.random.default_rng(42)
+    n = 6000
+    log10_en = rng.uniform(2.0, 4.0, n)  # 100 MeV .. 100 GeV
+    x = log10_en - 2.0
+    comp = rng.random(n)
+    phases = np.where(
+        comp < 0.35, rng.normal(0.22 + 0.04 * x, 0.03),
+        np.where(comp < 0.60, rng.normal(0.58, 0.05), rng.random(n)),
+    ) % 1.0
+
+    print(f"H-test: {hm(phases):.1f} (detection threshold ~ 25)")
+
+    tpl = LCTemplate([LCGaussian(sigma=0.04, loc=0.2),
+                      LCGaussian(sigma=0.06, loc=0.6)],
+                     norms=[0.3, 0.2])
+    f = LCFitter(tpl, phases)
+    params, lnl = f.fit()
+    print(f"energy-independent fit: lnL = {lnl:.1f}")
+
+    etpl = LCETemplate([LCEGaussian(sigma=0.04, loc=0.2),
+                        LCEGaussian(sigma=0.06, loc=0.6)],
+                       norms=[0.3, 0.2])
+    fe = LCEFitter(etpl, phases, log10_en)
+    eparams, elnl = fe.fit()
+    # layout: [n1, n2, sigma1, loc1, dsigma1, dloc1, sigma2, ...]
+    print(f"energy-dependent fit:   lnL = {elnl:.1f} "
+          f"(recovered dloc_1 = {eparams[5]:+.3f}, true +0.040)")
+    assert elnl > lnl
+
+
+if __name__ == "__main__":
+    main()

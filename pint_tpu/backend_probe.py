@@ -1,0 +1,91 @@
+"""Hang-proof JAX backend probing.
+
+Why: the known axon/TPU-tunnel failure mode is that even a trivial
+``jax.jit`` call blocks forever with no error, so ANY first touch of
+``jax.devices()`` / ``jax.default_backend()`` in a diagnostic or CLI
+entry point turns the tool into a second casualty of the exact failure
+it should be reporting.  The reference never needs this (CUDA either
+works or raises); here the probe runs in a *subprocess* with a hard
+timeout, so the parent can report a hung tunnel and fall back to the
+CPU backend.
+
+Used by ``bench.py`` (per-metric CPU fallback) and
+``pint_tpu.datacheck`` (backend line of the data diagnostic).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+__all__ = ["probe_backend", "ensure_live_backend"]
+
+
+def probe_backend(timeout_s: float, force_cpu_env: str | None = None):
+    """Jit a trivial function in a subprocess.
+
+    Returns ``(ok, backend_or_detail)``: on success the probed backend
+    name ("tpu", "cpu", ...); on failure a human-readable detail that
+    distinguishes a timeout (hung device tunnel) from a broken
+    environment (carries the probe's stderr tail).
+
+    ``force_cpu_env``: name of an env var that, when set, makes the
+    probe run on the CPU backend (bench.py's explicit-CPU escape
+    hatch).
+    """
+    import subprocess
+
+    pre = ""
+    if force_cpu_env:
+        pre = (
+            "import os\n"
+            f"if os.environ.get({force_cpu_env!r}):\n"
+            "    os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        )
+    code = (pre + "import jax, jax.numpy as jnp\n"
+            + (f"if __import__('os').environ.get({force_cpu_env!r}):\n"
+               "    jax.config.update('jax_platforms', 'cpu')\n"
+               if force_cpu_env else "")
+            + "jax.jit(lambda x: x * 2)(jnp.ones(8))\n"
+            "print(jax.default_backend())\n")
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True,
+                           timeout=timeout_s)
+        if r.returncode == 0:
+            return True, r.stdout.strip().splitlines()[-1]
+        return False, ("probe exited rc=%d: %s"
+                       % (r.returncode, r.stderr.strip()[-300:]))
+    except subprocess.TimeoutExpired:
+        return False, ("probe timed out after %.0fs (hung device "
+                       "tunnel)" % timeout_s)
+
+
+def ensure_live_backend(timeout_s: float | None = None):
+    """Probe the default backend; if it is hung or broken, force the
+    in-process JAX config onto the CPU backend so subsequent
+    ``jax.devices()`` calls return instead of blocking.
+
+    Must run BEFORE the first in-process backend touch (importing jax
+    is fine; initializing a backend is not).  Returns ``(live,
+    detail)`` where ``live`` says whether the *default* backend
+    answered and ``detail`` carries the probe result either way.
+    """
+    import jax
+
+    # already pinned to the CPU backend in-process (tests, tools that
+    # force cpu before importing): nothing can hang, skip the probe
+    if (getattr(jax.config, "jax_platforms", None) or "") == "cpu":
+        return True, "cpu (pre-forced in-process)"
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("PINT_TPU_PROBE_TIMEOUT", "20"))
+    ok, detail = probe_backend(timeout_s)
+    if not ok:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass  # backend already initialized; nothing to rescue
+    return ok, detail

@@ -24,6 +24,15 @@ def datacheck_report(ephem="builtin", sites=("gbt", "ao", "jb", "pks",
     """Return the diagnostic as a list of text lines."""
     lines = []
 
+    # probe the backend FIRST, in a subprocess with a hard timeout:
+    # with a hung TPU tunnel (known axon failure mode) any in-process
+    # jax.devices() touch blocks forever, turning this diagnostic into
+    # a second casualty of the exact failure it exists to report.  On a
+    # dead probe, the rest of the report runs on the CPU backend.
+    from pint_tpu.backend_probe import ensure_live_backend
+
+    backend_live, backend_detail = ensure_live_backend()
+
     from pint_tpu.ephem import get_ephemeris
 
     eph = get_ephemeris(ephem)
@@ -124,8 +133,15 @@ def datacheck_report(ephem="builtin", sites=("gbt", "ao", "jb", "pks",
 
     import jax
 
-    lines.append(f"JAX backend: {jax.default_backend()} "
-                 f"({len(jax.devices())} device(s))")
+    if backend_live:
+        lines.append(f"JAX backend: {jax.default_backend()} "
+                     f"({len(jax.devices())} device(s))")
+    else:
+        lines.append(
+            f"JAX backend: DEFAULT BACKEND UNRESPONSIVE — "
+            f"{backend_detail}; this report ran on the CPU "
+            f"backend ({jax.default_backend()}, "
+            f"{len(jax.devices())} device(s))")
     from pint_tpu.fixedpoint import backend_f64_is_ieee
 
     ieee = backend_f64_is_ieee()

@@ -30,7 +30,11 @@ class _DownhillMixin:
     """Adds the lambda-halving acceptance loop around a solver step and
     the optional noise-fitting stage."""
 
-    max_halvings = 8
+    #: 16 halvings reach lambda ~ 1.5e-5: a GN step along the Shapiro
+    #: degeneracy can overshoot SINI past 1 by 1e-3-relative (measured
+    #: on B1855 12.5yr wb: SINI=0.99918, dpar=+0.27 — lambda must fall
+    #: below ~3e-3 before the stepped model is even valid)
+    max_halvings = 16
     #: stop when chi2 decrease falls below this (reference fitter.py:1078)
     min_chi2_decrease = 1e-2
 
@@ -48,8 +52,16 @@ class _DownhillMixin:
 
         def cond(carry):
             lam, chi2_new, n = carry
+            # NOT(new < old), not (new >= old): a NaN chi2 (invalid
+            # stepped model, e.g. SINI pushed past 1) must count as
+            # "worse" and keep halving — `NaN >= x` is False and would
+            # end the loop with the invalid step still rejected but
+            # all remaining lambdas untried (measured hang-up on the
+            # B1855 12.5yr wideband set; reference analogue: invalid
+            # model parameters reject the step, fitter.py:1049-1057)
             return jnp.logical_and(
-                chi2_new >= chi2_old, n < self.max_halvings
+                jnp.logical_not(chi2_new < chi2_old),
+                n < self.max_halvings
             )
 
         def body(carry):

@@ -298,13 +298,20 @@ def get_model(parfile, allow_tcb=False, allow_T2=False) -> TimingModel:
         k for k in pardict
         if k not in consumed and not k.startswith("__")
     ]
-    if unknown:
+    # informational per-window companions of DMX ranges: tempo writes
+    # them, nothing fits them; the reference drops them *silently*
+    # (reference timing_model.py:105 ignore_prefix), so a NANOGrav par
+    # must not print a 200-name warning here.  Still carried as
+    # metadata for round-tripping.
+    _SILENT_PREFIXES = ("DMXEP_", "DMXF1_", "DMXF2_")
+    noisy = [k for k in unknown if not k.startswith(_SILENT_PREFIXES)]
+    if noisy:
         warnings.warn(
             f"par parameters not (yet) supported, carried as metadata: "
-            f"{sorted(unknown)}"
+            f"{sorted(noisy)}"
         )
-        for k in unknown:
-            model.meta.setdefault("__unknown__", {})[k] = pardict[k]
+    for k in unknown:
+        model.meta.setdefault("__unknown__", {})[k] = pardict[k]
 
     # sanity: a timing model needs a spin frequency
     if not model.has_component("Spindown") or np.isnan(

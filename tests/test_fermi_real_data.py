@@ -82,6 +82,42 @@ def test_template_file_real(fermi_toas):
     assert np.all(dens > -1e-9)
 
 
+def test_energy_dependent_multiprimitive_fit_real():
+    """Multi-primitive energy-dependent template on the real Fermi
+    J0030 photons (round-4 verdict item 7): wrap the reference-shipped
+    3-gaussian template in LCEWrapped + ENormAngles, fit phases x
+    energies with LCEFitter, and require a decisive likelihood gain
+    over the best energy-INDEPENDENT fit of the same structure — the
+    known energy evolution of J0030's profile, measured end-to-end."""
+    from pint_tpu.fits import read_events
+    from pint_tpu.templates import (
+        ENormAngles, LCEFitter, LCETemplate, LCEWrapped, LCFitter,
+        read_template)
+
+    _, d = read_events(FT1)
+    # pipeline phases: template shape testing, independent of the par
+    ph = np.asarray(d["PULSE_PHASE"], np.float64) % 1.0
+    w = np.asarray(d["PSRJ0030+0451"], np.float64)
+    log10_en = np.log10(np.asarray(d["ENERGY"], np.float64))
+
+    base = read_template(TEMPLATE)
+    f0 = LCFitter(base, ph, weights=w)
+    _, lnl_ind = f0.fit()
+
+    k = len(base.primitives)
+    norms0 = np.asarray(base.params[:k])
+    etpl = LCETemplate([LCEWrapped(p) for p in base.primitives],
+                       norms=norms0, enorms=ENormAngles(k))
+    fe = LCEFitter(etpl, ph, log10_en, weights=w)
+    params, lnl_e = fe.fit(maxiter=400)
+    assert np.isfinite(lnl_e)
+    # nested models: the energy-dependent fit can only gain; J0030's
+    # profile genuinely evolves, so require a decisive gain (>> the
+    # ~n_extra/2 chance-level improvement)
+    n_extra = etpl.n_params - base.n_params
+    assert lnl_e > lnl_ind + n_extra, (lnl_e, lnl_ind, n_extra)
+
+
 def test_fermiphase_real_data(tmp_path, capsys):
     """fermiphase end-to-end on the real FT1 file: weighted H-test,
     minWeight filter, PULSE_PHASE output file, phaseogram (reference

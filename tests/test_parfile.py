@@ -156,6 +156,29 @@ def test_reference_par_sweep_roundtrip():
     assert not failures, failures
 
 
+def test_dmx_companion_params_silent():
+    """DMXEP_/DMXF1_/DMXF2_ are informational per-window companions
+    that the reference drops silently (reference timing_model.py:105
+    ignore_prefix); loading a NANOGrav par must not print a 200-name
+    warning, but the values are still carried as metadata."""
+    import warnings
+
+    par = (TDB_PAR
+           + "DMX 6.5\nDMXR1_0001 54500\nDMXR2_0001 54800\n"
+             "DMX_0001 1e-3 1\n"
+           + "".join(f"DMX{kind}_0001 {v}\n"
+                     for kind, v in (("EP", 54650.0), ("F1", 1400.0),
+                                     ("F2", 2000.0))))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        m = get_model(par)
+    noisy = [x for x in w
+             if "not (yet) supported" in str(x.message)]
+    assert not noisy, [str(x.message) for x in noisy]
+    carried = m.meta.get("__unknown__", {})
+    assert {"DMXEP_0001", "DMXF1_0001", "DMXF2_0001"} <= set(carried)
+
+
 def test_incomplete_position_raises():
     """ELONG without ELAT (or RAJ without DECJ) raises instead of
     producing silently-NaN residuals (regression: the reference

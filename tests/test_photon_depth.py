@@ -161,10 +161,10 @@ class TestTemplateZoo:
                                        dloc=0.0)], norms=[0.99])
         f = LCEFitter(tpl, phases, log10_en)
         params, lnl = f.fit()
-        # params: [norm, sigma, dsigma, loc, dloc]
-        assert abs(params[3] - 0.5) < 0.02
+        # params: [norm, sigma, loc, dsigma, dloc] (LCEWrapped layout)
+        assert abs(params[2] - 0.5) < 0.02
         assert abs(params[4] - 0.05) < 0.02
-        assert abs(params[2] - (-0.01)) < 0.01
+        assert abs(params[3] - (-0.01)) < 0.01
 
 
 class TestTemplateIO:
@@ -419,6 +419,89 @@ class TestEnergyDependentNorms:
         assert abs(norms_lo - 0.3) < 0.06
         assert abs(norms_hi - 0.55) < 0.08
         assert norms_hi > norms_lo + 0.1
+
+
+class TestLCEZoo:
+    """The full energy-dependent primitive zoo (reference
+    lceprimitives.py:204-336): every base shape with linear-in-
+    log10(E) parameter evolution via the generic LCEWrapped."""
+
+    def _check_normalized_at(self, prim, log10_e):
+        grid = np.linspace(0.0, 1.0, 4001)
+        en = np.full_like(grid, log10_e)
+        p = np.array(prim.init_params())
+        f = np.asarray(prim.density(grid, p, en))
+        integral = np.trapezoid(f, grid) if hasattr(np, "trapezoid") \
+            else np.trapz(f, grid)
+        assert abs(integral - 1.0) < 3e-3, (type(prim).__name__,
+                                            log10_e, integral)
+        assert np.all(f >= -1e-9)
+
+    @pytest.mark.parametrize("make", [
+        lambda: __import__("pint_tpu.templates", fromlist=["x"])
+        .LCESkewGaussian(sigma=0.04, shape=3.0, loc=0.4,
+                         dsigma=-0.01, dloc=0.03),
+        lambda: __import__("pint_tpu.templates", fromlist=["x"])
+        .LCELorentzian(gamma=0.03, loc=0.5, dgamma=0.01, dloc=-0.02),
+        lambda: __import__("pint_tpu.templates", fromlist=["x"])
+        .LCELorentzian2(gamma1=0.02, gamma2=0.05, loc=0.4,
+                        dgamma1=0.005, dloc=0.02),
+        lambda: __import__("pint_tpu.templates", fromlist=["x"])
+        .LCEGaussian2(sigma1=0.03, sigma2=0.06, loc=0.6,
+                      dsigma2=-0.01, dloc=0.01),
+        lambda: __import__("pint_tpu.templates", fromlist=["x"])
+        .LCEVonMises(kappa=80.0, loc=0.5, dkappa=30.0, dloc=0.04),
+    ], ids=["skewgauss", "lorentzian", "lorentzian2", "gaussian2",
+            "vonmises"])
+    def test_normalized_across_energies(self, make):
+        prim = make()
+        for log10_e in (1.5, 2.0, 3.0, 4.0):
+            self._check_normalized_at(prim, log10_e)
+
+    def test_zero_slope_matches_base(self):
+        from pint_tpu.templates import LCELorentzian2, LCLorentzian2
+
+        base = LCLorentzian2(gamma1=0.02, gamma2=0.05, loc=0.4)
+        eprim = LCELorentzian2(gamma1=0.02, gamma2=0.05, loc=0.4)
+        grid = np.linspace(0.0, 1.0, 501)
+        en = np.full_like(grid, 3.7)  # any energy: slopes are zero
+        np.testing.assert_allclose(
+            np.asarray(eprim.density(grid, np.array(
+                eprim.init_params()), en)),
+            np.asarray(base.density(grid, np.array(
+                base.init_params()))),
+            rtol=1e-12)
+
+    def test_multiprimitive_slope_recovery(self):
+        """Two different energy-evolving shapes in one template: the
+        fit recovers both location slopes (verdict r4 item 7)."""
+        from pint_tpu.templates import (
+            LCEFitter, LCEGaussian, LCETemplate, LCEVonMises)
+
+        rng = np.random.default_rng(11)
+        n = 9000
+        log10_en = rng.uniform(2.0, 4.0, n)
+        x = log10_en - 2.0
+        comp = rng.random(n)
+        ph_g = rng.normal(0.3 + 0.05 * x, 0.03)
+        ph_v = (rng.vonmises(0.0, 60.0, n) / (2.0 * np.pi)
+                + (0.7 - 0.03 * x))
+        phases = np.where(comp < 0.4, ph_g,
+                          np.where(comp < 0.7, ph_v,
+                                   rng.random(n))) % 1.0
+        tpl = LCETemplate(
+            [LCEGaussian(sigma=0.035, loc=0.32),
+             LCEVonMises(kappa=50.0, loc=0.72)],
+            norms=[0.35, 0.25])
+        f = LCEFitter(tpl, phases, log10_en)
+        params, lnl = f.fit(maxiter=400)
+        # layout: [n1, n2, sigma, loc, dsigma, dloc,
+        #          kappa, loc_vm, dkappa, dloc_vm]
+        assert np.isfinite(lnl)
+        assert abs(params[3] - 0.3) < 0.02
+        assert abs(params[5] - 0.05) < 0.015
+        assert abs(params[7] - 0.7) < 0.02
+        assert abs(params[9] - (-0.03)) < 0.015
 
 
 class TestNewPrimitives:

@@ -1,0 +1,74 @@
+"""Decompose a golden ours-minus-tempo2 diff into timescales.
+
+Why: the golden diff on identical par/TOAs is a *deterministic* model
+difference (no data noise, no fit freedom beyond the phase mean), so its
+structure tells us exactly what a time-windowed Earth-position correction
+of a given knot spacing can absorb.  For each candidate knot spacing we
+fit a cubic spline (the same basis calibrate_pos_spline uses) to the
+diff and report the residual rms — the predicted post-calibration floor.
+
+Usage: python tools/diag_golden_diff.py [J1853_11y ...]
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+def epochize(t_day, d, gap=0.5):
+    """Cluster TOAs into observing epochs (gap days); return
+    (epoch mean time, epoch mean diff, within-epoch rms, counts)."""
+    order = np.argsort(t_day)
+    t, x = t_day[order], d[order]
+    breaks = np.flatnonzero(np.diff(t) > gap) + 1
+    groups = np.split(np.arange(len(t)), breaks)
+    tm = np.array([t[g].mean() for g in groups])
+    xm = np.array([x[g].mean() for g in groups])
+    win = np.concatenate([x[g] - x[g].mean() for g in groups])
+    cnt = np.array([len(g) for g in groups])
+    return tm, xm, float(win.std()), cnt
+
+
+def spline_residual(t, x, step_d):
+    from scipy.interpolate import CubicSpline
+
+    knots = np.arange(t.min() - step_d, t.max() + 2 * step_d, step_d)
+    # cardinal-basis least squares (not interpolation: epochs may be
+    # denser than knots in campaigns)
+    B = CubicSpline(knots, np.eye(len(knots)), axis=0)(
+        np.clip(t, knots[0], knots[-1]))
+    coef, *_ = np.linalg.lstsq(B, x, rcond=None)
+    r = x - B @ coef
+    return float(r.std())
+
+
+def main(names):
+    from tools.build_ephemeris import golden_diff_via_pipeline
+
+    npz = os.environ.get("PINT_TPU_EPHEM_BUILTIN") or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "pint_tpu", "data", "ephem_builtin.npz")
+    for name in names:
+        t_sec, d, k, f0 = golden_diff_via_pipeline(npz, name)
+        t_day = t_sec / 86400.0
+        tm, xm, win_rms, cnt = epochize(t_day, d)
+        print(f"\n=== {name}: n={len(d)} epochs={len(tm)} "
+              f"span={t_day.min():.0f}..{t_day.max():.0f} d "
+              f"(MJD {t_day.min()+51544.5:.0f}..{t_day.max()+51544.5:.0f})")
+        print(f"  full diff rms        = {d.std()*1e6:8.1f} us")
+        print(f"  within-epoch rms     = {win_rms*1e6:8.1f} us")
+        print(f"  epoch-mean rms       = {xm.std()*1e6:8.1f} us")
+        dt_ep = np.diff(np.sort(tm))
+        print(f"  epoch spacing: median={np.median(dt_ep):.1f} d "
+              f"p90={np.percentile(dt_ep, 90):.1f} d")
+        for step in (256.0, 128.0, 64.0, 32.0, 16.0, 8.0):
+            r = spline_residual(tm, xm, step)
+            print(f"  epoch-mean resid after {step:5.0f}-d cubic spline "
+                  f"= {r*1e6:8.1f} us")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:] or ["J1853_11y", "B1953_FB90"])
