@@ -553,6 +553,22 @@ def build_time_ephemeris(sysm):
 #: the wrap plateau (P/2), so the noise-free diff is usable as a linear
 #: constraint (see calibrate_joint docstring)
 GOLDEN_ANCHORS = ["J1853_11y", "B1953_FB90"]
+#: EXPLORED AND REJECTED BY MEASUREMENT (round 5, off by default —
+#: ``--extra-anchors`` re-enables for experiments): once the first
+#: position-spline pass un-wrapped them out-of-sample (B1855 9y:
+#: 2.06 ms -> 740 us smooth), promoting B1855 9y + J0023 to pos-stage
+#: anchors produced spectacular in-sample numbers (B1855 8-14 us,
+#: J0023 22 us) and a real out-of-sample gain (B1855_dfg 1.0 ms ->
+#: 111 us), BUT: the three near-parallel sky directions
+#: (NGC6440E/B1953/B1855 within ~30 deg) triangulate their ~100-us
+#: inconsistencies into multi-ms 3D corrections (max spline amplitude
+#: 2.9 -> 6.0 ms), NGC6440E's post-fit degrades 26 -> 175-203 us
+#: (tried extras sigma 5 and 25 us), and J0613 drifts into its wrap
+#: zone (668 -> 0.9-1.1 ms).  B1855's pre-2011 golden diff evidently
+#: contains non-Earth-position model difference; forcing it into the
+#: ephemeris is wrong physics.  The shipped npz is built WITHOUT
+#: extras.
+POS_EXTRA_ANCHORS = ["B1855_9y", "J0023_11y"]
 #: never fit against — out-of-sample validation only
 HOLDOUT_SETS = ["B1855_9y", "B1855_dfg_FB90", "J1744_basic",
                 "J0023_11y", "J0613_FB90"]
@@ -893,6 +909,14 @@ def calibrate_joint(sysm, workdir="/tmp", n_iter=8, n_pre=2):
 POS_SIG_GOLD = 5e-6
 POS_SIG_SLOW = 30e-6
 POS_SIG_FIX = 10e-6
+#: POS_EXTRA_ANCHORS sigma.  NOT the golden 5e-6: the extras share a
+#: ~30 deg sky region with B1953/NGC6440E, and forcing exact
+#: agreement among near-parallel directions triangulates small
+#: inconsistencies into multi-ms 3D corrections (measured: max spline
+#: amplitude 2.9 -> 6.0 ms, NGC6440E postfit 26 -> 203 us, J0613
+#: 668 us -> 1.1 ms wrapped).  At 25 us they inform their windows
+#: without bulldozing the other constraints.
+POS_SIG_EXTRA = 25e-6
 #: amplitude prior [light-s]: keeps unmeasured knots (2009-11 /
 #: 2016-19 gaps, unmeasured sky axes) near zero
 POS_SIG_AMP = 5e-4
@@ -902,7 +926,8 @@ POS_SIG_AMP = 5e-4
 POS_SIG_SMOOTH = 3e-4
 
 
-def calibrate_pos_spline(sysm, workdir="/tmp", n_iter=2):
+def calibrate_pos_spline(sysm, workdir="/tmp", n_iter=None,
+                         extra_anchors=False):
     """Direct windowed Earth-position correction (round 5).
 
     The element-basis stages (calibrate_joint) leave structure the
@@ -920,7 +945,24 @@ def calibrate_pos_spline(sysm, workdir="/tmp", n_iter=2):
     components at the min-norm solution.  The correction is therefore
     calibration (it generalizes to sky-adjacent pulsars — validated
     on the held-out B1855, 4.6 deg from J1853), not an ephemeris for
-    arbitrary directions.  HOLDOUT_SETS stay out of the fit."""
+    arbitrary directions.  HOLDOUT_SETS stay out of the fit — EXCEPT
+    under ``extra_anchors=True`` (off by default, rejected by
+    measurement), which promotes B1855 9y + J0023 INTO the fit and
+    therefore voids their holdout status for that build; a loud
+    warning marks such runs.
+
+    Default n_iter: 2 (the exactly-linear solve converges in one, the
+    second only re-evaluates wrap guards — this reproduces the shipped
+    npz); 3 with extras so the promoted anchors get two active
+    rounds."""
+    if n_iter is None:
+        n_iter = 3 if extra_anchors else 2
+    if extra_anchors:
+        print("WARNING: --extra-anchors promotes B1855_9y + J0023_11y "
+              "into the fit; their numbers are IN-SAMPLE for this "
+              "build and holdout comparisons against them are void "
+              "(rejected default — see POS_EXTRA_ANCHORS)",
+              flush=True)
     from tools.ephem_vs_tempo2 import load_truth
 
     _, tdb_sec, truth, _ = load_truth()
@@ -937,7 +979,13 @@ def calibrate_pos_spline(sysm, workdir="/tmp", n_iter=2):
         build_to(cur_npz, sysm, verbose=False)
         blocks_A, blocks_y = [], []
 
-        for gname in GOLDEN_ANCHORS:
+        # POS_EXTRA_ANCHORS are wrap-saturated in the pre-pos state,
+        # so they join from iteration 1, once the first pass has
+        # un-wrapped them; the P/3 keep mask drops straggler wraps.
+        # Off by default — see the rejection note at POS_EXTRA_ANCHORS.
+        anchors = GOLDEN_ANCHORS + (
+            POS_EXTRA_ANCHORS if extra_anchors and it >= 1 else [])
+        for gname in anchors:
             t_g, d_g, k_g, f0 = golden_diff_via_pipeline(
                 os.path.abspath(cur_npz), gname)
             t_g = t_g / 86400.0
@@ -948,8 +996,10 @@ def calibrate_pos_spline(sysm, workdir="/tmp", n_iter=2):
             B = pos_spline_cardinal(t_g)
             A = np.concatenate([B * k_g[ax] for ax in range(3)], axis=1)
             A = A - A.mean(axis=0)  # free phase mean
-            blocks_A.append(A / POS_SIG_GOLD)
-            blocks_y.append(-(d_g - d_g.mean()) / POS_SIG_GOLD)
+            sig = (POS_SIG_EXTRA if gname in POS_EXTRA_ANCHORS
+                   else POS_SIG_GOLD)
+            blocks_A.append(A / sig)
+            blocks_y.append(-(d_g - d_g.mean()) / sig)
 
         for sname, spar, stim in SLOW_SETS:
             t_s, d_s, k_s = slow_resids_via_pipeline(cur_npz, spar, stim)
@@ -999,7 +1049,8 @@ def calibrate_pos_spline(sysm, workdir="/tmp", n_iter=2):
 
     fin_npz = os.path.join(workdir, "ephem_pos_fin.npz")
     build_to(fin_npz, sysm, verbose=False)
-    for gname in GOLDEN_ANCHORS:
+    for gname in GOLDEN_ANCHORS + (POS_EXTRA_ANCHORS if extra_anchors
+                                   else []):
         _, d_g, _, _ = golden_diff_via_pipeline(
             os.path.abspath(fin_npz), gname)
         print(f"  pos final {gname} rms: {d_g.std()*1e6:.1f} us",
@@ -1010,7 +1061,7 @@ def calibrate_pos_spline(sysm, workdir="/tmp", n_iter=2):
               flush=True)
 
 
-def build(out_path, calibrate="joint"):
+def build(out_path, calibrate="joint", extra_anchors=False):
     print("integrating N-body system ...", flush=True)
     dense = integrate()
     print("fitting perturbation trends ...", flush=True)
@@ -1019,7 +1070,7 @@ def build(out_path, calibrate="joint"):
         print("joint calibration vs reference fixtures ...", flush=True)
         calibrate_joint(sysm)
         print("windowed position-spline calibration ...", flush=True)
-        calibrate_pos_spline(sysm)
+        calibrate_pos_spline(sysm, extra_anchors=extra_anchors)
     elif calibrate == "fixture":
         print("calibrating EMB elements vs tempo2 DE405 fixture ...",
               flush=True)
@@ -1091,5 +1142,10 @@ if __name__ == "__main__":
         "pint_tpu", "data", "ephem_builtin.npz"))
     ap.add_argument("--calibrate", default="joint",
                     choices=["joint", "fixture", "none"])
+    ap.add_argument("--extra-anchors", action="store_true",
+                    help="admit B1855 9y + J0023 as position-spline "
+                         "anchors (REJECTED default: see "
+                         "POS_EXTRA_ANCHORS note)")
     args = ap.parse_args()
-    build(args.out, calibrate=args.calibrate)
+    build(args.out, calibrate=args.calibrate,
+          extra_anchors=args.extra_anchors)
