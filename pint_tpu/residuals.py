@@ -88,6 +88,13 @@ class Residuals:
         self._delta_pn = (jnp.asarray(dpn) if np.any(dpn != 0.0)
                           else None)
         self.track_mode = track_mode
+        # extended Woodbury basis (mean-offset column appended), built
+        # eagerly OUTSIDE any trace — see _noise_basis_phi.  Always
+        # built: the wideband solve uses it even with a width-0 basis.
+        U = self.prepared.noise_basis
+        if self.subtract_mean:
+            U = jnp.concatenate([U, jnp.ones((U.shape[0], 1))], axis=1)
+        self._U_ext = U
         # jit wrappers are built lazily on first use: a 14-component GLS
         # model costs tens of seconds of XLA compile per function on
         # CPU, and most callers touch only one of the four
@@ -149,14 +156,19 @@ class Residuals:
 
     def _noise_basis_phi(self, values):
         """(U, phi) for the Woodbury paths, with the mean-offset column
-        appended when applicable."""
-        U = self.prepared.noise_basis
+        appended when applicable.
+
+        The extended U is values-independent and prebuilt EAGERLY in
+        __init__ (never inside a trace): concatenating in the traced
+        function re-created the (n_toa, n_basis) matrix as a fresh
+        constant-folded literal on every jit compile (XLA's
+        constant-folding alarm fired on the f64[8161,402] pad), and a
+        lazily-cached version leaks a tracer — jnp.ones under an
+        active trace is staged, not concrete."""
         phi = self.prepared.noise_weights_fn(values)
         if self.subtract_mean:
-            ones = jnp.ones((U.shape[0], 1))
-            U = jnp.concatenate([U, ones], axis=1)
             phi = jnp.concatenate([phi, jnp.array([MEAN_OFFSET_WEIGHT])])
-        return U, phi
+        return self._U_ext, phi
 
     def chi2_fn(self, values):
         r = self.time_resids_fn(values)
