@@ -75,7 +75,12 @@ def test_family_fits_converge():
     m, toas = _load("NGC6440E.par", "NGC6440E.tim")
     f = Fitter.auto(toas, m)
     f.fit_toas()
-    assert f.resids.rms_weighted() < 100e-6  # reference walkthrough ~us
+    # measured 26 us after the round-5 position-spline calibration
+    # (was 100.8, red, in round 4).  Tightened from 100 us: this
+    # post-fit is the arbiter that rejected the --extra-anchors
+    # promotion (which degraded it to 175-203 us), so the bound must
+    # be close enough to catch that class of regression.
+    assert f.resids.rms_weighted() < 50e-6
 
     m, toas = _load("J0023+0923_NANOGrav_11yv0.gls.par",
                     "J0023+0923_NANOGrav_11yv0.tim")

@@ -174,6 +174,48 @@ class TestGoldenResiduals:
                           "J1853+1303_NANOGrav_11yv0.gls.par.tempo2_test")
         assert rms < 2e-5
 
+    def test_j0613(self):
+        """Holdout, plateau-adjacent: measured 668 us (was 811 in
+        round 4), max 1.57 ms right at P/2 = 1.53 ms — marginally
+        wrapped, so this asserts the plateau neighborhood and guards
+        against a future calibration silently pushing J0613's sky
+        direction away (the rejected --extra-anchors configuration
+        measured 0.9-1.1 ms here)."""
+        rms = _golden_rms("J0613-0200_NANOGrav_dfg+12_TAI_FB90.par",
+                          "J0613-0200_NANOGrav_dfg+12.tim",
+                          "J0613-0200_NANOGrav_dfg+12_TAI_FB90.par"
+                          ".tempo2_test")
+        # 0.85e-3: below the rejected configuration's 0.9-1.1 ms range
+        # (so that regression class actually trips), 27% above measured
+        assert rms < 0.85e-3
+
+    def test_j0023(self):
+        """Holdout: measured 791 us and SMOOTH since round 5 — the
+        pre-round-5 state had 177 us of within-epoch wrap flips, now
+        0.1 us.  The within-epoch scatter is the statistic that locks
+        the un-wrapping (the raw rms sits near the P/sqrt(12) =
+        0.88 ms plateau and cannot distinguish re-saturation)."""
+        from pint_tpu.models.builder import get_model_and_toas
+        from pint_tpu.residuals import Residuals
+
+        model, toas = get_model_and_toas(
+            os.path.join(REFDATA, "J0023+0923_NANOGrav_11yv0.gls.par"),
+            os.path.join(REFDATA, "J0023+0923_NANOGrav_11yv0.tim"))
+        r = Residuals(toas, model, subtract_mean=True,
+                      use_weighted_mean=False, track_mode="nearest")
+        t2 = np.genfromtxt(
+            os.path.join(REFDATA, "J0023+0923_NANOGrav_11yv0.gls.par"
+                         ".tempo2_test"), skip_header=1, unpack=True)
+        if t2.ndim > 1:
+            t2 = t2[0]
+        d = np.asarray(r.time_resids) - t2
+        assert np.sqrt(np.mean((d - d.mean()) ** 2)) < 1.0e-3
+        day = np.round(np.asarray(toas.mjd_float)).astype(int)
+        win = np.concatenate([d[day == u] - d[day == u].mean()
+                              for u in np.unique(day)
+                              if (day == u).sum() >= 4])
+        assert win.std() < 20e-6, win.std()  # measured 0.1 us
+
     def test_b1855_9y(self):
         """HOLDOUT brought below its wrap plateau OUT-OF-SAMPLE
         (round-5 verdict item 2 'done' criterion): B1855 is 4.6 deg
