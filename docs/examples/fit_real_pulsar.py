@@ -14,6 +14,15 @@ sys.path.insert(0, os.path.join(os.path.dirname(
 
 import numpy as np
 
+from pint_tpu.backend_probe import ensure_live_backend
+
+# a hung TPU tunnel would otherwise block jax init forever; the
+# probe diagnoses it and drops to the CPU backend
+_live, _detail = ensure_live_backend()
+if not _live:
+    print(f"note: default backend unresponsive ({_detail}); "
+          "running on CPU")
+
 REFDATA = os.environ.get("PINT_TPU_EXAMPLE_DATA",
                          "/root/reference/tests/datafile")
 

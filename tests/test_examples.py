@@ -1,0 +1,48 @@
+"""The runnable examples in docs/examples must actually run.
+
+The cheap synthetic one runs in every suite; the two heavier ones
+(real-data fit, 8-device mesh batch) are gated behind the same env
+flag as the full golden sweep.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "docs", "examples")
+FULL = os.environ.get("PINT_TPU_FULL_GOLDEN", "") == "1"
+
+
+def _run(name, cwd, timeout=600):
+    # cwd = a temp dir: examples must not depend on the repo-root cwd,
+    # and fit_real_pulsar writes its output par into the cwd.
+    # JAX_PLATFORMS=cpu explicitly — relying on conftest's os.environ
+    # side effect would leave this test hanging on a dead TPU tunnel
+    # when run outside the suite's conftest (pint_tpu.__init__ applies
+    # the jax config update for the env var in the child)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, name)],
+        capture_output=True, text=True, timeout=timeout,
+        env=env, cwd=str(cwd))
+    assert r.returncode == 0, r.stderr[-2000:]
+    return r.stdout
+
+
+def test_photon_template_example(tmp_path):
+    out = _run("photon_template_fit.py", tmp_path)
+    assert "energy-dependent fit" in out
+
+
+@pytest.mark.skipif(not FULL, reason="set PINT_TPU_FULL_GOLDEN=1")
+def test_fit_real_pulsar_example(tmp_path):
+    out = _run("fit_real_pulsar.py", tmp_path)
+    assert "postfit rms" in out
+
+
+@pytest.mark.skipif(not FULL, reason="set PINT_TPU_FULL_GOLDEN=1")
+def test_pta_batch_example(tmp_path):
+    out = _run("pta_batch_fit.py", tmp_path)
+    assert "chi2" in out
