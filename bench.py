@@ -1,7 +1,10 @@
 """Driver benchmark suite vs the reference baselines (BASELINE.md).
 
 Emits ONE JSON line per metric, each
-``{"metric", "value", "unit", "vs_baseline"}``:
+``{"metric", "value", "unit", "vs_baseline", "backend", "compile_s",
+"flops"}`` — the last three are structured fields sourced from
+:mod:`pint_tpu.telemetry` / :mod:`pint_tpu.flops` (no consumer ever
+parses the human-readable ``unit`` string):
 
 1. ``gls_toas_per_sec`` — BASELINE.json's primary metric: a full GLS
    fit of a B1855-class config (DD binary, EFAC/EQUAD/ECORR masks,
@@ -18,10 +21,15 @@ Emits ONE JSON line per metric, each
    program (the reference's only analogue is a process fan-out of
    ~20 s/fit single-core sequential fits = 0.05 fits/s).
 
-Compile time is reported inside each unit string (amortized out of the
-timed number, like the reference's separately-reported load times), as
-is a rough FLOP estimate per timed call where meaningful.
-Runs on whatever backend JAX selects (the real TPU under the driver).
+Compile time is amortized out of the timed number (like the
+reference's separately-reported load times) and reported as the
+``compile_s`` field: sourced from the telemetry layer's
+``jax.monitoring`` compile counters when they tick, the warm-up call's
+wall time otherwise.  ``flops`` is the pint_tpu.flops cost-model
+estimate per timed call where meaningful.  Runs on whatever backend
+JAX selects (the real TPU under the driver); with ``PINT_TPU_TRACE``
+set, every metric record is mirrored into the JSONL trace sink
+alongside the library's own spans.
 """
 
 import json
@@ -90,15 +98,18 @@ def bench_roofline(jnp, backend):
     import jax
     from jax import lax
 
+    from pint_tpu import flops as fl
+
     n = 1536
     a = jnp.ones((n, n), jnp.float64) * 1.000001
     b = jnp.ones((n, n), jnp.float64) * 0.999999
 
     mm = jax.jit(lambda a, b: a @ b)
-    mm(a, b).block_until_ready()
+    compile_s = _timed_compile(lambda: mm(a, b).block_until_ready())
     best = min(_timed(lambda: mm(a, b).block_until_ready())
                for _ in range(3))
-    matmul_flops = 2.0 * n**3 / best
+    mm_count = fl.matmul_flops(n)
+    matmul_flops = mm_count / best
 
     from pint_tpu import dd
 
@@ -112,10 +123,10 @@ def bench_roofline(jnp, backend):
         return lax.fori_loop(0, iters, body, x)
 
     ch = jax.jit(chain)
-    ch(x).hi.block_until_ready()
+    compile_s += _timed_compile(lambda: ch(x).hi.block_until_ready())
     best_dd = min(_timed(lambda: ch(x).hi.block_until_ready())
                   for _ in range(3))
-    dd_flops = 43.0 * m * iters / best_dd
+    dd_flops = fl.dd_chain_flops(m, iters) / best_dd
 
     from pint_tpu.fixedpoint import phase_f0_t, seconds_to_ticks_f64
 
@@ -129,12 +140,12 @@ def bench_roofline(jnp, backend):
         return lax.fori_loop(0, iters, body, jnp.zeros(m))
 
     ph = jax.jit(phases)
-    ph(ticks).block_until_ready()
+    compile_s += _timed_compile(lambda: ph(ticks).block_until_ready())
     best_ph = min(_timed(lambda: ph(ticks).block_until_ready())
                   for _ in range(3))
     phase_rate = m * iters / best_ph
 
-    print(json.dumps({
+    _emit_metric({
         "metric": "roofline_f64_matmul_flops",
         "value": round(matmul_flops / 1e9, 2),
         "unit": (f"GFLOP/s measured (backend={backend}; f64 "
@@ -144,13 +155,43 @@ def bench_roofline(jnp, backend):
                  f"assumed-peak ratio "
                  f"{matmul_flops / _PEAK_F64_FLOPS.get(backend.split('-')[0], float('nan')):.2f})"),
         "vs_baseline": None,
-    }), flush=True)
+        "backend": backend,
+        "compile_s": round(compile_s, 3),
+        "flops": mm_count,
+    })
 
 
 def _timed(fn):
     t0 = time.time()
     fn()
     return time.time() - t0
+
+
+def _timed_compile(fn):
+    """Run the warm-up (compiling) call; return compile seconds.
+
+    Sourced from the telemetry layer's jax.monitoring compile-duration
+    counters when they ticked during the call (the honest number: it
+    excludes the warm-up's run time), the call's wall time otherwise
+    (the fallback regime, matching the suite's historical behavior)."""
+    from pint_tpu import telemetry
+
+    telemetry.compile_stats()  # install the listener before compiling
+    before = telemetry.counter_get("jit.compile_seconds")
+    t0 = time.time()
+    fn()
+    wall = time.time() - t0
+    delta = telemetry.counter_get("jit.compile_seconds") - before
+    return delta if delta > 0 else wall
+
+
+def _emit_metric(rec):
+    """One benchmark record: stdout JSON line + telemetry sink mirror
+    (one source of truth for the parent AND the trace file)."""
+    from pint_tpu import telemetry
+
+    print(json.dumps(rec), flush=True)
+    telemetry.emit({"type": "metric", **rec})
 
 B1855_LIKE_PAR = """PSR  B1855-LIKE
 RAJ 18:57:36.39
@@ -206,6 +247,7 @@ def _sim_two_band(model, n_toas, span=(53000.0, 56500.0), seed=0):
 
 
 def bench_gls(jnp, backend):
+    from pint_tpu import flops as fl
     from pint_tpu.fitter import GLSFitter
     from pint_tpu.models.builder import get_model
 
@@ -217,9 +259,7 @@ def bench_gls(jnp, backend):
     f = GLSFitter(toas, model)
     base_values = dict(model.values)
 
-    t0 = time.time()
-    f.fit_toas(maxiter=3)
-    compile_s = time.time() - t0
+    compile_s = _timed_compile(lambda: f.fit_toas(maxiter=3))
     # steady state: reset the start point and refit — values enter the
     # jitted step as arguments, so the compiled program is reused (the
     # framework's repeated-fit contract; grids/PTA batches rely on it)
@@ -230,12 +270,11 @@ def bench_gls(jnp, backend):
         f.fit_toas(maxiter=3)
     wall = (time.time() - t0) / reps
     toas_per_sec = n_toas / wall
-    # rough FLOPs: 3 iters x (jacfwd design ~ nfree x 60-op chain x N
-    # + normal equations N P^2 + basis (N x nb) ops)
-    nb = 2 * 30 + 120  # red-noise modes + ecorr epochs (approx)
-    flops = 3 * (nfree * 60 * n_toas * 2
-                 + n_toas * (nfree + nb) ** 2 * 2)
-    print(json.dumps({
+    # noise-basis width: the fitter's actual prepared basis (the cost
+    # model bench.py used to rebuild by hand)
+    nb = int(f.prepared.noise_basis.shape[1])
+    flops = fl.gls_fit_flops(n_toas, nfree, nb, n_iter=3)
+    _emit_metric({
         "metric": "gls_toas_per_sec",
         "value": round(toas_per_sec, 1),
         "unit": f"TOAs/s full GLS fit ({n_toas} TOAs, {nfree} free "
@@ -243,7 +282,10 @@ def bench_gls(jnp, backend):
                 f"compile={compile_s:.1f}s"
                 + _mfu_str(flops, wall, backend) + ")",
         "vs_baseline": round(toas_per_sec / 497.0, 1),
-    }), flush=True)
+        "backend": backend,
+        "compile_s": round(compile_s, 3),
+        "flops": flops,
+    })
 
 
 def bench_wls_grid(jnp, backend):
@@ -260,18 +302,17 @@ def bench_wls_grid(jnp, backend):
     mesh = np.array([(a, b) for a in m2s for b in sinis])
     fn, _ = make_grid_fn(toas, model, ["M2", "SINI"], n_steps=3)
     mesh_dev = jnp.asarray(mesh)
-    t0 = time.time()
-    np.asarray(fn(mesh_dev)[0])
-    compile_s = time.time() - t0
+    compile_s = _timed_compile(lambda: np.asarray(fn(mesh_dev)[0]))
     t0 = time.time()
     chi2 = np.asarray(fn(mesh_dev)[0])
     wall = time.time() - t0
     assert np.all(np.isfinite(chi2)), "grid produced non-finite chi2"
     pts = len(mesh) / wall
+    from pint_tpu import flops as fl
+
     nfree = len(model.free_params) - 2  # M2/SINI pinned per grid point
-    flops = len(mesh) * 3 * (nfree * 60 * n_toas * 2
-                             + n_toas * nfree ** 2 * 2)
-    print(json.dumps({
+    flops = fl.wls_grid_flops(len(mesh), n_toas, nfree, n_iter=3)
+    _emit_metric({
         "metric": "wls_chisq_grid_points_per_sec",
         "value": round(pts, 2),
         "unit": f"grid points/s (binary MSP, (M2,SINI) {n_side}x"
@@ -279,7 +320,10 @@ def bench_wls_grid(jnp, backend):
                 f"backend={backend}, compile={compile_s:.1f}s"
                 + _mfu_str(flops, wall, backend) + ")",
         "vs_baseline": round(pts / (9.0 / 176.437), 1),
-    }), flush=True)
+        "backend": backend,
+        "compile_s": round(compile_s, 3),
+        "flops": flops,
+    })
 
 
 def bench_mcmc(jnp, backend):
@@ -307,16 +351,16 @@ def bench_mcmc(jnp, backend):
     nwalkers, nsteps = 32, 200
     s = EnsembleSampler(lnpost, nwalkers=nwalkers, seed=0)
     x0 = s.initial_ball(center, scales)
-    t0 = time.time()
-    s.run_mcmc(x0, 2)
-    compile_s = time.time() - t0
+    compile_s = _timed_compile(lambda: s.run_mcmc(x0, 2))
     s2 = EnsembleSampler(lnpost, nwalkers=nwalkers, seed=1)
     t0 = time.time()
     s2.run_mcmc(x0, nsteps)
     wall = time.time() - t0
     evals = nwalkers * nsteps / wall
-    flops = nwalkers * nsteps * len(toas) * 60 * 2  # chi2 chain/eval
-    print(json.dumps({
+    from pint_tpu import flops as fl
+
+    flops = fl.mcmc_flops(nwalkers * nsteps, len(toas))
+    _emit_metric({
         "metric": "mcmc_evals_per_sec",
         "value": round(evals, 1),
         "unit": f"posterior evals/s (NGC6440E, {nwalkers} walkers x "
@@ -324,7 +368,10 @@ def bench_mcmc(jnp, backend):
                 f"compile={compile_s:.1f}s"
                 + _mfu_str(flops, wall, backend) + ")",
         "vs_baseline": round(evals / 38.5, 1),
-    }), flush=True)
+        "backend": backend,
+        "compile_s": round(compile_s, 3),
+        "flops": flops,
+    })
 
 
 def bench_pta(jnp, backend):
@@ -373,19 +420,18 @@ def bench_pta(jnp, backend):
             flags={"f": "L-wide"})
         pairs.append((m, t))
     batch = PTABatch(pairs)
-    t0 = time.time()
-    batch.fit_wideband(maxiter=3)
-    compile_s = time.time() - t0
+    compile_s = _timed_compile(lambda: batch.fit_wideband(maxiter=3))
     t0 = time.time()
     _, chi2, _ = batch.fit_wideband(maxiter=3)
     np.asarray(chi2)
     wall = time.time() - t0
     fits = n_psr / wall
-    nfree = 14  # superset free params per pulsar (approx, incl. DDK)
-    nb = 2 * 30 + 60  # red-noise modes + ecorr epochs (approx)
-    flops = n_psr * 3 * (nfree * 60 * n_toas * 2
-                         + n_toas * (nfree + nb) ** 2 * 2)
-    print(json.dumps({
+    from pint_tpu import flops as fl
+
+    nfree = len(batch.free_names)  # union free params per pulsar
+    nb = batch._noise_basis_width()
+    flops = fl.pta_batch_flops(n_psr, n_toas, nfree, nb, n_iter=3)
+    _emit_metric({
         "metric": "pta_batch_fits_per_sec",
         "value": round(fits, 2),
         "unit": f"pulsar GLS fits/s ({n_psr} heterogeneous pulsars "
@@ -394,7 +440,10 @@ def bench_pta(jnp, backend):
                 f"backend={backend}, compile={compile_s:.1f}s"
                 + _mfu_str(flops, wall, backend) + ")",
         "vs_baseline": round(fits / 0.05, 1),
-    }), flush=True)
+        "backend": backend,
+        "compile_s": round(compile_s, 3),
+        "flops": flops,
+    })
 
 
 #: run order: the roofline first (its measured matmul peak becomes the
@@ -433,6 +482,12 @@ def _run_one(name):
     import jax.numpy as jnp
 
     import pint_tpu  # noqa: F401  (x64)
+    from pint_tpu import telemetry
+    from pint_tpu.telemetry import span
+
+    # compile listener BEFORE any compilation so compile_s can be
+    # sourced from the monitoring counters rather than wall clocks
+    telemetry.compile_stats()
 
     backend = jax.default_backend()
     if os.environ.get("PINT_TPU_BENCH_FALLBACK"):
@@ -441,14 +496,18 @@ def _run_one(name):
         backend += "-fallback"
 
     try:
-        _METRICS[name](jnp, backend)
+        with span("bench.metric", metric=name, backend=backend):
+            _METRICS[name](jnp, backend)
+        telemetry.flush()
         return 0
     except Exception as e:
-        print(json.dumps({
+        _emit_metric({
             "metric": name, "value": None,
             "unit": f"FAILED: {type(e).__name__}: {e}",
             "vs_baseline": None,
-        }), flush=True)
+            "backend": backend, "compile_s": None, "flops": None,
+        })
+        telemetry.flush()
         # sentinel: "failed but the JSON line was printed" — any other
         # nonzero (unhandled import error rc=1, signal death rc<0)
         # means the parent must print the line itself
@@ -588,18 +647,17 @@ def main():
                 # where later metrics also fall back to the same cpu
                 # backend).  Backend mismatch (fallback peak vs a live
                 # TPU metric, or vice versa) is handled by _mfu_str
-                # comparing the backend tag exported here.
+                # comparing the backend tag exported here.  The backend
+                # is a structured field of the record — never regexed
+                # out of the display string (ADVICE round 5).
                 try:
-                    import re
-
                     parsed = json.loads(line)
                     peak_gflops = float(parsed["value"])
-                    mb = re.search(r"backend=([a-zA-Z-]+)",
-                                   parsed["unit"])
+                    rec_backend = parsed.get("backend") or ""
                     os.environ["PINT_TPU_MEASURED_PEAK_F64"] = str(
                         peak_gflops * 1e9)
                     os.environ["PINT_TPU_MEASURED_PEAK_BACKEND"] = (
-                        mb.group(1).split("-")[0] if mb else "")
+                        rec_backend.split("-")[0])
                 except (ValueError, KeyError, json.JSONDecodeError):
                     pass
             if '"value": null' in line or '"value": NaN' in line:

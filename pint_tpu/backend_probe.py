@@ -35,6 +35,9 @@ def probe_backend(timeout_s: float, force_cpu_env: str | None = None):
     """
     import subprocess
 
+    from pint_tpu import telemetry
+
+    telemetry.counter_add("backend_probe.attempts")
     pre = ""
     if force_cpu_env:
         pre = (
@@ -53,10 +56,19 @@ def probe_backend(timeout_s: float, force_cpu_env: str | None = None):
                            capture_output=True, text=True,
                            timeout=timeout_s)
         if r.returncode == 0:
-            return True, r.stdout.strip().splitlines()[-1]
+            lines = r.stdout.strip().splitlines()
+            if not lines:
+                # rc==0 with stdout swallowed (wrapper/sitecustomize):
+                # a diagnostic, not an IndexError (ADVICE round 5)
+                telemetry.counter_add("backend_probe.failures")
+                return False, "probe produced no output"
+            telemetry.counter_add("backend_probe.ok")
+            return True, lines[-1]
+        telemetry.counter_add("backend_probe.failures")
         return False, ("probe exited rc=%d: %s"
                        % (r.returncode, r.stderr.strip()[-300:]))
     except subprocess.TimeoutExpired:
+        telemetry.counter_add("backend_probe.timeouts")
         return False, ("probe timed out after %.0fs (hung device "
                        "tunnel)" % timeout_s)
 
@@ -81,6 +93,9 @@ def ensure_live_backend(timeout_s: float | None = None):
         timeout_s = float(os.environ.get("PINT_TPU_PROBE_TIMEOUT", "20"))
     ok, detail = probe_backend(timeout_s)
     if not ok:
+        from pint_tpu import telemetry
+
+        telemetry.counter_add("backend_probe.cpu_fallbacks")
         os.environ["JAX_PLATFORMS"] = "cpu"
         import jax
 

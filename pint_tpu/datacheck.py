@@ -150,7 +150,67 @@ def datacheck_report(ephem="builtin", sites=("gbt", "ao", "jb", "pks",
         + ("IEEE correctly-rounded (dd arithmetic valid)" if ieee
            else "~49-bit emulated (int64 fixed-point phase path active; "
                 "see TPU_PRECISION.md)"))
+
+    # -- telemetry: probe outcome counters + compile stats -------------------
+    from pint_tpu import telemetry
+
+    cs = telemetry.compile_stats()
+    lines.append(
+        "Telemetry: spans "
+        + ("enabled" if telemetry.enabled() else
+           "disabled (set $PINT_TPU_TRACE=path for a JSONL trace)"))
+    lines.append(
+        f"  backend probe: {'live' if backend_live else 'UNRESPONSIVE'}; "
+        f"attempts {int(telemetry.counter_get('backend_probe.attempts'))}"
+        f", timeouts "
+        f"{int(telemetry.counter_get('backend_probe.timeouts'))}, "
+        f"cpu fallbacks "
+        f"{int(telemetry.counter_get('backend_probe.cpu_fallbacks'))}")
+    lines.append(
+        f"  jit compile: {cs['events']} event(s), "
+        f"{cs['seconds']:.2f}s this session (source: {cs['source']})")
+    for tline in _last_session_compile_lines():
+        lines.append(tline)
     return lines
+
+
+def _last_session_compile_lines():
+    """Compile/span stats aggregated from the $PINT_TPU_TRACE file, if
+    one exists and parses.  The sink appends, so the totals cover every
+    session that wrote to the file — including the current one when its
+    sink is attached to the same path (the label says so).  Parsing is
+    delegated to the pinttrace CLI's loader so the two trace consumers
+    can't drift."""
+    from pint_tpu.scripts.pinttrace import _load
+
+    path = os.environ.get("PINT_TPU_TRACE")
+    if not path or not os.path.exists(path):
+        return []
+    try:
+        records, _ = _load(path)
+    except OSError:
+        return []
+    events = seconds = None
+    n_spans = 0
+    for rec in records:
+        if rec.get("type") == "span":
+            n_spans += 1
+        elif rec.get("type") == "counter":
+            if rec.get("name") == "jit.compile_events":
+                events = rec.get("value")
+            elif rec.get("name") == "jit.compile_seconds":
+                seconds = rec.get("value")
+    if events is None and seconds is None and not n_spans:
+        return []
+    from pint_tpu import telemetry
+
+    live = " incl. this session" if telemetry.enabled() else ""
+    out = [f"  trace file ({path}, all sessions{live}): "
+           f"{n_spans} span(s)"]
+    if events is not None or seconds is not None:
+        out[0] += (f", compile {int(events or 0)} event(s) / "
+                   f"{float(seconds or 0.0):.2f}s")
+    return out
 
 
 def main(argv=None):

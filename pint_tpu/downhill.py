@@ -19,8 +19,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pint_tpu import telemetry
 from pint_tpu.fitter import Fitter, GLSFitter, WLSFitter, WidebandTOAFitter
 from pint_tpu.linalg import gls_normal_solve
+from pint_tpu.telemetry import span
 
 __all__ = ["DownhillWLSFitter", "DownhillGLSFitter",
            "WidebandDownhillFitter"]
@@ -83,33 +85,51 @@ class _DownhillMixin:
     def fit_toas(self, maxiter=20, fit_noise=False, noise_maxiter=100):
         if not self.model.free_timing_params:
             raise ValueError("no free timing parameters to fit")
-        if tuple(self.model.free_timing_params) != getattr(
-                self, "_traced_free", ()):
-            self._retrace()
-            self._halving_jit = jax.jit(self._halving_step)
-        elif not hasattr(self, "_halving_jit"):
-            self._halving_jit = jax.jit(self._halving_step)
-        vec = jnp.array(
-            [self.model.values[k] for k in self._traced_free],
-            dtype=jnp.float64,
-        )
-        base = self.prepared._values_pytree()
-        cov = None
-        self.converged = False
-        for _ in range(maxiter):
-            vec, chi2_old, chi2_new, cov = self._halving_jit(vec, base)
-            if float(chi2_old) - float(chi2_new) < self.min_chi2_decrease:
-                self.converged = True
-                break
-        vec = np.asarray(vec)
-        errs = np.sqrt(np.diag(np.asarray(cov)))
-        params = self.model.params
-        for i, name in enumerate(self._traced_free):
-            self.model.values[name] = float(vec[i])
-            params[name].uncertainty = float(errs[i])
-        self.covariance = np.asarray(cov)
-        self._update_fit_meta()
-        self._post_fit()
+        with span("downhill_fit", fitter=type(self).__name__,
+                  n_toa=len(self.toas),
+                  n_free=len(self.model.free_timing_params),
+                  maxiter=maxiter) as sp:
+            if tuple(self.model.free_timing_params) != getattr(
+                    self, "_traced_free", ()):
+                self._retrace()
+                self._halving_jit = jax.jit(self._halving_step)
+            elif not hasattr(self, "_halving_jit"):
+                telemetry.counter_add("fitter.retraces")
+                self._halving_jit = jax.jit(self._halving_step)
+            else:
+                telemetry.counter_add("fitter.jit_cache_hits")
+            vec = jnp.array(
+                [self.model.values[k] for k in self._traced_free],
+                dtype=jnp.float64,
+            )
+            base = self.prepared._values_pytree()
+            cov = None
+            n_iter = 0
+            self.converged = False
+            for _ in range(maxiter):
+                vec, chi2_old, chi2_new, cov = self._halving_jit(vec, base)
+                n_iter += 1
+                if float(chi2_old) - float(chi2_new) \
+                        < self.min_chi2_decrease:
+                    self.converged = True
+                    break
+            vec = np.asarray(vec)
+            cov_np = np.asarray(cov)
+            telemetry.record_transfer(vec)
+            telemetry.record_transfer(cov_np)
+            errs = np.sqrt(np.diag(cov_np))
+            params = self.model.params
+            for i, name in enumerate(self._traced_free):
+                self.model.values[name] = float(vec[i])
+                params[name].uncertainty = float(errs[i])
+            self.covariance = cov_np
+            flops_est = self._fit_flops_est(n_iter)
+            telemetry.counter_add("fitter.iterations", n_iter)
+            telemetry.counter_add("fit.flops_est", flops_est)
+            sp.set(n_iter=n_iter, converged=self.converged,
+                   flops_est=flops_est)
+            self._update_fit_meta()
+            self._post_fit()
         if fit_noise:
             self.fit_noise(maxiter=noise_maxiter)
         return float(self.resids.chi2)
@@ -146,10 +166,11 @@ class _DownhillMixin:
             f, g = val_grad(jnp.asarray(v))
             return float(f), np.asarray(g, dtype=np.float64)
 
-        res = minimize(
-            fun, x, jac=True, method="L-BFGS-B",
-            options={"maxiter": maxiter},
-        )
+        with span("fit_noise", n_noise=len(names), maxiter=maxiter):
+            res = minimize(
+                fun, x, jac=True, method="L-BFGS-B",
+                options={"maxiter": maxiter},
+            )
         x = res.x
         for i, n in enumerate(names):
             self.model.values[n] = float(x[i])

@@ -22,7 +22,9 @@ import os
 import numpy as np
 
 from pint_tpu import AU_LS
+from pint_tpu import telemetry
 from pint_tpu.ephem import Ephemeris, PosVel
+from pint_tpu.telemetry import span
 from pint_tpu.ephem.analytic import (
     _EARTH_MOON_MASS_RATIO,
     _ECL_TO_EQ,
@@ -71,16 +73,19 @@ class CompiledEphemeris(Ephemeris):
             raise FileNotFoundError(path)
         st = os.stat(path)
         self._identity = f"compiled:{path}:{st.st_mtime_ns}:{st.st_size}"
-        z = np.load(path)
-        self.t0_day = float(z["t0_day"])
-        self.t1_day = float(z["t1_day"])
-        self._seg = {}
-        for b in [str(x) for x in z["bodies"]]:
-            self._seg[b] = (float(z[f"{b}_seg_d"]),
-                            np.ascontiguousarray(z[f"{b}_coeffs"]))
-        if "tdbtt_coeffs" in z:
-            self._seg["tdbtt"] = (float(z["tdbtt_seg_d"]),
-                                  np.ascontiguousarray(z["tdbtt_coeffs"]))
+        with span("ephem.load", path=path, bytes=st.st_size):
+            z = np.load(path)
+            self.t0_day = float(z["t0_day"])
+            self.t1_day = float(z["t1_day"])
+            self._seg = {}
+            for b in [str(x) for x in z["bodies"]]:
+                self._seg[b] = (float(z[f"{b}_seg_d"]),
+                                np.ascontiguousarray(z[f"{b}_coeffs"]))
+            if "tdbtt_coeffs" in z:
+                self._seg["tdbtt"] = (float(z["tdbtt_seg_d"]),
+                                      np.ascontiguousarray(
+                                          z["tdbtt_coeffs"]))
+        telemetry.counter_add("ephem.loads")
 
     @property
     def identity(self) -> str:
@@ -104,6 +109,7 @@ class CompiledEphemeris(Ephemeris):
         """(pos AU, vel AU/day) in ecliptic J2000, from the segments."""
         seg_d, coeffs = self._seg[body]
         t_day = np.atleast_1d(np.asarray(t_day, np.float64))
+        telemetry.counter_add("ephem.cheb_evals", float(t_day.size))
         if (t_day < self.t0_day).any() or (t_day > self.t1_day).any():
             bad_lo = float(t_day.min())
             bad_hi = float(t_day.max())

@@ -19,11 +19,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pint_tpu import flops as _flops
+from pint_tpu import telemetry
 from pint_tpu.linalg import gls_normal_solve
 from pint_tpu.residuals import Residuals, WidebandTOAResiduals
+from pint_tpu.telemetry import span
 
 __all__ = ["WLSFitter", "GLSFitter", "WidebandTOAFitter", "Fitter",
            "wls_gn_solve"]
+
+# compile events fire during the first fit_toas; the jax.monitoring
+# listener must exist before then for jit.compile_* counters to tick
+telemetry._install_compile_listener()
 
 
 def wls_gn_solve(resid_fn, vec, err, threshold=1e-14):
@@ -151,6 +158,7 @@ class Fitter:
         The trace closes over the free-param *names*; a changed free set
         with the same count would otherwise hit the stale jit cache and
         silently write steps into the wrong parameters."""
+        telemetry.counter_add("fitter.retraces")
         self._traced_free = tuple(self.model.free_timing_params)
         self._step_jit = jax.jit(self._step)
 
@@ -178,35 +186,58 @@ class Fitter:
                 "no free timing parameters to fit (mark them with a '1' "
                 "fit flag in the par file or clear Param.frozen)"
             )
-        if tuple(self.model.free_timing_params) != getattr(
-                self, "_traced_free", ()):
-            self._retrace()
-        vec = jnp.array(
-            [self.model.values[k] for k in self._traced_free],
-            dtype=jnp.float64,
-        )
-        base = self.prepared._values_pytree()
-        chi2_prev = None
-        cov = None
-        self._step_extras = ()
-        for _ in range(maxiter):
-            vec, chi2, dpar, cov, *extras = self._step_jit(vec, base)
-            self._step_extras = extras
-            if chi2_prev is not None and abs(float(chi2_prev) - float(chi2)) \
-                    < 1e-8 * max(float(chi2), 1.0):
-                break
-            chi2_prev = chi2
-        # write back
-        vec = np.asarray(vec)
-        errs = np.sqrt(np.diag(np.asarray(cov)))
-        params = self.model.params
-        for i, name in enumerate(self._traced_free):
-            self.model.values[name] = float(vec[i])
-            params[name].uncertainty = float(errs[i])
-        self.covariance = np.asarray(cov)
-        self._update_fit_meta()
-        self._post_fit()
-        return float(self.resids.chi2)
+        with span("fit_toas", fitter=type(self).__name__,
+                  n_toa=len(self.toas),
+                  n_free=len(self.model.free_timing_params),
+                  maxiter=maxiter) as sp:
+            if tuple(self.model.free_timing_params) != getattr(
+                    self, "_traced_free", ()):
+                self._retrace()
+            else:
+                telemetry.counter_add("fitter.jit_cache_hits")
+            vec = jnp.array(
+                [self.model.values[k] for k in self._traced_free],
+                dtype=jnp.float64,
+            )
+            base = self.prepared._values_pytree()
+            chi2_prev = None
+            cov = None
+            n_iter = 0
+            self._step_extras = ()
+            for _ in range(maxiter):
+                vec, chi2, dpar, cov, *extras = self._step_jit(vec, base)
+                n_iter += 1
+                self._step_extras = extras
+                if chi2_prev is not None and \
+                        abs(float(chi2_prev) - float(chi2)) \
+                        < 1e-8 * max(float(chi2), 1.0):
+                    break
+                chi2_prev = chi2
+            # write back
+            vec = np.asarray(vec)
+            cov_np = np.asarray(cov)
+            telemetry.record_transfer(vec)
+            telemetry.record_transfer(cov_np)
+            errs = np.sqrt(np.diag(cov_np))
+            params = self.model.params
+            for i, name in enumerate(self._traced_free):
+                self.model.values[name] = float(vec[i])
+                params[name].uncertainty = float(errs[i])
+            self.covariance = cov_np
+            flops_est = self._fit_flops_est(n_iter)
+            telemetry.counter_add("fitter.iterations", n_iter)
+            telemetry.counter_add("fit.flops_est", flops_est)
+            sp.set(n_iter=n_iter, flops_est=flops_est)
+            self._update_fit_meta()
+            self._post_fit()
+            return float(self.resids.chi2)
+
+    def _fit_flops_est(self, n_iter):
+        """Modeled FLOPs of this fit (pint_tpu.flops cost model)."""
+        n_basis = int(getattr(self.prepared, "noise_basis",
+                              np.zeros((0, 0))).shape[1])
+        return _flops.gls_fit_flops(
+            len(self.toas), len(self._traced_free), n_basis, n_iter)
 
     def _update_fit_meta(self):
         """Record the fit summary into the model metadata so it lands in
@@ -236,6 +267,12 @@ class WLSFitter(Fitter):
         super().__init__(toas, model, residuals)
         self.threshold = threshold
         self._retrace()
+
+    def _fit_flops_est(self, n_iter):
+        """The SVD step never touches the noise basis — cost it at
+        basis width 0 even when the model carries noise components."""
+        return _flops.wls_fit_flops(
+            len(self.toas), len(self._traced_free), n_iter)
 
     def _step(self, vec, base_values):
         """One Gauss-Newton WLS step.  base_values (the full values dict,

@@ -1,0 +1,80 @@
+"""Shared FLOP-accounting helpers for the fit hot paths.
+
+Promoted out of ``bench.py`` so the same cost model feeds the
+benchmark records, the telemetry layer (per-fit ``fit.flops_est``
+counters), and any MFU arithmetic.  These are *estimates* with stated
+assumptions, not hardware counters: the per-TOA residual chain is
+modeled as ~60 f64 ops (delay chain + phase polynomial, the dominant
+terms), autodiff design matrices cost one chain evaluation per free
+parameter under ``jacfwd``, and the normal-equation solves count the
+classic ``N * P^2`` matmul term.  The double-double op cost (43 f64
+flops per chained mul+add) is counted from the primitive operation
+breakdown in :mod:`pint_tpu.dd`.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "RESID_CHAIN_OPS", "DD_CHAIN_FLOPS_PER_ELEM",
+    "matmul_flops", "resid_eval_flops", "gls_fit_flops",
+    "wls_fit_flops", "wls_grid_flops", "mcmc_flops", "pta_batch_flops",
+    "dd_chain_flops",
+]
+
+#: modeled f64 ops per TOA for one residual-chain evaluation (delay
+#: components + phase polynomial; calibrated against the reference's
+#: profiling breakdown, profiling/README.txt:53-60)
+RESID_CHAIN_OPS = 60
+
+#: f64 flops per element of a chained double-double mul+add
+#: (two_prod/two_sum primitive counts: 17+3+3 mul, 12+2+3+3 add)
+DD_CHAIN_FLOPS_PER_ELEM = 43.0
+
+
+def matmul_flops(n, m=None, k=None):
+    """FLOPs of an (n x k) @ (k x m) matmul (square by default)."""
+    m = n if m is None else m
+    k = n if k is None else k
+    return 2.0 * n * m * k
+
+
+def resid_eval_flops(n_toa):
+    """One forward residual-chain evaluation over ``n_toa`` TOAs."""
+    return float(RESID_CHAIN_OPS * n_toa * 2)
+
+
+def gls_fit_flops(n_toa, n_free, n_basis, n_iter=3):
+    """A GLS Gauss-Newton fit: per iteration one jacfwd design matrix
+    (~n_free forward chains) plus the noise-augmented normal equations
+    over the (n_free + n_basis)-wide solve."""
+    per_iter = (n_free * resid_eval_flops(n_toa)
+                + 2.0 * n_toa * (n_free + n_basis) ** 2)
+    return float(n_iter * per_iter)
+
+
+def wls_fit_flops(n_toa, n_free, n_iter=3):
+    """A WLS SVD Gauss-Newton fit (no noise basis)."""
+    return gls_fit_flops(n_toa, n_free, 0, n_iter)
+
+
+def wls_grid_flops(n_points, n_toa, n_free, n_iter=3):
+    """A vmapped chi^2 grid: one WLS fit per grid point."""
+    return float(n_points) * wls_fit_flops(n_toa, n_free, n_iter)
+
+
+def mcmc_flops(n_evals, n_toa):
+    """Ensemble-sampler posterior evaluations: one chi^2/likelihood
+    chain per eval."""
+    return float(n_evals) * resid_eval_flops(n_toa)
+
+
+def pta_batch_flops(n_pulsars, n_toa, n_free, n_basis, n_iter=3):
+    """A batched PTA fit: n_pulsars independent GLS fits as one
+    program."""
+    return float(n_pulsars) * gls_fit_flops(n_toa, n_free, n_basis,
+                                            n_iter)
+
+
+def dd_chain_flops(n_elems, n_iters):
+    """The double-double mul+add roofline chain."""
+    return DD_CHAIN_FLOPS_PER_ELEM * float(n_elems) * float(n_iters)

@@ -18,8 +18,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pint_tpu import flops as _flops
+from pint_tpu import telemetry
 from pint_tpu.bayesian import UniformPrior
 from pint_tpu.sampler import EnsembleSampler
+from pint_tpu.telemetry import span
 
 __all__ = ["MCMCFitter", "MCMCFitterAnalyticTemplate",
            "MCMCFitterBinnedTemplate", "CompositeMCMCFitter"]
@@ -166,18 +169,26 @@ class MCMCFitter:
         s = EnsembleSampler(self.lnposterior, nwalkers=nwalkers,
                             seed=seed)
         x0 = s.initial_ball(center, np.array(scales))
-        if autocorr:
-            _, self.converged, self.tau = s.run_mcmc_autocorr(
-                x0, chunk=max(50, nsteps // 10), maxsteps=nsteps)
-            chain_len = int(np.asarray(s.chain).shape[0])
-            burn = (int(burnin) if burnin is not None
-                    else int(min(5 * np.max(self.tau), chain_len // 2))
-                    if np.all(np.isfinite(self.tau)) else chain_len // 4)
-        else:
-            s.run_mcmc(x0, nsteps)
-            chain_len = int(nsteps)
-            burn = (int(burnin) if burnin is not None
-                    else int(burn_frac * nsteps))
+        with span("mcmc.sample", nwalkers=nwalkers, nsteps=nsteps,
+                  n_toa=len(self.toas), autocorr=autocorr) as sp:
+            if autocorr:
+                _, self.converged, self.tau = s.run_mcmc_autocorr(
+                    x0, chunk=max(50, nsteps // 10), maxsteps=nsteps)
+                chain_len = int(np.asarray(s.chain).shape[0])
+                burn = (int(burnin) if burnin is not None
+                        else int(min(5 * np.max(self.tau),
+                                     chain_len // 2))
+                        if np.all(np.isfinite(self.tau))
+                        else chain_len // 4)
+            else:
+                s.run_mcmc(x0, nsteps)
+                chain_len = int(nsteps)
+                burn = (int(burnin) if burnin is not None
+                        else int(burn_frac * nsteps))
+            flops_est = _flops.mcmc_flops(nwalkers * chain_len,
+                                          len(self.toas))
+            telemetry.counter_add("fit.flops_est", flops_est)
+            sp.set(chain_len=chain_len, flops_est=flops_est)
         best, lnp = s.max_posterior()
         for i, name in enumerate(self.param_names):
             self.model.values[name] = float(best[i])
@@ -270,7 +281,9 @@ class CompositeMCMCFitter:
         s = EnsembleSampler(self.lnposterior, nwalkers=nwalkers,
                             seed=seed)
         x0 = s.initial_ball(center, np.array(scales))
-        s.run_mcmc(x0, nsteps)
+        with span("mcmc.sample", nwalkers=nwalkers, nsteps=nsteps,
+                  composite=len(self.fitters)):
+            s.run_mcmc(x0, nsteps)
         best, lnp = s.max_posterior()
         for i, name in enumerate(self.param_names):
             self.model.values[name] = float(best[i])

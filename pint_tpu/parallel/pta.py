@@ -23,9 +23,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pint_tpu import flops as _flops
+from pint_tpu import telemetry
 from pint_tpu.fitter import wls_gn_solve
 from pint_tpu.models.timing_model import PreparedModel
 from pint_tpu.residuals import Residuals
+from pint_tpu.telemetry import span
 
 __all__ = ["PTABatch", "pulsar_mesh"]
 
@@ -614,6 +617,12 @@ class PTABatch:
     def _run_batched(self, fit, args, mesh):
         """jit (optionally mesh-sharded over the pulsar axis), run, and
         write fitted values back (only genuinely-free params)."""
+        with span("pta.batched_fit", n_pulsars=self.n_pulsars,
+                  n_max=self.n_max, n_free=len(self.free_names),
+                  sharded=mesh is not None):
+            return self._run_batched_inner(fit, args, mesh)
+
+    def _run_batched_inner(self, fit, args, mesh):
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -634,11 +643,23 @@ class PTABatch:
             )
         vec, chi2, cov = jax.jit(lambda *a: fit(*a))(*args)
         vec_np = np.asarray(vec)
+        telemetry.record_transfer(vec_np)
+        telemetry.counter_add(
+            "fit.flops_est",
+            _flops.pta_batch_flops(
+                self.n_pulsars, self.n_max, len(self.free_names),
+                self._noise_basis_width()))
         for k, p in enumerate(self.prepareds):
             for i, name in enumerate(self.free_names):
                 if float(self.free_mask[k, i]):
                     p.model.values[name] = float(vec_np[k, i])
         return vec, chi2, cov
+
+    def _noise_basis_width(self):
+        """Widest per-pulsar noise-basis width (FLOP accounting)."""
+        return max(
+            int(np.shape(p.noise_basis)[1]) for p in self.prepareds
+        )
 
     # -- public API -----------------------------------------------------------
     def residuals(self, values=None):

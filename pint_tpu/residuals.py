@@ -21,8 +21,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pint_tpu import telemetry
 from pint_tpu.linalg import woodbury_chi2_logdet
 from pint_tpu.models.timing_model import PreparedModel, TimingModel
+from pint_tpu.telemetry import span
 
 __all__ = ["Residuals", "WidebandDMResiduals", "WidebandTOAResiduals"]
 
@@ -103,7 +105,10 @@ class Residuals:
     def _jitted(self, name, fn):
         got = self._jit_cache.get(name)
         if got is None:
+            telemetry.counter_add("residuals.jit_cache_misses")
             got = self._jit_cache[name] = jax.jit(fn)
+        else:
+            telemetry.counter_add("residuals.jit_cache_hits")
         return got
 
     @property
@@ -200,18 +205,28 @@ class Residuals:
 
     @property
     def phase_resids(self):
-        return np.asarray(self._phase_resids_jit(self._values()))
+        with span("residuals.calc", kind="phase",
+                  n_toa=len(self.toas)):
+            out = np.asarray(self._phase_resids_jit(self._values()))
+        telemetry.record_transfer(out)
+        return out
 
     @property
     def time_resids(self):
-        return np.asarray(self._time_resids_jit(self._values()))
+        with span("residuals.calc", kind="time", n_toa=len(self.toas)):
+            out = np.asarray(self._time_resids_jit(self._values()))
+        telemetry.record_transfer(out)
+        return out
 
     @property
     def chi2(self):
-        return float(self._chi2_jit(self._values()))
+        with span("residuals.calc", kind="chi2", n_toa=len(self.toas)):
+            return float(self._chi2_jit(self._values()))
 
     def lnlikelihood(self, values=None):
-        return float(self._lnlike_jit(self._values(values)))
+        with span("residuals.calc", kind="lnlike",
+                  n_toa=len(self.toas)):
+            return float(self._lnlike_jit(self._values(values)))
 
     @property
     def scaled_errors(self):

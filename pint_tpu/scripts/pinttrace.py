@@ -1,0 +1,127 @@
+"""Summarize a pint_tpu telemetry JSONL trace file.
+
+``pinttrace trace.jsonl`` (or ``python -m pint_tpu.scripts.pinttrace``)
+aggregates the records written by :mod:`pint_tpu.telemetry`
+(``PINT_TPU_TRACE=trace.jsonl``): spans by name (count/total/mean/max),
+final counter and gauge values, and any benchmark metric records that
+were routed through the same sink.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+__all__ = ["summarize", "main"]
+
+
+def _load(path):
+    """Parse a JSONL trace; returns (records, n_bad)."""
+    records, n_bad = [], 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                n_bad += 1
+    return records, n_bad
+
+
+def aggregate(records):
+    """Aggregate parsed trace records: returns (spans, counters,
+    gauges, metrics, n_other) where spans maps name ->
+    [count, total_s, max_s, max_depth].  The ONE aggregation both the
+    table and --json outputs are built from."""
+    spans: dict = {}
+    counters: dict = {}
+    gauges: dict = {}
+    metrics = []
+    other = 0
+    for rec in records:
+        kind = rec.get("type")
+        if kind == "span":
+            st = spans.setdefault(rec.get("name", "?"), [0, 0.0, 0.0, 0])
+            dur = float(rec.get("dur_s", 0.0))
+            st[0] += 1
+            st[1] += dur
+            st[2] = max(st[2], dur)
+            st[3] = max(st[3], int(rec.get("depth", 0)))
+        elif kind == "counter":
+            # flushes repeat cumulative values; last one wins
+            counters[rec.get("name", "?")] = rec.get("value")
+        elif kind == "gauge":
+            gauges[rec.get("name", "?")] = rec.get("value")
+        elif kind == "metric" or "metric" in rec:
+            metrics.append(rec)
+        else:
+            other += 1
+    return spans, counters, gauges, metrics, other
+
+
+def summarize(records):
+    """Aggregate parsed trace records into report lines."""
+    spans, counters, gauges, metrics, other = aggregate(records)
+
+    from pint_tpu.telemetry import render_stats_lines
+
+    lines = [f"{len(records)} records: "
+             f"{sum(s[0] for s in spans.values())} spans "
+             f"({len(spans)} distinct), {len(counters)} counters, "
+             f"{len(gauges)} gauges, {len(metrics)} metrics"
+             + (f", {other} other" if other else "")]
+    lines.extend(render_stats_lines(spans, counters, gauges))
+    for rec in metrics:
+        name = rec.get("metric", "?")
+        parts = [f"metric {name} = {rec.get('value')!r}"]
+        for key in ("backend", "compile_s", "flops", "vs_baseline"):
+            if rec.get(key) is not None:
+                parts.append(f"{key}={rec[key]!r}")
+        lines.append(" ".join(parts))
+    return lines
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="pinttrace",
+        description="Summarize a pint_tpu telemetry JSONL trace file")
+    p.add_argument("trace", help="path to the JSONL trace "
+                                 "(PINT_TPU_TRACE output)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the aggregate as one JSON object instead "
+                        "of a table")
+    args = p.parse_args(argv)
+    try:
+        records, n_bad = _load(args.trace)
+    except OSError as e:
+        print(f"pinttrace: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        spans, counters, gauges, metrics, other = aggregate(records)
+        print(json.dumps({
+            "n_records": len(records), "n_bad": n_bad,
+            "spans": {name: {"count": st[0], "total_s": st[1],
+                             "max_s": st[2], "max_depth": st[3]}
+                      for name, st in spans.items()},
+            "counters": counters, "gauges": gauges,
+            "metrics": metrics, "n_other": other,
+        }))
+    else:
+        try:
+            for line in summarize(records):
+                print(line)
+        except BrokenPipeError:  # | head closed the pipe: not an error
+            import os
+
+            os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+    if n_bad:
+        print(f"WARNING: {n_bad} unparseable line(s) skipped",
+              file=sys.stderr)
+    return 1 if n_bad else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

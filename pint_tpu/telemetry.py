@@ -1,0 +1,498 @@
+"""Structured telemetry: spans, counters, FLOP accounting, JSONL sink.
+
+Observability layer for the fit hot path.  Every perf-relevant event in
+the library — a GLS fit, a jit retrace, a backend-probe timeout, an XLA
+compile — becomes a structured record instead of a print statement or a
+number hand-assembled inside bench.py.  Zero dependencies beyond the
+stdlib; importing this module never touches a JAX backend.
+
+Four surfaces:
+
+- **Spans** — ``with span("gls_fit", n_toa=...):`` records wall time,
+  nesting (depth + parent), and structured attributes.  Disabled by
+  default: the disabled path is one module-global check returning a
+  shared no-op object, so instrumented library code pays one dict
+  lookup per enter.  Spans wrap *dispatch boundaries only* — never
+  code inside ``jax.jit`` (a span in traced code would measure trace
+  time once and nothing thereafter).
+- **Counters/gauges** — in-memory accumulators (always on; one dict
+  add) for jit compile events and compile seconds (via
+  ``jax.monitoring`` where available, graceful no-op fallback), jit
+  cache hits/misses at the library's own caches, device-transfer
+  bytes, probe attempts/timeouts, and per-fit FLOP estimates
+  (:mod:`pint_tpu.flops`).
+- **JSONL sink** — ``PINT_TPU_TRACE=path`` (read at first import) or
+  :func:`configure` emits one machine-parseable JSON object per span
+  exit / counter flush / metric record.  ``pinttrace`` (the
+  :mod:`pint_tpu.scripts.pinttrace` CLI) summarizes a trace file.
+- **Reporting** — :func:`summary` renders the session's spans and
+  counters as a text table; :func:`compile_stats` exposes the compile
+  counters (``pint_tpu.datacheck`` prints both).
+
+An optional :func:`xprof_trace` passthrough wraps
+``jax.profiler.trace`` for deep-dive profiling with the same on/off
+switch.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+
+__all__ = [
+    "span", "configure", "enabled", "emit", "flush",
+    "counter_add", "counter_get", "counters", "gauge_set", "gauges",
+    "record_transfer", "compile_stats", "summary", "summary_lines",
+    "render_stats_lines", "reset", "xprof_trace",
+]
+
+_TRACE_ENV = "PINT_TPU_TRACE"
+
+#: process-global state; guarded by _lock for the mutating paths.  The
+#: hot path (span() with telemetry disabled) reads one attribute
+#: lock-free — stale reads only mean a span near the configure() call
+#: is dropped or kept, never corruption.
+_lock = threading.RLock()
+
+
+class _State:
+    __slots__ = ("enabled", "sink", "sink_owned", "span_stats",
+                 "counters", "gauges", "t_session")
+
+    def __init__(self):
+        self.enabled = False
+        self.sink = None          # file-like with .write(str)
+        self.sink_owned = False   # close on reconfigure/exit
+        #: name -> [count, total_s, max_s]
+        self.span_stats: dict = {}
+        self.counters: dict = {}
+        self.gauges: dict = {}
+        self.t_session = time.time()
+
+
+_state = _State()
+
+_tls = threading.local()  # per-thread span stack for nesting
+
+
+# --------------------------------------------------------------------------
+# configuration
+# --------------------------------------------------------------------------
+
+def configure(sink=None, enabled=None):
+    """(Re)configure the telemetry layer.
+
+    sink: a path (opened append-mode, line-buffered), a file-like
+    object with ``.write``, or None to detach the sink.  enabled:
+    force spans on/off; defaults to "on iff a sink is attached".
+    Returns the module for chaining."""
+    global _state
+    with _lock:
+        if _state.sink is not None and _state.sink_owned:
+            try:
+                _state.sink.close()
+            except OSError:
+                pass
+        if sink is None:
+            _state.sink = None
+            _state.sink_owned = False
+        elif hasattr(sink, "write"):
+            _state.sink = sink
+            _state.sink_owned = False
+        else:
+            _state.sink = open(os.fspath(sink), "a", buffering=1)
+            _state.sink_owned = True
+        _state.enabled = bool(
+            _state.sink is not None if enabled is None else enabled
+        )
+    import sys
+
+    return sys.modules[__name__]
+
+
+def enabled() -> bool:
+    """Whether spans are live (cheap; safe to call anywhere)."""
+    return _state.enabled
+
+
+def reset():
+    """Drop accumulated stats/counters (tests; the sink is kept)."""
+    with _lock:
+        _state.span_stats.clear()
+        _state.counters.clear()
+        _state.gauges.clear()
+        _state.t_session = time.time()
+        _tls.stack = []
+
+
+# --------------------------------------------------------------------------
+# spans
+# --------------------------------------------------------------------------
+
+class _NullSpan:
+    """Shared do-nothing span: the disabled-path object.  __slots__ so
+    even attribute writes fail loudly instead of accumulating state."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs):
+        return self
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("name", "attrs", "t0", "wall0", "depth", "parent")
+
+    def __init__(self, name, attrs):
+        self.name = name
+        self.attrs = attrs
+
+    def set(self, **attrs):
+        """Attach attributes discovered mid-span."""
+        self.attrs.update(attrs)
+        return self
+
+    def __enter__(self):
+        stack = getattr(_tls, "stack", None)
+        if stack is None:
+            stack = _tls.stack = []
+        self.depth = len(stack)
+        self.parent = stack[-1].name if stack else None
+        stack.append(self)
+        self.wall0 = time.time()
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dur = time.perf_counter() - self.t0
+        stack = getattr(_tls, "stack", [])
+        if stack and stack[-1] is self:
+            stack.pop()
+        rec = {
+            "type": "span",
+            "name": self.name,
+            "ts": round(self.wall0, 6),
+            "dur_s": round(dur, 9),
+            "depth": self.depth,
+            "parent": self.parent,
+        }
+        if exc_type is not None:
+            rec["error"] = exc_type.__name__
+        if self.attrs:
+            rec["attrs"] = _jsonable(self.attrs)
+        with _lock:
+            st = _state.span_stats.setdefault(self.name, [0, 0.0, 0.0])
+            st[0] += 1
+            st[1] += dur
+            st[2] = max(st[2], dur)
+        emit(rec)
+        return False
+
+
+def span(name, **attrs):
+    """Open a telemetry span.  With telemetry disabled this returns a
+    shared no-op object — the whole call is one global load, one bool
+    check, and zero allocation beyond the kwargs dict."""
+    if not _state.enabled:
+        return _NULL_SPAN
+    return _Span(name, attrs)
+
+
+# --------------------------------------------------------------------------
+# counters / gauges
+# --------------------------------------------------------------------------
+
+def counter_add(name, value=1.0):
+    """Accumulate into a named counter (always on; in-memory)."""
+    with _lock:
+        _state.counters[name] = _state.counters.get(name, 0.0) + value
+
+
+def counter_get(name, default=0.0):
+    return _state.counters.get(name, default)
+
+
+def counters() -> dict:
+    """Snapshot of all counters."""
+    with _lock:
+        return dict(_state.counters)
+
+
+def gauge_set(name, value):
+    """Set a named gauge (last-value-wins)."""
+    with _lock:
+        _state.gauges[name] = value
+
+
+def gauges() -> dict:
+    with _lock:
+        return dict(_state.gauges)
+
+
+def record_transfer(arr, direction="d2h"):
+    """Account device<->host transfer bytes for an array-like (anything
+    with ``.nbytes``); silently ignores scalars/None."""
+    nbytes = getattr(arr, "nbytes", None)
+    if nbytes:
+        counter_add(f"transfer.{direction}_bytes", float(nbytes))
+
+
+# --------------------------------------------------------------------------
+# emission
+# --------------------------------------------------------------------------
+
+def _jsonable(obj):
+    """Best-effort conversion to JSON-serializable values (numpy
+    scalars, arrays-as-shapes) without importing numpy."""
+    if isinstance(obj, dict):
+        return {str(k): _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    if isinstance(obj, (str, int, float, bool)) or obj is None:
+        return obj
+    if hasattr(obj, "item") and getattr(obj, "ndim", 1) == 0:
+        try:
+            return obj.item()
+        except (TypeError, ValueError):
+            pass
+    if hasattr(obj, "shape"):
+        return {"shape": list(obj.shape),
+                "dtype": str(getattr(obj, "dtype", "?"))}
+    return repr(obj)
+
+
+def emit(record: dict):
+    """Write one JSONL record to the sink (no-op without a sink)."""
+    sink = _state.sink
+    if sink is None:
+        return
+    try:
+        line = json.dumps(_jsonable(record), separators=(",", ":"))
+    except (TypeError, ValueError):
+        line = json.dumps({"type": "emit_error", "repr": repr(record)})
+    with _lock:
+        if _state.sink is not sink:
+            # concurrent reconfigure swapped the sink while this record
+            # was being serialized: drop the record, never the new sink
+            return
+        try:
+            sink.write(line + "\n")
+        except (OSError, ValueError):  # closed/broken sink: detach
+            if _state.sink_owned:
+                try:
+                    sink.close()
+                except OSError:
+                    pass
+            _state.sink = None
+            _state.sink_owned = False
+
+
+def flush():
+    """Emit one record per counter and gauge (the periodic/exit flush),
+    then flush the sink's buffer."""
+    ts = round(time.time(), 6)
+    with _lock:
+        items = list(_state.counters.items())
+        gitems = list(_state.gauges.items())
+        sink = _state.sink
+    for name, value in items:
+        emit({"type": "counter", "name": name, "value": value, "ts": ts})
+    for name, value in gitems:
+        emit({"type": "gauge", "name": name, "value": _jsonable(value),
+              "ts": ts})
+    if sink is not None and hasattr(sink, "flush"):
+        try:
+            sink.flush()
+        except (OSError, ValueError):
+            pass
+
+
+@atexit.register
+def _exit_flush():
+    if _state.sink is not None:
+        flush()
+        if _state.sink_owned:
+            try:
+                _state.sink.close()
+            except OSError:
+                pass
+
+
+# --------------------------------------------------------------------------
+# compile counters (jax.monitoring hook, graceful fallback)
+# --------------------------------------------------------------------------
+
+_compile_listener_installed = False
+_compile_listener_source = "uninstalled"
+
+
+def _install_compile_listener(monitoring="auto"):
+    """Hook ``jax.monitoring`` duration events into the counters.
+
+    JAX's internal instrumentation reports every backend compile as a
+    ``/jax/.../compile`` duration event; registering a listener costs
+    nothing when no events fire.  When the API is absent (older/newer
+    jax, stubbed environment) the layer degrades to the counters that
+    the library increments itself (``jit.retrace`` etc.) — callers see
+    ``compile_stats()["source"] == "fallback"``.
+
+    monitoring: "auto" imports ``jax.monitoring``; pass an object (or
+    None) to override in tests."""
+    global _compile_listener_installed, _compile_listener_source
+    with _lock:
+        if _compile_listener_installed:
+            return _compile_listener_source
+        _compile_listener_installed = True
+        if monitoring == "auto":
+            try:
+                from jax import monitoring as _mon  # defers jax import cost
+                monitoring = _mon
+            except Exception:
+                monitoring = None
+        reg = getattr(monitoring,
+                      "register_event_duration_secs_listener", None)
+        if reg is None:
+            _compile_listener_source = "fallback"
+            return _compile_listener_source
+
+        def _on_duration(event, duration, **kw):
+            if "compil" in event:  # compile/compilation event keys
+                counter_add("jit.compile_events")
+                counter_add("jit.compile_seconds", float(duration))
+
+        try:
+            reg(_on_duration)
+            _compile_listener_source = "jax.monitoring"
+        except Exception:
+            _compile_listener_source = "fallback"
+        return _compile_listener_source
+
+
+def compile_stats() -> dict:
+    """Compile-event stats for this session: ``{"events", "seconds",
+    "source"}``.  Installs the jax.monitoring listener on first call
+    (so merely importing telemetry never imports jax)."""
+    source = _install_compile_listener()
+    return {
+        "events": int(counter_get("jit.compile_events")),
+        "seconds": float(counter_get("jit.compile_seconds")),
+        "source": source,
+    }
+
+
+# --------------------------------------------------------------------------
+# xprof passthrough
+# --------------------------------------------------------------------------
+
+class _NullCtx:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def xprof_trace(log_dir):
+    """Context manager: ``jax.profiler.trace`` when available (xprof/
+    tensorboard deep dives), a no-op context otherwise — callers keep
+    one code path whether or not the profiler exists."""
+    try:
+        import jax.profiler
+
+        return jax.profiler.trace(str(log_dir))
+    except Exception:
+        return _NullCtx()
+
+
+# --------------------------------------------------------------------------
+# reporting
+# --------------------------------------------------------------------------
+
+def _fmt_value(v):
+    try:
+        return (f"{int(v):d}" if float(v).is_integer()
+                else f"{float(v):.4f}")
+    except (TypeError, ValueError):
+        return repr(v)
+
+
+def render_stats_lines(span_stats, counters=None, gauges=None,
+                       indent=""):
+    """Render span/counter/gauge aggregates as table lines — the ONE
+    place the table format lives, shared by the in-process
+    :func:`summary` and the ``pinttrace`` CLI.
+
+    span_stats: name -> (count, total_s, max_s[, max_depth]); a DEPTH
+    column appears when any entry carries the 4th element."""
+    lines = []
+    with_depth = any(len(st) > 3 for st in span_stats.values())
+    if span_stats:
+        hdr = (f"{indent}{'SPAN':<28s} {'COUNT':>7s} {'TOTAL_S':>10s} "
+               f"{'MEAN_S':>10s} {'MAX_S':>10s}")
+        if with_depth:
+            hdr += f" {'DEPTH':>6s}"
+        lines.append(hdr)
+        for name in sorted(span_stats, key=lambda n: -span_stats[n][1]):
+            st = span_stats[name]
+            cnt, tot, mx = st[0], st[1], st[2]
+            row = (f"{indent}{name:<28s} {cnt:>7d} {tot:>10.4f} "
+                   f"{tot / max(cnt, 1):>10.4f} {mx:>10.4f}")
+            if with_depth:
+                row += f" {(st[3] if len(st) > 3 else 0):>6d}"
+            lines.append(row)
+    if counters:
+        lines.append(f"{indent}{'COUNTER':<40s} {'VALUE':>14s}")
+        for name in sorted(counters):
+            lines.append(
+                f"{indent}{name:<40s} {_fmt_value(counters[name]):>14s}")
+    for name in sorted(gauges or {}):
+        lines.append(f"{indent}gauge {name} = {gauges[name]!r}")
+    return lines
+
+
+def summary_lines():
+    """The session summary as a list of text lines (spans table +
+    counters + gauges)."""
+    with _lock:
+        stats = {k: list(v) for k, v in _state.span_stats.items()}
+        ctrs = dict(_state.counters)
+        gs = dict(_state.gauges)
+    lines = []
+    lines.append("telemetry session summary "
+                 f"(spans {'enabled' if _state.enabled else 'disabled'}, "
+                 f"sink {'attached' if _state.sink is not None else 'none'})")
+    if not stats:
+        lines.append("  (no spans recorded)")
+    lines.extend(render_stats_lines(stats, ctrs, gs, indent="  "))
+    return lines
+
+
+def summary() -> str:
+    """Pretty text table of the session's spans and counters."""
+    return "\n".join(summary_lines())
+
+
+# --------------------------------------------------------------------------
+# env activation
+# --------------------------------------------------------------------------
+
+_env_path = os.environ.get(_TRACE_ENV)
+if _env_path:
+    try:
+        configure(sink=_env_path)
+    except OSError as e:  # unwritable path must not break imports
+        import sys
+
+        print(f"pint_tpu.telemetry: cannot open {_TRACE_ENV}="
+              f"{_env_path!r}: {e}", file=sys.stderr)

@@ -227,3 +227,56 @@ def test_full_chain_pair_sweep():
             except Exception as e:
                 failures.append((stem, f"{type(e).__name__}: {e}"))
     assert not failures, failures
+
+
+class TestTelemetrySmoke:
+    def test_fit_under_trace_env_leaves_parseable_jsonl(
+            self, tmp_path, monkeypatch):
+        """Tier-1 telemetry smoke (ISSUE 1 CI satellite): run one real
+        fit with the JSONL sink attached the way PINT_TPU_TRACE would
+        attach it, then assert every line of the trace parses and the
+        hot-path spans/counters are present — so the sink can't
+        silently rot.  Always writes its own tmp file (never truncates
+        or asserts over a session-level $PINT_TPU_TRACE file, whose
+        records belong to the whole run); the session sink, if any, is
+        restored afterwards.  Self-contained (inline par, no reference
+        data files)."""
+        import json
+
+        from pint_tpu import telemetry
+
+        session_trace = os.environ.get("PINT_TPU_TRACE")
+        trace = str(tmp_path / "trace.jsonl")
+        monkeypatch.setenv("PINT_TPU_TRACE", trace)
+        m = get_model(
+            "PSR SMOKE\nF0 100.0 1\nF1 -1e-15 1\nPEPOCH 55000\n"
+            "RAJ 05:00:00\nDECJ 20:00:00\nDM 10\n"
+        )
+        toas = make_fake_toas_uniform(
+            54500, 55500, 80, m, obs="@", error_us=1.0,
+            add_noise=True, rng=np.random.default_rng(7))
+        try:
+            telemetry.configure(sink=trace)
+            telemetry.reset()
+            f = WLSFitter(toas, m)
+            f.fit_toas(maxiter=2)
+            telemetry.flush()
+        finally:
+            if session_trace:
+                telemetry.configure(sink=session_trace)
+            else:
+                telemetry.configure(sink=None, enabled=False)
+        with open(trace) as fh:
+            recs = [json.loads(line) for line in fh if line.strip()]
+        assert recs, "trace file is empty"
+        spans = [r for r in recs if r["type"] == "span"]
+        assert any(r["name"] == "fit_toas" for r in spans)
+        counters = {r["name"]: r["value"] for r in recs
+                    if r["type"] == "counter"}
+        assert counters.get("fit.flops_est", 0) > 0
+        # and the pinttrace CLI summarizes it without choking
+        from pint_tpu.scripts.pinttrace import _load, summarize
+
+        records, n_bad = _load(trace)
+        assert n_bad == 0
+        assert any("fit_toas" in line for line in summarize(records))

@@ -128,6 +128,17 @@ def test_polar_motion_orientation():
     np.testing.assert_allclose(out[2], 1.0, rtol=1e-9)
 
 
+@pytest.fixture(autouse=True)
+def _eop_cache_guard():
+    """Reset the module-global EOP cache after EVERY test in this file,
+    pass or fail: an assertion failure mid-test (e.g. in
+    test_zero_eop_budget_line_item, which loads a 0.35-arcsec
+    polar-motion table) must not leave the poisoned table cached for
+    later tests in the session (ADVICE round 5)."""
+    yield
+    iers._cached = None
+
+
 @pytest.fixture
 def eop_dir(tmp_path, monkeypatch):
     d = tmp_path / "iers"

@@ -1,0 +1,363 @@
+"""The structured telemetry layer (pint_tpu/telemetry.py): spans,
+counters, the JSONL sink, the pinttrace CLI, the jax.monitoring
+compile-listener fallback, and the backend-probe counters.
+
+No reference counterpart — the reference has no observability layer;
+here instrumentation lives in the library (ISSUE 1), so the layer gets
+first-class coverage: nesting/attrs round-trip the sink, the
+disabled-by-default path is a shared no-op object, and the probe's
+failure modes increment counters instead of only printing.
+"""
+
+import io
+import json
+import subprocess
+import types
+
+import numpy as np
+import pytest
+
+from pint_tpu import flops, telemetry
+from pint_tpu.scripts import pinttrace
+
+
+@pytest.fixture(autouse=True)
+def clean_telemetry():
+    """Isolate the process-global telemetry state per test."""
+    telemetry.configure(sink=None, enabled=False)
+    telemetry.reset()
+    yield
+    telemetry.configure(sink=None, enabled=False)
+    telemetry.reset()
+
+
+@pytest.fixture
+def listener_state():
+    """Save/restore the compile-listener install flags so tests can
+    exercise the install path without poisoning the session."""
+    saved = (telemetry._compile_listener_installed,
+             telemetry._compile_listener_source)
+    yield
+    (telemetry._compile_listener_installed,
+     telemetry._compile_listener_source) = saved
+
+
+# -- spans --------------------------------------------------------------------
+
+class TestSpans:
+    def test_disabled_by_default_is_shared_noop(self):
+        s1 = telemetry.span("anything", n=1)
+        s2 = telemetry.span("other")
+        assert s1 is s2 is telemetry._NULL_SPAN
+        with s1 as sp:
+            assert sp.set(extra=2) is sp  # attrs silently dropped
+        assert telemetry.counters() == {}
+        assert "no spans recorded" in telemetry.summary()
+
+    def test_disabled_span_emits_nothing(self):
+        buf = io.StringIO()
+        telemetry.configure(sink=buf, enabled=False)
+        with telemetry.span("quiet"):
+            pass
+        assert buf.getvalue() == ""
+
+    def test_nesting_attrs_roundtrip(self):
+        buf = io.StringIO()
+        telemetry.configure(sink=buf)
+        assert telemetry.enabled()
+        with telemetry.span("outer", n_toa=100):
+            with telemetry.span("inner", kind="chi2") as sp:
+                sp.set(late_attr=7)
+        recs = [json.loads(ln) for ln in buf.getvalue().splitlines()]
+        assert [r["name"] for r in recs] == ["inner", "outer"]
+        inner, outer = recs
+        assert inner["depth"] == 1 and inner["parent"] == "outer"
+        assert outer["depth"] == 0 and outer["parent"] is None
+        assert inner["attrs"] == {"kind": "chi2", "late_attr": 7}
+        assert outer["attrs"] == {"n_toa": 100}
+        for r in recs:
+            assert r["type"] == "span"
+            assert r["dur_s"] >= 0.0
+            assert r["ts"] > 0.0
+
+    def test_span_stats_accumulate_without_sink(self):
+        telemetry.configure(sink=None, enabled=True)
+        for _ in range(3):
+            with telemetry.span("hot"):
+                pass
+        lines = telemetry.summary()
+        assert "hot" in lines
+        assert telemetry._state.span_stats["hot"][0] == 3
+
+    def test_span_records_exception(self):
+        buf = io.StringIO()
+        telemetry.configure(sink=buf)
+        with pytest.raises(ValueError):
+            with telemetry.span("boom"):
+                raise ValueError("x")
+        rec = json.loads(buf.getvalue())
+        assert rec["error"] == "ValueError"
+
+    def test_numpy_attrs_jsonable(self):
+        buf = io.StringIO()
+        telemetry.configure(sink=buf)
+        with telemetry.span("np", scalar=np.float64(1.5),
+                            arr=np.zeros((3, 2))):
+            pass
+        rec = json.loads(buf.getvalue())
+        assert rec["attrs"]["scalar"] == 1.5
+        assert rec["attrs"]["arr"] == {"shape": [3, 2],
+                                       "dtype": "float64"}
+
+
+# -- counters / flush ---------------------------------------------------------
+
+class TestCounters:
+    def test_counters_and_flush(self):
+        buf = io.StringIO()
+        telemetry.configure(sink=buf)
+        telemetry.counter_add("x.count")
+        telemetry.counter_add("x.count", 2)
+        telemetry.gauge_set("y.backend", "cpu")
+        telemetry.flush()
+        recs = [json.loads(ln) for ln in buf.getvalue().splitlines()]
+        ctr = [r for r in recs if r["type"] == "counter"]
+        assert ctr == [{"type": "counter", "name": "x.count",
+                        "value": 3, "ts": ctr[0]["ts"]}]
+        gag = [r for r in recs if r["type"] == "gauge"]
+        assert gag[0]["name"] == "y.backend"
+        assert gag[0]["value"] == "cpu"
+
+    def test_record_transfer(self):
+        telemetry.record_transfer(np.zeros(8))  # 64 bytes
+        telemetry.record_transfer(None)
+        telemetry.record_transfer(3.0)
+        assert telemetry.counter_get("transfer.d2h_bytes") == 64.0
+
+
+# -- JSONL sink round-trip via the pinttrace CLI ------------------------------
+
+class TestPinttraceCLI:
+    def _write_trace(self, path):
+        telemetry.configure(sink=str(path))
+        with telemetry.span("fit_toas", n_toa=10):
+            with telemetry.span("residuals.calc", kind="chi2"):
+                pass
+        telemetry.counter_add("fitter.retraces")
+        telemetry.emit({"type": "metric", "metric": "gls_toas_per_sec",
+                        "value": 123.0, "backend": "cpu",
+                        "compile_s": 1.25, "flops": 1e9})
+        telemetry.flush()
+        telemetry.configure(sink=None, enabled=False)
+
+    def test_roundtrip_summary(self, tmp_path, capsys):
+        trace = tmp_path / "trace.jsonl"
+        self._write_trace(trace)
+        # every line must parse as JSON (the sink contract)
+        for line in trace.read_text().splitlines():
+            json.loads(line)
+        assert pinttrace.main([str(trace)]) == 0
+        out = capsys.readouterr().out
+        assert "fit_toas" in out
+        assert "residuals.calc" in out
+        assert "fitter.retraces" in out
+        assert "gls_toas_per_sec" in out
+        assert "backend='cpu'" in out
+
+    def test_roundtrip_json_mode(self, tmp_path, capsys):
+        trace = tmp_path / "trace.jsonl"
+        self._write_trace(trace)
+        assert pinttrace.main([str(trace), "--json"]) == 0
+        agg = json.loads(capsys.readouterr().out)
+        assert agg["spans"]["fit_toas"]["count"] == 1
+        assert agg["counters"]["fitter.retraces"] == 1
+        assert agg["n_bad"] == 0
+
+    def test_bad_lines_flagged(self, tmp_path, capsys):
+        trace = tmp_path / "trace.jsonl"
+        trace.write_text('{"type": "span", "name": "ok", "dur_s": 1}\n'
+                         "not json\n")
+        assert pinttrace.main([str(trace)]) == 1
+        assert "unparseable" in capsys.readouterr().err
+
+    def test_missing_file(self, capsys):
+        assert pinttrace.main(["/nonexistent/trace.jsonl"]) == 2
+
+
+# -- compile-counter listener -------------------------------------------------
+
+class TestCompileListener:
+    def test_fallback_when_monitoring_absent(self, listener_state):
+        telemetry._compile_listener_installed = False
+        assert telemetry._install_compile_listener(
+            monitoring=None) == "fallback"
+        stats = telemetry.compile_stats()
+        assert stats == {"events": 0, "seconds": 0.0,
+                         "source": "fallback"}
+
+    def test_fallback_when_api_missing(self, listener_state):
+        telemetry._compile_listener_installed = False
+        mon = types.SimpleNamespace()  # no register_* attributes
+        assert telemetry._install_compile_listener(
+            monitoring=mon) == "fallback"
+
+    def test_counts_compile_duration_events(self, listener_state):
+        telemetry._compile_listener_installed = False
+        listeners = []
+        mon = types.SimpleNamespace(
+            register_event_duration_secs_listener=listeners.append)
+        assert telemetry._install_compile_listener(
+            monitoring=mon) == "jax.monitoring"
+        (fn,) = listeners
+        fn("/jax/core/compile", 1.5)
+        fn("/jax/pjit/backend_compile_duration", 0.5)
+        fn("/jax/core/tracing", 99.0)  # not a compile event
+        stats = telemetry.compile_stats()
+        assert stats["events"] == 2
+        assert stats["seconds"] == pytest.approx(2.0)
+        assert stats["source"] == "jax.monitoring"
+
+    def test_install_is_idempotent(self, listener_state):
+        telemetry._compile_listener_installed = False
+        listeners = []
+        mon = types.SimpleNamespace(
+            register_event_duration_secs_listener=listeners.append)
+        telemetry._install_compile_listener(monitoring=mon)
+        telemetry._install_compile_listener(monitoring=mon)
+        assert len(listeners) == 1
+
+
+# -- backend-probe counters ---------------------------------------------------
+
+class TestProbeCounters:
+    def test_timeout_increments_counter(self, monkeypatch):
+        from pint_tpu import backend_probe
+
+        def fake_run(*a, **kw):
+            raise subprocess.TimeoutExpired(cmd="probe", timeout=1.0)
+
+        monkeypatch.setattr(subprocess, "run", fake_run)
+        ok, detail = backend_probe.probe_backend(1.0)
+        assert not ok and "timed out" in detail
+        assert telemetry.counter_get("backend_probe.attempts") == 1
+        assert telemetry.counter_get("backend_probe.timeouts") == 1
+
+    def test_empty_stdout_is_failure_not_crash(self, monkeypatch):
+        """rc==0 with swallowed stdout must be a diagnostic, not an
+        IndexError (ADVICE round 5, backend_probe.py:62)."""
+        from pint_tpu import backend_probe
+
+        monkeypatch.setattr(
+            subprocess, "run",
+            lambda *a, **kw: types.SimpleNamespace(
+                returncode=0, stdout="", stderr=""))
+        ok, detail = backend_probe.probe_backend(1.0)
+        assert not ok
+        assert detail == "probe produced no output"
+        assert telemetry.counter_get("backend_probe.failures") == 1
+
+    def test_success_counts_and_reports_backend(self, monkeypatch):
+        from pint_tpu import backend_probe
+
+        monkeypatch.setattr(
+            subprocess, "run",
+            lambda *a, **kw: types.SimpleNamespace(
+                returncode=0, stdout="warning noise\ncpu\n", stderr=""))
+        ok, backend = backend_probe.probe_backend(1.0)
+        assert ok and backend == "cpu"
+        assert telemetry.counter_get("backend_probe.ok") == 1
+
+
+# -- flops cost model ---------------------------------------------------------
+
+class TestFlops:
+    def test_matmul(self):
+        assert flops.matmul_flops(10) == 2000.0
+        assert flops.matmul_flops(2, 3, 4) == 48.0
+
+    def test_gls_scales_with_basis(self):
+        base = flops.gls_fit_flops(1000, 5, 0)
+        wide = flops.gls_fit_flops(1000, 5, 60)
+        assert wide > base > 0
+        assert flops.wls_fit_flops(1000, 5) == base
+
+    def test_grid_and_pta_are_per_item_multiples(self):
+        one = flops.wls_fit_flops(500, 8, n_iter=3)
+        assert flops.wls_grid_flops(256, 500, 8, n_iter=3) == 256 * one
+        g = flops.gls_fit_flops(500, 14, 120, n_iter=3)
+        assert flops.pta_batch_flops(68, 500, 14, 120) == 68 * g
+
+    def test_mcmc(self):
+        assert flops.mcmc_flops(10, 100) == \
+            10 * flops.resid_eval_flops(100)
+
+    def test_dd_chain(self):
+        assert flops.dd_chain_flops(1 << 10, 4) == 43.0 * 1024 * 4
+
+
+# -- instrumented library paths ----------------------------------------------
+
+class TestInstrumentation:
+    def test_fit_emits_span_and_flops(self):
+        from pint_tpu.fitter import WLSFitter
+        from pint_tpu.models import get_model
+        from pint_tpu.simulation import make_fake_toas_uniform
+
+        m = get_model(
+            "PSR FAKE\nF0 100.0 1\nF1 -1e-15\nPEPOCH 55000\n"
+            "RAJ 05:00:00\nDECJ 20:00:00\nDM 10\n")
+        toas = make_fake_toas_uniform(54500, 55500, 50, m, obs="@",
+                                      error_us=1.0)
+        buf = io.StringIO()
+        telemetry.configure(sink=buf)
+        f = WLSFitter(toas, m)
+        f.fit_toas(maxiter=2)
+        telemetry.configure(sink=None, enabled=False)
+        recs = [json.loads(ln) for ln in buf.getvalue().splitlines()]
+        fit = [r for r in recs if r.get("name") == "fit_toas"]
+        assert len(fit) == 1
+        attrs = fit[0]["attrs"]
+        assert attrs["n_toa"] == 50
+        assert attrs["fitter"] == "WLSFitter"
+        assert attrs["flops_est"] > 0
+        assert telemetry.counter_get("fit.flops_est") == \
+            attrs["flops_est"]
+        assert telemetry.counter_get("fitter.retraces") >= 1
+        assert telemetry.counter_get("transfer.d2h_bytes") > 0
+
+    def test_datacheck_reports_telemetry(self, monkeypatch):
+        monkeypatch.delenv("PINT_TPU_TRACE", raising=False)
+        from pint_tpu.datacheck import datacheck_report
+
+        text = "\n".join(datacheck_report())
+        assert "Telemetry: spans disabled" in text
+        assert "jit compile:" in text
+        assert "backend probe:" in text
+
+    def test_datacheck_last_trace_section(self, tmp_path, monkeypatch):
+        trace = tmp_path / "t.jsonl"
+        telemetry.configure(sink=str(trace))
+        with telemetry.span("fit_toas"):
+            pass
+        telemetry.counter_add("jit.compile_events", 4)
+        telemetry.counter_add("jit.compile_seconds", 12.5)
+        telemetry.flush()
+        telemetry.configure(sink=None, enabled=False)
+        monkeypatch.setenv("PINT_TPU_TRACE", str(trace))
+        from pint_tpu.datacheck import _last_session_compile_lines
+
+        (line,) = _last_session_compile_lines()
+        assert "1 span(s)" in line
+        assert "compile 4 event(s) / 12.50s" in line
+
+    def test_xprof_trace_noop_fallback(self, monkeypatch, tmp_path):
+        """Without a working profiler the passthrough must still be a
+        context manager."""
+        import jax.profiler
+
+        def broken(path):
+            raise RuntimeError("no profiler")
+
+        monkeypatch.setattr(jax.profiler, "trace", broken)
+        with telemetry.xprof_trace(tmp_path):
+            pass
