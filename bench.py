@@ -95,16 +95,22 @@ def bench_roofline(jnp, backend):
     3. the int64 fixed-point phase kernel (fixedpoint.phase_f0_t),
        reported as phase evaluations/s (integer ops, not FLOPs).
     """
-    import jax
     from jax import lax
 
+    from pint_tpu import compile_cache as cc
     from pint_tpu import flops as fl
 
     n = 1536
     a = jnp.ones((n, n), jnp.float64) * 1.000001
     b = jnp.ones((n, n), jnp.float64) * 0.999999
 
-    mm = jax.jit(lambda a, b: a @ b)
+    def shared(name, fn):
+        # fresh lambdas routed through the compile_cache registry: a
+        # rebuild (the warm pass) reuses the first build's trace
+        return cc.shared_jit(fn, key=("bench.roofline", name, n),
+                             fn_token="bench.roofline." + name)
+
+    mm = shared("matmul", lambda a, b: a @ b)
     compile_s = _timed_compile(lambda: mm(a, b).block_until_ready())
     best = min(_timed(lambda: mm(a, b).block_until_ready())
                for _ in range(3))
@@ -122,7 +128,7 @@ def bench_roofline(jnp, backend):
             return dd.add(dd.mul(y, x), x)
         return lax.fori_loop(0, iters, body, x)
 
-    ch = jax.jit(chain)
+    ch = shared("ddchain", chain)
     compile_s += _timed_compile(lambda: ch(x).hi.block_until_ready())
     best_dd = min(_timed(lambda: ch(x).hi.block_until_ready())
                   for _ in range(3))
@@ -139,11 +145,22 @@ def bench_roofline(jnp, backend):
             return acc + n_turn % 1000 + frac
         return lax.fori_loop(0, iters, body, jnp.zeros(m))
 
-    ph = jax.jit(phases)
+    ph = shared("phase", phases)
     compile_s += _timed_compile(lambda: ph(ticks).block_until_ready())
     best_ph = min(_timed(lambda: ph(ticks).block_until_ready())
                   for _ in range(3))
     phase_rate = m * iters / best_ph
+
+    # warm pass: rebuild each kernel through the registry and run once
+    warm_s = 0.0
+    for name, fn, call in (
+        ("matmul", lambda a_, b_: a_ @ b_,
+         lambda j: j(a, b).block_until_ready()),
+        ("ddchain", chain, lambda j: j(x).hi.block_until_ready()),
+        ("phase", phases, lambda j: j(ticks).block_until_ready()),
+    ):
+        j2 = shared(name, fn)
+        warm_s += _timed_compile2(lambda: call(j2))[0]
 
     _emit_metric({
         "metric": "roofline_f64_matmul_flops",
@@ -156,7 +173,7 @@ def bench_roofline(jnp, backend):
                  f"{matmul_flops / _PEAK_F64_FLOPS.get(backend.split('-')[0], float('nan')):.2f})"),
         "vs_baseline": None,
         "backend": backend,
-        "compile_s": round(compile_s, 3),
+        "compile_s": _cold_warm(compile_s, warm_s),
         "flops": mm_count,
     })
 
@@ -167,22 +184,49 @@ def _timed(fn):
     return time.time() - t0
 
 
-def _timed_compile(fn):
-    """Run the warm-up (compiling) call; return compile seconds.
+def _timed_compile2(fn):
+    """Run a (possibly compiling) call; return (compile_s, wall_s).
 
-    Sourced from the telemetry layer's jax.monitoring compile-duration
-    counters when they ticked during the call (the honest number: it
-    excludes the warm-up's run time), the call's wall time otherwise
-    (the fallback regime, matching the suite's historical behavior)."""
+    compile_s comes from the telemetry layer's jax.monitoring
+    counters when that source is live — preferring the backend-compile
+    split (actual XLA compiles, excluding tracing/lowering/cache
+    bookkeeping) when this jax emits it, and including an honest 0.0
+    for a warm-path call that compiled nothing (the number the
+    cold/warm split exists to record).  In the fallback regime the
+    wall time stands in for both (the suite's historical behavior)."""
     from pint_tpu import telemetry
 
     telemetry.compile_stats()  # install the listener before compiling
+    before_b = telemetry.counter_get("jit.backend_compile_seconds")
     before = telemetry.counter_get("jit.compile_seconds")
     t0 = time.time()
     fn()
     wall = time.time() - t0
+    delta_b = telemetry.counter_get(
+        "jit.backend_compile_seconds") - before_b
     delta = telemetry.counter_get("jit.compile_seconds") - before
-    return delta if delta > 0 else wall
+    if telemetry.compile_stats()["source"] == "jax.monitoring":
+        # the backend split only exists on jax versions that emit the
+        # backend_compile duration event; any tick this session proves
+        # it does, making delta_b (even 0.0) the honest answer
+        if telemetry.counter_get("jit.backend_compile_events") > 0:
+            return delta_b, wall
+        return delta, wall
+    return wall, wall
+
+
+def _timed_compile(fn):
+    """Compile seconds of one call (see _timed_compile2)."""
+    compile_s, wall = _timed_compile2(fn)
+    return compile_s if compile_s > 0 else wall
+
+
+def _cold_warm(cold_s, warm_s):
+    """The structured compile_s field: the first-build compile cost vs
+    what an identical second build pays through the compile_cache
+    registry (same-process) / persistent cache (cross-process).  The
+    bench contract is warm << cold — a recorded number, not a claim."""
+    return {"cold": round(cold_s, 3), "warm": round(warm_s, 3)}
 
 
 def _emit_metric(rec):
@@ -260,6 +304,12 @@ def bench_gls(jnp, backend):
     base_values = dict(model.values)
 
     compile_s = _timed_compile(lambda: f.fit_toas(maxiter=3))
+    # warm: a SECOND same-shaped fitter resolves its step through the
+    # compile_cache registry — the compile cost a new fitter instance
+    # (or, with PINT_TPU_CACHE_DIR, a new process) actually pays
+    model.values.update(base_values)
+    f_warm = GLSFitter(toas, model)
+    warm_s, _ = _timed_compile2(lambda: f_warm.fit_toas(maxiter=3))
     # steady state: reset the start point and refit — values enter the
     # jitted step as arguments, so the compiled program is reused (the
     # framework's repeated-fit contract; grids/PTA batches rely on it)
@@ -279,11 +329,11 @@ def bench_gls(jnp, backend):
         "value": round(toas_per_sec, 1),
         "unit": f"TOAs/s full GLS fit ({n_toas} TOAs, {nfree} free "
                 f"params, ECORR+rednoise, 3 iters, backend={backend}, "
-                f"compile={compile_s:.1f}s"
+                f"compile={compile_s:.1f}s/warm {warm_s:.1f}s"
                 + _mfu_str(flops, wall, backend) + ")",
         "vs_baseline": round(toas_per_sec / 497.0, 1),
         "backend": backend,
-        "compile_s": round(compile_s, 3),
+        "compile_s": _cold_warm(compile_s, warm_s),
         "flops": flops,
     })
 
@@ -303,6 +353,10 @@ def bench_wls_grid(jnp, backend):
     fn, _ = make_grid_fn(toas, model, ["M2", "SINI"], n_steps=3)
     mesh_dev = jnp.asarray(mesh)
     compile_s = _timed_compile(lambda: np.asarray(fn(mesh_dev)[0]))
+    # warm: rebuilding the grid over the same dataset resolves through
+    # the registry's content fingerprint — no second compile
+    fn2, _ = make_grid_fn(toas, model, ["M2", "SINI"], n_steps=3)
+    warm_s, _ = _timed_compile2(lambda: np.asarray(fn2(mesh_dev)[0]))
     t0 = time.time()
     chi2 = np.asarray(fn(mesh_dev)[0])
     wall = time.time() - t0
@@ -318,10 +372,11 @@ def bench_wls_grid(jnp, backend):
         "unit": f"grid points/s (binary MSP, (M2,SINI) {n_side}x"
                 f"{n_side}, {n_toas} TOAs, 3 GN iters/pt, "
                 f"backend={backend}, compile={compile_s:.1f}s"
+                f"/warm {warm_s:.1f}s"
                 + _mfu_str(flops, wall, backend) + ")",
         "vs_baseline": round(pts / (9.0 / 176.437), 1),
         "backend": backend,
-        "compile_s": round(compile_s, 3),
+        "compile_s": _cold_warm(compile_s, warm_s),
         "flops": flops,
     })
 
@@ -351,7 +406,13 @@ def bench_mcmc(jnp, backend):
     nwalkers, nsteps = 32, 200
     s = EnsembleSampler(lnpost, nwalkers=nwalkers, seed=0)
     x0 = s.initial_ball(center, scales)
-    compile_s = _timed_compile(lambda: s.run_mcmc(x0, 2))
+    # cold compile at the REAL chain length: the scan length is static,
+    # so warming at nsteps=2 left the 200-step program to compile
+    # inside the timed region (a historical leak the warm split fixes)
+    compile_s = _timed_compile(lambda: s.run_mcmc(x0, nsteps))
+    # warm: a fresh sampler over the same posterior hits the registry
+    s_w = EnsembleSampler(lnpost, nwalkers=nwalkers, seed=2)
+    warm_s, _ = _timed_compile2(lambda: s_w.run_mcmc(x0, nsteps))
     s2 = EnsembleSampler(lnpost, nwalkers=nwalkers, seed=1)
     t0 = time.time()
     s2.run_mcmc(x0, nsteps)
@@ -365,11 +426,11 @@ def bench_mcmc(jnp, backend):
         "value": round(evals, 1),
         "unit": f"posterior evals/s (NGC6440E, {nwalkers} walkers x "
                 f"{nsteps} steps as one lax.scan, backend={backend}, "
-                f"compile={compile_s:.1f}s"
+                f"compile={compile_s:.1f}s/warm {warm_s:.1f}s"
                 + _mfu_str(flops, wall, backend) + ")",
         "vs_baseline": round(evals / 38.5, 1),
         "backend": backend,
-        "compile_s": round(compile_s, 3),
+        "compile_s": _cold_warm(compile_s, warm_s),
         "flops": flops,
     })
 
@@ -421,6 +482,12 @@ def bench_pta(jnp, backend):
         pairs.append((m, t))
     batch = PTABatch(pairs)
     compile_s = _timed_compile(lambda: batch.fit_wideband(maxiter=3))
+    # warm: a SECOND batch over the same pulsars — the batched program
+    # resolves through the registry's structural key (every per-pulsar
+    # array is a vmapped argument, nothing dataset-specific is baked)
+    batch_w = PTABatch(pairs)
+    warm_s, _ = _timed_compile2(
+        lambda: batch_w.fit_wideband(maxiter=3))
     t0 = time.time()
     _, chi2, _ = batch.fit_wideband(maxiter=3)
     np.asarray(chi2)
@@ -438,10 +505,11 @@ def bench_pta(jnp, backend):
                 f"(isolated+ELL1+DD+DDK+wideband, ECORR+rednoise) x "
                 f"{n_toas} TOAs, one batched program, "
                 f"backend={backend}, compile={compile_s:.1f}s"
+                f"/warm {warm_s:.1f}s"
                 + _mfu_str(flops, wall, backend) + ")",
         "vs_baseline": round(fits / 0.05, 1),
         "backend": backend,
-        "compile_s": round(compile_s, 3),
+        "compile_s": _cold_warm(compile_s, warm_s),
         "flops": flops,
     })
 

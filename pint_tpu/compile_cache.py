@@ -1,0 +1,633 @@
+"""Compile-amortization subsystem: persistent XLA cache, shared jit
+registry, TOA shape bucketing, AOT warmup.
+
+Every recorded bench round shows XLA compile time dwarfing compute on
+the fit hot path (PERF.md: ~30 s compiles feeding fits that then run in
+milliseconds) — and the seed design paid it again on every process
+start, every new ``Fitter`` instance, and every TOA-count change.  This
+module is the single place that cost is amortized, in four layers:
+
+1. **Persistent on-disk XLA compilation cache** —
+   :func:`enable_persistent_cache` turns on
+   ``jax_compilation_cache_dir`` (version-tolerant: falls back to the
+   ``jax.experimental.compilation_cache`` API, degrades to a no-op when
+   neither exists) so compiled executables survive process restarts.
+   Gated by ``PINT_TPU_CACHE_DIR``: the fit path auto-enables only when
+   the variable is set; an explicit call (``pintwarm``, ``datacheck
+   --warm``) defaults to ``~/.cache/pint_tpu/xla``.  ``0``/``off``/
+   ``none`` disable.
+2. **Process-level shared jit registry** — :func:`shared_jit` keys a
+   jitted callable on (function identity x static-structure key), so
+   two fitters on same-shaped problems share ONE trace and ONE
+   executable instead of each paying ``jax.jit(self._step)`` from
+   scratch.  Correctness rests on the callers' keys covering everything
+   their trace bakes in: the fit-path step functions take the per-TOA
+   data as *arguments* (pytrees of arrays, like the batched PTA path
+   always has), so only model *structure* is baked and the key is
+   structural (:func:`model_structure_key`).  Hits/misses feed the
+   telemetry counters ``compile_cache.registry_{hits,misses}``.
+3. **TOA-count shape bucketing** — :func:`pad_toas` pads a dataset to
+   the next geometric bucket (:func:`bucket_size`, 1.25x steps) with
+   sentinel TOAs of enormous uncertainty (``PAD_ERROR_US``), whose
+   weight ``1/sigma^2 ~ 1e-32`` drops out of every weighted reduction
+   to beyond f64 resolution — the exact zero-weight-padding discipline
+   of :mod:`pint_tpu.parallel.pta`.  Nearby dataset sizes then share
+   one executable instead of forcing a fresh compile per TOA count.
+4. **AOT warmup** — :func:`warmup` ``lower().compile()``s the standard
+   fit shapes offline (the ``pintwarm`` CLI / ``datacheck --warm``) to
+   pre-populate the persistent cache, so the first real fit of a fresh
+   process pays a disk read instead of a 30-second compile.
+
+The split/merge helpers (:func:`split_ctx` / :func:`merge_ctx`) carry
+the prepare-time component ctx across the jit boundary: array leaves
+travel as dynamic arguments, static python leaves stay closed over and
+are folded into the structural key.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time
+from collections import OrderedDict
+
+import numpy as np
+
+from pint_tpu import telemetry
+
+__all__ = [
+    "enable_persistent_cache", "cache_dir", "cache_entries",
+    "shared_jit", "registry_stats", "clear_registry",
+    "bucket_size", "pad_toas", "PAD_ERROR_US",
+    "split_ctx", "merge_ctx", "fingerprint",
+    "model_structure_key", "donation_argnums", "warmup",
+]
+
+_CACHE_ENV = "PINT_TPU_CACHE_DIR"
+_BUCKET_ENV = "PINT_TPU_BUCKET_TOAS"
+_DEFAULT_CACHE_DIR = os.path.join("~", ".cache", "pint_tpu", "xla")
+
+_lock = threading.RLock()
+
+
+# --------------------------------------------------------------------------
+# layer 1: persistent on-disk XLA compilation cache
+# --------------------------------------------------------------------------
+
+#: None = not yet decided; "" = disabled; otherwise the active dir
+_cache_dir_state = None
+
+
+def _disabled_token(raw) -> bool:
+    return str(raw).strip().lower() in ("", "0", "off", "none", "disabled")
+
+
+def enable_persistent_cache(path=None):
+    """Enable the on-disk XLA compilation cache; returns the directory
+    (or None when disabled/unavailable).  Idempotent.
+
+    path=None resolves ``$PINT_TPU_CACHE_DIR``, falling back to
+    ``~/.cache/pint_tpu/xla``.  Set the env var to ``0``/``off`` to
+    disable explicitly.  Every jax config knob is applied inside its
+    own try/except so a jax version that lacks one still gets the rest
+    (version-tolerant fallback, never an import-time crash)."""
+    global _cache_dir_state
+    with _lock:
+        if _cache_dir_state is not None and path is None:
+            return _cache_dir_state or None
+        raw = path if path is not None else os.environ.get(
+            _CACHE_ENV, _DEFAULT_CACHE_DIR)
+        if _disabled_token(raw):
+            _cache_dir_state = ""
+            return None
+        resolved = os.path.abspath(os.path.expanduser(os.fspath(raw)))
+        try:
+            os.makedirs(resolved, exist_ok=True)
+        except OSError as e:
+            import sys
+
+            print(f"pint_tpu.compile_cache: cannot create cache dir "
+                  f"{resolved!r}: {e}; persistent cache disabled",
+                  file=sys.stderr)
+            _cache_dir_state = ""
+            return None
+        import jax
+
+        ok = False
+        try:
+            jax.config.update("jax_compilation_cache_dir", resolved)
+            ok = True
+        except Exception:
+            try:  # pre-config-flag API
+                from jax.experimental.compilation_cache import (
+                    compilation_cache as _cc,
+                )
+
+                _cc.set_cache_dir(resolved)
+                ok = True
+            except Exception:
+                pass
+        if not ok:
+            _cache_dir_state = ""
+            return None
+        # cache every compile, not just the >1s ones: the whole point
+        # is amortizing fit-step compiles across processes
+        for knob, val in (
+            ("jax_persistent_cache_min_compile_time_secs", 0),
+            ("jax_persistent_cache_min_entry_size_bytes", -1),
+        ):
+            try:
+                jax.config.update(knob, val)
+            except Exception:
+                pass
+        # a backend initialized before this call holds a cache handle
+        # built with the old dir; reset so the new dir takes effect
+        try:
+            from jax._src import compilation_cache as _icc
+
+            _icc.reset_cache()
+        except Exception:
+            pass
+        _cache_dir_state = resolved
+        telemetry.gauge_set("compile_cache.dir", resolved)
+        return resolved
+
+
+def _auto_enable():
+    """Fit-path hook: enable the disk cache iff the env var asks for
+    it.  (Explicit tools — pintwarm, datacheck --warm — call
+    enable_persistent_cache() directly and get the default dir.)"""
+    if _cache_dir_state is None and os.environ.get(_CACHE_ENV):
+        enable_persistent_cache()
+
+
+def cache_dir():
+    """The active persistent-cache directory, or None."""
+    return _cache_dir_state or None
+
+
+def cache_entries():
+    """Number of compiled executables in the persistent cache (0 when
+    disabled or empty)."""
+    d = cache_dir()
+    if not d:
+        return 0
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return 0
+    # jax's LRU file cache stores '<key>-cache' payloads next to
+    # '-atime' bookkeeping files; older layouts store bare keys
+    payload = [n for n in names if not n.endswith("-atime")]
+    return len(payload)
+
+
+def _reset_for_tests():
+    """Forget the enable decision and empty the registry (tests)."""
+    global _cache_dir_state
+    with _lock:
+        _cache_dir_state = None
+        _registry.clear()
+
+
+# --------------------------------------------------------------------------
+# layer 2: process-level shared jit registry
+# --------------------------------------------------------------------------
+
+_registry: "OrderedDict" = OrderedDict()
+
+
+def _registry_cap():
+    try:
+        return max(1, int(os.environ.get("PINT_TPU_JIT_REGISTRY_CAP",
+                                         "128")))
+    except ValueError:
+        return 128
+
+
+def shared_jit(fn, *, key, fn_token=None, donate_argnums=None,
+               static_argnums=None):
+    """The one jitted callable for (fn identity x key), creating it on
+    first use.
+
+    fn identity is ``fn.__func__`` for bound methods (shared across
+    instances of a class) or ``fn`` itself; pass ``fn_token`` when the
+    callable is constructed fresh per call (vmapped lambdas) and the
+    key alone must identify the computation.  ``key`` must cover every
+    closed-over static the trace bakes in — abstract avals of the call
+    arguments are handled by jax.jit's own cache underneath.
+
+    The registry holds strong references (an entry keeps its first
+    caller's closure alive); it is LRU-bounded by
+    ``$PINT_TPU_JIT_REGISTRY_CAP`` (default 128)."""
+    _auto_enable()
+    identity = fn_token if fn_token is not None else getattr(
+        fn, "__func__", fn)
+    full_key = (identity, key)
+    with _lock:
+        got = _registry.get(full_key)
+        if got is not None:
+            _registry.move_to_end(full_key)
+            telemetry.counter_add("compile_cache.registry_hits")
+            return got
+        telemetry.counter_add("compile_cache.registry_misses")
+        import jax
+
+        kwargs = {}
+        if donate_argnums is not None:
+            kwargs["donate_argnums"] = donate_argnums
+        if static_argnums is not None:
+            kwargs["static_argnums"] = static_argnums
+
+        # Anchor jax's GLOBAL trace caches to this registry entry, not
+        # to `fn`: bound methods compare/hash EQUAL across re-keys of
+        # the same instance (f._step == f._step even after the free
+        # set changed), and with the previous entry's jit kept alive
+        # by the registry, jax's jaxpr cache would hand the new wrapper
+        # the STALE trace — the silently-fit-the-old-params bug the
+        # fitter's _retrace exists to prevent.  A fresh def per entry
+        # has unique identity, so nothing aliases.
+        def _entry(*args):
+            return fn(*args)
+
+        _entry.__name__ = getattr(fn, "__name__", "shared_jit_entry")
+        _entry.__qualname__ = getattr(fn, "__qualname__",
+                                      _entry.__name__)
+        jitted = jax.jit(_entry, **kwargs)
+        _registry[full_key] = jitted
+        cap = _registry_cap()
+        while len(_registry) > cap:
+            _registry.popitem(last=False)
+        return jitted
+
+
+def registry_stats():
+    """{"entries", "hits", "misses", "cap"} for datacheck/tests."""
+    with _lock:
+        entries = len(_registry)
+    return {
+        "entries": entries,
+        "hits": int(telemetry.counter_get("compile_cache.registry_hits")),
+        "misses": int(
+            telemetry.counter_get("compile_cache.registry_misses")),
+        "cap": _registry_cap(),
+    }
+
+
+def clear_registry():
+    """Drop every registry entry (tests / memory pressure)."""
+    with _lock:
+        _registry.clear()
+
+
+def donation_argnums(argnums):
+    """``argnums`` when the backend supports buffer donation, None
+    otherwise.  Donation of the iterate-in-place step vector saves one
+    buffer per iteration on TPU/GPU; CPU accepts it silently on current
+    jax, but older jaxlibs warn per call — gate on the platform."""
+    try:
+        import jax
+
+        backend = jax.default_backend()
+    except Exception:
+        return None
+    if backend in ("tpu", "gpu", "cuda", "rocm"):
+        return tuple(argnums)
+    if os.environ.get("PINT_TPU_DONATE_CPU"):
+        return tuple(argnums)
+    return None
+
+
+# --------------------------------------------------------------------------
+# structural keys and content fingerprints
+# --------------------------------------------------------------------------
+
+#: model.meta keys that change the traced computation (everything else
+#: in meta — CHI2/TRES/NTOA fit summaries, PSR names — is cosmetic and
+#: must NOT break registry sharing between consecutive fits)
+_STRUCTURAL_META = ("UNITS", "TRACK", "EPHEM", "CLK", "PLANET_SHAPIRO",
+                    "DMDATA", "TZRSITE")
+
+
+def model_structure_key(model) -> str:
+    """A string identifying everything about a TimingModel that a fit
+    trace bakes in: component classes and order, their mask selects and
+    parameter names, the values-pytree key set, structural meta, and
+    superset-inert gating.  Parameter VALUES are excluded — they enter
+    the jitted step as dynamic arguments."""
+    rows = [type(model).__name__]
+    for c in model.components:
+        rows.append((
+            type(c).__name__,
+            repr(getattr(c, "selects", None)),
+            tuple(p.name for p in c.params),
+            bool(getattr(c, "_use_rn", False)),
+        ))
+    rows.append(tuple(sorted(model.values.keys())))
+    rows.append(tuple((k, model.meta.get(k)) for k in _STRUCTURAL_META))
+    rows.append(tuple(sorted(getattr(model, "_superset_inert", ()) or ())))
+    return repr(rows)
+
+
+def fingerprint(tree) -> str:
+    """Content fingerprint of a pytree of arrays/scalars/strings —
+    for registry keys where data IS baked into the trace (the grid
+    path closes over its dataset).  Hashing is by array bytes, so two
+    numerically identical datasets fingerprint equal."""
+    h = hashlib.blake2b(digest_size=16)
+
+    def feed(obj):
+        if obj is None:
+            h.update(b"\x00N")
+        elif isinstance(obj, dict):
+            h.update(b"\x00D%d" % len(obj))
+            for k in sorted(obj, key=repr):
+                h.update(repr(k).encode())
+                feed(obj[k])
+        elif isinstance(obj, (list, tuple)):
+            h.update(b"\x00L%d" % len(obj))
+            for v in obj:
+                feed(v)
+        elif isinstance(obj, (str, bytes, int, float, bool, complex)):
+            h.update(repr(obj).encode())
+        elif hasattr(obj, "shape"):
+            a = np.asarray(obj)
+            h.update(b"\x00A" + str(a.dtype).encode()
+                     + repr(a.shape).encode())
+            h.update(a.tobytes())
+        elif hasattr(obj, "_fields"):  # NamedTuple pytree (TOABatch)
+            feed(tuple(obj))
+        else:
+            h.update(repr(obj).encode())
+
+    feed(tree)
+    return h.hexdigest()
+
+
+# --------------------------------------------------------------------------
+# ctx split/merge across the jit boundary
+# --------------------------------------------------------------------------
+
+def _is_dynamic_leaf(v):
+    """Array leaves cross the jit boundary as arguments; python
+    scalars/strings/tuples are static jit structure (the partition
+    rule of parallel.pta._stack_ctxs).  One deliberate extension:
+    numpy 0-d scalars (np.float64 'df' in the Fourier-noise ctx) are
+    DYNAMIC here — they are data-derived and differ in the last ulp
+    between same-shaped datasets, which must not break trace sharing.
+    (pta's stacker instead drops them per-pulsar with a warning; its
+    batched trace never reads them.)"""
+    if isinstance(v, np.generic):
+        return True
+    return hasattr(v, "shape") and not isinstance(
+        v, (tuple, int, float, bool))
+
+
+def split_ctx(ctx_map):
+    """Split a prepare()-time ``{component: {key: leaf}}`` ctx into
+    (dynamic arrays part, static part).  The dynamic part is a pytree
+    of arrays to pass as a jit argument; the static part stays closed
+    over and must be folded into the registry key (its repr is
+    deterministic)."""
+    if ctx_map is None:
+        return None, {}
+    arrays, static = {}, {}
+    for comp, ctx in ctx_map.items():
+        a, s = {}, {}
+        for k, v in ctx.items():
+            if _is_dynamic_leaf(v):
+                a[k] = v
+            else:
+                s[k] = v
+        arrays[comp] = a
+        static[comp] = s
+    return arrays, static
+
+
+def merge_ctx(arrays, static):
+    """Reassemble a component ctx from its dynamic and static parts
+    (inside OR outside a trace)."""
+    return {
+        comp: {**static.get(comp, {}), **arrays[comp]}
+        for comp in arrays
+    }
+
+
+def static_ctx_key(static) -> str:
+    """Deterministic repr of a split_ctx static part for registry
+    keys."""
+    return repr(sorted(
+        (comp, sorted((k, repr(v)) for k, v in d.items()))
+        for comp, d in (static or {}).items()
+    ))
+
+
+# --------------------------------------------------------------------------
+# layer 3: TOA-count shape bucketing
+# --------------------------------------------------------------------------
+
+#: sentinel uncertainty for padded TOAs [us]: sigma = 1e16 s, weight
+#: 1/sigma^2 = 1e-32 s^-2 — vanishes against any real TOA weight
+#: (~1e12) to far beyond f64 resolution, and sigma^2 = 1e32 stays
+#: representable inside the TPU's float32-pair f64 emulation (high
+#: word saturates at ~3.4e38; see residuals.MEAN_OFFSET_WEIGHT)
+PAD_ERROR_US = 1e22
+
+#: default geometric bucketing: 64, 80, 100, 125, 157, ... (1.25x)
+BUCKET_BASE = 64
+BUCKET_GROWTH = 1.25
+
+
+def bucket_size(n, base=BUCKET_BASE, growth=BUCKET_GROWTH):
+    """Smallest bucket >= n in geometric steps: datasets whose sizes
+    land in the same bucket compile to the SAME executable (<= 25%
+    padded compute buys an entire 30-second compile)."""
+    n = int(n)
+    if n <= base:
+        return base
+    b = float(base)
+    while int(round(b)) < n:
+        b *= growth
+    return int(round(b))
+
+
+def bucketing_default():
+    """Whether fitters bucket by default (``$PINT_TPU_BUCKET_TOAS``)."""
+    raw = os.environ.get(_BUCKET_ENV, "")
+    return raw.strip().lower() in ("1", "true", "yes", "on")
+
+
+def pad_toas(toas, n_target=None):
+    """Pad a TOAs object to its bucket size with zero-weight sentinel
+    rows; returns the padded object (``.n_real`` records the original
+    count) or the input unchanged when already at a bucket boundary.
+
+    The sentinels are copies of the LAST real TOA (so they join its
+    noise-mask groups and its ECORR epoch — never adding basis
+    columns) with uncertainty ``PAD_ERROR_US`` (and ``-pp_dme`` set to
+    the same sentinel when the dataset carries wideband DM data), so
+    every weighted reduction downstream — chi^2, weighted mean,
+    normal equations, Woodbury — drops them to below f64 resolution.
+    dof/NTOA accounting uses ``n_real``, never the padded length.
+    """
+    from pint_tpu.toa import TOAs
+
+    n = len(toas)
+    if getattr(toas, "n_real", None) is not None:
+        # already padded; an explicit conflicting target must not be
+        # silently ignored
+        if n_target is not None and int(n_target) != n:
+            raise ValueError(
+                f"TOAs already padded to {n} (n_real={toas.n_real}); "
+                f"cannot re-pad to {n_target}")
+        return toas
+    target = bucket_size(n) if n_target is None else int(n_target)
+    if target < n:
+        raise ValueError(f"pad target {target} < {n} TOAs")
+    if target == n:
+        # at a bucket boundary: return a COPY carrying n_real — never
+        # stamp bucketing state onto the caller's object (it would
+        # change the structure key of every Residuals later built from
+        # it, silently splitting the registry into mask/no-mask
+        # variants of the same problem)
+        out = toas[np.arange(n)]
+        out.n_real = n
+        return out
+    pad = toas[np.full(target - n, n - 1, dtype=np.int64)]
+    pad.error_us = np.full(target - n, PAD_ERROR_US)
+    for f in pad.flags:
+        f["pad"] = "1"
+        if "pp_dm" in f:
+            f["pp_dme"] = repr(PAD_ERROR_US)
+    padded = TOAs.merge([toas, pad])
+    padded.n_real = n
+    telemetry.counter_add("compile_cache.toas_padded")
+    telemetry.counter_add("compile_cache.pad_rows", float(target - n))
+    return padded
+
+
+# --------------------------------------------------------------------------
+# layer 4: AOT warmup
+# --------------------------------------------------------------------------
+
+#: standard GLS shape: DD binary + two-receiver EFAC/EQUAD/ECORR masks
+#: + power-law red noise — the B1855-class config every bench round
+#: measures (bench.py B1855_LIKE_PAR stays the measurement twin)
+WARM_GLS_PAR = """PSR  WARMUP-GLS
+RAJ 18:57:36.39
+DECJ 09:43:17.2
+F0 186.49408156698235146 1
+F1 -6.2049e-16 1
+PEPOCH 54000
+DM 13.29984 1
+BINARY DD
+PB 12.32717119132762 1
+A1 9.230780480 1
+ECC 0.00002170 1
+T0 54000.7262 1
+OM 276.55 1
+M2 0.26 1
+SINI 0.999 1
+TZRMJD 54000
+TZRSITE @
+TZRFRQ 1400
+EFAC -f L-wide 1.1
+EQUAD -f L-wide 0.3
+ECORR -f L-wide 0.5
+TNRedAmp -13.5
+TNRedGam 3.3
+TNRedC 30
+UNITS TDB
+EPHEM builtin
+"""
+
+#: minimal isolated-pulsar WLS shape (fast CPU warmup / smoke tests)
+WARM_WLS_PAR = """PSR  WARMUP-WLS
+RAJ 18:57:36.39
+DECJ 09:43:17.2
+F0 186.49408156698235146 1
+F1 -6.2049e-16 1
+PEPOCH 54000
+DM 13.29984 1
+TZRMJD 54000
+TZRSITE @
+TZRFRQ 1400
+UNITS TDB
+EPHEM builtin
+"""
+
+
+def _warm_pairs(n_toas, kind, seed=0):
+    from pint_tpu.models.builder import get_model
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    par = WARM_GLS_PAR if kind in ("gls", "downhill_gls") else WARM_WLS_PAR
+    model = get_model(par)
+    toas = make_fake_toas_uniform(
+        53000.0, 56500.0, int(n_toas), model, freq_mhz=1400.0, obs="gbt",
+        error_us=1.0, add_noise=True,
+        rng=np.random.default_rng(seed),
+        flags={"f": "L-wide"})
+    return model, toas
+
+
+def warmup(toa_counts=(500, 1000), kinds=("wls", "gls"), bucket=None,
+           progress=None, pairs=None):
+    """AOT-compile (``jit.lower().compile()``) the standard fit shapes,
+    populating the persistent cache for future processes.  Returns a
+    list of {"kind", "n_toas", "bucket", "compile_s"} records.
+
+    bucket=None follows :func:`bucketing_default` — the warmed shapes
+    must be the shapes default-configured fits will actually request
+    (a 596-row bucketed executable serves nothing when production fits
+    trace at exactly 500 TOAs, and vice versa).  Pass True/False to
+    warm for an explicitly bucketed/exact deployment.
+
+    pairs: optional explicit [(model, toas), ...] to warm a real
+    dataset's shapes instead of the synthetic standards (the
+    ``pintwarm --par/--tim`` path)."""
+    from pint_tpu.downhill import DownhillGLSFitter, DownhillWLSFitter
+    from pint_tpu.fitter import GLSFitter, WLSFitter
+
+    fitter_of = {
+        "wls": WLSFitter,
+        "gls": GLSFitter,
+        "downhill_wls": DownhillWLSFitter,
+        "downhill_gls": DownhillGLSFitter,
+    }
+    if bucket is None:
+        bucket = bucketing_default()
+    out = []
+    jobs = []
+    if pairs is not None:
+        for kind in kinds:
+            for model, toas in pairs:
+                jobs.append((kind, model, toas))
+    else:
+        for kind in kinds:
+            for n in toa_counts:
+                model, toas = _warm_pairs(n, kind)
+                jobs.append((kind, model, toas))
+    for kind, model, toas in jobs:
+        cls = fitter_of[kind]
+        n_in = len(toas)
+        if bucket:
+            toas = pad_toas(toas)
+        f = cls(toas, model)
+        dt = f.warm_compile()
+        rec = {"kind": kind, "n_toas": n_in, "bucket": len(toas),
+               "compile_s": round(dt, 3)}
+        out.append(rec)
+        if progress is not None:
+            progress(f"warmed {kind} n_toas={n_in} "
+                     f"(bucket {len(toas)}): compile {dt:.1f}s")
+    telemetry.counter_add("compile_cache.warmups", len(out))
+    return out
+
+
+def warm_timed(fn):
+    """Time one AOT compile call (helper for warm_compile methods)."""
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0

@@ -168,9 +168,33 @@ def datacheck_report(ephem="builtin", sites=("gbt", "ao", "jb", "pks",
         f"{int(telemetry.counter_get('backend_probe.cpu_fallbacks'))}")
     lines.append(
         f"  jit compile: {cs['events']} event(s), "
-        f"{cs['seconds']:.2f}s this session (source: {cs['source']})")
+        f"{cs['seconds']:.2f}s this session (source: {cs['source']}; "
+        f"backend compiles {cs['backend_events']} / "
+        f"{cs['backend_seconds']:.2f}s, disk-cache hits "
+        f"{cs['cache_hits']} saving {cs['cache_saved_seconds']:.2f}s)")
     for tline in _last_session_compile_lines():
         lines.append(tline)
+
+    # -- compile cache: persistent dir + shared jit registry ------------------
+    from pint_tpu import compile_cache
+
+    d = compile_cache.cache_dir()
+    if d is None and os.environ.get("PINT_TPU_CACHE_DIR"):
+        # env var present but nothing has compiled yet this process
+        d = compile_cache.enable_persistent_cache()
+    if d:
+        lines.append(
+            f"Compile cache: {d} ({compile_cache.cache_entries()} "
+            "entries on disk)")
+    else:
+        lines.append(
+            "Compile cache: disabled (set $PINT_TPU_CACHE_DIR, or run "
+            "pintwarm, to persist XLA compiles across processes)")
+    rs = compile_cache.registry_stats()
+    lines.append(
+        f"  jit registry: {rs['entries']} shared trace(s), "
+        f"{rs['hits']} hit(s) / {rs['misses']} miss(es) this session "
+        f"(cap {rs['cap']})")
     return lines
 
 
@@ -222,9 +246,22 @@ def main(argv=None):
                     "consequences")
     p.add_argument("ephem", nargs="?", default="builtin",
                    help="ephemeris name to resolve (default builtin)")
+    p.add_argument("--warm", action="store_true",
+                   help="AOT-compile a small standard fit shape into "
+                        "the persistent cache after the report "
+                        "(pintwarm does the full shape sweep)")
     args = p.parse_args(argv)
     for line in datacheck_report(args.ephem):
         print(line)
+    if args.warm:
+        from pint_tpu import compile_cache
+
+        d = compile_cache.enable_persistent_cache()
+        print(f"Warmup (cache {d or 'DISABLED'}):")
+        compile_cache.warmup(toa_counts=(500,), kinds=("wls", "gls"),
+                             progress=lambda s: print("  " + s))
+        if d:
+            print(f"  -> {compile_cache.cache_entries()} entries on disk")
     return 0
 
 

@@ -19,9 +19,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pint_tpu import compile_cache as _cc
 from pint_tpu import telemetry
 from pint_tpu.fitter import Fitter, GLSFitter, WLSFitter, WidebandTOAFitter
-from pint_tpu.linalg import gls_normal_solve
 from pint_tpu.telemetry import span
 
 __all__ = ["DownhillWLSFitter", "DownhillGLSFitter",
@@ -40,17 +40,42 @@ class _DownhillMixin:
     #: stop when chi2 decrease falls below this (reference fitter.py:1078)
     min_chi2_decrease = 1e-2
 
-    def _chi2_at(self, values):
-        return self.resids.chi2_fn(values)
+    def _retrace(self):
+        """Key BOTH jitted steps (plain + halving) through the shared
+        registry; the halving step is the one fit_toas drives."""
+        super()._retrace()
+        self._halving_jit = _cc.shared_jit(
+            self._halving_step,
+            key=("downhill.halving", type(self).__name__,
+                 self._traced_free, self.max_halvings,
+                 getattr(self, "threshold", None),
+                 self.resids._structure_key()),
+            donate_argnums=_cc.donation_argnums((0,)))
 
-    def _halving_step(self, vec, base_values):
+    def warm_compile(self):
+        """AOT-compile the halving step (the downhill hot path) plus
+        the residuals accessors the fit epilogue reports through."""
+        vec = jnp.zeros(len(self._traced_free), dtype=jnp.float64)
+        base = self.prepared._values_pytree()
+        lowered = self._halving_jit.lower(vec, base, self._fit_data)
+        total = _cc.warm_timed(lowered.compile)
+        warm_resids = getattr(self.resids, "warm_compile", None)
+        if warm_resids is not None:
+            total += warm_resids()
+        return total
+
+    def _chi2_at(self, values, data):
+        return self.resids.chi2_at(values, data)
+
+    def _halving_step(self, vec, base_values, data):
         """Propose dpar at vec, then find the largest lambda in
         {1, 1/2, 1/4, ...} whose step decreases chi^2.  Returns
         (new_vec, chi2_old, chi2_new, cov)."""
-        new_vec, chi2_old, dpar, cov = self._propose(vec, base_values)
+        new_vec, chi2_old, dpar, cov = self._propose(vec, base_values,
+                                                     data)
 
         def chi2_of(v):
-            return self._chi2_at(self._merged(base_values, v))
+            return self._chi2_at(self._merged(base_values, v), data)
 
         def cond(carry):
             lam, chi2_new, n = carry
@@ -92,10 +117,6 @@ class _DownhillMixin:
             if tuple(self.model.free_timing_params) != getattr(
                     self, "_traced_free", ()):
                 self._retrace()
-                self._halving_jit = jax.jit(self._halving_step)
-            elif not hasattr(self, "_halving_jit"):
-                telemetry.counter_add("fitter.retraces")
-                self._halving_jit = jax.jit(self._halving_step)
             else:
                 telemetry.counter_add("fitter.jit_cache_hits")
             vec = jnp.array(
@@ -107,7 +128,8 @@ class _DownhillMixin:
             n_iter = 0
             self.converged = False
             for _ in range(maxiter):
-                vec, chi2_old, chi2_new, cov = self._halving_jit(vec, base)
+                vec, chi2_old, chi2_new, cov = self._halving_jit(
+                    vec, base, self._fit_data)
                 n_iter += 1
                 if float(chi2_old) - float(chi2_new) \
                         < self.min_chi2_decrease:
@@ -150,20 +172,28 @@ class _DownhillMixin:
                 "params to fit them)"
             )
         base = self.prepared._values_pytree()
+        data = self.resids._data()
 
-        def neg_lnl(v):
-            values = dict(base)
+        def neg_lnl(v, base_values, fit_data):
+            values = dict(base_values)
             for i, n in enumerate(names):
                 values[n] = v[i]
-            return -self.resids.lnlikelihood_fn(values)
+            return -self.resids.lnlikelihood_at(values, fit_data)
 
-        val_grad = jax.jit(jax.value_and_grad(neg_lnl))
+        # base values and dataset are dynamic arguments, so the traced
+        # gradient is shared across same-structure fitters (and across
+        # repeated fit_noise calls) through the registry
+        val_grad = _cc.shared_jit(
+            jax.value_and_grad(neg_lnl),
+            key=("downhill.fit_noise", type(self).__name__,
+                 tuple(names), self.resids._structure_key()),
+            fn_token="downhill.fit_noise")
         x = np.array([self.model.values[n] for n in names], dtype=np.float64)
 
         from scipy.optimize import minimize
 
         def fun(v):
-            f, g = val_grad(jnp.asarray(v))
+            f, g = val_grad(jnp.asarray(v), base, data)
             return float(f), np.asarray(g, dtype=np.float64)
 
         with span("fit_noise", n_noise=len(names), maxiter=maxiter):
@@ -175,7 +205,8 @@ class _DownhillMixin:
         for i, n in enumerate(names):
             self.model.values[n] = float(x[i])
         # uncertainties: inverse Hessian of -lnL at the optimum
-        H = np.asarray(jax.hessian(neg_lnl)(jnp.asarray(x)))
+        H = np.asarray(
+            jax.hessian(lambda v: neg_lnl(v, base, data))(jnp.asarray(x)))
         try:
             hinv = np.linalg.inv(H)
             errs = np.sqrt(np.clip(np.diag(hinv), 0, None))
@@ -191,17 +222,20 @@ class _DownhillMixin:
 class DownhillWLSFitter(_DownhillMixin, WLSFitter):
     """Step-halving WLS (reference DownhillWLSFitter, fitter.py:1379)."""
 
-    def _propose(self, vec, base_values):
-        new_vec, chi2, dpar, cov = WLSFitter._step(self, vec, base_values)
-        return new_vec, self._chi2_at(self._merged(base_values, vec)), \
+    def _propose(self, vec, base_values, data):
+        new_vec, chi2, dpar, cov = WLSFitter._step(
+            self, vec, base_values, data)
+        return new_vec, \
+            self._chi2_at(self._merged(base_values, vec), data), \
             dpar, cov
 
 
 class DownhillGLSFitter(_DownhillMixin, GLSFitter):
     """Step-halving GLS (reference DownhillGLSFitter, fitter.py:1527)."""
 
-    def _propose(self, vec, base_values):
-        new_vec, chi2, dpar, cov, _ = GLSFitter._step(self, vec, base_values)
+    def _propose(self, vec, base_values, data):
+        new_vec, chi2, dpar, cov, _ = GLSFitter._step(
+            self, vec, base_values, data)
         return new_vec, chi2, dpar, cov
 
 
@@ -209,8 +243,8 @@ class WidebandDownhillFitter(_DownhillMixin, WidebandTOAFitter):
     """Step-halving wideband fitter (reference WidebandDownhillFitter,
     fitter.py:1812)."""
 
-    def _propose(self, vec, base_values):
+    def _propose(self, vec, base_values, data):
         new_vec, chi2, dpar, cov, _ = WidebandTOAFitter._step(
-            self, vec, base_values
+            self, vec, base_values, data
         )
         return new_vec, chi2, dpar, cov

@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pint_tpu import compile_cache as _cc
 from pint_tpu import flops as _flops
 from pint_tpu import telemetry
 from pint_tpu.linalg import gls_normal_solve
@@ -61,16 +62,26 @@ def wls_gn_solve(resid_fn, vec, err, threshold=1e-14):
 
 
 class Fitter:
-    """Base fitter: holds (toas, model), exposes fit_toas()."""
+    """Base fitter: holds (toas, model), exposes fit_toas().
 
-    def __init__(self, toas, model, residuals=None):
+    bucket: pad the TOAs to the next geometric size bucket
+    (compile_cache.pad_toas) so nearby dataset sizes share one XLA
+    executable.  None reads ``$PINT_TPU_BUCKET_TOAS`` (default off);
+    explicit residuals suppress padding (their dataset is fixed).
+    """
+
+    def __init__(self, toas, model, residuals=None, bucket=None):
+        if bucket is None:
+            bucket = _cc.bucketing_default()
+        if bucket and residuals is None:
+            toas = _cc.pad_toas(toas)
         self.toas = toas
         self.model = model
         self.resids = residuals or Residuals(toas, model)
         self.prepared = self.resids.prepared
 
     @staticmethod
-    def auto(toas, model, downhill=True):
+    def auto(toas, model, downhill=True, bucket=None):
         """Pick a fitter like the reference (fitter.py:252): wideband
         when the TOAs carry -pp_dm data (and the model says DMDATA), GLS
         when the model carries correlated noise, WLS otherwise; downhill
@@ -87,17 +98,17 @@ class Fitter:
             if downhill:
                 from pint_tpu.downhill import WidebandDownhillFitter
 
-                return WidebandDownhillFitter(toas, model)
-            return WidebandTOAFitter(toas, model)
+                return WidebandDownhillFitter(toas, model, bucket=bucket)
+            return WidebandTOAFitter(toas, model, bucket=bucket)
         if downhill:
             from pint_tpu.downhill import DownhillGLSFitter, DownhillWLSFitter
 
             if model.has_correlated_errors:
-                return DownhillGLSFitter(toas, model)
-            return DownhillWLSFitter(toas, model)
+                return DownhillGLSFitter(toas, model, bucket=bucket)
+            return DownhillWLSFitter(toas, model, bucket=bucket)
         if model.has_correlated_errors:
-            return GLSFitter(toas, model)
-        return WLSFitter(toas, model)
+            return GLSFitter(toas, model, bucket=bucket)
+        return WLSFitter(toas, model, bucket=bucket)
 
     # -- reporting -----------------------------------------------------------
     def get_summary(self) -> str:
@@ -154,22 +165,53 @@ class Fitter:
 
     # -- shared machinery -----------------------------------------------------
     def _retrace(self):
-        """(Re)build the jitted step for the current free-param set.
+        """(Re)key the jitted step for the current free-param set.
         The trace closes over the free-param *names*; a changed free set
         with the same count would otherwise hit the stale jit cache and
-        silently write steps into the wrong parameters."""
+        silently write steps into the wrong parameters.
+
+        The jitted callable comes from the process-level registry
+        (compile_cache.shared_jit): the step takes the dataset as a
+        DYNAMIC argument, so its key is purely structural and a second
+        fitter on a same-shaped problem reuses this one's trace and
+        executable — zero new XLA compiles."""
         telemetry.counter_add("fitter.retraces")
         self._traced_free = tuple(self.model.free_timing_params)
-        self._step_jit = jax.jit(self._step)
+        self._fit_data = self.resids._data()
+        self._step_jit = _cc.shared_jit(
+            self._step, key=self._step_key(),
+            donate_argnums=_cc.donation_argnums((0,)))
 
-    def _resid_fn_of(self, base_values):
+    def _step_key(self):
+        """Everything a trace of _step bakes in beyond the avals."""
+        return ("fitter.step", type(self).__name__, self._traced_free,
+                getattr(self, "threshold", None),
+                self.resids._structure_key())
+
+    def warm_compile(self):
+        """AOT-compile (lower().compile()) the fit step AND the
+        residuals accessors the fit epilogue reports through (chi^2,
+        weighted RMS) for this problem's shapes, without running a fit
+        — with the persistent cache enabled this writes the
+        executables to disk, so a future process's first fit is
+        disk reads end to end.  Returns compile seconds."""
+        vec = jnp.zeros(len(self._traced_free), dtype=jnp.float64)
+        base = self.prepared._values_pytree()
+        lowered = self._step_jit.lower(vec, base, self._fit_data)
+        total = _cc.warm_timed(lowered.compile)
+        warm_resids = getattr(self.resids, "warm_compile", None)
+        if warm_resids is not None:
+            total += warm_resids()
+        return total
+
+    def _resid_fn_of(self, base_values, data):
         free = self._traced_free
 
         def resid_fn(v):
             values = dict(base_values)
             for i, name in enumerate(free):
                 values[name] = v[i]
-            return self.resids.time_resids_fn(values)
+            return self.resids.time_resids_at(values, data)
 
         return resid_fn
 
@@ -205,7 +247,8 @@ class Fitter:
             n_iter = 0
             self._step_extras = ()
             for _ in range(maxiter):
-                vec, chi2, dpar, cov, *extras = self._step_jit(vec, base)
+                vec, chi2, dpar, cov, *extras = self._step_jit(
+                    vec, base, self._fit_data)
                 n_iter += 1
                 self._step_extras = extras
                 if chi2_prev is not None and \
@@ -244,7 +287,8 @@ class Fitter:
         the output par file (reference: CHI2/TRES/NTOA params,
         timing_model.py:344-386)."""
         r = self.resids
-        self.model.meta["NTOA"] = str(len(self.toas))
+        self.model.meta["NTOA"] = str(
+            getattr(r, "n_real", None) or len(self.toas))
         self.model.meta["CHI2"] = f"{r.chi2:.6f}"
         self.model.meta["TRES"] = f"{r.rms_weighted() * 1e6:.6f}"
 
@@ -263,8 +307,9 @@ class WLSFitter(Fitter):
     by the noise-scaled uncertainties (EFAC/EQUAD), matching the
     reference WLS path (fitter.py:1990)."""
 
-    def __init__(self, toas, model, residuals=None, threshold=1e-14):
-        super().__init__(toas, model, residuals)
+    def __init__(self, toas, model, residuals=None, threshold=1e-14,
+                 bucket=None):
+        super().__init__(toas, model, residuals, bucket=bucket)
         self.threshold = threshold
         self._retrace()
 
@@ -274,13 +319,15 @@ class WLSFitter(Fitter):
         return _flops.wls_fit_flops(
             len(self.toas), len(self._traced_free), n_iter)
 
-    def _step(self, vec, base_values):
-        """One Gauss-Newton WLS step.  base_values (the full values dict,
-        including frozen params) is a dynamic argument so that edits to
-        frozen parameters between fits take effect without retracing;
-        changes to WHICH params are free go through _retrace()."""
-        resid_fn = self._resid_fn_of(base_values)
-        sigma = self.resids.sigma_fn(self._merged(base_values, vec))
+    def _step(self, vec, base_values, data):
+        """One Gauss-Newton WLS step.  base_values (the full values
+        dict, including frozen params) and data (the dataset pytree)
+        are dynamic arguments, so edits to frozen parameters between
+        fits take effect without retracing and same-shaped problems
+        share the trace; changes to WHICH params are free go through
+        _retrace()."""
+        resid_fn = self._resid_fn_of(base_values, data)
+        sigma = self.resids.sigma_at(self._merged(base_values, vec), data)
         return wls_gn_solve(resid_fn, vec, sigma, self.threshold)
 
 
@@ -292,14 +339,18 @@ class WidebandTOAFitter(Fitter):
     acts on the time block; DM rows see DMEFAC/DMEQUAD-scaled white
     noise."""
 
-    def __init__(self, toas, model, residuals=None):
+    def __init__(self, toas, model, residuals=None, bucket=None):
         if residuals is None:
+            if bucket is None:
+                bucket = _cc.bucketing_default()
+            if bucket:
+                toas = _cc.pad_toas(toas)
             residuals = WidebandTOAResiduals(toas, model)
-        super().__init__(toas, model, residuals=residuals)
+        super().__init__(toas, model, residuals=residuals, bucket=False)
         self.noise_realizations = {}
         self._retrace()
 
-    def _stacked_resid_fn(self, base_values):
+    def _stacked_resid_fn(self, base_values, data):
         free = self._traced_free
         toa_r = self.resids.toa
         dm_r = self.resids.dm
@@ -309,20 +360,22 @@ class WidebandTOAFitter(Fitter):
             for i, name in enumerate(free):
                 values[name] = v[i]
             return jnp.concatenate(
-                [toa_r.time_resids_fn(values), dm_r.dm_resids_fn(values)]
+                [toa_r.time_resids_at(values, data["toa"]),
+                 dm_r.dm_resids_at(values, data["dm"])]
             )
 
         return resid_fn
 
-    def _step(self, vec, base_values):
+    def _step(self, vec, base_values, data):
         values = self._merged(base_values, vec)
-        sigma_t = self.resids.toa.sigma_fn(values)
-        sigma_dm = self.resids.dm.sigma_fn(values)
+        sigma_t = self.resids.toa.sigma_at(values, data["toa"])
+        sigma_dm = self.resids.dm.sigma_at(values, data["dm"])
         sigma = jnp.concatenate([sigma_t, sigma_dm])
-        resid_fn = self._stacked_resid_fn(base_values)
+        resid_fn = self._stacked_resid_fn(base_values, data)
         r = resid_fn(vec)
         J = jax.jacfwd(resid_fn)(vec)
-        U_t, phi = self.resids.toa._noise_basis_phi(values)
+        U_t, phi = self.resids.toa._noise_basis_phi_at(values,
+                                                       data["toa"])
         U = jnp.concatenate(
             [U_t, jnp.zeros((sigma_dm.shape[0], U_t.shape[1]))], axis=0
         )
@@ -340,16 +393,16 @@ class GLSFitter(Fitter):
     (reference :2269-2282).
     """
 
-    def __init__(self, toas, model, residuals=None):
-        super().__init__(toas, model, residuals)
+    def __init__(self, toas, model, residuals=None, bucket=None):
+        super().__init__(toas, model, residuals, bucket=bucket)
         self.noise_realizations = {}
         self._retrace()
 
-    def _step(self, vec, base_values):
-        resid_fn = self._resid_fn_of(base_values)
+    def _step(self, vec, base_values, data):
+        resid_fn = self._resid_fn_of(base_values, data)
         values = self._merged(base_values, vec)
-        sigma = self.resids.sigma_fn(values)
-        U, phi = self.resids._noise_basis_phi(values)
+        sigma = self.resids.sigma_at(values, data)
+        U, phi = self.resids._noise_basis_phi_at(values, data)
         r = resid_fn(vec)
         J = jax.jacfwd(resid_fn)(vec)
         dpar, cov, ncoef, chi2 = gls_normal_solve(r, J, sigma, U, phi)
@@ -373,5 +426,5 @@ class GLSFitter(Fitter):
             dtype=jnp.float64,
         )
         base = self.prepared._values_pytree()
-        *_, ncoef = self._step_jit(vec, base)
+        *_, ncoef = self._step_jit(vec, base, self._fit_data)
         self._set_noise_realizations(ncoef)

@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pint_tpu import compile_cache as _cc
 from pint_tpu.residuals import Residuals
 
 __all__ = ["grid_chisq", "grid_chisq_vectorized", "make_grid_fn",
@@ -29,6 +30,31 @@ def _make_fit_one(prepared, resids, grid_params, fit_params, n_steps):
 
     base_values = {k: jnp.float64(v) for k, v in prepared.model.values.items()}
     correlated = prepared.model.has_correlated_errors
+
+    # host-side prebuild of the values-independent noise solve (the
+    # same treatment as the eager _U_ext build in residuals.py): when
+    # no gridded or refit parameter touches the noise model, sigma, U
+    # and phi are trace-time CONSTANTS — leaving them in the trace
+    # hands XLA an all-constant (U^T N^-1 U + Phi^-1) build + Cholesky
+    # to constant-fold from (n_toa, n_basis) inputs on EVERY grid
+    # compile (the multi-GFLOP fold behind the BENCH_r05 alarm)
+    noise_owned = {
+        p.name
+        for c in prepared.model.noise_components
+        for p in c.params
+    }
+    sigma_frozen = noise_owned.isdisjoint(
+        set(grid_params) | set(fit_params))
+    pre = None
+    sigma_const = None
+    U_const = phi_const = None
+    if sigma_frozen:
+        sigma_const = resids.sigma_fn(base_values)  # eager, concrete
+        if correlated:
+            from pint_tpu.linalg import woodbury_precompute
+
+            U_const, phi_const = resids._noise_basis_phi(base_values)
+            pre = woodbury_precompute(sigma_const, U_const, phi_const)
 
     def values_of(fit_vec, grid_vec):
         values = dict(base_values)
@@ -43,16 +69,21 @@ def _make_fit_one(prepared, resids, grid_params, fit_params, n_steps):
 
     def gn_step(fit_vec, grid_vec):
         values = values_of(fit_vec, grid_vec)
-        sigma = resids.sigma_fn(values)
+        sigma = (sigma_const if sigma_const is not None
+                 else resids.sigma_fn(values))
         if correlated:
             import jax as _jax
 
             from pint_tpu.linalg import gls_normal_solve
 
             fn = lambda v: resid_of(v, grid_vec)  # noqa: E731
-            U, phi = resids._noise_basis_phi(values)
+            if pre is not None:
+                U, phi = U_const, phi_const
+            else:
+                U, phi = resids._noise_basis_phi(values)
             dpar, *_ = gls_normal_solve(
-                fn(fit_vec), _jax.jacfwd(fn)(fit_vec), sigma, U, phi
+                fn(fit_vec), _jax.jacfwd(fn)(fit_vec), sigma, U, phi,
+                pre=pre
             )
             return fit_vec + dpar
         from pint_tpu.fitter import wls_gn_solve
@@ -71,7 +102,17 @@ def _make_fit_one(prepared, resids, grid_params, fit_params, n_steps):
         if fit_params:  # all-params-gridded case: plain chi2 evaluation
             for _ in range(n_steps):  # unrolled: small fixed count
                 vec = gn_step(vec, grid_vec)
-        chi2 = resids.chi2_fn(values_of(vec, grid_vec))
+        values = values_of(vec, grid_vec)
+        if pre is not None:
+            from pint_tpu.linalg import woodbury_chi2_logdet_pre
+
+            r = resids.time_resids_fn(values)
+            chi2, _ = woodbury_chi2_logdet_pre(r, pre)
+        elif sigma_const is not None and not correlated:
+            r = resids.time_resids_fn(values)
+            chi2 = jnp.sum((r / sigma_const) ** 2)
+        else:
+            chi2 = resids.chi2_fn(values)
         return chi2, vec
 
     return fit_one
@@ -80,14 +121,23 @@ def _make_fit_one(prepared, resids, grid_params, fit_params, n_steps):
 def make_grid_fn(toas, model, grid_params, n_steps=3):
     """Compile once, call many times: returns (fn, fit_params) where
     fn(grid_values (n,k)) -> (chi2 (n,), fitted (n, nfree)).  Lets
-    callers (bench, repeated scans) reuse the jitted program."""
+    callers (bench, repeated scans) reuse the jitted program.
+
+    The jitted grid is registry-shared (compile_cache.shared_jit): the
+    grid program bakes its dataset in as constants, so the key carries
+    a CONTENT fingerprint — a rebuilt grid over the same data, params
+    and step count reuses the previous trace and executable."""
     resids = Residuals(toas, model)
     prepared = resids.prepared
     grid_params = list(grid_params)
     fit_params = [p for p in model.free_timing_params if p not in grid_params]
     fit_one = _make_fit_one(prepared, resids, grid_params, fit_params,
                             n_steps)
-    return jax.jit(jax.vmap(fit_one)), fit_params
+    key = ("grid.fit_one", resids._structure_key(),
+           tuple(grid_params), tuple(fit_params), int(n_steps),
+           _cc.fingerprint((resids._data(), prepared.model.values)))
+    return _cc.shared_jit(jax.vmap(fit_one), key=key,
+                          fn_token="grid.make_grid_fn"), fit_params
 
 
 def grid_chisq_vectorized(

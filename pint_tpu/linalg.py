@@ -10,10 +10,14 @@ Pure jax, differentiable, vmappable.
 
 from __future__ import annotations
 
+from typing import NamedTuple
+
 import jax
 import jax.numpy as jnp
 
-__all__ = ["woodbury_chi2_logdet", "gls_normal_solve"]
+__all__ = ["woodbury_chi2_logdet", "gls_normal_solve",
+           "WoodburyPre", "woodbury_precompute",
+           "woodbury_chi2_logdet_pre"]
 
 #: floor on basis weights: a zero weight (e.g. ECORR 0) means infinite
 #: prior precision on that column — the coefficient is pinned to zero and
@@ -23,12 +27,17 @@ __all__ = ["woodbury_chi2_logdet", "gls_normal_solve"]
 _PHI_FLOOR = 1e-30
 
 
-def woodbury_chi2_logdet(r, sigma, U, phi):
+def woodbury_chi2_logdet(r, sigma, U, phi, valid=None):
     """(chi2, logdet C) for C = diag(sigma^2) + U diag(phi) U^T.
 
     chi2 = r^T C^-1 r via the Woodbury identity; logdet via the matrix
     determinant lemma with the Cholesky of Sigma (reference:
     utils.woodbury_dot, utils.py:3074).
+
+    valid: optional boolean mask excluding bucketing pad rows from the
+    white logdet term (their ~1e-32 weights already vanish from every
+    other reduction, but their log sigma^2 would shift — and, with
+    EFAC free, bias — the log-likelihood).
     """
     phi = jnp.maximum(phi, _PHI_FLOOR)
     nvec = sigma**2
@@ -38,15 +47,64 @@ def woodbury_chi2_logdet(r, sigma, U, phi):
     cf = jax.scipy.linalg.cho_factor(sigma_cap, lower=True)
     x = jax.scipy.linalg.cho_solve(cf, ut_ninv_r)
     chi2 = jnp.sum(r * ninv_r) - jnp.sum(ut_ninv_r * x)
+    log_nvec = jnp.log(nvec)
+    if valid is not None:
+        log_nvec = jnp.where(valid, log_nvec, 0.0)
     logdet = (
-        jnp.sum(jnp.log(nvec))
+        jnp.sum(log_nvec)
         + jnp.sum(jnp.log(phi))
         + 2.0 * jnp.sum(jnp.log(jnp.diag(cf[0])))
     )
     return chi2, logdet
 
 
-def gls_normal_solve(r, J, sigma, U, phi):
+class WoodburyPre(NamedTuple):
+    """Values-independent pieces of the Woodbury solve, prebuilt
+    host-side (eagerly, OUTSIDE any trace) when sigma/U/phi are known
+    constants — the chi^2-grid case where all noise parameters sit
+    frozen in the closed-over base values.  Without this, every grid
+    compile hands XLA an all-constant ``(U^T N^-1 U + Phi^-1)`` build
+    plus its Cholesky to constant-fold from (n_toa, n_basis) inputs —
+    the multi-GFLOP fold behind the BENCH_r05 constant-folding alarm
+    (the same alarm class the eager ``_U_ext`` fix in residuals.py
+    silenced)."""
+
+    nvec: jnp.ndarray      # (N,) sigma^2
+    U: jnp.ndarray         # (N, K)
+    chol_lower: jnp.ndarray  # (K, K) lower Cholesky of the capacity mat
+    logdet: jnp.ndarray    # scalar logdet C
+
+
+def woodbury_precompute(sigma, U, phi):
+    """Eagerly build the capacity-matrix Cholesky and logdet for
+    constant (sigma, U, phi).  Call OUTSIDE jit with concrete arrays;
+    the result is a small pytree whose in-trace footprint is (N, K) +
+    (K, K) constants instead of a foldable (N, K) x (N, K) matmul."""
+    phi = jnp.maximum(jnp.asarray(phi), _PHI_FLOOR)
+    sigma = jnp.asarray(sigma)
+    U = jnp.asarray(U)
+    nvec = sigma**2
+    sigma_cap = (U.T * (1.0 / nvec)[None, :]) @ U + jnp.diag(1.0 / phi)
+    chol = jax.scipy.linalg.cho_factor(sigma_cap, lower=True)[0]
+    logdet = (
+        jnp.sum(jnp.log(nvec))
+        + jnp.sum(jnp.log(phi))
+        + 2.0 * jnp.sum(jnp.log(jnp.diag(chol)))
+    )
+    return WoodburyPre(nvec, U, chol, logdet)
+
+
+def woodbury_chi2_logdet_pre(r, pre: WoodburyPre):
+    """(chi2, logdet) against a :func:`woodbury_precompute` result —
+    only the r-dependent work stays in the trace."""
+    ninv_r = r / pre.nvec
+    ut_ninv_r = pre.U.T @ ninv_r
+    x = jax.scipy.linalg.cho_solve((pre.chol_lower, True), ut_ninv_r)
+    chi2 = jnp.sum(r * ninv_r) - jnp.sum(ut_ninv_r * x)
+    return chi2, pre.logdet
+
+
+def gls_normal_solve(r, J, sigma, U, phi, pre=None):
     """Solve the noise-augmented GLS normal equations (reference:
     GLSFitter.fit_toas, fitter.py:2164-2204).
 
@@ -56,6 +114,10 @@ def gls_normal_solve(r, J, sigma, U, phi):
     with J = d resid/d param (so the step applied is -d), cov is the
     parameter covariance block, noise_coeffs are the basis amplitudes a,
     and chi2 is the Woodbury chi^2 of r against C = N + U Phi U^T.
+
+    pre: optional :class:`WoodburyPre` for the chi^2 evaluation when
+    (sigma, U, phi) are trace-time constants (the chi^2-grid path) —
+    keeps XLA from constant-folding the capacity matrix per compile.
     """
     phi = jnp.maximum(phi, _PHI_FLOOR)
     n_par = J.shape[1]
@@ -83,7 +145,10 @@ def gls_normal_solve(r, J, sigma, U, phi):
     xhat = (Q @ (w_inv * (Q.T @ (rhs / norm)))) / norm
     cov_full = (Q * w_inv[None, :]) @ Q.T / jnp.outer(norm, norm)
     if U.shape[1]:
-        chi2, _ = woodbury_chi2_logdet(r, sigma, U, phi)
+        if pre is not None:
+            chi2, _ = woodbury_chi2_logdet_pre(r, pre)
+        else:
+            chi2, _ = woodbury_chi2_logdet(r, sigma, U, phi)
     else:
         chi2 = jnp.sum((r / sigma) ** 2)
     return (

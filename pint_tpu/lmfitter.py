@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pint_tpu import compile_cache as _cc
 from pint_tpu.fitter import Fitter
 
 __all__ = ["LMFitter", "PowellFitter"]
@@ -34,35 +35,42 @@ class LMFitter(Fitter):
     down = 10.0
     max_tries = 12
 
-    def __init__(self, toas, model, residuals=None):
-        super().__init__(toas, model, residuals)
+    def __init__(self, toas, model, residuals=None, bucket=None):
+        super().__init__(toas, model, residuals, bucket=bucket)
         self._retrace()
 
     def _retrace(self):
-        # base _retrace jits self._step, which LM replaces wholesale
+        # base _retrace jits self._step, which LM replaces wholesale;
+        # both LM functions resolve through the shared registry with
+        # the dataset as a dynamic argument (fitter.py contract)
         self._traced_free = tuple(self.model.free_timing_params)
-        self._lm_jit = jax.jit(self._lm_solve)
-        self._chi2_vec_jit = jax.jit(self._chi2_of_vec)
+        self._fit_data = self.resids._data()
+        key = (type(self).__name__, self._traced_free,
+               self.resids._structure_key())
+        self._lm_jit = _cc.shared_jit(
+            self._lm_solve, key=("lm.solve",) + key)
+        self._chi2_vec_jit = _cc.shared_jit(
+            self._chi2_of_vec, key=("lm.chi2",) + key)
 
-    def _chi2_of_vec(self, vec, base_values):
+    def _chi2_of_vec(self, vec, base_values, data):
         values = self._merged(base_values, vec)
-        resid_fn = self._lm_resid_fn(base_values)
+        resid_fn = self._lm_resid_fn(base_values, data)
         r = resid_fn(vec)
-        return jnp.sum((r / self._lm_sigma(values)) ** 2)
+        return jnp.sum((r / self._lm_sigma(values, data)) ** 2)
 
     # hooks the wideband subclass overrides with the stacked system
-    def _lm_resid_fn(self, base_values):
-        return self._resid_fn_of(base_values)
+    def _lm_resid_fn(self, base_values, data):
+        return self._resid_fn_of(base_values, data)
 
-    def _lm_sigma(self, values):
-        return self.resids.sigma_fn(values)
+    def _lm_sigma(self, values, data):
+        return self.resids.sigma_at(values, data)
 
-    def _lm_solve(self, vec, base_values, lam):
+    def _lm_solve(self, vec, base_values, lam, data):
         """One damped step at fixed lambda: (J^T W J + lam diag) d =
         -J^T W r on the whitened residuals."""
-        resid_fn = self._lm_resid_fn(base_values)
+        resid_fn = self._lm_resid_fn(base_values, data)
         values = self._merged(base_values, vec)
-        sigma = self._lm_sigma(values)
+        sigma = self._lm_sigma(values, data)
         r = resid_fn(vec)
         J = jax.jacfwd(resid_fn)(vec)
         w = 1.0 / sigma
@@ -101,11 +109,12 @@ class LMFitter(Fitter):
         cov = None
         self.converged = False
         for _ in range(maxiter):
-            dpar, chi2_old, cov = self._lm_jit(vec, base, lam)
+            dpar, chi2_old, cov = self._lm_jit(vec, base, lam,
+                                               self._fit_data)
             accepted = False
             for _try in range(self.max_tries):
                 chi2_new = float(
-                    self._chi2_vec_jit(vec + dpar, base)
+                    self._chi2_vec_jit(vec + dpar, base, self._fit_data)
                 )
                 if chi2_new < float(chi2_old):
                     vec = vec + dpar
@@ -113,7 +122,8 @@ class LMFitter(Fitter):
                     accepted = True
                     break
                 lam = lam * self.up
-                dpar, chi2_old, cov = self._lm_jit(vec, base, lam)
+                dpar, chi2_old, cov = self._lm_jit(vec, base, lam,
+                                                   self._fit_data)
             if not accepted:
                 self.converged = True
                 break
@@ -136,17 +146,20 @@ class PowellFitter(Fitter):
     PowellFitter, fitter.py:1902) — the escape hatch when the problem
     is too nonlinear for Gauss-Newton steps."""
 
-    def __init__(self, toas, model, residuals=None):
-        super().__init__(toas, model, residuals)
+    def __init__(self, toas, model, residuals=None, bucket=None):
+        super().__init__(toas, model, residuals, bucket=bucket)
         self._retrace()
 
     def _retrace(self):
         self._traced_free = tuple(self.model.free_timing_params)
-        self._chi2_jit = jax.jit(
-            lambda vec, base: self.resids.chi2_fn(
-                self._merged(base, vec)
-            )
-        )
+        self._fit_data = self.resids._data()
+        self._chi2_jit = _cc.shared_jit(
+            lambda vec, base, data: self.resids.chi2_at(
+                self._merged(base, vec), data
+            ),
+            key=("powell.chi2", self._traced_free,
+                 self.resids._structure_key()),
+            fn_token="powell.chi2")
 
     def fit_toas(self, maxiter=2000):
         from scipy.optimize import minimize
@@ -170,7 +183,7 @@ class PowellFitter(Fitter):
 
         def fun(z):
             return float(self._chi2_jit(jnp.asarray(x0 + z * scales),
-                                        base))
+                                        base, self._fit_data))
 
         res = minimize(fun, np.zeros_like(x0), method="Powell",
                        options={"maxiter": maxiter, "xtol": 1e-10})
@@ -186,14 +199,18 @@ class WidebandLMFitter(LMFitter):
     """Levenberg-Marquardt on the wideband stacked [time; DM] system
     (reference: WidebandLMFitter, fitter.py:2766)."""
 
-    def __init__(self, toas, model, residuals=None):
+    def __init__(self, toas, model, residuals=None, bucket=None):
         from pint_tpu.residuals import WidebandTOAResiduals
 
         if residuals is None:
+            if bucket is None:
+                bucket = _cc.bucketing_default()
+            if bucket:
+                toas = _cc.pad_toas(toas)
             residuals = WidebandTOAResiduals(toas, model)
-        super().__init__(toas, model, residuals=residuals)
+        super().__init__(toas, model, residuals=residuals, bucket=False)
 
-    def _lm_resid_fn(self, base_values):
+    def _lm_resid_fn(self, base_values, data):
         free = self._traced_free
         toa_r = self.resids.toa
         dm_r = self.resids.dm
@@ -203,14 +220,14 @@ class WidebandLMFitter(LMFitter):
             for i, name in enumerate(free):
                 values[name] = v[i]
             return jnp.concatenate(
-                [toa_r.time_resids_fn(values),
-                 dm_r.dm_resids_fn(values)]
+                [toa_r.time_resids_at(values, data["toa"]),
+                 dm_r.dm_resids_at(values, data["dm"])]
             )
 
         return resid_fn
 
-    def _lm_sigma(self, values):
+    def _lm_sigma(self, values, data):
         return jnp.concatenate(
-            [self.resids.toa.sigma_fn(values),
-             self.resids.dm.sigma_fn(values)]
+            [self.resids.toa.sigma_at(values, data["toa"]),
+             self.resids.dm.sigma_at(values, data["dm"])]
         )

@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pint_tpu import compile_cache as _cc
 from pint_tpu import flops as _flops
 from pint_tpu import telemetry
 from pint_tpu.bayesian import UniformPrior
@@ -122,6 +123,33 @@ class MCMCFitter:
             )
         return lnp + lnl
 
+    def _sampler_jit_key(self):
+        """Registry identity of this fitter's posterior: the chain
+        program bakes in the model structure, the base values, the
+        template, the weights and the priors — all fingerprinted, so a
+        second identically-configured MCMCFitter (or every chunk of an
+        autocorr run) reuses ONE compiled chain instead of retracing
+        the posterior per instance."""
+        def _prior_sig(p):
+            try:
+                items = vars(p).items()
+            except TypeError:  # __slots__ priors: fall back to repr
+                return repr(p)
+            return repr(sorted(
+                (k, v) for k, v in items
+                if isinstance(v, (int, float, str, bool))))
+
+        priors = [
+            (n, type(p).__name__, _prior_sig(p))
+            for n, p in sorted(self.priors.items())
+        ]
+        tpl = (np.asarray(self.template, dtype=np.float64)
+               if self._binned else np.asarray(self.template.params))
+        return ("mcmc.lnposterior",
+                _cc.model_structure_key(self.model),
+                tuple(self.param_names), self._n_template,
+                _cc.fingerprint((self._base, self.weights, tpl, priors)))
+
     # -- driver ---------------------------------------------------------------
     def lnlike_only(self, vec):
         """Photon likelihood without the prior terms (used by the
@@ -167,7 +195,7 @@ class MCMCFitter:
             )
         scales += [0.01] * self._n_template
         s = EnsembleSampler(self.lnposterior, nwalkers=nwalkers,
-                            seed=seed)
+                            seed=seed, jit_key=self._sampler_jit_key())
         x0 = s.initial_ball(center, np.array(scales))
         with span("mcmc.sample", nwalkers=nwalkers, nsteps=nsteps,
                   n_toa=len(self.toas), autocorr=autocorr) as sp:
@@ -278,8 +306,10 @@ class CompositeMCMCFitter:
                 (p.hi - p.lo) / 100.0 if isinstance(p, UniformPrior)
                 else p.sigma
             )
-        s = EnsembleSampler(self.lnposterior, nwalkers=nwalkers,
-                            seed=seed)
+        s = EnsembleSampler(
+            self.lnposterior, nwalkers=nwalkers, seed=seed,
+            jit_key=("mcmc.composite",) + tuple(
+                f._sampler_jit_key() for f in self.fitters))
         x0 = s.initial_ball(center, np.array(scales))
         with span("mcmc.sample", nwalkers=nwalkers, nsteps=nsteps,
                   composite=len(self.fitters)):

@@ -515,22 +515,27 @@ class PreparedModel:
         self._phase_jit = jax.jit(self._phase_raw)
 
     # -- noise interface ------------------------------------------------------
-    def scaled_sigma_fn(self, values):
+    def scaled_sigma_fn(self, values, batch=None, ctx=None):
         """Per-TOA uncertainty [s] after white-noise scaling (reference:
-        scaled_toa_uncertainty, timing_model.py:1644)."""
-        sigma = self.batch.error_s
+        scaled_toa_uncertainty, timing_model.py:1644).  batch/ctx
+        default to this dataset's; the fit hot path passes them as
+        dynamic jit arguments (compile_cache shared-trace contract)."""
+        batch = self.batch if batch is None else batch
+        ctx = self.ctx if ctx is None else ctx
+        sigma = batch.error_s
         for c in self.model.noise_components:
             sigma = c.scaled_sigma(
-                values, self.batch, self.ctx[type(c).__name__], sigma
+                values, batch, ctx[type(c).__name__], sigma
             )
         return sigma
 
-    def noise_weights_fn(self, values):
+    def noise_weights_fn(self, values, ctx=None):
         """Concatenated basis weights phi, aligned with noise_basis
         columns (reference: noise_model_basis_weight,
         timing_model.py:1696)."""
+        ctx = self.ctx if ctx is None else ctx
         parts = [
-            c.weights(values, self.ctx[type(c).__name__])
+            c.weights(values, ctx[type(c).__name__])
             for c in self._noise_basis_comps
         ]
         return jnp.concatenate(parts) if parts else jnp.zeros(0)
@@ -547,19 +552,22 @@ class PreparedModel:
         return out
 
     # -- wideband DM interface ------------------------------------------------
-    def total_dm_fn(self, values):
+    def total_dm_fn(self, values, batch=None, ctx=None):
         """Modeled DM [pc cm^-3] at each TOA: the sum of every
         component's ``dm_value`` contribution (reference:
         TimingModel.total_dm via dm_value_funcs)."""
-        return gated_dm_sum(self.model, values, self.batch, self.ctx)
+        return gated_dm_sum(self.model, values,
+                            self.batch if batch is None else batch,
+                            self.ctx if ctx is None else ctx)
 
-    def scaled_dm_sigma_fn(self, values, dm_sigma):
+    def scaled_dm_sigma_fn(self, values, dm_sigma, ctx=None):
         """Wideband DM uncertainties after DMEFAC/DMEQUAD scaling
         (reference: scaled_dm_uncertainty)."""
+        ctx = self.ctx if ctx is None else ctx
         for c in self.model.noise_components:
             f = getattr(c, "scaled_dm_sigma", None)
             if f is not None:
-                dm_sigma = f(values, self.ctx[type(c).__name__], dm_sigma)
+                dm_sigma = f(values, ctx[type(c).__name__], dm_sigma)
         return dm_sigma
 
     # pure function of values (pytree dict of f64 scalars)
@@ -594,13 +602,20 @@ class PreparedModel:
                 frac = frac + (ph if gate is None else ph * gate)
         return n, frac
 
-    def _phase_raw(self, values):
-        n, frac = self._phase_sum(values, self.batch, self.ctx)
-        if self.tzr_batch is not None:
-            tn, tfrac = self._phase_sum(values, self.tzr_batch, self.tzr_ctx)
+    def _phase_raw_at(self, values, batch, ctx, tzr_batch, tzr_ctx):
+        """TZR-referenced (n, frac) with the dataset passed explicitly —
+        the pure-function form the compile-cache shared traces use
+        (batch/ctx arrive as jit arguments, not closure constants)."""
+        n, frac = self._phase_sum(values, batch, ctx)
+        if tzr_batch is not None:
+            tn, tfrac = self._phase_sum(values, tzr_batch, tzr_ctx)
             n = n - tn[0]
             frac = frac - tfrac[0]
         return fp.renorm_phase(n, frac)
+
+    def _phase_raw(self, values):
+        return self._phase_raw_at(values, self.batch, self.ctx,
+                                  self.tzr_batch, self.tzr_ctx)
 
     # -- public API ----------------------------------------------------------
     def delay(self, values=None):

@@ -23,8 +23,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pint_tpu import compile_cache as _cc
 from pint_tpu import flops as _flops
 from pint_tpu import telemetry
+from pint_tpu.compile_cache import merge_ctx as _merge_ctx
 from pint_tpu.fitter import wls_gn_solve
 from pint_tpu.models.timing_model import PreparedModel
 from pint_tpu.residuals import Residuals
@@ -162,11 +164,8 @@ def _stack_ctxs(ctxs):
     return arrays, static
 
 
-def _merge_ctx(arrays, static):
-    return {
-        comp: {**static.get(comp, {}), **arrays[comp]}
-        for comp in arrays
-    }
+# ctx reassembly is shared with the single-fitter path
+# (compile_cache.merge_ctx) so the two split/merge rules cannot drift
 
 
 #: placeholder values for parameters whose neutral default would divide
@@ -570,6 +569,67 @@ class PTABatch:
         _, cov, _, chi2 = gls_normal_solve(r, J, err, U_wb, phi)
         return vec, chi2, cov
 
+    # -- batched-fit construction (memoized; registry-shared) -----------------
+    def _structure_key(self):
+        """Everything the batched traces bake in: the superset model
+        structure, free-name union, batch geometry, and the static ctx
+        parts — all per-pulsar DATA travels as vmapped arguments."""
+        got = getattr(self, "_structure_key_cached", None)
+        if got is None:
+            got = self._structure_key_cached = repr((
+                _cc.model_structure_key(self.prepareds[0].model),
+                tuple(self.free_names), self.n_pulsars, self.n_max,
+                self.tzr_batch is not None, self.tzr_ctx is not None,
+                _cc.static_ctx_key(self.static_ctx),
+                _cc.static_ctx_key(self.static_tzr_ctx),
+            ))
+        return got
+
+    def _build_fit(self, kind, maxiter):
+        tzr_ax = 0 if self.tzr_batch is not None else None
+        tcx_ax = 0 if self.tzr_ctx is not None else None
+        if kind == "wls":
+            return jax.vmap(
+                lambda v, b, bt, c, tb, tc, m, fm: self._fit_one(
+                    v, b, bt, c, tb, tc, m, fm, maxiter
+                ),
+                in_axes=(0, 0, 0, 0, tzr_ax, tcx_ax, 0, 0),
+            )
+        if kind == "gls":
+            return jax.vmap(
+                lambda v, b, bt, c, tb, tc, m, fm, uu, ph:
+                self._fit_one_gls(v, b, bt, c, tb, tc, m, fm, uu, ph,
+                                  maxiter),
+                in_axes=(0, 0, 0, 0, tzr_ax, tcx_ax, 0, 0, 0, 0),
+            )
+        return jax.vmap(
+            lambda v, b, bt, c, tb, tc, m, fm, uu, ph, dd, de, dv:
+            self._fit_one_wb(v, b, bt, c, tb, tc, m, fm, uu, ph,
+                             dd, de, dv, maxiter),
+            in_axes=(0, 0, 0, 0, tzr_ax, tcx_ax, 0, 0, 0, 0, 0, 0, 0),
+        )
+
+    def _batched_fit_jit(self, kind, maxiter):
+        """ONE jitted batched fit per (kind, maxiter), memoized on the
+        instance and shared across same-structure batches through the
+        process registry.  This replaces the old per-call
+        ``jax.jit(lambda *a: fit(*a))`` — a fresh jitted callable (and
+        a full retrace + XLA compile of the entire PTA program) on
+        EVERY fit invocation."""
+        cache = getattr(self, "_fit_jit_cache", None)
+        if cache is None:
+            cache = self._fit_jit_cache = {}
+        got = cache.get((kind, maxiter))
+        if got is None:
+            got = cache[(kind, maxiter)] = _cc.shared_jit(
+                self._build_fit(kind, maxiter),
+                key=("pta.batched", kind, int(maxiter),
+                     self._structure_key()),
+                fn_token="pta.batched_fit")
+        else:
+            telemetry.counter_add("pta.fit_jit_cache_hits")
+        return got
+
     def fit_wideband(self, maxiter=3, mesh=None):
         """Batched wideband fit: stacked [time; DM] residuals per
         pulsar, the whole (possibly mixed narrowband+wideband) PTA as
@@ -578,15 +638,7 @@ class PTABatch:
         semantics match fit_wls."""
         U, phi = self._gather_noise()
         dm_data, dm_error, dm_valid = self._gather_dm()
-        fit = jax.vmap(
-            lambda v, b, bt, c, tb, tc, m, fm, uu, ph, dd, de, dv:
-            self._fit_one_wb(v, b, bt, c, tb, tc, m, fm, uu, ph,
-                             dd, de, dv, maxiter),
-            in_axes=(0, 0, 0, 0,
-                     0 if self.tzr_batch is not None else None,
-                     0 if self.tzr_ctx is not None else None,
-                     0, 0, 0, 0, 0, 0, 0),
-        )
+        fit = self._batched_fit_jit("wideband", maxiter)
         return self._run_batched(
             fit, (self.values0, self.base_values, self.batch, self.ctx,
                   self.tzr_batch, self.tzr_ctx, self.valid,
@@ -600,23 +652,16 @@ class PTABatch:
         replacing the reference's per-pulsar GLSFitter process fan-out
         (gridutils.py:166-391).  Sharding semantics match fit_wls."""
         U, phi = self._gather_noise()
-        fit = jax.vmap(
-            lambda v, b, bt, c, tb, tc, m, fm, uu, ph: self._fit_one_gls(
-                v, b, bt, c, tb, tc, m, fm, uu, ph, maxiter
-            ),
-            in_axes=(0, 0, 0, 0,
-                     0 if self.tzr_batch is not None else None,
-                     0 if self.tzr_ctx is not None else None,
-                     0, 0, 0, 0),
-        )
+        fit = self._batched_fit_jit("gls", maxiter)
         return self._run_batched(
             fit, (self.values0, self.base_values, self.batch, self.ctx,
                   self.tzr_batch, self.tzr_ctx, self.valid,
                   self.free_mask, U, phi), mesh)
 
     def _run_batched(self, fit, args, mesh):
-        """jit (optionally mesh-sharded over the pulsar axis), run, and
-        write fitted values back (only genuinely-free params)."""
+        """Run the jitted batched fit (optionally mesh-sharded over the
+        pulsar axis) and write fitted values back (only genuinely-free
+        params)."""
         with span("pta.batched_fit", n_pulsars=self.n_pulsars,
                   n_max=self.n_max, n_free=len(self.free_names),
                   sharded=mesh is not None):
@@ -641,7 +686,7 @@ class PTABatch:
             args = tuple(
                 shard_tree(a) if a is not None else None for a in args
             )
-        vec, chi2, cov = jax.jit(lambda *a: fit(*a))(*args)
+        vec, chi2, cov = fit(*args)
         vec_np = np.asarray(vec)
         telemetry.record_transfer(vec_np)
         telemetry.counter_add(
@@ -681,15 +726,7 @@ class PTABatch:
 
         With a mesh, the pulsar axis is sharded over devices
         (NamedSharding) — the multi-chip path the driver dry-runs."""
-        fit = jax.vmap(
-            lambda v, b, bt, c, tb, tc, m, fm: self._fit_one(
-                v, b, bt, c, tb, tc, m, fm, maxiter
-            ),
-            in_axes=(0, 0, 0, 0,
-                     0 if self.tzr_batch is not None else None,
-                     0 if self.tzr_ctx is not None else None,
-                     0, 0),
-        )
+        fit = self._batched_fit_jit("wls", maxiter)
         return self._run_batched(
             fit, (self.values0, self.base_values, self.batch, self.ctx,
                   self.tzr_batch, self.tzr_ctx, self.valid,

@@ -13,14 +13,26 @@ chi^2 dispatch mirrors the reference: plain WLS sum when the model has
 no correlated noise; Woodbury over the low-rank noise basis otherwise,
 with a unit basis column at weight 1e40 absorbing the subtracted mean
 (reference :567-636, the 1e40 column at :583-585).
+
+Compile-amortization contract (:mod:`pint_tpu.compile_cache`): every
+evaluation function exists in ``*_at(values, data)`` form, where
+``data`` is the dataset pytree (:meth:`Residuals._data` — TOA batch,
+prepare-time ctx arrays, noise basis, pulse numbers) passed as a
+DYNAMIC jit argument.  A trace of an ``_at`` function bakes in only
+model *structure* (:meth:`Residuals._structure_key`), so the process
+jit registry can share one trace — and one XLA executable — across
+fitter instances and across same-bucket datasets.  The classic
+closure-style ``*_fn(values)`` functions remain as thin delegates that
+bind this instance's concrete data (the grid path still wants data
+constant-folded into its one big program).
 """
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pint_tpu import compile_cache as _cc
 from pint_tpu import telemetry
 from pint_tpu.linalg import woodbury_chi2_logdet
 from pint_tpu.models.timing_model import PreparedModel, TimingModel
@@ -97,69 +109,139 @@ class Residuals:
         if self.subtract_mean:
             U = jnp.concatenate([U, jnp.ones((U.shape[0], 1))], axis=1)
         self._U_ext = U
-        # jit wrappers are built lazily on first use: a 14-component GLS
-        # model costs tens of seconds of XLA compile per function on
-        # CPU, and most callers touch only one of the four
+        # TOA-count bucketing (compile_cache.pad_toas): sentinel rows
+        # beyond n_real carry ~zero weight; dof/NTOA/lnlike accounting
+        # uses the real count, and the lnlike logdet masks pad rows
+        # (their log sigma would otherwise bias noise fitting)
+        self.n_real = getattr(toas, "n_real", None) or len(toas)
+        # bucketed datasets ALWAYS carry the mask (all-true at a bucket
+        # boundary) so every member of a bucket shares one trace
+        # structure; unbucketed datasets carry none
+        self._pad_valid = None
+        if getattr(toas, "n_real", None) is not None:
+            self._pad_valid = jnp.asarray(
+                np.arange(len(toas)) < self.n_real)
+        # dataset pytree split: array leaves travel as jit arguments,
+        # static python leaves stay closed over (and keyed)
+        self._ctx_dyn, self._ctx_static = _cc.split_ctx(self.prepared.ctx)
+        self._tzr_ctx_dyn, self._tzr_ctx_static = _cc.split_ctx(
+            self.prepared.tzr_ctx)
+        self._data_cached = None
+        self._structure_key_cached = None
+        # jit wrappers are resolved lazily through the process-level
+        # shared registry: a 14-component GLS model costs tens of
+        # seconds of XLA compile per function on CPU, most callers
+        # touch only one of the four, and a second same-structure
+        # Residuals must reuse the first one's traces
         self._jit_cache: dict = {}
+
+    # -- dataset pytree / structural identity --------------------------------
+    def _data(self):
+        """The dataset as a pytree of arrays — the dynamic argument of
+        every shared-trace evaluation function."""
+        if self._data_cached is None:
+            self._data_cached = {
+                "batch": self.prepared.batch,
+                "ctx": self._ctx_dyn,
+                "tzr_batch": self.prepared.tzr_batch,
+                "tzr_ctx": self._tzr_ctx_dyn,
+                "U_ext": self._U_ext,
+                "pn": self._pulse_numbers,
+                "dpn": self._delta_pn,
+                "valid": self._pad_valid,
+                # dynamic, NOT read from self inside a trace: a shared
+                # trace serves instances with different real counts
+                # (both across plain datasets of different lengths and
+                # across members of one bucket)
+                "n_real": np.float64(self.n_real),
+            }
+        return self._data_cached
+
+    def _structure_key(self):
+        """Everything a trace of the ``*_at`` functions bakes in."""
+        if self._structure_key_cached is None:
+            self._structure_key_cached = repr((
+                _cc.model_structure_key(self.model),
+                self.subtract_mean, self.use_weighted_mean,
+                self.track_mode,
+                self._pulse_numbers is not None,
+                self._delta_pn is not None,
+                self._pad_valid is not None,
+                _cc.static_ctx_key(self._ctx_static),
+                _cc.static_ctx_key(self._tzr_ctx_static),
+            ))
+        return self._structure_key_cached
+
+    def _ctx_at(self, data):
+        return _cc.merge_ctx(data["ctx"], self._ctx_static)
+
+    def _tzr_ctx_at(self, data):
+        if data["tzr_ctx"] is None:
+            return None
+        return _cc.merge_ctx(data["tzr_ctx"], self._tzr_ctx_static)
 
     def _jitted(self, name, fn):
         got = self._jit_cache.get(name)
         if got is None:
             telemetry.counter_add("residuals.jit_cache_misses")
-            got = self._jit_cache[name] = jax.jit(fn)
+            got = self._jit_cache[name] = _cc.shared_jit(
+                fn, key=("residuals", name, self._structure_key()))
         else:
             telemetry.counter_add("residuals.jit_cache_hits")
         return got
 
     @property
     def _phase_resids_jit(self):
-        return self._jitted("phase", self.phase_resids_fn)
+        return self._jitted("phase", self.phase_resids_at)
 
     @property
     def _time_resids_jit(self):
-        return self._jitted("time", self.time_resids_fn)
+        return self._jitted("time", self.time_resids_at)
 
     @property
     def _chi2_jit(self):
-        return self._jitted("chi2", self.chi2_fn)
+        return self._jitted("chi2", self.chi2_at)
 
     @property
     def _lnlike_jit(self):
-        return self._jitted("lnlike", self.lnlikelihood_fn)
+        return self._jitted("lnlike", self.lnlikelihood_at)
 
-    # -- pure functions (values pytree -> arrays), jit-safe ------------------
-    def sigma_fn(self, values):
+    # -- pure functions of (values, data), jit-safe and shareable ------------
+    def sigma_at(self, values, data):
         """Noise-scaled per-TOA uncertainty [s]."""
-        return self.prepared.scaled_sigma_fn(values)
+        return self.prepared.scaled_sigma_fn(
+            values, batch=data["batch"], ctx=self._ctx_at(data))
 
-    def phase_resids_fn(self, values):
-        n, frac = self.prepared._phase_raw(values)
+    def phase_resids_at(self, values, data):
+        n, frac = self.prepared._phase_raw_at(
+            values, data["batch"], self._ctx_at(data),
+            data["tzr_batch"], self._tzr_ctx_at(data))
         if self._pulse_numbers is not None:
             # TRACK -2 semantics (reference residuals.py:368-392):
             # residual = absolute model phase - assigned pulse number;
             # integer arithmetic first so 4e11-turn counts stay exact
-            resid = (n - self._pulse_numbers).astype(jnp.float64) + frac
+            resid = (n - data["pn"]).astype(jnp.float64) + frac
             if self._delta_pn is not None:
-                resid = resid + self._delta_pn
+                resid = resid + data["dpn"]
         else:
             resid = frac
             if self._delta_pn is not None:
                 # PHASE commands shift the phase before the nearest-
                 # integer assignment (reference residuals.py:394-406)
-                resid = resid + self._delta_pn
+                resid = resid + data["dpn"]
                 resid = resid - jnp.round(resid)
         if self.subtract_mean:
             if self.use_weighted_mean:
-                w = 1.0 / self.sigma_fn(values) ** 2
+                w = 1.0 / self.sigma_at(values, data) ** 2
                 resid = resid - weighted_mean_phase(resid, w)
             else:
                 resid = resid - jnp.mean(resid)
         return resid
 
-    def time_resids_fn(self, values):
-        return self.phase_resids_fn(values) / values["F0"]
+    def time_resids_at(self, values, data):
+        return self.phase_resids_at(values, data) / values["F0"]
 
-    def _noise_basis_phi(self, values):
+    def _noise_basis_phi_at(self, values, data):
         """(U, phi) for the Woodbury paths, with the mean-offset column
         appended when applicable.
 
@@ -170,34 +252,76 @@ class Residuals:
         constant-folding alarm fired on the f64[8161,402] pad), and a
         lazily-cached version leaks a tracer — jnp.ones under an
         active trace is staged, not concrete."""
-        phi = self.prepared.noise_weights_fn(values)
+        phi = self.prepared.noise_weights_fn(values, ctx=self._ctx_at(data))
         if self.subtract_mean:
             phi = jnp.concatenate([phi, jnp.array([MEAN_OFFSET_WEIGHT])])
-        return self._U_ext, phi
+        return data["U_ext"], phi
 
-    def chi2_fn(self, values):
-        r = self.time_resids_fn(values)
-        sigma = self.sigma_fn(values)
+    def chi2_at(self, values, data):
+        r = self.time_resids_at(values, data)
+        sigma = self.sigma_at(values, data)
         if not self.model.has_correlated_errors:
             return jnp.sum((r / sigma) ** 2)
-        U, phi = self._noise_basis_phi(values)
+        U, phi = self._noise_basis_phi_at(values, data)
         chi2, _ = woodbury_chi2_logdet(r, sigma, U, phi)
         return chi2
 
-    def lnlikelihood_fn(self, values):
+    def lnlikelihood_at(self, values, data):
         """Gaussian log-likelihood of the residuals under the full noise
         covariance (reference residuals.py:713); differentiable wrt
-        noise parameters for gradient-based noise fitting."""
-        r = self.time_resids_fn(values)
-        sigma = self.sigma_fn(values)
-        n = r.shape[0]
+        noise parameters for gradient-based noise fitting.  Bucketing
+        pad rows are masked out of the white logdet (their
+        EFAC-dependent log sigma would otherwise bias noise fits); the
+        2*pi normalization counts real TOAs only."""
+        r = self.time_resids_at(values, data)
+        sigma = self.sigma_at(values, data)
+        valid = data["valid"]
+        n = data["n_real"]
         if not self.model.has_correlated_errors:
             chi2 = jnp.sum((r / sigma) ** 2)
-            logdet = 2.0 * jnp.sum(jnp.log(sigma))
+            logs = jnp.log(sigma)
+            if valid is not None:
+                logs = jnp.where(valid, logs, 0.0)
+            logdet = 2.0 * jnp.sum(logs)
         else:
-            U, phi = self._noise_basis_phi(values)
-            chi2, logdet = woodbury_chi2_logdet(r, sigma, U, phi)
+            U, phi = self._noise_basis_phi_at(values, data)
+            chi2, logdet = woodbury_chi2_logdet(r, sigma, U, phi,
+                                                valid=valid)
         return -0.5 * (chi2 + logdet) - 0.5 * n * jnp.log(2.0 * jnp.pi)
+
+    # -- classic closure forms (this dataset constant-folded) ----------------
+    def sigma_fn(self, values):
+        """Noise-scaled per-TOA uncertainty [s]."""
+        return self.sigma_at(values, self._data())
+
+    def phase_resids_fn(self, values):
+        return self.phase_resids_at(values, self._data())
+
+    def time_resids_fn(self, values):
+        return self.time_resids_at(values, self._data())
+
+    def _noise_basis_phi(self, values):
+        return self._noise_basis_phi_at(values, self._data())
+
+    def chi2_fn(self, values):
+        return self.chi2_at(values, self._data())
+
+    def lnlikelihood_fn(self, values):
+        return self.lnlikelihood_at(values, self._data())
+
+    def warm_compile(self):
+        """AOT-compile the accessor programs a fit's epilogue touches
+        (chi^2 and time residuals) — the other half of the cold-start
+        cost next to the fitter step itself.  Returns compile
+        seconds."""
+        values = self._values()
+        data = self._data()
+        total = 0.0
+        for name, fn in (("chi2", self.chi2_at),
+                         ("time", self.time_resids_at)):
+            lowered = self._jitted(name, fn).lower(values, data)
+            total += _cc.warm_timed(lowered.compile)
+        return total
 
     # -- convenience numpy accessors -----------------------------------------
     def _values(self, values=None):
@@ -207,26 +331,29 @@ class Residuals:
     def phase_resids(self):
         with span("residuals.calc", kind="phase",
                   n_toa=len(self.toas)):
-            out = np.asarray(self._phase_resids_jit(self._values()))
+            out = np.asarray(
+                self._phase_resids_jit(self._values(), self._data()))
         telemetry.record_transfer(out)
         return out
 
     @property
     def time_resids(self):
         with span("residuals.calc", kind="time", n_toa=len(self.toas)):
-            out = np.asarray(self._time_resids_jit(self._values()))
+            out = np.asarray(
+                self._time_resids_jit(self._values(), self._data()))
         telemetry.record_transfer(out)
         return out
 
     @property
     def chi2(self):
         with span("residuals.calc", kind="chi2", n_toa=len(self.toas)):
-            return float(self._chi2_jit(self._values()))
+            return float(self._chi2_jit(self._values(), self._data()))
 
     def lnlikelihood(self, values=None):
         with span("residuals.calc", kind="lnlike",
                   n_toa=len(self.toas)):
-            return float(self._lnlike_jit(self._values(values)))
+            return float(
+                self._lnlike_jit(self._values(values), self._data()))
 
     @property
     def scaled_errors(self):
@@ -235,7 +362,7 @@ class Residuals:
 
     @property
     def dof(self):
-        return len(self.toas) - len(self.model.free_params) - int(
+        return self.n_real - len(self.model.free_params) - int(
             self.subtract_mean
         )
 
@@ -262,7 +389,8 @@ class Residuals:
         values = self._values()
         ecorr_err2 = np.asarray(comp.weights(values, ctx))
         if use_noise_model:
-            err = np.asarray(self._jitted("sigma", self.sigma_fn)(values))
+            err = np.asarray(
+                self._jitted("sigma", self.sigma_at)(values, self._data()))
         else:
             err = np.asarray(self.toas.error_us) * 1e-6
             ecorr_err2 = ecorr_err2 * 0.0
@@ -312,27 +440,79 @@ class WidebandDMResiduals:
         self.dm_data = jnp.asarray(np.where(valid, dm, 0.0))
         self.dm_error = jnp.asarray(np.where(valid, dme, 1.0))
         self.subtract_mean = subtract_mean
-        self._resids_jit = jax.jit(self.dm_resids_fn)
-        self._chi2_jit = jax.jit(self.chi2_fn)
+        # bucketing: pad rows carry sentinel -pp_dme (zero weight); the
+        # dof counts only real measurements
+        self.n_real_toas = getattr(toas, "n_real", None) or len(toas)
+        self._n_valid_real = int(
+            np.count_nonzero(valid[: self.n_real_toas]))
+        self._ctx_dyn, self._ctx_static = _cc.split_ctx(self.prepared.ctx)
+        self._data_cached = None
+        self._structure_key_cached = None
+        self._jit_cache: dict = {}
+
+    # -- dataset pytree / structural identity --------------------------------
+    def _data(self):
+        if self._data_cached is None:
+            self._data_cached = {
+                "batch": self.prepared.batch,
+                "ctx": self._ctx_dyn,
+                "dm_data": self.dm_data,
+                "dm_error": self.dm_error,
+                "valid_idx": self.valid_idx,
+            }
+        return self._data_cached
+
+    def _structure_key(self):
+        if self._structure_key_cached is None:
+            self._structure_key_cached = repr((
+                "wb_dm", _cc.model_structure_key(self.model),
+                self.subtract_mean,
+                _cc.static_ctx_key(self._ctx_static),
+            ))
+        return self._structure_key_cached
+
+    def _ctx_at(self, data):
+        return _cc.merge_ctx(data["ctx"], self._ctx_static)
+
+    def _jitted(self, name, fn):
+        got = self._jit_cache.get(name)
+        if got is None:
+            telemetry.counter_add("residuals.jit_cache_misses")
+            got = self._jit_cache[name] = _cc.shared_jit(
+                fn, key=("residuals", name, self._structure_key()))
+        else:
+            telemetry.counter_add("residuals.jit_cache_hits")
+        return got
 
     # -- pure functions ------------------------------------------------------
-    def sigma_fn(self, values):
+    def sigma_at(self, values, data):
         """DMEFAC/DMEQUAD-scaled DM uncertainties, valid TOAs only."""
-        sig = self.prepared.scaled_dm_sigma_fn(values, self.dm_error)
-        return sig[self.valid_idx]
+        sig = self.prepared.scaled_dm_sigma_fn(
+            values, data["dm_error"], ctx=self._ctx_at(data))
+        return sig[data["valid_idx"]]
 
-    def dm_resids_fn(self, values):
-        model_dm = self.prepared.total_dm_fn(values)
-        r = (self.dm_data - model_dm)[self.valid_idx]
+    def dm_resids_at(self, values, data):
+        model_dm = self.prepared.total_dm_fn(
+            values, batch=data["batch"], ctx=self._ctx_at(data))
+        r = (data["dm_data"] - model_dm)[data["valid_idx"]]
         if self.subtract_mean:
-            sig = self.sigma_fn(values)
+            sig = self.sigma_at(values, data)
             w = 1.0 / sig**2
             r = r - jnp.sum(r * w) / jnp.sum(w)
         return r
 
+    def chi2_at(self, values, data):
+        r = self.dm_resids_at(values, data)
+        return jnp.sum((r / self.sigma_at(values, data)) ** 2)
+
+    def sigma_fn(self, values):
+        return self.sigma_at(values, self._data())
+
+    def dm_resids_fn(self, values):
+        return self.dm_resids_at(values, self._data())
+
     def chi2_fn(self, values):
-        r = self.dm_resids_fn(values)
-        return jnp.sum((r / self.sigma_fn(values)) ** 2)
+        return self.chi2_at(values, self._data())
 
     # -- numpy accessors -----------------------------------------------------
     def _values(self, values=None):
@@ -340,11 +520,13 @@ class WidebandDMResiduals:
 
     @property
     def dm_resids(self):
-        return np.asarray(self._resids_jit(self._values()))
+        return np.asarray(self._jitted("dm_resids", self.dm_resids_at)(
+            self._values(), self._data()))
 
     @property
     def chi2(self):
-        return float(self._chi2_jit(self._values()))
+        return float(self._jitted("chi2", self.chi2_at)(
+            self._values(), self._data()))
 
     @property
     def scaled_errors(self):
@@ -352,7 +534,7 @@ class WidebandDMResiduals:
 
     @property
     def dof(self):
-        return int(np.count_nonzero(self.valid))
+        return self._n_valid_real
 
     def rms_weighted(self):
         r = self.dm_resids
@@ -377,22 +559,50 @@ class WidebandTOAResiduals:
         self.toa = Residuals(toas, prepared, subtract_mean=subtract_mean,
                              track_mode=track_mode)
         self.dm = WidebandDMResiduals(toas, prepared)
-        self._chi2_jit = jax.jit(self.chi2_fn)
+        self.n_real = self.toa.n_real
+        self._jit_cache: dict = {}
+
+    def _data(self):
+        return {"toa": self.toa._data(), "dm": self.dm._data()}
+
+    def _structure_key(self):
+        return repr(("wb", self.toa._structure_key(),
+                     self.dm._structure_key()))
+
+    def chi2_at(self, values, data):
+        return (self.toa.chi2_at(values, data["toa"])
+                + self.dm.chi2_at(values, data["dm"]))
 
     def chi2_fn(self, values):
-        return self.toa.chi2_fn(values) + self.dm.chi2_fn(values)
+        return self.chi2_at(values, self._data())
+
+    def warm_compile(self):
+        """AOT-compile the wideband fit epilogue: the stacked chi^2
+        plus the time-block accessors (see Residuals.warm_compile)."""
+        got = self._jit_cache.get("chi2")
+        if got is None:
+            got = self._jit_cache["chi2"] = _cc.shared_jit(
+                self.chi2_at, key=("residuals", "chi2",
+                                   self._structure_key()))
+        lowered = got.lower(self._values(), self._data())
+        return _cc.warm_timed(lowered.compile) + self.toa.warm_compile()
 
     def _values(self, values=None):
         return self.prepared._values_pytree(values)
 
     @property
     def chi2(self):
-        return float(self._chi2_jit(self._values()))
+        got = self._jit_cache.get("chi2")
+        if got is None:
+            got = self._jit_cache["chi2"] = _cc.shared_jit(
+                self.chi2_at, key=("residuals", "chi2",
+                                   self._structure_key()))
+        return float(got(self._values(), self._data()))
 
     @property
     def dof(self):
         return (
-            len(self.toas) + self.dm.dof
+            self.n_real + self.dm.dof
             - len(self.model.free_params) - int(self.toa.subtract_mean)
         )
 

@@ -67,13 +67,23 @@ def _stretch_half(key, active, other, lnp_active, lnpost_v, a):
     return new, new_lnp, accept
 
 
-def run_mcmc(lnpost, x0, nsteps, key=None, a=2.0, thin=1):
+def run_mcmc(lnpost, x0, nsteps, key=None, a=2.0, thin=1, jit_key=None):
     """Run an ensemble chain.
 
     lnpost: f(vec[ndim]) -> scalar log-posterior (jax-traceable).
     x0: (nwalkers, ndim) initial walker positions (nwalkers even).
     Returns (chain (nsteps//thin, nwalkers, ndim), lnp, acceptance_rate).
-    """
+
+    The whole chain is ONE jitted scan, resolved through the process
+    jit registry (compile_cache.shared_jit) keyed on the posterior's
+    identity — by default ``lnpost`` itself (bound methods of the same
+    object compare equal, so every chunk of an autocorr run and every
+    re-run on the same sampler reuses one trace instead of recompiling
+    the full chain program per call), or an explicit ``jit_key`` when
+    the caller can vouch for a broader identity (MCMCFitter passes a
+    content fingerprint so two identically-configured fitters share)."""
+    from pint_tpu import compile_cache as _cc
+
     x0 = jnp.asarray(x0, dtype=jnp.float64)
     nw = x0.shape[0]
     if nw % 2:
@@ -83,26 +93,32 @@ def run_mcmc(lnpost, x0, nsteps, key=None, a=2.0, thin=1):
     lnpost_v = jax.vmap(lnpost)
     half = nw // 2
 
-    def step(carry, k):
-        x, lnp = carry
-        k1, k2 = jax.random.split(k)
-        first, second = x[:half], x[half:]
-        lnp1, lnp2 = lnp[:half], lnp[half:]
-        first, lnp1, acc1 = _stretch_half(
-            k1, first, second, lnp1, lnpost_v, a
-        )
-        second, lnp2, acc2 = _stretch_half(
-            k2, second, first, lnp2, lnpost_v, a
-        )
-        x = jnp.concatenate([first, second])
-        lnp = jnp.concatenate([lnp1, lnp2])
-        acc = jnp.concatenate([acc1, acc2])
-        return (x, lnp), (x, lnp, jnp.mean(acc))
+    def scan_chain(x0, keys):
+        def step(carry, k):
+            x, lnp = carry
+            k1, k2 = jax.random.split(k)
+            first, second = x[:half], x[half:]
+            lnp1, lnp2 = lnp[:half], lnp[half:]
+            first, lnp1, acc1 = _stretch_half(
+                k1, first, second, lnp1, lnpost_v, a
+            )
+            second, lnp2, acc2 = _stretch_half(
+                k2, second, first, lnp2, lnpost_v, a
+            )
+            x = jnp.concatenate([first, second])
+            lnp = jnp.concatenate([lnp1, lnp2])
+            acc = jnp.concatenate([acc1, acc2])
+            return (x, lnp), (x, lnp, jnp.mean(acc))
 
+        return jax.lax.scan(step, (x0, lnpost_v(x0)), keys)
+
+    # nw/a are baked into the stored closure — they must be part of
+    # the key, not left to aval-driven retracing of a stale closure
+    runner = _cc.shared_jit(
+        scan_chain, key=("sampler.run_mcmc", nw, float(a)),
+        fn_token=jit_key if jit_key is not None else lnpost)
     keys = jax.random.split(key, nsteps)
-    (xf, lnpf), (chain, lnps, accs) = jax.lax.scan(
-        step, (x0, lnpost_v(x0)), keys
-    )
+    (xf, lnpf), (chain, lnps, accs) = runner(x0, keys)
     if thin > 1:
         chain = chain[::thin]
         lnps = lnps[::thin]
@@ -114,10 +130,11 @@ class EnsembleSampler:
     (reference: EmceeSampler, sampler.py:60): hold (lnpost, nwalkers),
     initialize walkers from a ball or from priors, run, expose chains."""
 
-    def __init__(self, lnpost, nwalkers=32, seed=0):
+    def __init__(self, lnpost, nwalkers=32, seed=0, jit_key=None):
         self.lnpost = lnpost
         self.nwalkers = int(nwalkers)
         self.key = jax.random.PRNGKey(seed)
+        self.jit_key = jit_key  # registry identity override (run_mcmc)
         self.chain = None
         self.lnprob = None
         self.acceptance = None
@@ -135,7 +152,8 @@ class EnsembleSampler:
     def run_mcmc(self, x0, nsteps, thin=1):
         self.key, sub = jax.random.split(self.key)
         self.chain, self.lnprob, self.acceptance = run_mcmc(
-            self.lnpost, x0, int(nsteps), key=sub, thin=thin
+            self.lnpost, x0, int(nsteps), key=sub, thin=thin,
+            jit_key=self.jit_key
         )
         return self.chain
 
@@ -158,7 +176,8 @@ class EnsembleSampler:
         while total < maxsteps:
             step = int(min(chunk, maxsteps - total))
             self.key, sub = jax.random.split(self.key)
-            chain, lnprob, acc = run_mcmc(self.lnpost, x, step, key=sub)
+            chain, lnprob, acc = run_mcmc(self.lnpost, x, step, key=sub,
+                                          jit_key=self.jit_key)
             chains.append(np.asarray(chain))
             lnprobs.append(np.asarray(lnprob))
             accs.append((float(np.mean(np.asarray(acc))), step))

@@ -1,0 +1,105 @@
+"""pintwarm: AOT-warm the persistent XLA compilation cache.
+
+``pintwarm`` ``lower().compile()``s the standard fit shapes (or a real
+dataset's shapes via ``--par/--tim``) into the on-disk compilation
+cache (:mod:`pint_tpu.compile_cache`), so production processes start
+with their fit executables on disk instead of paying a 30-second XLA
+compile on the first request.  The offline half of the
+compile-amortization story; the online half is the in-process shared
+jit registry plus TOA-count bucketing (``--no-bucket`` to warm exact
+sizes instead of bucketed ones).
+
+Examples::
+
+    pintwarm                           # standard WLS+GLS shapes
+    pintwarm --toas 500,1000,5000 --kinds gls,downhill_gls
+    pintwarm --par J0613.par --tim J0613.tim
+    PINT_TPU_CACHE_DIR=/fast/cache pintwarm
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+__all__ = ["main"]
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="pintwarm",
+        description="Pre-populate the persistent XLA compile cache "
+                    "with the standard pulsar-fit shapes")
+    p.add_argument("--toas", default="500,1000",
+                   help="comma-separated TOA counts to warm "
+                        "(default 500,1000; bucketed unless "
+                        "--no-bucket)")
+    p.add_argument("--kinds", default="wls,gls",
+                   help="comma-separated fitter kinds: wls, gls, "
+                        "downhill_wls, downhill_gls (default wls,gls)")
+    p.add_argument("--cache-dir", default=None,
+                   help="persistent cache directory (default "
+                        "$PINT_TPU_CACHE_DIR or ~/.cache/pint_tpu/xla)")
+    g = p.add_mutually_exclusive_group()
+    g.add_argument("--bucket", action="store_true", default=None,
+                   dest="bucket",
+                   help="warm the geometric-bucket shapes (for "
+                        "deployments fitting with bucket=True / "
+                        "PINT_TPU_BUCKET_TOAS=1)")
+    g.add_argument("--no-bucket", action="store_false", default=None,
+                   dest="bucket",
+                   help="warm the exact TOA counts (default follows "
+                        "$PINT_TPU_BUCKET_TOAS, so warmed shapes match "
+                        "what default-configured fits will request)")
+    p.add_argument("--par", default=None,
+                   help="warm a real dataset's shapes: par file "
+                        "(requires --tim)")
+    p.add_argument("--tim", default=None,
+                   help="tim file for --par")
+    args = p.parse_args(argv)
+
+    if (args.par is None) != (args.tim is None):
+        p.error("--par and --tim must be given together")
+
+    from pint_tpu import compile_cache
+
+    cache = compile_cache.enable_persistent_cache(args.cache_dir)
+    if cache:
+        print(f"persistent cache: {cache} "
+              f"({compile_cache.cache_entries()} entries before warmup)")
+    else:
+        print("persistent cache DISABLED (unwritable dir or disabled "
+              "by env); warming in-process registry only",
+              file=sys.stderr)
+
+    kinds = tuple(k.strip() for k in args.kinds.split(",") if k.strip())
+    counts = tuple(int(t) for t in args.toas.split(",") if t.strip())
+
+    pairs = None
+    if args.par is not None:
+        from pint_tpu.models.builder import get_model_and_toas
+
+        model, toas = get_model_and_toas(args.par, args.tim)
+        pairs = [(model, toas)]
+        print(f"warming {args.par} ({len(toas)} TOAs)")
+
+    bucket = (compile_cache.bucketing_default() if args.bucket is None
+              else args.bucket)
+    if bucket and not compile_cache.bucketing_default():
+        print("note: warming BUCKETED shapes — they serve fits made "
+              "with bucket=True or PINT_TPU_BUCKET_TOAS=1",
+              file=sys.stderr)
+    records = compile_cache.warmup(
+        toa_counts=counts, kinds=kinds, bucket=bucket,
+        progress=print, pairs=pairs)
+
+    total = sum(r["compile_s"] for r in records)
+    print(f"warmed {len(records)} shape(s) in {total:.1f}s of compile")
+    if cache:
+        print(f"persistent cache: {compile_cache.cache_entries()} "
+              "entries after warmup")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -370,6 +370,18 @@ def _install_compile_listener(monitoring="auto"):
             if "compil" in event:  # compile/compilation event keys
                 counter_add("jit.compile_events")
                 counter_add("jit.compile_seconds", float(duration))
+                # refined split: the broad counters above also count
+                # tracing/lowering and persistent-cache bookkeeping;
+                # these separate the actual XLA backend compiles from
+                # the disk-cache hits that AVOIDED one
+                if "backend_compile" in event:
+                    counter_add("jit.backend_compile_events")
+                    counter_add("jit.backend_compile_seconds",
+                                float(duration))
+                elif "compile_time_saved" in event:
+                    counter_add("jit.persistent_cache_hits")
+                    counter_add("jit.persistent_cache_saved_seconds",
+                                float(duration))
 
         try:
             reg(_on_duration)
@@ -380,13 +392,24 @@ def _install_compile_listener(monitoring="auto"):
 
 
 def compile_stats() -> dict:
-    """Compile-event stats for this session: ``{"events", "seconds",
-    "source"}``.  Installs the jax.monitoring listener on first call
-    (so merely importing telemetry never imports jax)."""
+    """Compile-event stats for this session.  ``events``/``seconds``
+    are the broad counters (every jax compile-phase event: tracing,
+    lowering, backend compile, cache bookkeeping);
+    ``backend_events``/``backend_seconds`` count only actual XLA
+    backend compiles, and ``cache_hits``/``cache_saved_seconds``
+    count persistent-cache retrievals that avoided one.  Installs the
+    jax.monitoring listener on first call (so merely importing
+    telemetry never imports jax)."""
     source = _install_compile_listener()
     return {
         "events": int(counter_get("jit.compile_events")),
         "seconds": float(counter_get("jit.compile_seconds")),
+        "backend_events": int(counter_get("jit.backend_compile_events")),
+        "backend_seconds": float(
+            counter_get("jit.backend_compile_seconds")),
+        "cache_hits": int(counter_get("jit.persistent_cache_hits")),
+        "cache_saved_seconds": float(
+            counter_get("jit.persistent_cache_saved_seconds")),
         "source": source,
     }
 

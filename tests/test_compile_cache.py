@@ -1,0 +1,377 @@
+"""Compile-amortization subsystem tests (pint_tpu.compile_cache).
+
+Covers the four layers: the shared jit registry (two same-shaped
+Fitters -> ZERO new XLA compiles for the second, asserted through the
+telemetry compile counter), TOA-count bucketing (same-bucket datasets
+share one executable and give mask-correct chi^2), the persistent
+on-disk cache round-trip (tmpdir PINT_TPU_CACHE_DIR populates), and
+the AOT warmup path (pintwarm CLI).  All CPU, tier-1-fast.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from pint_tpu import compile_cache, telemetry
+from pint_tpu.fitter import GLSFitter, WLSFitter
+from pint_tpu.models.builder import get_model
+from pint_tpu.simulation import make_fake_toas_uniform
+
+WLS_PAR = """PSR TSTCACHE
+RAJ 18:57:36.39
+DECJ 09:43:17.2
+F0 186.494 1
+F1 -6.2e-16 1
+PEPOCH 54000
+DM 13.3 1
+TZRMJD 54000
+TZRSITE @
+TZRFRQ 1400
+UNITS TDB
+EPHEM builtin
+"""
+
+# red noise only (no ECORR): the Fourier basis width is fixed by
+# TNRedC, so two datasets with different TOA counts keep identical
+# basis shapes after bucketing — the executable-sharing scenario
+GLS_PAR = WLS_PAR.replace(
+    "UNITS TDB",
+    "EFAC -f L-wide 1.1\nTNRedAmp -13.5\nTNRedGam 3.3\nTNRedC 10\n"
+    "UNITS TDB")
+
+
+def _mk(par, n, seed):
+    model = get_model(par)
+    toas = make_fake_toas_uniform(
+        53000.0, 56500.0, n, model, freq_mhz=1400.0, obs="gbt",
+        error_us=1.0, add_noise=True, rng=np.random.default_rng(seed),
+        flags={"f": "L-wide"})
+    return model, toas
+
+
+def _compiles():
+    telemetry.compile_stats()
+    return telemetry.counter_get("jit.compile_events")
+
+
+def _monitoring_live():
+    return telemetry.compile_stats()["source"] == "jax.monitoring"
+
+
+class TestBucketSize:
+    def test_geometric(self):
+        assert compile_cache.bucket_size(1) == 64
+        assert compile_cache.bucket_size(64) == 64
+        assert compile_cache.bucket_size(65) == 80
+        # monotone, >= n, bounded overhead
+        prev = 0
+        for n in range(1, 3000, 37):
+            b = compile_cache.bucket_size(n)
+            assert b >= n
+            assert b >= prev
+            prev = b
+            if n > 64:
+                assert b / n <= compile_cache.BUCKET_GROWTH + 1e-9
+
+    def test_same_bucket_for_nearby_sizes(self):
+        assert compile_cache.bucket_size(90) == compile_cache.bucket_size(
+            100)
+
+
+class TestSharedRegistry:
+    def test_two_fitters_zero_new_compiles(self):
+        """The ISSUE 2 acceptance regression: a second same-shaped
+        Fitter performs ZERO new XLA compiles (telemetry counter) and
+        shares the first one's jitted step object."""
+        model, toas = _mk(WLS_PAR, 80, 0)
+        f1 = WLSFitter(toas, model)
+        f1.fit_toas(maxiter=3)
+        before = _compiles()
+        hits_before = compile_cache.registry_stats()["hits"]
+        f2 = WLSFitter(toas, model)
+        f2.fit_toas(maxiter=3)
+        assert f2._step_jit is f1._step_jit
+        assert compile_cache.registry_stats()["hits"] > hits_before
+        if _monitoring_live():
+            assert _compiles() - before == 0
+        assert telemetry.counter_get("compile_cache.registry_misses") > 0
+
+    def test_different_free_set_not_shared(self):
+        """A changed free-parameter set must NOT reuse the stale trace
+        (it would silently write steps into the wrong parameters)."""
+        m1, t1 = _mk(WLS_PAR, 80, 0)
+        f1 = WLSFitter(t1, m1)
+        m2, t2 = _mk(WLS_PAR.replace("DM 13.3 1", "DM 13.3"), 80, 0)
+        f2 = WLSFitter(t2, m2)
+        assert f1._step_jit is not f2._step_jit
+
+    def test_registry_lru_cap(self, monkeypatch):
+        monkeypatch.setenv("PINT_TPU_JIT_REGISTRY_CAP", "2")
+        compile_cache.clear_registry()
+        for i in range(4):
+            compile_cache.shared_jit(
+                lambda x: x + i, key=("lru-test", i),
+                fn_token="lru-test")
+        assert compile_cache.registry_stats()["entries"] <= 2
+        compile_cache.clear_registry()
+
+    def test_key_distinguishes(self):
+        a = compile_cache.shared_jit(lambda x: x + 1,
+                                     key=("k", 1), fn_token="t")
+        b = compile_cache.shared_jit(lambda x: x + 2,
+                                     key=("k", 2), fn_token="t")
+        c = compile_cache.shared_jit(lambda x: x * 3,
+                                     key=("k", 1), fn_token="t")
+        assert a is not b
+        assert a is c  # same (token, key) -> first registration wins
+        assert float(c(jnp.float64(1.0))) == 2.0
+
+
+class TestBucketing:
+    def test_pad_toas_mask_correct(self):
+        """chi^2/dof/fit of a padded dataset match the unpadded fit to
+        f64 resolution — the sentinel rows carry ~1e-32 relative
+        weight."""
+        m_pad, t_pad = _mk(GLS_PAR, 90, 3)
+        m_ref, t_ref = _mk(GLS_PAR, 90, 3)
+
+        f_ref = GLSFitter(t_ref, m_ref)
+        chi2_ref = f_ref.fit_toas(maxiter=3)
+
+        f_pad = GLSFitter(t_pad, m_pad, bucket=True)
+        assert len(f_pad.toas) == compile_cache.bucket_size(90)
+        assert f_pad.resids.n_real == 90
+        chi2_pad = f_pad.fit_toas(maxiter=3)
+
+        # rel 1e-8, not f64-exact: the padded solve runs SVD/eigh over
+        # 100 rows (10 of them ~zero-weight) vs 90 — different-shaped
+        # reductions round differently at the ~1e-11 level
+        assert chi2_pad == pytest.approx(chi2_ref, rel=1e-8)
+        assert f_pad.resids.dof == f_ref.resids.dof
+        assert f_pad.model.meta["NTOA"] == "90"
+        for name in ("F0", "F1", "DM"):
+            assert m_pad.values[name] == pytest.approx(
+                m_ref.values[name], rel=1e-8, abs=1e-30)
+
+    def test_same_bucket_shares_executable(self):
+        """Two TOA sets in the same bucket (90 and 100 -> 100) share
+        ONE jitted step; the second pays zero new XLA compiles."""
+        m1, t1 = _mk(GLS_PAR, 90, 0)
+        m2, t2 = _mk(GLS_PAR, 100, 1)
+        f1 = GLSFitter(t1, m1, bucket=True)
+        f1.fit_toas(maxiter=3)
+        before = _compiles()
+        f2 = GLSFitter(t2, m2, bucket=True)
+        chi2 = f2.fit_toas(maxiter=3)
+        assert f2._step_jit is f1._step_jit
+        if _monitoring_live():
+            assert _compiles() - before == 0
+        # mask-correct: matches the unbucketed fit of the same data
+        m3, t3 = _mk(GLS_PAR, 100, 1)
+        f3 = GLSFitter(t3, m3)
+        assert chi2 == pytest.approx(f3.fit_toas(maxiter=3), rel=1e-8)
+
+    def test_pad_toas_idempotent_and_boundary(self):
+        _, t = _mk(WLS_PAR, 64, 0)
+        p = compile_cache.pad_toas(t)
+        assert len(p) == 64 and p.n_real == 64  # already at a bucket
+        # the caller's object must stay pristine (stamping n_real on
+        # it would change the structure key of every later Residuals)
+        assert p is not t
+        assert getattr(t, "n_real", None) is None
+        assert compile_cache.pad_toas(p) is p   # idempotent
+        # an explicit conflicting re-pad target must not be ignored
+        with pytest.raises(ValueError):
+            compile_cache.pad_toas(p, n_target=128)
+
+    def test_lnlike_not_baked_to_first_instance_count(self):
+        """Registry-shared lnlike traces must not bake the first
+        instance's n_real: two same-structure datasets of DIFFERENT
+        lengths get independent normalizations (the 0.5*n*log(2pi)
+        term), not the first caller's."""
+        from pint_tpu.residuals import Residuals
+
+        m1, t1 = _mk(GLS_PAR, 80, 11)
+        m2, t2 = _mk(GLS_PAR, 120, 12)
+        r1 = Residuals(t1, m1)
+        lnl1 = r1.lnlikelihood()  # builds the shared trace first
+        r2 = Residuals(t2, m2)
+        lnl2_shared = r2.lnlikelihood()
+        compile_cache.clear_registry()
+        r2b = Residuals(t2, m2)
+        lnl2_fresh = r2b.lnlikelihood()
+        assert lnl2_shared == pytest.approx(lnl2_fresh, rel=1e-12)
+        assert lnl1 != pytest.approx(lnl2_shared, rel=1e-6)
+
+    def test_padded_lnlike_masks_pad_rows(self):
+        """lnlikelihood of the padded set equals the unpadded one (the
+        pad rows' logdet terms are masked, not merely small)."""
+        from pint_tpu.residuals import Residuals
+
+        m1, t1 = _mk(GLS_PAR, 90, 5)
+        m2, t2 = _mk(GLS_PAR, 90, 5)
+        r_ref = Residuals(t1, m1)
+        r_pad = Residuals(compile_cache.pad_toas(t2), m2)
+        assert r_pad.lnlikelihood() == pytest.approx(
+            r_ref.lnlikelihood(), rel=1e-8)
+
+
+class TestSplitMergeCtx:
+    def test_roundtrip_mixed_leaves(self):
+        ctx = {
+            "CompA": {"mask": np.ones(4, bool), "count": 3,
+                      "name": "x", "scale": 1.5},
+            "CompB": {"basis": np.eye(2), "modes": (1, 2)},
+        }
+        dyn, static = compile_cache.split_ctx(ctx)
+        assert set(dyn["CompA"]) == {"mask"}
+        assert set(static["CompA"]) == {"count", "name", "scale"}
+        merged = compile_cache.merge_ctx(dyn, static)
+        assert set(merged["CompA"]) == set(ctx["CompA"])
+        assert merged["CompB"]["modes"] == (1, 2)
+        assert np.array_equal(merged["CompB"]["basis"], np.eye(2))
+
+    def test_split_none(self):
+        dyn, static = compile_cache.split_ctx(None)
+        assert dyn is None and static == {}
+
+    def test_static_key_deterministic(self):
+        _, s1 = compile_cache.split_ctx({"A": {"n": 1, "s": "x"}})
+        _, s2 = compile_cache.split_ctx({"A": {"s": "x", "n": 1}})
+        assert compile_cache.static_ctx_key(
+            s1) == compile_cache.static_ctx_key(s2)
+
+
+class TestFingerprint:
+    def test_array_content(self):
+        a = compile_cache.fingerprint({"x": np.arange(5.0)})
+        b = compile_cache.fingerprint({"x": np.arange(5.0)})
+        c = compile_cache.fingerprint({"x": np.arange(5.0) + 1})
+        assert a == b and a != c
+
+    def test_structure_sensitive(self):
+        assert compile_cache.fingerprint(
+            [1.0, None]) != compile_cache.fingerprint([1.0, 0.0])
+
+
+class TestPersistentCache:
+    def test_roundtrip_populates_tmpdir(self, tmp_path, monkeypatch):
+        """PINT_TPU_CACHE_DIR round-trip: enabling the cache and
+        compiling through the registry leaves executables on disk."""
+        d = tmp_path / "xla"
+        monkeypatch.setenv("PINT_TPU_CACHE_DIR", str(d))
+        compile_cache._reset_for_tests()
+        try:
+            got = compile_cache.enable_persistent_cache()
+            assert got == str(d)
+            assert compile_cache.cache_dir() == str(d)
+            fn = compile_cache.shared_jit(
+                lambda x: jnp.sin(x) * 41.5 + jnp.cos(x) ** 3,
+                key=("cache-roundtrip-test",),
+                fn_token="cache-roundtrip-test")
+            fn(jnp.arange(23.0)).block_until_ready()
+            assert compile_cache.cache_entries() >= 1
+            assert any(d.iterdir())
+        finally:
+            compile_cache._reset_for_tests()
+
+    def test_disabled_tokens(self, monkeypatch):
+        compile_cache._reset_for_tests()
+        try:
+            monkeypatch.setenv("PINT_TPU_CACHE_DIR", "off")
+            assert compile_cache.enable_persistent_cache() is None
+            assert compile_cache.cache_dir() is None
+            assert compile_cache.cache_entries() == 0
+        finally:
+            compile_cache._reset_for_tests()
+
+    def test_auto_enable_requires_env(self, monkeypatch):
+        """The fit path only switches the disk cache on when the env
+        var asks for it (tests and sandboxes must not write ~)."""
+        monkeypatch.delenv("PINT_TPU_CACHE_DIR", raising=False)
+        compile_cache._reset_for_tests()
+        try:
+            compile_cache._auto_enable()
+            assert compile_cache.cache_dir() is None
+        finally:
+            compile_cache._reset_for_tests()
+
+
+class TestModelStructureKey:
+    def test_values_excluded(self):
+        m1 = get_model(WLS_PAR)
+        m2 = get_model(WLS_PAR)
+        m2.values["F0"] = 187.0  # values are dynamic, not structural
+        assert compile_cache.model_structure_key(
+            m1) == compile_cache.model_structure_key(m2)
+
+    def test_fit_meta_excluded(self):
+        """CHI2/TRES/NTOA written back by a fit must not break sharing
+        between consecutive fitters."""
+        m1 = get_model(WLS_PAR)
+        key = compile_cache.model_structure_key(m1)
+        m1.meta["CHI2"] = "123.4"
+        m1.meta["NTOA"] = "80"
+        m1.meta["TRES"] = "0.9"
+        assert compile_cache.model_structure_key(m1) == key
+
+    def test_structure_detected(self):
+        m1 = get_model(WLS_PAR)
+        m2 = get_model(WLS_PAR.replace("DM 13.3 1", "DM 13.3"))
+        k1 = compile_cache.model_structure_key(m1)
+        k2 = compile_cache.model_structure_key(m2)
+        assert k1 == k2  # frozen-ness is not structural (values dict)
+        m3 = get_model(GLS_PAR)
+        assert compile_cache.model_structure_key(m3) != k1
+
+
+class TestWarmup:
+    def test_warmup_records(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PINT_TPU_CACHE_DIR",
+                           str(tmp_path / "warm"))
+        compile_cache._reset_for_tests()
+        try:
+            recs = compile_cache.warmup(toa_counts=(64,),
+                                        kinds=("wls",))
+            assert len(recs) == 1
+            assert recs[0]["kind"] == "wls"
+            assert recs[0]["bucket"] == 64
+            assert recs[0]["compile_s"] > 0
+            assert compile_cache.cache_entries() >= 1
+        finally:
+            compile_cache._reset_for_tests()
+
+    def test_warm_compile_then_fit_no_new_compile(self):
+        """Fitter.warm_compile() AOT-compiles the step; verify it runs
+        and returns a positive duration."""
+        model, toas = _mk(WLS_PAR, 80, 7)
+        f = WLSFitter(toas, model)
+        dt = f.warm_compile()
+        assert dt >= 0.0
+        assert np.isfinite(f.fit_toas(maxiter=2))
+
+
+class TestDatacheckIntegration:
+    def test_report_mentions_compile_cache(self):
+        from pint_tpu.datacheck import datacheck_report
+
+        text = "\n".join(datacheck_report())
+        assert "Compile cache:" in text
+        assert "jit registry:" in text
+
+
+class TestPintwarmCLI:
+    def test_cli_runs(self, tmp_path, capsys):
+        from pint_tpu.scripts.pintwarm import main
+
+        compile_cache._reset_for_tests()
+        try:
+            rc = main(["--toas", "64", "--kinds", "wls",
+                       "--cache-dir", str(tmp_path / "xla")])
+            assert rc == 0
+            out = capsys.readouterr().out
+            assert "warmed" in out
+            assert "persistent cache" in out
+        finally:
+            compile_cache._reset_for_tests()

@@ -93,8 +93,11 @@ class TestTrackingSemantics:
                            track_mode="nearest")
         r_pn = Residuals(gap_toas, model, subtract_mean=False,
                          track_mode="use_pulse_numbers")
-        near = np.asarray(r_near._phase_resids_jit(r_near._values(vals)))
-        track = np.asarray(r_pn._phase_resids_jit(r_pn._values(vals)))
+        near = np.asarray(
+            r_near._phase_resids_jit(r_near._values(vals),
+                                     r_near._data()))
+        track = np.asarray(
+            r_pn._phase_resids_jit(r_pn._values(vals), r_pn._data()))
         # nearest: wrapped into half a turn, gap swallowed silently
         assert np.max(np.abs(near)) <= 0.5
         # tracking: the true phase drift is exposed, > 2 turns
