@@ -514,6 +514,56 @@ def bench_pta(jnp, backend):
     })
 
 
+def bench_os(jnp, backend):
+    """The cross-pulsar optimal statistic: per-pulsar Woodbury
+    whitening + all N(N-1)/2 pair contractions as one vmapped program
+    (pint_tpu.gw.os).  No reference baseline exists — the reference
+    has no cross-pulsar engine; vs_baseline anchors to 1 pair/s (a
+    generous estimate for a per-pair Python loop at this shape)."""
+    from pint_tpu.gw import OptimalStatistic
+    from pint_tpu.simulation import (add_gwb, make_fake_pta,
+                                     pta_injection_seed)
+
+    n_psr = 40
+    n_toas = 250
+    nmodes = 10
+
+    def build(seed):
+        pairs = make_fake_pta(
+            n_psr, n_toas, seed=seed,
+            extra_par="TNRedAmp -13.7\nTNRedGam 4.33\nTNRedC 10\n")
+        add_gwb([t for _, t in pairs], [m for m, _ in pairs], 2e-14,
+                rng=pta_injection_seed(seed, n_psr), nmodes=nmodes)
+        return pairs
+
+    os1 = OptimalStatistic(build(0), nmodes=nmodes)
+    compile_s = _timed_compile(lambda: os1.compute())
+    # warm: a second same-shaped array resolves through the registry
+    os2 = OptimalStatistic(build(5000), nmodes=nmodes)
+    warm_s, _ = _timed_compile2(lambda: os2.compute())
+    t0 = time.time()
+    res = os1.compute()
+    wall = time.time() - t0
+    rate = os1.n_pairs / wall
+    from pint_tpu import flops as fl
+
+    flops = fl.os_flops(n_psr, n_toas, int(os1.U.shape[2]),
+                        2 * nmodes, os1.n_pairs)
+    _emit_metric({
+        "metric": "os_pairs_per_s",
+        "value": round(rate, 2),
+        "unit": (f"pulsar-pair OS/s ({n_psr} pulsars x {n_toas} TOAs "
+                 f"-> {os1.n_pairs} pairs, {nmodes} modes, HD ORF, "
+                 f"S/N={res.snr:.1f}, backend={backend}, "
+                 f"compile={compile_s:.1f}s/warm {warm_s:.1f}s"
+                 + _mfu_str(flops, wall, backend) + ")"),
+        "vs_baseline": round(rate / 1.0, 1),
+        "backend": backend,
+        "compile_s": _cold_warm(compile_s, warm_s),
+        "flops": flops,
+    })
+
+
 #: run order: the roofline first (its measured matmul peak becomes the
 #: honest MFU denominator for everything after it), then
 #: proven-cheapest compile first, heaviest (GLS) last, so a mid-run
@@ -522,6 +572,7 @@ _METRICS = {
     "roofline": bench_roofline,
     "wls_grid": bench_wls_grid,
     "mcmc": bench_mcmc,
+    "os": bench_os,
     "pta": bench_pta,
     "gls": bench_gls,
 }

@@ -346,6 +346,8 @@ def fingerprint(tree) -> str:
                 h.update(repr(k).encode())
                 feed(obj[k])
         elif isinstance(obj, (list, tuple)):
+            # NamedTuple pytrees (TOABatch) land here too: tuple
+            # subclasses, hashed by content like any other sequence
             h.update(b"\x00L%d" % len(obj))
             for v in obj:
                 feed(v)
@@ -356,8 +358,6 @@ def fingerprint(tree) -> str:
             h.update(b"\x00A" + str(a.dtype).encode()
                      + repr(a.shape).encode())
             h.update(a.tobytes())
-        elif hasattr(obj, "_fields"):  # NamedTuple pytree (TOABatch)
-            feed(tuple(obj))
         else:
             h.update(repr(obj).encode())
 

@@ -195,7 +195,45 @@ def datacheck_report(ephem="builtin", sites=("gbt", "ao", "jb", "pks",
         f"  jit registry: {rs['entries']} shared trace(s), "
         f"{rs['hits']} hit(s) / {rs['misses']} miss(es) this session "
         f"(cap {rs['cap']})")
+
+    # -- cross-pulsar GW engine: geometry + OS smoke ---------------------------
+    lines.extend(_gw_section())
     return lines
+
+
+def _gw_section(n_psr=3, ntoa=24):
+    """Sanity of the cross-pulsar GW engine on a tiny synthetic array:
+    pair count, ORF matrix symmetry/positive-semidefiniteness, and an
+    optimal-statistic smoke evaluation (finite Ahat^2 / S/N).  Any
+    failure is reported, never raised — this is a diagnostic."""
+    try:
+        import numpy as np
+
+        from pint_tpu.gw import OptimalStatistic, orf_matrix
+        from pint_tpu.simulation import make_fake_pta
+
+        pairs = make_fake_pta(n_psr, ntoa, start_mjd=54000.0,
+                              duration_days=1500.0,
+                              name_prefix="GWCHK")
+        os_ = OptimalStatistic(pairs, nmodes=3)
+        G = np.asarray(orf_matrix(os_.pos))
+        sym = float(np.max(np.abs(G - G.T)))
+        min_eig = float(np.linalg.eigvalsh(G).min())
+        res = os_.compute()
+        ok = (np.isfinite(res.ahat2) and np.isfinite(res.snr)
+              and sym == 0.0 and min_eig > -1e-12)
+        return [
+            "GW engine (cross-pulsar OS, tiny synthetic array): "
+            + ("OK" if ok else "PROBLEM"),
+            f"  {n_psr} pulsars -> {os_.n_pairs} pair(s); HD ORF "
+            f"symmetric (max asym {sym:.1e}), min eigenvalue "
+            f"{min_eig:.3e} (PSD: {'yes' if min_eig > -1e-12 else 'NO'})",
+            f"  OS smoke: Ahat^2 = {res.ahat2:.3e} "
+            f"+/- {res.sigma_ahat2:.3e}, S/N = {res.snr:.2f} "
+            f"({'finite' if np.isfinite(res.snr) else 'NON-FINITE'})",
+        ]
+    except Exception as e:  # diagnostic must never take the report down
+        return [f"GW engine: ERROR {type(e).__name__}: {e}"]
 
 
 def _last_session_compile_lines():

@@ -18,7 +18,7 @@ __all__ = [
     "RESID_CHAIN_OPS", "DD_CHAIN_FLOPS_PER_ELEM",
     "matmul_flops", "resid_eval_flops", "gls_fit_flops",
     "wls_fit_flops", "wls_grid_flops", "mcmc_flops", "pta_batch_flops",
-    "dd_chain_flops",
+    "dd_chain_flops", "os_flops",
 ]
 
 #: modeled f64 ops per TOA for one residual-chain evaluation (delay
@@ -78,3 +78,16 @@ def pta_batch_flops(n_pulsars, n_toa, n_free, n_basis, n_iter=3):
 def dd_chain_flops(n_elems, n_iters):
     """The double-double mul+add roofline chain."""
     return DD_CHAIN_FLOPS_PER_ELEM * float(n_elems) * float(n_iters)
+
+
+def os_flops(n_pulsars, n_toa, n_basis, n_gw, n_pairs):
+    """The pair-wise optimal statistic: per pulsar, the Woodbury
+    whitening of the GW basis (capacity build n*nb^2, Cholesky nb^3/3,
+    multi-RHS solve + projections ~ n*nb*m + n*m^2 with m GW columns);
+    per pair, the m^2 trace contraction."""
+    per_psr = (2.0 * n_toa * n_basis**2
+               + n_basis**3 / 3.0
+               + 2.0 * n_toa * n_basis * n_gw
+               + 2.0 * n_toa * n_gw**2)
+    per_pair = 4.0 * n_gw**2
+    return float(n_pulsars * per_psr + n_pairs * per_pair)

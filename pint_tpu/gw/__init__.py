@@ -1,0 +1,29 @@
+"""Cross-pulsar gravitational-wave engine.
+
+Layers (docs/gw.md):
+
+- :mod:`pint_tpu.gw.orf` — overlap-reduction functions (Hellings–Downs,
+  monopole, dipole) as dense (N, N) matrices of the array geometry;
+- :mod:`pint_tpu.gw.common` — the common red process (CRN/GWB)
+  likelihood: ORF-coupled cross-pulsar Fourier blocks through the
+  dense-prior extension of :mod:`pint_tpu.linalg`'s Woodbury solver;
+- :mod:`pint_tpu.gw.os` — the pair-wise optimal statistic, vmapped
+  over all N(N-1)/2 pairs and shardable over a device mesh, plus the
+  noise-marginalized variant vmapped over posterior draws;
+- injection lives in :func:`pint_tpu.simulation.add_gwb` (HD-correlated
+  Fourier coefficients across the whole array).
+"""
+
+from pint_tpu.gw.common import (CommonProcess, build_pulsar_data,
+                                common_tspan_s, gwb_phi)
+from pint_tpu.gw.orf import (angular_separation_matrix, dipole,
+                             hellings_downs, monopole, orf_matrix,
+                             pair_indices, pulsar_positions)
+from pint_tpu.gw.os import GWB_GAMMA, OptimalStatistic, OSResult
+
+__all__ = [
+    "hellings_downs", "monopole", "dipole", "orf_matrix",
+    "angular_separation_matrix", "pair_indices", "pulsar_positions",
+    "CommonProcess", "build_pulsar_data", "common_tspan_s", "gwb_phi",
+    "OptimalStatistic", "OSResult", "GWB_GAMMA",
+]

@@ -1,0 +1,297 @@
+"""Common red process (CRN/GWB) likelihood across a pulsar array.
+
+The cross-pulsar extension of the single-pulsar Woodbury likelihood
+(van Haasteren & Levin, arXiv:1107.5366): a gravitational-wave
+background adds, on top of every pulsar's own noise, a shared power-law
+Fourier process whose cross-pulsar covariance is the overlap-reduction
+function.  Over the STACKED residual vector of the whole array the
+covariance is
+
+    C = diag(sigma^2) + U Phi U^T,
+
+with U the block-diagonal concatenation of every pulsar's noise basis
+followed by every pulsar's common-frequency GW Fourier basis, and Phi
+block-structured: diagonal per-pulsar noise weights, plus a dense GWB
+sector ``Gamma (x) diag(phi_gw)`` (Kronecker of the ORF matrix with the
+power-law spectrum).  That dense-prior form goes through the SAME
+:func:`pint_tpu.linalg.woodbury_chi2_logdet` solver as every
+single-pulsar fit — ``phi`` is simply 2-D — so the per-pulsar and PTA
+likelihoods share one code path.
+
+The Fourier machinery is the one implementation in
+:mod:`pint_tpu.models.noise` (``fourier_basis`` / ``powerlaw`` /
+``toa_fourier_basis``); the GW bases of all pulsars are evaluated at
+COMMON frequencies k/T over the array-wide span, on the absolute TDB
+time axis, so the process is phase-coherent across pulsars.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu import compile_cache as _cc
+from pint_tpu.gw.orf import orf_matrix, pulsar_positions
+from pint_tpu.linalg import woodbury_chi2_logdet
+from pint_tpu.models.noise import powerlaw, toa_fourier_basis
+from pint_tpu.residuals import MEAN_OFFSET_WEIGHT, Residuals
+from pint_tpu.telemetry import span
+
+__all__ = ["PulsarGWData", "build_pulsar_data", "common_tspan_s",
+           "CommonProcess", "gwb_phi"]
+
+#: pad-row sigma [s] for the padded per-pulsar stacks: weight 1e-32,
+#: in line with compile_cache.PAD_ERROR_US (1e22 us) — sigma^2 = 1e32
+#: survives the TPU float32-pair f64 emulation (1e30 s would square to
+#: 1e60 and saturate the high word)
+PAD_SIGMA_S = 1e16
+
+
+def common_tspan_s(toas_list) -> float:
+    """Array-wide observing span [s]: max - min TDB second over every
+    pulsar's TOAs — the T whose k/T harmonics the common process
+    lives on."""
+    lo = min(float(t.ticks.min()) for t in toas_list) / 2**32
+    hi = max(float(t.ticks.max()) for t in toas_list) / 2**32
+    return hi - lo
+
+
+def gwb_phi(freqs, amp, gamma, df):
+    """Per-mode GWB prior weights [s^2]: the shared power-law PSD
+    integrated over one frequency bin — the same
+    :func:`pint_tpu.models.noise.powerlaw` convention every intrinsic
+    red-noise component uses."""
+    return powerlaw(freqs, amp, gamma) * df
+
+
+class PulsarGWData(NamedTuple):
+    """One pulsar's ingredients for the cross-correlation engine, all
+    concrete numpy/jax arrays at the model's current parameter values."""
+
+    r: np.ndarray       # (n,) time residuals [s], mean-subtracted
+    sigma: np.ndarray   # (n,) noise-scaled uncertainties [s]
+    U: np.ndarray       # (n, nb) own noise basis + offset/timing cols
+    phi: np.ndarray     # (nb,) own basis weights [s^2]
+    F: np.ndarray       # (n, 2*nmodes) common-frequency GW basis
+    name: str
+
+
+def _timing_design(resid: Residuals) -> np.ndarray:
+    """Column-normalized timing-model design matrix (n, n_free) of one
+    pulsar, for marginalizing the fitted timing model out of the GW
+    statistics (the van Haasteren G-matrix, realized as basis columns
+    at MEAN_OFFSET_WEIGHT prior variance).  Eager jacfwd — no XLA
+    compile is triggered at build time.
+
+    free_timing_params, NOT free_params: a free noise parameter (EFAC
+    etc.) has a pure-roundoff residual derivative (~1e-22 column norm
+    through the weighted mean) that unit normalization would amplify
+    into an arbitrary full-magnitude direction projected out of every
+    GW statistic — the same reason the fitters' design matrices
+    exclude noise parameters."""
+    names = list(resid.model.free_timing_params)
+    n = len(resid.toas)
+    if not names:
+        return np.zeros((n, 0))
+    base = resid._values()
+    data = resid._data()
+
+    def f(vec):
+        values = dict(base)
+        for i, k in enumerate(names):
+            values[k] = vec[i]
+        return resid.time_resids_at(values, data)
+
+    vec0 = jnp.asarray([float(resid.model.values[k]) for k in names])
+    J = np.asarray(jax.jacfwd(f)(vec0))
+    norm = np.linalg.norm(J, axis=0)
+    norm[norm == 0.0] = 1.0
+    return J / norm
+
+
+def build_pulsar_data(
+    pairs: Optional[Sequence[Tuple]] = None,
+    *,
+    batch=None,
+    nmodes: int = 10,
+    tspan_s: Optional[float] = None,
+    marginalize_timing: bool = True,
+) -> Tuple[List[PulsarGWData], np.ndarray, np.ndarray, float,
+           List[Residuals]]:
+    """Assemble every pulsar's (r, sigma, U, phi, F) plus the array
+    geometry.
+
+    pairs: ``[(TimingModel, TOAs), ...]``; or pass ``batch=`` a
+    :class:`pint_tpu.parallel.PTABatch` to reuse its prepared models.
+    Returns ``(data_list, positions (N, 3), freqs (2*nmodes,), df,
+    resids)`` — the :class:`Residuals` list rides along so callers can
+    reach the prepared models (noise layout metadata) without a second
+    prepare pass.
+    """
+    if batch is not None:
+        resids = list(batch.resids)
+        models = [p.model for p in batch.prepareds]
+    elif pairs:
+        resids = [Residuals(t, m, track_mode="nearest")
+                  for m, t in pairs]
+        models = [r.model for r in resids]
+    else:
+        raise ValueError("build_pulsar_data needs pairs or batch=")
+    if len(resids) < 2:
+        raise ValueError(
+            f"a cross-correlation analysis needs >= 2 pulsars, got "
+            f"{len(resids)}")
+    toas_list = [r.toas for r in resids]
+    T = float(tspan_s) if tspan_s else common_tspan_s(toas_list)
+    pos = pulsar_positions(models)
+    out = []
+    freqs = None
+    for resid in resids:
+        prep = resid.prepared
+        values = resid._values()
+        r = np.asarray(resid.time_resids, dtype=np.float64)
+        sigma = np.asarray(resid.scaled_errors, dtype=np.float64)
+        U = np.asarray(prep.noise_basis, dtype=np.float64)
+        phi = np.asarray(prep.noise_weights_fn(values),
+                         dtype=np.float64)
+        n = len(resid.toas)
+        cols = [U, np.ones((n, 1))]
+        ws = [phi, np.array([MEAN_OFFSET_WEIGHT])]
+        if marginalize_timing:
+            J = _timing_design(resid)
+            cols.append(J)
+            ws.append(np.full(J.shape[1], MEAN_OFFSET_WEIGHT))
+        U_ext = np.concatenate(cols, axis=1)
+        phi_ext = np.concatenate(ws)
+        F, fgrid = toa_fourier_basis(resid.toas, nmodes, tspan_s=T)
+        if freqs is None:
+            freqs = fgrid
+        out.append(PulsarGWData(
+            r=r, sigma=sigma, U=U_ext, phi=phi_ext, F=F,
+            name=str(resid.model.meta.get("PSR", "?"))))
+    return out, pos, freqs, float(freqs[0]), resids
+
+
+# --------------------------------------------------------------------------
+# stacked CRN/GWB likelihood
+# --------------------------------------------------------------------------
+
+def _crn_lnlike_one(r, sigma, U_full, phi_noise, orf, freqs, df,
+                    n_toa, log10_amp, gamma):
+    """Log-likelihood of the stacked array under noise + an
+    ORF-correlated common power-law process.  Pure function of dynamic
+    arrays — one trace serves every same-shaped PTA."""
+    amp = 10.0 ** log10_amp
+    phi_gw = gwb_phi(freqs, amp, gamma, df)
+    kn = phi_noise.shape[0]
+    ktot = U_full.shape[1]
+    gw_block = jnp.kron(orf, jnp.diag(phi_gw))
+    phi_dense = jnp.zeros((ktot, ktot))
+    phi_dense = phi_dense.at[:kn, :kn].set(jnp.diag(phi_noise))
+    phi_dense = phi_dense.at[kn:, kn:].set(gw_block)
+    chi2, logdet = woodbury_chi2_logdet(r, sigma, U_full, phi_dense)
+    return -0.5 * (chi2 + logdet) - 0.5 * n_toa * jnp.log(2.0 * jnp.pi)
+
+
+_crn_lnlike_vec = jax.vmap(
+    _crn_lnlike_one,
+    in_axes=(None, None, None, None, None, None, None, None, 0, 0),
+)
+
+
+class CommonProcess:
+    """The PTA likelihood with an ORF-correlated common red process.
+
+    Timing parameters are held at each model's current values (their
+    linearized freedom is marginalized through the design-matrix
+    columns when ``marginalize_timing``); the two live parameters are
+    the common process's ``(log10_amp, gamma)``.  ``orf``: 'hd' |
+    'monopole' | 'dipole' | a callable — 'monopole'/'dipole' give the
+    clock-error / ephemeris-error systematics fits of the standard PTA
+    triage.
+
+    Every jitted entry point routes through
+    :func:`pint_tpu.compile_cache.shared_jit`, keyed purely on
+    structure: a second same-shaped PTA performs zero new XLA
+    compiles.
+    """
+
+    def __init__(self, pairs=None, *, batch=None, nmodes=10, orf="hd",
+                 tspan_s=None, marginalize_timing=True,
+                 _prebuilt=None):
+        with span("gw.common.build", nmodes=nmodes,
+                  orf=orf if isinstance(orf, str) else "custom"):
+            if _prebuilt is not None:
+                # per-pulsar data already assembled by a sibling
+                # engine (OptimalStatistic.common_process) — skip the
+                # second build_pulsar_data pass (and its per-pulsar
+                # eager jacfwd timing-design sweep)
+                data, pos, freqs, df = _prebuilt
+            else:
+                data, pos, freqs, df, _ = build_pulsar_data(
+                    pairs, batch=batch, nmodes=nmodes,
+                    tspan_s=tspan_s,
+                    marginalize_timing=marginalize_timing)
+            self.data = data
+            self.names = [d.name for d in data]
+            self.n_pulsars = len(data)
+            self.nmodes = int(nmodes)
+            self.pos = pos
+            self.orf_kind = orf
+            self.orf = jnp.asarray(np.asarray(orf_matrix(pos, orf)))
+            self.freqs = jnp.asarray(freqs)
+            self.df = jnp.float64(df)
+            # stacked vectors (ragged concatenation — no padding)
+            self.r = jnp.asarray(np.concatenate([d.r for d in data]))
+            self.sigma = jnp.asarray(
+                np.concatenate([d.sigma for d in data]))
+            self.phi_noise = jnp.asarray(
+                np.concatenate([d.phi for d in data]))
+            n_tot = self.r.shape[0]
+            kn = self.phi_noise.shape[0]
+            m2 = 2 * self.nmodes
+            U = np.zeros((n_tot, kn + self.n_pulsars * m2))
+            row = col = 0
+            for k, d in enumerate(data):
+                n, nb = d.U.shape
+                U[row:row + n, col:col + nb] = d.U
+                U[row:row + n, kn + k * m2: kn + (k + 1) * m2] = d.F
+                row += n
+                col += nb
+            self.U_full = jnp.asarray(U)
+            self.n_toa_total = n_tot
+
+    def _lnlike_jit(self):
+        return _cc.shared_jit(_crn_lnlike_one,
+                              key=("gw.common.lnlike",))
+
+    def lnlike(self, log10_amp, gamma):
+        """Log-likelihood at one (log10 amplitude, spectral index)."""
+        with span("gw.common.lnlike", n_pulsars=self.n_pulsars,
+                  nmodes=self.nmodes):
+            out = self._lnlike_jit()(
+                self.r, self.sigma, self.U_full, self.phi_noise,
+                self.orf, self.freqs, self.df,
+                jnp.float64(self.n_toa_total),
+                jnp.float64(log10_amp), jnp.float64(gamma))
+            return float(out)
+
+    def lnlike_grid(self, log10_amps, gammas):
+        """(A, G) log-likelihood surface over the outer product of the
+        two 1-d grids — one vmapped program."""
+        log10_amps = np.atleast_1d(np.asarray(log10_amps, np.float64))
+        gammas = np.atleast_1d(np.asarray(gammas, np.float64))
+        aa, gg = np.meshgrid(log10_amps, gammas, indexing="ij")
+        fn = _cc.shared_jit(_crn_lnlike_vec,
+                            key=("gw.common.lnlike_grid",),
+                            fn_token="gw.common.lnlike_grid")
+        with span("gw.common.lnlike_grid", n_pulsars=self.n_pulsars,
+                  n_points=aa.size):
+            out = fn(self.r, self.sigma, self.U_full, self.phi_noise,
+                     self.orf, self.freqs, self.df,
+                     jnp.float64(self.n_toa_total),
+                     jnp.asarray(aa.ravel()), jnp.asarray(gg.ravel()))
+        return np.asarray(out).reshape(aa.shape)

@@ -2,10 +2,17 @@
 
 Counterpart of the reference's Woodbury/Sherman-Morrison helpers
 (reference: src/pint/utils.py:3024 sherman_morrison_dot, :3074
-woodbury_dot).  The covariance is C = N + U diag(phi) U^T with N
-diagonal; all quantities are computed through the rank-K capacity
-matrix Sigma = Phi^-1 + U^T N^-1 U so nothing O(N^2) is ever formed.
+woodbury_dot).  The covariance is C = N + U Phi U^T with N diagonal;
+all quantities are computed through the rank-K capacity matrix
+Sigma = Phi^-1 + U^T N^-1 U so nothing O(N^2) is ever formed.
 Pure jax, differentiable, vmappable.
+
+``phi`` may be either a (K,) vector — the classic independent-weights
+case, Phi = diag(phi) — or a full (K, K) prior covariance matrix.  The
+dense form carries the cross-pulsar GWB structure of :mod:`pint_tpu.gw`
+(Hellings–Downs-coupled Fourier blocks across a stacked multi-pulsar
+basis) through the SAME solver, so the single-pulsar and PTA
+likelihoods cannot drift apart.
 """
 
 from __future__ import annotations
@@ -17,7 +24,7 @@ import jax.numpy as jnp
 
 __all__ = ["woodbury_chi2_logdet", "gls_normal_solve",
            "WoodburyPre", "woodbury_precompute",
-           "woodbury_chi2_logdet_pre"]
+           "woodbury_chi2_logdet_pre", "woodbury_solve"]
 
 #: floor on basis weights: a zero weight (e.g. ECORR 0) means infinite
 #: prior precision on that column — the coefficient is pinned to zero and
@@ -27,24 +34,71 @@ __all__ = ["woodbury_chi2_logdet", "gls_normal_solve",
 _PHI_FLOOR = 1e-30
 
 
+def _phi_terms(phi):
+    """Normalize a basis prior to its solver form.
+
+    Returns ``(phi_inv, logdet_phi)`` where ``phi_inv`` is the (K, K)
+    inverse-prior term to ADD to ``U^T N^-1 U`` — ``diag(1/phi)`` for a
+    (K,) weight vector, a dense Cholesky inverse for a (K, K) prior
+    covariance (the GWB cross-pulsar block structure).  Both forms
+    floor the diagonal at ``_PHI_FLOOR`` so pinned-to-zero columns stay
+    finite."""
+    phi = jnp.asarray(phi)
+    if phi.ndim == 2:
+        # per-column relative jitter before the Cholesky: physically
+        # meaningful dense priors are rank-deficient (a monopole ORF
+        # is rank 1, dipole rank 3, so kron(ORF, diag(phi_gw)) has an
+        # exact null space whose pivots are negative roundoff —
+        # cho_factor would NaN).  The jitter must be relative to EACH
+        # diagonal entry, never a global scale: a stacked PTA prior
+        # legitimately spans ~60 orders of magnitude (1e30 offset
+        # columns next to ~1e-28 GW mode weights), and Cholesky of the
+        # block structure preserves that separation exactly while a
+        # global floor (or an eigh pseudo-inverse, whose absolute
+        # eigenvalue error is eps * ||phi||) would destroy the small
+        # blocks.  1e-12 sits above accumulated f64 pivot roundoff and
+        # pins null-space coefficients to ~zero variance — the dense
+        # analogue of the vector-phi _PHI_FLOOR.
+        k = phi.shape[0]
+        d = jnp.abs(jnp.diag(phi)) + _PHI_FLOOR
+        phi = phi + 1e-12 * jnp.diag(d)
+        cf = jax.scipy.linalg.cho_factor(phi, lower=True)
+        phi_inv = jax.scipy.linalg.cho_solve(cf, jnp.eye(k))
+        logdet_phi = 2.0 * jnp.sum(jnp.log(jnp.diag(cf[0])))
+        return phi_inv, logdet_phi
+    phi = jnp.maximum(phi, _PHI_FLOOR)
+    return jnp.diag(1.0 / phi), jnp.sum(jnp.log(phi))
+
+
+def _capacity(sigma, U, phi):
+    """THE capacity-matrix construction every Woodbury path shares:
+    ``(nvec, cho_factor(U^T N^-1 U + Phi^-1), logdet Phi)``.  A
+    conditioning or masking change here reaches chi2/logdet, solve,
+    and precompute identically."""
+    phi_inv, logdet_phi = _phi_terms(phi)
+    nvec = sigma**2
+    sigma_cap = (U.T * (1.0 / nvec)[None, :]) @ U + phi_inv
+    cf = jax.scipy.linalg.cho_factor(sigma_cap, lower=True)
+    return nvec, cf, logdet_phi
+
+
 def woodbury_chi2_logdet(r, sigma, U, phi, valid=None):
-    """(chi2, logdet C) for C = diag(sigma^2) + U diag(phi) U^T.
+    """(chi2, logdet C) for C = diag(sigma^2) + U Phi U^T.
 
     chi2 = r^T C^-1 r via the Woodbury identity; logdet via the matrix
     determinant lemma with the Cholesky of Sigma (reference:
-    utils.woodbury_dot, utils.py:3074).
+    utils.woodbury_dot, utils.py:3074).  ``phi`` is a (K,) weight
+    vector (Phi diagonal) or a (K, K) prior covariance (the stacked
+    cross-pulsar GWB structure).
 
     valid: optional boolean mask excluding bucketing pad rows from the
     white logdet term (their ~1e-32 weights already vanish from every
     other reduction, but their log sigma^2 would shift — and, with
     EFAC free, bias — the log-likelihood).
     """
-    phi = jnp.maximum(phi, _PHI_FLOOR)
-    nvec = sigma**2
+    nvec, cf, logdet_phi = _capacity(sigma, U, phi)
     ninv_r = r / nvec
     ut_ninv_r = U.T @ ninv_r
-    sigma_cap = (U.T * (1.0 / nvec)[None, :]) @ U + jnp.diag(1.0 / phi)
-    cf = jax.scipy.linalg.cho_factor(sigma_cap, lower=True)
     x = jax.scipy.linalg.cho_solve(cf, ut_ninv_r)
     chi2 = jnp.sum(r * ninv_r) - jnp.sum(ut_ninv_r * x)
     log_nvec = jnp.log(nvec)
@@ -52,10 +106,24 @@ def woodbury_chi2_logdet(r, sigma, U, phi, valid=None):
         log_nvec = jnp.where(valid, log_nvec, 0.0)
     logdet = (
         jnp.sum(log_nvec)
-        + jnp.sum(jnp.log(phi))
+        + logdet_phi
         + 2.0 * jnp.sum(jnp.log(jnp.diag(cf[0])))
     )
     return chi2, logdet
+
+
+def woodbury_solve(sigma, U, phi, y):
+    """C^-1 y for C = diag(sigma^2) + U Phi U^T, with y a vector (N,)
+    or a matrix (N, M) of right-hand sides.  The cross-correlation
+    engine (:mod:`pint_tpu.gw.os`) whitens residuals and GW bases
+    through this; ``phi`` follows the vector/dense convention of
+    :func:`woodbury_chi2_logdet`."""
+    nvec, cf, _ = _capacity(sigma, U, phi)
+    y2 = y if y.ndim == 2 else y[:, None]
+    ninv_y = y2 / nvec[:, None]
+    x = jax.scipy.linalg.cho_solve(cf, U.T @ ninv_y)
+    out = ninv_y - (U @ x) / nvec[:, None]
+    return out if y.ndim == 2 else out[:, 0]
 
 
 class WoodburyPre(NamedTuple):
@@ -79,16 +147,17 @@ def woodbury_precompute(sigma, U, phi):
     """Eagerly build the capacity-matrix Cholesky and logdet for
     constant (sigma, U, phi).  Call OUTSIDE jit with concrete arrays;
     the result is a small pytree whose in-trace footprint is (N, K) +
-    (K, K) constants instead of a foldable (N, K) x (N, K) matmul."""
-    phi = jnp.maximum(jnp.asarray(phi), _PHI_FLOOR)
+    (K, K) constants instead of a foldable (N, K) x (N, K) matmul.
+    ``phi`` may be a (K,) weight vector or a dense (K, K) prior
+    covariance (stacked GWB structure), like
+    :func:`woodbury_chi2_logdet`."""
     sigma = jnp.asarray(sigma)
     U = jnp.asarray(U)
-    nvec = sigma**2
-    sigma_cap = (U.T * (1.0 / nvec)[None, :]) @ U + jnp.diag(1.0 / phi)
-    chol = jax.scipy.linalg.cho_factor(sigma_cap, lower=True)[0]
+    nvec, cf, logdet_phi = _capacity(sigma, U, phi)
+    chol = cf[0]
     logdet = (
         jnp.sum(jnp.log(nvec))
-        + jnp.sum(jnp.log(phi))
+        + logdet_phi
         + 2.0 * jnp.sum(jnp.log(jnp.diag(chol)))
     )
     return WoodburyPre(nvec, U, chol, logdet)
@@ -118,16 +187,23 @@ def gls_normal_solve(r, J, sigma, U, phi, pre=None):
     pre: optional :class:`WoodburyPre` for the chi^2 evaluation when
     (sigma, U, phi) are trace-time constants (the chi^2-grid path) —
     keeps XLA from constant-folding the capacity matrix per compile.
+
+    ``phi`` may be a (K,) weight vector or a dense (K, K) prior
+    covariance (stacked cross-pulsar GWB structure) — the inverse
+    prior enters the normal matrix as a block either way.
     """
-    phi = jnp.maximum(phi, _PHI_FLOOR)
     n_par = J.shape[1]
     M = jnp.concatenate([J, U], axis=1) if U.shape[1] else J
     nvec = sigma**2
     mtn = (M * (1.0 / nvec)[:, None]).T
-    phi_inv_full = jnp.concatenate(
-        [jnp.zeros(n_par), 1.0 / phi]
-    ) if U.shape[1] else jnp.zeros(n_par)
-    mtcm = mtn @ M + jnp.diag(phi_inv_full)
+    if U.shape[1]:
+        phi_inv, _ = _phi_terms(phi)
+        nb = U.shape[1]
+        phi_inv_full = jnp.zeros(
+            (n_par + nb, n_par + nb)).at[n_par:, n_par:].set(phi_inv)
+    else:
+        phi_inv_full = jnp.zeros((n_par, n_par))
+    mtcm = mtn @ M + phi_inv_full
     rhs = mtn @ r
     # column normalization for conditioning (reference
     # normalize_designmatrix, utils.py:2879)

@@ -148,7 +148,12 @@ class MCMCFitter:
         return ("mcmc.lnposterior",
                 _cc.model_structure_key(self.model),
                 tuple(self.param_names), self._n_template,
-                _cc.fingerprint((self._base, self.weights, tpl, priors)))
+                _cc.fingerprint((self._base, self.weights, tpl, priors,
+                                 # the photon dataset itself: lnposterior
+                                 # closes over prepared.batch, so two
+                                 # same-config fitters on different
+                                 # events must NOT share a trace
+                                 self.prepared.batch)))
 
     # -- driver ---------------------------------------------------------------
     def lnlike_only(self, vec):

@@ -42,6 +42,7 @@ __all__ = [
     "create_quantization_matrix",
     "powerlaw",
     "fourier_basis",
+    "toa_fourier_basis",
 ]
 
 #: 1/yr in Hz, the reference's fyr constant (noise_model.py:905)
@@ -100,6 +101,17 @@ def fourier_basis(t_s, nmodes: int, tspan_s=None) -> Tuple[np.ndarray, np.ndarra
     F[:, ::2] = np.sin(2 * np.pi * t_s[:, None] * freqs[::2])
     F[:, 1::2] = np.cos(2 * np.pi * t_s[:, None] * freqs[1::2])
     return F, freqs
+
+
+def toa_fourier_basis(toas, nmodes: int, tspan_s=None):
+    """Fourier design matrix of a TOAs object on the absolute TDB
+    second axis — THE shared implementation behind every red-noise
+    basis in the tree (per-pulsar power-law components here, and the
+    cross-pulsar common process / GWB injection in
+    :mod:`pint_tpu.gw`, which pass the array-wide ``tspan_s`` so all
+    pulsars share one coherent frequency comb)."""
+    t = toas.ticks.astype(np.float64) / 2**32
+    return fourier_basis(t, nmodes, tspan_s=tspan_s)
 
 
 def powerlaw(f, amp, gamma):
@@ -342,9 +354,8 @@ class _PLNoiseBase(NoiseComponent):
         return np.ones_like(freq_mhz)
 
     def prepare(self, toas, model):
-        t = toas.ticks.astype(np.float64) / 2**32
         nf = self._nmodes(model)
-        F, freqs = fourier_basis(t, nf)
+        F, freqs = toa_fourier_basis(toas, nf)
         F = F * self._freq_scaling(model, toas.freq_mhz)[:, None]
         return {"basis": F, "freqs": freqs, "df": freqs[0]}
 

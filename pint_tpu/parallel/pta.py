@@ -735,3 +735,28 @@ class PTABatch:
     @property
     def dof(self):
         return np.asarray(self.n_toas) - len(self.free_names) - 1
+
+    # -- cross-pulsar GW engine hooks -----------------------------------------
+    def sky_positions(self):
+        """(n_pulsars, 3) SSB->pulsar unit vectors — the geometry the
+        ORF matrices of :mod:`pint_tpu.gw.orf` are built from."""
+        from pint_tpu.gw.orf import pulsar_positions
+
+        return pulsar_positions([p.model for p in self.prepareds])
+
+    def optimal_statistic(self, **kwargs):
+        """A :class:`pint_tpu.gw.OptimalStatistic` over this batch's
+        prepared pulsars (residuals/noise at current values — call
+        after :meth:`fit_wls`/:meth:`fit_gls` for post-fit
+        statistics).  kwargs: nmodes, gamma, orf, tspan_s,
+        marginalize_timing."""
+        from pint_tpu.gw.os import OptimalStatistic
+
+        return OptimalStatistic(batch=self, **kwargs)
+
+    def common_process(self, **kwargs):
+        """A :class:`pint_tpu.gw.CommonProcess` likelihood over this
+        batch (kwargs: nmodes, orf, tspan_s, marginalize_timing)."""
+        from pint_tpu.gw.common import CommonProcess
+
+        return CommonProcess(batch=self, **kwargs)

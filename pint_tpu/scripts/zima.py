@@ -26,6 +26,12 @@ def main(argv=None):
     p.add_argument("--addcorrnoise", action="store_true",
                    help="add a correlated-noise realization from the "
                         "model's ECORR/red/DM noise components")
+    p.add_argument("--gwbamp", type=float, default=None,
+                   help="inject a GWB realization at this amplitude "
+                        "(linear, e.g. 2e-15; a negative value is "
+                        "read as log10)")
+    p.add_argument("--gwbgamma", type=float, default=13.0 / 3.0,
+                   help="GWB spectral index (default 13/3)")
     p.add_argument("--wideband", action="store_true")
     p.add_argument("--dmerror", type=float, default=1e-4)
     p.add_argument("--inputtim", default=None,
@@ -66,6 +72,15 @@ def main(argv=None):
             multifreq=args.multifreq, add_correlated=args.addcorrnoise,
             rng=rng,
         )
+    if args.gwbamp is not None:
+        from pint_tpu.simulation import add_gwb
+
+        # a single-pulsar "array": the 1x1 ORF is the pure
+        # auto-correlation — a GWB-spectrum red-noise realization
+        add_gwb([toas], [model], args.gwbamp, gamma=args.gwbgamma,
+                rng=rng)
+        print(f"injected GWB realization (amp={args.gwbamp!r}, "
+              f"gamma={args.gwbgamma:.3f})")
     write_tim(toas, args.timfile)
     print(f"wrote {len(toas)} simulated TOAs to {args.timfile}")
     if args.plot:

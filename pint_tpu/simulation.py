@@ -15,8 +15,22 @@ from pint_tpu.residuals import Residuals
 from pint_tpu.toa import TOA, TOAs
 
 __all__ = ["make_fake_toas_uniform", "make_fake_toas_fromMJDs",
-           "make_fake_toas_fromtim", "add_correlated_noise",
+           "make_fake_toas_fromtim", "make_fake_pta",
+           "pta_white_noise_seed", "pta_injection_seed",
+           "gwb_amp_linear", "add_correlated_noise", "add_gwb",
            "zero_residuals", "calculate_random_models"]
+
+
+def _as_rng(rng, default_seed=0):
+    """Normalize an rng argument: None -> default_rng(default_seed),
+    int seed -> default_rng(seed), Generator passes through.  An int
+    seed of 0 is honored (the old ``rng or default_rng(0)`` idiom would
+    treat a passed-in 0 as falsy)."""
+    if rng is None:
+        return np.random.default_rng(default_seed)
+    if isinstance(rng, (int, np.integer)):
+        return np.random.default_rng(int(rng))
+    return rng
 
 
 def zero_residuals(toas: TOAs, model, iterations=2):
@@ -67,7 +81,7 @@ def make_fake_toas_uniform(
     make_fake_toas_uniform add_correlated_noise path)."""
     mjds = np.linspace(float(start_mjd), float(end_mjd), int(ntoas))
     if fuzz_days:
-        rng = rng or np.random.default_rng(0)
+        rng = _as_rng(rng)
         fuzz = rng.normal(0.0, float(fuzz_days), int(ntoas))
         mjds = np.sort(np.clip(mjds + fuzz, float(start_mjd),
                                float(end_mjd)))
@@ -124,7 +138,7 @@ def _apply_noise_products(toas, model, add_noise, wideband, dm_error,
     TOA's own error), wideband -pp_dm/-pp_dme flags, correlated
     realization."""
     if add_noise:
-        rng = rng or np.random.default_rng(0)
+        rng = _as_rng(rng)
         noise = rng.standard_normal(len(toas)) * toas.error_us * 1e-6
         toas.ticks = toas.ticks + np.round(noise * 2**32).astype(np.int64)
         toas._compute_posvels()
@@ -134,7 +148,7 @@ def _apply_noise_products(toas, model, add_noise, wideband, dm_error,
             prepared.total_dm_fn(prepared._values_pytree())
         )
         if add_noise:
-            rng = rng or np.random.default_rng(0)
+            rng = _as_rng(rng)
             dm = dm + rng.standard_normal(len(toas)) * dm_error
         for i, f in enumerate(toas.flags):
             f["pp_dm"] = repr(float(dm[i]))
@@ -150,7 +164,12 @@ def add_correlated_noise(toas: TOAs, model, rng=None):
     simulation.py add_correlated_noise): draw c = U @ (sqrt(phi) * z)
     with z ~ N(0, 1) over the noise basis U and weights phi.  Raises
     when the model has no correlated components (like the reference) —
-    a silent no-op would let --addcorrnoise lie about its output."""
+    a silent no-op would let --addcorrnoise lie about its output.
+
+    ``rng`` may be a Generator, an int seed (0 included), or None
+    (seed 0).  Returns ``(toas, noise_sec)`` — the exact drawn
+    realization [s] per TOA, so injection tests can assert against the
+    draw instead of reverse-engineering it from the ticks."""
     if not model.has_correlated_errors:
         raise ValueError(
             "add_correlated_noise: the model has no correlated-noise "
@@ -160,13 +179,141 @@ def add_correlated_noise(toas: TOAs, model, rng=None):
     values = r._values()
     U = np.asarray(r.prepared.noise_basis)
     phi = np.asarray(r.prepared.noise_weights_fn(values))
-    rng = rng or np.random.default_rng(0)
+    rng = _as_rng(rng)
     z = rng.standard_normal(U.shape[1])
     noise_sec = U @ (np.sqrt(np.maximum(phi, 0.0)) * z)
     toas.ticks = toas.ticks + np.round(
         noise_sec * 2**32).astype(np.int64)
     toas._compute_posvels()
-    return toas
+    return toas, noise_sec
+
+
+def make_fake_pta(n_psr, ntoa, start_mjd=53000.0, duration_days=3000.0,
+                  error_us=1.0, seed=0, extra_par="", obs="@",
+                  name_prefix="FAKE", f0_base=100.0, f0_step=10.0):
+    """A sky-scattered synthetic pulsar array: ``[(model, toas), ...]``,
+    deterministic in ``seed`` — THE shared builder behind every
+    synthetic-PTA consumer (the ``pintgw`` CLI's --simulate mode, the
+    bench.py OS metric, the multichip dry run, and tests), so the par
+    template and sky-scatter formulas exist once.
+
+    Pulsar i sits at RA ``i * 24h / n_psr`` and declination
+    ``(i * 37) % 120 - 60`` degrees (a deterministic scatter with no
+    two pulsars co-located for n_psr <= 120 — the Hellings–Downs curve
+    gets sampled across its full range).  ``extra_par`` appends par
+    lines to every pulsar (e.g. TNRed* intrinsic red noise); per-TOA
+    white noise is drawn from ``default_rng(seed * 1000 + i)``.
+
+    A caller that then injects signals (``add_gwb``) must draw from a
+    DISJOINT stream — the convention is ``rng = seed * 1000 + n_psr``
+    (see :func:`pta_injection_seed`): reusing the bare ``seed`` would
+    make the injection draw bit-identical normals to pulsar 0's white
+    noise at seed 0.
+    """
+    from pint_tpu.models.builder import get_model
+
+    mid = start_mjd + duration_days / 2.0
+    pairs = []
+    for i in range(int(n_psr)):
+        ra_h = (i * 24.0 / n_psr) % 24
+        dec = int(((i * 37) % 120) - 60)
+        par = (f"PSR {name_prefix}{i:02d}\nRAJ {int(ra_h):02d}:"
+               f"{int((ra_h % 1) * 60):02d}:00\nDECJ {dec:+03d}:00:00\n"
+               f"F0 {f0_base + f0_step * i!r} 1\nF1 -1e-15 1\n"
+               f"PEPOCH {mid:.1f}\nDM {10 + i * 0.5}\n"
+               f"TZRMJD {mid:.1f}\nTZRSITE @\nTZRFRQ 1400\n"
+               f"UNITS TDB\nEPHEM builtin\n" + extra_par)
+        m = get_model(par)
+        toas = make_fake_toas_uniform(
+            start_mjd, start_mjd + duration_days, ntoa, m, obs=obs,
+            error_us=error_us, add_noise=True,
+            rng=np.random.default_rng(pta_white_noise_seed(seed, i)))
+        pairs.append((m, toas))
+    return pairs
+
+
+def pta_white_noise_seed(seed, i) -> int:
+    """Pulsar i's white-noise stream seed in a synthetic array — THE
+    convention :func:`make_fake_pta` draws from, shared so external
+    TOA builders (the pintgw par-file path) stay disjoint from
+    :func:`pta_injection_seed` by construction."""
+    return int(seed) * 1000 + int(i)
+
+
+def pta_injection_seed(seed, n_psr) -> int:
+    """The injection-stream seed matching a :func:`make_fake_pta`
+    array: disjoint from every per-pulsar white-noise stream
+    (:func:`pta_white_noise_seed`, i < n_psr)."""
+    return pta_white_noise_seed(seed, n_psr)
+
+
+def gwb_amp_linear(amp) -> float:
+    """THE amp-argument convention of the GWB surface (add_gwb, the
+    pintgw CLI, zima --gwbamp): linear when positive, log10 when
+    negative.  amp = 0 means a zero-amplitude injection."""
+    amp = float(amp)
+    return 10.0 ** amp if amp < 0 else amp
+
+
+def add_gwb(toas_list, models, amp, gamma=13.0 / 3.0, rng=None,
+            nmodes=30, tspan_s=None, orf="hd"):
+    """Inject one realization of an ORF-correlated gravitational-wave
+    background across a whole pulsar array, in place.
+
+    Draws Fourier coefficients with the exact cross-pulsar covariance
+    ``Gamma (x) diag(phi)`` — ``a[p, i] = sum_q L[p, q] sqrt(phi_i)
+    z[q, i]`` with ``L`` the Cholesky factor of the (N, N) ORF matrix
+    of the array's sky positions and ``phi`` the power-law prior
+    weights at (amp, gamma) — then adds ``F_p @ a[p]`` to each
+    pulsar's TOA ticks.  All pulsars share one frequency comb
+    ``k / T`` over the array-wide span on the absolute TDB time axis,
+    so the injected process is phase-coherent across the array — the
+    signal the optimal statistic (:mod:`pint_tpu.gw.os`) estimates.
+
+    amp: GWB characteristic-strain amplitude (linear; a negative value
+    is read as log10).  ``rng``: Generator | int seed | None (seed 0).
+    Returns ``(noise_sec_list, coeffs)``: the per-pulsar injected
+    series [s] and the (N, 2*nmodes) coefficient draw, so tests can
+    assert against the exact realization.
+    """
+    from pint_tpu.gw.common import common_tspan_s, gwb_phi
+    from pint_tpu.gw.orf import orf_matrix, pulsar_positions
+    from pint_tpu.models.noise import toa_fourier_basis
+    from pint_tpu.telemetry import span
+
+    if len(toas_list) != len(models) or not toas_list:
+        raise ValueError(
+            "add_gwb needs matched, non-empty toas_list and models")
+    amp = gwb_amp_linear(amp)
+    with span("gw.inject", n_pulsars=len(models), nmodes=nmodes,
+              amp=amp, gamma=float(gamma)):
+        T = float(tspan_s) if tspan_s else common_tspan_s(toas_list)
+        pos = pulsar_positions(models)
+        gam_mat = np.asarray(orf_matrix(pos, orf), dtype=np.float64)
+        # eigendecomposition instead of plain Cholesky: a pair of
+        # (near-)co-located pulsars makes the ORF matrix semidefinite
+        w, Q = np.linalg.eigh(gam_mat)
+        L = Q @ np.diag(np.sqrt(np.clip(w, 0.0, None)))
+        rng = _as_rng(rng)
+        n_psr = len(models)
+        phi = None
+        noise_list = []
+        z = None
+        coeffs = None
+        for k, toas in enumerate(toas_list):
+            F, freqs = toa_fourier_basis(toas, nmodes, tspan_s=T)
+            if phi is None:
+                phi = np.asarray(
+                    gwb_phi(freqs, amp, float(gamma), freqs[0]),
+                    dtype=np.float64)
+                z = rng.standard_normal((n_psr, len(freqs)))
+                coeffs = (L @ z) * np.sqrt(phi)[None, :]
+            noise_sec = F @ coeffs[k]
+            toas.ticks = toas.ticks + np.round(
+                noise_sec * 2**32).astype(np.int64)
+            toas._compute_posvels()
+            noise_list.append(noise_sec)
+    return noise_list, coeffs
 
 
 def make_fake_toas_fromtim(timfile, model, add_noise=False, rng=None,
@@ -204,7 +351,7 @@ def calculate_random_models(fitter, toas, n_models=100, rng=None,
     cov = np.asarray(fitter.covariance)
     names = list(getattr(fitter, "_traced_free", model.free_params))
     center = np.array([model.values[k] for k in names])
-    rng = rng or np.random.default_rng(0)
+    rng = _as_rng(rng)
     # sample via Cholesky with a jitter fallback for semi-definite cov
     try:
         L = np.linalg.cholesky(cov)
