@@ -564,6 +564,92 @@ def bench_os(jnp, backend):
     })
 
 
+def bench_guard(jnp, backend):
+    """Guard overhead: steady-state wall of ONE jitted GLS step with
+    the health pytree riding the program (PINT_TPU_GUARD default) vs
+    the identical step with the guard compiled out (PINT_TPU_GUARD=0 —
+    a different registry entry, same shapes).  Timed at the device
+    boundary (block_until_ready on the raw step), min-of-reps — the
+    whole-fit wall is dominated by host Python whose same-host
+    variance (PERF.md) swamps a percent-level effect.  The acceptance
+    budget is <2% (the health record is a handful of isfinite
+    reductions next to an eigh/SVD)."""
+    import os
+
+    import jax
+
+    from pint_tpu.fitter import GLSFitter
+    from pint_tpu.models.builder import get_model
+
+    n_toas = 2000
+    reps = 30
+
+    def build(model, toas, cls=GLSFitter):
+        f = cls(toas, model)
+        vec = jnp.array([model.values[k] for k in f._traced_free])
+        base = f.prepared._values_pytree()
+        # vec + 0.0 below: a fresh buffer per call — the step donates
+        # arg0 on TPU/GPU, so reusing one buffer would error there
+        jax.block_until_ready(f._step_jit(vec + 0.0, base,
+                                          f._fit_data))
+        return f, vec, base
+
+    def timed_step(f, vec, base):
+        t0 = time.time()
+        jax.block_until_ready(f._step_jit(vec + 0.0, base,
+                                          f._fit_data))
+        return time.time() - t0
+
+    class _ControlGLS(GLSFitter):
+        """Same code, different registry key (class name is in the
+        step key) — a SECOND independently-compiled guarded executable.
+        The A/A difference between it and the primary guarded step is
+        the measurement's noise floor (XLA code-layout luck between
+        recompiles of identical semantics), recorded so a noisy host
+        can't be misread as guard cost."""
+
+    model = get_model(B1855_LIKE_PAR)
+    toas = _sim_two_band(model, n_toas)
+    prev = os.environ.pop("PINT_TPU_GUARD", None)
+    try:
+        on = build(model, toas)
+        on2 = build(get_model(B1855_LIKE_PAR), toas,
+                    cls=_ControlGLS)
+        os.environ["PINT_TPU_GUARD"] = "0"
+        off = build(get_model(B1855_LIKE_PAR), toas)
+    finally:
+        if prev is None:
+            os.environ.pop("PINT_TPU_GUARD", None)
+        else:
+            os.environ["PINT_TPU_GUARD"] = prev
+    # interleaved A/B/A': same-host load drift (PERF.md variance note)
+    # hits all variants identically; min-of-reps is the floor each
+    # can reach
+    t_on, t_off, t_on2 = [], [], []
+    for _ in range(reps):
+        t_on.append(timed_step(*on))
+        t_off.append(timed_step(*off))
+        t_on2.append(timed_step(*on2))
+    wall_on, wall_off = min(t_on), min(t_off)
+    overhead_pct = (wall_on - wall_off) / wall_off * 100.0
+    noise_pct = abs(min(t_on2) - wall_on) / wall_on * 100.0
+    _emit_metric({
+        "metric": "guard_overhead",
+        "value": round(overhead_pct, 2),
+        "unit": f"% per-step overhead of the numerical-health guard "
+                f"(one jitted GLS step, {n_toas} TOAs, min of {reps} "
+                f"reps: {wall_on*1e3:.2f}ms guarded vs "
+                f"{wall_off*1e3:.2f}ms unguarded; A/A recompile noise "
+                f"floor {noise_pct:.1f}%, budget <2% above floor, "
+                f"backend={backend})",
+        "vs_baseline": round(overhead_pct / 2.0, 2),
+        "backend": backend,
+        "compile_s": None,
+        "flops": None,
+        "noise_floor_pct": round(noise_pct, 2),
+    })
+
+
 #: run order: the roofline first (its measured matmul peak becomes the
 #: honest MFU denominator for everything after it), then
 #: proven-cheapest compile first, heaviest (GLS) last, so a mid-run
@@ -574,6 +660,7 @@ _METRICS = {
     "mcmc": bench_mcmc,
     "os": bench_os,
     "pta": bench_pta,
+    "guard_overhead": bench_guard,
     "gls": bench_gls,
 }
 

@@ -172,6 +172,16 @@ def datacheck_report(ephem="builtin", sites=("gbt", "ao", "jb", "pks",
         f"backend compiles {cs['backend_events']} / "
         f"{cs['backend_seconds']:.2f}s, disk-cache hits "
         f"{cs['cache_hits']} saving {cs['cache_saved_seconds']:.2f}s)")
+    from pint_tpu import guard as _guard
+
+    lines.append(
+        f"  numerical guard: {'on' if _guard.enabled() else 'OFF'} "
+        f"($PINT_TPU_GUARD); checks "
+        f"{int(telemetry.counter_get('guard.checks'))}, trips "
+        f"{int(telemetry.counter_get('guard.trips'))}, checkpoints "
+        f"{int(telemetry.counter_get('guard.checkpoint_saves'))} "
+        f"saved / {int(telemetry.counter_get('guard.checkpoint_resumes'))} "
+        "resumed")
     for tline in _last_session_compile_lines():
         lines.append(tline)
 
@@ -236,6 +246,98 @@ def _gw_section(n_psr=3, ntoa=24):
         return [f"GW engine: ERROR {type(e).__name__}: {e}"]
 
 
+def _faults_section():
+    """Chaos smoke: inject each fast fault class and verify the guard
+    layer's contract — structured FitDivergedError for bad inputs, a
+    documented recovery rung for degenerate priors, a loud parse error
+    for corrupted clock tables.  Diagnostic: reports, never raises."""
+    from pint_tpu import faults, guard
+
+    lines = ["Fault-injection smoke (--faults):"]
+
+    def record(name, what, ok):
+        lines.append(f"  {name}: {what} -> "
+                     f"{'OK' if ok else 'PROBLEM'}")
+
+    try:
+        import tempfile
+
+        import numpy as np
+
+        from pint_tpu.compile_cache import WARM_WLS_PAR
+        from pint_tpu.fitter import WLSFitter
+        from pint_tpu.models.builder import get_model
+        from pint_tpu.simulation import make_fake_toas_uniform
+
+        def tiny_fit():
+            m = get_model(WARM_WLS_PAR)
+            t = make_fake_toas_uniform(
+                53000.0, 54000.0, 40, m, freq_mhz=1400.0, obs="gbt",
+                error_us=1.0, add_noise=True,
+                rng=np.random.default_rng(0))
+            return WLSFitter(t, m)
+
+        for fault in ("nan_resid", "inf_sigma"):
+            faults.clear()
+            faults.inject(fault, index=3)
+            try:
+                try:
+                    tiny_fit().fit_toas(maxiter=2)
+                    record(fault, "fit returned (should have raised)",
+                           False)
+                except guard.FitDivergedError as e:
+                    record(fault,
+                           f"structured FitDivergedError, last_good "
+                           f"kept ({len(e.last_good or {})} params)",
+                           True)
+            finally:
+                faults.clear()
+
+        from pint_tpu.gw import CommonProcess
+        from pint_tpu.simulation import make_fake_pta
+
+        faults.inject("rank_deficient_phi")
+        try:
+            crn = CommonProcess(
+                make_fake_pta(3, 20, start_mjd=54000.0,
+                              duration_days=900.0,
+                              name_prefix="FLTCHK"), nmodes=3)
+            v = crn.lnlike(-14.0, 4.0)
+            record("rank_deficient_phi",
+                   f"lnlike finite via dense-phi jitter ({v:.1f})",
+                   bool(np.isfinite(v)))
+        except guard.FitDivergedError:
+            record("rank_deficient_phi",
+                   "FitDivergedError (jitter rung did not recover)",
+                   False)
+        finally:
+            faults.clear()
+
+        from pint_tpu.obs.clock import ClockFile
+
+        faults.inject("clock_corrupt")
+        try:
+            with tempfile.NamedTemporaryFile(
+                    "w", suffix=".clk", delete=False) as f:
+                f.write("# SITE UTC(GPS)\n50000.0 1e-6\n51000.0 2e-6\n")
+                path = f.name
+            try:
+                ClockFile.read_tempo2(path)
+                record("clock_corrupt",
+                       "parsed silently (should have raised)", False)
+            except ValueError:
+                record("clock_corrupt",
+                       "structured ValueError (no silent NaN "
+                       "interpolation)", True)
+            os.unlink(path)
+        finally:
+            faults.clear()
+    except Exception as e:  # the smoke must never take the report down
+        faults.clear()
+        lines.append(f"  ERROR {type(e).__name__}: {e}")
+    return lines
+
+
 def _last_session_compile_lines():
     """Compile/span stats aggregated from the $PINT_TPU_TRACE file, if
     one exists and parses.  The sink appends, so the totals cover every
@@ -288,9 +390,16 @@ def main(argv=None):
                    help="AOT-compile a small standard fit shape into "
                         "the persistent cache after the report "
                         "(pintwarm does the full shape sweep)")
+    p.add_argument("--faults", action="store_true",
+                   help="run the fault-injection smoke: each fast "
+                        "fault class must recover via a documented "
+                        "ladder rung or raise a structured error")
     args = p.parse_args(argv)
     for line in datacheck_report(args.ephem):
         print(line)
+    if args.faults:
+        for line in _faults_section():
+            print(line)
     if args.warm:
         from pint_tpu import compile_cache
 

@@ -15,11 +15,14 @@ scipy Newton-CG; here autodiff replaces the hand derivatives) and
 
 from __future__ import annotations
 
+import warnings
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from pint_tpu import compile_cache as _cc
+from pint_tpu import guard as _guard
 from pint_tpu import telemetry
 from pint_tpu.fitter import Fitter, GLSFitter, WLSFitter, WidebandTOAFitter
 from pint_tpu.telemetry import span
@@ -48,7 +51,7 @@ class _DownhillMixin:
             self._halving_step,
             key=("downhill.halving", type(self).__name__,
                  self._traced_free, self.max_halvings,
-                 getattr(self, "threshold", None),
+                 getattr(self, "threshold", None), self._guard_on,
                  self.resids._structure_key()),
             donate_argnums=_cc.donation_argnums((0,)))
 
@@ -70,9 +73,12 @@ class _DownhillMixin:
     def _halving_step(self, vec, base_values, data):
         """Propose dpar at vec, then find the largest lambda in
         {1, 1/2, 1/4, ...} whose step decreases chi^2.  Returns
-        (new_vec, chi2_old, chi2_new, cov)."""
-        new_vec, chi2_old, dpar, cov = self._propose(vec, base_values,
-                                                     data)
+        (new_vec, chi2_old, chi2_new, cov, health) — health is the
+        propose step's guard record (empty tuple with the guard off);
+        the in-trace halving itself is divergence-tolerant (a NaN
+        chi^2 keeps halving, below)."""
+        new_vec, chi2_old, dpar, cov, health = self._propose(
+            vec, base_values, data)
 
         def chi2_of(v):
             return self._chi2_at(self._merged(base_values, v), data)
@@ -105,7 +111,38 @@ class _DownhillMixin:
         ok = chi2_new < chi2_old
         lam = jnp.where(ok, lam, 0.0)
         chi2_new = jnp.where(ok, chi2_new, chi2_old)
-        return vec + lam * dpar, chi2_old, chi2_new, cov
+        return vec + lam * dpar, chi2_old, chi2_new, cov, health
+
+    def _iterate(self, maxiter, guard_eps=0.0):
+        """One ladder rung of the downhill loop (fitter.Fitter._iterate
+        contract): the in-trace lambda-halving already rejects
+        chi^2-raising and NaN steps, so the guard's job here is the
+        propose-solve health plus last-good tracking."""
+        vec = jnp.array(
+            [self.model.values[k] for k in self._traced_free],
+            dtype=jnp.float64,
+        )
+        base = self.prepared._values_pytree()
+        data = self._guard_data(guard_eps)
+        cov = None
+        n_iter = 0
+        health = ()
+        self.converged = False
+        last_good = np.array(
+            [self.model.values[k] for k in self._traced_free])
+        for _ in range(maxiter):
+            vec_in = np.asarray(vec)  # pre-donation snapshot
+            vec, chi2_old, chi2_new, cov, health = self._halving_jit(
+                vec, base, data)
+            n_iter += 1
+            if np.isfinite(float(chi2_old)):
+                last_good = vec_in
+            self._check_step_health(health, last_good, n_iter)
+            if float(chi2_old) - float(chi2_new) \
+                    < self.min_chi2_decrease:
+                self.converged = True
+                break
+        return vec, cov, (), n_iter, health
 
     def fit_toas(self, maxiter=20, fit_noise=False, noise_maxiter=100):
         if not self.model.free_timing_params:
@@ -119,22 +156,9 @@ class _DownhillMixin:
                 self._retrace()
             else:
                 telemetry.counter_add("fitter.jit_cache_hits")
-            vec = jnp.array(
-                [self.model.values[k] for k in self._traced_free],
-                dtype=jnp.float64,
-            )
-            base = self.prepared._values_pytree()
-            cov = None
-            n_iter = 0
-            self.converged = False
-            for _ in range(maxiter):
-                vec, chi2_old, chi2_new, cov = self._halving_jit(
-                    vec, base, self._fit_data)
-                n_iter += 1
-                if float(chi2_old) - float(chi2_new) \
-                        < self.min_chi2_decrease:
-                    self.converged = True
-                    break
+            (vec, cov, _extras, n_iter, health), rung = \
+                _guard.run_ladder(self._guard_rungs(maxiter),
+                                  context=type(self).__name__)
             vec = np.asarray(vec)
             cov_np = np.asarray(cov)
             telemetry.record_transfer(vec)
@@ -150,6 +174,7 @@ class _DownhillMixin:
             telemetry.counter_add("fit.flops_est", flops_est)
             sp.set(n_iter=n_iter, converged=self.converged,
                    flops_est=flops_est)
+            self._record_guard(rung, health, sp)
             self._update_fit_meta()
             self._post_fit()
         if fit_noise:
@@ -201,21 +226,65 @@ class _DownhillMixin:
                 fun, x, jac=True, method="L-BFGS-B",
                 options={"maxiter": maxiter},
             )
+        # a DIVERGED L-BFGS-B (non-finite optimum) must never poison
+        # model.values: keep the last-good (input) values, flag, warn.
+        # Mere maxiter exhaustion (success=False, status=1, finite
+        # improved x) is NOT divergence — discarding the finite
+        # optimum would regress the pre-guard behavior; it writes back
+        # with noise_fit_ok=False and a "not_converged" flag instead.
+        diverged = (not np.all(np.isfinite(res.x))
+                    or not np.isfinite(res.fun))
+        self.noise_fit_ok = bool(res.success) and not diverged
+        if diverged:
+            telemetry.counter_add("guard.trips")
+            telemetry.counter_add("guard.trip.noise_fit")
+            self.model.meta["GUARD_NOISE_FIT"] = "diverged"
+            self.noise_covariance = None
+            warnings.warn(
+                f"fit_noise diverged (success={res.success}, "
+                f"fun={res.fun!r}); keeping pre-fit noise values — see "
+                "model.meta['GUARD_NOISE_FIT']")
+            return -np.inf
+        if not res.success:
+            telemetry.counter_add("guard.trip.noise_fit_not_converged")
+            self.model.meta["GUARD_NOISE_FIT"] = "not_converged"
+            warnings.warn(
+                f"fit_noise did not converge ({res.message}); writing "
+                "back the finite partial optimum — see "
+                "model.meta['GUARD_NOISE_FIT']")
+        else:
+            # a later clean fit clears the flag (the meta lands in the
+            # output par file and must describe THIS fit)
+            self.model.meta.pop("GUARD_NOISE_FIT", None)
         x = res.x
         for i, n in enumerate(names):
             self.model.values[n] = float(x[i])
-        # uncertainties: inverse Hessian of -lnL at the optimum
+        # uncertainties: inverse Hessian of -lnL at the optimum.  A
+        # NaN/inf Hessian passes np.linalg.inv WITHOUT LinAlgError and
+        # yields garbage uncertainties — pinv with an explicit
+        # finiteness check, and noise_covariance = None plus a
+        # diagnostic when it fails
         H = np.asarray(
             jax.hessian(lambda v: neg_lnl(v, base, data))(jnp.asarray(x)))
-        try:
-            hinv = np.linalg.inv(H)
+        hinv = None
+        if np.all(np.isfinite(H)):
+            try:
+                hinv = np.linalg.pinv(H)
+            except np.linalg.LinAlgError:
+                hinv = None
+        if hinv is not None and np.all(np.isfinite(hinv)):
             errs = np.sqrt(np.clip(np.diag(hinv), 0, None))
             params = self.model.params
             for i, n in enumerate(names):
                 params[n].uncertainty = float(errs[i])
             self.noise_covariance = hinv
-        except np.linalg.LinAlgError:
+        else:
             self.noise_covariance = None
+            telemetry.counter_add("guard.trip.noise_hessian")
+            warnings.warn(
+                "fit_noise: non-finite/singular Hessian at the optimum "
+                "— noise uncertainties not updated "
+                "(noise_covariance=None)")
         return -float(res.fun)
 
 
@@ -223,20 +292,20 @@ class DownhillWLSFitter(_DownhillMixin, WLSFitter):
     """Step-halving WLS (reference DownhillWLSFitter, fitter.py:1379)."""
 
     def _propose(self, vec, base_values, data):
-        new_vec, chi2, dpar, cov = WLSFitter._step(
+        new_vec, chi2, dpar, cov, health = WLSFitter._step(
             self, vec, base_values, data)
         return new_vec, \
             self._chi2_at(self._merged(base_values, vec), data), \
-            dpar, cov
+            dpar, cov, health
 
 
 class DownhillGLSFitter(_DownhillMixin, GLSFitter):
     """Step-halving GLS (reference DownhillGLSFitter, fitter.py:1527)."""
 
     def _propose(self, vec, base_values, data):
-        new_vec, chi2, dpar, cov, _ = GLSFitter._step(
+        new_vec, chi2, dpar, cov, _ncoef, health = GLSFitter._step(
             self, vec, base_values, data)
-        return new_vec, chi2, dpar, cov
+        return new_vec, chi2, dpar, cov, health
 
 
 class WidebandDownhillFitter(_DownhillMixin, WidebandTOAFitter):
@@ -244,7 +313,6 @@ class WidebandDownhillFitter(_DownhillMixin, WidebandTOAFitter):
     fitter.py:1812)."""
 
     def _propose(self, vec, base_values, data):
-        new_vec, chi2, dpar, cov, _ = WidebandTOAFitter._step(
-            self, vec, base_values, data
-        )
-        return new_vec, chi2, dpar, cov
+        new_vec, chi2, dpar, cov, _ncoef, health = \
+            WidebandTOAFitter._step(self, vec, base_values, data)
+        return new_vec, chi2, dpar, cov, health

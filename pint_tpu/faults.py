@@ -1,0 +1,195 @@
+"""Deterministic fault-injection harness (``$PINT_TPU_FAULTS``).
+
+Every degradation path of the guard layer (:mod:`pint_tpu.guard`) is
+exercised by chaos tests instead of trusted on faith: this module
+injects known fault classes at the library's host-side data boundaries
+— never inside a traced function, so a fault-active dataset is just
+different *data* under the same shared trace and can never poison the
+jit registry.
+
+Fault classes (spec grammar: comma-separated ``name[:key=val...]``):
+
+- ``nan_resid[:index=K]`` — NaN one TOA's observing frequency, making
+  that row's dispersion delay (and, through the weighted mean, every
+  residual) NaN: the classic corrupted-input fit.  Applied where
+  :class:`pint_tpu.residuals.Residuals` builds its dataset pytree.
+- ``inf_sigma[:index=K]`` — one TOA uncertainty becomes +inf (a
+  corrupted ``.tim`` error column).  Same hook.
+- ``rank_deficient_phi`` — the cross-pulsar ORF matrix becomes the
+  all-ones rank-1 matrix, giving the dense GW prior an exact null
+  space (the monopole-ORF degeneracy class the per-diagonal Cholesky
+  jitter in ``linalg._phi_terms`` exists for).  Applied where
+  :class:`pint_tpu.gw.common.CommonProcess` builds its ORF.
+- ``clock_corrupt[:index=K]`` — one parsed clock-file row's offset
+  becomes NaN (a corrupted tabulation).  Applied in
+  ``ClockFile.read_tempo2``; the ``ClockFile`` finiteness validation
+  must turn it into a structured error, never silent NaN
+  interpolation.
+- ``kill[:after=N][:site=S][:code=C]`` — deterministic process death:
+  the Nth call to :func:`maybe_kill` at site ``S`` hard-exits (default
+  code 137), simulating a mid-chain kill for checkpoint/resume tests.
+
+Faults activate via the environment variable (read per call, so a
+subprocess harness controls them) or programmatically
+(:func:`inject`/:func:`clear` — tests MUST clear in teardown).  Every
+injection ticks ``faults.injected`` / ``faults.injected.<name>``.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from pint_tpu import telemetry
+
+__all__ = ["parse", "config", "active", "any_active", "inject", "clear",
+           "corrupt_batch", "corrupt_orf", "corrupt_clock_rows",
+           "maybe_kill"]
+
+ENV = "PINT_TPU_FAULTS"
+
+_programmatic: dict = {}
+_site_counts: dict = {}
+
+
+def _coerce(v: str):
+    for cast in (int, float):
+        try:
+            return cast(v)
+        except ValueError:
+            pass
+    return v
+
+
+def parse(spec: str) -> dict:
+    """``"nan_resid:index=3,kill:after=2:site=sampler.chunk"`` ->
+    ``{"nan_resid": {"index": 3}, "kill": {"after": 2, "site": ...}}``."""
+    out = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        bits = part.split(":")
+        params = {}
+        for b in bits[1:]:
+            k, _, v = b.partition("=")
+            params[k.strip()] = _coerce(v.strip())
+        out[bits[0].strip()] = params
+    return out
+
+
+def config() -> dict:
+    """Active faults: the env spec overlaid with programmatic ones."""
+    cfg = parse(os.environ.get(ENV, ""))
+    cfg.update(_programmatic)
+    return cfg
+
+
+def active(name):
+    """The fault's param dict when active, else None."""
+    return config().get(name)
+
+
+def any_active() -> bool:
+    return bool(config())
+
+
+def inject(name, **params):
+    """Activate a fault programmatically (tests/datacheck)."""
+    _programmatic[name] = params
+
+
+def clear():
+    """Deactivate every programmatic fault and reset kill counters."""
+    _programmatic.clear()
+    _site_counts.clear()
+
+
+def _tick(name):
+    telemetry.counter_add("faults.injected")
+    telemetry.counter_add(f"faults.injected.{name}")
+
+
+# --------------------------------------------------------------------------
+# hooks (each a no-op returning its input when the fault is inactive)
+# --------------------------------------------------------------------------
+
+def _batch_with(batch, **repl):
+    """Rebuild a TOABatch with replaced fields.  NOT ``_replace``:
+    TOABatch overrides ``__len__`` (TOA count), which breaks
+    NamedTuple._make's field-count sanity check."""
+    return type(batch)(**{**batch._asdict(), **repl})
+
+
+def _member_match(params, member):
+    """Batched-path targeting: a fault carrying ``pulsar=K`` applies
+    ONLY to batch member K — including never to a standalone
+    (member=None) dataset built while it is active; without the key it
+    applies everywhere."""
+    want = params.get("pulsar")
+    if want is None:
+        return True
+    return member is not None and int(want) == int(member)
+
+
+def corrupt_batch(batch, member=None):
+    """Apply ``nan_resid``/``inf_sigma`` to a TOABatch (host-side,
+    concrete arrays — the corrupted dataset flows through the shared
+    traces as ordinary dynamic data).  member: the pulsar index on the
+    batched PTA path (see :func:`_member_match`)."""
+    import jax.numpy as jnp
+
+    p = active("nan_resid")
+    if p is not None and _member_match(p, member):
+        idx = int(p.get("index", 0))
+        f = np.array(batch.freq_mhz, dtype=np.float64)
+        f[idx % max(f.shape[0], 1)] = np.nan
+        batch = _batch_with(batch, freq_mhz=jnp.asarray(f))
+        _tick("nan_resid")
+    p = active("inf_sigma")
+    if p is not None and _member_match(p, member):
+        idx = int(p.get("index", 0))
+        e = np.array(batch.error_s, dtype=np.float64)
+        e[idx % max(e.shape[0], 1)] = np.inf
+        batch = _batch_with(batch, error_s=jnp.asarray(e))
+        _tick("inf_sigma")
+    return batch
+
+
+def corrupt_orf(orf):
+    """``rank_deficient_phi``: replace the ORF with the all-ones rank-1
+    matrix (an exact null space in the dense kron(ORF, phi) prior)."""
+    if active("rank_deficient_phi") is not None:
+        import jax.numpy as jnp
+
+        _tick("rank_deficient_phi")
+        return jnp.ones_like(orf)
+    return orf
+
+
+def corrupt_clock_rows(mjds, offsets):
+    """``clock_corrupt``: NaN one parsed clock row's offset in place
+    (python lists, called from the clock-file parsers)."""
+    p = active("clock_corrupt")
+    if p is not None and offsets:
+        idx = int(p.get("index", len(offsets) // 2)) % len(offsets)
+        offsets[idx] = float("nan")
+        _tick("clock_corrupt")
+
+
+def maybe_kill(site):
+    """``kill``: hard-exit on the Nth call at the named site (default
+    site = any, after=1, code=137).  ``os._exit`` — no atexit, no
+    cleanup — the honest simulation of a SIGKILL mid-job."""
+    p = active("kill")
+    if p is None:
+        return
+    want = p.get("site")
+    if want is not None and want != site:
+        return
+    n = _site_counts[site] = _site_counts.get(site, 0) + 1
+    if n >= int(p.get("after", 1)):
+        _tick("kill")
+        telemetry.flush()
+        os._exit(int(p.get("code", 137)))

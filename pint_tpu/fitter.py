@@ -15,12 +15,15 @@ when the model has correlated noise, WLS otherwise.
 
 from __future__ import annotations
 
+import warnings
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from pint_tpu import compile_cache as _cc
 from pint_tpu import flops as _flops
+from pint_tpu import guard as _guard
 from pint_tpu import telemetry
 from pint_tpu.linalg import gls_normal_solve
 from pint_tpu.residuals import Residuals, WidebandTOAResiduals
@@ -34,12 +37,19 @@ __all__ = ["WLSFitter", "GLSFitter", "WidebandTOAFitter", "Fitter",
 telemetry._install_compile_listener()
 
 
-def wls_gn_solve(resid_fn, vec, err, threshold=1e-14):
+def wls_gn_solve(resid_fn, vec, err, threshold=1e-14, rcond=None,
+                 with_health=False):
     """One whitened, column-normalized SVD Gauss-Newton step.
 
     The shared numerical core of WLSFitter and the vmapped grid (one
     implementation, one threshold).  resid_fn(vec) -> residuals [s].
     Returns (new_vec, chi2_before, dpar, covariance).
+
+    rcond: optional traced scalar raising the singular-value cutoff
+    above ``threshold`` (the guard ladder's escalation — dynamic, so
+    it costs zero new compiles).  with_health: additionally return a
+    :class:`pint_tpu.guard.SolveDiag` from the SVD spectrum already in
+    hand.
     """
     r = resid_fn(vec)
     J = jax.jacfwd(resid_fn)(vec)  # (N, P) d resid / d param
@@ -52,13 +62,22 @@ def wls_gn_solve(resid_fn, vec, err, threshold=1e-14):
     Jn = Jw / norms[None, :]
     U, s, Vt = jnp.linalg.svd(Jn, full_matrices=False)
     smax = jnp.max(s)
-    s_inv = jnp.where(s > threshold * smax, 1.0 / s, 0.0)
+    cut = threshold if rcond is None else jnp.maximum(threshold, rcond)
+    s_inv = jnp.where(s > cut * smax, 1.0 / s, 0.0)
     dpar_n = -(Vt.T * s_inv[None, :]) @ (U.T @ rw)
     dpar = dpar_n / norms
     cov_n = (Vt.T * s_inv[None, :] ** 2) @ Vt
     cov = cov_n / jnp.outer(norms, norms)
     chi2 = jnp.sum(rw * rw)
-    return vec + dpar, chi2, dpar, cov
+    out = (vec + dpar, chi2, dpar, cov)
+    if with_health:
+        kept_min = jnp.min(jnp.where(s_inv > 0.0, s, smax))
+        diag = _guard.SolveDiag(
+            n_truncated=jnp.sum(s_inv == 0.0).astype(jnp.int32),
+            cond_log10=jnp.log10(smax / jnp.maximum(kept_min, 1e-300)),
+        )
+        out = out + (diag,)
+    return out
 
 
 class Fitter:
@@ -177,7 +196,13 @@ class Fitter:
         executable — zero new XLA compiles."""
         telemetry.counter_add("fitter.retraces")
         self._traced_free = tuple(self.model.free_timing_params)
-        self._fit_data = self.resids._data()
+        # the guard's escalation scalar rides the data pytree as a
+        # DYNAMIC leaf (precedent: n_real), so ladder rungs reuse the
+        # same trace; the on/off flag changes the traced program and is
+        # part of the key
+        self._guard_on = _guard.enabled()
+        self._fit_data = {**self.resids._data(),
+                          "guard_eps": np.float64(0.0)}
         self._step_jit = _cc.shared_jit(
             self._step, key=self._step_key(),
             donate_argnums=_cc.donation_argnums((0,)))
@@ -185,7 +210,7 @@ class Fitter:
     def _step_key(self):
         """Everything a trace of _step bakes in beyond the avals."""
         return ("fitter.step", type(self).__name__, self._traced_free,
-                getattr(self, "threshold", None),
+                getattr(self, "threshold", None), self._guard_on,
                 self.resids._structure_key())
 
     def warm_compile(self):
@@ -221,8 +246,117 @@ class Fitter:
             values[name] = vec[i]
         return values
 
+    # -- guard integration ----------------------------------------------------
+    #: degradation-ladder escalation values (guard.JITTER_RUNGS)
+    _guard_jitter_rungs = _guard.JITTER_RUNGS
+
+    def _last_good_dict(self, vec_np):
+        return {name: float(vec_np[i])
+                for i, name in enumerate(self._traced_free)}
+
+    def _check_step_health(self, health, last_good_np, n_iter):
+        """THE per-iteration health check every fitter loop shares
+        (plain/downhill/LM): one counter, one packed-``ok`` device
+        read, StepDiverged with the last finite-chi^2 state on a bad
+        verdict.  No-op with the guard off (empty health)."""
+        if not health:
+            return
+        telemetry.counter_add("guard.checks")
+        if _guard.verdict(health) != "ok":
+            raise _guard.StepDiverged(
+                health, last_good=self._last_good_dict(last_good_np),
+                n_iter=n_iter)
+
+    def _guard_data(self, guard_eps):
+        if guard_eps == 0.0:
+            return self._fit_data
+        return {**self._fit_data, "guard_eps": np.float64(guard_eps)}
+
+    def _guard_rungs(self, maxiter):
+        """The degradation ladder for this fitter: baseline, then (when
+        the guard is on) escalating jitter, then an optional downgrade
+        (GLS fitters fall back to a WLS solve — `_downgrade_rung`)."""
+        rungs = [("baseline", lambda: self._iterate(maxiter))]
+        if self._guard_on:
+            for name, eps in self._guard_jitter_rungs:
+                rungs.append(
+                    (name,
+                     lambda e=eps: self._iterate(maxiter, guard_eps=e)))
+            down = self._downgrade_rung(maxiter)
+            if down is not None:
+                rungs.append(down)
+        return rungs
+
+    def _downgrade_rung(self, maxiter):
+        """Hook: the final ladder rung (GLS fitters downgrade to WLS)."""
+        return None
+
+    def _record_guard(self, rung, health, sp):
+        """Publish the fit's guard outcome: ``fit_rung``/``fit_health``
+        attributes always; fit meta + a warning when a degraded rung
+        served (a degraded fit must be loud, never silent)."""
+        self.fit_rung = rung
+        self.fit_health = _guard.to_record(health)
+        if rung != "baseline":
+            self.model.meta["GUARD_RUNG"] = rung
+            if sp is not None:
+                sp.set(guard_rung=rung)
+            warnings.warn(
+                f"{type(self).__name__}: fit served by degradation "
+                f"rung {rung!r} (see model.meta['GUARD_RUNG'] and "
+                "fitter.fit_health)")
+        else:
+            # a later clean fit clears the flag — the meta lands in the
+            # output par file and must describe THIS fit, not a
+            # degraded one from before the data was fixed
+            self.model.meta.pop("GUARD_RUNG", None)
+
+    def _iterate(self, maxiter, guard_eps=0.0):
+        """Run the Gauss-Newton loop once (one ladder rung).  Returns
+        (vec, cov, extras, n_iter, health); raises guard.StepDiverged
+        with the last finite-chi^2 parameter state on a bad verdict."""
+        vec = jnp.array(
+            [self.model.values[k] for k in self._traced_free],
+            dtype=jnp.float64,
+        )
+        base = self.prepared._values_pytree()
+        data = self._guard_data(guard_eps)
+        chi2_prev = None
+        cov = None
+        n_iter = 0
+        extras = ()
+        health = ()
+        last_good = np.array(
+            [self.model.values[k] for k in self._traced_free])
+        for _ in range(maxiter):
+            # the step donates its input vector on TPU/GPU — snapshot
+            # the candidate before the call so last_good stays readable
+            vec_in = np.asarray(vec)
+            vec, chi2, dpar, cov, *rest = self._step_jit(
+                vec, base, data)
+            extras, health = tuple(rest[:-1]), rest[-1]
+            n_iter += 1
+            chi2_f = float(chi2)
+            if np.isfinite(chi2_f):
+                # chi2 is evaluated at the INPUT vector — that vector
+                # is the proven-good state
+                last_good = vec_in
+            self._check_step_health(health, last_good, n_iter)
+            if chi2_prev is not None and \
+                    abs(float(chi2_prev) - chi2_f) \
+                    < 1e-8 * max(chi2_f, 1.0):
+                break
+            chi2_prev = chi2_f
+        return vec, cov, extras, n_iter, health
+
     def fit_toas(self, maxiter=3):
-        """Iterate Gauss-Newton steps; write back values + uncertainties."""
+        """Iterate Gauss-Newton steps; write back values + uncertainties.
+
+        On divergence the guard's degradation ladder retries through
+        escalating rungs; past the last rung a
+        :class:`pint_tpu.guard.FitDivergedError` carries the last-good
+        parameter vector and the health record — ``model.values`` is
+        never written with non-finite results."""
         if not self.model.free_timing_params:
             raise ValueError(
                 "no free timing parameters to fit (mark them with a '1' "
@@ -237,25 +371,9 @@ class Fitter:
                 self._retrace()
             else:
                 telemetry.counter_add("fitter.jit_cache_hits")
-            vec = jnp.array(
-                [self.model.values[k] for k in self._traced_free],
-                dtype=jnp.float64,
-            )
-            base = self.prepared._values_pytree()
-            chi2_prev = None
-            cov = None
-            n_iter = 0
-            self._step_extras = ()
-            for _ in range(maxiter):
-                vec, chi2, dpar, cov, *extras = self._step_jit(
-                    vec, base, self._fit_data)
-                n_iter += 1
-                self._step_extras = extras
-                if chi2_prev is not None and \
-                        abs(float(chi2_prev) - float(chi2)) \
-                        < 1e-8 * max(float(chi2), 1.0):
-                    break
-                chi2_prev = chi2
+            (vec, cov, extras, n_iter, health), rung = _guard.run_ladder(
+                self._guard_rungs(maxiter), context=type(self).__name__)
+            self._step_extras = extras
             # write back
             vec = np.asarray(vec)
             cov_np = np.asarray(cov)
@@ -271,6 +389,7 @@ class Fitter:
             telemetry.counter_add("fitter.iterations", n_iter)
             telemetry.counter_add("fit.flops_est", flops_est)
             sp.set(n_iter=n_iter, flops_est=flops_est)
+            self._record_guard(rung, health, sp)
             self._update_fit_meta()
             self._post_fit()
             return float(self.resids.chi2)
@@ -325,10 +444,23 @@ class WLSFitter(Fitter):
         are dynamic arguments, so edits to frozen parameters between
         fits take effect without retracing and same-shaped problems
         share the trace; changes to WHICH params are free go through
-        _retrace()."""
+        _retrace().  Returns (new_vec, chi2, dpar, cov, health) —
+        health rides the same compiled program (empty with the guard
+        off)."""
         resid_fn = self._resid_fn_of(base_values, data)
         sigma = self.resids.sigma_at(self._merged(base_values, vec), data)
-        return wls_gn_solve(resid_fn, vec, sigma, self.threshold)
+        if not self._guard_on:
+            return wls_gn_solve(resid_fn, vec, sigma,
+                                self.threshold) + ((),)
+        new_vec, chi2, dpar, cov, diag = wls_gn_solve(
+            resid_fn, vec, sigma, self.threshold,
+            rcond=data["guard_eps"], with_health=True)
+        health = _guard.step_health(
+            resid_fn(vec), sigma, chi2, dpar, cov, diag,
+            valid=data["valid"],
+            inputs_ok=_guard.batch_input_finite(data["batch"],
+                                                data["valid"]))
+        return new_vec, chi2, dpar, cov, health
 
 
 class WidebandTOAFitter(Fitter):
@@ -379,8 +511,25 @@ class WidebandTOAFitter(Fitter):
         U = jnp.concatenate(
             [U_t, jnp.zeros((sigma_dm.shape[0], U_t.shape[1]))], axis=0
         )
-        dpar, cov, ncoef, chi2 = gls_normal_solve(r, J, sigma, U, phi)
-        return vec + dpar, chi2, dpar, cov, ncoef
+        if not self._guard_on:
+            dpar, cov, ncoef, chi2 = gls_normal_solve(r, J, sigma, U,
+                                                      phi)
+            return vec + dpar, chi2, dpar, cov, ncoef, ()
+        dpar, cov, ncoef, chi2, diag = gls_normal_solve(
+            r, J, sigma, U, phi, guard_eps=data["guard_eps"],
+            with_health=True)
+        # the stacked [time; DM] vector needs a stacked pad mask: the
+        # DM block's rows are the valid-indexed subset of the TOA rows
+        v_t = data["toa"]["valid"]
+        valid = None
+        if v_t is not None:
+            valid = jnp.concatenate(
+                [v_t, v_t[data["dm"]["valid_idx"]]])
+        health = _guard.step_health(
+            r, sigma, chi2, dpar, cov, diag, valid=valid,
+            inputs_ok=_guard.batch_input_finite(data["toa"]["batch"],
+                                                v_t))
+        return vec + dpar, chi2, dpar, cov, ncoef, health
 
 
 class GLSFitter(Fitter):
@@ -405,8 +554,31 @@ class GLSFitter(Fitter):
         U, phi = self.resids._noise_basis_phi_at(values, data)
         r = resid_fn(vec)
         J = jax.jacfwd(resid_fn)(vec)
-        dpar, cov, ncoef, chi2 = gls_normal_solve(r, J, sigma, U, phi)
-        return vec + dpar, chi2, dpar, cov, ncoef
+        if not self._guard_on:
+            dpar, cov, ncoef, chi2 = gls_normal_solve(r, J, sigma, U,
+                                                      phi)
+            return vec + dpar, chi2, dpar, cov, ncoef, ()
+        dpar, cov, ncoef, chi2, diag = gls_normal_solve(
+            r, J, sigma, U, phi, guard_eps=data["guard_eps"],
+            with_health=True)
+        health = _guard.step_health(
+            r, sigma, chi2, dpar, cov, diag, valid=data["valid"],
+            inputs_ok=_guard.batch_input_finite(data["batch"],
+                                                data["valid"]))
+        return vec + dpar, chi2, dpar, cov, ncoef, health
+
+    def _downgrade_rung(self, maxiter):
+        """The ladder's last resort: a correlated-noise fit whose solve
+        stays non-finite through every jitter rung falls back to the
+        plain WLS step (noise-scaled white errors, no basis
+        augmentation) on the SAME residuals — degraded statistics, but
+        finite timing parameters with the rung flagged in fit meta."""
+        def downgrade():
+            wls = WLSFitter(self.toas, self.model,
+                            residuals=self.resids)
+            return wls._iterate(maxiter)
+
+        return ("wls", downgrade)
 
     def _set_noise_realizations(self, ncoef):
         """Per-component noise realizations U_c @ a_c [s] (reference
@@ -421,10 +593,15 @@ class GLSFitter(Fitter):
         """Solve once more at the written-back optimum so the noise
         realizations correspond to the reported parameters (the loop's
         extras are one Gauss-Newton step stale)."""
+        if getattr(self, "fit_rung", "baseline") == "wls":
+            # the GLS solve is the thing that diverged — re-running it
+            # here would hand back the same non-finite amplitudes
+            self.noise_realizations = {}
+            return
         vec = jnp.array(
             [self.model.values[k] for k in self._traced_free],
             dtype=jnp.float64,
         )
         base = self.prepared._values_pytree()
-        *_, ncoef = self._step_jit(vec, base, self._fit_data)
+        *_, ncoef, _health = self._step_jit(vec, base, self._fit_data)
         self._set_noise_realizations(ncoef)

@@ -1,0 +1,396 @@
+"""Numerical-health guard layer: on-device health verdicts, the
+degradation ladder, structured divergence errors, checkpoint/resume.
+
+The fit hot path runs at ~10^-15 relative precision on hardware whose
+f32-pair f64 emulation (~49-bit) makes near-degenerate normal matrices
+fail Cholesky outright (linalg.py) — yet before this layer the stack
+had no systematic answer to a fit going bad: a NaN chi^2 propagated
+into ``model.values``, a truncated pseudo-inverse silently zeroed
+degenerate directions, and a killed 10^5-step chain lost everything.
+
+Four surfaces:
+
+- **Health pytrees** — every jitted fit/likelihood program returns a
+  small on-device :class:`Health` record alongside its result (isfinite
+  verdicts on residuals/sigma/chi2/step/cov, the count of
+  pseudo-inverse-truncated eigenvalues, a condition proxy from the
+  already-computed spectrum).  The record rides the SAME compiled
+  program as the fit step — zero extra XLA compiles — and bucketing
+  pad-sentinel rows are masked out so ``PAD_ERROR_US`` rows can never
+  raise a false alarm.  Gate: ``$PINT_TPU_GUARD`` (default on; ``0``/
+  ``off`` trace the steps without the health outputs — the traced
+  program differs, so the flag is part of every step's registry key).
+- **Degradation ladder** — :func:`run_ladder` drives bounded retry
+  through escalating rungs (prior-jitter escalation -> hard jitter ->
+  GLS->WLS downgrade; the eigh pseudo-inverse is the always-on rung-0
+  mechanism of ``linalg.gls_normal_solve``).  ``input``-class
+  divergence (non-finite residuals or uncertainties — bad data no
+  solver rung can fix) aborts the ladder immediately.  The serving
+  rung lands in fit meta (``GUARD_RUNG``) and the ``guard.*``
+  telemetry counters.
+- **Structured errors** — a fit that diverges past every rung raises
+  :class:`FitDivergedError` carrying the last-good parameter vector,
+  the host-side health record, and the rungs tried — never a silent
+  garbage write into ``model.values``.
+- **Checkpoint/resume** — :func:`save_checkpoint` atomic-writes
+  (tmp + ``os.replace``) a dict of arrays plus a caller fingerprint;
+  :func:`load_checkpoint` validates the fingerprint so a stale trace
+  (different posterior, different model structure) can never be
+  silently resumed — mismatch raises :class:`CheckpointMismatchError`.
+  :mod:`pint_tpu.sampler` checkpoints MCMC chain state per chunk and
+  :class:`pint_tpu.parallel.PTABatch` checkpoints fit progress.
+
+Importing this module never touches a JAX backend (the traced helpers
+import ``jax.numpy`` lazily), matching :mod:`pint_tpu.telemetry`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import NamedTuple
+
+import numpy as np
+
+from pint_tpu import telemetry
+
+__all__ = [
+    "Health", "SolveDiag", "FitDivergedError", "CheckpointMismatchError",
+    "StepDiverged", "enabled", "step_health", "verdict", "batch_bad",
+    "to_record", "run_ladder", "save_checkpoint", "load_checkpoint",
+]
+
+_GUARD_ENV = "PINT_TPU_GUARD"
+
+#: checkpoint payload format version (bumped on incompatible layout
+#: changes; load refuses a version it does not understand)
+CHECKPOINT_VERSION = 1
+
+#: THE degradation-ladder escalation table (rung name, guard_eps):
+#: raised pseudo-inverse cutoff + capacity/prior ridge, as dynamic
+#: scalars through the same trace.  Shared by the single-pulsar
+#: fitters and the batched PTA path so the two ladders cannot drift.
+JITTER_RUNGS = (("jitter", 1e-10), ("jitter_hard", 1e-6))
+
+
+def enabled() -> bool:
+    """Whether fit steps compute health outputs (``$PINT_TPU_GUARD``,
+    default on).  Read at trace-build time; the flag is part of every
+    step's registry key because it changes the traced program."""
+    raw = os.environ.get(_GUARD_ENV, "").strip().lower()
+    return raw not in ("0", "off", "false", "no", "disabled")
+
+
+# --------------------------------------------------------------------------
+# on-device health records
+# --------------------------------------------------------------------------
+
+class SolveDiag(NamedTuple):
+    """Spectrum diagnostics of one normal-equation solve, computed from
+    the eigh/SVD spectrum the solver already has in hand (zero extra
+    factorizations)."""
+
+    n_truncated: object  #: eigenvalues/singulars zeroed by the cutoff
+    cond_log10: object   #: log10(max / smallest KEPT eigenvalue)
+
+
+class Health(NamedTuple):
+    """The per-step health pytree.  All leaves are 0-d device arrays
+    (or per-pulsar vectors on the vmapped PTA path); an empty tuple
+    ``()`` stands in when the guard is disabled.
+
+    ``ok`` is the AND of every verdict bit, computed ON DEVICE: the
+    healthy host path reads exactly one scalar per iteration (next to
+    the chi^2 it already pulls) and touches the individual bits only
+    after a trip."""
+
+    ok: object            #: all verdicts clean (the one hot-path read)
+    input_finite: object  #: dataset float leaves finite (pad masked)
+    resid_finite: object  #: residuals finite (pad-sentinel rows masked)
+    sigma_finite: object  #: uncertainties finite (pad rows masked)
+    chi2_finite: object
+    step_finite: object   #: proposed parameter step finite
+    cov_finite: object    #: covariance block finite
+    n_truncated: object   #: pseudo-inverse-truncated directions
+    cond_log10: object    #: condition proxy of the normal matrix
+
+
+def batch_input_finite(batch, valid=None):
+    """Per-TOA finiteness verdict over a TOABatch's float leaves.
+
+    The fixed-point phase pipeline CONVERTS delays to int64 ticks, so
+    a NaN observing frequency (corrupted ``.tim`` row) does not
+    propagate NaN into the residuals — it silently becomes a
+    plausible-looking number.  The only honest detector is a direct
+    check on the inputs, masked so bucketing pad rows can't raise
+    false alarms."""
+    import jax.numpy as jnp
+
+    f = jnp.isfinite(batch.freq_mhz)
+    f = f & jnp.isfinite(batch.error_s)
+    f = f & jnp.all(jnp.isfinite(batch.ssb_obs_pos), axis=-1)
+    f = f & jnp.all(jnp.isfinite(batch.ssb_obs_vel), axis=-1)
+    f = f & jnp.all(jnp.isfinite(batch.obs_sun_pos), axis=-1)
+    if batch.planet_pos.shape[0]:
+        f = f & jnp.all(jnp.isfinite(batch.planet_pos), axis=(0, 2))
+    if valid is not None:
+        f = jnp.logical_or(f, jnp.logical_not(valid))
+    return jnp.all(f)
+
+
+def step_health(r, sigma, chi2, dpar, cov, diag=None, valid=None,
+                inputs_ok=None):
+    """Build a :class:`Health` record inside a traced fit step.
+
+    valid: optional boolean mask — bucketing pad-sentinel rows
+    (``compile_cache.PAD_ERROR_US``) are excluded from the residual and
+    sigma finiteness verdicts so they can never raise a false alarm.
+    inputs_ok: optional scalar from :func:`batch_input_finite`.
+    """
+    import jax.numpy as jnp
+
+    def masked_all_finite(x):
+        f = jnp.isfinite(x)
+        if valid is not None:
+            f = jnp.logical_or(f, jnp.logical_not(valid))
+        return jnp.all(f)
+
+    if diag is None:
+        diag = SolveDiag(jnp.int32(0), jnp.float64(0.0))
+    input_finite = (jnp.bool_(True) if inputs_ok is None
+                    else inputs_ok)
+    resid_finite = masked_all_finite(r)
+    sigma_finite = masked_all_finite(sigma)
+    chi2_finite = jnp.isfinite(chi2)
+    step_finite = jnp.all(jnp.isfinite(dpar))
+    cov_finite = jnp.all(jnp.isfinite(cov))
+    return Health(
+        ok=(input_finite & resid_finite & sigma_finite & chi2_finite
+            & step_finite & cov_finite),
+        input_finite=input_finite,
+        resid_finite=resid_finite,
+        sigma_finite=sigma_finite,
+        chi2_finite=chi2_finite,
+        step_finite=step_finite,
+        cov_finite=cov_finite,
+        n_truncated=diag.n_truncated,
+        cond_log10=diag.cond_log10,
+    )
+
+
+# --------------------------------------------------------------------------
+# host-side verdicts
+# --------------------------------------------------------------------------
+
+def verdict(health) -> str:
+    """Classify a (scalar) health record host-side.
+
+    ``"ok"`` — all verdicts clean; ``"input"`` — residuals or sigmas
+    non-finite (bad data: a NaN TOA, an inf uncertainty — no solver
+    rung can fix it, the ladder aborts); ``"solve"`` — inputs clean but
+    the solve produced non-finite chi2/step/cov (the degeneracy class
+    the jitter rungs exist for)."""
+    if not health:
+        return "ok"
+    # one device read on the hot path; the bit-by-bit classification
+    # happens only after a trip
+    if bool(health.ok):
+        return "ok"
+    input_ok = (bool(health.input_finite) and bool(health.resid_finite)
+                and bool(health.sigma_finite))
+    return "input" if not input_ok else "solve"
+
+
+def batch_bad(health):
+    """Per-pulsar bad mask of a vmapped health record (the PTA path):
+    numpy bool array, True where that pulsar's verdict is not ok.
+    Returns None when the guard is off (empty health)."""
+    if not health:
+        return None
+    return ~np.asarray(health.ok)
+
+
+def batch_input_bad(health):
+    """Per-pulsar input-class mask (non-finite data): the members no
+    solver rung can fix — the batched ladder must not waste full-batch
+    retries on them, mirroring :func:`run_ladder`'s immediate
+    input-class abort."""
+    if not health:
+        return None
+    return ~(np.asarray(health.input_finite)
+             & np.asarray(health.resid_finite)
+             & np.asarray(health.sigma_finite))
+
+
+def to_record(health) -> dict:
+    """Host-side dict of plain python values (error payloads, fit_health
+    attributes, JSONL telemetry)."""
+    if not health:
+        return {}
+    out = {}
+    for k, v in health._asdict().items():
+        a = np.asarray(v)
+        if a.ndim == 0:
+            out[k] = bool(a) if a.dtype == np.bool_ else (
+                int(a) if np.issubdtype(a.dtype, np.integer) else float(a))
+        else:  # vmapped (PTA) record: keep per-pulsar vectors
+            out[k] = a.tolist()
+    return out
+
+
+# --------------------------------------------------------------------------
+# structured errors + the degradation ladder
+# --------------------------------------------------------------------------
+
+class StepDiverged(Exception):
+    """Internal control-flow signal: one fit attempt (one ladder rung)
+    saw a bad health verdict.  Carries the last-good parameter state
+    and the offending health record; :func:`run_ladder` converts the
+    final one into a :class:`FitDivergedError`."""
+
+    def __init__(self, health, last_good=None, n_iter=0, kind=None):
+        self.health = health
+        self.last_good = last_good
+        self.n_iter = n_iter
+        self.kind = kind or verdict(health)
+        super().__init__(f"fit step diverged ({self.kind}) at "
+                         f"iteration {n_iter}")
+
+
+class FitDivergedError(RuntimeError):
+    """A fit/likelihood diverged past every degradation rung.
+
+    Attributes: ``context`` (which program), ``health`` (host-side
+    record dict), ``last_good`` (the last parameter state with a finite
+    chi^2 — ``{name: value}`` for fitters, an array for samplers),
+    ``rungs_tried``, and optionally ``bad_indices``/``results`` on the
+    batched PTA path (healthy pulsars' results are written back before
+    the raise; the bad ones are listed here)."""
+
+    def __init__(self, context, *, health=None, last_good=None,
+                 rungs_tried=(), bad_indices=None, results=None,
+                 detail=""):
+        self.context = context
+        self.health = health or {}
+        self.last_good = last_good
+        self.rungs_tried = tuple(rungs_tried)
+        self.bad_indices = bad_indices
+        self.results = results
+        msg = f"{context}: fit diverged"
+        if rungs_tried:
+            msg += f" after rungs {list(self.rungs_tried)}"
+        if bad_indices is not None:
+            msg += f" for batch members {list(bad_indices)}"
+        if detail:
+            msg += f" ({detail})"
+        if last_good is not None:
+            msg += "; .last_good carries the last finite parameter state"
+        super().__init__(msg)
+
+
+class CheckpointMismatchError(RuntimeError):
+    """A checkpoint's fingerprint does not match the resuming job — a
+    stale chain/fit state must never be silently reused."""
+
+
+def run_ladder(rungs, *, context):
+    """Drive the degradation ladder: try each ``(name, callable)`` in
+    order until one returns.  A callable signals divergence by raising
+    :class:`StepDiverged`; ``input``-class divergence aborts
+    immediately (no rung fixes bad data).  Returns ``(result,
+    rung_name)`` or raises :class:`FitDivergedError` carrying the
+    best last-good state seen across attempts."""
+    tried = []
+    last = None
+    last_good = None
+    for name, fn in rungs:
+        try:
+            result = fn()
+        except StepDiverged as sd:
+            tried.append(name)
+            last = sd
+            if sd.last_good is not None:
+                last_good = sd.last_good
+            telemetry.counter_add("guard.trips")
+            telemetry.counter_add(f"guard.trip.{sd.kind}")
+            if sd.kind == "input":
+                break
+            continue
+        if tried:  # a degraded rung is serving — count which
+            telemetry.counter_add(f"guard.rung.{name}")
+        return result, name
+    raise FitDivergedError(
+        context,
+        health=to_record(last.health) if last is not None else {},
+        last_good=last_good,
+        rungs_tried=tried,
+        detail=(f"{last.kind}-class divergence" if last is not None
+                else "no rungs available"),
+    )
+
+
+# --------------------------------------------------------------------------
+# checkpoint/resume
+# --------------------------------------------------------------------------
+
+def save_checkpoint(path, arrays: dict, fingerprint, meta=None):
+    """Atomic-write a checkpoint: a dict of named arrays plus a caller
+    fingerprint (the job's jit/structure identity).  The write goes to
+    a same-directory temp file, is fsynced, then ``os.replace``d — a
+    process killed mid-save leaves the previous checkpoint intact."""
+    head = {"version": CHECKPOINT_VERSION,
+            "fingerprint": str(fingerprint),
+            "meta": meta or {}}
+    payload = {k: np.asarray(v) for k, v in arrays.items()}
+    path = os.fspath(path)
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".ckpt-", suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, __meta__=np.array(json.dumps(head)), **payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+    telemetry.counter_add("guard.checkpoint_saves")
+    return path
+
+
+def load_checkpoint(path, fingerprint=None, missing_ok=True):
+    """Load a checkpoint written by :func:`save_checkpoint`.
+
+    Returns ``(arrays, head)`` — the named-array dict and the header
+    (version/fingerprint/meta) — or None when the file is missing and
+    ``missing_ok``.  A fingerprint mismatch (or an unknown payload
+    version) raises :class:`CheckpointMismatchError`: resuming a chain
+    against a different posterior, or a fit against a different model
+    structure, must fail loudly, never silently."""
+    path = os.fspath(path)
+    if not os.path.exists(path):
+        if missing_ok:
+            return None
+        raise FileNotFoundError(path)
+    with np.load(path, allow_pickle=False) as z:
+        head = json.loads(str(z["__meta__"][()]))
+        if int(head.get("version", -1)) != CHECKPOINT_VERSION:
+            telemetry.counter_add("guard.checkpoint_mismatches")
+            raise CheckpointMismatchError(
+                f"{path}: checkpoint version {head.get('version')} != "
+                f"{CHECKPOINT_VERSION}")
+        if fingerprint is not None and \
+                head.get("fingerprint") != str(fingerprint):
+            telemetry.counter_add("guard.checkpoint_mismatches")
+            raise CheckpointMismatchError(
+                f"{path}: checkpoint fingerprint "
+                f"{head.get('fingerprint')!r} does not match this job's "
+                f"{str(fingerprint)!r} — a stale state must not be "
+                "silently resumed (delete the file to start fresh)")
+        arrays = {k: z[k] for k in z.files if k != "__meta__"}
+    telemetry.counter_add("guard.checkpoint_resumes")
+    return arrays, head

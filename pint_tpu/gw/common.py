@@ -34,6 +34,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from pint_tpu import compile_cache as _cc
+from pint_tpu import faults as _faults
+from pint_tpu import guard as _guard
+from pint_tpu import telemetry
 from pint_tpu.gw.orf import orf_matrix, pulsar_positions
 from pint_tpu.linalg import woodbury_chi2_logdet
 from pint_tpu.models.noise import powerlaw, toa_fourier_basis
@@ -183,7 +186,9 @@ def _crn_lnlike_one(r, sigma, U_full, phi_noise, orf, freqs, df,
                     n_toa, log10_amp, gamma):
     """Log-likelihood of the stacked array under noise + an
     ORF-correlated common power-law process.  Pure function of dynamic
-    arrays — one trace serves every same-shaped PTA."""
+    arrays — one trace serves every same-shaped PTA.  Returns
+    (lnlike, health) with health the on-device finiteness verdict
+    (chi2, logdet) riding the same compiled program."""
     amp = 10.0 ** log10_amp
     phi_gw = gwb_phi(freqs, amp, gamma, df)
     kn = phi_noise.shape[0]
@@ -193,7 +198,9 @@ def _crn_lnlike_one(r, sigma, U_full, phi_noise, orf, freqs, df,
     phi_dense = phi_dense.at[:kn, :kn].set(jnp.diag(phi_noise))
     phi_dense = phi_dense.at[kn:, kn:].set(gw_block)
     chi2, logdet = woodbury_chi2_logdet(r, sigma, U_full, phi_dense)
-    return -0.5 * (chi2 + logdet) - 0.5 * n_toa * jnp.log(2.0 * jnp.pi)
+    lnl = -0.5 * (chi2 + logdet) - 0.5 * n_toa * jnp.log(2.0 * jnp.pi)
+    health = (jnp.isfinite(chi2), jnp.isfinite(logdet))
+    return lnl, health
 
 
 _crn_lnlike_vec = jax.vmap(
@@ -241,7 +248,8 @@ class CommonProcess:
             self.nmodes = int(nmodes)
             self.pos = pos
             self.orf_kind = orf
-            self.orf = jnp.asarray(np.asarray(orf_matrix(pos, orf)))
+            self.orf = _faults.corrupt_orf(
+                jnp.asarray(np.asarray(orf_matrix(pos, orf))))
             self.freqs = jnp.asarray(freqs)
             self.df = jnp.float64(df)
             # stacked vectors (ragged concatenation — no padding)
@@ -268,20 +276,41 @@ class CommonProcess:
         return _cc.shared_jit(_crn_lnlike_one,
                               key=("gw.common.lnlike",))
 
-    def lnlike(self, log10_amp, gamma):
-        """Log-likelihood at one (log10 amplitude, spectral index)."""
+    def lnlike(self, log10_amp, gamma, check=True):
+        """Log-likelihood at one (log10 amplitude, spectral index).
+
+        check: a non-finite likelihood (degenerate prior past the
+        dense-phi jitter, corrupted inputs) raises a structured
+        :class:`pint_tpu.guard.FitDivergedError` instead of silently
+        handing a sampler NaN; pass check=False for raw -inf/NaN
+        semantics."""
         with span("gw.common.lnlike", n_pulsars=self.n_pulsars,
                   nmodes=self.nmodes):
-            out = self._lnlike_jit()(
+            out, health = self._lnlike_jit()(
                 self.r, self.sigma, self.U_full, self.phi_noise,
                 self.orf, self.freqs, self.df,
                 jnp.float64(self.n_toa_total),
                 jnp.float64(log10_amp), jnp.float64(gamma))
+            # the check honors the guard gate — PINT_TPU_GUARD=0
+            # restores raw -inf/NaN semantics like check=False
+            if check and _guard.enabled():
+                telemetry.counter_add("guard.checks")
+            if check and _guard.enabled() \
+                    and not np.isfinite(float(out)):
+                telemetry.counter_add("guard.trips")
+                telemetry.counter_add("guard.trip.gw_lnlike")
+                raise _guard.FitDivergedError(
+                    "gw.common.lnlike",
+                    health={"chi2_finite": bool(health[0]),
+                            "logdet_finite": bool(health[1])},
+                    detail=f"lnlike({log10_amp}, {gamma}) non-finite")
             return float(out)
 
     def lnlike_grid(self, log10_amps, gammas):
         """(A, G) log-likelihood surface over the outer product of the
-        two 1-d grids — one vmapped program."""
+        two 1-d grids — one vmapped program.  Non-finite grid points
+        are counted (``guard.trip.gw_lnlike_grid``) and warned about,
+        never silently returned as a clean-looking surface."""
         log10_amps = np.atleast_1d(np.asarray(log10_amps, np.float64))
         gammas = np.atleast_1d(np.asarray(gammas, np.float64))
         aa, gg = np.meshgrid(log10_amps, gammas, indexing="ij")
@@ -290,8 +319,21 @@ class CommonProcess:
                             fn_token="gw.common.lnlike_grid")
         with span("gw.common.lnlike_grid", n_pulsars=self.n_pulsars,
                   n_points=aa.size):
-            out = fn(self.r, self.sigma, self.U_full, self.phi_noise,
-                     self.orf, self.freqs, self.df,
-                     jnp.float64(self.n_toa_total),
-                     jnp.asarray(aa.ravel()), jnp.asarray(gg.ravel()))
-        return np.asarray(out).reshape(aa.shape)
+            out, _health = fn(
+                self.r, self.sigma, self.U_full, self.phi_noise,
+                self.orf, self.freqs, self.df,
+                jnp.float64(self.n_toa_total),
+                jnp.asarray(aa.ravel()), jnp.asarray(gg.ravel()))
+        surf = np.asarray(out).reshape(aa.shape)
+        n_bad = int(np.count_nonzero(~np.isfinite(surf)))
+        if n_bad:
+            import warnings
+
+            if _guard.enabled():
+                telemetry.counter_add("guard.trips")
+                telemetry.counter_add("guard.trip.gw_lnlike_grid",
+                                      n_bad)
+            warnings.warn(
+                f"lnlike_grid: {n_bad}/{surf.size} non-finite grid "
+                "points (degenerate prior or corrupted inputs)")
+        return surf

@@ -22,6 +22,8 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from pint_tpu.guard import SolveDiag
+
 __all__ = ["woodbury_chi2_logdet", "gls_normal_solve",
            "WoodburyPre", "woodbury_precompute",
            "woodbury_chi2_logdet_pre", "woodbury_solve"]
@@ -34,7 +36,7 @@ __all__ = ["woodbury_chi2_logdet", "gls_normal_solve",
 _PHI_FLOOR = 1e-30
 
 
-def _phi_terms(phi):
+def _phi_terms(phi, jitter=None):
     """Normalize a basis prior to its solver form.
 
     Returns ``(phi_inv, logdet_phi)`` where ``phi_inv`` is the (K, K)
@@ -42,7 +44,12 @@ def _phi_terms(phi):
     (K,) weight vector, a dense Cholesky inverse for a (K, K) prior
     covariance (the GWB cross-pulsar block structure).  Both forms
     floor the diagonal at ``_PHI_FLOOR`` so pinned-to-zero columns stay
-    finite."""
+    finite.
+
+    jitter: optional traced scalar — the guard layer's degradation
+    ladder escalates the dense path's per-diagonal relative jitter
+    above its 1e-12 baseline when a Cholesky NaNs anyway (TPU ~49-bit
+    pivot roundoff on a deeply rank-deficient prior)."""
     phi = jnp.asarray(phi)
     if phi.ndim == 2:
         # per-column relative jitter before the Cholesky: physically
@@ -61,7 +68,8 @@ def _phi_terms(phi):
         # analogue of the vector-phi _PHI_FLOOR.
         k = phi.shape[0]
         d = jnp.abs(jnp.diag(phi)) + _PHI_FLOOR
-        phi = phi + 1e-12 * jnp.diag(d)
+        rel = 1e-12 if jitter is None else jnp.maximum(1e-12, jitter)
+        phi = phi + rel * jnp.diag(d)
         cf = jax.scipy.linalg.cho_factor(phi, lower=True)
         phi_inv = jax.scipy.linalg.cho_solve(cf, jnp.eye(k))
         logdet_phi = 2.0 * jnp.sum(jnp.log(jnp.diag(cf[0])))
@@ -70,19 +78,30 @@ def _phi_terms(phi):
     return jnp.diag(1.0 / phi), jnp.sum(jnp.log(phi))
 
 
-def _capacity(sigma, U, phi):
+def _capacity(sigma, U, phi, jitter=None):
     """THE capacity-matrix construction every Woodbury path shares:
     ``(nvec, cho_factor(U^T N^-1 U + Phi^-1), logdet Phi)``.  A
     conditioning or masking change here reaches chi2/logdet, solve,
-    and precompute identically."""
-    phi_inv, logdet_phi = _phi_terms(phi)
+    and precompute identically.
+
+    jitter: optional traced scalar (guard degradation ladder) — adds a
+    per-diagonal relative ridge to the capacity matrix before its
+    Cholesky, the same escalation the dense prior gets in
+    :func:`_phi_terms`.  The chi^2/logdet of a jittered solve is the
+    exact answer for a slightly-regularized covariance, not the
+    original — the serving rung is recorded in fit meta so degraded
+    results are never mistaken for clean ones."""
+    phi_inv, logdet_phi = _phi_terms(phi, jitter=jitter)
     nvec = sigma**2
     sigma_cap = (U.T * (1.0 / nvec)[None, :]) @ U + phi_inv
+    if jitter is not None:
+        d = jnp.abs(jnp.diag(sigma_cap))
+        sigma_cap = sigma_cap + jitter * jnp.diag(d)
     cf = jax.scipy.linalg.cho_factor(sigma_cap, lower=True)
     return nvec, cf, logdet_phi
 
 
-def woodbury_chi2_logdet(r, sigma, U, phi, valid=None):
+def woodbury_chi2_logdet(r, sigma, U, phi, valid=None, jitter=None):
     """(chi2, logdet C) for C = diag(sigma^2) + U Phi U^T.
 
     chi2 = r^T C^-1 r via the Woodbury identity; logdet via the matrix
@@ -94,9 +113,11 @@ def woodbury_chi2_logdet(r, sigma, U, phi, valid=None):
     valid: optional boolean mask excluding bucketing pad rows from the
     white logdet term (their ~1e-32 weights already vanish from every
     other reduction, but their log sigma^2 would shift — and, with
-    EFAC free, bias — the log-likelihood).
+    EFAC free, bias — the log-likelihood).  jitter: optional traced
+    scalar, the guard ladder's capacity/prior ridge (see
+    :func:`_capacity`).
     """
-    nvec, cf, logdet_phi = _capacity(sigma, U, phi)
+    nvec, cf, logdet_phi = _capacity(sigma, U, phi, jitter=jitter)
     ninv_r = r / nvec
     ut_ninv_r = U.T @ ninv_r
     x = jax.scipy.linalg.cho_solve(cf, ut_ninv_r)
@@ -173,7 +194,8 @@ def woodbury_chi2_logdet_pre(r, pre: WoodburyPre):
     return chi2, pre.logdet
 
 
-def gls_normal_solve(r, J, sigma, U, phi, pre=None):
+def gls_normal_solve(r, J, sigma, U, phi, pre=None, guard_eps=None,
+                     with_health=False):
     """Solve the noise-augmented GLS normal equations (reference:
     GLSFitter.fit_toas, fitter.py:2164-2204).
 
@@ -191,6 +213,14 @@ def gls_normal_solve(r, J, sigma, U, phi, pre=None):
     ``phi`` may be a (K,) weight vector or a dense (K, K) prior
     covariance (stacked cross-pulsar GWB structure) — the inverse
     prior enters the normal matrix as a block either way.
+
+    guard_eps: optional traced scalar, the guard degradation ladder's
+    escalation knob — raises the pseudo-inverse relative cutoff above
+    its 1e-16 baseline AND ridges the Woodbury capacity/prior
+    Choleskys (:func:`_capacity`).  Dynamic, so escalating costs zero
+    new compiles.  with_health: additionally return a
+    :class:`pint_tpu.guard.SolveDiag` (truncated-direction count +
+    condition proxy from the eigh spectrum already in hand).
     """
     n_par = J.shape[1]
     M = jnp.concatenate([J, U], axis=1) if U.shape[1] else J
@@ -217,19 +247,30 @@ def gls_normal_solve(r, J, sigma, U, phi, pre=None):
     # the fallback is the main path here.  mtcm_n has unit diagonal, so
     # eigenvalues are O(1)..O(P) and the cutoff is a clean relative one.
     w, Q = jnp.linalg.eigh(mtcm_n)
-    w_inv = jnp.where(w > 1e-16 * jnp.max(w), 1.0 / w, 0.0)
+    wmax = jnp.max(w)
+    cut = 1e-16 if guard_eps is None else jnp.maximum(1e-16, guard_eps)
+    w_inv = jnp.where(w > cut * wmax, 1.0 / w, 0.0)
     xhat = (Q @ (w_inv * (Q.T @ (rhs / norm)))) / norm
     cov_full = (Q * w_inv[None, :]) @ Q.T / jnp.outer(norm, norm)
     if U.shape[1]:
         if pre is not None:
             chi2, _ = woodbury_chi2_logdet_pre(r, pre)
         else:
-            chi2, _ = woodbury_chi2_logdet(r, sigma, U, phi)
+            chi2, _ = woodbury_chi2_logdet(r, sigma, U, phi,
+                                           jitter=guard_eps)
     else:
         chi2 = jnp.sum((r / sigma) ** 2)
-    return (
+    out = (
         -xhat[:n_par],
         cov_full[:n_par, :n_par],
         xhat[n_par:],
         chi2,
     )
+    if with_health:
+        kept_min = jnp.min(jnp.where(w_inv > 0.0, w, wmax))
+        diag = SolveDiag(
+            n_truncated=jnp.sum(w_inv == 0.0).astype(jnp.int32),
+            cond_log10=jnp.log10(wmax / jnp.maximum(kept_min, 1e-300)),
+        )
+        out = out + (diag,)
+    return out

@@ -17,6 +17,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from pint_tpu import compile_cache as _cc
+from pint_tpu import guard as _guard
+from pint_tpu import telemetry
 from pint_tpu.fitter import Fitter
 
 __all__ = ["LMFitter", "PowellFitter"]
@@ -44,8 +46,10 @@ class LMFitter(Fitter):
         # both LM functions resolve through the shared registry with
         # the dataset as a dynamic argument (fitter.py contract)
         self._traced_free = tuple(self.model.free_timing_params)
-        self._fit_data = self.resids._data()
-        key = (type(self).__name__, self._traced_free,
+        self._guard_on = _guard.enabled()
+        self._fit_data = {**self.resids._data(),
+                          "guard_eps": np.float64(0.0)}
+        key = (type(self).__name__, self._traced_free, self._guard_on,
                self.resids._structure_key())
         self._lm_jit = _cc.shared_jit(
             self._lm_solve, key=("lm.solve",) + key)
@@ -67,7 +71,8 @@ class LMFitter(Fitter):
 
     def _lm_solve(self, vec, base_values, lam, data):
         """One damped step at fixed lambda: (J^T W J + lam diag) d =
-        -J^T W r on the whitened residuals."""
+        -J^T W r on the whitened residuals.  Returns (dpar, chi2, cov,
+        health) — health empty with the guard off."""
         resid_fn = self._lm_resid_fn(base_values, data)
         values = self._merged(base_values, vec)
         sigma = self._lm_sigma(values, data)
@@ -79,42 +84,66 @@ class LMFitter(Fitter):
         A = Jw.T @ Jw
         g = Jw.T @ rw
         damped = A + lam * jnp.diag(jnp.diag(A))
+        cut = (1e-16 if not self._guard_on
+               else jnp.maximum(1e-16, data["guard_eps"]))
         # eigh solve (TPU-safe; see linalg.gls_normal_solve)
         norm = jnp.sqrt(jnp.diag(damped))
         norm = jnp.where(norm == 0, 1.0, norm)
         dn = damped / jnp.outer(norm, norm)
         ww, Q = jnp.linalg.eigh(dn)
-        w_inv = jnp.where(ww > 1e-16 * jnp.max(ww), 1.0 / ww, 0.0)
+        w_inv = jnp.where(ww > cut * jnp.max(ww), 1.0 / ww, 0.0)
         dpar = -(Q @ (w_inv * (Q.T @ (g / norm)))) / norm
         # covariance from the undamped system
         An = A / jnp.outer(norm, norm)
         wa, Qa = jnp.linalg.eigh(An)
-        wa_inv = jnp.where(wa > 1e-16 * jnp.max(wa), 1.0 / wa, 0.0)
+        wa_inv = jnp.where(wa > cut * jnp.max(wa), 1.0 / wa, 0.0)
         cov = (Qa * wa_inv[None, :]) @ Qa.T / jnp.outer(norm, norm)
         chi2 = jnp.sum(rw * rw)
-        return dpar, chi2, cov
+        if not self._guard_on:
+            return dpar, chi2, cov, ()
+        wmax = jnp.max(ww)
+        kept_min = jnp.min(jnp.where(w_inv > 0.0, ww, wmax))
+        diag = _guard.SolveDiag(
+            n_truncated=jnp.sum(w_inv == 0.0).astype(jnp.int32),
+            cond_log10=jnp.log10(wmax / jnp.maximum(kept_min, 1e-300)))
+        b = data["toa"]["batch"] if "toa" in data else data["batch"]
+        health = _guard.step_health(
+            r, sigma, chi2, dpar, cov, diag, valid=data.get("valid"),
+            inputs_ok=_guard.batch_input_finite(b, data.get("valid")))
+        return dpar, chi2, cov, health
 
-    def fit_toas(self, maxiter=20, min_chi2_decrease=1e-2):
-        if not self.model.free_timing_params:
-            raise ValueError("no free timing parameters to fit")
-        if tuple(self.model.free_timing_params) != getattr(
-                self, "_traced_free", ()):
-            self._retrace()
+    def _iterate(self, maxiter, guard_eps=0.0, min_chi2_decrease=1e-2):
+        """One ladder rung of the LM loop (fitter.Fitter._iterate
+        contract minus extras)."""
         vec = jnp.array(
             [self.model.values[k] for k in self._traced_free],
             dtype=jnp.float64,
         )
         base = self.prepared._values_pytree()
+        data = self._guard_data(guard_eps)
         lam = self.lambda0
         cov = None
+        health = ()
+        n_iter = 0
         self.converged = False
+        last_good = np.array(
+            [self.model.values[k] for k in self._traced_free])
+
+        def checked(out):
+            dpar, chi2, cov, health = out
+            self._check_step_health(health, last_good, n_iter)
+            return dpar, chi2, cov, health
+
         for _ in range(maxiter):
-            dpar, chi2_old, cov = self._lm_jit(vec, base, lam,
-                                               self._fit_data)
+            if np.all(np.isfinite(np.asarray(vec))):
+                last_good = np.asarray(vec)
+            dpar, chi2_old, cov, health = checked(
+                self._lm_jit(vec, base, lam, data))
+            n_iter += 1
             accepted = False
             for _try in range(self.max_tries):
                 chi2_new = float(
-                    self._chi2_vec_jit(vec + dpar, base, self._fit_data)
+                    self._chi2_vec_jit(vec + dpar, base, data)
                 )
                 if chi2_new < float(chi2_old):
                     vec = vec + dpar
@@ -122,14 +151,32 @@ class LMFitter(Fitter):
                     accepted = True
                     break
                 lam = lam * self.up
-                dpar, chi2_old, cov = self._lm_jit(vec, base, lam,
-                                                   self._fit_data)
+                dpar, chi2_old, cov, health = checked(
+                    self._lm_jit(vec, base, lam, data))
             if not accepted:
                 self.converged = True
                 break
             if float(chi2_old) - chi2_new < min_chi2_decrease:
                 self.converged = True
                 break
+        return vec, cov, (), n_iter, health
+
+    def fit_toas(self, maxiter=20, min_chi2_decrease=1e-2):
+        if not self.model.free_timing_params:
+            raise ValueError("no free timing parameters to fit")
+        if tuple(self.model.free_timing_params) != getattr(
+                self, "_traced_free", ()):
+            self._retrace()
+        rungs = [("baseline",
+                  lambda: self._iterate(
+                      maxiter, min_chi2_decrease=min_chi2_decrease))]
+        if self._guard_on:
+            for name, eps in self._guard_jitter_rungs:
+                rungs.append((name, lambda e=eps: self._iterate(
+                    maxiter, guard_eps=e,
+                    min_chi2_decrease=min_chi2_decrease)))
+        (vec, cov, _extras, _n_iter, health), rung = _guard.run_ladder(
+            rungs, context=type(self).__name__)
         vec_np = np.asarray(vec)
         errs = np.sqrt(np.clip(np.diag(np.asarray(cov)), 0, None))
         params = self.model.params
@@ -137,6 +184,7 @@ class LMFitter(Fitter):
             self.model.values[name] = float(vec_np[i])
             params[name].uncertainty = float(errs[i])
         self.covariance = np.asarray(cov)
+        self._record_guard(rung, health, None)
         self._update_fit_meta()
         return float(self.resids.chi2)
 
@@ -188,6 +236,15 @@ class PowellFitter(Fitter):
         res = minimize(fun, np.zeros_like(x0), method="Powell",
                        options={"maxiter": maxiter, "xtol": 1e-10})
         vec = x0 + res.x * scales
+        if not (np.all(np.isfinite(vec)) and np.isfinite(res.fun)):
+            telemetry.counter_add("guard.trips")
+            telemetry.counter_add("guard.trip.powell")
+            raise _guard.FitDivergedError(
+                type(self).__name__,
+                last_good={n: float(x0[i])
+                           for i, n in enumerate(self._traced_free)},
+                detail=f"Powell returned non-finite optimum "
+                       f"(fun={res.fun!r})")
         for i, name in enumerate(self._traced_free):
             self.model.values[name] = float(vec[i])
         self.converged = bool(res.success)

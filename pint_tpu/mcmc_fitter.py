@@ -175,7 +175,7 @@ class MCMCFitter:
             self.weights * f + (1.0 - self.weights), 1e-300)))
 
     def fit_toas(self, nwalkers=32, nsteps=500, seed=0, burn_frac=0.25,
-                 autocorr=False, burnin=None):
+                 autocorr=False, burnin=None, checkpoint=None):
         """Run the ensemble sampler; set model values to the
         max-posterior sample (reference MCMCFitter.fit_toas maxpost).
         Returns the max-posterior lnL.
@@ -185,7 +185,13 @@ class MCMCFitter:
         10%%) with ``nsteps`` as the cap (reference event_optimize
         run_sampler_autocorr); the default burn-in is then
         ``5 * max(tau)`` rather than a fraction of the cap.
-        ``burnin`` (absolute steps) overrides either default."""
+        ``burnin`` (absolute steps) overrides either default.
+
+        ``checkpoint`` (autocorr runs only): path for per-chunk
+        atomic chain snapshots; an existing checkpoint resumes the
+        run, validated against this fitter's posterior fingerprint
+        (``_sampler_jit_key``) so a chain from a different model/
+        dataset/prior configuration can never be silently reused."""
         ndim = self.nparams + self._n_template
         center = np.array(
             [self.model.values[n] for n in self.param_names]
@@ -206,7 +212,8 @@ class MCMCFitter:
                   n_toa=len(self.toas), autocorr=autocorr) as sp:
             if autocorr:
                 _, self.converged, self.tau = s.run_mcmc_autocorr(
-                    x0, chunk=max(50, nsteps // 10), maxsteps=nsteps)
+                    x0, chunk=max(50, nsteps // 10), maxsteps=nsteps,
+                    checkpoint=checkpoint)
                 chain_len = int(np.asarray(s.chain).shape[0])
                 burn = (int(burnin) if burnin is not None
                         else int(min(5 * np.max(self.tau),

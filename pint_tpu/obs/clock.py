@@ -30,6 +30,16 @@ class ClockFile:
     def __init__(self, mjds, offsets_sec, name="", limits="warn"):
         mjds = np.asarray(mjds, dtype=np.float64)
         offsets_sec = np.asarray(offsets_sec, dtype=np.float64)
+        # a corrupted tabulation must fail loudly: 'nan'/'inf' parse as
+        # valid floats, and np.interp would silently smear a single
+        # NaN row across every TOA in its neighborhood
+        bad = ~(np.isfinite(mjds) & np.isfinite(offsets_sec))
+        if bad.any():
+            raise ValueError(
+                f"clock file {name or '<anonymous>'}: "
+                f"{int(bad.sum())} non-finite MJD/offset row(s) "
+                f"(first at index {int(np.flatnonzero(bad)[0])}) — a "
+                "corrupted table must not silently interpolate")
         order = np.argsort(mjds, kind="stable")
         self.mjds = mjds[order]
         self.offsets = offsets_sec[order]
@@ -75,6 +85,9 @@ class ClockFile:
                 f"clock file {path}: no parseable 'MJD offset' rows — "
                 "a present-but-garbage file must not silently mean "
                 "zero corrections")
+        from pint_tpu import faults as _faults
+
+        _faults.corrupt_clock_rows(mjds, offs)
         return cls(mjds, offs, name=os.path.basename(path), limits=limits)
 
     @classmethod

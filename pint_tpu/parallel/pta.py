@@ -25,6 +25,7 @@ import numpy as np
 
 from pint_tpu import compile_cache as _cc
 from pint_tpu import flops as _flops
+from pint_tpu import guard as _guard
 from pint_tpu import telemetry
 from pint_tpu.compile_cache import merge_ctx as _merge_ctx
 from pint_tpu.fitter import wls_gn_solve
@@ -317,9 +318,15 @@ class PTABatch:
         )
 
         # stack padded batches / ctx / values — one pytree with a
-        # leading pulsar axis
+        # leading pulsar axis (fault injection at the same host
+        # boundary the single-pulsar path uses, per-pulsar targeted)
+        from pint_tpu import faults as _faults
+
         batches = [
-            _pad_batch(p.batch, self.n_max) for p in self.prepareds
+            _pad_batch(
+                _faults.corrupt_batch(p.batch, member=k)
+                if _faults.any_active() else p.batch, self.n_max)
+            for k, p in enumerate(self.prepareds)
         ]
         self.batch = jax.tree.map(
             lambda *xs: jnp.stack(xs), *batches
@@ -397,8 +404,18 @@ class PTABatch:
                 sigma = f(values, batch, ctx[type(c).__name__], sigma)
         return sigma
 
+    def _step_health_one(self, resid_fn, vec, err, sigma, chi2, dpar,
+                         cov, diag, batch, valid):
+        """One pulsar's guard record: padded rows masked out of every
+        input/residual verdict (they carry 1e30 errors by
+        construction)."""
+        return _guard.step_health(
+            resid_fn(vec), sigma, chi2, dpar, cov, diag, valid=valid,
+            inputs_ok=_guard.batch_input_finite(batch, valid))
+
     def _fit_one(self, vec0, base_values, batch, ctx, tzr_batch,
-                 tzr_ctx, valid, free_mask, maxiter):
+                 tzr_ctx, valid, free_mask, guard_eps, maxiter,
+                 with_health):
         merged = _merge_ctx(ctx, self.static_ctx)
         values0 = dict(base_values)
         for i, name in enumerate(self.free_names):
@@ -414,14 +431,22 @@ class PTABatch:
 
         def body(carry, _):
             vec, _ = carry
-            new_vec, chi2, dpar, cov = wls_gn_solve(resid_fn, vec, err)
+            new_vec, chi2, dpar, cov = wls_gn_solve(
+                resid_fn, vec, err, rcond=guard_eps)
             return (new_vec, chi2), None
 
         (vec, _), _ = jax.lax.scan(
             body, (vec0, jnp.float64(0.0)), None, length=maxiter
         )
-        _, chi2, _, cov = wls_gn_solve(resid_fn, vec, err)
-        return vec, chi2, cov
+        if not with_health:
+            _, chi2, _, cov = wls_gn_solve(resid_fn, vec, err,
+                                           rcond=guard_eps)
+            return vec, chi2, cov, ()
+        _, chi2, dpar, cov, diag = wls_gn_solve(
+            resid_fn, vec, err, rcond=guard_eps, with_health=True)
+        health = self._step_health_one(resid_fn, vec, err, sigma, chi2,
+                                       dpar, cov, diag, batch, valid)
+        return vec, chi2, cov, health
 
     def _gather_noise(self):
         """Static per-pulsar noise bases for the batched GLS path:
@@ -451,7 +476,8 @@ class PTABatch:
         return jnp.asarray(U_pad), jnp.asarray(phi_pad)
 
     def _fit_one_gls(self, vec0, base_values, batch, ctx, tzr_batch,
-                     tzr_ctx, valid, free_mask, U, phi, maxiter):
+                     tzr_ctx, valid, free_mask, U, phi, guard_eps,
+                     maxiter, with_health):
         from pint_tpu.linalg import gls_normal_solve
 
         merged = _merge_ctx(ctx, self.static_ctx)
@@ -471,7 +497,8 @@ class PTABatch:
             vec, _ = carry
             r = resid_fn(vec)
             J = jax.jacfwd(resid_fn)(vec)
-            dpar, cov, _, chi2 = gls_normal_solve(r, J, err, U, phi)
+            dpar, cov, _, chi2 = gls_normal_solve(
+                r, J, err, U, phi, guard_eps=guard_eps)
             return (vec + dpar, chi2), None
 
         (vec, _), _ = jax.lax.scan(
@@ -479,8 +506,15 @@ class PTABatch:
         )
         r = resid_fn(vec)
         J = jax.jacfwd(resid_fn)(vec)
-        _, cov, ncoef, chi2 = gls_normal_solve(r, J, err, U, phi)
-        return vec, chi2, cov
+        if not with_health:
+            _, cov, ncoef, chi2 = gls_normal_solve(
+                r, J, err, U, phi, guard_eps=guard_eps)
+            return vec, chi2, cov, ()
+        dpar, cov, ncoef, chi2, diag = gls_normal_solve(
+            r, J, err, U, phi, guard_eps=guard_eps, with_health=True)
+        health = self._step_health_one(resid_fn, vec, err, sigma, chi2,
+                                       dpar, cov, diag, batch, valid)
+        return vec, chi2, cov, health
 
     # -- wideband (stacked TOA + DM) path -------------------------------------
     def _gather_dm(self):
@@ -523,7 +557,8 @@ class PTABatch:
 
     def _fit_one_wb(self, vec0, base_values, batch, ctx, tzr_batch,
                     tzr_ctx, valid, free_mask, U, phi, dm_data,
-                    dm_error, dm_valid, maxiter):
+                    dm_error, dm_valid, guard_eps, maxiter,
+                    with_health):
         """One pulsar's wideband GLS fit: stacked [time; DM] residual
         with the correlated-noise basis acting on the time block only
         (zero rows under the DM block), same normal equations as
@@ -558,7 +593,8 @@ class PTABatch:
             vec, _ = carry
             r = resid_fn(vec)
             J = jax.jacfwd(resid_fn)(vec)
-            dpar, cov, _, chi2 = gls_normal_solve(r, J, err, U_wb, phi)
+            dpar, cov, _, chi2 = gls_normal_solve(
+                r, J, err, U_wb, phi, guard_eps=guard_eps)
             return (vec + dpar, chi2), None
 
         (vec, _), _ = jax.lax.scan(
@@ -566,8 +602,18 @@ class PTABatch:
         )
         r = resid_fn(vec)
         J = jax.jacfwd(resid_fn)(vec)
-        _, cov, _, chi2 = gls_normal_solve(r, J, err, U_wb, phi)
-        return vec, chi2, cov
+        if not with_health:
+            _, cov, _, chi2 = gls_normal_solve(
+                r, J, err, U_wb, phi, guard_eps=guard_eps)
+            return vec, chi2, cov, ()
+        dpar, cov, _, chi2, diag = gls_normal_solve(
+            r, J, err, U_wb, phi, guard_eps=guard_eps,
+            with_health=True)
+        stacked_valid = jnp.concatenate([valid, dm_valid])
+        health = _guard.step_health(
+            r, err, chi2, dpar, cov, diag, valid=stacked_valid,
+            inputs_ok=_guard.batch_input_finite(batch, valid))
+        return vec, chi2, cov, health
 
     # -- batched-fit construction (memoized; registry-shared) -----------------
     def _structure_key(self):
@@ -585,28 +631,33 @@ class PTABatch:
             ))
         return got
 
-    def _build_fit(self, kind, maxiter):
+    def _build_fit(self, kind, maxiter, with_health):
         tzr_ax = 0 if self.tzr_batch is not None else None
         tcx_ax = 0 if self.tzr_ctx is not None else None
+        # guard_eps is the LAST argument, broadcast over pulsars
+        # (in_axes None) — the ladder escalates it as dynamic data
+        # through the one compiled batch program
         if kind == "wls":
             return jax.vmap(
-                lambda v, b, bt, c, tb, tc, m, fm: self._fit_one(
-                    v, b, bt, c, tb, tc, m, fm, maxiter
+                lambda v, b, bt, c, tb, tc, m, fm, ge: self._fit_one(
+                    v, b, bt, c, tb, tc, m, fm, ge, maxiter,
+                    with_health
                 ),
-                in_axes=(0, 0, 0, 0, tzr_ax, tcx_ax, 0, 0),
+                in_axes=(0, 0, 0, 0, tzr_ax, tcx_ax, 0, 0, None),
             )
         if kind == "gls":
             return jax.vmap(
-                lambda v, b, bt, c, tb, tc, m, fm, uu, ph:
+                lambda v, b, bt, c, tb, tc, m, fm, uu, ph, ge:
                 self._fit_one_gls(v, b, bt, c, tb, tc, m, fm, uu, ph,
-                                  maxiter),
-                in_axes=(0, 0, 0, 0, tzr_ax, tcx_ax, 0, 0, 0, 0),
+                                  ge, maxiter, with_health),
+                in_axes=(0, 0, 0, 0, tzr_ax, tcx_ax, 0, 0, 0, 0, None),
             )
         return jax.vmap(
-            lambda v, b, bt, c, tb, tc, m, fm, uu, ph, dd, de, dv:
+            lambda v, b, bt, c, tb, tc, m, fm, uu, ph, dd, de, dv, ge:
             self._fit_one_wb(v, b, bt, c, tb, tc, m, fm, uu, ph,
-                             dd, de, dv, maxiter),
-            in_axes=(0, 0, 0, 0, tzr_ax, tcx_ax, 0, 0, 0, 0, 0, 0, 0),
+                             dd, de, dv, ge, maxiter, with_health),
+            in_axes=(0, 0, 0, 0, tzr_ax, tcx_ax, 0, 0, 0, 0, 0, 0, 0,
+                     None),
         )
 
     def _batched_fit_jit(self, kind, maxiter):
@@ -616,21 +667,22 @@ class PTABatch:
         ``jax.jit(lambda *a: fit(*a))`` — a fresh jitted callable (and
         a full retrace + XLA compile of the entire PTA program) on
         EVERY fit invocation."""
+        with_health = _guard.enabled()
         cache = getattr(self, "_fit_jit_cache", None)
         if cache is None:
             cache = self._fit_jit_cache = {}
-        got = cache.get((kind, maxiter))
+        got = cache.get((kind, maxiter, with_health))
         if got is None:
-            got = cache[(kind, maxiter)] = _cc.shared_jit(
-                self._build_fit(kind, maxiter),
-                key=("pta.batched", kind, int(maxiter),
+            got = cache[(kind, maxiter, with_health)] = _cc.shared_jit(
+                self._build_fit(kind, maxiter, with_health),
+                key=("pta.batched", kind, int(maxiter), with_health,
                      self._structure_key()),
                 fn_token="pta.batched_fit")
         else:
             telemetry.counter_add("pta.fit_jit_cache_hits")
         return got
 
-    def fit_wideband(self, maxiter=3, mesh=None):
+    def fit_wideband(self, maxiter=3, mesh=None, checkpoint=None):
         """Batched wideband fit: stacked [time; DM] residuals per
         pulsar, the whole (possibly mixed narrowband+wideband) PTA as
         one XLA program — the batched counterpart of
@@ -643,9 +695,9 @@ class PTABatch:
             fit, (self.values0, self.base_values, self.batch, self.ctx,
                   self.tzr_batch, self.tzr_ctx, self.valid,
                   self.free_mask, U, phi, dm_data, dm_error, dm_valid),
-            mesh)
+            mesh, checkpoint)
 
-    def fit_gls(self, maxiter=3, mesh=None):
+    def fit_gls(self, maxiter=3, mesh=None, checkpoint=None):
         """Batched GLS fit: every pulsar's timing parameters against
         its own correlated-noise covariance (ECORR / red-noise bases at
         the current noise values), the whole PTA as one XLA program —
@@ -656,18 +708,22 @@ class PTABatch:
         return self._run_batched(
             fit, (self.values0, self.base_values, self.batch, self.ctx,
                   self.tzr_batch, self.tzr_ctx, self.valid,
-                  self.free_mask, U, phi), mesh)
+                  self.free_mask, U, phi), mesh, checkpoint)
 
-    def _run_batched(self, fit, args, mesh):
+    def _run_batched(self, fit, args, mesh, checkpoint=None):
         """Run the jitted batched fit (optionally mesh-sharded over the
         pulsar axis) and write fitted values back (only genuinely-free
         params)."""
         with span("pta.batched_fit", n_pulsars=self.n_pulsars,
                   n_max=self.n_max, n_free=len(self.free_names),
                   sharded=mesh is not None):
-            return self._run_batched_inner(fit, args, mesh)
+            return self._run_batched_inner(fit, args, mesh, checkpoint)
 
-    def _run_batched_inner(self, fit, args, mesh):
+    #: batched-path ladder: same escalation table as the
+    #: single-pulsar fitters
+    _guard_jitter_rungs = _guard.JITTER_RUNGS
+
+    def _run_batched_inner(self, fit, args, mesh, checkpoint=None):
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -686,7 +742,45 @@ class PTABatch:
             args = tuple(
                 shard_tree(a) if a is not None else None for a in args
             )
-        vec, chi2, cov = fit(*args)
+        vec, chi2, cov, health = fit(*args, jnp.float64(0.0))
+        telemetry.counter_add("guard.checks")
+        bad = _guard.batch_bad(health)
+        rung = "baseline"
+        rung_of = {}  # member index -> serving rung name
+        if bad is not None and bad.any():
+            # degradation ladder over the WHOLE batch (one compiled
+            # program; guard_eps is dynamic): merge per pulsar — keep
+            # each pulsar's first healthy result.  Input-class members
+            # (non-finite data) are excluded up front: no rung fixes
+            # bad data, and a full-batch retry is not free (mirrors
+            # run_ladder's immediate input-class abort).
+            telemetry.counter_add("guard.trips")
+            telemetry.counter_add("guard.trip.pta")
+            fixable = bad & ~_guard.batch_input_bad(health)
+            for name, eps in self._guard_jitter_rungs:
+                if not fixable.any():
+                    break
+                v2, c2, k2, h2 = fit(*args, jnp.float64(eps))
+                fixed = fixable & ~_guard.batch_bad(h2)
+                if fixed.any():
+                    telemetry.counter_add(f"guard.rung.{name}",
+                                          float(fixed.sum()))
+                    m = jnp.asarray(fixed)
+                    vec = jnp.where(m[:, None], v2, vec)
+                    chi2 = jnp.where(m, c2, chi2)
+                    cov = jnp.where(m[:, None, None], k2, cov)
+                    # fit_health must describe the SERVED results —
+                    # merge the recovered pulsars' health records too
+                    health = jax.tree.map(
+                        lambda old, new: jnp.where(
+                            m.reshape(m.shape + (1,) * (old.ndim - 1)),
+                            new, old),
+                        health, h2)
+                    rung = name
+                    for i in np.flatnonzero(fixed):
+                        rung_of[int(i)] = name
+                    bad = bad & ~fixed
+                    fixable = fixable & ~fixed
         vec_np = np.asarray(vec)
         telemetry.record_transfer(vec_np)
         telemetry.counter_add(
@@ -694,10 +788,47 @@ class PTABatch:
             _flops.pta_batch_flops(
                 self.n_pulsars, self.n_max, len(self.free_names),
                 self._noise_basis_width()))
+        bad_idx = [] if bad is None else list(np.flatnonzero(bad))
         for k, p in enumerate(self.prepareds):
+            if k in bad_idx:
+                continue  # never write a diverged pulsar's values
             for i, name in enumerate(self.free_names):
                 if float(self.free_mask[k, i]):
                     p.model.values[name] = float(vec_np[k, i])
+        self.fit_rung = rung
+        self.fit_health = _guard.to_record(health)
+        # the loudness contract of fitter._record_guard, per pulsar: a
+        # rung-served member's exported par file must carry the
+        # degradation flag (and the batch warns); a cleanly-served
+        # member clears any stale flag from an earlier degraded fit
+        if bad is not None:
+            for k, p in enumerate(self.prepareds):
+                if k in rung_of:
+                    p.model.meta["GUARD_RUNG"] = rung_of[k]
+                elif k not in bad_idx:
+                    p.model.meta.pop("GUARD_RUNG", None)
+            if rung_of:
+                import warnings
+
+                warnings.warn(
+                    "PTABatch: fit served by degradation rung(s) "
+                    f"{rung_of} (see model.meta['GUARD_RUNG'] and "
+                    "batch.fit_health)")
+        if checkpoint is not None:
+            # healthy pulsars' progress survives even when the batch
+            # partially diverged (the raise below)
+            self.save_checkpoint(checkpoint)
+        if bad_idx:
+            raise _guard.FitDivergedError(
+                "PTABatch",
+                health=_guard.to_record(health),
+                bad_indices=[int(i) for i in bad_idx],
+                results=(vec, chi2, cov),
+                rungs_tried=["baseline"] + [n for n, _ in
+                                            self._guard_jitter_rungs],
+                detail="healthy pulsars were written back (and "
+                       "checkpointed when requested); the listed "
+                       "indices kept their pre-fit values")
         return vec, chi2, cov
 
     def _noise_basis_width(self):
@@ -720,17 +851,62 @@ class PTABatch:
                  self.tzr_batch, self.tzr_ctx, self.valid,
                  self.free_mask)
 
-    def fit_wls(self, maxiter=3, mesh=None):
+    def fit_wls(self, maxiter=3, mesh=None, checkpoint=None):
         """Batched WLS Gauss-Newton fit of every pulsar; returns
         (fitted_values (k, P), chi2 (k,), cov (k, P, P)).
 
         With a mesh, the pulsar axis is sharded over devices
-        (NamedSharding) — the multi-chip path the driver dry-runs."""
+        (NamedSharding) — the multi-chip path the driver dry-runs.
+        checkpoint: optional path — fitted values are atomic-written
+        after the fit (guard.save_checkpoint), validated on restore
+        against this batch's structure fingerprint."""
         fit = self._batched_fit_jit("wls", maxiter)
         return self._run_batched(
             fit, (self.values0, self.base_values, self.batch, self.ctx,
                   self.tzr_batch, self.tzr_ctx, self.valid,
-                  self.free_mask), mesh)
+                  self.free_mask), mesh, checkpoint)
+
+    # -- checkpoint/resume ----------------------------------------------------
+    def _checkpoint_fingerprint(self):
+        """Identity a fit checkpoint is validated against: the batched
+        trace's structure key (superset model structure, free-name
+        union, batch geometry) — values from a different array layout
+        must never be silently restored."""
+        return _cc.fingerprint(self._structure_key())
+
+    def save_checkpoint(self, path):
+        """Atomic-write the batch's fit progress: every pulsar's
+        current values for the free-name union (the quantities
+        fit_wls/fit_gls write back)."""
+        vals = np.array([
+            [float(p.model.values[n]) for n in self.free_names]
+            for p in self.prepareds
+        ])
+        return _guard.save_checkpoint(
+            path, {"values": vals},
+            fingerprint=self._checkpoint_fingerprint(),
+            meta={"free_names": list(self.free_names)})
+
+    def restore_checkpoint(self, path):
+        """Restore fit progress saved by :meth:`save_checkpoint` into
+        the models (free-masked entries only) and ``values0``.
+        Validates the structure fingerprint; raises
+        :class:`pint_tpu.guard.CheckpointMismatchError` on a stale or
+        foreign checkpoint, FileNotFoundError when absent."""
+        arrays, _head = _guard.load_checkpoint(
+            path, fingerprint=self._checkpoint_fingerprint(),
+            missing_ok=False)
+        vals = np.asarray(arrays["values"])
+        if vals.shape != (self.n_pulsars, len(self.free_names)):
+            raise _guard.CheckpointMismatchError(
+                f"{path}: values shape {vals.shape} != "
+                f"({self.n_pulsars}, {len(self.free_names)})")
+        for k, p in enumerate(self.prepareds):
+            for i, name in enumerate(self.free_names):
+                if float(self.free_mask[k, i]):
+                    p.model.values[name] = float(vals[k, i])
+        self.values0 = jnp.asarray(vals)
+        return vals
 
     @property
     def dof(self):

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from pint_tpu import compile_cache as _cc
+from pint_tpu import faults as _faults
 from pint_tpu import telemetry
 from pint_tpu.linalg import woodbury_chi2_logdet
 from pint_tpu.models.timing_model import PreparedModel, TimingModel
@@ -140,8 +141,15 @@ class Residuals:
         """The dataset as a pytree of arrays — the dynamic argument of
         every shared-trace evaluation function."""
         if self._data_cached is None:
+            batch = self.prepared.batch
+            if _faults.any_active():
+                # fault injection happens HERE, at the host boundary
+                # where concrete arrays become the dynamic dataset — a
+                # corrupted dataset is ordinary data under the shared
+                # traces and can never poison the jit registry
+                batch = _faults.corrupt_batch(batch)
             self._data_cached = {
-                "batch": self.prepared.batch,
+                "batch": batch,
                 "ctx": self._ctx_dyn,
                 "tzr_batch": self.prepared.tzr_batch,
                 "tzr_ctx": self._tzr_ctx_dyn,

@@ -20,6 +20,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pint_tpu import faults as _faults
+from pint_tpu import guard as _guard
+from pint_tpu import telemetry
+
 __all__ = ["run_mcmc", "EnsembleSampler", "integrated_autocorr_time"]
 
 
@@ -110,7 +114,14 @@ def run_mcmc(lnpost, x0, nsteps, key=None, a=2.0, thin=1, jit_key=None):
             acc = jnp.concatenate([acc1, acc2])
             return (x, lnp), (x, lnp, jnp.mean(acc))
 
-        return jax.lax.scan(step, (x0, lnpost_v(x0)), keys)
+        (xf, lnpf), ys = jax.lax.scan(step, (x0, lnpost_v(x0)), keys)
+        # on-device chain health, riding the same compiled program:
+        # positions must stay finite, and at least one walker must end
+        # with a finite log-posterior (all -inf = the whole ensemble
+        # stuck outside the prior support, every proposal NaN-rejected)
+        health = (jnp.all(jnp.isfinite(xf)),
+                  jnp.any(jnp.isfinite(lnpf)))
+        return (xf, lnpf), ys, health
 
     # nw/a are baked into the stored closure — they must be part of
     # the key, not left to aval-driven retracing of a stale closure
@@ -118,7 +129,23 @@ def run_mcmc(lnpost, x0, nsteps, key=None, a=2.0, thin=1, jit_key=None):
         scan_chain, key=("sampler.run_mcmc", nw, float(a)),
         fn_token=jit_key if jit_key is not None else lnpost)
     keys = jax.random.split(key, nsteps)
-    (xf, lnpf), (chain, lnps, accs) = runner(x0, keys)
+    (xf, lnpf), (chain, lnps, accs), (pos_ok, lnp_ok) = runner(x0, keys)
+    # the health tuple always rides the program (two trailing
+    # reductions; keeping it out of the key), but the host-side raise
+    # honors the guard gate — PINT_TPU_GUARD=0 restores raw semantics
+    if _guard.enabled():
+        telemetry.counter_add("guard.checks")
+        if not (bool(pos_ok) and bool(lnp_ok)):
+            telemetry.counter_add("guard.trips")
+            telemetry.counter_add("guard.trip.sampler")
+            raise _guard.FitDivergedError(
+                "sampler.run_mcmc",
+                health={"positions_finite": bool(pos_ok),
+                        "any_finite_lnp": bool(lnp_ok)},
+                last_good=np.asarray(x0),
+                detail="chain diverged (non-finite walker positions "
+                       "or every walker at lnp=-inf); .last_good "
+                       "carries the initial ensemble state")
     if thin > 1:
         chain = chain[::thin]
         lnps = lnps[::thin]
@@ -157,14 +184,37 @@ class EnsembleSampler:
         )
         return self.chain
 
+    def _checkpoint_fingerprint(self, x0):
+        """Identity a chain checkpoint is validated against: the
+        posterior's jit identity (the registry key MCMCFitter
+        fingerprints, or the posterior's qualname as a weaker stand-in)
+        plus the ensemble geometry — a checkpoint from a different
+        posterior or walker layout must never be silently resumed."""
+        from pint_tpu import compile_cache as _cc
+
+        ident = (repr(self.jit_key) if self.jit_key is not None
+                 else getattr(self.lnpost, "__qualname__",
+                              type(self.lnpost).__name__))
+        return _cc.fingerprint(
+            (ident, self.nwalkers, int(np.shape(x0)[-1])))
+
     def run_mcmc_autocorr(self, x0, chunk=100, maxsteps=5000,
-                          tau_factor=50.0, rtol=0.1):
+                          tau_factor=50.0, rtol=0.1, checkpoint=None):
         """Run in chunks until converged by the emcee criterion
         (reference: event_optimize run_sampler_autocorr): stop when the
         chain is longer than ``tau_factor`` integrated autocorrelation
         times AND tau changed by < ``rtol`` between chunks; give up at
         exactly ``maxsteps``.  No thinning — tau must be measured in
-        raw steps.  Returns (chain, converged, tau)."""
+        raw steps.  Returns (chain, converged, tau).
+
+        checkpoint: optional path — chain state (samples, log-probs,
+        rng key, step count) is atomic-written after every chunk, and
+        an existing checkpoint at the path resumes the run mid-chain
+        (a killed 10^5-step job loses at most one chunk).  Resume is
+        validated against the posterior's jit fingerprint
+        (:meth:`_checkpoint_fingerprint`); a mismatch raises
+        :class:`pint_tpu.guard.CheckpointMismatchError` rather than
+        silently reusing a stale chain."""
         chains = []
         lnprobs = []
         accs = []
@@ -173,6 +223,18 @@ class EnsembleSampler:
         converged = False
         x = x0
         total = 0
+        fp = None
+        if checkpoint is not None:
+            fp = self._checkpoint_fingerprint(x0)
+            loaded = _guard.load_checkpoint(checkpoint, fingerprint=fp)
+            if loaded is not None:
+                arrays, head = loaded
+                chains = [arrays["chain"]]
+                lnprobs = [arrays["lnprob"]]
+                accs = [(float(a), int(n)) for a, n in arrays["accs"]]
+                total = int(arrays["total"][()])
+                x = jnp.asarray(arrays["chain"][-1])
+                self.key = jnp.asarray(arrays["key"])
         while total < maxsteps:
             step = int(min(chunk, maxsteps - total))
             self.key, sub = jax.random.split(self.key)
@@ -184,6 +246,17 @@ class EnsembleSampler:
             x = chain[-1]
             total += step
             full = np.concatenate(chains, axis=0)
+            if checkpoint is not None:
+                _guard.save_checkpoint(
+                    checkpoint,
+                    {"chain": full,
+                     "lnprob": np.concatenate(lnprobs, axis=0),
+                     "accs": np.asarray(accs, dtype=np.float64),
+                     "total": np.int64(total),
+                     "key": np.asarray(self.key)},
+                    fingerprint=fp,
+                    meta={"maxsteps": int(maxsteps)})
+                _faults.maybe_kill("sampler.chunk")
             tau = integrated_autocorr_time(full)
             if (np.all(np.isfinite(tau))
                     and total > tau_factor * np.max(tau)
@@ -193,6 +266,14 @@ class EnsembleSampler:
                 converged = True
                 break
             tau_prev = tau
+        if not np.all(np.isfinite(tau)) and chains:
+            # resumed at total >= maxsteps: the loop never ran, so tau
+            # is still its placeholder — measure it from the restored
+            # chain instead of handing the caller [inf] (converged
+            # stays False: the chunk-to-chunk stability criterion
+            # cannot be honestly evaluated from a single snapshot)
+            tau = integrated_autocorr_time(
+                np.concatenate(chains, axis=0))
         self.chain = jnp.asarray(np.concatenate(chains, axis=0))
         self.lnprob = jnp.asarray(np.concatenate(lnprobs, axis=0))
         # whole-run mean acceptance (chunk-length weighted), matching

@@ -1,0 +1,298 @@
+"""Chaos suite: every guard degradation path exercised by injected
+faults (pint_tpu.faults), never trusted on faith.
+
+Each fault class from the robustness contract — NaN residual inputs,
+inf sigma, rank-deficient phi priors, corrupted clock rows, mid-chain
+process death — must either recover via a documented ladder rung or
+raise a structured error carrying last-good state.  No silent garbage.
+
+Marked ``chaos`` (registered in pyproject); everything here is
+tier-1-fast and runs under ``-m 'not slow'``.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from pint_tpu import faults, guard, telemetry
+from pint_tpu.fitter import GLSFitter, WLSFitter
+from pint_tpu.models.builder import get_model
+from pint_tpu.simulation import make_fake_pta, make_fake_toas_uniform
+
+pytestmark = pytest.mark.chaos
+
+WLS_PAR = """PSR TSTCHAOS
+RAJ 18:57:36.39
+DECJ 09:43:17.2
+F0 186.494 1
+F1 -6.2e-16 1
+PEPOCH 54000
+DM 13.3 1
+TZRMJD 54000
+TZRSITE @
+TZRFRQ 1400
+UNITS TDB
+EPHEM builtin
+"""
+
+GLS_PAR = WLS_PAR.replace(
+    "UNITS TDB",
+    "EFAC -f L-wide 1.1\nTNRedAmp -13.5\nTNRedGam 3.3\nTNRedC 10\n"
+    "UNITS TDB")
+
+
+def _mk(par, n, seed):
+    model = get_model(par)
+    toas = make_fake_toas_uniform(
+        53000.0, 56500.0, n, model, freq_mhz=1400.0, obs="gbt",
+        error_us=1.0, add_noise=True, rng=np.random.default_rng(seed),
+        flags={"f": "L-wide"})
+    return model, toas
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+class TestSpecGrammar:
+    def test_parse(self):
+        cfg = faults.parse(
+            "nan_resid:index=3,kill:after=2:site=sampler.chunk,"
+            "inf_sigma")
+        assert cfg == {
+            "nan_resid": {"index": 3},
+            "kill": {"after": 2, "site": "sampler.chunk"},
+            "inf_sigma": {},
+        }
+        assert faults.parse("") == {}
+
+    def test_env_activation(self, monkeypatch):
+        monkeypatch.setenv(faults.ENV, "nan_resid:index=7")
+        assert faults.active("nan_resid") == {"index": 7}
+        assert faults.active("inf_sigma") is None
+
+    def test_programmatic_overrides_env(self, monkeypatch):
+        monkeypatch.setenv(faults.ENV, "nan_resid:index=7")
+        faults.inject("nan_resid", index=2)
+        assert faults.active("nan_resid") == {"index": 2}
+        faults.clear()
+        assert faults.active("nan_resid") == {"index": 7}
+
+
+class TestInputFaults:
+    def test_nan_resid_structured_error(self):
+        """A NaN observing frequency (which the fixed-point phase path
+        silently swallows into plausible-looking residuals) must raise
+        a structured FitDivergedError, never return garbage."""
+        faults.inject("nan_resid", index=4)
+        model, toas = _mk(WLS_PAR, 50, 0)
+        f = WLSFitter(toas, model)
+        before = dict(model.values)
+        trips0 = telemetry.counter_get("guard.trips")
+        with pytest.raises(guard.FitDivergedError) as ei:
+            f.fit_toas(maxiter=3)
+        assert model.values == before
+        assert ei.value.health["input_finite"] is False
+        assert ei.value.last_good is not None
+        assert telemetry.counter_get("guard.trips") > trips0
+        assert telemetry.counter_get("faults.injected.nan_resid") > 0
+
+    def test_inf_sigma_structured_error(self):
+        faults.inject("inf_sigma", index=2)
+        model, toas = _mk(WLS_PAR, 50, 1)
+        f = WLSFitter(toas, model)
+        with pytest.raises(guard.FitDivergedError) as ei:
+            f.fit_toas(maxiter=3)
+        assert ei.value.health["sigma_finite"] is False
+
+    def test_nan_resid_gls_path(self):
+        faults.inject("nan_resid", index=4)
+        model, toas = _mk(GLS_PAR, 60, 2)
+        f = GLSFitter(toas, model)
+        with pytest.raises(guard.FitDivergedError):
+            f.fit_toas(maxiter=2)
+
+
+class TestRankDeficientPhi:
+    def test_dense_phi_jitter_rung_recovers(self):
+        """The rank-1 ORF (exact null space in kron(ORF, phi)) must
+        recover via the documented per-diagonal Cholesky jitter —
+        lnlike finite, no error."""
+        from pint_tpu.gw import CommonProcess
+
+        pairs = make_fake_pta(3, 20, start_mjd=54000.0,
+                              duration_days=900.0, name_prefix="CHAOS")
+        faults.inject("rank_deficient_phi")
+        crn = CommonProcess(pairs, nmodes=3)
+        v = crn.lnlike(-14.0, 4.0)
+        assert np.isfinite(v)
+        surf = crn.lnlike_grid([-15.0, -14.0], [4.0])
+        assert np.all(np.isfinite(surf))
+        assert telemetry.counter_get(
+            "faults.injected.rank_deficient_phi") > 0
+
+
+class TestCorruptedClock:
+    def test_corrupt_row_raises_structured(self, tmp_path):
+        from pint_tpu.obs.clock import ClockFile
+
+        p = tmp_path / "site.clk"
+        p.write_text("# SITE UTC(GPS)\n"
+                     "50000.0 1.0e-6\n51000.0 2.0e-6\n52000.0 1.5e-6\n")
+        # clean parse first
+        assert ClockFile.read_tempo2(str(p)).mjds.size == 3
+        faults.inject("clock_corrupt")
+        with pytest.raises(ValueError, match="non-finite"):
+            ClockFile.read_tempo2(str(p))
+
+    def test_literal_nan_row_rejected_without_fault(self, tmp_path):
+        """'nan' parses as a valid float — the ClockFile validation,
+        not the parser loop, is the real guard."""
+        from pint_tpu.obs.clock import ClockFile
+
+        p = tmp_path / "bad.clk"
+        p.write_text("50000.0 1.0e-6\n51000.0 nan\n52000.0 1.5e-6\n")
+        with pytest.raises(ValueError, match="non-finite"):
+            ClockFile.read_tempo2(str(p))
+
+
+_KILL_RESUME_SCRIPT = r"""
+import os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+import jax.numpy as jnp
+from pint_tpu.sampler import EnsembleSampler
+
+def lnpost(x):
+    return -0.5 * jnp.sum(x ** 2)
+
+s = EnsembleSampler(lnpost, nwalkers=8, seed=0, jit_key=("chaos-kill",))
+x0 = s.initial_ball(jnp.zeros(2), 0.1 * jnp.ones(2))
+chain, conv, tau = s.run_mcmc_autocorr(
+    x0, chunk=15, maxsteps=60, checkpoint=sys.argv[1])
+print("CHAIN_LEN", np.asarray(s.chain).shape[0])
+"""
+
+
+class TestKillAndResume:
+    def test_mid_chain_kill_then_resume(self, tmp_path):
+        """The full story: a chain killed mid-run (deterministic kill
+        fault after 2 checkpointed chunks) resumes from its checkpoint
+        and completes — at most one chunk of work is ever lost."""
+        script = tmp_path / "driver.py"
+        script.write_text(_KILL_RESUME_SCRIPT)
+        ckpt = tmp_path / "chain.npz"
+        import pint_tpu
+
+        repo_root = os.path.dirname(os.path.dirname(pint_tpu.__file__))
+        pypath = repo_root + os.pathsep + os.environ.get("PYTHONPATH",
+                                                         "")
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONPATH=pypath,
+                   PINT_TPU_FAULTS="kill:after=2:site=sampler.chunk")
+        r1 = subprocess.run([sys.executable, str(script), str(ckpt)],
+                            env=env, capture_output=True, text=True,
+                            timeout=300)
+        assert r1.returncode == 137, (r1.stdout, r1.stderr)
+        assert ckpt.exists()
+        arrays, head = guard.load_checkpoint(ckpt)
+        assert int(arrays["total"][()]) == 30  # 2 chunks of 15 survived
+
+        env2 = dict(os.environ, JAX_PLATFORMS="cpu",
+                    PYTHONPATH=pypath)
+        env2.pop("PINT_TPU_FAULTS", None)
+        r2 = subprocess.run([sys.executable, str(script), str(ckpt)],
+                            env=env2, capture_output=True, text=True,
+                            timeout=300)
+        assert r2.returncode == 0, (r2.stdout, r2.stderr)
+        assert "CHAIN_LEN 60" in r2.stdout
+        arrays, _ = guard.load_checkpoint(ckpt)
+        assert int(arrays["total"][()]) == 60
+
+    def test_resume_of_finished_run_reports_real_tau(self, tmp_path):
+        """Resuming a checkpoint that already reached maxsteps must
+        measure tau from the restored chain, not return the [inf]
+        placeholder (which would silently change the burn-in rule)."""
+        from pint_tpu.sampler import EnsembleSampler
+
+        def lnpost(x):
+            return -0.5 * jnp.sum(x ** 2)
+
+        ckpt = tmp_path / "done.npz"
+        s1 = EnsembleSampler(lnpost, nwalkers=8, seed=0,
+                             jit_key=("post-T",))
+        x0 = s1.initial_ball(jnp.zeros(2), 0.1 * jnp.ones(2))
+        s1.run_mcmc_autocorr(x0, chunk=20, maxsteps=40,
+                             checkpoint=ckpt)
+        s2 = EnsembleSampler(lnpost, nwalkers=8, seed=0,
+                             jit_key=("post-T",))
+        chain, converged, tau = s2.run_mcmc_autocorr(
+            x0, chunk=20, maxsteps=40, checkpoint=ckpt)
+        assert np.asarray(chain).shape[0] == 40
+        assert np.all(np.isfinite(tau))
+
+    def test_stale_checkpoint_never_silently_resumed(self, tmp_path):
+        from pint_tpu.sampler import EnsembleSampler
+
+        def lnpost(x):
+            return -0.5 * jnp.sum(x ** 2)
+
+        ckpt = tmp_path / "c.npz"
+        s1 = EnsembleSampler(lnpost, nwalkers=8, seed=0,
+                             jit_key=("post-A",))
+        x0 = s1.initial_ball(jnp.zeros(2), 0.1 * jnp.ones(2))
+        s1.run_mcmc_autocorr(x0, chunk=10, maxsteps=20,
+                             checkpoint=ckpt)
+        s2 = EnsembleSampler(lnpost, nwalkers=8, seed=0,
+                             jit_key=("post-B",))
+        with pytest.raises(guard.CheckpointMismatchError):
+            s2.run_mcmc_autocorr(x0, chunk=10, maxsteps=20,
+                                 checkpoint=ckpt)
+
+
+class TestSamplerDivergence:
+    def test_all_walkers_stuck_raises(self):
+        from pint_tpu.sampler import run_mcmc
+
+        def lnbad(x):  # -inf everywhere reachable
+            return jnp.where(jnp.all(x < -1e30), 0.0, -jnp.inf)
+
+        with pytest.raises(guard.FitDivergedError) as ei:
+            run_mcmc(lnbad, jnp.zeros((8, 2)), 10)
+        assert ei.value.health["any_finite_lnp"] is False
+        assert ei.value.last_good is not None
+
+    def test_guard_off_restores_raw_semantics(self, monkeypatch):
+        """PINT_TPU_GUARD=0 must disable the host-side raise — the
+        documented escape back to pre-guard behavior."""
+        from pint_tpu.sampler import run_mcmc
+
+        monkeypatch.setenv("PINT_TPU_GUARD", "0")
+
+        def lnbad(x):
+            return jnp.where(jnp.all(x < -1e30), 0.0, -jnp.inf)
+
+        chain, lnps, acc = run_mcmc(lnbad, jnp.zeros((8, 2)), 10)
+        assert np.asarray(chain).shape == (10, 8, 2)
+
+
+class TestDatacheckFaultsSection:
+    def test_section_reports_all_ok(self):
+        from pint_tpu.datacheck import _faults_section
+
+        lines = _faults_section()
+        text = "\n".join(lines)
+        assert "PROBLEM" not in text and "ERROR" not in text
+        for fault in ("nan_resid", "inf_sigma", "rank_deficient_phi",
+                      "clock_corrupt"):
+            assert fault in text
+        # the smoke must leave no fault active
+        assert not faults.any_active()

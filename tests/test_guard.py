@@ -1,0 +1,434 @@
+"""Numerical-health guard layer tests (pint_tpu.guard).
+
+Covers: the on-device health pytree (clean fits report clean, the
+pad-sentinel satellite — a bucketed fit with PAD_ERROR_US rows gives a
+clean verdict while a real NaN TOA trips), the ladder driver, the
+solve diagnostics (truncation count / condition proxy), checkpoint
+atomic-write + fingerprint validation, the fit_noise divergence and
+Hessian satellites, the guard on/off gate, and the zero-new-compile
+acceptance regression.  All CPU, tier-1-fast.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pint_tpu import compile_cache, faults, guard, telemetry
+from pint_tpu.compile_cache import pad_toas
+from pint_tpu.fitter import GLSFitter, WLSFitter, wls_gn_solve
+from pint_tpu.linalg import gls_normal_solve
+from pint_tpu.models.builder import get_model
+from pint_tpu.simulation import make_fake_toas_uniform
+
+WLS_PAR = """PSR TSTGUARD
+RAJ 18:57:36.39
+DECJ 09:43:17.2
+F0 186.494 1
+F1 -6.2e-16 1
+PEPOCH 54000
+DM 13.3 1
+TZRMJD 54000
+TZRSITE @
+TZRFRQ 1400
+UNITS TDB
+EPHEM builtin
+"""
+
+GLS_PAR = WLS_PAR.replace(
+    "UNITS TDB",
+    "EFAC -f L-wide 1.1\nTNRedAmp -13.5\nTNRedGam 3.3\nTNRedC 10\n"
+    "UNITS TDB")
+
+
+def _mk(par, n, seed):
+    model = get_model(par)
+    toas = make_fake_toas_uniform(
+        53000.0, 56500.0, n, model, freq_mhz=1400.0, obs="gbt",
+        error_us=1.0, add_noise=True, rng=np.random.default_rng(seed),
+        flags={"f": "L-wide"})
+    return model, toas
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _compiles():
+    telemetry.compile_stats()
+    return telemetry.counter_get("jit.compile_events")
+
+
+def _monitoring_live():
+    return telemetry.compile_stats()["source"] == "jax.monitoring"
+
+
+class TestHealthRecord:
+    def test_clean_wls_fit_reports_clean(self):
+        model, toas = _mk(WLS_PAR, 60, 0)
+        f = WLSFitter(toas, model)
+        f.fit_toas(maxiter=3)
+        assert f.fit_rung == "baseline"
+        h = f.fit_health
+        for k in ("input_finite", "resid_finite", "sigma_finite",
+                  "chi2_finite", "step_finite", "cov_finite"):
+            assert h[k] is True, k
+        assert h["n_truncated"] == 0
+        assert np.isfinite(h["cond_log10"])
+        assert "GUARD_RUNG" not in model.meta
+
+    def test_clean_gls_fit_reports_clean(self):
+        model, toas = _mk(GLS_PAR, 80, 1)
+        f = GLSFitter(toas, model)
+        f.fit_toas(maxiter=2)
+        assert f.fit_rung == "baseline"
+        assert f.fit_health["chi2_finite"] is True
+
+    def test_pad_sentinel_rows_give_clean_verdict(self):
+        """The bucketing satellite: sentinel rows at PAD_ERROR_US must
+        NOT raise a health alarm."""
+        model, toas = _mk(WLS_PAR, 70, 2)  # pads to bucket 80
+        padded = pad_toas(toas)
+        assert len(padded) > 70
+        f = WLSFitter(padded, model)
+        f.fit_toas(maxiter=3)
+        assert f.fit_rung == "baseline"
+        assert f.fit_health["input_finite"] is True
+        assert f.fit_health["resid_finite"] is True
+
+    def test_real_nan_toa_trips_on_padded_fit(self):
+        """...while the same bucketed fit with one REAL NaN TOA must
+        trip — the pad mask hides sentinels, never real corruption."""
+        model, toas = _mk(WLS_PAR, 70, 3)
+        faults.inject("nan_resid", index=5)
+        padded = pad_toas(toas)
+        f = WLSFitter(padded, model)
+        before = dict(model.values)
+        with pytest.raises(guard.FitDivergedError) as ei:
+            f.fit_toas(maxiter=3)
+        assert ei.value.health["input_finite"] is False
+        # input-class divergence: ladder aborts after one rung and the
+        # model keeps its pre-fit values
+        assert ei.value.rungs_tried == ("baseline",)
+        assert model.values == before
+        assert ei.value.last_good is not None
+        assert set(ei.value.last_good) == set(model.free_timing_params)
+
+    def test_clean_fit_clears_stale_guard_rung(self):
+        """A clean fit must clear a GUARD_RUNG flag left by an earlier
+        degraded fit — the meta lands in the output par file and must
+        describe THIS fit."""
+        model, toas = _mk(WLS_PAR, 60, 11)
+        model.meta["GUARD_RUNG"] = "jitter"
+        f = WLSFitter(toas, model)
+        f.fit_toas(maxiter=2)
+        assert "GUARD_RUNG" not in model.meta
+
+    def test_guard_off_gate(self, monkeypatch):
+        """PINT_TPU_GUARD=0 compiles the steps without health outputs
+        (a distinct registry entry) and reports an empty record."""
+        monkeypatch.setenv("PINT_TPU_GUARD", "0")
+        model, toas = _mk(WLS_PAR, 60, 4)
+        f = WLSFitter(toas, model)
+        f.fit_toas(maxiter=2)
+        assert f.fit_rung == "baseline"
+        assert f.fit_health == {}
+        monkeypatch.delenv("PINT_TPU_GUARD")
+        f_on = WLSFitter(toas, model)
+        assert f_on._step_jit is not f._step_jit  # gate is in the key
+
+
+class TestSolveDiagnostics:
+    def test_truncation_count_on_rank_deficient_system(self):
+        """A duplicated design column is an exact degeneracy: the eigh
+        pseudo-inverse must truncate it, report it, and still return
+        finite results (the always-on rung-0 mechanism)."""
+        rng = np.random.default_rng(0)
+        n = 50
+        J = rng.normal(size=(n, 3))
+        J = np.concatenate([J, J[:, :1]], axis=1)  # exact duplicate
+        r = rng.normal(size=n) * 1e-6
+        sigma = np.full(n, 1e-6)
+        U = np.zeros((n, 0))
+        dpar, cov, ncoef, chi2, diag = gls_normal_solve(
+            jnp.asarray(r), jnp.asarray(J), jnp.asarray(sigma),
+            jnp.asarray(U), jnp.zeros(0), with_health=True)
+        assert int(diag.n_truncated) >= 1
+        assert np.all(np.isfinite(np.asarray(dpar)))
+        assert np.all(np.isfinite(np.asarray(cov)))
+        assert np.isfinite(float(chi2))
+
+    def test_wls_solve_diag(self):
+        rng = np.random.default_rng(1)
+        n = 40
+        J = rng.normal(size=(n, 2))
+
+        def resid_fn(v):
+            return jnp.asarray(J) @ v - jnp.asarray(
+                rng.normal(size=n) * 1e-6)
+
+        out = wls_gn_solve(resid_fn, jnp.zeros(2),
+                           jnp.full(n, 1e-6), with_health=True)
+        assert len(out) == 5
+        diag = out[4]
+        assert int(diag.n_truncated) == 0
+        assert float(diag.cond_log10) >= 0.0
+
+    def test_guard_eps_raises_cutoff(self):
+        """The escalation scalar is dynamic: a near-degenerate pair of
+        columns survives the 1e-16 baseline cutoff but is truncated at
+        guard_eps=1e-2 — same trace, different data."""
+        rng = np.random.default_rng(2)
+        n = 50
+        a = rng.normal(size=n)
+        J = np.stack([a, a + 1e-6 * rng.normal(size=n)], axis=1)
+        r = rng.normal(size=n) * 1e-6
+        args = (jnp.asarray(r), jnp.asarray(J), jnp.full(n, 1e-6),
+                jnp.zeros((n, 0)), jnp.zeros(0))
+        *_, d0 = gls_normal_solve(*args, guard_eps=jnp.float64(0.0),
+                                  with_health=True)
+        *_, d1 = gls_normal_solve(*args, guard_eps=jnp.float64(1e-2),
+                                  with_health=True)
+        assert int(d1.n_truncated) > int(d0.n_truncated)
+
+
+class TestLadder:
+    def test_serves_first_healthy_rung(self):
+        calls = []
+
+        def bad():
+            calls.append("bad")
+            raise guard.StepDiverged((), last_good={"X": 1.0},
+                                     kind="solve")
+
+        def good():
+            calls.append("good")
+            return "result"
+
+        before = telemetry.counter_get("guard.rung.second")
+        result, rung = guard.run_ladder(
+            [("first", bad), ("second", good)], context="test")
+        assert result == "result" and rung == "second"
+        assert calls == ["bad", "good"]
+        assert telemetry.counter_get("guard.rung.second") == before + 1
+
+    def test_input_class_aborts_immediately(self):
+        calls = []
+
+        def input_bad():
+            calls.append("a")
+            raise guard.StepDiverged((), last_good={"X": 2.0},
+                                     kind="input")
+
+        def never():
+            calls.append("b")
+            return "x"
+
+        with pytest.raises(guard.FitDivergedError) as ei:
+            guard.run_ladder([("first", input_bad), ("second", never)],
+                             context="test")
+        assert calls == ["a"]
+        assert ei.value.last_good == {"X": 2.0}
+        assert ei.value.rungs_tried == ("first",)
+
+    def test_all_rungs_fail_raises_with_last_good(self):
+        def bad(v):
+            def f():
+                raise guard.StepDiverged((), last_good={"X": v},
+                                         kind="solve")
+            return f
+
+        with pytest.raises(guard.FitDivergedError) as ei:
+            guard.run_ladder([("r1", bad(1.0)), ("r2", bad(2.0))],
+                             context="test")
+        assert ei.value.last_good == {"X": 2.0}  # best across attempts
+        assert ei.value.rungs_tried == ("r1", "r2")
+
+
+class TestVerdict:
+    def test_classification(self):
+        def h(**over):
+            base = dict(input_finite=True, resid_finite=True,
+                        sigma_finite=True, chi2_finite=True,
+                        step_finite=True, cov_finite=True,
+                        n_truncated=0, cond_log10=1.0)
+            base.update(over)
+            bits = ("input_finite", "resid_finite", "sigma_finite",
+                    "chi2_finite", "step_finite", "cov_finite")
+            return guard.Health(ok=all(base[b] for b in bits), **base)
+
+        assert guard.verdict(()) == "ok"
+        assert guard.verdict(h()) == "ok"
+        assert guard.verdict(h(resid_finite=False)) == "input"
+        assert guard.verdict(h(input_finite=False)) == "input"
+        assert guard.verdict(h(sigma_finite=False)) == "input"
+        assert guard.verdict(h(chi2_finite=False)) == "solve"
+        assert guard.verdict(h(step_finite=False)) == "solve"
+        # input outranks solve (no rung fixes bad data)
+        assert guard.verdict(
+            h(resid_finite=False, chi2_finite=False)) == "input"
+
+
+class TestCheckpoint:
+    def test_roundtrip(self, tmp_path):
+        p = tmp_path / "state.npz"
+        arrays = {"a": np.arange(6).reshape(2, 3),
+                  "k": np.uint32([1, 2])}
+        guard.save_checkpoint(p, arrays, fingerprint="fp-1",
+                              meta={"note": "x"})
+        loaded, head = guard.load_checkpoint(p, fingerprint="fp-1")
+        np.testing.assert_array_equal(loaded["a"], arrays["a"])
+        np.testing.assert_array_equal(loaded["k"], arrays["k"])
+        assert head["meta"]["note"] == "x"
+        assert head["version"] == guard.CHECKPOINT_VERSION
+
+    def test_fingerprint_mismatch_raises(self, tmp_path):
+        p = tmp_path / "state.npz"
+        guard.save_checkpoint(p, {"a": np.zeros(2)}, fingerprint="fp-1")
+        with pytest.raises(guard.CheckpointMismatchError):
+            guard.load_checkpoint(p, fingerprint="fp-OTHER")
+
+    def test_missing_ok(self, tmp_path):
+        assert guard.load_checkpoint(tmp_path / "nope.npz") is None
+        with pytest.raises(FileNotFoundError):
+            guard.load_checkpoint(tmp_path / "nope.npz",
+                                  missing_ok=False)
+
+    def test_atomic_no_tmp_litter(self, tmp_path):
+        p = tmp_path / "state.npz"
+        for i in range(3):
+            guard.save_checkpoint(p, {"i": np.int64(i)},
+                                  fingerprint="fp")
+        names = sorted(f.name for f in tmp_path.iterdir())
+        assert names == ["state.npz"]
+
+
+class TestFitNoiseSatellites:
+    def _noise_fitter(self):
+        from pint_tpu.downhill import DownhillGLSFitter
+
+        par = GLS_PAR.replace("EFAC -f L-wide 1.1",
+                              "EFAC -f L-wide 1.1 1")
+        model, toas = _mk(par, 60, 5)
+        f = DownhillGLSFitter(toas, model)
+        f.fit_toas(maxiter=2)
+        return f, model
+
+    def test_diverged_lbfgs_keeps_last_good(self, monkeypatch):
+        """The downhill.py satellite: res.success False / non-finite
+        res.x must never be written into model.values."""
+        import scipy.optimize
+
+        f, model = self._noise_fitter()
+        before = dict(model.values)
+
+        class FakeRes:
+            success = False
+            x = np.array([np.nan])
+            fun = np.nan
+
+        monkeypatch.setattr(scipy.optimize, "minimize",
+                            lambda *a, **k: FakeRes())
+        with pytest.warns(UserWarning, match="fit_noise diverged"):
+            f.fit_noise(maxiter=5)
+        assert model.values == before
+        assert f.noise_fit_ok is False
+        assert f.noise_covariance is None
+        assert model.meta["GUARD_NOISE_FIT"] == "diverged"
+
+    def test_nonfinite_hessian_yields_none_covariance(self, monkeypatch):
+        """A NaN Hessian passes np.linalg.inv without LinAlgError; the
+        guard path must detect it and set noise_covariance=None."""
+        f, model = self._noise_fitter()
+        monkeypatch.setattr(
+            jax, "hessian",
+            lambda fn: (lambda v: jnp.full((v.shape[0], v.shape[0]),
+                                           jnp.nan)))
+        with pytest.warns(UserWarning, match="Hessian"):
+            f.fit_noise(maxiter=50)
+        assert f.noise_covariance is None
+        assert f.noise_fit_ok is True  # the optimum itself was fine
+
+    def test_healthy_fit_noise_still_works(self):
+        f, model = self._noise_fitter()
+        lnl = f.fit_noise(maxiter=20)
+        assert np.isfinite(lnl)
+        assert f.noise_fit_ok is True
+        assert f.noise_covariance is not None
+
+
+class TestZeroRecompile:
+    def test_second_guarded_fit_zero_new_compiles(self):
+        """The acceptance regression: the guard's health outputs ride
+        the shared step program — a second same-shaped fit performs
+        ZERO new XLA compiles."""
+        model, toas = _mk(GLS_PAR, 80, 6)
+        f1 = GLSFitter(toas, model)
+        f1.fit_toas(maxiter=2)
+        assert f1.fit_health["chi2_finite"] is True  # guard was live
+        before = _compiles()
+        model2, _ = _mk(GLS_PAR, 80, 7)
+        f2 = GLSFitter(toas, model2)
+        f2.fit_toas(maxiter=2)
+        assert f2._step_jit is f1._step_jit
+        if _monitoring_live():
+            assert _compiles() - before == 0
+
+
+class TestPTAGuard:
+    def test_partial_divergence_writes_back_healthy(self):
+        """One corrupted pulsar in a batch: healthy pulsars' fits are
+        written back, the bad one keeps pre-fit values, and the raise
+        names it."""
+        from pint_tpu.parallel import PTABatch
+        from pint_tpu.simulation import make_fake_pta
+
+        pairs = make_fake_pta(3, 24, start_mjd=54000.0,
+                              duration_days=800.0, name_prefix="GRDP")
+        faults.inject("nan_resid", index=2, pulsar=1)
+        batch = PTABatch(pairs)
+        before = [dict(p.model.values) for p in batch.prepareds]
+        with pytest.raises(guard.FitDivergedError) as ei:
+            batch.fit_wls(maxiter=2)
+        assert ei.value.bad_indices == [1]
+        # pulsar 1 untouched, 0 and 2 updated
+        assert batch.prepareds[1].model.values == before[1]
+        assert batch.prepareds[0].model.values != before[0]
+        assert batch.prepareds[2].model.values != before[2]
+
+    def test_checkpoint_roundtrip(self, tmp_path):
+        from pint_tpu.parallel import PTABatch
+        from pint_tpu.simulation import make_fake_pta
+
+        def build():
+            return PTABatch(make_fake_pta(
+                2, 20, start_mjd=54000.0, duration_days=700.0,
+                name_prefix="GRDC"))
+
+        b1 = build()
+        vec, chi2, cov = b1.fit_wls(maxiter=2)
+        p = tmp_path / "pta.npz"
+        b1.save_checkpoint(p)
+        b2 = build()
+        b2.restore_checkpoint(p)
+        np.testing.assert_allclose(np.asarray(b2.values0),
+                                   np.asarray(vec))
+
+    def test_checkpoint_structure_mismatch(self, tmp_path):
+        from pint_tpu.parallel import PTABatch
+        from pint_tpu.simulation import make_fake_pta
+
+        b1 = PTABatch(make_fake_pta(2, 20, start_mjd=54000.0,
+                                    duration_days=700.0,
+                                    name_prefix="GRDD"))
+        p = tmp_path / "pta.npz"
+        b1.save_checkpoint(p)
+        b3 = PTABatch(make_fake_pta(3, 20, start_mjd=54000.0,
+                                    duration_days=700.0,
+                                    name_prefix="GRDD"))
+        with pytest.raises(guard.CheckpointMismatchError):
+            b3.restore_checkpoint(p)
