@@ -52,6 +52,8 @@ class _DownhillMixin:
             key=("downhill.halving", type(self).__name__,
                  self._traced_free, self.max_halvings,
                  getattr(self, "threshold", None), self._guard_on,
+                 self._partition, self._frozen_names,
+                 self._noise_frozen,
                  self.resids._structure_key()),
             donate_argnums=_cc.donation_argnums((0,)))
 
@@ -156,19 +158,10 @@ class _DownhillMixin:
                 self._retrace()
             else:
                 telemetry.counter_add("fitter.jit_cache_hits")
-            (vec, cov, _extras, n_iter, health), rung = \
-                _guard.run_ladder(self._guard_rungs(maxiter),
-                                  context=type(self).__name__)
-            vec = np.asarray(vec)
-            cov_np = np.asarray(cov)
-            telemetry.record_transfer(vec)
-            telemetry.record_transfer(cov_np)
-            errs = np.sqrt(np.diag(cov_np))
-            params = self.model.params
-            for i, name in enumerate(self._traced_free):
-                self.model.values[name] = float(vec[i])
-                params[name].uncertainty = float(errs[i])
-            self.covariance = cov_np
+                self._refresh_frozen()
+            vec, cov_np, n_iter, health, rung = \
+                self._fit_with_depth_guard(
+                    lambda: self._guard_rungs(maxiter))
             flops_est = self._fit_flops_est(n_iter)
             telemetry.counter_add("fitter.iterations", n_iter)
             telemetry.counter_add("fit.flops_est", flops_est)

@@ -25,20 +25,100 @@ from pint_tpu import compile_cache as _cc
 from pint_tpu import flops as _flops
 from pint_tpu import guard as _guard
 from pint_tpu import telemetry
-from pint_tpu.linalg import gls_normal_solve
+from pint_tpu.linalg import StructuredU, basis_ncols, gls_normal_solve, \
+    su_pad_rows
+from pint_tpu.models.timing_model import frozen_delay_default, \
+    hybrid_design_default
 from pint_tpu.residuals import Residuals, WidebandTOAResiduals
 from pint_tpu.telemetry import span
 
 __all__ = ["WLSFitter", "GLSFitter", "WidebandTOAFitter", "Fitter",
-           "wls_gn_solve"]
+           "wls_gn_solve", "resid_and_design",
+           "wideband_resid_and_design"]
 
 # compile events fire during the first fit_toas; the jax.monitoring
 # listener must exist before then for jit.compile_* counters to tick
 telemetry._install_compile_listener()
 
 
+def resid_and_design(free, vec, partition, resid_of, linear_of):
+    """(r, J) for the free-parameter vector ``vec`` — the hybrid
+    analytic/AD design matrix build shared by every fitter step (plain,
+    downhill, LM, wideband, grid, batched PTA).
+
+    ``partition`` is PreparedModel.design_partition's ``(linear,
+    nonlinear)`` split of ``free``.  ``resid_of(sub)`` evaluates the
+    residual vector with the {name: value} dict ``sub`` overriding the
+    base values; ``linear_of(values_sub)`` returns the (N, L)
+    closed-form columns for the linear names at those values
+    (Residuals.linear_design_at — one delay fold plus one ``jvp``
+    through the phase stage, shared by every column).  ``jax.jacfwd``
+    runs only over the nonlinear remainder, so the tangent width
+    through the full residual chain drops from P to P_nl.  With an
+    empty linear set this degrades to exactly the classic full-jacfwd
+    build."""
+    lin, nl = partition
+    free = tuple(free)
+    full = {name: vec[i] for i, name in enumerate(free)}
+    r = resid_of(full)
+    if not lin:
+        def resid_fn(v):
+            return resid_of({name: v[i] for i, name in enumerate(free)})
+
+        return r, jax.jacfwd(resid_fn)(vec)
+    idx = {name: i for i, name in enumerate(free)}
+    J_lin = linear_of(full)
+    if nl:
+        nl_idx = jnp.asarray([idx[p] for p in nl])
+
+        def resid_nl(nv):
+            sub = dict(full)
+            for j, p in enumerate(nl):
+                sub[p] = nv[j]
+            return resid_of(sub)
+
+        J_nl = jax.jacfwd(resid_nl)(vec[nl_idx])
+        blocks = jnp.concatenate([J_nl, J_lin], axis=1)
+    else:
+        blocks = J_lin
+    # one gather back to free order instead of P column slices+stack
+    order = {p: j for j, p in enumerate(tuple(nl) + tuple(lin))}
+    perm = [order[p] for p in free]
+    if perm == list(range(len(free))):
+        return r, blocks
+    return r, blocks[:, jnp.asarray(perm)]
+
+
+def wideband_resid_and_design(resids, base_values, data, free, vec,
+                              partition):
+    """Hybrid (r, J) for the stacked wideband [time; DM] system —
+    shared by WidebandTOAFitter and WidebandLMFitter.  The linear
+    columns stack the time block (Residuals.linear_design_at) over the
+    DM block (WidebandDMResiduals.linear_dm_design_at); the partition
+    already required every linear owner with a ``dm_value`` to provide
+    ``d_dm_d_param`` (design_partition(wideband=True))."""
+    toa_r, dm_r = resids.toa, resids.dm
+
+    def resid_of(sub):
+        values = dict(base_values)
+        values.update(sub)
+        return jnp.concatenate(
+            [toa_r.time_resids_at(values, data["toa"]),
+             dm_r.dm_resids_at(values, data["dm"])])
+
+    def linear_of(sub):
+        values = dict(base_values)
+        values.update(sub)
+        lin = partition[0]
+        return jnp.concatenate(
+            [toa_r.linear_design_at(values, data["toa"], lin),
+             dm_r.linear_dm_design_at(values, data["dm"], lin)], axis=0)
+
+    return resid_and_design(free, vec, partition, resid_of, linear_of)
+
+
 def wls_gn_solve(resid_fn, vec, err, threshold=1e-14, rcond=None,
-                 with_health=False):
+                 with_health=False, rj=None):
     """One whitened, column-normalized SVD Gauss-Newton step.
 
     The shared numerical core of WLSFitter and the vmapped grid (one
@@ -49,10 +129,15 @@ def wls_gn_solve(resid_fn, vec, err, threshold=1e-14, rcond=None,
     above ``threshold`` (the guard ladder's escalation — dynamic, so
     it costs zero new compiles).  with_health: additionally return a
     :class:`pint_tpu.guard.SolveDiag` from the SVD spectrum already in
-    hand.
+    hand.  rj: optional precomputed ``(r, J)`` — the hybrid design
+    path (:func:`resid_and_design`) supplies it so the solve never
+    re-runs ``jacfwd`` over the full chain; resid_fn may then be None.
     """
-    r = resid_fn(vec)
-    J = jax.jacfwd(resid_fn)(vec)  # (N, P) d resid / d param
+    if rj is not None:
+        r, J = rj
+    else:
+        r = resid_fn(vec)
+        J = jax.jacfwd(resid_fn)(vec)  # (N, P) d resid / d param
     w = 1.0 / err
     rw = r * w
     Jw = J * w[:, None]
@@ -88,6 +173,14 @@ class Fitter:
     executable.  None reads ``$PINT_TPU_BUCKET_TOAS`` (default off);
     explicit residuals suppress padding (their dataset is fixed).
     """
+
+    #: which frozen-noise leaves this class's step consumes: every
+    #: step whitens with ``noise_sigma``, but only the GLS normal
+    #: equations also read ``(noise_phi, noise_gram)`` — building the
+    #: ~N K^2 gram eagerly (then shipping and donating its leaves
+    #: through every step call) for a WLS/LM/Powell step that never
+    #: reads it is pure waste on correlated-noise models.
+    _noise_gram_leaves = False
 
     def __init__(self, toas, model, residuals=None, bucket=None):
         if bucket is None:
@@ -183,6 +276,181 @@ class Fitter:
         }
 
     # -- shared machinery -----------------------------------------------------
+    def _partition_setup(self):
+        """Compute the structure-aware split for the current free set:
+        the frozen-delay component list (owns no free parameter — its
+        delay enters the trace as precomputed DATA), the hybrid
+        linear/nonlinear design partition, and the frozen-value
+        fingerprint that detects stale precomputed leaves.  Returns the
+        extra data leaves to merge into the fit-data pytree."""
+        free = self._traced_free
+        prep = self.prepared
+        self._hybrid_on = hybrid_design_default()
+        self._frozen_on = frozen_delay_default()
+        self._frozen_names = (prep.frozen_delay_split(free)
+                              if self._frozen_on else ())
+        wideband = isinstance(self.resids, WidebandTOAResiduals)
+        if self._hybrid_on:
+            self._partition = prep.design_partition(
+                free, frozen=self._frozen_names, wideband=wideband)
+        else:
+            self._partition = ((), tuple(free))
+        self._frozen_fp = prep.frozen_param_values(self._frozen_names)
+        telemetry.counter_add("fitter.linear_cols",
+                              len(self._partition[0]))
+        telemetry.counter_add("fitter.frozen_components",
+                              len(self._frozen_names))
+        frozen, tzr_frozen = prep.frozen_delay_leaves(self._frozen_names)
+        leaves = {}
+        if frozen is not None:
+            leaves["frozen"] = frozen
+            if tzr_frozen is not None:
+                leaves["tzr_frozen"] = tzr_frozen
+        # frozen-noise fast path: when no free parameter belongs to a
+        # noise component, sigma / U / phi are constants of the fit —
+        # they enter the traced step as precomputed DATA leaves (same
+        # contract as the frozen delays: dynamic, so trace sharing and
+        # zero-recompile survive), and the GLS normal matrix reuses the
+        # precomputed (K, K) noise gram instead of rebuilding the
+        # O(N (P+K)^2) weighted gram every iteration
+        self._noise_owned = tuple(sorted(
+            p.name for c in prep.model.noise_components for p in c.params))
+        self._noise_frozen = (
+            self._frozen_on
+            and not wideband
+            and set(self._noise_owned).isdisjoint(free))
+        if self._noise_frozen:
+            self._noise_fp = self._noise_param_values()
+            leaves.update(self._noise_leaves())
+            telemetry.counter_add("fitter.noise_frozen")
+        return leaves
+
+    def _noise_param_values(self):
+        """{param: value} over the noise components — the fingerprint
+        that detects stale frozen-noise leaves (an EFAC edited between
+        fits must re-fold sigma, never serve the old one)."""
+        return {name: float(self.model.values.get(name, np.nan))
+                for name in self._noise_owned}
+
+    def _noise_leaves(self):
+        """Precompute the fit-constant noise arrays host-side: sigma
+        always; (phi, gram) only for classes whose step consumes them
+        (``_noise_gram_leaves`` — the GLS normal equations).  The
+        guard ladder's dynamic capacity jitter keeps working: the
+        gram-served chi^2 applies the same per-diagonal relative ridge
+        in-trace (linalg.gls_normal_solve)."""
+        from pint_tpu.linalg import noise_gram_precompute
+
+        base = self.prepared._values_pytree()
+        sigma = jnp.asarray(np.asarray(self.resids.sigma_fn(base)))
+        leaves = {"noise_sigma": sigma}
+        if not self._noise_gram_leaves:
+            return leaves
+        # U itself already rides the data pytree as "U_ext"; phi/gram
+        # are built even for an uncorrelated model (whose basis is just
+        # the mean-offset column) — the GLS step uses them regardless
+        U, phi = self.resids._noise_basis_phi(base)
+        leaves["noise_phi"] = jnp.asarray(np.asarray(phi))
+        leaves["noise_gram"] = jnp.asarray(np.asarray(
+            noise_gram_precompute(sigma, U, phi)))
+        return leaves
+
+    def _inject_frozen(self, data, leaves):
+        """Merge the frozen-delay leaves into the fit-data pytree (the
+        time-block sub-dict on the wideband layout)."""
+        if not leaves:
+            return data
+        if "toa" in data:
+            return {**data, "toa": {**data["toa"], **leaves}}
+        return {**data, **leaves}
+
+    @staticmethod
+    def _fp_same(a, b):
+        """NaN-tolerant {param: value} fingerprint equality."""
+        return a.keys() == b.keys() and all(
+            v == b[k] or (v != v and b[k] != b[k]) for k, v in a.items())
+
+    def _refresh_frozen(self):
+        """Re-fold the frozen-delay / frozen-noise leaves when a frozen
+        parameter was edited between fits (fingerprint mismatch) — a
+        cheap host recompute, never a retrace: the leaves are dynamic
+        data."""
+        if getattr(self, "_frozen_names", ()):
+            fp = self.prepared.frozen_param_values(self._frozen_names)
+            if not self._fp_same(fp, self._frozen_fp):
+                telemetry.counter_add("fitter.frozen_refreshes")
+                self._frozen_fp = fp
+                frozen, tzr_frozen = self.prepared.frozen_delay_leaves(
+                    self._frozen_names)
+                leaves = {"frozen": frozen}
+                if tzr_frozen is not None:
+                    leaves["tzr_frozen"] = tzr_frozen
+                self._fit_data = self._inject_frozen(
+                    {k: v for k, v in self._fit_data.items()
+                     if k not in ("frozen", "tzr_frozen")}, leaves)
+        if getattr(self, "_noise_frozen", False):
+            fp = self._noise_param_values()
+            if not self._fp_same(fp, self._noise_fp):
+                telemetry.counter_add("fitter.noise_refreshes")
+                self._noise_fp = fp
+                self._fit_data = {**self._fit_data,
+                                  **self._noise_leaves()}
+
+    def _kepler_depth_guard(self):
+        """Post-fit Kepler-depth verification.  The Newton unroll
+        depth is a STATIC ctx int chosen from the PREPARE-time
+        eccentricity class (binary/base.prepare); a fit that moves
+        ECC/EDOT into a higher class would otherwise iterate a
+        too-shallow solver silently (e = 0.9 at the 4-deep unroll
+        leaves ~1e-5 rad in the eccentric anomaly).  Called after
+        write-back: re-derives the reach at the FITTED values, deepens
+        the unroll when the class rose, and re-keys the traces.
+        Returns True when the caller must run the fit again — the
+        previous solution came from the shallow solver.  Depth is
+        monotone over four classes, so the refit loop is bounded."""
+        reach = self.prepared.kepler_ecc_reach()
+        if reach == float("-inf"):
+            return False
+        if not self.resids.ensure_kepler_depth(reach):
+            return False
+        telemetry.counter_add("fitter.kepler_depth_refits")
+        warnings.warn(
+            "fitted eccentricity reach %.3g exceeds the prepare-time "
+            "Kepler depth class — deepening the Newton unroll and "
+            "refitting" % reach)
+        self._retrace()
+        return True
+
+    def _fit_with_depth_guard(self, rungs_fn):
+        """The guard-laddered fit + write-back + post-fit Kepler depth
+        verification shared by the plain, downhill and LM fit loops
+        (Powell's scipy-shaped variant has its own).  Depth classes
+        are monotone (4 -> 6 -> 8 -> full), so the guard can force at
+        most three reruns — each after a ``_retrace``, which is why
+        ``rungs_fn`` rebuilds its rung closures against the current
+        traced state.  Returns (vec_np, cov_np, n_iter, health,
+        rung)."""
+        for _depth_try in range(4):
+            (vec, cov, extras, n_iter, health), rung = \
+                _guard.run_ladder(rungs_fn(),
+                                  context=type(self).__name__)
+            self._step_extras = extras
+            # write back (cov diagonal clipped: a last-ulp negative
+            # variance must not write a NaN uncertainty)
+            vec_np = np.asarray(vec)
+            cov_np = np.asarray(cov)
+            telemetry.record_transfer(vec_np)
+            telemetry.record_transfer(cov_np)
+            errs = np.sqrt(np.clip(np.diag(cov_np), 0, None))
+            params = self.model.params
+            for i, name in enumerate(self._traced_free):
+                self.model.values[name] = float(vec_np[i])
+                params[name].uncertainty = float(errs[i])
+            self.covariance = cov_np
+            if not self._kepler_depth_guard():
+                break
+        return vec_np, cov_np, n_iter, health, rung
+
     def _retrace(self):
         """(Re)key the jitted step for the current free-param set.
         The trace closes over the free-param *names*; a changed free set
@@ -201,17 +469,42 @@ class Fitter:
         # same trace; the on/off flag changes the traced program and is
         # part of the key
         self._guard_on = _guard.enabled()
-        self._fit_data = {**self.resids._data(),
-                          "guard_eps": np.float64(0.0)}
+        leaves = self._partition_setup()
+        self._fit_data = self._inject_frozen(
+            {**self.resids._data(), "guard_eps": np.float64(0.0)},
+            leaves)
         self._step_jit = _cc.shared_jit(
             self._step, key=self._step_key(),
             donate_argnums=_cc.donation_argnums((0,)))
 
     def _step_key(self):
-        """Everything a trace of _step bakes in beyond the avals."""
+        """Everything a trace of _step bakes in beyond the avals.
+        The design partition and frozen-component list change the
+        traced program (which columns are analytic, which chain
+        members fold in data), so they are part of the key — as are
+        the env gates through them."""
         return ("fitter.step", type(self).__name__, self._traced_free,
                 getattr(self, "threshold", None), self._guard_on,
+                self._partition, self._frozen_names, self._noise_frozen,
                 self.resids._structure_key())
+
+    def _rj(self, vec, base_values, data):
+        """(r, J) over the traced free set — the hybrid analytic/AD
+        design build (see resid_and_design)."""
+
+        def resid_of(sub):
+            values = dict(base_values)
+            values.update(sub)
+            return self.resids.time_resids_at(values, data)
+
+        def linear_of(sub):
+            values = dict(base_values)
+            values.update(sub)
+            return self.resids.linear_design_at(values, data,
+                                                self._partition[0])
+
+        return resid_and_design(self._traced_free, vec,
+                                self._partition, resid_of, linear_of)
 
     def warm_compile(self):
         """AOT-compile (lower().compile()) the fit step AND the
@@ -371,20 +664,13 @@ class Fitter:
                 self._retrace()
             else:
                 telemetry.counter_add("fitter.jit_cache_hits")
-            (vec, cov, extras, n_iter, health), rung = _guard.run_ladder(
-                self._guard_rungs(maxiter), context=type(self).__name__)
-            self._step_extras = extras
-            # write back
-            vec = np.asarray(vec)
-            cov_np = np.asarray(cov)
-            telemetry.record_transfer(vec)
-            telemetry.record_transfer(cov_np)
-            errs = np.sqrt(np.diag(cov_np))
-            params = self.model.params
-            for i, name in enumerate(self._traced_free):
-                self.model.values[name] = float(vec[i])
-                params[name].uncertainty = float(errs[i])
-            self.covariance = cov_np
+                # an edited frozen parameter must refresh the
+                # precomputed delay leaves (data, not a retrace) — the
+                # partition re-keys only when the free SET changes
+                self._refresh_frozen()
+            vec, cov_np, n_iter, health, rung = \
+                self._fit_with_depth_guard(
+                    lambda: self._guard_rungs(maxiter))
             flops_est = self._fit_flops_est(n_iter)
             telemetry.counter_add("fitter.iterations", n_iter)
             telemetry.counter_add("fit.flops_est", flops_est)
@@ -395,11 +681,16 @@ class Fitter:
             return float(self.resids.chi2)
 
     def _fit_flops_est(self, n_iter):
-        """Modeled FLOPs of this fit (pint_tpu.flops cost model)."""
+        """Modeled FLOPs of this fit (pint_tpu.flops cost model) —
+        structure-aware: only the nonlinear remainder pays a tangent
+        chain, and segment-carried ECORR columns cost O(N) instead of
+        dense matmul terms."""
         n_basis = int(getattr(self.prepared, "noise_basis",
                               np.zeros((0, 0))).shape[1])
         return _flops.gls_fit_flops(
-            len(self.toas), len(self._traced_free), n_basis, n_iter)
+            len(self.toas), len(self._traced_free), n_basis, n_iter,
+            n_lin=len(self._partition[0]),
+            ecorr_seg=getattr(self.resids, "ecorr_segment_cols", 0))
 
     def _update_fit_meta(self):
         """Record the fit summary into the model metadata so it lands in
@@ -436,7 +727,8 @@ class WLSFitter(Fitter):
         """The SVD step never touches the noise basis — cost it at
         basis width 0 even when the model carries noise components."""
         return _flops.wls_fit_flops(
-            len(self.toas), len(self._traced_free), n_iter)
+            len(self.toas), len(self._traced_free), n_iter,
+            n_lin=len(self._partition[0]))
 
     def _step(self, vec, base_values, data):
         """One Gauss-Newton WLS step.  base_values (the full values
@@ -447,16 +739,20 @@ class WLSFitter(Fitter):
         _retrace().  Returns (new_vec, chi2, dpar, cov, health) —
         health rides the same compiled program (empty with the guard
         off)."""
-        resid_fn = self._resid_fn_of(base_values, data)
-        sigma = self.resids.sigma_at(self._merged(base_values, vec), data)
+        if self._noise_frozen:
+            sigma = data["noise_sigma"]
+        else:
+            sigma = self.resids.sigma_at(self._merged(base_values, vec),
+                                         data)
+        rj = self._rj(vec, base_values, data)
         if not self._guard_on:
-            return wls_gn_solve(resid_fn, vec, sigma,
-                                self.threshold) + ((),)
+            return wls_gn_solve(None, vec, sigma,
+                                self.threshold, rj=rj) + ((),)
         new_vec, chi2, dpar, cov, diag = wls_gn_solve(
-            resid_fn, vec, sigma, self.threshold,
-            rcond=data["guard_eps"], with_health=True)
+            None, vec, sigma, self.threshold,
+            rcond=data["guard_eps"], with_health=True, rj=rj)
         health = _guard.step_health(
-            resid_fn(vec), sigma, chi2, dpar, cov, diag,
+            rj[0], sigma, chi2, dpar, cov, diag,
             valid=data["valid"],
             inputs_ok=_guard.batch_input_finite(data["batch"],
                                                 data["valid"]))
@@ -482,35 +778,27 @@ class WidebandTOAFitter(Fitter):
         self.noise_realizations = {}
         self._retrace()
 
-    def _stacked_resid_fn(self, base_values, data):
-        free = self._traced_free
-        toa_r = self.resids.toa
-        dm_r = self.resids.dm
-
-        def resid_fn(v):
-            values = dict(base_values)
-            for i, name in enumerate(free):
-                values[name] = v[i]
-            return jnp.concatenate(
-                [toa_r.time_resids_at(values, data["toa"]),
-                 dm_r.dm_resids_at(values, data["dm"])]
-            )
-
-        return resid_fn
+    def _rj(self, vec, base_values, data):
+        return wideband_resid_and_design(
+            self.resids, base_values, data, self._traced_free, vec,
+            self._partition)
 
     def _step(self, vec, base_values, data):
         values = self._merged(base_values, vec)
         sigma_t = self.resids.toa.sigma_at(values, data["toa"])
         sigma_dm = self.resids.dm.sigma_at(values, data["dm"])
         sigma = jnp.concatenate([sigma_t, sigma_dm])
-        resid_fn = self._stacked_resid_fn(base_values, data)
-        r = resid_fn(vec)
-        J = jax.jacfwd(resid_fn)(vec)
+        r, J = self._rj(vec, base_values, data)
         U_t, phi = self.resids.toa._noise_basis_phi_at(values,
                                                        data["toa"])
-        U = jnp.concatenate(
-            [U_t, jnp.zeros((sigma_dm.shape[0], U_t.shape[1]))], axis=0
-        )
+        if isinstance(U_t, StructuredU):
+            # the DM block sees no noise basis: zero rows, outside
+            # every ECORR epoch (segment id K_e)
+            U = su_pad_rows(U_t, sigma_dm.shape[0])
+        else:
+            U = jnp.concatenate(
+                [U_t, jnp.zeros((sigma_dm.shape[0], U_t.shape[1]))],
+                axis=0)
         if not self._guard_on:
             dpar, cov, ncoef, chi2 = gls_normal_solve(r, J, sigma, U,
                                                       phi)
@@ -542,25 +830,35 @@ class GLSFitter(Fitter):
     (reference :2269-2282).
     """
 
+    _noise_gram_leaves = True
+
     def __init__(self, toas, model, residuals=None, bucket=None):
         super().__init__(toas, model, residuals, bucket=bucket)
         self.noise_realizations = {}
         self._retrace()
 
     def _step(self, vec, base_values, data):
-        resid_fn = self._resid_fn_of(base_values, data)
         values = self._merged(base_values, vec)
-        sigma = self.resids.sigma_at(values, data)
-        U, phi = self.resids._noise_basis_phi_at(values, data)
-        r = resid_fn(vec)
-        J = jax.jacfwd(resid_fn)(vec)
+        if self._noise_frozen:
+            # frozen-noise fast path: sigma/phi/gram arrive as
+            # precomputed data leaves; the chi^2 is served from the
+            # gram's Cholesky with the guard's capacity jitter applied
+            # in-trace (gls_normal_solve)
+            sigma = data["noise_sigma"]
+            U, phi = data["U_ext"], data["noise_phi"]
+            gram = data["noise_gram"]
+        else:
+            sigma = self.resids.sigma_at(values, data)
+            U, phi = self.resids._noise_basis_phi_at(values, data)
+            gram = None
+        r, J = self._rj(vec, base_values, data)
         if not self._guard_on:
             dpar, cov, ncoef, chi2 = gls_normal_solve(r, J, sigma, U,
-                                                      phi)
+                                                      phi, gram=gram)
             return vec + dpar, chi2, dpar, cov, ncoef, ()
         dpar, cov, ncoef, chi2, diag = gls_normal_solve(
-            r, J, sigma, U, phi, guard_eps=data["guard_eps"],
-            with_health=True)
+            r, J, sigma, U, phi, gram=gram,
+            guard_eps=data["guard_eps"], with_health=True)
         health = _guard.step_health(
             r, sigma, chi2, dpar, cov, diag, valid=data["valid"],
             inputs_ok=_guard.batch_input_finite(data["batch"],

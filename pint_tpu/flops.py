@@ -15,8 +15,9 @@ breakdown in :mod:`pint_tpu.dd`.
 from __future__ import annotations
 
 __all__ = [
-    "RESID_CHAIN_OPS", "DD_CHAIN_FLOPS_PER_ELEM",
-    "matmul_flops", "resid_eval_flops", "gls_fit_flops",
+    "RESID_CHAIN_OPS", "DD_CHAIN_FLOPS_PER_ELEM", "ANALYTIC_COL_OPS",
+    "matmul_flops", "resid_eval_flops", "design_flops",
+    "normal_eq_flops", "gls_fit_flops",
     "wls_fit_flops", "wls_grid_flops", "mcmc_flops", "pta_batch_flops",
     "dd_chain_flops", "os_flops",
 ]
@@ -43,23 +44,59 @@ def resid_eval_flops(n_toa):
     return float(RESID_CHAIN_OPS * n_toa * 2)
 
 
-def gls_fit_flops(n_toa, n_free, n_basis, n_iter=3):
-    """A GLS Gauss-Newton fit: per iteration one jacfwd design matrix
-    (~n_free forward chains) plus the noise-augmented normal equations
-    over the (n_free + n_basis)-wide solve."""
-    per_iter = (n_free * resid_eval_flops(n_toa)
-                + 2.0 * n_toa * (n_free + n_basis) ** 2)
+#: modeled f64 ops per TOA for one closed-form design column (a Taylor
+#: monomial, mask gather, or sinusoid — a handful of elementwise ops,
+#: nothing like a chain evaluation)
+ANALYTIC_COL_OPS = 8
+
+
+def design_flops(n_toa, n_free, n_lin=0):
+    """One design-matrix build under the hybrid analytic/AD split:
+    ``n_free - n_lin`` tangent chains through the full residual chain
+    (jacfwd over the nonlinear partition), plus — when any column is
+    analytic — one shared jvp through the phase stage (~one chain) and
+    the cheap closed-form column builds.  ``n_lin = 0`` reproduces the
+    classic all-jacfwd accounting."""
+    n_nl = max(int(n_free) - int(n_lin), 0)
+    total = n_nl * resid_eval_flops(n_toa)
+    if n_lin:
+        total += resid_eval_flops(n_toa) \
+            + ANALYTIC_COL_OPS * float(n_toa) * n_lin
+    return float(total)
+
+
+def normal_eq_flops(n_toa, n_free, n_basis, ecorr_seg=0):
+    """The noise-augmented normal-equation assembly + solve over the
+    ``n_free + n_basis`` system.  ``ecorr_seg`` of the basis columns
+    carried as epoch segment ids cost O(N) segment-sums (cross blocks
+    against the dense columns plus a scalar diagonal) instead of
+    entering the dense ``N x K`` gram matmul."""
+    dense = int(n_free) + int(n_basis) - int(ecorr_seg)
+    total = 2.0 * n_toa * dense**2
+    if ecorr_seg:
+        total += n_toa * (2.0 * dense + 1.0)
+    return float(total)
+
+
+def gls_fit_flops(n_toa, n_free, n_basis, n_iter=3, n_lin=0,
+                  ecorr_seg=0):
+    """A GLS Gauss-Newton fit: per iteration one hybrid design build
+    (:func:`design_flops`) plus the noise-augmented normal equations
+    (:func:`normal_eq_flops`)."""
+    per_iter = (design_flops(n_toa, n_free, n_lin)
+                + normal_eq_flops(n_toa, n_free, n_basis, ecorr_seg))
     return float(n_iter * per_iter)
 
 
-def wls_fit_flops(n_toa, n_free, n_iter=3):
+def wls_fit_flops(n_toa, n_free, n_iter=3, n_lin=0):
     """A WLS SVD Gauss-Newton fit (no noise basis)."""
-    return gls_fit_flops(n_toa, n_free, 0, n_iter)
+    return gls_fit_flops(n_toa, n_free, 0, n_iter, n_lin=n_lin)
 
 
-def wls_grid_flops(n_points, n_toa, n_free, n_iter=3):
+def wls_grid_flops(n_points, n_toa, n_free, n_iter=3, n_lin=0):
     """A vmapped chi^2 grid: one WLS fit per grid point."""
-    return float(n_points) * wls_fit_flops(n_toa, n_free, n_iter)
+    return float(n_points) * wls_fit_flops(n_toa, n_free, n_iter,
+                                           n_lin=n_lin)
 
 
 def mcmc_flops(n_evals, n_toa):
@@ -68,11 +105,12 @@ def mcmc_flops(n_evals, n_toa):
     return float(n_evals) * resid_eval_flops(n_toa)
 
 
-def pta_batch_flops(n_pulsars, n_toa, n_free, n_basis, n_iter=3):
+def pta_batch_flops(n_pulsars, n_toa, n_free, n_basis, n_iter=3,
+                    n_lin=0):
     """A batched PTA fit: n_pulsars independent GLS fits as one
     program."""
     return float(n_pulsars) * gls_fit_flops(n_toa, n_free, n_basis,
-                                            n_iter)
+                                            n_iter, n_lin=n_lin)
 
 
 def dd_chain_flops(n_elems, n_iters):

@@ -18,6 +18,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from pint_tpu import compile_cache as _cc
+from pint_tpu.models.timing_model import frozen_delay_default, \
+    hybrid_design_default
 from pint_tpu.residuals import Residuals
 
 __all__ = ["grid_chisq", "grid_chisq_vectorized", "make_grid_fn",
@@ -26,10 +28,45 @@ __all__ = ["grid_chisq", "grid_chisq_vectorized", "make_grid_fn",
 
 
 def _make_fit_one(prepared, resids, grid_params, fit_params, n_steps):
-    """Build the pure function grid_values -> (chi2, fitted_values)."""
+    """Build the pure function grid_values -> (chi2, fitted_values).
+    Returns ``(fit_one, partition_record)``."""
 
     base_values = {k: jnp.float64(v) for k, v in prepared.model.values.items()}
     correlated = prepared.model.has_correlated_errors
+
+    # structure-aware hot path (see fitter.py / design_matrix.md):
+    # components owning neither a gridded nor a refit parameter are
+    # evaluated ONCE host-side and enter the traced per-point step as
+    # precomputed data — a (M2, SINI) grid stops re-interpolating the
+    # SSB ephemeris and clock chain per point AND stops handing XLA
+    # the whole frozen chain to constant-fold on every grid compile
+    active = tuple(grid_params) + tuple(fit_params)
+    frozen_names = (prepared.frozen_delay_split(active)
+                    if frozen_delay_default() else ())
+    frozen, tzr_frozen = prepared.frozen_delay_leaves(frozen_names)
+    data = dict(resids._data())
+    if frozen is not None:
+        data["frozen"] = frozen
+        if tzr_frozen is not None:
+            data["tzr_frozen"] = tzr_frozen
+    # hybrid design partition over the REFIT parameters only (grid
+    # parameters are constants of each point): jacfwd tangent width
+    # drops from len(fit_params) to the nonlinear remainder
+    if hybrid_design_default():
+        partition = prepared.design_partition(fit_params,
+                                              frozen=frozen_names)
+    else:
+        partition = ((), tuple(fit_params))
+    # introspection record for bench/datacheck (the jitted grid fn
+    # itself can't carry attributes): what this grid build chose
+    partition_record = {
+        "n_linear": len(partition[0]),
+        "n_nonlinear": len(partition[1]),
+        "n_frozen": len(frozen_names),
+        "frozen": tuple(frozen_names),
+        "linear": tuple(partition[0]),
+        "nonlinear": tuple(partition[1]),
+    }
 
     # host-side prebuild of the values-independent noise solve (the
     # same treatment as the eager _U_ext build in residuals.py): when
@@ -47,14 +84,19 @@ def _make_fit_one(prepared, resids, grid_params, fit_params, n_steps):
         set(grid_params) | set(fit_params))
     pre = None
     sigma_const = None
-    U_const = phi_const = None
+    U_const = phi_const = gram_const = None
     if sigma_frozen:
         sigma_const = resids.sigma_fn(base_values)  # eager, concrete
         if correlated:
-            from pint_tpu.linalg import woodbury_precompute
+            from pint_tpu.linalg import (noise_gram_precompute,
+                                         woodbury_precompute)
 
             U_const, phi_const = resids._noise_basis_phi(base_values)
             pre = woodbury_precompute(sigma_const, U_const, phi_const)
+            # constant block of the normal matrix: per GN iteration
+            # only the J-dependent blocks remain to assemble
+            gram_const = noise_gram_precompute(sigma_const, U_const,
+                                               phi_const)
 
     def values_of(fit_vec, grid_vec):
         values = dict(base_values)
@@ -64,33 +106,47 @@ def _make_fit_one(prepared, resids, grid_params, fit_params, n_steps):
             values[name] = fit_vec[i]
         return values
 
-    def resid_of(fit_vec, grid_vec):
-        return resids.time_resids_fn(values_of(fit_vec, grid_vec))
+    def rj_of(fit_vec, grid_vec):
+        """(r, J) over fit_params at one grid point — the hybrid
+        analytic/AD build (fitter.resid_and_design)."""
+        from pint_tpu.fitter import resid_and_design
+
+        grid_sub = {name: grid_vec[i]
+                    for i, name in enumerate(grid_params)}
+
+        def resid_of(sub):
+            values = dict(base_values)
+            values.update(grid_sub)
+            values.update(sub)
+            return resids.time_resids_at(values, data)
+
+        def linear_of(sub):
+            values = dict(base_values)
+            values.update(grid_sub)
+            values.update(sub)
+            return resids.linear_design_at(values, data, partition[0])
+
+        return resid_and_design(fit_params, fit_vec, partition,
+                                resid_of, linear_of)
 
     def gn_step(fit_vec, grid_vec):
         values = values_of(fit_vec, grid_vec)
         sigma = (sigma_const if sigma_const is not None
-                 else resids.sigma_fn(values))
+                 else resids.sigma_at(values, data))
+        rj = rj_of(fit_vec, grid_vec)
         if correlated:
-            import jax as _jax
-
             from pint_tpu.linalg import gls_normal_solve
 
-            fn = lambda v: resid_of(v, grid_vec)  # noqa: E731
             if pre is not None:
                 U, phi = U_const, phi_const
             else:
-                U, phi = resids._noise_basis_phi(values)
-            dpar, *_ = gls_normal_solve(
-                fn(fit_vec), _jax.jacfwd(fn)(fit_vec), sigma, U, phi,
-                pre=pre
-            )
+                U, phi = resids._noise_basis_phi_at(values, data)
+            dpar, *_ = gls_normal_solve(rj[0], rj[1], sigma, U, phi,
+                                        pre=pre, gram=gram_const)
             return fit_vec + dpar
         from pint_tpu.fitter import wls_gn_solve
 
-        new_vec, _, _, _ = wls_gn_solve(
-            lambda v: resid_of(v, grid_vec), fit_vec, sigma
-        )
+        new_vec, _, _, _ = wls_gn_solve(None, fit_vec, sigma, rj=rj)
         return new_vec
 
     fit0 = jnp.array(
@@ -106,22 +162,25 @@ def _make_fit_one(prepared, resids, grid_params, fit_params, n_steps):
         if pre is not None:
             from pint_tpu.linalg import woodbury_chi2_logdet_pre
 
-            r = resids.time_resids_fn(values)
+            r = resids.time_resids_at(values, data)
             chi2, _ = woodbury_chi2_logdet_pre(r, pre)
         elif sigma_const is not None and not correlated:
-            r = resids.time_resids_fn(values)
+            r = resids.time_resids_at(values, data)
             chi2 = jnp.sum((r / sigma_const) ** 2)
         else:
-            chi2 = resids.chi2_fn(values)
+            chi2 = resids.chi2_at(values, data)
         return chi2, vec
 
-    return fit_one
+    return fit_one, partition_record
 
 
 def make_grid_fn(toas, model, grid_params, n_steps=3):
-    """Compile once, call many times: returns (fn, fit_params) where
-    fn(grid_values (n,k)) -> (chi2 (n,), fitted (n, nfree)).  Lets
-    callers (bench, repeated scans) reuse the jitted program.
+    """Compile once, call many times: returns (fn, fit_params,
+    partition) where fn(grid_values (n,k)) -> (chi2 (n,), fitted
+    (n, nfree)) and partition records the structure choice this build
+    made (n_linear / n_nonlinear / n_frozen + the name tuples — bench
+    and datacheck introspection).  Lets callers (bench, repeated
+    scans) reuse the jitted program.
 
     The jitted grid is registry-shared (compile_cache.shared_jit): the
     grid program bakes its dataset in as constants, so the key carries
@@ -130,14 +189,26 @@ def make_grid_fn(toas, model, grid_params, n_steps=3):
     resids = Residuals(toas, model)
     prepared = resids.prepared
     grid_params = list(grid_params)
+    if any(p in ("ECC", "EDOT") for p in grid_params):
+        # gridded eccentricity ranges are arbitrary, so the static
+        # Newton depth must cover the full e < 0.97 unroll — the
+        # prepare-time class only covers the base value.  Refit-only
+        # ECC keeps its class: a grid refit is a local Gauss-Newton
+        # polish around base values (the fitter path re-verifies the
+        # class post-fit; a vmapped grid point cannot).
+        resids.ensure_kepler_depth(float("nan"))
     fit_params = [p for p in model.free_timing_params if p not in grid_params]
-    fit_one = _make_fit_one(prepared, resids, grid_params, fit_params,
-                            n_steps)
+    fit_one, partition = _make_fit_one(prepared, resids, grid_params,
+                                       fit_params, n_steps)
     key = ("grid.fit_one", resids._structure_key(),
            tuple(grid_params), tuple(fit_params), int(n_steps),
+           # the gates change the traced program (partition + frozen
+           # leaves derive deterministically from them + the free set)
+           hybrid_design_default(), frozen_delay_default(),
            _cc.fingerprint((resids._data(), prepared.model.values)))
     return _cc.shared_jit(jax.vmap(fit_one), key=key,
-                          fn_token="grid.make_grid_fn"), fit_params
+                          fn_token="grid.make_grid_fn"), fit_params, \
+        partition
 
 
 def grid_chisq_vectorized(
@@ -150,7 +221,7 @@ def grid_chisq_vectorized(
     device memory for very large grids.
     """
     grid_values = jnp.asarray(grid_values, dtype=jnp.float64)
-    fn, _ = make_grid_fn(toas, model, grid_params, n_steps)
+    fn, _, _ = make_grid_fn(toas, model, grid_params, n_steps)
     if chunk is None or grid_values.shape[0] <= chunk:
         chi2, fitted = fn(grid_values)
     else:

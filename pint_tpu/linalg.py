@@ -13,6 +13,14 @@ dense form carries the cross-pulsar GWB structure of :mod:`pint_tpu.gw`
 (Hellings–Downs-coupled Fourier blocks across a stacked multi-pulsar
 basis) through the SAME solver, so the single-pulsar and PTA
 likelihoods cannot drift apart.
+
+``U`` may be either a dense (N, K) array or a :class:`StructuredU` —
+the segment-id representation of an ECORR epoch-indicator block
+(built by :class:`pint_tpu.residuals.Residuals` when eligible), whose
+0/1 products are carried by ``jax.ops.segment_sum`` instead of dense
+matmuls.  The dense path is the fallback for everything else — the
+GW dense-phi sector always passes dense arrays — and both paths are
+brute-force-verified equivalent (tests/test_design.py).
 """
 
 from __future__ import annotations
@@ -26,7 +34,9 @@ from pint_tpu.guard import SolveDiag
 
 __all__ = ["woodbury_chi2_logdet", "gls_normal_solve",
            "WoodburyPre", "woodbury_precompute",
-           "woodbury_chi2_logdet_pre", "woodbury_solve"]
+           "woodbury_chi2_logdet_pre", "woodbury_solve",
+           "StructuredU", "structured_from_dense_blocks", "su_to_dense",
+           "su_pad_rows", "basis_ncols", "noise_gram_precompute"]
 
 #: floor on basis weights: a zero weight (e.g. ECORR 0) means infinite
 #: prior precision on that column — the coefficient is pinned to zero and
@@ -34,6 +44,126 @@ __all__ = ["woodbury_chi2_logdet", "gls_normal_solve",
 #: 1e-30 (not smaller): TPU's float32-pair f64 emulation loses precision
 #: below the f32 subnormal range (~1e-38), and 1/phi must stay finite
 _PHI_FLOOR = 1e-30
+
+
+class StructuredU(NamedTuple):
+    """Structure-aware Woodbury basis: an ECORR epoch-indicator block
+    carried as per-TOA segment ids instead of a dense 0/1 matrix, with
+    the dense remainder (Fourier red-noise columns, mean-offset column)
+    on either side.
+
+    Column layout is ``[pre | ecorr epochs | post]`` — the SAME column
+    order as the dense basis it replaces, so phi vectors, noise-
+    coefficient slices (``noise_dimensions``) and the mean-offset
+    column position are untouched.  Every contraction a Woodbury path
+    needs (``U^T y``, ``U x``, ``U^T diag(w) U``) replaces the epoch
+    block's dense matmuls with ``jax.ops.segment_sum`` / gathers: the
+    ``N x K_e`` indicator products drop from O(N K_e K) to O(N K_d).
+
+    All four fields are arrays, so a StructuredU is an ordinary pytree
+    leaf-bundle of the fit-data dict — dynamic under shared traces.
+    ``eslot`` is a zeros-(K_e,) shape carrier: in-trace code reads the
+    STATIC epoch count from its shape (segment counts must be static
+    for XLA), while rows outside any epoch carry segment id K_e and
+    fall off the end of the ``[:K_e]`` slice."""
+
+    pre: jnp.ndarray    # (N, K_pre) dense columns before the block
+    seg: jnp.ndarray    # (N,) int32 epoch id, K_e = "no epoch"
+    eslot: jnp.ndarray  # (K_e,) zeros — static epoch-count carrier
+    post: jnp.ndarray   # (N, K_post) dense columns after the block
+
+
+def basis_ncols(U) -> int:
+    """Total column count of a dense or structured basis."""
+    if isinstance(U, StructuredU):
+        return (U.pre.shape[1] + U.eslot.shape[0] + U.post.shape[1])
+    return U.shape[1]
+
+
+def structured_from_dense_blocks(pre, seg, n_epoch, post):
+    """Build a StructuredU from concrete blocks (host-side)."""
+    return StructuredU(
+        pre=jnp.asarray(pre),
+        seg=jnp.asarray(seg, dtype=jnp.int32),
+        eslot=jnp.zeros(int(n_epoch), dtype=jnp.float64),
+        post=jnp.asarray(post),
+    )
+
+
+def su_to_dense(su: StructuredU):
+    """Materialize the dense (N, K) basis — the fallback/verification
+    form (woodbury_precompute, brute-force tests)."""
+    n = su.seg.shape[0]
+    k_e = su.eslot.shape[0]
+    ecorr = (su.seg[:, None] == jnp.arange(k_e)[None, :]).astype(
+        jnp.float64)
+    return jnp.concatenate([su.pre, ecorr, su.post], axis=1)
+
+
+def su_pad_rows(su: StructuredU, n_rows: int):
+    """Append ``n_rows`` zero rows (outside every epoch) — the wideband
+    stacked [time; DM] system's DM block sees no noise basis."""
+    k_e = su.eslot.shape[0]
+    return StructuredU(
+        pre=jnp.concatenate(
+            [su.pre, jnp.zeros((n_rows, su.pre.shape[1]))], axis=0),
+        seg=jnp.concatenate(
+            [su.seg, jnp.full(n_rows, k_e, dtype=jnp.int32)]),
+        eslot=su.eslot,
+        post=jnp.concatenate(
+            [su.post, jnp.zeros((n_rows, su.post.shape[1]))], axis=0),
+    )
+
+
+def _ut_dot(U, y):
+    """``U^T @ y`` for dense or structured U; y is (N,) or (N, M)."""
+    if not isinstance(U, StructuredU):
+        return U.T @ y
+    k_e = U.eslot.shape[0]
+    seg_part = jax.ops.segment_sum(y, U.seg, num_segments=k_e + 1)[:k_e]
+    return jnp.concatenate([U.pre.T @ y, seg_part, U.post.T @ y],
+                           axis=0)
+
+
+def _u_dot(U, x):
+    """``U @ x`` for dense or structured U; x is (K,) or (K, M)."""
+    if not isinstance(U, StructuredU):
+        return U @ x
+    k_pre = U.pre.shape[1]
+    k_e = U.eslot.shape[0]
+    x_pre = x[:k_pre]
+    x_e = x[k_pre:k_pre + k_e]
+    x_post = x[k_pre + k_e:]
+    # out-of-epoch rows (seg == k_e) must gather zero
+    x_e_ext = jnp.concatenate(
+        [x_e, jnp.zeros((1,) + x_e.shape[1:], dtype=x_e.dtype)], axis=0)
+    return U.pre @ x_pre + x_e_ext[U.seg] + U.post @ x_post
+
+
+def _weighted_gram(U, w):
+    """``U^T diag(w) U`` for dense or structured U — THE capacity-gram
+    build.  Structured path: the epoch block's products become one
+    scalar segment-sum (diagonal block) plus segment-sums of the
+    weighted dense columns (cross blocks)."""
+    if not isinstance(U, StructuredU):
+        return (U.T * w[None, :]) @ U
+    k_e = U.eslot.shape[0]
+    pre_w = U.pre * w[:, None]
+    post_w = U.post * w[:, None]
+    g_pp = U.pre.T @ pre_w
+    g_p_post = U.pre.T @ post_w
+    g_post_post = U.post.T @ post_w
+    g_pe = jax.ops.segment_sum(pre_w, U.seg,
+                               num_segments=k_e + 1)[:k_e].T
+    g_e_post = jax.ops.segment_sum(post_w, U.seg,
+                                   num_segments=k_e + 1)[:k_e]
+    g_ee = jnp.diag(jax.ops.segment_sum(w, U.seg,
+                                        num_segments=k_e + 1)[:k_e])
+    return jnp.block([
+        [g_pp, g_pe, g_p_post],
+        [g_pe.T, g_ee, g_e_post],
+        [g_p_post.T, g_e_post.T, g_post_post],
+    ])
 
 
 def _phi_terms(phi, jitter=None):
@@ -93,7 +223,7 @@ def _capacity(sigma, U, phi, jitter=None):
     results are never mistaken for clean ones."""
     phi_inv, logdet_phi = _phi_terms(phi, jitter=jitter)
     nvec = sigma**2
-    sigma_cap = (U.T * (1.0 / nvec)[None, :]) @ U + phi_inv
+    sigma_cap = _weighted_gram(U, 1.0 / nvec) + phi_inv
     if jitter is not None:
         d = jnp.abs(jnp.diag(sigma_cap))
         sigma_cap = sigma_cap + jitter * jnp.diag(d)
@@ -119,7 +249,7 @@ def woodbury_chi2_logdet(r, sigma, U, phi, valid=None, jitter=None):
     """
     nvec, cf, logdet_phi = _capacity(sigma, U, phi, jitter=jitter)
     ninv_r = r / nvec
-    ut_ninv_r = U.T @ ninv_r
+    ut_ninv_r = _ut_dot(U, ninv_r)
     x = jax.scipy.linalg.cho_solve(cf, ut_ninv_r)
     chi2 = jnp.sum(r * ninv_r) - jnp.sum(ut_ninv_r * x)
     log_nvec = jnp.log(nvec)
@@ -142,8 +272,8 @@ def woodbury_solve(sigma, U, phi, y):
     nvec, cf, _ = _capacity(sigma, U, phi)
     y2 = y if y.ndim == 2 else y[:, None]
     ninv_y = y2 / nvec[:, None]
-    x = jax.scipy.linalg.cho_solve(cf, U.T @ ninv_y)
-    out = ninv_y - (U @ x) / nvec[:, None]
+    x = jax.scipy.linalg.cho_solve(cf, _ut_dot(U, ninv_y))
+    out = ninv_y - _u_dot(U, x) / nvec[:, None]
     return out if y.ndim == 2 else out[:, 0]
 
 
@@ -171,8 +301,13 @@ def woodbury_precompute(sigma, U, phi):
     (K, K) constants instead of a foldable (N, K) x (N, K) matmul.
     ``phi`` may be a (K,) weight vector or a dense (K, K) prior
     covariance (stacked GWB structure), like
-    :func:`woodbury_chi2_logdet`."""
+    :func:`woodbury_chi2_logdet`.  A :class:`StructuredU` basis is
+    densified here — the precompute runs ONCE, host-side, where the
+    dense contraction is cheap and the WoodburyPre layout stays
+    uniform."""
     sigma = jnp.asarray(sigma)
+    if isinstance(U, StructuredU):
+        U = su_to_dense(U)
     U = jnp.asarray(U)
     nvec, cf, logdet_phi = _capacity(sigma, U, phi)
     chol = cf[0]
@@ -194,8 +329,22 @@ def woodbury_chi2_logdet_pre(r, pre: WoodburyPre):
     return chi2, pre.logdet
 
 
-def gls_normal_solve(r, J, sigma, U, phi, pre=None, guard_eps=None,
-                     with_health=False):
+def noise_gram_precompute(sigma, U, phi):
+    """Eagerly build the constant block of the GLS normal matrix,
+    ``U^T diag(sigma^-2) U + Phi^-1`` — the (K, K) piece that does NOT
+    depend on the design matrix.  Call OUTSIDE jit with concrete
+    (sigma, U, phi) when no fitted parameter touches the noise model:
+    per Gauss-Newton iteration only the J-dependent blocks (P x P and
+    P x K) remain to build, instead of the full (N, K+P) x (K+P)
+    weighted gram — the dominant per-point matmul of a chi^2 grid.
+    ``U`` may be dense or a :class:`StructuredU`."""
+    sigma = jnp.asarray(sigma)
+    phi_inv, _ = _phi_terms(phi)
+    return _weighted_gram(U, 1.0 / sigma**2) + phi_inv
+
+
+def gls_normal_solve(r, J, sigma, U, phi, pre=None, gram=None,
+                     guard_eps=None, with_health=False):
     """Solve the noise-augmented GLS normal equations (reference:
     GLSFitter.fit_toas, fitter.py:2164-2204).
 
@@ -210,6 +359,17 @@ def gls_normal_solve(r, J, sigma, U, phi, pre=None, guard_eps=None,
     (sigma, U, phi) are trace-time constants (the chi^2-grid path) —
     keeps XLA from constant-folding the capacity matrix per compile.
 
+    gram: optional precomputed ``U^T diag(w) U + Phi^-1`` block
+    (:func:`noise_gram_precompute`) under the same constancy contract
+    as ``pre`` — the normal matrix is then assembled from the small
+    J-dependent blocks only, dropping the O(N (P+K)^2) weighted gram
+    to O(N P (P+K)) per iteration, and the chi^2 reuses the gram's
+    Cholesky (it IS the Woodbury capacity matrix) instead of
+    rebuilding the weighted gram.  It may also arrive as a dynamic
+    data-pytree leaf (the fitter's frozen-noise fast path), which
+    keeps trace sharing intact.  Gram callers must pass a vector
+    ``phi`` (the dense-prior GWB sector goes through the dense path).
+
     ``phi`` may be a (K,) weight vector or a dense (K, K) prior
     covariance (stacked cross-pulsar GWB structure) — the inverse
     prior enters the normal matrix as a block either way.
@@ -223,18 +383,41 @@ def gls_normal_solve(r, J, sigma, U, phi, pre=None, guard_eps=None,
     condition proxy from the eigh spectrum already in hand).
     """
     n_par = J.shape[1]
-    M = jnp.concatenate([J, U], axis=1) if U.shape[1] else J
+    nb = basis_ncols(U)
     nvec = sigma**2
-    mtn = (M * (1.0 / nvec)[:, None]).T
-    if U.shape[1]:
+    w = 1.0 / nvec
+    if gram is not None and nb:
+        # constant-gram fast path: only the design-dependent blocks
+        # are built per call; the (K, K) noise block is data
+        Jw = J * w[:, None]
+        a_jj = J.T @ Jw
+        a_ju = _ut_dot(U, Jw).T           # (P, K)
+        mtcm = jnp.block([[a_jj, a_ju],
+                          [a_ju.T, gram]])
+        rhs = jnp.concatenate([Jw.T @ r, _ut_dot(U, w * r)])
+    elif isinstance(U, StructuredU):
+        # structured normal equations: the ECORR epoch block of
+        # M = [J | U] enters every product through segment-sums
+        # (_ut_dot/_weighted_gram) instead of dense (N, K_e) matmuls
+        Jw = J * w[:, None]
+        a_jj = J.T @ Jw
+        a_ju = _ut_dot(U, Jw).T           # (P, K)
+        a_uu = _weighted_gram(U, w)
         phi_inv, _ = _phi_terms(phi)
-        nb = U.shape[1]
-        phi_inv_full = jnp.zeros(
-            (n_par + nb, n_par + nb)).at[n_par:, n_par:].set(phi_inv)
+        mtcm = jnp.block([[a_jj, a_ju],
+                          [a_ju.T, a_uu + phi_inv]])
+        rhs = jnp.concatenate([Jw.T @ r, _ut_dot(U, w * r)])
     else:
-        phi_inv_full = jnp.zeros((n_par, n_par))
-    mtcm = mtn @ M + phi_inv_full
-    rhs = mtn @ r
+        M = jnp.concatenate([J, U], axis=1) if nb else J
+        mtn = (M * w[:, None]).T
+        if nb:
+            phi_inv, _ = _phi_terms(phi)
+            phi_inv_full = jnp.zeros(
+                (n_par + nb, n_par + nb)).at[n_par:, n_par:].set(phi_inv)
+        else:
+            phi_inv_full = jnp.zeros((n_par, n_par))
+        mtcm = mtn @ M + phi_inv_full
+        rhs = mtn @ r
     # column normalization for conditioning (reference
     # normalize_designmatrix, utils.py:2879)
     norm = jnp.sqrt(jnp.diag(mtcm))
@@ -252,9 +435,29 @@ def gls_normal_solve(r, J, sigma, U, phi, pre=None, guard_eps=None,
     w_inv = jnp.where(w > cut * wmax, 1.0 / w, 0.0)
     xhat = (Q @ (w_inv * (Q.T @ (rhs / norm)))) / norm
     cov_full = (Q * w_inv[None, :]) @ Q.T / jnp.outer(norm, norm)
-    if U.shape[1]:
+    if nb:
         if pre is not None:
             chi2, _ = woodbury_chi2_logdet_pre(r, pre)
+        elif gram is not None:
+            # the precomputed gram IS the Woodbury capacity matrix
+            # (U^T N^-1 U + Phi^-1 == _capacity's sigma_cap), so the
+            # chi^2 comes from its Cholesky directly — rebuilding the
+            # O(N K^2) weighted gram per iteration through
+            # woodbury_chi2_logdet would undo exactly the saving the
+            # gram path exists for.  The guard ladder's escalation
+            # ridge is applied in-trace the way _capacity does it
+            # (per-diagonal relative), so rung behaviour matches the
+            # dense path.  Contract: gram callers carry a vector phi
+            # (the fitter's frozen-noise leaves), where _phi_terms
+            # ignores the jitter and the match is exact.
+            cap = gram
+            if guard_eps is not None:
+                cap = cap + guard_eps * jnp.diag(jnp.abs(jnp.diag(cap)))
+            cf = jax.scipy.linalg.cho_factor(cap, lower=True)
+            ninv_r = r / nvec
+            ut_ninv_r = _ut_dot(U, ninv_r)
+            x = jax.scipy.linalg.cho_solve(cf, ut_ninv_r)
+            chi2 = jnp.sum(r * ninv_r) - jnp.sum(ut_ninv_r * x)
         else:
             chi2, _ = woodbury_chi2_logdet(r, sigma, U, phi,
                                            jitter=guard_eps)

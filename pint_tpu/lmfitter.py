@@ -47,9 +47,12 @@ class LMFitter(Fitter):
         # the dataset as a dynamic argument (fitter.py contract)
         self._traced_free = tuple(self.model.free_timing_params)
         self._guard_on = _guard.enabled()
-        self._fit_data = {**self.resids._data(),
-                          "guard_eps": np.float64(0.0)}
+        leaves = self._partition_setup()
+        self._fit_data = self._inject_frozen(
+            {**self.resids._data(), "guard_eps": np.float64(0.0)},
+            leaves)
         key = (type(self).__name__, self._traced_free, self._guard_on,
+               self._partition, self._frozen_names, self._noise_frozen,
                self.resids._structure_key())
         self._lm_jit = _cc.shared_jit(
             self._lm_solve, key=("lm.solve",) + key)
@@ -67,17 +70,19 @@ class LMFitter(Fitter):
         return self._resid_fn_of(base_values, data)
 
     def _lm_sigma(self, values, data):
+        if self._noise_frozen:
+            return data["noise_sigma"]  # frozen-noise data leaf
         return self.resids.sigma_at(values, data)
 
     def _lm_solve(self, vec, base_values, lam, data):
         """One damped step at fixed lambda: (J^T W J + lam diag) d =
         -J^T W r on the whitened residuals.  Returns (dpar, chi2, cov,
         health) — health empty with the guard off."""
-        resid_fn = self._lm_resid_fn(base_values, data)
         values = self._merged(base_values, vec)
         sigma = self._lm_sigma(values, data)
-        r = resid_fn(vec)
-        J = jax.jacfwd(resid_fn)(vec)
+        # hybrid analytic/AD design (fitter.Fitter._rj): the tangent
+        # chain runs only over the nonlinear partition
+        r, J = self._rj(vec, base_values, data)
         w = 1.0 / sigma
         rw = r * w
         Jw = J * w[:, None]
@@ -167,23 +172,21 @@ class LMFitter(Fitter):
         if tuple(self.model.free_timing_params) != getattr(
                 self, "_traced_free", ()):
             self._retrace()
-        rungs = [("baseline",
-                  lambda: self._iterate(
-                      maxiter, min_chi2_decrease=min_chi2_decrease))]
-        if self._guard_on:
-            for name, eps in self._guard_jitter_rungs:
-                rungs.append((name, lambda e=eps: self._iterate(
-                    maxiter, guard_eps=e,
-                    min_chi2_decrease=min_chi2_decrease)))
-        (vec, cov, _extras, _n_iter, health), rung = _guard.run_ladder(
-            rungs, context=type(self).__name__)
-        vec_np = np.asarray(vec)
-        errs = np.sqrt(np.clip(np.diag(np.asarray(cov)), 0, None))
-        params = self.model.params
-        for i, name in enumerate(self._traced_free):
-            self.model.values[name] = float(vec_np[i])
-            params[name].uncertainty = float(errs[i])
-        self.covariance = np.asarray(cov)
+        else:
+            self._refresh_frozen()
+        def rungs_fn():
+            rungs = [("baseline",
+                      lambda: self._iterate(
+                          maxiter, min_chi2_decrease=min_chi2_decrease))]
+            if self._guard_on:
+                for name, eps in self._guard_jitter_rungs:
+                    rungs.append((name, lambda e=eps: self._iterate(
+                        maxiter, guard_eps=e,
+                        min_chi2_decrease=min_chi2_decrease)))
+            return rungs
+
+        _vec, _cov, _n_iter, health, rung = \
+            self._fit_with_depth_guard(rungs_fn)
         self._record_guard(rung, health, None)
         self._update_fit_meta()
         return float(self.resids.chi2)
@@ -200,12 +203,16 @@ class PowellFitter(Fitter):
 
     def _retrace(self):
         self._traced_free = tuple(self.model.free_timing_params)
-        self._fit_data = self.resids._data()
+        # Powell needs no design matrix, but the frozen-delay leaves
+        # still cut the traced chi^2 chain down to live components
+        leaves = self._partition_setup()
+        self._fit_data = self._inject_frozen(self.resids._data(),
+                                             leaves)
         self._chi2_jit = _cc.shared_jit(
             lambda vec, base, data: self.resids.chi2_at(
                 self._merged(base, vec), data
             ),
-            key=("powell.chi2", self._traced_free,
+            key=("powell.chi2", self._traced_free, self._frozen_names,
                  self.resids._structure_key()),
             fn_token="powell.chi2")
 
@@ -217,36 +224,43 @@ class PowellFitter(Fitter):
         if tuple(self.model.free_timing_params) != getattr(
                 self, "_traced_free", ()):
             self._retrace()
-        base = self.prepared._values_pytree()
-        x0 = np.array(
-            [self.model.values[k] for k in self._traced_free],
-            dtype=np.float64,
-        )
-        # scale the search by par uncertainties when available (Powell
-        # is scale-sensitive; F1 ~ 1e-15 in raw units)
-        scales = np.array([
-            self.model.params[k].uncertainty or max(abs(v), 1e-12)
-            for k, v in zip(self._traced_free, x0)
-        ])
+        else:
+            self._refresh_frozen()
+        # bounded: the Kepler depth guard escalates through at most
+        # three classes (fitter._kepler_depth_guard)
+        for _depth_try in range(4):
+            base = self.prepared._values_pytree()
+            x0 = np.array(
+                [self.model.values[k] for k in self._traced_free],
+                dtype=np.float64,
+            )
+            # scale the search by par uncertainties when available
+            # (Powell is scale-sensitive; F1 ~ 1e-15 in raw units)
+            scales = np.array([
+                self.model.params[k].uncertainty or max(abs(v), 1e-12)
+                for k, v in zip(self._traced_free, x0)
+            ])
 
-        def fun(z):
-            return float(self._chi2_jit(jnp.asarray(x0 + z * scales),
-                                        base, self._fit_data))
+            def fun(z, x0=x0, scales=scales, base=base):
+                return float(self._chi2_jit(
+                    jnp.asarray(x0 + z * scales), base, self._fit_data))
 
-        res = minimize(fun, np.zeros_like(x0), method="Powell",
-                       options={"maxiter": maxiter, "xtol": 1e-10})
-        vec = x0 + res.x * scales
-        if not (np.all(np.isfinite(vec)) and np.isfinite(res.fun)):
-            telemetry.counter_add("guard.trips")
-            telemetry.counter_add("guard.trip.powell")
-            raise _guard.FitDivergedError(
-                type(self).__name__,
-                last_good={n: float(x0[i])
-                           for i, n in enumerate(self._traced_free)},
-                detail=f"Powell returned non-finite optimum "
-                       f"(fun={res.fun!r})")
-        for i, name in enumerate(self._traced_free):
-            self.model.values[name] = float(vec[i])
+            res = minimize(fun, np.zeros_like(x0), method="Powell",
+                           options={"maxiter": maxiter, "xtol": 1e-10})
+            vec = x0 + res.x * scales
+            if not (np.all(np.isfinite(vec)) and np.isfinite(res.fun)):
+                telemetry.counter_add("guard.trips")
+                telemetry.counter_add("guard.trip.powell")
+                raise _guard.FitDivergedError(
+                    type(self).__name__,
+                    last_good={n: float(x0[i])
+                               for i, n in enumerate(self._traced_free)},
+                    detail=f"Powell returned non-finite optimum "
+                           f"(fun={res.fun!r})")
+            for i, name in enumerate(self._traced_free):
+                self.model.values[name] = float(vec[i])
+            if not self._kepler_depth_guard():
+                break
         self.converged = bool(res.success)
         self.covariance = None
         self._update_fit_meta()
@@ -288,3 +302,10 @@ class WidebandLMFitter(LMFitter):
             [self.resids.toa.sigma_at(values, data["toa"]),
              self.resids.dm.sigma_at(values, data["dm"])]
         )
+
+    def _rj(self, vec, base_values, data):
+        from pint_tpu.fitter import wideband_resid_and_design
+
+        return wideband_resid_and_design(
+            self.resids, base_values, data, self._traced_free, vec,
+            self._partition)

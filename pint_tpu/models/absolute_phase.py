@@ -96,3 +96,12 @@ class PhaseOffset(PhaseComponent):
         if not ctx["apply"]:
             return jnp.zeros_like(delay)
         return -values["PHOFF"] * jnp.ones_like(delay)
+
+    # -- hybrid design matrix -------------------------------------------------
+    def linear_params(self):
+        return ("PHOFF",)
+
+    def d_phase_d_param(self, values, batch, ctx, delay, name):
+        if not ctx["apply"]:  # the TZR TOA opts out (prepare above)
+            return jnp.zeros_like(delay)
+        return -jnp.ones_like(delay)

@@ -43,6 +43,11 @@ class BinaryComponent(DelayComponent):
     category = "pulsar_system"
     binary_name: str = ""
     epoch_param: str = "T0"
+    #: dt_epoch subtracts the accumulated delay chain: a perturbation of
+    #: any EARLIER delay component feeds back through the orbital phase,
+    #: so parameters upstream of a binary are never exactly phase-linear
+    #: (see Component.reads_delay_accum / design_partition)
+    reads_delay_accum = True
 
     def __init_subclass__(cls, **kw):
         super().__init_subclass__(**kw)
@@ -102,11 +107,22 @@ class BinaryComponent(DelayComponent):
             self.epoch_param,
             int(round(model.values[self.epoch_param] * 2**32)),
         )
+        dt0 = fp.ticks_to_seconds(jnp.asarray(toas.ticks)
+                                  - jnp.int64(ticks))
+        # static Kepler depth from the prepare-time eccentricity class
+        # (incl. EDOT drift over the span): a python int, so it lands
+        # in the STATIC ctx part and keys every shared trace — two
+        # same-structure models in different eccentricity classes
+        # never share an unroll
+        from pint_tpu.models.binary.kepler import newton_iters_for
+
+        ecc = abs(float(model.values.get("ECC", float("nan"))))
+        edot = abs(float(model.values.get("EDOT", 0.0) or 0.0))
+        span = float(jnp.max(jnp.abs(dt0))) if dt0.size else 0.0
         return {
-            "dt0": fp.ticks_to_seconds(
-                jnp.asarray(toas.ticks) - jnp.int64(ticks)
-            ),
+            "dt0": dt0,
             "epoch_ref": jnp.float64(ticks / 2**32),
+            "kepler_iters": newton_iters_for(ecc + edot * span),
         }
 
     def dt_epoch(self, values, ctx, accum):

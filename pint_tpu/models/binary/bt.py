@@ -47,12 +47,33 @@ class KeplerianMixin:
                  GAMMA=0.0)
         return d
 
-    def eccentric_anomaly(self, values, dt):
-        """(E, ecc, orbital freq) at dt = t - T0."""
+    def ecc_reach(self, values, batch):
+        """Largest |eccentricity| this binary's Kepler solve can see at
+        ``values`` over the dataset: |ECC| + |EDOT| * max|t - T0| — the
+        host-side reach PreparedModel.kepler_ecc_reach aggregates to
+        validate the static Newton depth against fitted/gridded
+        eccentricities."""
+        from pint_tpu import fixedpoint as fp
+
+        ecc = abs(float(values.get("ECC", float("nan"))))
+        edot = abs(float(values.get("EDOT", 0.0) or 0.0))
+        span = 0.0
+        if edot and getattr(batch, "ticks", None) is not None:
+            ticks = np.int64(int(round(
+                float(values[self.epoch_param]) * 2**32)))
+            dt0 = fp.ticks_to_seconds(np.asarray(batch.ticks) - ticks)
+            span = float(np.max(np.abs(dt0))) if dt0.size else 0.0
+        return ecc + edot * span
+
+    def eccentric_anomaly(self, values, dt, ctx=None):
+        """(E, ecc, orbital freq) at dt = t - T0.  ctx supplies the
+        static Newton depth chosen at prepare time (kepler_iters)."""
         orbits, forb = self.orbits_and_freq(values, dt)
         mean_anom = self.orbit_phase(orbits)
         ecc = values["ECC"] + dt * values["EDOT"]
-        return kepler_eccentric_anomaly(mean_anom, ecc), ecc, forb
+        iters = (ctx or {}).get("kepler_iters", 10)
+        return kepler_eccentric_anomaly(mean_anom, ecc, iters), ecc, \
+            forb
 
 
 class BinaryBT(KeplerianMixin, BinaryComponent):
@@ -66,10 +87,10 @@ class BinaryBT(KeplerianMixin, BinaryComponent):
         return self.keplerian_defaults()
 
     def binary_delay(self, values, dt, ctx):
-        return self._bt_delay_core(values, dt, values["A1"])
+        return self._bt_delay_core(values, dt, values["A1"], ctx)
 
-    def _bt_delay_core(self, values, dt, a1_base):
-        E, ecc, forb = self.eccentric_anomaly(values, dt)
+    def _bt_delay_core(self, values, dt, a1_base, ctx=None):
+        E, ecc, forb = self.eccentric_anomaly(values, dt, ctx)
         a1 = a1_base + dt * values["XDOT"]
         omega = values["OM"] + dt * values["OMDOT"]
         sw, cw = jnp.sin(omega), jnp.cos(omega)
@@ -163,4 +184,4 @@ class BinaryBTPiecewise(BinaryBT):
             use_a1 = jnp.where(jnp.isnan(a1x), values["A1"], a1x)
             t0_off = t0_off + m * (use_t0 - values["T0"])
             a1 = a1 + m * (use_a1 - values["A1"])
-        return self._bt_delay_core(values, dt - t0_off, a1)
+        return self._bt_delay_core(values, dt - t0_off, a1, ctx)

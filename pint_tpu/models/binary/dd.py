@@ -65,7 +65,7 @@ class BinaryDD(KeplerianMixin, BinaryComponent):
         )
 
     def binary_delay(self, values, dt, ctx):
-        E, ecc, forb = self.eccentric_anomaly(values, dt)
+        E, ecc, forb = self.eccentric_anomaly(values, dt, ctx)
         sE, cE = jnp.sin(E), jnp.cos(E)
         nu = true_anomaly(E, ecc)
         q = self.dd_quantities(values, dt, ctx, nu, forb)
@@ -245,6 +245,12 @@ class BinaryDDK(BinaryDD):
     component (reference: DDK_model.py, binary_ddk.py:44)."""
 
     binary_name = "DDK"
+
+    #: dd_quantities reads the astrometry component's parallax and
+    #: proper motion in-trace (Kopeikin secular/annual terms) — free
+    #: astrometry must keep this component out of the frozen set and
+    #: its analytic columns honest (reads_params contract)
+    reads_params = ("PX", "PMRA", "PMDEC", "PMELONG", "PMELAT")
 
     #: values forced when this component is added as an INERT member of
     #: a heterogeneous-PTA superset (parallel.pta): the gate zeroes its

@@ -42,6 +42,23 @@ class Component:
     register: bool = True
     #: par-file keys whose presence selects this component (builder hint)
     trigger_params: tuple = ()
+    #: True when ``delay()`` reads its ``delay_accum`` argument — the
+    #: accumulated delay of earlier chain members.  The hybrid design
+    #: matrix (PreparedModel.design_partition) must know: a parameter of
+    #: an EARLIER component perturbs every later accum-reader (binary
+    #: orbital phase at t - accum shifts a DM column at the ~1e-4
+    #: relative level), so its structured column carries the chain's
+    #: suffix-response factor (one shared ``jvp`` per position) to stay
+    #: exact against the 1e-12 hybrid==jacfwd pin.
+    reads_delay_accum: bool = False
+    #: names of OTHER components' parameters this component reads from
+    #: ``values`` inside ``delay()``/``phase()`` (e.g. SolarSystemShapiro
+    #: recomputes the pulsar direction from RAJ/DECJ; DDK reads PX and
+    #: the proper motion).  The structured design build must evaluate
+    #: this component's local partial too — an undeclared cross-read
+    #: would silently drop that term from the analytic column.  Own
+    #: (``has_param``) parameters need not be listed.
+    reads_params: tuple = ()
 
     def __init_subclass__(cls, **kw):
         super().__init_subclass__(**kw)
@@ -85,17 +102,51 @@ class Component:
         """Static per-dataset arrays; captured as jit constants."""
         return {}
 
+    # -- hybrid design matrix (PINT's d_phase_d_param split) ------------------
+    def linear_params(self) -> tuple:
+        """Names of this component's parameters whose phase contribution
+        is linear with a closed-form design column (the analytic half of
+        the hybrid design matrix).  A name listed here promises the
+        matching ``d_delay_d_param`` / ``d_phase_d_param`` hook returns
+        the EXACT derivative of ``delay()`` / ``phase()`` — the hybrid
+        column is regression-pinned against full ``jacfwd`` at 1e-12
+        relative.  Default: nothing is analytic."""
+        return ()
+
 
 class DelayComponent(Component):
     def delay(self, values, batch, ctx, delay_accum):
         """Return delay in seconds (float64, shape of batch)."""
         raise NotImplementedError
 
+    def d_delay_d_param(self, values, batch, ctx, delay_accum, name):
+        """d delay / d ``name`` [s per internal unit], for names listed
+        in :meth:`Component.linear_params`.  ``delay_accum`` is the
+        accumulated delay of earlier chain members, exactly as
+        ``delay()`` receives it."""
+        raise NotImplementedError(
+            f"{type(self).__name__} declares {name} linear but defines "
+            "no d_delay_d_param")
+
+    # optional extra hook ``d_dm_d_param(values, batch, ctx, name)``:
+    # components exposing a ``dm_value`` must provide it for their
+    # linear params or those params stay nonlinear on the wideband
+    # (stacked [time; DM]) fitters, whose DM block differentiates the
+    # modeled DM as well as the delay.
+
 
 class PhaseComponent(Component):
     def phase(self, values, batch, ctx, delay):
         """Return phase turns: float64 array, or (int64, float64) pair."""
         raise NotImplementedError
+
+    def d_phase_d_param(self, values, batch, ctx, delay, name):
+        """d phase / d ``name`` [turns per internal unit], for names
+        listed in :meth:`Component.linear_params`.  ``delay`` is the
+        full accumulated delay, exactly as ``phase()`` receives it."""
+        raise NotImplementedError(
+            f"{type(self).__name__} declares {name} linear but defines "
+            "no d_phase_d_param")
 
 
 def mask_from_select(select: tuple, toas) -> "jnp.ndarray":

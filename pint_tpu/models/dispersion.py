@@ -86,6 +86,31 @@ class DispersionDM(DelayComponent):
         dm = self.dm_at(values, ctx)
         return DM_CONST * dm / ctx["bfreq"] ** 2
 
+    # -- hybrid design matrix -------------------------------------------------
+    def linear_params(self):
+        return ("DM",) + tuple(
+            f"DM{k}" for k in range(1, self.num_dm_derivs + 1))
+
+    def _d_dm(self, ctx, name):
+        """d DM(t) / d name: the Taylor monomial dt^k/k! (1 for DM),
+        built with the same chained multiplies as dm_at."""
+        if name == "DM":
+            return jnp.ones_like(ctx["dt_yr"])
+        k = int(name[2:])
+        dt = ctx["dt_yr"]
+        fact = 1.0
+        power = dt
+        for j in range(2, k + 1):
+            fact *= j
+            power = power * dt
+        return power / fact
+
+    def d_delay_d_param(self, values, batch, ctx, delay_accum, name):
+        return DM_CONST * self._d_dm(ctx, name) / ctx["bfreq"] ** 2
+
+    def d_dm_d_param(self, values, batch, ctx, name):
+        return self._d_dm(ctx, name)
+
 
 class DispersionDMX(DelayComponent):
     """Piecewise DM offsets over MJD ranges (DMX_####/DMXR1/DMXR2)."""
@@ -148,6 +173,20 @@ class DispersionDMX(DelayComponent):
         return DM_CONST * self.dm_value(values, batch, ctx) \
             / ctx["bfreq"] ** 2
 
+    # -- hybrid design matrix -------------------------------------------------
+    def linear_params(self):
+        return tuple(f"DMX_{i:04d}" for i in self.indices)
+
+    def _d_dm(self, ctx, name):
+        j = self.indices.index(int(name[4:]))
+        return ctx["masks"][j].astype(jnp.float64)
+
+    def d_delay_d_param(self, values, batch, ctx, delay_accum, name):
+        return DM_CONST * self._d_dm(ctx, name) / ctx["bfreq"] ** 2
+
+    def d_dm_d_param(self, values, batch, ctx, name):
+        return self._d_dm(ctx, name)
+
 
 class DispersionJump(DelayComponent):
     """Constant offsets to the *measured DM values* on TOA subsets
@@ -204,3 +243,15 @@ class DispersionJump(DelayComponent):
         # sign: DMJUMP is subtracted from the modeled DM (reference
         # jump_dm adds -value)
         return -jnp.sum(ctx["masks"] * dj[:, None], axis=0)
+
+    # -- hybrid design matrix -------------------------------------------------
+    def linear_params(self):
+        return tuple(
+            f"DMJUMP{i}" for i in range(1, len(self.selects) + 1))
+
+    def d_delay_d_param(self, values, batch, ctx, delay_accum, name):
+        return jnp.zeros_like(batch.freq_mhz)
+
+    def d_dm_d_param(self, values, batch, ctx, name):
+        i = int(name[6:])
+        return -ctx["masks"][i - 1].astype(jnp.float64)

@@ -63,6 +63,18 @@ class FD(DelayComponent):
             acc = (acc + values[f"FD{k}"]) * y
         return acc
 
+    # -- hybrid design matrix -------------------------------------------------
+    def linear_params(self):
+        return tuple(f"FD{k}" for k in range(1, self.num_terms + 1))
+
+    def d_delay_d_param(self, values, batch, ctx, delay_accum, name):
+        k = int(name[2:])
+        y = ctx["log_freq_ghz"]
+        col = y
+        for _ in range(k - 1):
+            col = col * y
+        return col
+
 
 class FDJump(DelayComponent):
     """Per-system FD polynomials.  Internal names FD{p}JUMP{q}: p = FD
@@ -124,6 +136,18 @@ class FDJump(DelayComponent):
                 ctx["masks"][j], values[f"FD{p}JUMP{q}"] * y**p, 0.0
             )
         return out
+
+    # -- hybrid design matrix -------------------------------------------------
+    def linear_params(self):
+        return tuple(f"FD{p}JUMP{q}" for p, q, _sel in self.terms)
+
+    def d_delay_d_param(self, values, batch, ctx, delay_accum, name):
+        y = ctx["y"]
+        for j, (p, q, _sel) in enumerate(self.terms):
+            if f"FD{p}JUMP{q}" == name:
+                return jnp.where(ctx["masks"][j], y**p,
+                                 jnp.zeros_like(y))
+        raise KeyError(name)
 
 
 class FDJumpDM(DelayComponent):
@@ -187,3 +211,18 @@ class FDJumpDM(DelayComponent):
         # (reference fdjump_dm_delay -> dispersion_type_delay)
         return DM_CONST * self.dm_value(values, batch, ctx) \
             / ctx["bfreq"] ** 2
+
+    # -- hybrid design matrix -------------------------------------------------
+    def linear_params(self):
+        return tuple(
+            f"FDJUMPDM{i}" for i in range(1, len(self.selects) + 1))
+
+    def _d_dm(self, ctx, name):
+        i = int(name[8:])
+        return -ctx["masks"][i - 1].astype(jnp.float64)
+
+    def d_delay_d_param(self, values, batch, ctx, delay_accum, name):
+        return DM_CONST * self._d_dm(ctx, name) / ctx["bfreq"] ** 2
+
+    def d_dm_d_param(self, values, batch, ctx, name):
+        return self._d_dm(ctx, name)

@@ -62,10 +62,23 @@ class _JumpBase:
 class PhaseJump(_JumpBase, PhaseComponent):
     category = "phase_jump"
     trigger_params = ("JUMP",)
+    #: phase() converts the jump seconds to turns through the spindown
+    #: component's F0 (reads_params contract; F0 is already nonlinear
+    #: in the hybrid partition, so this only documents the read today)
+    reads_params = ("F0",)
 
     def phase(self, values, batch, ctx, delay):
         jump = self._total_jump_sec(values, ctx, batch.ticks.shape[0])
         return jump * values["F0"]
+
+    # -- hybrid design matrix -------------------------------------------------
+    def linear_params(self):
+        return tuple(
+            f"JUMP{i}" for i in range(1, len(self.selects) + 1))
+
+    def d_phase_d_param(self, values, batch, ctx, delay, name):
+        i = int(name[4:])
+        return ctx["masks"][i - 1] * values["F0"]
 
 
 class DelayJump(_JumpBase, DelayComponent):
@@ -75,3 +88,12 @@ class DelayJump(_JumpBase, DelayComponent):
 
     def delay(self, values, batch, ctx, delay_accum):
         return -self._total_jump_sec(values, ctx, batch.ticks.shape[0])
+
+    # -- hybrid design matrix -------------------------------------------------
+    def linear_params(self):
+        return tuple(
+            f"JUMP{i}" for i in range(1, len(self.selects) + 1))
+
+    def d_delay_d_param(self, values, batch, ctx, delay_accum, name):
+        i = int(name[4:])
+        return -ctx["masks"][i - 1].astype(jnp.float64)

@@ -39,6 +39,12 @@ def _obj_shapiro(obj_pos_ls, psr_dir, t_obj):
 class SolarSystemShapiro(DelayComponent):
     category = "solar_system_shapiro"
     trigger_params = ("PLANET_SHAPIRO",)
+    #: delay() recomputes the pulsar direction from the astrometry
+    #: component's position parameters (_psr_dir_from_values) — free
+    #: astrometry must keep this component in the trace
+    #: (frozen_delay_split), and edits to a fixed position must refresh
+    #: its frozen leaf (frozen_param_values)
+    reads_params = ("RAJ", "DECJ", "ELONG", "ELAT")
 
     def __init__(self):
         super().__init__()

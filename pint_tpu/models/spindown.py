@@ -78,3 +78,22 @@ class Spindown(PhaseComponent):
             small = small + values[f"F{k}"] * power / fact
             power = power * dt
         return n, frac + small
+
+    # -- hybrid design matrix -------------------------------------------------
+    def linear_params(self):
+        """F1..Fk enter the phase as Fk * dt^(k+1)/(k+1)! — linear with
+        the Taylor monomial as the closed-form column.  F0 stays
+        nonlinear: it multiplies the delay term AND divides the
+        time-residual conversion, so its column is left to jacfwd."""
+        return tuple(f"F{k}" for k in range(1, self.num_freq_derivs + 1))
+
+    def d_phase_d_param(self, values, batch, ctx, delay, name):
+        k = int(name[1:])
+        dt = fp.ticks_to_seconds(ctx["dt_ticks"]) - delay
+        fact = 1.0
+        power = dt * dt
+        for j in range(1, k):
+            power = power * dt
+        for j in range(1, k + 1):
+            fact *= j + 1
+        return power / fact

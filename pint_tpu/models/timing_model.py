@@ -29,6 +29,27 @@ import numpy as np
 from pint_tpu import fixedpoint as fp
 from pint_tpu.models.component import Component, DelayComponent, PhaseComponent
 
+def _env_on(var: str) -> bool:
+    """Default-on env gate: anything but 0/off/no/false enables."""
+    import os
+
+    return os.environ.get(var, "1").strip().lower() not in (
+        "0", "off", "no", "false")
+
+
+def hybrid_design_default() -> bool:
+    """Whether fitters/grids build hybrid analytic/AD design matrices
+    (``$PINT_TPU_HYBRID_DESIGN``, default on).  The gate changes traced
+    programs, so callers fold it into their jit keys."""
+    return _env_on("PINT_TPU_HYBRID_DESIGN")
+
+
+def frozen_delay_default() -> bool:
+    """Whether fitters/grids precompute frozen-component delays as
+    dynamic data leaves (``$PINT_TPU_FROZEN_DELAY``, default on)."""
+    return _env_on("PINT_TPU_FROZEN_DELAY")
+
+
 #: evaluation order by category (reference DEFAULT_ORDER,
 #: timing_model.py:107-123)
 DEFAULT_ORDER = [
@@ -571,18 +592,30 @@ class PreparedModel:
         return dm_sigma
 
     # pure function of values (pytree dict of f64 scalars)
-    def _delay_raw(self, values, batch, ctx_map):
+    def _delay_raw(self, values, batch, ctx_map, frozen=None):
+        """Sequential delay fold.  frozen: optional ``{component_name:
+        precomputed (N,) delay}`` — those components' contributions
+        enter the fold as DATA at their chain position instead of being
+        re-evaluated (the frozen-delay precompute; see
+        :meth:`frozen_delay_split` for when substitution is sound)."""
         total = jnp.zeros(batch.ticks.shape, dtype=jnp.float64)
         for c in self.model.delay_components:
-            ctx = ctx_map[type(c).__name__]
+            name = type(c).__name__
+            if frozen is not None and name in frozen:
+                total = total + frozen[name]
+                continue
+            ctx = ctx_map[name]
             d = c.delay(values, batch, ctx, total)
             if "__gate__" in ctx:
                 d = d * ctx["__gate__"]
             total = total + d
         return total
 
-    def _phase_sum(self, values, batch, ctx_map):
-        delay = self._delay_raw(values, batch, ctx_map)
+    def _phase_sum_given_delay(self, values, batch, ctx_map, delay):
+        """The phase-component fold at an explicit total delay — split
+        out of :meth:`_phase_sum` so the hybrid design matrix can take
+        one ``jvp`` through the phase stage alone (the pointwise
+        d phase/d delay multiplier every delay-linear column shares)."""
         n = jnp.zeros(batch.ticks.shape, dtype=jnp.int64)
         frac = jnp.zeros(batch.ticks.shape, dtype=jnp.float64)
         for c in self.model.phase_components:
@@ -602,13 +635,22 @@ class PreparedModel:
                 frac = frac + (ph if gate is None else ph * gate)
         return n, frac
 
-    def _phase_raw_at(self, values, batch, ctx, tzr_batch, tzr_ctx):
+    def _phase_sum(self, values, batch, ctx_map, frozen=None):
+        delay = self._delay_raw(values, batch, ctx_map, frozen=frozen)
+        return self._phase_sum_given_delay(values, batch, ctx_map,
+                                           delay)
+
+    def _phase_raw_at(self, values, batch, ctx, tzr_batch, tzr_ctx,
+                      frozen=None, tzr_frozen=None):
         """TZR-referenced (n, frac) with the dataset passed explicitly —
         the pure-function form the compile-cache shared traces use
-        (batch/ctx arrive as jit arguments, not closure constants)."""
-        n, frac = self._phase_sum(values, batch, ctx)
+        (batch/ctx arrive as jit arguments, not closure constants).
+        frozen/tzr_frozen: optional precomputed-delay dicts riding the
+        fit-data pytree (see _delay_raw)."""
+        n, frac = self._phase_sum(values, batch, ctx, frozen=frozen)
         if tzr_batch is not None:
-            tn, tfrac = self._phase_sum(values, tzr_batch, tzr_ctx)
+            tn, tfrac = self._phase_sum(values, tzr_batch, tzr_ctx,
+                                        frozen=tzr_frozen)
             n = n - tn[0]
             frac = frac - tfrac[0]
         return fp.renorm_phase(n, frac)
@@ -616,6 +658,281 @@ class PreparedModel:
     def _phase_raw(self, values):
         return self._phase_raw_at(values, self.batch, self.ctx,
                                   self.tzr_batch, self.tzr_ctx)
+
+    # -- hybrid design matrix / frozen-delay partition -------------------------
+    def frozen_delay_split(self, free_names):
+        """Names of delay components whose delay arrays are constants of
+        the fit given this free set: the component owns no free
+        parameter, READS no free foreign parameter
+        (``Component.reads_params`` — SolarSystemShapiro recomputes the
+        pulsar direction from RAJ/DECJ inside ``delay()``, so freezing
+        it against free astrometry would serve a stale direction AND
+        drop d(Shapiro)/d(position) from the Jacobian), and either
+        ignores the accumulated delay or sits in the all-frozen chain
+        prefix (an accum-reader behind an active component varies
+        through the chain even with its own parameters frozen, and must
+        stay in the trace)."""
+        free = set(free_names)
+        frozen = []
+        seen_active = False
+        for c in self.model.delay_components:
+            active = any(p.name in free for p in c.params) or any(
+                n in free for n in getattr(c, "reads_params", ()))
+            reads = getattr(c, "reads_delay_accum", False)
+            if not active and (not reads or not seen_active):
+                frozen.append(type(c).__name__)
+            else:
+                seen_active = True
+        return tuple(frozen)
+
+    def frozen_delay_leaves(self, frozen_names, values=None):
+        """Precompute the frozen components' delay arrays host-side
+        (eagerly, OUTSIDE any trace).  Returns ``(data_dict,
+        tzr_dict_or_None)`` of concrete (N,)/(1,) arrays — dynamic
+        leaves of the fit-data pytree, so a same-structure fitter still
+        shares the trace and editing a frozen parameter between fits
+        costs a cheap host re-fold, never a recompile.
+
+        The running accumulator covers frozen components only: a frozen
+        accum-reader is, by :meth:`frozen_delay_split`, preceded
+        exclusively by frozen components, so the partial sum it sees
+        here equals the full chain accum; non-readers ignore it."""
+        if not frozen_names:
+            return None, None
+        want = set(frozen_names)
+        v = self._values_pytree(values)
+
+        def fold(batch, ctx_map):
+            out = {}
+            total = jnp.zeros(batch.ticks.shape, dtype=jnp.float64)
+            for c in self.model.delay_components:
+                name = type(c).__name__
+                if name not in want:
+                    continue
+                ctx = ctx_map[name]
+                d = c.delay(v, batch, ctx, total)
+                if "__gate__" in ctx:
+                    d = d * ctx["__gate__"]
+                out[name] = jnp.asarray(np.asarray(d))
+                total = total + d
+            return out
+
+        data = fold(self.batch, self.ctx)
+        tzr = (fold(self.tzr_batch, self.tzr_ctx)
+               if self.tzr_batch is not None else None)
+        return data, tzr
+
+    def frozen_param_values(self, frozen_names):
+        """{param: value} over the frozen components — the fingerprint
+        fit_toas compares so an edit to a frozen parameter refreshes
+        the precomputed leaves instead of serving stale delays.  Covers
+        the components' OWN params and their declared foreign reads
+        (``reads_params``): an edit to a fixed RAJ between fits must
+        re-fold the frozen Shapiro delay too."""
+        out = {}
+        for c in self.model.delay_components:
+            if type(c).__name__ in frozen_names:
+                names = [p.name for p in c.params]
+                names += [n for n in getattr(c, "reads_params", ())
+                          if n in self.model.values]
+                for name in names:
+                    out[name] = float(self.model.values.get(name,
+                                                            np.nan))
+        return out
+
+    def kepler_ecc_reach(self, values=None):
+        """Largest |eccentricity| the binary delay chain can see at
+        ``values``: max over Kepler-solving binaries of |ECC| + |EDOT|
+        times the dataset half-span (the same reach binary/base.prepare
+        classifies).  NaN when a binary's ECC is unset; -inf when no
+        Kepler binary is present."""
+        v = self.model.values if values is None else values
+        reach = float("-inf")
+        for c in self.model.delay_components:
+            f = getattr(c, "ecc_reach", None)
+            if f is not None:
+                reach = max(reach, f(v, self.batch))
+        return reach
+
+    def ensure_kepler_depth(self, ecc_max):
+        """Monotonically raise every binary ctx's static Kepler Newton
+        depth to cover eccentricities up to ``ecc_max`` (NaN -> the
+        full e < 0.97 unroll).  The depth is a STATIC ctx int chosen
+        from the prepare-time eccentricity class (binary/base.prepare);
+        a fit or grid that can move ECC/EDOT beyond that class must
+        call this first or the fixed-iteration solver silently
+        under-converges (e = 0.9 at the 4-deep unroll leaves ~1e-5 rad
+        in the eccentric anomaly).  Returns True when any ctx changed —
+        callers holding a split static ctx (Residuals) must re-split
+        and re-key their traces."""
+        from pint_tpu.models.binary.kepler import newton_iters_for
+
+        need = newton_iters_for(ecc_max)
+        changed = False
+        for ctx_map in (self.ctx, self.tzr_ctx):
+            if not ctx_map:
+                continue
+            for sub in ctx_map.values():
+                if (isinstance(sub, dict)
+                        and sub.get("kepler_iters", need) < need):
+                    sub["kepler_iters"] = need
+                    changed = True
+        return changed
+
+    def design_partition(self, free_names, frozen=(), wideband=False):
+        """Split free timing parameters into ``(linear, nonlinear)``
+        tuples (free order preserved) — PINT's ``d_phase_d_param``
+        split.  ``linear`` columns are built analytically in the trace
+        (:meth:`linear_phase_columns`); ``jacfwd`` runs only over the
+        nonlinear remainder.
+
+        A parameter is linear iff EVERY component owning it lists it in
+        ``linear_params()`` and, for delay components, no accum-reading
+        delay component that is still in the trace (not in ``frozen``)
+        follows it in the chain — a later binary/WaveX would feed the
+        column back through the chain at far above the 1e-12
+        hybrid==jacfwd pin.  ``wideband`` additionally requires any
+        owner exposing ``dm_value`` to implement ``d_dm_d_param`` (the
+        stacked fitters differentiate the DM block too)."""
+        from pint_tpu.models.component import DelayComponent
+
+        frozen = set(frozen)
+        delay_comps = self.model.delay_components
+        # unsafe_after[i]: an in-trace accum-reader strictly after i
+        unsafe_after = [False] * len(delay_comps)
+        flag = False
+        for i in range(len(delay_comps) - 1, -1, -1):
+            unsafe_after[i] = flag
+            c = delay_comps[i]
+            if (getattr(c, "reads_delay_accum", False)
+                    and type(c).__name__ not in frozen):
+                flag = True
+        delay_pos = {id(c): i for i, c in enumerate(delay_comps)}
+
+        # a free parameter READ (not owned) by an in-trace component
+        # (Component.reads_params) gets contributions the owners'
+        # closed-form columns cannot see — leave it to jacfwd.  A
+        # frozen reader cannot read a free parameter at all
+        # (frozen_delay_split), so only in-trace readers block.
+        read_elsewhere = set()
+        for c in self.model.components:
+            if type(c).__name__ not in frozen:
+                read_elsewhere.update(getattr(c, "reads_params", ()))
+
+        linear, nonlinear = [], []
+        for name in free_names:
+            owners = [c for c in self.model.components
+                      if c.has_param(name)]
+            ok = bool(owners) and name not in read_elsewhere
+            for c in owners:
+                if name not in set(c.linear_params()):
+                    ok = False
+                    break
+                if isinstance(c, DelayComponent):
+                    if unsafe_after[delay_pos[id(c)]]:
+                        ok = False
+                        break
+                    if wideband and getattr(c, "dm_value", None) \
+                            is not None and getattr(
+                                c, "d_dm_d_param", None) is None:
+                        ok = False
+                        break
+            (linear if ok else nonlinear).append(name)
+        return tuple(linear), tuple(nonlinear)
+
+    def linear_phase_columns(self, values, batch, ctx_map, names,
+                             frozen=None):
+        """(N, L) matrix of d phase / d name [turns per unit] for the
+        phase-linear parameters ``names``, inside the trace but WITHOUT
+        any tangent pass through the delay chain: one delay fold
+        collects each delay-owner's closed-form d delay/d param at its
+        chain position, one ``jvp`` through the phase stage alone gives
+        the shared pointwise d phase/d delay multiplier, and
+        phase-owners contribute their columns directly."""
+        import jax
+
+        n_toa = batch.ticks.shape[0]
+        want = list(names)
+        delay_cols = {}
+        phase_cols = {}
+
+        def add(store, nm, col):
+            prev = store.get(nm)
+            store[nm] = col if prev is None else prev + col
+
+        delay = jnp.zeros(n_toa, dtype=jnp.float64)
+        for c in self.model.delay_components:
+            cname = type(c).__name__
+            ctx = ctx_map[cname]
+            gate = ctx.get("__gate__")
+            for nm in want:
+                if c.has_param(nm):
+                    col = c.d_delay_d_param(values, batch, ctx, delay,
+                                            nm)
+                    if gate is not None:
+                        col = col * gate
+                    add(delay_cols, nm, col)
+            if frozen is not None and cname in frozen:
+                d = frozen[cname]
+            else:
+                d = c.delay(values, batch, ctx, delay)
+                if gate is not None:
+                    d = d * gate
+            delay = delay + d
+
+        if delay_cols:
+            def frac_of(dly):
+                _, frac = self._phase_sum_given_delay(
+                    values, batch, ctx_map, dly)
+                return frac
+
+            _, dphase_ddelay = jax.jvp(
+                frac_of, (delay,), (jnp.ones_like(delay),))
+
+        for c in self.model.phase_components:
+            ctx = ctx_map[type(c).__name__]
+            gate = ctx.get("__gate__")
+            for nm in want:
+                if c.has_param(nm):
+                    col = c.d_phase_d_param(values, batch, ctx, delay,
+                                            nm)
+                    if gate is not None:
+                        col = col * gate
+                    add(phase_cols, nm, col)
+
+        cols = []
+        for nm in want:
+            col = phase_cols.get(nm)
+            dcol = delay_cols.get(nm)
+            if dcol is not None:
+                dcol = dphase_ddelay * dcol
+                col = dcol if col is None else col + dcol
+            if col is None:
+                col = jnp.zeros(n_toa, dtype=jnp.float64)
+            cols.append(col)
+        return jnp.stack(cols, axis=1)
+
+    def linear_dm_columns(self, values, batch, ctx_map, names):
+        """(N, L) matrix of d DM / d name [pc cm^-3 per unit] — the
+        wideband DM-block counterpart of linear_phase_columns.
+        Components without a dm_value contribute zero columns."""
+        n_toa = batch.ticks.shape[0]
+        cols = []
+        for nm in names:
+            col = None
+            for c in self.model.components:
+                if c.has_param(nm) and getattr(c, "dm_value", None) \
+                        is not None:
+                    ctx = ctx_map[type(c).__name__]
+                    d = c.d_dm_d_param(values, batch, ctx, nm)
+                    gate = ctx.get("__gate__")
+                    if gate is not None:
+                        d = d * gate
+                    col = d if col is None else col + d
+            if col is None:
+                col = jnp.zeros(n_toa, dtype=jnp.float64)
+            cols.append(col)
+        return jnp.stack(cols, axis=1)
 
     # -- public API ----------------------------------------------------------
     def delay(self, values=None):

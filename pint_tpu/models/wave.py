@@ -102,6 +102,22 @@ class Wave(PhaseComponent):
             sec = sec + values[f"WAVE{k}B"] * jnp.cos(arg)
         return sec * values["F0"]
 
+    # -- hybrid design matrix -------------------------------------------------
+    def linear_params(self):
+        """Sine/cosine amplitudes are linear; WAVE_OM (inside the trig
+        argument) stays nonlinear."""
+        out = []
+        for k in range(1, self.num_terms + 1):
+            out += [f"WAVE{k}A", f"WAVE{k}B"]
+        return tuple(out)
+
+    def d_phase_d_param(self, values, batch, ctx, delay, name):
+        tau = ctx["t_days"] - delay / SECS_PER_DAY
+        k = int(name[4:-1])
+        arg = k * (values["WAVE_OM"] * tau)
+        trig = jnp.sin(arg) if name.endswith("A") else jnp.cos(arg)
+        return trig * values["F0"]
+
 
 class IFunc(PhaseComponent):
     """Tabulated phase offsets: phase = F0 * interp(t) with SIFUNC type

@@ -33,6 +33,8 @@ class _FourierBase(DelayComponent):
     px: str = ""
     epoch_name: str = ""
     amp_units: str = "s"
+    #: tau = t - EPOCH - accumulated_delay: the series reads the chain
+    reads_delay_accum = True
 
     def __init__(self, indices=()):
         super().__init__()
@@ -96,6 +98,33 @@ class _FourierBase(DelayComponent):
             axis=0,
         )
 
+    # -- hybrid design matrix -------------------------------------------------
+    def linear_params(self):
+        """Sin/cos amplitudes are linear; the (non-fittable) frequencies
+        and epoch sit inside the trig argument."""
+        out = []
+        for i in self.indices:
+            out += [f"{self.px}SIN_{i:04d}", f"{self.px}COS_{i:04d}"]
+        return tuple(out)
+
+    def _series_column(self, values, ctx, delay_accum, name):
+        """d series / d amplitude: the sinusoid at this term's
+        frequency, with tau exactly as ``series`` builds it."""
+        tau = ctx["t_days"] - delay_accum / SECS_PER_DAY
+        i = int(name[-4:])
+        arg = 2.0 * jnp.pi * values[f"{self.px}FREQ_{i:04d}"] * tau
+        kind = name[len(self.px):len(self.px) + 3]
+        return jnp.sin(arg) if kind == "SIN" else jnp.cos(arg)
+
+    def _amp_scale(self, values, ctx, col):
+        """Map a series column to a delay column (identity: WaveX)."""
+        return col
+
+    def d_delay_d_param(self, values, batch, ctx, delay_accum, name):
+        return self._amp_scale(
+            values, ctx, self._series_column(values, ctx, delay_accum,
+                                             name))
+
 
 class WaveX(_FourierBase):
     """Achromatic Fourier delay — the unbiased alternative to the legacy
@@ -137,6 +166,13 @@ class DMWaveX(_FourierBase):
         dm = self.series(values, ctx, delay_accum)
         return DM_CONST * dm / ctx["bfreq"] ** 2
 
+    def _amp_scale(self, values, ctx, col):
+        return DM_CONST * col / ctx["bfreq"] ** 2
+
+    def d_dm_d_param(self, values, batch, ctx, name):
+        # dm_value evaluates the series at zero accumulated delay
+        return self._series_column(values, ctx, 0.0, name)
+
 
 class CMWaveX(_FourierBase):
     """Fourier chromatic-measure variation (reference: cmwavex.py:14);
@@ -169,3 +205,6 @@ class CMWaveX(_FourierBase):
     def delay(self, values, batch, ctx, delay_accum):
         cm = self.series(values, ctx, delay_accum)
         return DM_CONST * cm * ctx["bfreq"] ** (-values["TNCHROMIDX"])
+
+    def _amp_scale(self, values, ctx, col):
+        return DM_CONST * col * ctx["bfreq"] ** (-values["TNCHROMIDX"])

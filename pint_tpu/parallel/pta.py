@@ -310,6 +310,22 @@ class PTABatch:
             for p in self.prepareds
         ]))
         self.n_pulsars = len(self.prepareds)
+        # hybrid design partition over the union free set (shared model
+        # structure, so one partition serves every pulsar); pinned
+        # params get their analytic columns zeroed by free_mask in the
+        # trace.  Frozen-delay precompute is NOT applied on the batched
+        # path: per-pulsar frozen leaves would need their own stacking
+        # rule, and the union free set usually keeps the chain live.
+        from pint_tpu.models.timing_model import hybrid_design_default
+
+        p0 = self.prepareds[0]
+        if hybrid_design_default():
+            self._partition = p0.design_partition(self.free_names)
+            self._partition_wb = p0.design_partition(self.free_names,
+                                                     wideband=True)
+        else:
+            self._partition = self._partition_wb = \
+                ((), tuple(self.free_names))
         self.n_max = max(
             p.batch.ticks.shape[0] for p in self.prepareds
         )
@@ -331,6 +347,26 @@ class PTABatch:
         self.batch = jax.tree.map(
             lambda *xs: jnp.stack(xs), *batches
         )
+        # harmonize the static Kepler Newton depth across the batch:
+        # the stacked trace closes over ONE python int per component
+        # key, and a per-pulsar class mismatch (one circular MSP, one
+        # e=0.7 binary) would otherwise drop the key from the static
+        # ctx — deepening the shallow members to the batch max is
+        # exact, just marginally slower for them
+        depth = max((sub["kepler_iters"]
+                     for p in self.prepareds
+                     for m in (p.ctx, p.tzr_ctx) if m
+                     for sub in m.values()
+                     if isinstance(sub, dict) and "kepler_iters" in sub),
+                    default=0)
+        if depth:
+            for p in self.prepareds:
+                for m in (p.ctx, p.tzr_ctx):
+                    if not m:
+                        continue
+                    for sub in m.values():
+                        if isinstance(sub, dict) and "kepler_iters" in sub:
+                            sub["kepler_iters"] = depth
         ctxs = [
             _pad_ctx(p.ctx, p.batch.ticks.shape[0], self.n_max)
             for p in self.prepareds
@@ -365,15 +401,24 @@ class PTABatch:
         )
 
     # -- single-pulsar pure functions (vmapped below) -------------------------
-    def _resid_one(self, vec, base_values, batch, ctx, tzr_batch,
-                   tzr_ctx, valid, free_mask):
-        p0 = self.prepareds[0]
+    def _values_at(self, vec_or_sub, base_values, free_mask):
+        """The per-pulsar values dict at a free-parameter vector (or a
+        {name: value} dict): masked-out params stay pinned at this
+        pulsar's own value, making their design columns exactly zero."""
         values = dict(base_values)
         for i, name in enumerate(self.free_names):
-            # masked-out params stay pinned at this pulsar's own value,
-            # making their design columns exactly zero
-            values[name] = jnp.where(free_mask[i], vec[i],
+            v = (vec_or_sub[name] if isinstance(vec_or_sub, dict)
+                 else vec_or_sub[i])
+            values[name] = jnp.where(free_mask[i], v,
                                      base_values[name])
+        return values
+
+    def _resid_one_values(self, values, batch, ctx, tzr_batch,
+                          tzr_ctx, valid):
+        """Mean-subtracted, pad-masked time residuals for one pulsar at
+        a prebuilt values dict (the core both the residual function and
+        the hybrid design build evaluate)."""
+        p0 = self.prepareds[0]
         ctx = _merge_ctx(ctx, self.static_ctx)
         n, frac = p0._phase_sum(values, batch, ctx)
         if tzr_batch is not None:
@@ -391,6 +436,77 @@ class PTABatch:
         w = jnp.where(valid, 1.0 / sigma**2, 0.0)
         mean = jnp.sum(resid * w) / jnp.sum(w)
         return jnp.where(valid, resid - mean, 0.0)
+
+    def _resid_one(self, vec, base_values, batch, ctx, tzr_batch,
+                   tzr_ctx, valid, free_mask):
+        return self._resid_one_values(
+            self._values_at(vec, base_values, free_mask), batch, ctx,
+            tzr_batch, tzr_ctx, valid)
+
+    def _linear_cols_one(self, values, batch, ctx, tzr_batch, tzr_ctx,
+                         valid, free_mask, lin):
+        """Closed-form (n_max, L) time-residual design columns for one
+        pulsar — the batched counterpart of Residuals.linear_design_at:
+        TZR column subtraction, /F0, the valid-masked weighted mean,
+        pad-row zeroing, and the free-mask pinning (a masked-out
+        parameter's column is exactly zero, same as the jacfwd of the
+        ``where``-pinned residual)."""
+        p0 = self.prepareds[0]
+        merged = _merge_ctx(ctx, self.static_ctx)
+        cols = p0.linear_phase_columns(values, batch, merged, lin)
+        if tzr_batch is not None:
+            tz = _merge_ctx(tzr_ctx, self.static_tzr_ctx)
+            tcols = p0.linear_phase_columns(values, tzr_batch, tz, lin)
+            cols = cols - tcols[0:1, :]
+        cols = cols / values["F0"]
+        sigma = self._sigma_one(values, batch, merged)
+        w = jnp.where(valid, 1.0 / sigma**2, 0.0)
+        cols = cols - jnp.sum(cols * w[:, None], axis=0) / jnp.sum(w)
+        cols = jnp.where(valid[:, None], cols, 0.0)
+        lin_idx = jnp.asarray([self.free_names.index(p) for p in lin])
+        return cols * free_mask[lin_idx][None, :]
+
+    def _rj_one(self, vec, base_values, batch, ctx, tzr_batch, tzr_ctx,
+                valid, free_mask, dm_extra=None):
+        """Hybrid (r, J) for one pulsar (fitter.resid_and_design over
+        the union free set).  dm_extra = (dm_data, dm_error, dm_valid)
+        switches to the stacked wideband [time; DM] system."""
+        from pint_tpu.fitter import resid_and_design
+
+        partition = (self._partition if dm_extra is None
+                     else self._partition_wb)
+
+        def resid_of(sub):
+            values = self._values_at(sub, base_values, free_mask)
+            r_t = self._resid_one_values(values, batch, ctx, tzr_batch,
+                                         tzr_ctx, valid)
+            if dm_extra is None:
+                return r_t
+            dm_data, _dm_error, dm_valid = dm_extra
+            merged = _merge_ctx(ctx, self.static_ctx)
+            r_dm = self._dm_resid_one(values, batch, merged, dm_data,
+                                      dm_valid)
+            return jnp.concatenate([r_t, r_dm])
+
+        def linear_of(sub):
+            values = self._values_at(sub, base_values, free_mask)
+            lin = partition[0]
+            cols = self._linear_cols_one(values, batch, ctx, tzr_batch,
+                                         tzr_ctx, valid, free_mask, lin)
+            if dm_extra is None:
+                return cols
+            _dm_data, _dm_error, dm_valid = dm_extra
+            merged = _merge_ctx(ctx, self.static_ctx)
+            p0 = self.prepareds[0]
+            dmc = -p0.linear_dm_columns(values, batch, merged, lin)
+            dmc = jnp.where(dm_valid[:, None], dmc, 0.0)
+            lin_idx = jnp.asarray(
+                [self.free_names.index(p) for p in lin])
+            dmc = dmc * free_mask[lin_idx][None, :]
+            return jnp.concatenate([cols, dmc], axis=0)
+
+        return resid_and_design(tuple(self.free_names), vec, partition,
+                                resid_of, linear_of)
 
     def _sigma_one(self, values, batch, ctx):
         """Noise-scaled per-TOA sigma for ONE pulsar's (batch, ctx) —
@@ -429,21 +545,26 @@ class PTABatch:
                 free_mask,
             )
 
+        def rj(v):
+            return self._rj_one(v, base_values, batch, ctx, tzr_batch,
+                                tzr_ctx, valid, free_mask)
+
         def body(carry, _):
             vec, _ = carry
             new_vec, chi2, dpar, cov = wls_gn_solve(
-                resid_fn, vec, err, rcond=guard_eps)
+                None, vec, err, rcond=guard_eps, rj=rj(vec))
             return (new_vec, chi2), None
 
         (vec, _), _ = jax.lax.scan(
             body, (vec0, jnp.float64(0.0)), None, length=maxiter
         )
         if not with_health:
-            _, chi2, _, cov = wls_gn_solve(resid_fn, vec, err,
-                                           rcond=guard_eps)
+            _, chi2, _, cov = wls_gn_solve(None, vec, err,
+                                           rcond=guard_eps, rj=rj(vec))
             return vec, chi2, cov, ()
         _, chi2, dpar, cov, diag = wls_gn_solve(
-            resid_fn, vec, err, rcond=guard_eps, with_health=True)
+            None, vec, err, rcond=guard_eps, with_health=True,
+            rj=rj(vec))
         health = self._step_health_one(resid_fn, vec, err, sigma, chi2,
                                        dpar, cov, diag, batch, valid)
         return vec, chi2, cov, health
@@ -493,10 +614,13 @@ class PTABatch:
                 free_mask,
             )
 
+        def rj(v):
+            return self._rj_one(v, base_values, batch, ctx, tzr_batch,
+                                tzr_ctx, valid, free_mask)
+
         def body(carry, _):
             vec, _ = carry
-            r = resid_fn(vec)
-            J = jax.jacfwd(resid_fn)(vec)
+            r, J = rj(vec)
             dpar, cov, _, chi2 = gls_normal_solve(
                 r, J, err, U, phi, guard_eps=guard_eps)
             return (vec + dpar, chi2), None
@@ -504,8 +628,7 @@ class PTABatch:
         (vec, _), _ = jax.lax.scan(
             body, (vec0, jnp.float64(0.0)), None, length=maxiter
         )
-        r = resid_fn(vec)
-        J = jax.jacfwd(resid_fn)(vec)
+        r, J = rj(vec)
         if not with_health:
             _, cov, ncoef, chi2 = gls_normal_solve(
                 r, J, err, U, phi, guard_eps=guard_eps)
@@ -577,22 +700,14 @@ class PTABatch:
         U_wb = jnp.concatenate(
             [U, jnp.zeros((dm_data.shape[0], U.shape[1]))], axis=0)
 
-        def resid_fn(v):
-            values = dict(base_values)
-            for i, name in enumerate(self.free_names):
-                values[name] = jnp.where(free_mask[i], v[i],
-                                         base_values[name])
-            r_t = self._resid_one(
-                v, base_values, batch, ctx, tzr_batch, tzr_ctx, valid,
-                free_mask)
-            r_dm = self._dm_resid_one(values, batch, merged, dm_data,
-                                      dm_valid)
-            return jnp.concatenate([r_t, r_dm])
+        def rj(v):
+            return self._rj_one(v, base_values, batch, ctx, tzr_batch,
+                                tzr_ctx, valid, free_mask,
+                                dm_extra=(dm_data, dm_error, dm_valid))
 
         def body(carry, _):
             vec, _ = carry
-            r = resid_fn(vec)
-            J = jax.jacfwd(resid_fn)(vec)
+            r, J = rj(vec)
             dpar, cov, _, chi2 = gls_normal_solve(
                 r, J, err, U_wb, phi, guard_eps=guard_eps)
             return (vec + dpar, chi2), None
@@ -600,8 +715,7 @@ class PTABatch:
         (vec, _), _ = jax.lax.scan(
             body, (vec0, jnp.float64(0.0)), None, length=maxiter
         )
-        r = resid_fn(vec)
-        J = jax.jacfwd(resid_fn)(vec)
+        r, J = rj(vec)
         if not with_health:
             _, cov, _, chi2 = gls_normal_solve(
                 r, J, err, U_wb, phi, guard_eps=guard_eps)
@@ -626,6 +740,9 @@ class PTABatch:
                 _cc.model_structure_key(self.prepareds[0].model),
                 tuple(self.free_names), self.n_pulsars, self.n_max,
                 self.tzr_batch is not None, self.tzr_ctx is not None,
+                # the hybrid design partition changes the traced
+                # per-pulsar step (which columns are analytic)
+                self._partition, self._partition_wb,
                 _cc.static_ctx_key(self.static_ctx),
                 _cc.static_ctx_key(self.static_tzr_ctx),
             ))
@@ -688,14 +805,18 @@ class PTABatch:
         one XLA program — the batched counterpart of
         WidebandTOAFitter (reference fitter.py:2292-2640).  Sharding
         semantics match fit_wls."""
-        U, phi = self._gather_noise()
-        dm_data, dm_error, dm_valid = self._gather_dm()
-        fit = self._batched_fit_jit("wideband", maxiter)
-        return self._run_batched(
-            fit, (self.values0, self.base_values, self.batch, self.ctx,
-                  self.tzr_batch, self.tzr_ctx, self.valid,
-                  self.free_mask, U, phi, dm_data, dm_error, dm_valid),
-            mesh, checkpoint)
+        while True:
+            U, phi = self._gather_noise()
+            dm_data, dm_error, dm_valid = self._gather_dm()
+            fit = self._batched_fit_jit("wideband", maxiter)
+            out = self._run_batched(
+                fit, (self.values0, self.base_values, self.batch,
+                      self.ctx, self.tzr_batch, self.tzr_ctx,
+                      self.valid, self.free_mask, U, phi, dm_data,
+                      dm_error, dm_valid),
+                mesh, checkpoint, n_lin=len(self._partition_wb[0]))
+            if not self._kepler_depth_guard():
+                return out
 
     def fit_gls(self, maxiter=3, mesh=None, checkpoint=None):
         """Batched GLS fit: every pulsar's timing parameters against
@@ -703,27 +824,36 @@ class PTABatch:
         the current noise values), the whole PTA as one XLA program —
         replacing the reference's per-pulsar GLSFitter process fan-out
         (gridutils.py:166-391).  Sharding semantics match fit_wls."""
-        U, phi = self._gather_noise()
-        fit = self._batched_fit_jit("gls", maxiter)
-        return self._run_batched(
-            fit, (self.values0, self.base_values, self.batch, self.ctx,
-                  self.tzr_batch, self.tzr_ctx, self.valid,
-                  self.free_mask, U, phi), mesh, checkpoint)
+        while True:
+            U, phi = self._gather_noise()
+            fit = self._batched_fit_jit("gls", maxiter)
+            out = self._run_batched(
+                fit, (self.values0, self.base_values, self.batch,
+                      self.ctx, self.tzr_batch, self.tzr_ctx,
+                      self.valid, self.free_mask, U, phi),
+                mesh, checkpoint)
+            if not self._kepler_depth_guard():
+                return out
 
-    def _run_batched(self, fit, args, mesh, checkpoint=None):
+    def _run_batched(self, fit, args, mesh, checkpoint=None,
+                     n_lin=None):
         """Run the jitted batched fit (optionally mesh-sharded over the
         pulsar axis) and write fitted values back (only genuinely-free
-        params)."""
+        params).  n_lin: analytic-column count of the partition the
+        traced step actually uses (structure-aware FLOP accounting —
+        the wideband step follows _partition_wb, not _partition)."""
         with span("pta.batched_fit", n_pulsars=self.n_pulsars,
                   n_max=self.n_max, n_free=len(self.free_names),
                   sharded=mesh is not None):
-            return self._run_batched_inner(fit, args, mesh, checkpoint)
+            return self._run_batched_inner(fit, args, mesh, checkpoint,
+                                           n_lin=n_lin)
 
     #: batched-path ladder: same escalation table as the
     #: single-pulsar fitters
     _guard_jitter_rungs = _guard.JITTER_RUNGS
 
-    def _run_batched_inner(self, fit, args, mesh, checkpoint=None):
+    def _run_batched_inner(self, fit, args, mesh, checkpoint=None,
+                           n_lin=None):
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -787,7 +917,9 @@ class PTABatch:
             "fit.flops_est",
             _flops.pta_batch_flops(
                 self.n_pulsars, self.n_max, len(self.free_names),
-                self._noise_basis_width()))
+                self._noise_basis_width(),
+                n_lin=(len(self._partition[0]) if n_lin is None
+                       else n_lin)))
         bad_idx = [] if bad is None else list(np.flatnonzero(bad))
         for k, p in enumerate(self.prepareds):
             if k in bad_idx:
@@ -837,6 +969,68 @@ class PTABatch:
             int(np.shape(p.noise_basis)[1]) for p in self.prepareds
         )
 
+    def _kepler_depth_guard(self):
+        """Batched counterpart of ``Fitter._kepler_depth_guard``:
+        after write-back, re-derive every pulsar's eccentricity reach
+        at the FITTED values; when any member crossed its prepare-time
+        class, the whole batch deepens to the new harmonized max (the
+        stacked trace closes over ONE static depth per component key)
+        and the caller must rerun the fit — the previous solution came
+        from a too-shallow Newton unroll.  Bounded: the depth is
+        monotone over four classes."""
+        from pint_tpu.models.binary.kepler import newton_iters_for
+
+        reaches = [r for r in (p.kepler_ecc_reach()
+                               for p in self.prepareds)
+                   if r != float("-inf")]
+        if not reaches:
+            return False
+        # NaN reach (unset ECC) sorts to the full unroll
+        worst = max(reaches, key=newton_iters_for)
+        # via the Residuals wrappers so their own ctx splits re-key too;
+        # list first — any() would short-circuit the remaining members
+        changed = [r.ensure_kepler_depth(worst) for r in self.resids]
+        if not any(changed):
+            return False
+        telemetry.counter_add("pta.kepler_depth_refits")
+        import warnings
+
+        warnings.warn(
+            "batched fit moved an eccentricity reach to %.3g — past "
+            "the prepare-time Kepler depth class; deepening the "
+            "Newton unroll and refitting the batch" % worst)
+        self._restack_after_depth_change()
+        return True
+
+    def _restack_after_depth_change(self):
+        """Rebuild the stacked ctx pytrees (and their static split)
+        after ``ensure_kepler_depth`` mutated the per-pulsar ctxs,
+        refresh the starting values from the written-back models, and
+        drop every structure-keyed cache — the deeper unroll is a
+        different traced program."""
+        ctxs = [
+            _pad_ctx(p.ctx, p.batch.ticks.shape[0], self.n_max)
+            for p in self.prepareds
+        ]
+        self.ctx, self.static_ctx = _stack_ctxs(ctxs)
+        if self.tzr_ctx is not None:
+            self.tzr_ctx, self.static_tzr_ctx = _stack_ctxs(
+                [p.tzr_ctx for p in self.prepareds]
+            )
+        self.values0 = jnp.asarray(np.array([
+            [float(p.model.values[n]) for n in self.free_names]
+            for p in self.prepareds
+        ]))
+        self._full_values = [
+            p._values_pytree() for p in self.prepareds
+        ]
+        self.base_values = jax.tree.map(
+            lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
+            *self._full_values,
+        )
+        self._structure_key_cached = None
+        self._fit_jit_cache = {}
+
     # -- public API -----------------------------------------------------------
     def residuals(self, values=None):
         """(n_pulsars, n_max) padded time residuals, zero where
@@ -860,11 +1054,14 @@ class PTABatch:
         checkpoint: optional path — fitted values are atomic-written
         after the fit (guard.save_checkpoint), validated on restore
         against this batch's structure fingerprint."""
-        fit = self._batched_fit_jit("wls", maxiter)
-        return self._run_batched(
-            fit, (self.values0, self.base_values, self.batch, self.ctx,
-                  self.tzr_batch, self.tzr_ctx, self.valid,
-                  self.free_mask), mesh, checkpoint)
+        while True:
+            fit = self._batched_fit_jit("wls", maxiter)
+            out = self._run_batched(
+                fit, (self.values0, self.base_values, self.batch,
+                      self.ctx, self.tzr_batch, self.tzr_ctx,
+                      self.valid, self.free_mask), mesh, checkpoint)
+            if not self._kepler_depth_guard():
+                return out
 
     # -- checkpoint/resume ----------------------------------------------------
     def _checkpoint_fingerprint(self):

@@ -32,14 +32,26 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
+import os
+
 from pint_tpu import compile_cache as _cc
 from pint_tpu import faults as _faults
 from pint_tpu import telemetry
-from pint_tpu.linalg import woodbury_chi2_logdet
-from pint_tpu.models.timing_model import PreparedModel, TimingModel
+from pint_tpu.linalg import (StructuredU, structured_from_dense_blocks,
+                             woodbury_chi2_logdet)
+from pint_tpu.models.timing_model import PreparedModel, TimingModel, \
+    _env_on
 from pint_tpu.telemetry import span
 
-__all__ = ["Residuals", "WidebandDMResiduals", "WidebandTOAResiduals"]
+__all__ = ["Residuals", "WidebandDMResiduals", "WidebandTOAResiduals",
+           "segment_ecorr_default"]
+
+
+def segment_ecorr_default() -> bool:
+    """Whether eligible ECORR bases are carried as segment ids
+    (``$PINT_TPU_SEGMENT_ECORR``, default on; 0/off forces the dense
+    fallback everywhere)."""
+    return _env_on("PINT_TPU_SEGMENT_ECORR")
 
 #: weight given to the synthetic constant-offset basis column when the
 #: mean is subtracted (reference residuals.py:583-585 uses 1e40; we use
@@ -110,6 +122,18 @@ class Residuals:
         if self.subtract_mean:
             U = jnp.concatenate([U, jnp.ones((U.shape[0], 1))], axis=1)
         self._U_ext = U
+        # structure-aware ECORR: when the model's single EcorrNoise
+        # block is a disjoint 0/1 epoch-indicator matrix (each TOA in
+        # at most one epoch — always true of create_quantization_matrix
+        # output under disjoint selects), carry it as per-TOA segment
+        # ids so the Woodbury contractions run through segment_sum
+        # instead of dense (N, K_e) matmuls.  Overlapping masks, a
+        # non-indicator basis, multiple ECORR components, or the env
+        # gate keep the dense fallback.
+        su = self._build_structured_U(U) if segment_ecorr_default() \
+            else None
+        if su is not None:
+            self._U_ext = su
         # TOA-count bucketing (compile_cache.pad_toas): sentinel rows
         # beyond n_real carry ~zero weight; dof/NTOA/lnlike accounting
         # uses the real count, and the lnlike logdet masks pad rows
@@ -135,6 +159,27 @@ class Residuals:
         # touch only one of the four, and a second same-structure
         # Residuals must reuse the first one's traces
         self._jit_cache: dict = {}
+
+    def _build_structured_U(self, U_ext):
+        """StructuredU over the dense extended basis, or None when the
+        dataset/model is ineligible (dense fallback)."""
+        ecorrs = [c for c in self.prepared._noise_basis_comps
+                  if getattr(c, "category", "") == "ecorr_noise"]
+        if len(ecorrs) != 1:
+            return None
+        dims = self.prepared.noise_dimensions()
+        start, nb = dims[type(ecorrs[0]).__name__]
+        if nb == 0:
+            return None
+        B = np.asarray(U_ext[:, start:start + nb])
+        if not np.isin(B, (0.0, 1.0)).all():
+            return None
+        rowsum = B.sum(axis=1)
+        if rowsum.max(initial=0.0) > 1.0:
+            return None  # overlapping epochs: dense fallback
+        seg = np.where(rowsum > 0, B.argmax(axis=1), nb)
+        return structured_from_dense_blocks(
+            U_ext[:, :start], seg, nb, U_ext[:, start + nb:])
 
     # -- dataset pytree / structural identity --------------------------------
     def _data(self):
@@ -175,6 +220,10 @@ class Residuals:
                 self._pulse_numbers is not None,
                 self._delta_pn is not None,
                 self._pad_valid is not None,
+                # segment-ECORR vs dense basis changes every Woodbury
+                # trace; two same-model datasets can differ (epoch
+                # overlap forces the dense fallback on one)
+                isinstance(self._U_ext, StructuredU),
                 _cc.static_ctx_key(self._ctx_static),
                 _cc.static_ctx_key(self._tzr_ctx_static),
             ))
@@ -187,6 +236,29 @@ class Residuals:
         if data["tzr_ctx"] is None:
             return None
         return _cc.merge_ctx(data["tzr_ctx"], self._tzr_ctx_static)
+
+    def ensure_kepler_depth(self, ecc_max):
+        """Raise the binary ctx's static Kepler Newton depth to cover
+        ``ecc_max`` (NaN -> full unroll; see
+        PreparedModel.ensure_kepler_depth) and, when anything changed,
+        re-split the ctx and drop the cached structure key / jit
+        wrappers — the deeper unroll is a different traced program and
+        must re-key every shared trace.  Returns True on change."""
+        if not self.prepared.ensure_kepler_depth(ecc_max):
+            return False
+        self._rekey_after_ctx_change()
+        return True
+
+    def _rekey_after_ctx_change(self):
+        """Re-split the (mutated) prepared ctx and drop every
+        structure-keyed cache."""
+        self._ctx_dyn, self._ctx_static = _cc.split_ctx(
+            self.prepared.ctx)
+        self._tzr_ctx_dyn, self._tzr_ctx_static = _cc.split_ctx(
+            self.prepared.tzr_ctx)
+        self._data_cached = None
+        self._structure_key_cached = None
+        self._jit_cache = {}
 
     def _jitted(self, name, fn):
         got = self._jit_cache.get(name)
@@ -221,9 +293,14 @@ class Residuals:
             values, batch=data["batch"], ctx=self._ctx_at(data))
 
     def phase_resids_at(self, values, data):
+        # data may carry precomputed frozen-component delays (the fit
+        # hot path's "frozen"/"tzr_frozen" leaves; accessor datasets
+        # don't) — the chain folds them in as data at their position
         n, frac = self.prepared._phase_raw_at(
             values, data["batch"], self._ctx_at(data),
-            data["tzr_batch"], self._tzr_ctx_at(data))
+            data["tzr_batch"], self._tzr_ctx_at(data),
+            frozen=data.get("frozen"),
+            tzr_frozen=data.get("tzr_frozen"))
         if self._pulse_numbers is not None:
             # TRACK -2 semantics (reference residuals.py:368-392):
             # residual = absolute model phase - assigned pulse number;
@@ -248,6 +325,34 @@ class Residuals:
 
     def time_resids_at(self, values, data):
         return self.phase_resids_at(values, data) / values["F0"]
+
+    def linear_design_at(self, values, data, names):
+        """(N, L) time-residual design columns for the phase-linear
+        parameters ``names`` — the analytic half of the hybrid design
+        matrix (see PreparedModel.design_partition).  Applies exactly
+        the transformations ``jacfwd`` of time_resids_at would: the TZR
+        column subtraction, the /F0 turns-to-seconds conversion, and
+        the (weighted-)mean subtraction with parameter-independent
+        weights.  Honors the same frozen-delay data leaves as the
+        residual evaluation."""
+        prep = self.prepared
+        cols = prep.linear_phase_columns(
+            values, data["batch"], self._ctx_at(data), names,
+            frozen=data.get("frozen"))
+        if data["tzr_batch"] is not None:
+            tcols = prep.linear_phase_columns(
+                values, data["tzr_batch"], self._tzr_ctx_at(data),
+                names, frozen=data.get("tzr_frozen"))
+            cols = cols - tcols[0:1, :]
+        cols = cols / values["F0"]
+        if self.subtract_mean:
+            if self.use_weighted_mean:
+                w = 1.0 / self.sigma_at(values, data) ** 2
+                cols = cols - jnp.sum(cols * w[:, None], axis=0) \
+                    / jnp.sum(w)
+            else:
+                cols = cols - jnp.mean(cols, axis=0)
+        return cols
 
     def _noise_basis_phi_at(self, values, data):
         """(U, phi) for the Woodbury paths, with the mean-offset column
@@ -369,6 +474,14 @@ class Residuals:
         return np.asarray(self.sigma_fn(self._values()))
 
     @property
+    def ecorr_segment_cols(self):
+        """Epoch count carried through segment-sums (0 on the dense
+        fallback) — feeds the structure-aware FLOP cost model."""
+        if isinstance(self._U_ext, StructuredU):
+            return int(self._U_ext.eslot.shape[0])
+        return 0
+
+    @property
     def dof(self):
         return self.n_real - len(self.model.free_params) - int(
             self.subtract_mean
@@ -482,6 +595,21 @@ class WidebandDMResiduals:
     def _ctx_at(self, data):
         return _cc.merge_ctx(data["ctx"], self._ctx_static)
 
+    def ensure_kepler_depth(self, ecc_max):
+        """Wideband counterpart of Residuals.ensure_kepler_depth (no
+        TZR ctx on this layout)."""
+        if not self.prepared.ensure_kepler_depth(ecc_max):
+            return False
+        self._rekey_after_ctx_change()
+        return True
+
+    def _rekey_after_ctx_change(self):
+        self._ctx_dyn, self._ctx_static = _cc.split_ctx(
+            self.prepared.ctx)
+        self._data_cached = None
+        self._structure_key_cached = None
+        self._jit_cache = {}
+
     def _jitted(self, name, fn):
         got = self._jit_cache.get(name)
         if got is None:
@@ -508,6 +636,22 @@ class WidebandDMResiduals:
             w = 1.0 / sig**2
             r = r - jnp.sum(r * w) / jnp.sum(w)
         return r
+
+    def linear_dm_design_at(self, values, data, names):
+        """(n_valid, L) DM-residual design columns for the phase-linear
+        parameters — the DM block of the wideband hybrid design.
+        dm_resid = measured - modeled, so the column is minus the
+        modeled-DM derivative; parameters without a dm_value
+        contribution get exact zero columns."""
+        cols = -self.prepared.linear_dm_columns(
+            values, data["batch"], self._ctx_at(data), names)
+        cols = cols[data["valid_idx"]]
+        if self.subtract_mean:
+            sig = self.sigma_at(values, data)
+            w = 1.0 / sig**2
+            cols = cols - jnp.sum(cols * w[:, None], axis=0) \
+                / jnp.sum(w)
+        return cols
 
     def chi2_at(self, values, data):
         r = self.dm_resids_at(values, data)
@@ -577,6 +721,19 @@ class WidebandTOAResiduals:
         return repr(("wb", self.toa._structure_key(),
                      self.dm._structure_key()))
 
+    def ensure_kepler_depth(self, ecc_max):
+        """Stacked-layout counterpart of
+        Residuals.ensure_kepler_depth: ONE mutation of the shared
+        PreparedModel, then BOTH blocks re-key.  (Forwarding to the
+        blocks' own ``ensure_kepler_depth`` would short-circuit the
+        second — the shared prepared reports the change only once.)"""
+        if not self.prepared.ensure_kepler_depth(ecc_max):
+            return False
+        self.toa._rekey_after_ctx_change()
+        self.dm._rekey_after_ctx_change()
+        self._jit_cache = {}
+        return True
+
     def chi2_at(self, values, data):
         return (self.toa.chi2_at(values, data["toa"])
                 + self.dm.chi2_at(values, data["dm"]))
@@ -606,6 +763,12 @@ class WidebandTOAResiduals:
                 self.chi2_at, key=("residuals", "chi2",
                                    self._structure_key()))
         return float(got(self._values(), self._data()))
+
+    @property
+    def ecorr_segment_cols(self):
+        """Structure-aware FLOP accounting: the time block's segment
+        ECORR column count (the DM block sees no noise basis)."""
+        return self.toa.ecorr_segment_cols
 
     @property
     def dof(self):

@@ -1,0 +1,649 @@
+"""Structure-aware hot path tests (ISSUE 5).
+
+Covers the three structures the fit stack now exploits:
+
+1. hybrid analytic/AD design matrix — analytic columns pinned against
+   full ``jacfwd`` at <= 1e-12 relative across the component zoo
+   (isolated, ELL1, DD, DDK, wideband, JUMP/FD/WaveX), partition rules
+   (accum-readers block upstream linearity, frozen readers unblock it);
+2. frozen-delay precompute — refit correctness when a frozen component
+   gains a free parameter (partition re-keys, no stale columns) and
+   when a frozen parameter is edited between fits (leaves re-fold);
+   frozen-noise leaves (sigma/phi/gram) refresh on noise-value edits;
+3. segment-sum ECORR — StructuredU contractions brute-force-verified
+   against the dense basis, end-to-end chi^2/fit equality vs the
+   dense fallback, plus the constant-gram normal-equation fast path.
+
+Zero-recompile + guard-health regressions on all three paths ride the
+telemetry compile counter (compile_cache contract).  All CPU,
+tier-1-fast.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pint_tpu import telemetry
+from pint_tpu.fitter import GLSFitter, WLSFitter
+from pint_tpu.models.builder import get_model
+from pint_tpu.simulation import make_fake_toas_fromMJDs, \
+    make_fake_toas_uniform
+
+BASE = """PSR TSTDESIGN
+RAJ 18:57:36.39
+DECJ 09:43:17.2
+PMRA -2.9
+PMDEC -5.4
+PX 0.9
+F0 186.494 1
+F1 -6.2e-16 1
+PEPOCH 54000
+DM 13.3 1
+TZRMJD 54000
+TZRSITE @
+TZRFRQ 1400
+UNITS TDB
+EPHEM builtin
+"""
+
+#: the hybrid==jacfwd acceptance pin (relative, per column, scaled by
+#: the column's max magnitude)
+PIN = 1e-12
+
+ZOO = {
+    "isolated": "",
+    "jump_fd_wave": ("JUMP -f L-wide 1e-5 1\nFD1 1e-5 1\nFD2 -2e-6 1\n"
+                     "WXEPOCH 54000\nWXFREQ_0001 0.001\n"
+                     "WXSIN_0001 1e-6 1\nWXCOS_0001 2e-6 1\n"),
+    "ELL1": ("BINARY ELL1\nPB 5.7410 1\nA1 3.3667 1\nTASC 53900.1234 1\n"
+             "EPS1 1.2e-5 1\nEPS2 -3.4e-6 1\nM2 0.25\nSINI 0.97\n"),
+    "DD": ("BINARY DD\nPB 10.5 1\nA1 8.2 1\nT0 53900.5 1\nECC 0.31 1\n"
+           "OM 110.0 1\nOMDOT 0.01\nGAMMA 0.002\nM2 0.3 1\nSINI 0.9 1\n"),
+    "DDK": ("BINARY DDK\nPB 10.5 1\nA1 8.2 1\nT0 53900.5 1\nECC 0.31 1\n"
+            "OM 110.0 1\nM2 0.3\nKIN 71.0\nKOM 107.0\n"),
+}
+
+GLS_EXTRA = ("EFAC -f L-wide 1.1\nEQUAD -f L-wide 0.3\n"
+             "ECORR -f L-wide 0.5\n"
+             "TNRedAmp -13.5\nTNRedGam 3.3\nTNRedC 5\n")
+
+
+def _toas(model, n=80, seed=0, clustered=False, **kw):
+    if clustered:
+        # 4 TOAs within ~0.1 s per observing epoch: real ECORR epochs
+        # for create_quantization_matrix (dt = 1 s, nmin = 2)
+        epochs = np.linspace(53800.0, 54600.0, n // 4)
+        mjds = np.repeat(epochs, 4) + np.tile(
+            np.arange(4) * 0.1 / 86400.0, n // 4)
+        return make_fake_toas_fromMJDs(
+            mjds, model, freq_mhz=1400.0, obs="gbt", error_us=1.0,
+            add_noise=True, rng=np.random.default_rng(seed),
+            flags={"f": "L-wide"}, **kw)
+    return make_fake_toas_uniform(
+        53800.0, 54600.0, n, model, freq_mhz=1400.0, obs="gbt",
+        error_us=1.0, add_noise=True, rng=np.random.default_rng(seed),
+        flags={"f": "L-wide"}, **kw)
+
+
+def _design_pair(fitter):
+    """(J_hybrid, J_dense_jacfwd) at the fitter's current values."""
+    vec = jnp.asarray([fitter.model.values[p]
+                       for p in fitter._traced_free])
+    base = fitter.prepared._values_pytree()
+    data = fitter._fit_data
+    _, J = fitter._rj(vec, base, data)
+
+    free = fitter._traced_free
+
+    def resid_fn(v):
+        values = dict(base)
+        for i, name in enumerate(free):
+            values[name] = v[i]
+        return fitter.resids.time_resids_at(values, data)
+
+    J_dense = jax.jacfwd(resid_fn)(vec)
+    return np.asarray(J), np.asarray(J_dense)
+
+
+def _max_rel(J, J_dense):
+    scale = np.abs(J_dense).max(axis=0)
+    return float((np.abs(J - J_dense)
+                  / np.maximum(scale, 1e-300)).max())
+
+
+class TestHybridZoo:
+    @pytest.mark.parametrize("family", sorted(ZOO))
+    def test_hybrid_matches_jacfwd(self, family):
+        model = get_model(BASE + ZOO[family])
+        toas = _toas(model)
+        f = WLSFitter(toas, model)
+        lin, nl = f._partition
+        J, J_dense = _design_pair(f)
+        assert _max_rel(J, J_dense) <= PIN, (lin, nl)
+
+    @pytest.mark.parametrize("family", ["isolated", "DD"])
+    def test_hybrid_matches_jacfwd_gls(self, family):
+        model = get_model(BASE + ZOO[family] + GLS_EXTRA)
+        toas = _toas(model, clustered=True)
+        f = GLSFitter(toas, model)
+        J, J_dense = _design_pair(f)
+        assert _max_rel(J, J_dense) <= PIN
+
+    def test_isolated_partition_has_linear_columns(self):
+        model = get_model(BASE)
+        f = WLSFitter(_toas(model), model)
+        lin, nl = f._partition
+        # no accum-reader in the chain: DM and F1 are analytic; F0
+        # stays AD (it divides the time-residual conversion)
+        assert "DM" in lin and "F1" in lin
+        assert "F0" in nl
+
+    def test_wideband_hybrid_matches_jacfwd(self):
+        from pint_tpu.fitter import WidebandTOAFitter
+
+        model = get_model(BASE.replace("UNITS TDB", "DMDATA 1\nUNITS TDB"))
+        n = 80
+        freqs = np.where(np.arange(n) % 2 == 0, 1400.0, 800.0)
+        toas = make_fake_toas_uniform(
+            53800.0, 54600.0, n, model, freq_mhz=freqs, obs="gbt",
+            error_us=1.0, add_noise=True,
+            rng=np.random.default_rng(0), wideband=True, dm_error=1e-4,
+            flags={"f": "L-wide"})
+        f = WidebandTOAFitter(toas, model)
+        lin, nl = f._partition
+        assert lin, "wideband partition found no analytic columns"
+        vec = jnp.asarray([model.values[p] for p in f._traced_free])
+        base = f.prepared._values_pytree()
+        data = f._fit_data
+        _, J = f._rj(vec, base, data)
+
+        free = f._traced_free
+        toa_r, dm_r = f.resids.toa, f.resids.dm
+
+        def resid_fn(v):
+            values = dict(base)
+            for i, name in enumerate(free):
+                values[name] = v[i]
+            return jnp.concatenate(
+                [toa_r.time_resids_at(values, data["toa"]),
+                 dm_r.dm_resids_at(values, data["dm"])])
+
+        J_dense = jax.jacfwd(resid_fn)(vec)
+        assert _max_rel(np.asarray(J), np.asarray(J_dense)) <= PIN
+
+
+class TestPartitionRules:
+    def test_accum_reader_blocks_upstream_linearity(self):
+        # a live binary AFTER the dispersion delay feeds a DM
+        # perturbation back through the orbital phase: DM must fall to
+        # the AD side
+        model = get_model(BASE + ZOO["DD"])
+        prep = model.prepare(_toas(model))
+        free = tuple(model.free_params)
+        lin, nl = prep.design_partition(free, frozen=())
+        assert "DM" in nl and "F1" in lin
+
+    def test_frozen_reader_prefix_rule(self):
+        model = get_model(BASE + ZOO["DD"])
+        prep = model.prepare(_toas(model))
+        # binary params frozen -> the binary is still an accum-reader
+        # BEHIND active components (DM free), so it must stay in the
+        # trace (not frozen), and DM stays nonlinear
+        frozen = prep.frozen_delay_split(("DM", "F0", "F1"))
+        assert "BinaryDD" not in frozen
+        assert "AstrometryEquatorial" in frozen
+        lin, nl = prep.design_partition(("DM", "F0", "F1"),
+                                        frozen=frozen)
+        assert "DM" in nl
+        # ...but with NO free delay parameter upstream of it, the
+        # whole chain prefix including the binary freezes, and the
+        # remaining free set is all-analytic except F0
+        frozen2 = prep.frozen_delay_split(("F0", "F1"))
+        assert "BinaryDD" in frozen2
+        lin2, _ = prep.design_partition(("F0", "F1"), frozen=frozen2)
+        assert "F1" in lin2
+
+    def test_shapiro_reader_tracks_free_astrometry(self):
+        # SolarSystemShapiro owns no fittable parameter but recomputes
+        # the pulsar direction from RAJ/DECJ inside delay()
+        # (reads_params): freezing it against free astrometry would
+        # serve a stale direction and drop d(Shapiro)/d(position) from
+        # the AD columns
+        model = get_model(BASE)
+        prep = model.prepare(_toas(model))
+        assert "SolarSystemShapiro" in prep.frozen_delay_split(
+            ("DM", "F0", "F1"))
+        model.params["RAJ"].frozen = False
+        frozen = prep.frozen_delay_split(tuple(model.free_params))
+        assert "SolarSystemShapiro" not in frozen
+        assert "AstrometryEquatorial" not in frozen
+
+    def test_hybrid_gate_off_is_all_ad(self, monkeypatch):
+        monkeypatch.setenv("PINT_TPU_HYBRID_DESIGN", "0")
+        model = get_model(BASE)
+        f = WLSFitter(_toas(model), model)
+        assert f._partition == ((), tuple(f._traced_free))
+
+
+class TestFrozenDelay:
+    def test_refit_after_unfreezing_frozen_component(self):
+        # RAJ's owner (astrometry) is frozen in the first fit; freeing
+        # RAJ must re-key the partition and produce the same result as
+        # a fresh fitter — never serve stale frozen leaves/columns
+        model = get_model(BASE)
+        toas = _toas(model, n=60)
+        f = WLSFitter(toas, model)
+        assert "AstrometryEquatorial" in f._frozen_names
+        f.fit_toas(maxiter=2)
+        model.params["RAJ"].frozen = False
+        f.fit_toas(maxiter=2)
+        assert "AstrometryEquatorial" not in f._frozen_names
+        assert "RAJ" in f._traced_free
+
+        model2 = get_model(BASE)
+        model2.params["RAJ"].frozen = False
+        f2 = WLSFitter(toas, model2)
+        f2.fit_toas(maxiter=2)
+        # two independent double-fit histories won't agree to roundoff;
+        # they must agree to fit precision
+        np.testing.assert_allclose(
+            model.values["RAJ"], model2.values["RAJ"], rtol=1e-9)
+
+    def test_frozen_param_edit_refreshes_leaves(self):
+        model = get_model(BASE)
+        toas = _toas(model, n=60)
+        f = WLSFitter(toas, model)
+        f.fit_toas(maxiter=2)
+        before = telemetry.counter_get("fitter.frozen_refreshes")
+        # edit a FROZEN parameter between fits: the precomputed delay
+        # leaves must re-fold (data refresh, not a retrace)
+        model.values["PX"] = 2.0
+        f.fit_toas(maxiter=2)
+        assert telemetry.counter_get("fitter.frozen_refreshes") \
+            == before + 1
+        chi2_frozen = float(f.resids.chi2)
+
+        model2 = get_model(BASE)
+        model2.values["PX"] = 2.0
+        f2 = WLSFitter(toas, model2)
+        f2.fit_toas(maxiter=2)
+        np.testing.assert_allclose(chi2_frozen, float(f2.resids.chi2),
+                                   rtol=1e-8)
+
+    def test_noise_param_edit_refreshes_leaves(self):
+        model = get_model(BASE + GLS_EXTRA)
+        toas = _toas(model, n=64, clustered=True)
+        f = GLSFitter(toas, model)
+        assert f._noise_frozen
+        f.fit_toas(maxiter=2)
+        before = telemetry.counter_get("fitter.noise_refreshes")
+        model.values["EFAC1"] = 1.7
+        f.fit_toas(maxiter=2)
+        assert telemetry.counter_get("fitter.noise_refreshes") \
+            == before + 1
+        chi2_leaf = float(f.resids.chi2)
+
+        model2 = get_model(BASE + GLS_EXTRA)
+        model2.values["EFAC1"] = 1.7
+        f2 = GLSFitter(toas, model2)
+        f2.fit_toas(maxiter=2)
+        np.testing.assert_allclose(chi2_leaf, float(f2.resids.chi2),
+                                   rtol=1e-8)
+
+    def test_noise_leaves_gated_by_fitter_class(self):
+        # only the GLS normal equations consume (phi, gram); the WLS
+        # step reads sigma alone — building/transferring the (K, K)
+        # gram on the WLS path would be pure waste
+        model = get_model(BASE + GLS_EXTRA)
+        toas = _toas(model, n=64, clustered=True)
+        g = GLSFitter(toas, model)
+        assert g._noise_frozen
+        assert "noise_gram" in g._fit_data and "noise_phi" in g._fit_data
+        w = WLSFitter(toas, get_model(BASE + GLS_EXTRA))
+        assert w._noise_frozen
+        assert "noise_sigma" in w._fit_data
+        assert "noise_gram" not in w._fit_data
+        assert "noise_phi" not in w._fit_data
+
+    def test_frozen_gate_off_matches_default(self, monkeypatch):
+        model = get_model(BASE + GLS_EXTRA)
+        toas = _toas(model, n=64, clustered=True)
+        f = GLSFitter(toas, model)
+        chi2_on = f.fit_toas(maxiter=3)
+
+        monkeypatch.setenv("PINT_TPU_FROZEN_DELAY", "0")
+        model2 = get_model(BASE + GLS_EXTRA)
+        f2 = GLSFitter(toas, model2)
+        assert f2._frozen_names == () and not f2._noise_frozen
+        chi2_off = f2.fit_toas(maxiter=3)
+        # the two paths order the same arithmetic differently (frozen
+        # fold + const gram vs one traced chain); 3 GN iterations
+        # amplify the roundoff, so the pin is fit-precision, not ulp
+        np.testing.assert_allclose(chi2_on, chi2_off, rtol=1e-6)
+        for p in f._traced_free:
+            np.testing.assert_allclose(
+                model.values[p], model2.values[p], rtol=1e-7,
+                err_msg=p)
+
+
+def _random_structured(rng, n=60, k_pre=3, k_e=7, k_post=2):
+    from pint_tpu.linalg import structured_from_dense_blocks
+
+    pre = rng.normal(size=(n, k_pre))
+    post = rng.normal(size=(n, k_post))
+    seg = rng.integers(0, k_e + 1, size=n)  # k_e = outside every epoch
+    return structured_from_dense_blocks(pre, seg, k_e, post)
+
+
+class TestStructuredU:
+    def test_contractions_match_dense(self):
+        from pint_tpu import linalg as L
+
+        rng = np.random.default_rng(3)
+        su = _random_structured(rng)
+        U = np.asarray(L.su_to_dense(su))
+        n, k = U.shape
+        y = rng.normal(size=n)
+        Y = rng.normal(size=(n, 4))
+        x = rng.normal(size=k)
+        X = rng.normal(size=(k, 3))
+        w = rng.uniform(0.5, 2.0, size=n)
+        np.testing.assert_allclose(L._ut_dot(su, y), U.T @ y,
+                                   rtol=1e-13, atol=1e-13)
+        np.testing.assert_allclose(L._ut_dot(su, Y), U.T @ Y,
+                                   rtol=1e-13, atol=1e-13)
+        np.testing.assert_allclose(L._u_dot(su, x), U @ x,
+                                   rtol=1e-13, atol=1e-13)
+        np.testing.assert_allclose(L._u_dot(su, X), U @ X,
+                                   rtol=1e-13, atol=1e-13)
+        np.testing.assert_allclose(L._weighted_gram(su, w),
+                                   (U.T * w[None, :]) @ U,
+                                   rtol=1e-13, atol=1e-13)
+
+    def test_woodbury_paths_match_dense(self):
+        from pint_tpu import linalg as L
+
+        rng = np.random.default_rng(4)
+        su = _random_structured(rng)
+        U = L.su_to_dense(su)
+        n, k = U.shape
+        r = rng.normal(size=n)
+        sigma = rng.uniform(0.5, 2.0, size=n)
+        phi = rng.uniform(0.1, 10.0, size=k)
+        c_s, l_s = L.woodbury_chi2_logdet(r, sigma, su, phi)
+        c_d, l_d = L.woodbury_chi2_logdet(r, sigma, U, phi)
+        np.testing.assert_allclose(c_s, c_d, rtol=1e-12)
+        np.testing.assert_allclose(l_s, l_d, rtol=1e-12)
+        np.testing.assert_allclose(
+            L.woodbury_solve(sigma, su, phi, r),
+            L.woodbury_solve(sigma, U, phi, r), rtol=1e-10, atol=1e-14)
+        # brute force: C = N + U Phi U^T
+        C = np.diag(sigma**2) + np.asarray(U) @ np.diag(phi) \
+            @ np.asarray(U).T
+        np.testing.assert_allclose(c_s, r @ np.linalg.solve(C, r),
+                                   rtol=1e-9)
+
+    def test_gls_normal_solve_matches_dense_and_gram(self):
+        from pint_tpu import linalg as L
+
+        rng = np.random.default_rng(5)
+        su = _random_structured(rng)
+        U = L.su_to_dense(su)
+        n, k = U.shape
+        p = 4
+        J = rng.normal(size=(n, p))
+        r = rng.normal(size=n)
+        sigma = rng.uniform(0.5, 2.0, size=n)
+        phi = rng.uniform(0.1, 10.0, size=k)
+        out_s = L.gls_normal_solve(r, J, sigma, su, phi)
+        out_d = L.gls_normal_solve(r, J, sigma, U, phi)
+        gram = L.noise_gram_precompute(sigma, U, phi)
+        out_g = L.gls_normal_solve(r, J, sigma, U, phi, gram=gram)
+        out_gs = L.gls_normal_solve(r, J, sigma, su, phi, gram=gram)
+        for got in (out_s, out_g, out_gs):
+            for a, b in zip(got, out_d):
+                np.testing.assert_allclose(np.asarray(a),
+                                           np.asarray(b),
+                                           rtol=1e-9, atol=1e-12)
+        # the gram-served chi^2 applies the guard ladder's capacity
+        # ridge in-trace exactly like _capacity does on the dense path
+        for eps in (0.0, 1e-8):
+            eps = jnp.float64(eps)
+            out_de = L.gls_normal_solve(r, J, sigma, U, phi,
+                                        guard_eps=eps)
+            out_ge = L.gls_normal_solve(r, J, sigma, U, phi, gram=gram,
+                                        guard_eps=eps)
+            for a, b in zip(out_ge, out_de):
+                np.testing.assert_allclose(np.asarray(a),
+                                           np.asarray(b),
+                                           rtol=1e-9, atol=1e-12)
+
+    def test_residuals_build_structured_ecorr(self):
+        model = get_model(BASE + GLS_EXTRA)
+        toas = _toas(model, n=64, clustered=True)
+        from pint_tpu.linalg import StructuredU
+        from pint_tpu.residuals import Residuals
+
+        r = Residuals(toas, model)
+        assert isinstance(r._U_ext, StructuredU)
+        assert r.ecorr_segment_cols > 0
+
+    def test_segment_vs_dense_end_to_end(self, monkeypatch):
+        model = get_model(BASE + GLS_EXTRA)
+        toas = _toas(model, n=64, clustered=True)
+        f = GLSFitter(toas, model)
+        assert f.resids.ecorr_segment_cols > 0
+        chi2_s = f.fit_toas(maxiter=3)
+
+        monkeypatch.setenv("PINT_TPU_SEGMENT_ECORR", "0")
+        model2 = get_model(BASE + GLS_EXTRA)
+        f2 = GLSFitter(toas, model2)
+        assert f2.resids.ecorr_segment_cols == 0
+        chi2_d = f2.fit_toas(maxiter=3)
+        np.testing.assert_allclose(chi2_s, chi2_d, rtol=1e-9)
+        for p in f._traced_free:
+            np.testing.assert_allclose(
+                model.values[p], model2.values[p], rtol=1e-9,
+                err_msg=p)
+
+    def test_overlapping_epochs_fall_back_dense(self):
+        # two ECORR selects whose masks overlap row-wise (every TOA
+        # carries BOTH flags) cannot be a single segment id per TOA ->
+        # dense fallback
+        par = BASE + ("EFAC -f L-wide 1.1\nECORR -f L-wide 0.5\n"
+                      "ECORR -fe Rcvr 0.4\n")
+        model = get_model(par)
+        epochs = np.linspace(53800.0, 54600.0, 16)
+        mjds = np.repeat(epochs, 4) + np.tile(
+            np.arange(4) * 0.1 / 86400.0, 16)
+        toas = make_fake_toas_fromMJDs(
+            mjds, model, freq_mhz=1400.0, obs="gbt", error_us=1.0,
+            add_noise=True, rng=np.random.default_rng(0),
+            flags={"f": "L-wide", "fe": "Rcvr"})
+        from pint_tpu.linalg import StructuredU
+        from pint_tpu.residuals import Residuals
+
+        r = Residuals(toas, model)
+        assert not isinstance(r._U_ext, StructuredU)
+
+
+class TestKeplerDepth:
+    def test_class_depths_match_full_depth(self):
+        from pint_tpu.models.binary.kepler import (
+            kepler_eccentric_anomaly, newton_iters_for)
+
+        M = jnp.asarray(np.linspace(-np.pi, np.pi, 1001))
+        for e, lo in ((0.0, 4), (0.02, 4), (0.2, 6), (0.5, 8),
+                      (0.9, 10)):
+            iters = newton_iters_for(e)
+            assert iters >= lo or iters == lo
+            E_fast = kepler_eccentric_anomaly(M, jnp.full_like(M, e),
+                                              iters)
+            E_full = kepler_eccentric_anomaly(M, jnp.full_like(M, e),
+                                              10)
+            np.testing.assert_allclose(np.asarray(E_fast),
+                                       np.asarray(E_full), atol=5e-15)
+
+    def test_nan_ecc_gets_full_depth(self):
+        from pint_tpu.models.binary.kepler import newton_iters_for
+
+        assert newton_iters_for(float("nan")) == 10
+
+    def test_gridded_ecc_gets_full_depth(self, monkeypatch):
+        # an ECC grid sweeps arbitrary eccentricities: the grid builder
+        # must raise the static Newton depth to the full unroll before
+        # tracing, whatever the base value's class; a grid over other
+        # params keeps the prepare-time class
+        from pint_tpu import compile_cache as _cc
+        from pint_tpu import grid as G
+
+        captured = []
+        orig = G.Residuals
+        monkeypatch.setattr(
+            G, "Residuals",
+            lambda *a, **k: captured.append(orig(*a, **k)) or captured[-1])
+        par = BASE + ZOO["DD"].replace("ECC 0.31 1", "ECC 0.02 1")
+        model = get_model(par)
+        toas = _toas(model, n=40)
+        G.make_grid_fn(toas, model, ["ECC"], n_steps=1)
+        _, static = _cc.split_ctx(captured[-1].prepared.ctx)
+        assert static["BinaryDD"]["kepler_iters"] == 10
+
+        G.make_grid_fn(toas, get_model(par), ["M2", "SINI"], n_steps=1)
+        _, static = _cc.split_ctx(captured[-1].prepared.ctx)
+        assert static["BinaryDD"]["kepler_iters"] == 4
+
+    def test_postfit_guard_deepens_and_signals_refit(self):
+        # a fit stepping ECC across its prepare-time class bound must
+        # deepen the unroll and rerun (fitter._kepler_depth_guard);
+        # within-class movement keeps the trace
+        from pint_tpu import compile_cache as _cc
+
+        par = BASE + ZOO["DD"].replace("ECC 0.31 1", "ECC 0.02 1")
+        model = get_model(par)
+        f = WLSFitter(_toas(model, n=40), model)
+        _, static = _cc.split_ctx(f.prepared.ctx)
+        assert static["BinaryDD"]["kepler_iters"] == 4
+        before = telemetry.counter_get("fitter.kepler_depth_refits")
+        model.values["ECC"] = 0.3  # as if a GN step crossed the bound
+        with pytest.warns(UserWarning, match="Kepler depth class"):
+            assert f._kepler_depth_guard()
+        assert telemetry.counter_get("fitter.kepler_depth_refits") \
+            == before + 1
+        _, static = _cc.split_ctx(f.prepared.ctx)
+        assert static["BinaryDD"]["kepler_iters"] == 8
+        assert not f._kepler_depth_guard()  # within-class: no retrace
+
+    def test_wideband_binary_fit_runs_depth_guard(self):
+        # the stacked wideband layout must survive the post-fit depth
+        # guard (regression: WidebandTOAResiduals had no
+        # ensure_kepler_depth — every wideband fit of a Kepler-solving
+        # binary crashed at the guard)
+        from pint_tpu.fitter import WidebandTOAFitter
+
+        par = (BASE + ZOO["DD"]).replace("UNITS TDB",
+                                         "DMDATA 1\nUNITS TDB")
+        model = get_model(par)
+        # 64 TOAs: the full free DD set needs this much data for a
+        # stable GN step (40 genuinely diverges, parent included)
+        toas = make_fake_toas_uniform(
+            53800.0, 54600.0, 64, model, freq_mhz=1400.0, obs="gbt",
+            error_us=1.0, add_noise=True,
+            rng=np.random.default_rng(0), wideband=True, dm_error=1e-4,
+            flags={"f": "L-wide"})
+        f = WidebandTOAFitter(toas, model)
+        f.fit_toas(maxiter=2)  # reach 0.31 -> guard runs, no crash
+        model.values["ECC"] = 0.9  # class 8 -> full unroll
+        with pytest.warns(UserWarning, match="Kepler depth class"):
+            assert f._kepler_depth_guard()
+        assert not f._kepler_depth_guard()
+
+    def test_pta_batch_postfit_guard(self):
+        # the batched path enforces the same invariant as the
+        # single-pulsar loops: a fit that moves any member's ECC past
+        # the harmonized class deepens the WHOLE batch and reruns
+        from pint_tpu.parallel import PTABatch
+
+        par = BASE + ZOO["DD"].replace("ECC 0.31 1", "ECC 0.02 1")
+        pairs = []
+        for i in range(2):
+            m = get_model(par.replace("PSR TSTDESIGN", f"PSR TSTD{i}"))
+            pairs.append((m, _toas(m, n=24, seed=i)))
+        batch = PTABatch(pairs)
+        assert batch.static_ctx["BinaryDD"]["kepler_iters"] == 4
+        before = telemetry.counter_get("pta.kepler_depth_refits")
+        pairs[1][0].values["ECC"] = 0.3
+        with pytest.warns(UserWarning, match="Kepler depth class"):
+            assert batch._kepler_depth_guard()
+        assert telemetry.counter_get("pta.kepler_depth_refits") \
+            == before + 1
+        assert batch.static_ctx["BinaryDD"]["kepler_iters"] == 8
+        assert not batch._kepler_depth_guard()  # within-class now
+
+    def test_depth_rides_static_ctx(self):
+        model = get_model(BASE + ZOO["DD"])  # ECC 0.31 -> depth 8
+        prep = model.prepare(_toas(model))
+        from pint_tpu import compile_cache as _cc
+
+        _, static = _cc.split_ctx(prep.ctx)
+        assert static["BinaryDD"]["kepler_iters"] == 8
+        # ... and therefore keys the shared traces
+        assert "kepler_iters" in _cc.static_ctx_key(static)
+
+
+class TestZeroRecompileAndGuard:
+    def _compiles(self):
+        telemetry.compile_stats()
+        return telemetry.counter_get("jit.compile_events")
+
+    def _monitoring_live(self):
+        return telemetry.compile_stats()["source"] == "jax.monitoring"
+
+    def test_second_fitter_zero_compiles_all_paths(self):
+        """Hybrid WLS, frozen-noise GLS with segment-ECORR: a second
+        same-shaped fitter performs ZERO new XLA compiles."""
+        if not self._monitoring_live():
+            pytest.skip("jax.monitoring compile events unavailable")
+        for cls, par, clustered in (
+                (WLSFitter, BASE, False),
+                (GLSFitter, BASE + GLS_EXTRA, True)):
+            model = get_model(par)
+            toas = _toas(model, n=64, clustered=clustered)
+            f1 = cls(toas, model)
+            assert f1._partition[0], "hybrid path not engaged"
+            f1.fit_toas(maxiter=2)
+            float(f1.resids.chi2)
+            n0 = self._compiles()
+            model2 = get_model(par)
+            f2 = cls(toas, model2)
+            f2.fit_toas(maxiter=2)
+            float(f2.resids.chi2)
+            assert self._compiles() == n0, cls.__name__
+
+    def test_guard_health_rides_new_paths(self, monkeypatch):
+        monkeypatch.setenv("PINT_TPU_GUARD", "1")
+        model = get_model(BASE + GLS_EXTRA)
+        toas = _toas(model, n=64, clustered=True)
+        f = GLSFitter(toas, model)
+        assert f._noise_frozen and f._partition[0]
+        f.fit_toas(maxiter=2)
+        assert f.fit_rung == "baseline"
+        assert f.fit_health and f.fit_health.get("ok")
+
+    def test_guard_trips_on_nan_toa_frozen_path(self, monkeypatch):
+        from pint_tpu import faults
+        from pint_tpu import guard as _guard
+
+        monkeypatch.setenv("PINT_TPU_GUARD", "1")
+        model = get_model(BASE + GLS_EXTRA)
+        toas = _toas(model, n=64, clustered=True)
+        faults.inject("nan_resid", index=5)
+        try:
+            f = GLSFitter(toas, model)
+            assert f._noise_frozen  # the new fast path is the one under test
+            with pytest.raises(_guard.FitDivergedError):
+                f.fit_toas(maxiter=2)
+        finally:
+            faults.clear()
