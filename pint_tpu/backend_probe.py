@@ -17,8 +17,9 @@ from __future__ import annotations
 
 import os
 import sys
+import time
 
-__all__ = ["probe_backend", "ensure_live_backend"]
+__all__ = ["probe_backend", "probe_with_retry", "ensure_live_backend"]
 
 
 def probe_backend(timeout_s: float, force_cpu_env: str | None = None):
@@ -73,8 +74,71 @@ def probe_backend(timeout_s: float, force_cpu_env: str | None = None):
                        "tunnel)" % timeout_s)
 
 
-def ensure_live_backend(timeout_s: float | None = None):
-    """Probe the default backend; if it is hung or broken, force the
+def probe_with_retry(timeout_s: float | None = None,
+                     retries: int | None = None,
+                     backoff_s: float | None = None,
+                     force_cpu_env: str | None = None,
+                     probe_fn=None):
+    """Bounded-retry probe with exponential backoff: a *transiently*
+    hung device tunnel (the BENCH_r03-r05 failure mode, where rounds
+    silently fell to a mislabeled CPU floor) gets ``retries`` chances
+    to come back before the caller falls back.
+
+    retries: total probe attempts (``$PINT_TPU_PROBE_RETRIES``,
+    default 3).  backoff_s: sleep before the second attempt
+    (``$PINT_TPU_PROBE_BACKOFF``, default 2.0), doubling each retry,
+    capped at 60 s.  probe_fn: ``() -> (ok, detail)`` override for
+    tests (the injected always-timeout probe).
+
+    Telemetry: ``probe.attempts`` per attempt, ``probe.backoff_s``
+    cumulative sleep, ``probe.recoveries`` when a retry succeeds after
+    a failure.  Returns ``(ok, detail)``; detail notes the recovering
+    attempt so a recovered run is distinguishable from a first-try
+    pass."""
+    from pint_tpu import telemetry
+
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("PINT_TPU_PROBE_TIMEOUT", "20"))
+    if retries is None:
+        try:
+            retries = int(os.environ.get("PINT_TPU_PROBE_RETRIES", "3"))
+        except ValueError:
+            retries = 3
+    retries = max(1, retries)
+    if backoff_s is None:
+        try:
+            backoff_s = float(
+                os.environ.get("PINT_TPU_PROBE_BACKOFF", "2.0"))
+        except ValueError:
+            backoff_s = 2.0
+    if probe_fn is None:
+        probe_fn = lambda: probe_backend(  # noqa: E731
+            timeout_s, force_cpu_env=force_cpu_env)
+    delay = backoff_s
+    ok, detail = False, "no probe attempts"
+    for attempt in range(1, retries + 1):
+        telemetry.counter_add("probe.attempts")
+        ok, detail = probe_fn()
+        if ok:
+            if attempt > 1:
+                telemetry.counter_add("probe.recoveries")
+                detail = (f"{detail} (recovered on attempt "
+                          f"{attempt}/{retries})")
+            return ok, detail
+        if attempt < retries:
+            telemetry.counter_add("probe.backoff_s", delay)
+            time.sleep(delay)
+            delay = min(delay * 2.0, 60.0)
+    return ok, f"{detail} (after {retries} attempt(s))"
+
+
+def ensure_live_backend(timeout_s: float | None = None,
+                        retries: int | None = None,
+                        backoff_s: float | None = None,
+                        force_cpu_env: str | None = None,
+                        probe_fn=None):
+    """Probe the default backend (with bounded retry/backoff — see
+    :func:`probe_with_retry`); if it stays hung or broken, force the
     in-process JAX config onto the CPU backend so subsequent
     ``jax.devices()`` calls return instead of blocking.
 
@@ -89,15 +153,14 @@ def ensure_live_backend(timeout_s: float | None = None):
     # force cpu before importing): nothing can hang, skip the probe
     if (getattr(jax.config, "jax_platforms", None) or "") == "cpu":
         return True, "cpu (pre-forced in-process)"
-    if timeout_s is None:
-        timeout_s = float(os.environ.get("PINT_TPU_PROBE_TIMEOUT", "20"))
-    ok, detail = probe_backend(timeout_s)
+    ok, detail = probe_with_retry(timeout_s, retries, backoff_s,
+                                  force_cpu_env=force_cpu_env,
+                                  probe_fn=probe_fn)
     if not ok:
         from pint_tpu import telemetry
 
         telemetry.counter_add("backend_probe.cpu_fallbacks")
         os.environ["JAX_PLATFORMS"] = "cpu"
-        import jax
 
         try:
             jax.config.update("jax_platforms", "cpu")

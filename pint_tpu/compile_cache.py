@@ -54,7 +54,7 @@ from collections import OrderedDict
 
 import numpy as np
 
-from pint_tpu import telemetry
+from pint_tpu import profiling, telemetry
 
 __all__ = [
     "enable_persistent_cache", "cache_dir", "cache_entries",
@@ -206,8 +206,17 @@ def _registry_cap():
         return 128
 
 
+def _derive_label(fn, key):
+    """Program label for the profiling registry: the conventional
+    string head of the key (every library key starts with one), else
+    the callable's qualname."""
+    if isinstance(key, tuple) and key and isinstance(key[0], str):
+        return key[0]
+    return getattr(fn, "__qualname__", None) or "program"
+
+
 def shared_jit(fn, *, key, fn_token=None, donate_argnums=None,
-               static_argnums=None):
+               static_argnums=None, label=None):
     """The one jitted callable for (fn identity x key), creating it on
     first use.
 
@@ -217,6 +226,13 @@ def shared_jit(fn, *, key, fn_token=None, donate_argnums=None,
     key alone must identify the computation.  ``key`` must cover every
     closed-over static the trace bakes in — abstract avals of the call
     arguments are handled by jax.jit's own cache underneath.
+
+    Every entry is returned wrapped in the profiling proxy
+    (:func:`pint_tpu.profiling.wrap_program`): with the
+    ``$PINT_TPU_PROFILE`` gate off the proxy is one branch on top of
+    the raw call; with it on, each call's trace/dispatch/device phase
+    split, byte sizes, and device-time histogram accumulate under
+    ``label`` (default: the key's string head).
 
     The registry holds strong references (an entry keeps its first
     caller's closure alive); it is LRU-bounded by
@@ -254,7 +270,9 @@ def shared_jit(fn, *, key, fn_token=None, donate_argnums=None,
         _entry.__name__ = getattr(fn, "__name__", "shared_jit_entry")
         _entry.__qualname__ = getattr(fn, "__qualname__",
                                       _entry.__name__)
-        jitted = jax.jit(_entry, **kwargs)
+        jitted = profiling.wrap_program(
+            jax.jit(_entry, **kwargs), key=key,
+            label=label if label is not None else _derive_label(fn, key))
         _registry[full_key] = jitted
         cap = _registry_cap()
         while len(_registry) > cap:

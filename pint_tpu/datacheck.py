@@ -438,6 +438,93 @@ def _faults_section():
     return lines
 
 
+def _profile_section():
+    """Device-truth profiling smoke (--profile): the per-program
+    registry table, histogram sanity (p50 <= p99), memory watermarks,
+    a profile-on/profile-off zero-recompile check, and the
+    perf-regression sentinel over any BENCH_r*.json rounds in the cwd.
+    Diagnostic: reports, never raises."""
+    from pint_tpu import compile_cache, profiling, telemetry
+
+    lines = ["Profiling (--profile): gate "
+             + ("ON" if profiling.enabled() else
+                "off (forced on for this smoke; set "
+                "$PINT_TPU_PROFILE=1 to profile real runs)")]
+    try:
+        import numpy as np
+
+        from pint_tpu.compile_cache import WARM_WLS_PAR
+        from pint_tpu.fitter import WLSFitter
+        from pint_tpu.models.builder import get_model
+        from pint_tpu.simulation import make_fake_toas_uniform
+
+        model = get_model(WARM_WLS_PAR)
+        toas = make_fake_toas_uniform(
+            53000.0, 54000.0, 60, model, freq_mhz=1400.0, obs="gbt",
+            error_us=1.0, add_noise=True,
+            rng=np.random.default_rng(0))
+        # fit 1 with the gate OFF (pays the cold compiles), fit 2 with
+        # it ON: flipping the gate must trigger ZERO new XLA compiles
+        # — the gate lives outside the traced program by construction
+        with profiling.profiled(False):
+            f1 = WLSFitter(toas, model)
+            f1.fit_toas(maxiter=2)
+        telemetry.compile_stats()
+        before = telemetry.counter_get("jit.compile_events")
+        hits_before = compile_cache.registry_stats()["hits"]
+        with profiling.profiled(True):
+            f2 = WLSFitter(toas, model)
+            f2.fit_toas(maxiter=2)
+        d_compiles = int(telemetry.counter_get("jit.compile_events")
+                         - before)
+        shared = compile_cache.registry_stats()["hits"] > hits_before
+        monitoring = telemetry.compile_stats()["source"] \
+            == "jax.monitoring"
+        ok = shared and (d_compiles == 0 or not monitoring)
+        lines.append(
+            f"  profile-on/off zero-recompile smoke: "
+            f"{d_compiles} new compile event(s), registry "
+            f"{'shared' if shared else 'NOT SHARED'} -> "
+            + ("OK" if ok else "PROBLEM"))
+
+        lines.append("  per-program registry:")
+        lines.extend(profiling.table_lines(indent="    "))
+
+        hists = telemetry.histograms()
+        bad = [n for n, s in hists.items()
+               if s["n"] and not (s["p50"] <= s["p99"])]
+        lines.append(
+            f"  histograms: {len(hists)} recorded; p50<=p99 "
+            + ("OK" if not bad else f"PROBLEM ({', '.join(bad)})"))
+
+        mem = profiling.sample_memory()
+        if mem:
+            parts = [f"{k}={v / 1e6:.1f}MB" for k, v in mem.items()]
+            lines.append("  memory watermarks: " + ", ".join(parts))
+        else:
+            lines.append("  memory watermarks: unavailable")
+    except Exception as e:  # diagnostic must never take the report down
+        lines.append(f"  ERROR {type(e).__name__}: {e}")
+
+    # perf-regression sentinel readout (printed, never failing here —
+    # `pinttrace --check-regression` is the gating entry point)
+    try:
+        from pint_tpu.scripts.pinttrace import regression_verdict
+
+        got = regression_verdict()
+        if got is not None:
+            header, vlines, _rc = got
+            lines.append(f"  {header}")
+            lines.extend(f"    {ln}" for ln in vlines)
+        else:
+            lines.append("  perf-regression sentinel: no BENCH_r*.json "
+                         "rounds in cwd")
+    except Exception as e:
+        lines.append(f"  perf-regression sentinel: ERROR "
+                     f"{type(e).__name__}: {e}")
+    return lines
+
+
 def _last_session_compile_lines():
     """Compile/span stats aggregated from the $PINT_TPU_TRACE file, if
     one exists and parses.  The sink appends, so the totals cover every
@@ -494,11 +581,19 @@ def main(argv=None):
                    help="run the fault-injection smoke: each fast "
                         "fault class must recover via a documented "
                         "ladder rung or raise a structured error")
+    p.add_argument("--profile", action="store_true",
+                   help="run the device-truth profiling smoke: "
+                        "per-program table, histogram sanity, memory "
+                        "watermarks, profile-on/off zero-recompile "
+                        "check, perf-regression sentinel readout")
     args = p.parse_args(argv)
     for line in datacheck_report(args.ephem):
         print(line)
     if args.faults:
         for line in _faults_section():
+            print(line)
+    if args.profile:
+        for line in _profile_section():
             print(line)
     if args.warm:
         from pint_tpu import compile_cache

@@ -55,7 +55,8 @@ class _DownhillMixin:
                  self._partition, self._frozen_names,
                  self._noise_frozen,
                  self.resids._structure_key()),
-            donate_argnums=_cc.donation_argnums((0,)))
+            donate_argnums=_cc.donation_argnums((0,)),
+            label=f"downhill.halving:{type(self).__name__}")
 
     def warm_compile(self):
         """AOT-compile the halving step (the downhill hot path) plus

@@ -475,7 +475,12 @@ class Fitter:
             leaves)
         self._step_jit = _cc.shared_jit(
             self._step, key=self._step_key(),
-            donate_argnums=_cc.donation_argnums((0,)))
+            donate_argnums=_cc.donation_argnums((0,)),
+            label=f"fitter.step:{type(self).__name__}")
+        # flops.py's per-step estimate rides the program record so the
+        # profiler can reconcile it against XLA's own cost_analysis
+        # (>2x disagreement -> profile.flops_mismatch)
+        self._step_jit.set_analytic_flops(self._fit_flops_est(1))
 
     def _step_key(self):
         """Everything a trace of _step bakes in beyond the avals.

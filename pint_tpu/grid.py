@@ -206,8 +206,9 @@ def make_grid_fn(toas, model, grid_params, n_steps=3):
            # leaves derive deterministically from them + the free set)
            hybrid_design_default(), frozen_delay_default(),
            _cc.fingerprint((resids._data(), prepared.model.values)))
-    return _cc.shared_jit(jax.vmap(fit_one), key=key,
-                          fn_token="grid.make_grid_fn"), fit_params, \
+    return _cc.shared_jit(
+        jax.vmap(fit_one), key=key, fn_token="grid.make_grid_fn",
+        label=f"grid.fit_one:{'+'.join(grid_params)}"), fit_params, \
         partition
 
 

@@ -794,7 +794,17 @@ class PTABatch:
                 self._build_fit(kind, maxiter, with_health),
                 key=("pta.batched", kind, int(maxiter), with_health,
                      self._structure_key()),
-                fn_token="pta.batched_fit")
+                fn_token="pta.batched_fit",
+                label=f"pta.batched_fit:{kind}")
+            # per-call analytic cost for the profiler's reconciliation:
+            # one batched fit = n_psr independent GLS fits
+            try:
+                got.set_analytic_flops(_flops.pta_batch_flops(
+                    self.n_pulsars, self.n_max, len(self.free_names),
+                    self._noise_basis_width(), n_iter=int(maxiter),
+                    n_lin=len(self._partition_wb[0])))
+            except Exception:
+                pass  # cost metadata only; never block the fit path
         else:
             telemetry.counter_add("pta.fit_jit_cache_hits")
         return got

@@ -1,0 +1,457 @@
+"""Device-truth profiling: per-program accounting, phase split, memory
+watermarks (``$PINT_TPU_PROFILE``).
+
+The telemetry layer (spans/counters) records *that* a fit happened and
+how long the host waited; this module records *where* that time went.
+Every jitted program that resolves through the shared-jit registry
+(:func:`pint_tpu.compile_cache.shared_jit`) is wrapped in a thin proxy
+that — only while the profile gate is on — attributes each call to four
+phases and accumulates a per-program record:
+
+- **trace_s** — jax tracing/lowering/compile work during the call,
+  measured as the delta of the telemetry compile counters
+  (``jit.compile_seconds``) across it.  Zero on the warm path.
+- **dispatch_s** — the remainder of the wall time the call itself
+  took: argument processing + enqueueing the executable.  Under async
+  dispatch this is microseconds.
+- **device_s** — the wait inside ``jax.block_until_ready`` on the
+  call's outputs: device execution (plus any not-yet-retired work
+  queued before the call — see docs/telemetry.md for what this timing
+  does and does NOT mean).  Log-bucketed into a per-program
+  :class:`~pint_tpu.telemetry.LogHistogram` for p50/p95/p99 readout.
+- **bytes** — cumulative argument / result pytree bytes.
+
+With the gate OFF (the default) a profiled call is one env read, one
+branch, and the raw jitted call — the async dispatch path pays
+nothing, which is what keeps the gate safe to leave in production hot
+paths.  The gate never changes the traced program, so flipping it can
+never force a recompile (regression-tested).
+
+On the first *compiling* profiled call of each program the proxy also
+captures XLA's own ``cost_analysis()`` FLOP/byte estimates (via
+``Lowered.cost_analysis`` — no extra backend compile, verified to tick
+zero compile-monitoring events) and reconciles them against the
+analytic cost model a caller registered (:mod:`pint_tpu.flops` via
+``set_analytic_flops``): disagreement beyond 2x in either direction
+emits the ``profile.flops_mismatch`` counter plus a structured record.
+
+Memory watermarks: :func:`sample_memory` publishes live-buffer bytes
+(``jax.live_arrays``) and, where the backend exposes
+``device.memory_stats()`` (TPU/GPU), device bytes-in-use and
+peak-bytes gauges.  While profiling is on it is sampled automatically
+at telemetry span boundaries (rate-limited) via the span hook.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import os
+import threading
+import time
+from collections import OrderedDict
+
+from pint_tpu import telemetry
+
+__all__ = [
+    "PROFILE_ENV", "enabled", "configure", "profiled",
+    "wrap_program", "programs", "table_lines", "reset",
+    "sample_memory", "flush_programs",
+]
+
+PROFILE_ENV = "PINT_TPU_PROFILE"
+
+_lock = threading.RLock()
+
+#: None = follow the env var (read per call — a dict lookup, so a
+#: subprocess harness or a with-block controls it); True/False = forced
+_override = None
+
+
+def enabled() -> bool:
+    """Whether the profile gate is on (env var or programmatic)."""
+    if _override is not None:
+        return _override
+    raw = os.environ.get(PROFILE_ENV)
+    if not raw:
+        return False
+    return raw.strip().lower() in ("1", "true", "yes", "on")
+
+
+def configure(enabled=None):
+    """Force the gate on/off programmatically; ``None`` returns control
+    to ``$PINT_TPU_PROFILE``.  Returns the module for chaining."""
+    global _override
+    _override = None if enabled is None else bool(enabled)
+    import sys
+
+    return sys.modules[__name__]
+
+
+@contextlib.contextmanager
+def profiled(on=True):
+    """Context manager: the profile gate forced on (off) inside the
+    block, previous state restored after — bench's one-extra-profiled-
+    call phase probe and the datacheck smoke."""
+    global _override
+    prev = _override
+    _override = bool(on)
+    try:
+        yield
+    finally:
+        _override = prev
+
+
+# --------------------------------------------------------------------------
+# per-program registry
+# --------------------------------------------------------------------------
+
+class ProgramStats:
+    """Cumulative device-truth record of one registry program."""
+
+    __slots__ = ("label", "key_hash", "calls", "compiles", "arg_bytes",
+                 "result_bytes", "trace_s", "dispatch_s", "device_s",
+                 "hist", "analytic_flops", "xla_flops", "xla_bytes",
+                 "cost_checked")
+
+    def __init__(self, label, key_hash):
+        self.label = label
+        self.key_hash = key_hash
+        self.calls = 0
+        self.compiles = 0          # calls during which a compile ticked
+        self.arg_bytes = 0
+        self.result_bytes = 0
+        self.trace_s = 0.0
+        self.dispatch_s = 0.0
+        self.device_s = 0.0
+        self.hist = telemetry.LogHistogram()   # per-call device_s
+        self.analytic_flops = None  # flops.py estimate per call
+        self.xla_flops = None       # XLA cost_analysis() per call
+        self.xla_bytes = None
+        self.cost_checked = False
+
+    def snapshot(self) -> dict:
+        h = self.hist.snapshot()
+        return {
+            "label": self.label,
+            "key": self.key_hash,
+            "calls": self.calls,
+            "compiles": self.compiles,
+            "arg_bytes": self.arg_bytes,
+            "result_bytes": self.result_bytes,
+            "trace_s": round(self.trace_s, 6),
+            "dispatch_s": round(self.dispatch_s, 6),
+            "device_s": round(self.device_s, 6),
+            "device_p50_s": h["p50"],
+            "device_p95_s": h["p95"],
+            "device_p99_s": h["p99"],
+            "analytic_flops": self.analytic_flops,
+            "xla_flops": self.xla_flops,
+            "xla_bytes": self.xla_bytes,
+        }
+
+
+#: program id -> ProgramStats, LRU order.  Bounded: registry keys can
+#: embed dataset fingerprints (the grid path), so a warm service
+#: cycling datasets would otherwise grow this forever — the same
+#: reasoning behind compile_cache's registry cap, sized above it so
+#: stats outlive the jit entries they describe.
+_programs: "OrderedDict[str, ProgramStats]" = OrderedDict()
+
+_PROGRAMS_CAP = 512
+
+
+def _register(label, key) -> ProgramStats:
+    key_hash = hashlib.blake2b(
+        repr(key).encode(), digest_size=4).hexdigest()
+    pid = f"{label}#{key_hash}"
+    with _lock:
+        st = _programs.get(pid)
+        if st is None:
+            st = _programs[pid] = ProgramStats(label, key_hash)
+            while len(_programs) > _PROGRAMS_CAP:
+                _programs.popitem(last=False)
+        else:
+            _programs.move_to_end(pid)
+        return st
+
+
+def programs() -> list:
+    """Snapshot of every program record (dicts, registry order).
+    Snapshots are built under the lock — the per-program histogram is
+    mutated by concurrent profiled calls."""
+    with _lock:
+        return [st.snapshot() for st in _programs.values()]
+
+
+def reset():
+    """Drop all program records (tests)."""
+    with _lock:
+        _programs.clear()
+
+
+# --------------------------------------------------------------------------
+# the profiled proxy
+# --------------------------------------------------------------------------
+
+def _tree_bytes(tree) -> int:
+    try:
+        from jax.tree_util import tree_leaves
+
+        return sum(int(getattr(leaf, "nbytes", 0) or 0)
+                   for leaf in tree_leaves(tree))
+    except Exception:
+        return 0
+
+
+def _attach_cost(st, jitted, args, kwargs):
+    """Capture XLA's cost_analysis for this program (once), and
+    reconcile against the registered analytic model.  Uses
+    ``Lowered.cost_analysis`` — a host-side retrace plus HLO cost
+    analysis, no backend compile (and zero compile-monitoring events,
+    verified) — so it is safe on the path that just compiled anyway."""
+    st.cost_checked = True
+    try:
+        ca = jitted.lower(*args, **kwargs).cost_analysis()
+    except Exception:
+        return
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    if not isinstance(ca, dict):
+        return
+    try:
+        st.xla_flops = float(ca.get("flops", 0.0))
+        st.xla_bytes = float(ca.get("bytes accessed", 0.0))
+    except (TypeError, ValueError):
+        return
+    a, x = st.analytic_flops, st.xla_flops
+    if a and x and (x > 2.0 * a or x < 0.5 * a):
+        telemetry.counter_add("profile.flops_mismatch")
+        telemetry.emit({
+            "type": "flops_mismatch", "program": st.label,
+            "key": st.key_hash, "analytic_flops": a, "xla_flops": x,
+            "ratio": round(x / a, 3),
+        })
+
+
+def _profiled_call(jitted, st, args, kwargs):
+    import jax
+
+    telemetry.compile_stats()  # listener installed before any timing
+    c0 = telemetry.counter_get("jit.compile_seconds")
+    e0 = telemetry.counter_get("jit.compile_events")
+    t0 = time.perf_counter()
+    out = jitted(*args, **kwargs)
+    t1 = time.perf_counter()
+    jax.block_until_ready(out)
+    t2 = time.perf_counter()
+    call_wall = t1 - t0
+    trace_s = min(max(
+        telemetry.counter_get("jit.compile_seconds") - c0, 0.0),
+        call_wall)
+    dispatch_s = max(call_wall - trace_s, 0.0)
+    device_s = t2 - t1
+    compiled = telemetry.counter_get("jit.compile_events") - e0 > 0
+    with _lock:
+        st.calls += 1
+        if compiled:
+            st.compiles += 1
+        st.trace_s += trace_s
+        st.dispatch_s += dispatch_s
+        st.device_s += device_s
+        st.hist.record(device_s)
+        st.arg_bytes += _tree_bytes(args) + _tree_bytes(kwargs)
+        st.result_bytes += _tree_bytes(out)
+    telemetry.counter_add("profile.calls")
+    telemetry.counter_add("profile.trace_s", trace_s)
+    telemetry.counter_add("profile.dispatch_s", dispatch_s)
+    telemetry.counter_add("profile.device_s", device_s)
+    # mirrored into the shared histogram surface so percentiles read
+    # out through telemetry.gauges() even with spans disabled
+    telemetry.hist_record(f"program.{st.label}.device_s", device_s)
+    if compiled and not st.cost_checked:
+        _attach_cost(st, jitted, args, kwargs)
+    return out
+
+
+class _ProfiledProgram:
+    """Callable proxy around a registry jit entry.  Gate off: one
+    branch, then the raw call (no sync — async dispatch preserved).
+    Gate on: phase-split timing at the device boundary.  Every other
+    attribute (``lower`` for AOT warmup, etc.) forwards to the
+    underlying jitted callable."""
+
+    __slots__ = ("_jitted", "_stats")
+
+    def __init__(self, jitted, stats):
+        object.__setattr__(self, "_jitted", jitted)
+        object.__setattr__(self, "_stats", stats)
+
+    def __call__(self, *args, **kwargs):
+        if not enabled():
+            return self._jitted(*args, **kwargs)
+        return _profiled_call(self._jitted, self._stats, args, kwargs)
+
+    def __getattr__(self, name):
+        return getattr(object.__getattribute__(self, "_jitted"), name)
+
+    @property
+    def stats(self) -> ProgramStats:
+        return object.__getattribute__(self, "_stats")
+
+    def set_analytic_flops(self, flops_per_call):
+        """Register the flops.py cost-model estimate for ONE call of
+        this program — the reconciliation baseline for XLA's
+        cost_analysis."""
+        self._stats.analytic_flops = float(flops_per_call)
+        return self
+
+
+def wrap_program(jitted, *, key, label):
+    """Wrap a jitted callable in the profiling proxy, registering (or
+    re-attaching to) its per-program record."""
+    return _ProfiledProgram(jitted, _register(label, key))
+
+
+# --------------------------------------------------------------------------
+# memory watermarks
+# --------------------------------------------------------------------------
+
+_mem_lock = threading.Lock()
+_mem_last_sample = 0.0
+_live_peak = 0
+
+
+def _backend_initialized() -> bool:
+    """Whether a jax backend is ALREADY up, without initializing one.
+    On a hung device tunnel backend init blocks forever (the r03-r05
+    pathology) and no except-clause can catch a hang — so anything
+    that runs automatically (the span hook) must check first."""
+    try:
+        import sys
+
+        xb = getattr(sys.modules.get("jax._src.xla_bridge"),
+                     "_backends", None)
+        return bool(xb)
+    except Exception:
+        return False
+
+
+def sample_memory() -> dict:
+    """Sample live-buffer bytes and (where the backend exposes
+    ``memory_stats``) device memory; publish as gauges, track the
+    live-buffer peak across the session.  Returns what was sampled.
+    Never initializes a backend that is not already up (checked, not
+    assumed: the span hook can fire from pure-host spans like
+    ephem.load before any jitted call, and touching a hung tunnel
+    would block forever)."""
+    global _live_peak
+    out = {}
+    if not _backend_initialized():
+        return out
+    try:
+        import jax
+
+        live = sum(int(getattr(a, "nbytes", 0) or 0)
+                   for a in jax.live_arrays())
+        out["live_buffer_bytes"] = live
+        with _mem_lock:
+            _live_peak = max(_live_peak, live)
+            peak = _live_peak
+        telemetry.gauge_set("profile.live_buffer_bytes", live)
+        telemetry.gauge_set("profile.live_buffer_peak_bytes", peak)
+        dev = jax.devices()[0]
+        stats_fn = getattr(dev, "memory_stats", None)
+        stats = stats_fn() if callable(stats_fn) else None
+        if stats:
+            in_use = stats.get("bytes_in_use")
+            peak_dev = stats.get("peak_bytes_in_use")
+            if in_use is not None:
+                out["device_bytes_in_use"] = int(in_use)
+                telemetry.gauge_set("profile.device_bytes_in_use",
+                                    int(in_use))
+            if peak_dev is not None:
+                out["device_peak_bytes"] = int(peak_dev)
+                telemetry.gauge_set("profile.device_peak_bytes",
+                                    int(peak_dev))
+    except Exception:
+        pass  # a watermark sample must never take the caller down
+    return out
+
+
+# --------------------------------------------------------------------------
+# telemetry hooks: span-boundary sampling + flush mirror
+# --------------------------------------------------------------------------
+
+@telemetry.add_span_hook
+def _span_hook(name, dur_s):
+    """On every span exit while profiling is on: the span's latency
+    into a log-bucketed histogram (p50/p95/p99 via telemetry.gauges()),
+    plus a rate-limited memory-watermark sample."""
+    global _mem_last_sample
+    if not enabled():
+        return
+    telemetry.hist_record(f"span.{name}", dur_s)
+    now = time.monotonic()
+    if now - _mem_last_sample >= 0.25:
+        _mem_last_sample = now
+        sample_memory()
+
+
+@telemetry.add_flush_hook
+def flush_programs():
+    """Mirror the program registry into the JSONL sink (one
+    ``{"type": "program", ...}`` record per program, cumulative — the
+    last record per program wins at aggregation).  Runs on every
+    telemetry.flush(); a no-op when nothing was profiled."""
+    for snap in programs():
+        if snap["calls"]:
+            telemetry.emit({"type": "program", **snap})
+
+
+# --------------------------------------------------------------------------
+# reporting
+# --------------------------------------------------------------------------
+
+def _fmt_bytes(n):
+    if n is None:
+        return "-"
+    v = float(n)
+    for unit in ("B", "KB", "MB", "GB"):
+        if abs(v) < 1024.0 or unit == "GB":
+            return f"{v:.0f}B" if unit == "B" else f"{v:.1f}{unit}"
+        v /= 1024.0
+
+
+def _fmt_ms(s):
+    return "-" if s is None else f"{s * 1e3:.2f}"
+
+
+def table_lines(snapshots=None, indent=""):
+    """Render program records as table lines — the ONE place the
+    format lives, shared by ``datacheck --profile`` (in-process
+    registry) and ``pinttrace --programs`` (trace records)."""
+    snaps = programs() if snapshots is None else snapshots
+    snaps = [s for s in snaps if s.get("calls")]
+    if not snaps:
+        return [f"{indent}(no profiled programs recorded)"]
+    lines = [
+        f"{indent}{'PROGRAM':<34s} {'CALLS':>6s} {'COMP':>5s} "
+        f"{'DEV_P50MS':>9s} {'DEV_P99MS':>9s} {'DEV_TOT_S':>9s} "
+        f"{'ARGS':>9s} {'FLOPS(XLA)':>11s}"
+    ]
+    for s in sorted(snaps, key=lambda s: -(s.get("device_s") or 0.0)):
+        name = f"{s['label']}#{s['key']}"
+        if len(name) > 34:
+            name = name[:31] + "..."
+        xf = s.get("xla_flops")
+        lines.append(
+            f"{indent}{name:<34s} {s['calls']:>6d} "
+            f"{s.get('compiles', 0):>5d} "
+            f"{_fmt_ms(s.get('device_p50_s')):>9s} "
+            f"{_fmt_ms(s.get('device_p99_s')):>9s} "
+            f"{(s.get('device_s') or 0.0):>9.4f} "
+            f"{_fmt_bytes(s.get('arg_bytes')):>9s} "
+            f"{('%.3g' % xf) if xf else '-':>11s}"
+        )
+    return lines

@@ -1,10 +1,25 @@
-"""Summarize a pint_tpu telemetry JSONL trace file.
+"""Summarize, export, and gate on pint_tpu telemetry/bench records.
 
-``pinttrace trace.jsonl`` (or ``python -m pint_tpu.scripts.pinttrace``)
-aggregates the records written by :mod:`pint_tpu.telemetry`
-(``PINT_TPU_TRACE=trace.jsonl``): spans by name (count/total/mean/max),
-final counter and gauge values, and any benchmark metric records that
-were routed through the same sink.
+Four modes:
+
+- ``pinttrace trace.jsonl`` — aggregate the records written by
+  :mod:`pint_tpu.telemetry` (``PINT_TPU_TRACE=trace.jsonl``): spans by
+  name (count/total/mean/max), final counter/gauge/histogram values,
+  and any benchmark metric records routed through the same sink.
+- ``pinttrace --chrome-trace out.json trace.jsonl`` — export the span
+  tree as Chrome ``trace_event`` JSON (load in Perfetto /
+  ``chrome://tracing``): spans become complete ("X") duration events
+  with nesting preserved, metrics become instant events.
+- ``pinttrace --programs trace.jsonl`` — the per-program registry
+  table (``{"type": "program"}`` records the profiling layer mirrors
+  on flush): key, calls, compiles, device-time p50/p99, bytes.
+- ``pinttrace --check-regression [BENCH_r*.json ...]`` — the
+  perf-regression sentinel: reads a bench-round trajectory, compares
+  each metric's latest value against its best non-fallback record
+  (``--tolerance``), flags trailing ``cpu-fallback``/failed-round
+  streaks (``--streak``) and metrics that vanished from the latest
+  round, and exits nonzero on any flag so CI and the bench parent can
+  gate on it.
 """
 
 from __future__ import annotations
@@ -13,7 +28,8 @@ import argparse
 import json
 import sys
 
-__all__ = ["summarize", "main"]
+__all__ = ["summarize", "chrome_trace", "programs_table",
+           "check_regression", "main"]
 
 
 def _load(path):
@@ -55,6 +71,13 @@ def aggregate(records):
             counters[rec.get("name", "?")] = rec.get("value")
         elif kind == "gauge":
             gauges[rec.get("name", "?")] = rec.get("value")
+        elif kind == "hist":
+            # expose the percentile readout through the gauge table
+            name = rec.get("name", "?")
+            for k in ("p50", "p95", "p99", "n"):
+                gauges[f"hist.{name}.{k}"] = rec.get(k)
+        elif kind in ("program", "sink_rotation", "flops_mismatch"):
+            other += 1  # aggregated by their dedicated consumers
         elif kind == "metric" or "metric" in rec:
             metrics.append(rec)
         else:
@@ -77,29 +100,369 @@ def summarize(records):
     for rec in metrics:
         name = rec.get("metric", "?")
         parts = [f"metric {name} = {rec.get('value')!r}"]
-        for key in ("backend", "compile_s", "flops", "vs_baseline"):
+        for key in ("backend", "compile_s", "phase_s", "flops",
+                    "vs_baseline"):
             if rec.get(key) is not None:
                 parts.append(f"{key}={rec[key]!r}")
         lines.append(" ".join(parts))
     return lines
 
 
+# --------------------------------------------------------------------------
+# --chrome-trace: trace_event JSON export
+# --------------------------------------------------------------------------
+
+def chrome_trace(records) -> dict:
+    """Convert span/metric records into Chrome ``trace_event`` format
+    (the JSON-object form: {"traceEvents": [...]}).
+
+    Spans map to complete ("X") duration events with ``ts``/``dur`` in
+    microseconds; the viewer reconstructs nesting from time
+    containment on a track, which the recorded wall-clock enter time
+    and duration preserve exactly (depth/parent ride along in
+    ``args``).  Metric records become instant ("i") events.  Counter
+    flushes become counter ("C") samples so cumulative counters plot
+    as time series."""
+    events = []
+    for rec in records:
+        kind = rec.get("type")
+        if kind == "span":
+            ts = float(rec.get("ts", 0.0))
+            dur = float(rec.get("dur_s", 0.0))
+            ev = {
+                "name": rec.get("name", "?"),
+                "cat": "span",
+                "ph": "X",
+                "ts": ts * 1e6,
+                "dur": dur * 1e6,
+                "pid": 1,
+                # span nesting is per-thread; one track per thread so
+                # concurrent spans can't garble time-containment
+                # (records from before the tid field land on track 1)
+                "tid": int(rec.get("tid", 1)),
+            }
+            args = dict(rec.get("attrs") or {})
+            args["depth"] = rec.get("depth", 0)
+            if rec.get("parent"):
+                args["parent"] = rec["parent"]
+            if rec.get("error"):
+                args["error"] = rec["error"]
+            ev["args"] = args
+            events.append(ev)
+        elif kind == "metric" or "metric" in rec:
+            ts = float(rec.get("ts", 0.0))
+            events.append({
+                "name": f"metric:{rec.get('metric', '?')}",
+                "cat": "metric",
+                "ph": "i",
+                "s": "g",
+                "ts": ts * 1e6,
+                "pid": 1,
+                "tid": 1,
+                "args": {"value": rec.get("value"),
+                         "backend": rec.get("backend")},
+            })
+        elif kind == "counter":
+            events.append({
+                "name": rec.get("name", "?"),
+                "cat": "counter",
+                "ph": "C",
+                "ts": float(rec.get("ts", 0.0)) * 1e6,
+                "pid": 1,
+                "args": {"value": rec.get("value")},
+            })
+    events.sort(key=lambda e: e["ts"])
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+# --------------------------------------------------------------------------
+# --programs: per-program registry table from trace records
+# --------------------------------------------------------------------------
+
+def programs_table(records):
+    """Table lines for the ``{"type": "program"}`` records in a trace.
+    Program records are cumulative flush mirrors, so the LAST record
+    per (label, key) wins."""
+    progs: dict = {}
+    for rec in records:
+        if rec.get("type") == "program":
+            progs[(rec.get("label", "?"), rec.get("key", "?"))] = rec
+    from pint_tpu.profiling import table_lines
+
+    return table_lines(list(progs.values()))
+
+
+# --------------------------------------------------------------------------
+# --check-regression: the perf-regression sentinel
+# --------------------------------------------------------------------------
+
+#: metrics where a SMALLER value is better (everything else in the
+#: suite is a rate)
+_LOWER_IS_BETTER = {"guard_overhead", "profile_overhead"}
+
+#: absolute slack (same units as the metric — percentage points for
+#: the overhead metrics) under the lower-is-better comparison: a
+#: multiplicative tolerance is meaningless around a near-zero or
+#: negative best (overhead jitters about 0 on a quiet host)
+_LOWER_ABS_SLACK = 2.0
+
+
+def _parse_round(path):
+    """One bench round -> (round_no, [metric records]).
+
+    Accepts the driver layout ({"n", "rc", "tail": <log text with one
+    JSON line per metric>}), a bare list of metric records, or
+    {"metrics": [...]} (synthetic fixtures)."""
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, list):
+        return None, [r for r in data if isinstance(r, dict)
+                      and "metric" in r]
+    if not isinstance(data, dict):
+        return None, []
+    if isinstance(data.get("metrics"), list):
+        return data.get("n"), [r for r in data["metrics"]
+                               if isinstance(r, dict) and "metric" in r]
+    metrics = []
+    for ln in str(data.get("tail", "")).splitlines():
+        ln = ln.strip()
+        if ln.startswith('{"metric"'):
+            try:
+                metrics.append(json.loads(ln))
+            except json.JSONDecodeError:
+                pass
+    return data.get("n"), metrics
+
+
+def _is_fallback(rec) -> bool:
+    if "fallback" in str(rec.get("backend") or ""):
+        return True
+    # rounds recorded before the structured backend field existed
+    # (r01-r02 era) carry the label only inside the unit string
+    return "backend=cpu-fallback" in str(rec.get("unit") or "")
+
+
+def _round_is_bad(metrics) -> bool:
+    """A round counts against the fallback streak when it produced no
+    usable on-target number: every metric null/failed, or every usable
+    metric served by a fallback backend.  A round where most metrics
+    ran on-chip and one fell back is a metric problem, not a lost
+    device — the per-metric REGRESSION/FALLBACK lines cover it."""
+    if not metrics:
+        return True
+    usable = [r for r in metrics if r.get("value") is not None]
+    if not usable:
+        return True
+    return all(_is_fallback(r) for r in usable)
+
+
+def check_regression(paths, tolerance=0.5, streak=2):
+    """The perf-regression sentinel over a BENCH_r*.json trajectory.
+
+    Contract (docs/telemetry.md): for each metric, the best value ever
+    recorded on a non-fallback backend is the reference; the latest
+    non-fallback value must stay within ``tolerance`` (fraction —
+    0.5 means "no worse than half the best rate") or the metric is
+    flagged REGRESSION.  A trailing run of >= ``streak`` rounds that
+    were fallback-served or produced nothing flags FALLBACK-STREAK
+    (the r03-r05 hung-tunnel pathology: the chip was lost and nobody
+    alarmed).  A metric that ever produced a real value but is absent
+    from the latest round flags MISSING.  Returns ``(lines, rc)``
+    with rc nonzero iff anything was flagged."""
+    rounds = []   # (label, round_no, metrics)
+    for i, path in enumerate(paths):
+        try:
+            n, metrics = _parse_round(path)
+        except (OSError, json.JSONDecodeError) as e:
+            return [f"ERROR unreadable round {path}: {e}"], 2
+        rounds.append((str(path), n if n is not None else i + 1,
+                       metrics))
+    rounds.sort(key=lambda r: (r[1], r[0]))
+    if not rounds:
+        return ["ERROR no rounds to check"], 2
+
+    lines = []
+    flagged = False
+
+    # trailing fallback/failed streak
+    run = 0
+    for _, _, metrics in reversed(rounds):
+        if _round_is_bad(metrics):
+            run += 1
+        else:
+            break
+    if run >= streak:
+        flagged = True
+        first_bad = rounds[len(rounds) - run][1]
+        last_bad = rounds[-1][1]
+        lines.append(
+            f"FALLBACK-STREAK rounds r{first_bad:02d}-r{last_bad:02d}: "
+            f"{run} consecutive round(s) fallback-served or empty "
+            "(device lost; see backend_probe retry/backoff)")
+
+    # per-metric best-vs-latest
+    best: dict = {}       # metric -> (value, round_no)
+    latest: dict = {}     # metric -> (rec, round_no)
+    for _, rno, metrics in rounds:
+        for rec in metrics:
+            name = rec.get("metric")
+            val = rec.get("value")
+            if name is None:
+                continue
+            if val is not None:
+                latest[name] = (rec, rno)
+                if not _is_fallback(rec):
+                    lower = name in _LOWER_IS_BETTER
+                    cur = best.get(name)
+                    if (cur is None
+                            or (val < cur[0] if lower else val > cur[0])):
+                        best[name] = (val, rno)
+    last_round_metrics = {r.get("metric") for r in rounds[-1][2]
+                          if r.get("value") is not None}
+    # a fully-bad latest round is the streak check's jurisdiction: one
+    # transient empty round must not MISSING-flag every metric when it
+    # is below the --streak threshold the caller chose to tolerate
+    last_round_bad = _round_is_bad(rounds[-1][2])
+    for name in sorted(best):
+        best_val, best_rno = best[name]
+        rec, rno = latest[name]
+        val = rec.get("value")
+        lower = name in _LOWER_IS_BETTER
+        if name not in last_round_metrics:
+            if last_round_bad:
+                lines.append(
+                    f"NOTE {name}: absent from the latest round "
+                    "(round empty/fallback-served; streak check "
+                    "owns the alarm)")
+                continue
+            flagged = True
+            lines.append(
+                f"MISSING {name}: no value in the latest round "
+                f"(best {best_val:g} at r{best_rno:02d})")
+            continue
+        if _is_fallback(rec):
+            # the streak check owns fallback alarms; note it per metric
+            back = rec.get("backend") or "cpu-fallback"
+            lines.append(
+                f"FALLBACK {name}: latest value {val:g} is "
+                f"{back!r} (best non-fallback "
+                f"{best_val:g} at r{best_rno:02d})")
+            continue
+        if lower:
+            floor = best_val + max(abs(best_val) * tolerance,
+                                   _LOWER_ABS_SLACK)
+            bad = val > floor
+        else:
+            floor = best_val * (1.0 - tolerance)
+            bad = val < floor
+        if bad:
+            flagged = True
+            lines.append(
+                f"REGRESSION {name}: latest {val:g} (r{rno:02d}) vs "
+                f"best {best_val:g} (r{best_rno:02d}), tolerance "
+                f"{tolerance:g}")
+        else:
+            lines.append(
+                f"OK {name}: latest {val:g} (r{rno:02d}), best "
+                f"{best_val:g} (r{best_rno:02d})")
+    if not best:
+        lines.append("NOTE no non-fallback metric values anywhere in "
+                     "the trajectory")
+    return lines, 1 if flagged else 0
+
+
+def regression_verdict(paths=None):
+    """The non-fatal sentinel readout shared by ``bench.py`` (suite
+    end) and ``datacheck --profile``: globs ``BENCH_r*.json`` in the
+    cwd when ``paths`` is None.  Returns ``(header, lines, rc)`` or
+    None when no rounds exist.  Gating belongs to the
+    ``--check-regression`` CLI exit code, not to these callers."""
+    if paths is None:
+        import glob
+
+        paths = sorted(glob.glob("BENCH_r*.json"))
+    if not paths:
+        return None
+    lines, rc = check_regression(paths)
+    header = (f"perf-regression sentinel over {len(paths)} round(s): "
+              + ("OK" if rc == 0 else "FLAGGED"))
+    return header, lines, rc
+
+
+# --------------------------------------------------------------------------
+# CLI
+# --------------------------------------------------------------------------
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         prog="pinttrace",
-        description="Summarize a pint_tpu telemetry JSONL trace file")
-    p.add_argument("trace", help="path to the JSONL trace "
-                                 "(PINT_TPU_TRACE output)")
+        description="Summarize/export a pint_tpu telemetry JSONL "
+                    "trace, or gate on a BENCH_r*.json perf "
+                    "trajectory")
+    p.add_argument("paths", nargs="*",
+                   help="the JSONL trace (PINT_TPU_TRACE output); with "
+                        "--check-regression, the BENCH_r*.json round "
+                        "files (default: BENCH_r*.json in the cwd)")
     p.add_argument("--json", action="store_true",
                    help="emit the aggregate as one JSON object instead "
                         "of a table")
+    p.add_argument("--chrome-trace", metavar="OUT",
+                   help="write the span tree as Chrome trace_event "
+                        "JSON (Perfetto-loadable) to OUT")
+    p.add_argument("--programs", action="store_true",
+                   help="print the per-program profiling registry "
+                        "table from the trace's program records")
+    p.add_argument("--check-regression", action="store_true",
+                   help="perf-regression sentinel over bench rounds: "
+                        "exits 1 on regression/fallback-streak/"
+                        "missing metric")
+    p.add_argument("--tolerance", type=float, default=0.5,
+                   help="allowed fractional slack vs the best "
+                        "non-fallback value (default 0.5)")
+    p.add_argument("--streak", type=int, default=2,
+                   help="trailing fallback/failed rounds that flag a "
+                        "streak (default 2)")
     args = p.parse_args(argv)
+
+    if args.check_regression:
+        paths = args.paths
+        if not paths:
+            import glob
+
+            paths = sorted(glob.glob("BENCH_r*.json"))
+        if not paths:
+            print("pinttrace: no BENCH_r*.json rounds found",
+                  file=sys.stderr)
+            return 2
+        lines, rc = check_regression(paths, tolerance=args.tolerance,
+                                     streak=args.streak)
+        for line in lines:
+            print(line)
+        return rc
+
+    if not args.paths:
+        p.error("a trace file is required (or use --check-regression)")
     try:
-        records, n_bad = _load(args.trace)
+        records, n_bad = _load(args.paths[0])
     except OSError as e:
         print(f"pinttrace: {e}", file=sys.stderr)
         return 2
-    if args.json:
+
+    if args.chrome_trace:
+        doc = chrome_trace(records)
+        with open(args.chrome_trace, "w") as f:
+            json.dump(doc, f, separators=(",", ":"))
+        print(f"pinttrace: wrote {len(doc['traceEvents'])} trace "
+              f"events to {args.chrome_trace}")
+    elif args.programs:
+        try:
+            for line in programs_table(records):
+                print(line)
+        except BrokenPipeError:
+            import os
+
+            os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+    elif args.json:
         spans, counters, gauges, metrics, other = aggregate(records)
         print(json.dumps({
             "n_records": len(records), "n_bad": n_bad,

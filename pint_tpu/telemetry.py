@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import atexit
 import json
+import math as _math
 import os
 import threading
 import time
@@ -45,11 +46,14 @@ import time
 __all__ = [
     "span", "configure", "enabled", "emit", "flush",
     "counter_add", "counter_get", "counters", "gauge_set", "gauges",
+    "LogHistogram", "hist_record", "histograms",
+    "add_span_hook", "add_flush_hook",
     "record_transfer", "compile_stats", "summary", "summary_lines",
     "render_stats_lines", "reset", "xprof_trace",
 ]
 
 _TRACE_ENV = "PINT_TPU_TRACE"
+_TRACE_MAX_ENV = "PINT_TPU_TRACE_MAX_MB"
 
 #: process-global state; guarded by _lock for the mutating paths.  The
 #: hot path (span() with telemetry disabled) reads one attribute
@@ -60,7 +64,8 @@ _lock = threading.RLock()
 
 class _State:
     __slots__ = ("enabled", "sink", "sink_owned", "span_stats",
-                 "counters", "gauges", "t_session")
+                 "counters", "gauges", "hists", "t_session",
+                 "sink_path", "sink_bytes", "sink_max_bytes")
 
     def __init__(self):
         self.enabled = False
@@ -70,25 +75,66 @@ class _State:
         self.span_stats: dict = {}
         self.counters: dict = {}
         self.gauges: dict = {}
+        self.hists: dict = {}     # name -> LogHistogram
         self.t_session = time.time()
+        self.sink_path = None     # path of an owned sink (rotation)
+        self.sink_bytes = 0       # bytes written since open/rotate
+        self.sink_max_bytes = 0   # 0 = unbounded (the default)
 
 
 _state = _State()
 
 _tls = threading.local()  # per-thread span stack for nesting
 
+#: extension hooks — profiling (and tests) register callables here;
+#: failures inside a hook must never take a span or a flush down.
+_span_hooks: list = []    # fn(name, dur_s) on every span exit
+_flush_hooks: list = []   # fn() at the start of every flush()
+
+
+def add_span_hook(fn):
+    """Register ``fn(name, dur_s)`` to run on every span exit (only
+    while spans are enabled).  Idempotent per function object."""
+    if fn not in _span_hooks:
+        _span_hooks.append(fn)
+    return fn
+
+
+def add_flush_hook(fn):
+    """Register ``fn()`` to run at the start of every :func:`flush`
+    (profiling uses this to mirror its program registry into the
+    sink).  Idempotent per function object."""
+    if fn not in _flush_hooks:
+        _flush_hooks.append(fn)
+    return fn
+
 
 # --------------------------------------------------------------------------
 # configuration
 # --------------------------------------------------------------------------
 
-def configure(sink=None, enabled=None):
+def _max_bytes_from(max_mb):
+    """Resolve the sink size cap: an explicit ``max_mb`` wins, else
+    ``$PINT_TPU_TRACE_MAX_MB``; 0/unset/unparseable = unbounded."""
+    raw = max_mb if max_mb is not None else os.environ.get(
+        _TRACE_MAX_ENV, "")
+    try:
+        mb = float(raw)
+    except (TypeError, ValueError):
+        return 0
+    return int(mb * 1e6) if mb > 0 else 0
+
+
+def configure(sink=None, enabled=None, max_mb=None):
     """(Re)configure the telemetry layer.
 
     sink: a path (opened append-mode, line-buffered), a file-like
     object with ``.write``, or None to detach the sink.  enabled:
     force spans on/off; defaults to "on iff a sink is attached".
-    Returns the module for chaining."""
+    max_mb: rotate an owned (path) sink once it grows past this many
+    MB (default ``$PINT_TPU_TRACE_MAX_MB``; 0/unset = unbounded — a
+    long-lived warm service should set a cap).  Returns the module for
+    chaining."""
     global _state
     with _lock:
         if _state.sink is not None and _state.sink_owned:
@@ -96,6 +142,9 @@ def configure(sink=None, enabled=None):
                 _state.sink.close()
             except OSError:
                 pass
+        _state.sink_path = None
+        _state.sink_bytes = 0
+        _state.sink_max_bytes = _max_bytes_from(max_mb)
         if sink is None:
             _state.sink = None
             _state.sink_owned = False
@@ -103,14 +152,73 @@ def configure(sink=None, enabled=None):
             _state.sink = sink
             _state.sink_owned = False
         else:
-            _state.sink = open(os.fspath(sink), "a", buffering=1)
+            path = os.fspath(sink)
+            _state.sink = open(path, "a", buffering=1)
             _state.sink_owned = True
+            _state.sink_path = path
+            try:  # append mode: the cap covers the file, not the session
+                _state.sink_bytes = os.path.getsize(path)
+            except OSError:
+                _state.sink_bytes = 0
         _state.enabled = bool(
             _state.sink is not None if enabled is None else enabled
         )
     import sys
 
     return sys.modules[__name__]
+
+
+def _rotate_sink_locked():
+    """Rotate the owned sink file (caller holds ``_lock``): close,
+    move aside as ``<path>.1`` (one generation — the live file plus
+    one keeps disk bounded at ~2x the cap), reopen fresh.  Recorded as
+    the ``telemetry.sink_rotations`` counter plus one record in the
+    new file.
+
+    A failed rename (target is a directory, parent permissions, some
+    overlay mounts) must not be reported as a rotation that happened:
+    the cap is disabled for this sink (otherwise every emit would
+    retry the doomed rename AND the byte counter would restart on an
+    untruncated file, growing it a full cap per cycle), a
+    ``telemetry.sink_rotation_failures`` counter ticks, and the file
+    keeps appending."""
+    path = _state.sink_path
+    try:
+        _state.sink.close()
+    except OSError:
+        pass
+    try:
+        os.replace(path, path + ".1")
+        rotated = True
+    except OSError:
+        rotated = False
+    try:
+        _state.sink = open(path, "a", buffering=1)
+    except OSError:
+        _state.sink = None
+        _state.sink_owned = False
+        _state.sink_path = None
+        return
+    if rotated:
+        _state.sink_bytes = 0
+        _state.counters["telemetry.sink_rotations"] = \
+            _state.counters.get("telemetry.sink_rotations", 0.0) + 1.0
+        rec = {"type": "sink_rotation", "rotated_to": path + ".1",
+               "ts": round(time.time(), 6)}
+    else:
+        _state.sink_max_bytes = 0  # cap unenforceable: stop pretending
+        _state.counters["telemetry.sink_rotation_failures"] = \
+            _state.counters.get(
+                "telemetry.sink_rotation_failures", 0.0) + 1.0
+        rec = {"type": "sink_rotation_failed",
+               "detail": "rename to .1 failed; size cap disabled",
+               "ts": round(time.time(), 6)}
+    line = json.dumps(rec, separators=(",", ":"))
+    try:
+        _state.sink.write(line + "\n")
+        _state.sink_bytes += len(line) + 1
+    except (OSError, ValueError):
+        pass
 
 
 def enabled() -> bool:
@@ -124,6 +232,7 @@ def reset():
         _state.span_stats.clear()
         _state.counters.clear()
         _state.gauges.clear()
+        _state.hists.clear()
         _state.t_session = time.time()
         _tls.stack = []
 
@@ -186,6 +295,10 @@ class _Span:
             "dur_s": round(dur, 9),
             "depth": self.depth,
             "parent": self.parent,
+            # nesting (depth/parent and the span stack) is per-thread;
+            # consumers that lay spans on tracks (chrome_trace) need
+            # the thread identity or concurrent spans garble a track
+            "tid": threading.get_ident(),
         }
         if exc_type is not None:
             rec["error"] = exc_type.__name__
@@ -196,6 +309,11 @@ class _Span:
             st[0] += 1
             st[1] += dur
             st[2] = max(st[2], dur)
+        for hook in _span_hooks:
+            try:
+                hook(self.name, dur)
+            except Exception:
+                pass  # a broken hook must never take a span down
         emit(rec)
         return False
 
@@ -236,8 +354,105 @@ def gauge_set(name, value):
 
 
 def gauges() -> dict:
+    """Snapshot of all gauges.  Histogram percentiles ride along as
+    flattened ``hist.<name>.{p50,p95,p99,n}`` entries — the one
+    readout surface for latency distributions."""
     with _lock:
-        return dict(_state.gauges)
+        out = dict(_state.gauges)
+        snaps = {name: h.snapshot()
+                 for name, h in _state.hists.items()}
+    for name, snap in snaps.items():
+        for k in ("p50", "p95", "p99", "n"):
+            out[f"hist.{name}.{k}"] = snap[k]
+    return out
+
+
+class LogHistogram:
+    """Host-side log-bucketed histogram of positive values (latencies,
+    byte counts): O(1) record into sparse geometric buckets, p50/p95/
+    p99 readout from the cumulative counts.  A bucket's estimate is
+    its geometric midpoint, clamped to the exactly-tracked [min, max]
+    — so a single-value histogram reports that value at every
+    percentile, and p50 <= p95 <= p99 always holds (ranks and bucket
+    indices are both monotone)."""
+
+    __slots__ = ("base", "_log_growth", "counts", "n", "total",
+                 "vmin", "vmax")
+
+    #: default resolution: ~19% bucket width from 1 ns up — 2 decades
+    #: of latency span ~26 buckets
+    BASE = 1e-9
+    GROWTH = 1.1892071150027210667  # 2**0.25
+
+    def __init__(self, base=BASE, growth=GROWTH):
+        self.base = float(base)
+        self._log_growth = _math.log(float(growth))
+        self.counts: dict = {}       # bucket index -> count
+        self.n = 0
+        self.total = 0.0
+        self.vmin = None
+        self.vmax = None
+
+    def record(self, value):
+        v = float(value)
+        if v <= self.base:
+            idx = 0                  # underflow bucket (v <= base)
+        else:
+            idx = 1 + int(_math.log(v / self.base) / self._log_growth)
+        self.counts[idx] = self.counts.get(idx, 0) + 1
+        self.n += 1
+        self.total += v
+        self.vmin = v if self.vmin is None else min(self.vmin, v)
+        self.vmax = v if self.vmax is None else max(self.vmax, v)
+
+    def percentile(self, q):
+        """Value estimate at percentile ``q`` (0-100); None if empty."""
+        if self.n == 0:
+            return None
+        rank = max(1, _math.ceil(q / 100.0 * self.n))
+        cum = 0
+        for idx in sorted(self.counts):
+            cum += self.counts[idx]
+            if cum >= rank:
+                if idx == 0:
+                    est = self.base
+                else:  # geometric midpoint of bucket idx
+                    est = self.base * _math.exp(
+                        (idx - 0.5) * self._log_growth)
+                return min(max(est, self.vmin), self.vmax)
+        return self.vmax  # unreachable (cum ends at n >= rank)
+
+    def snapshot(self) -> dict:
+        return {
+            "n": self.n,
+            "total": self.total,
+            "min": self.vmin,
+            "max": self.vmax,
+            "p50": self.percentile(50),
+            "p95": self.percentile(95),
+            "p99": self.percentile(99),
+        }
+
+
+def hist_record(name, value):
+    """Record one sample into the named log-bucketed histogram.
+    The record happens under the module lock — LogHistogram itself is
+    not thread-safe, and concurrent recorders (profiled calls + span
+    hooks) share these instances."""
+    with _lock:
+        h = _state.hists.get(name)
+        if h is None:
+            h = _state.hists[name] = LogHistogram()
+        h.record(value)
+
+
+def histograms() -> dict:
+    """Snapshot of every histogram: name -> {n, total, min, max, p50,
+    p95, p99}.  Snapshots are taken under the lock so a concurrent
+    record can never be observed half-applied."""
+    with _lock:
+        return {name: h.snapshot()
+                for name, h in _state.hists.items()}
 
 
 def record_transfer(arr, direction="d2h"):
@@ -296,21 +511,38 @@ def emit(record: dict):
                     pass
             _state.sink = None
             _state.sink_owned = False
+            return
+        _state.sink_bytes += len(line) + 1
+        if (_state.sink_owned and _state.sink_max_bytes
+                and _state.sink_path
+                and _state.sink_bytes >= _state.sink_max_bytes):
+            _rotate_sink_locked()
 
 
 def flush():
-    """Emit one record per counter and gauge (the periodic/exit flush),
-    then flush the sink's buffer."""
+    """Emit one record per counter, gauge, and histogram (the
+    periodic/exit flush), then flush the sink's buffer.  Flush hooks
+    (profiling's program-registry mirror) run first so their records
+    land in the same flush."""
+    for hook in _flush_hooks:
+        try:
+            hook()
+        except Exception:
+            pass  # a broken hook must never take the flush down
     ts = round(time.time(), 6)
     with _lock:
         items = list(_state.counters.items())
         gitems = list(_state.gauges.items())
+        hitems = [(name, h.snapshot())
+                  for name, h in _state.hists.items()]
         sink = _state.sink
     for name, value in items:
         emit({"type": "counter", "name": name, "value": value, "ts": ts})
     for name, value in gitems:
         emit({"type": "gauge", "name": name, "value": _jsonable(value),
               "ts": ts})
+    for name, snap in hitems:
+        emit({"type": "hist", "name": name, "ts": ts, **snap})
     if sink is not None and hasattr(sink, "flush"):
         try:
             sink.flush()

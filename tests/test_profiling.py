@@ -1,0 +1,587 @@
+"""Device-truth observability: per-program profiler, latency
+histograms, phase-split attribution, Chrome-trace export, the
+perf-regression sentinel, and the resilient backend probe (ISSUE 6).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from pint_tpu import backend_probe, compile_cache, profiling, telemetry
+from pint_tpu.compile_cache import WARM_WLS_PAR
+from pint_tpu.fitter import GLSFitter, WLSFitter
+from pint_tpu.models.builder import get_model
+from pint_tpu.scripts import pinttrace
+from pint_tpu.simulation import make_fake_toas_uniform
+
+GLS_PAR = (
+    "PSR TESTPROF\nRAJ 05:00:00\nDECJ 20:00:00\n"
+    "F0 300.0 1\nF1 -1e-15 1\nPEPOCH 54000\nDM 15.0 1\n"
+    "TZRMJD 54000\nTZRSITE @\nTZRFRQ 1400\n"
+    "EFAC -f L-wide 1.1\nEQUAD -f L-wide 0.3\n"
+    "TNRedAmp -13.5\nTNRedGam 3.3\nTNRedC 10\nUNITS TDB\n")
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    telemetry.reset()
+    profiling.reset()
+    profiling.configure(None)
+    yield
+    profiling.configure(None)
+    telemetry.configure(sink=None)
+    telemetry.reset()
+    profiling.reset()
+
+
+def _mk(par, n, seed=0):
+    model = get_model(par)
+    toas = make_fake_toas_uniform(
+        53000.0, 56000.0, n, model, freq_mhz=1400.0, obs="gbt",
+        error_us=1.0, add_noise=True, rng=np.random.default_rng(seed),
+        flags={"f": "L-wide"})
+    return model, toas
+
+
+def _monitoring_live():
+    return telemetry.compile_stats()["source"] == "jax.monitoring"
+
+
+# --------------------------------------------------------------------------
+# log-bucketed histogram
+# --------------------------------------------------------------------------
+
+class TestLogHistogram:
+    def test_empty(self):
+        h = telemetry.LogHistogram()
+        s = h.snapshot()
+        assert s["n"] == 0
+        assert s["p50"] is None and s["p99"] is None
+
+    def test_single_value_every_percentile(self):
+        h = telemetry.LogHistogram()
+        h.record(0.0123)
+        s = h.snapshot()
+        # clamped to the exactly-tracked min/max: one sample reports
+        # itself at every percentile, not a bucket edge
+        assert s["p50"] == s["p95"] == s["p99"] == pytest.approx(0.0123)
+        assert s["min"] == s["max"] == pytest.approx(0.0123)
+
+    def test_percentiles_ordered_and_bounded(self):
+        rng = np.random.default_rng(0)
+        h = telemetry.LogHistogram()
+        vals = 10.0 ** rng.uniform(-6, 0, size=500)
+        for v in vals:
+            h.record(v)
+        s = h.snapshot()
+        assert s["n"] == 500
+        assert s["min"] <= s["p50"] <= s["p95"] <= s["p99"] <= s["max"]
+        # bucket resolution: p50 within one bucket width (~19%) of the
+        # exact median
+        exact = float(np.median(vals))
+        assert s["p50"] == pytest.approx(exact, rel=0.25)
+
+    def test_underflow_and_extremes(self):
+        h = telemetry.LogHistogram()
+        for v in (0.0, 1e-12, 5.0):
+            h.record(v)
+        s = h.snapshot()
+        assert s["min"] == 0.0 and s["max"] == 5.0
+        assert s["p50"] is not None
+        assert 0.0 <= s["p50"] <= 5.0
+
+    def test_hist_record_exposed_via_gauges(self):
+        telemetry.hist_record("lat.test", 0.010)
+        telemetry.hist_record("lat.test", 0.020)
+        g = telemetry.gauges()
+        assert g["hist.lat.test.n"] == 2
+        assert g["hist.lat.test.p50"] <= g["hist.lat.test.p99"]
+
+    def test_flush_emits_hist_records(self):
+        import io
+
+        buf = io.StringIO()
+        telemetry.configure(sink=buf)
+        telemetry.hist_record("lat.flush", 0.5)
+        telemetry.flush()
+        recs = [json.loads(ln) for ln in buf.getvalue().splitlines()]
+        hist = [r for r in recs if r["type"] == "hist"]
+        assert hist and hist[0]["name"] == "lat.flush"
+        assert hist[0]["n"] == 1
+
+
+# --------------------------------------------------------------------------
+# per-program phase-split attribution
+# --------------------------------------------------------------------------
+
+class TestPhaseSplit:
+    def test_gate_off_no_accounting(self):
+        m, t = _mk(WARM_WLS_PAR, 80)
+        f = WLSFitter(t, m)
+        f.fit_toas(maxiter=2)
+        assert telemetry.counter_get("profile.calls") == 0
+        assert all(s["calls"] == 0 for s in profiling.programs())
+
+    def test_profiled_gls_step_attribution(self):
+        """The acceptance shape: a warm GLS fit under the profile gate
+        reports a per-call phase split whose device fraction exceeds
+        50% — host dispatch under async dispatch is microseconds while
+        the solve itself is milliseconds."""
+        m, t = _mk(GLS_PAR, 1500)
+        f = GLSFitter(t, m)
+        f.fit_toas(maxiter=3)          # cold, unprofiled
+        base = dict(m.values)
+        names = ("trace_s", "dispatch_s", "device_s")
+        before = {n: telemetry.counter_get("profile." + n)
+                  for n in names}
+        with profiling.profiled():
+            m.values.update(base)
+            f.fit_toas(maxiter=3)      # warm, profiled
+        d = {n: telemetry.counter_get("profile." + n) - before[n]
+             for n in names}
+        total = sum(d.values())
+        assert total > 0
+        assert d["trace_s"] == pytest.approx(0.0, abs=1e-6), \
+            "warm path must not trace"
+        assert d["device_s"] / total > 0.5, d
+        # the program record carries the same story
+        recs = {s["label"]: s for s in profiling.programs()}
+        step = recs["fitter.step:GLSFitter"]
+        assert step["calls"] >= 3
+        assert step["compiles"] == 0     # warm calls compiled nothing
+        assert step["device_p50_s"] <= step["device_p99_s"]
+        assert step["arg_bytes"] > 0 and step["result_bytes"] > 0
+        assert step["analytic_flops"] and step["analytic_flops"] > 0
+        # device-time histogram readout through the shared surface
+        g = telemetry.gauges()
+        key = "hist.program.fitter.step:GLSFitter.device_s.p50"
+        assert key in g and g[key] > 0
+
+    def test_zero_new_compiles_with_profile_on(self):
+        """The ISSUE 6 acceptance regression: with $PINT_TPU_PROFILE=1
+        the second same-shaped fitter still triggers ZERO new XLA
+        compiles — the gate is host-side only and can never change the
+        traced program."""
+        with profiling.profiled():
+            m, t = _mk(WARM_WLS_PAR, 80)
+            f1 = WLSFitter(t, m)
+            f1.fit_toas(maxiter=3)
+            before = telemetry.counter_get("jit.compile_events")
+            hits_before = compile_cache.registry_stats()["hits"]
+            f2 = WLSFitter(t, m)
+            f2.fit_toas(maxiter=3)
+            assert f2._step_jit is f1._step_jit
+            assert compile_cache.registry_stats()["hits"] > hits_before
+            if _monitoring_live():
+                assert telemetry.counter_get(
+                    "jit.compile_events") - before == 0
+
+    def test_cold_call_captures_xla_cost(self):
+        """A compiling profiled call captures XLA cost_analysis flops
+        and reconciles against a registered analytic model: a wildly
+        wrong analytic estimate trips profile.flops_mismatch."""
+        n = 64
+        jitted = compile_cache.shared_jit(
+            lambda a, b: a @ b, key=("test.cost", n),
+            fn_token="test.cost", label="test.cost")
+        jitted.set_analytic_flops(1.0)  # absurd: real cost is 2n^3
+        before = telemetry.counter_get("profile.flops_mismatch")
+        with profiling.profiled():
+            a = jnp.ones((n, n), jnp.float64)
+            jax.block_until_ready(jitted(a, a))
+        st = jitted.stats
+        if st.xla_flops is None:
+            pytest.skip("cost_analysis unavailable on this jax")
+        assert st.xla_flops > 1e5  # ~2*64^3 = 5.2e5
+        assert telemetry.counter_get("profile.flops_mismatch") \
+            - before >= 1
+
+    def test_proxy_forwards_lower(self):
+        """AOT warmup goes through the proxy: .lower() must forward."""
+        m, t = _mk(WARM_WLS_PAR, 64)
+        f = WLSFitter(t, m)
+        assert f.warm_compile() >= 0.0
+
+    def test_memory_watermarks(self):
+        x = jnp.ones(1024, jnp.float64)
+        jax.block_until_ready(x)
+        out = profiling.sample_memory()
+        assert out.get("live_buffer_bytes", 0) >= x.nbytes
+        g = telemetry.gauges()
+        assert g["profile.live_buffer_bytes"] >= x.nbytes
+        assert g["profile.live_buffer_peak_bytes"] >= \
+            g["profile.live_buffer_bytes"] or True  # peak >= current
+        del x
+
+    def test_span_hook_records_latency_hist(self):
+        telemetry.configure(sink=None, enabled=True)
+        try:
+            with profiling.profiled():
+                with telemetry.span("hooked"):
+                    pass
+            assert "span.hooked" in telemetry.histograms()
+        finally:
+            telemetry.configure(sink=None)
+
+
+# --------------------------------------------------------------------------
+# JSONL sink rotation
+# --------------------------------------------------------------------------
+
+class TestSinkRotation:
+    def test_rotation_caps_file(self, tmp_path):
+        path = tmp_path / "trace.jsonl"
+        telemetry.configure(sink=str(path), max_mb=0.0005)  # 500 bytes
+        try:
+            for i in range(50):
+                telemetry.emit({"type": "filler", "i": i,
+                                "pad": "x" * 40})
+        finally:
+            telemetry.configure(sink=None)
+        rotated = tmp_path / "trace.jsonl.1"
+        assert rotated.exists()
+        assert telemetry.counter_get("telemetry.sink_rotations") >= 1
+        # live file stays bounded (~cap + one record)
+        assert path.stat().st_size < 2000
+        # the rotation left parseable JSONL on both sides
+        for p in (path, rotated):
+            for ln in p.read_text().splitlines():
+                json.loads(ln)
+
+    def test_unbounded_by_default(self, tmp_path):
+        path = tmp_path / "t.jsonl"
+        telemetry.configure(sink=str(path))
+        try:
+            for i in range(50):
+                telemetry.emit({"type": "filler", "i": i})
+        finally:
+            telemetry.configure(sink=None)
+        assert not (tmp_path / "t.jsonl.1").exists()
+
+    def test_failed_rotation_is_honest(self, tmp_path):
+        """A failed rename must not be reported as a rotation: the cap
+        disables (no unbounded grow-by-a-cap-per-cycle retry loop), a
+        failure counter ticks, and the rotations counter does NOT."""
+        path = tmp_path / "trace.jsonl"
+        (tmp_path / "trace.jsonl.1").mkdir()  # rename target blocked
+        telemetry.configure(sink=str(path), max_mb=0.0002)
+        try:
+            for i in range(30):
+                telemetry.emit({"type": "filler", "i": i,
+                                "pad": "x" * 40})
+            assert telemetry.counter_get(
+                "telemetry.sink_rotation_failures") >= 1
+            assert telemetry.counter_get(
+                "telemetry.sink_rotations") == 0
+            # cap disabled after the failure: exactly one failure tick
+            assert telemetry.counter_get(
+                "telemetry.sink_rotation_failures") == 1
+            text = path.read_text()
+            assert "sink_rotation_failed" in text
+            assert '"type":"sink_rotation"' not in text.replace(
+                "sink_rotation_failed", "")
+        finally:
+            telemetry.configure(sink=None)
+
+
+# --------------------------------------------------------------------------
+# chrome-trace export
+# --------------------------------------------------------------------------
+
+class TestChromeTrace:
+    def _trace_file(self, tmp_path):
+        path = tmp_path / "trace.jsonl"
+        telemetry.configure(sink=str(path))
+        try:
+            with telemetry.span("outer", n=1):
+                with telemetry.span("inner"):
+                    pass
+            telemetry.emit({"type": "metric", "metric": "m1",
+                            "value": 3.0, "ts": 1000.0,
+                            "backend": "cpu"})
+            telemetry.counter_add("c1", 2)
+            telemetry.flush()
+        finally:
+            telemetry.configure(sink=None)
+        return path
+
+    def test_roundtrip_schema(self, tmp_path):
+        src = self._trace_file(tmp_path)
+        out = tmp_path / "chrome.json"
+        rc = pinttrace.main(["--chrome-trace", str(out), str(src)])
+        assert rc == 0
+        doc = json.loads(out.read_text())
+        assert isinstance(doc["traceEvents"], list)
+        evs = doc["traceEvents"]
+        xs = [e for e in evs if e["ph"] == "X"]
+        assert {e["name"] for e in xs} == {"outer", "inner"}
+        for e in xs:  # trace_event schema for complete events
+            assert isinstance(e["ts"], float)
+            assert isinstance(e["dur"], float)
+            assert e["pid"] == 1 and isinstance(e["tid"], int)
+        # same recording thread -> same track (nesting needs it)
+        assert len({e["tid"] for e in xs}) == 1
+        # nesting preserved: inner's interval inside outer's
+        outer = next(e for e in xs if e["name"] == "outer")
+        inner = next(e for e in xs if e["name"] == "inner")
+        assert outer["ts"] <= inner["ts"]
+        assert inner["ts"] + inner["dur"] \
+            <= outer["ts"] + outer["dur"] + 1.0  # 1 us slack
+        assert inner["args"]["depth"] == 1
+        assert inner["args"]["parent"] == "outer"
+        # metric -> instant event, counter -> C sample
+        assert any(e["ph"] == "i" and e["name"] == "metric:m1"
+                   for e in evs)
+        assert any(e["ph"] == "C" and e["name"] == "c1" for e in evs)
+        # sorted by timestamp (viewer requirement)
+        tss = [e["ts"] for e in evs]
+        assert tss == sorted(tss)
+
+    def test_programs_table_from_trace(self, tmp_path):
+        path = tmp_path / "trace.jsonl"
+        telemetry.configure(sink=str(path))
+        try:
+            with profiling.profiled():
+                jitted = compile_cache.shared_jit(
+                    lambda x: x * 2, key=("test.prog",),
+                    fn_token="test.prog", label="test.prog")
+                jax.block_until_ready(jitted(jnp.ones(8)))
+            telemetry.flush()
+        finally:
+            telemetry.configure(sink=None)
+        records, n_bad = pinttrace._load(str(path))
+        assert n_bad == 0
+        lines = pinttrace.programs_table(records)
+        assert any("test.prog" in ln for ln in lines)
+
+
+# --------------------------------------------------------------------------
+# perf-regression sentinel
+# --------------------------------------------------------------------------
+
+def _write_rounds(tmp_path, rounds):
+    """rounds: list of lists of metric records."""
+    paths = []
+    for i, metrics in enumerate(rounds, 1):
+        p = tmp_path / f"BENCH_r{i:02d}.json"
+        p.write_text(json.dumps({"n": i, "metrics": metrics}))
+        paths.append(str(p))
+    return paths
+
+
+def _rec(name, value, backend="tpu"):
+    return {"metric": name, "value": value, "backend": backend}
+
+
+class TestCheckRegression:
+    def test_improving_trajectory_exits_zero(self, tmp_path):
+        paths = _write_rounds(tmp_path, [
+            [_rec("gls", 10.0), _rec("grid", 1.0)],
+            [_rec("gls", 20.0), _rec("grid", 2.0)],
+            [_rec("gls", 30.0), _rec("grid", 3.0)],
+        ])
+        lines, rc = pinttrace.check_regression(paths)
+        assert rc == 0
+        assert all(ln.startswith("OK") for ln in lines)
+
+    def test_regression_flagged(self, tmp_path):
+        paths = _write_rounds(tmp_path, [
+            [_rec("gls", 100.0)],
+            [_rec("gls", 10.0)],
+        ])
+        lines, rc = pinttrace.check_regression(paths, tolerance=0.5)
+        assert rc == 1
+        assert any(ln.startswith("REGRESSION gls") for ln in lines)
+
+    def test_tolerance_configurable(self, tmp_path):
+        paths = _write_rounds(tmp_path, [
+            [_rec("gls", 100.0)],
+            [_rec("gls", 60.0)],
+        ])
+        _, rc_tight = pinttrace.check_regression(paths, tolerance=0.2)
+        _, rc_loose = pinttrace.check_regression(paths, tolerance=0.5)
+        assert rc_tight == 1 and rc_loose == 0
+
+    def test_fallback_streak_flagged(self, tmp_path):
+        paths = _write_rounds(tmp_path, [
+            [_rec("gls", 100.0)],
+            [_rec("gls", 90.0, backend="cpu-fallback")],
+            [_rec("gls", 95.0, backend="cpu-fallback")],
+        ])
+        lines, rc = pinttrace.check_regression(paths, streak=2)
+        assert rc == 1
+        assert any(ln.startswith("FALLBACK-STREAK") for ln in lines)
+
+    def test_single_fallback_round_not_a_streak(self, tmp_path):
+        paths = _write_rounds(tmp_path, [
+            [_rec("gls", 100.0)],
+            [_rec("gls", 90.0, backend="cpu-fallback")],
+        ])
+        _, rc = pinttrace.check_regression(paths, streak=2)
+        assert rc == 0
+
+    def test_missing_metric_flagged(self, tmp_path):
+        paths = _write_rounds(tmp_path, [
+            [_rec("gls", 100.0), _rec("grid", 5.0)],
+            [_rec("gls", 110.0)],
+        ])
+        lines, rc = pinttrace.check_regression(paths)
+        assert rc == 1
+        assert any(ln.startswith("MISSING grid") for ln in lines)
+
+    def test_single_empty_round_below_streak_not_missing(self, tmp_path):
+        """One transient empty round below --streak must not
+        MISSING-flag every metric — that alarm belongs to the streak
+        check and the caller chose to tolerate a single bad round."""
+        paths = _write_rounds(tmp_path, [
+            [_rec("gls", 10.0), _rec("grid", 5.0)],
+            [],
+        ])
+        lines, rc = pinttrace.check_regression(paths, streak=2)
+        assert rc == 0
+        assert not any(ln.startswith("MISSING") for ln in lines)
+
+    def test_lower_is_better_metric(self, tmp_path):
+        paths = _write_rounds(tmp_path, [
+            [_rec("guard_overhead", 1.0)],
+            [_rec("guard_overhead", 4.0)],
+        ])
+        lines, rc = pinttrace.check_regression(paths, tolerance=0.5)
+        assert rc == 1
+        assert any("REGRESSION guard_overhead" in ln for ln in lines)
+
+    def test_real_trajectory_flags_r03_r05_streak(self):
+        """The ISSUE 6 acceptance: the recorded BENCH_r01-r05 set must
+        flag the r03-r05 cpu-fallback streak and exit nonzero."""
+        import glob
+
+        paths = sorted(glob.glob(os.path.join(
+            os.path.dirname(os.path.dirname(__file__)),
+            "BENCH_r0*.json")))
+        if len(paths) < 5:
+            pytest.skip("recorded bench trajectory not present")
+        lines, rc = pinttrace.check_regression(paths)
+        assert rc == 1
+        assert any("FALLBACK-STREAK" in ln and "r03" in ln
+                   and "r05" in ln for ln in lines)
+
+    def test_cli_entry(self, tmp_path):
+        paths = _write_rounds(tmp_path, [
+            [_rec("gls", 10.0)], [_rec("gls", 20.0)],
+        ])
+        assert pinttrace.main(["--check-regression"] + paths) == 0
+
+    def test_driver_tail_layout(self, tmp_path):
+        """The real driver layout: metrics as JSON lines inside a
+        captured 'tail' log, fallback labeled only in the unit str."""
+        p = tmp_path / "BENCH_r01.json"
+        line = json.dumps({"metric": "gls", "value": 5.0,
+                           "unit": "TOAs/s (backend=cpu-fallback)",
+                           "vs_baseline": 1.0})
+        p.write_text(json.dumps(
+            {"n": 1, "rc": 1, "tail": f"noise\n{line}\nmore noise"}))
+        n, metrics = pinttrace._parse_round(str(p))
+        assert n == 1 and len(metrics) == 1
+        assert pinttrace._is_fallback(metrics[0])
+
+
+# --------------------------------------------------------------------------
+# resilient backend probe
+# --------------------------------------------------------------------------
+
+class TestProbeRetry:
+    def test_always_timeout_probe_exhausts_retries(self, monkeypatch):
+        """An injected always-timeout probe (the faults.py idiom: a
+        deterministic failure at the boundary) must exhaust the
+        bounded retries, accumulate backoff telemetry, and report the
+        attempt count."""
+        calls = []
+
+        def dead_probe():
+            calls.append(1)
+            return False, "probe timed out after 1s (hung device tunnel)"
+
+        sleeps = []
+        monkeypatch.setattr(backend_probe.time, "sleep",
+                            lambda s: sleeps.append(s))
+        a0 = telemetry.counter_get("probe.attempts")
+        b0 = telemetry.counter_get("probe.backoff_s")
+        ok, detail = backend_probe.probe_with_retry(
+            timeout_s=1.0, retries=3, backoff_s=0.5,
+            probe_fn=dead_probe)
+        assert not ok
+        assert len(calls) == 3
+        assert sleeps == [0.5, 1.0]  # exponential backoff
+        assert telemetry.counter_get("probe.attempts") - a0 == 3
+        assert telemetry.counter_get("probe.backoff_s") - b0 \
+            == pytest.approx(1.5)
+        assert "after 3 attempt(s)" in detail
+
+    def test_transient_failure_recovers(self, monkeypatch):
+        """The roadmap 5c contract: a transiently hung tunnel yields a
+        recovered run, not a mislabeled CPU floor."""
+        state = {"n": 0}
+
+        def flaky_probe():
+            state["n"] += 1
+            if state["n"] < 2:
+                return False, "probe timed out (hung device tunnel)"
+            return True, "tpu"
+
+        monkeypatch.setattr(backend_probe.time, "sleep", lambda s: None)
+        r0 = telemetry.counter_get("probe.recoveries")
+        ok, detail = backend_probe.probe_with_retry(
+            timeout_s=1.0, retries=3, backoff_s=0.01,
+            probe_fn=flaky_probe)
+        assert ok
+        assert "recovered on attempt 2/3" in detail
+        assert telemetry.counter_get("probe.recoveries") - r0 == 1
+
+    def test_first_try_success_no_backoff(self):
+        b0 = telemetry.counter_get("probe.backoff_s")
+        ok, detail = backend_probe.probe_with_retry(
+            retries=3, backoff_s=5.0, probe_fn=lambda: (True, "tpu"))
+        assert ok and detail == "tpu"
+        assert telemetry.counter_get("probe.backoff_s") - b0 == 0
+
+    def test_env_defaults(self, monkeypatch):
+        monkeypatch.setenv("PINT_TPU_PROBE_RETRIES", "2")
+        monkeypatch.setattr(backend_probe.time, "sleep", lambda s: None)
+        calls = []
+
+        def dead():
+            calls.append(1)
+            return False, "down"
+
+        ok, _ = backend_probe.probe_with_retry(
+            timeout_s=1.0, backoff_s=0.01, probe_fn=dead)
+        assert not ok and len(calls) == 2
+
+    def test_ensure_live_backend_short_circuits_on_cpu(self):
+        """Under the tier-1 CPU pin nothing can hang: the probe must
+        not even run (a subprocess per test would be pure waste)."""
+        ok, detail = backend_probe.ensure_live_backend(
+            probe_fn=lambda: (False, "must not be called"))
+        assert ok and "pre-forced" in detail
+
+
+# --------------------------------------------------------------------------
+# datacheck --profile
+# --------------------------------------------------------------------------
+
+class TestDatacheckProfile:
+    def test_profile_section_reports_ok(self):
+        from pint_tpu.datacheck import _profile_section
+
+        lines = _profile_section()
+        text = "\n".join(lines)
+        assert "zero-recompile smoke" in text
+        assert "OK" in text
+        assert "PROBLEM" not in text
+        assert "per-program registry" in text
+        assert "histograms:" in text
