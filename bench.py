@@ -611,6 +611,158 @@ def bench_os(jnp, backend):
     })
 
 
+def bench_grid_sharded(jnp, backend):
+    """The chi^2 grid through the one mesh layer (parallel/mesh.py):
+    grid points sharded over every visible device (on CPU the child
+    forces 8 host devices — see _sharded_env).  Records the structured
+    ``mesh`` field (device count + axis layout) and the
+    sharded-vs-unsharded delta alongside the rate — a sharded number
+    that silently diverged from the single-program result would be
+    worthless."""
+    from pint_tpu.grid import make_grid_fn
+    from pint_tpu.models.builder import get_model
+    from pint_tpu.parallel import make_mesh, mesh_desc
+
+    model = get_model(B1855_LIKE_PAR)
+    n_toas = 4000
+    toas = _sim_two_band(model, n_toas, seed=1)
+    n_side = 16
+    m2s = 0.26 + np.linspace(-2, 2, n_side) * 0.0075
+    sinis = np.clip(0.999 + np.linspace(-2, 2, n_side) * 0.0002,
+                    None, 0.99999)
+    pts = np.array([(a, b) for a in m2s for b in sinis])
+    mesh = make_mesh("grid")
+    fn_ref, _, _ = make_grid_fn(toas, model, ["M2", "SINI"], n_steps=3)
+    chi2_ref = np.asarray(fn_ref(jnp.asarray(pts))[0])
+    fn, _, part = make_grid_fn(toas, model, ["M2", "SINI"], n_steps=3,
+                               mesh=mesh)
+    compile_s = _timed_compile(lambda: np.asarray(fn(jnp.asarray(pts))[0]))
+    fn2, _, _ = make_grid_fn(toas, model, ["M2", "SINI"], n_steps=3,
+                             mesh=mesh)
+    warm_s, _ = _timed_compile2(lambda: np.asarray(fn2(jnp.asarray(pts))[0]))
+    t0 = time.time()
+    chi2 = np.asarray(fn(jnp.asarray(pts))[0])
+    wall = time.time() - t0
+    assert np.all(np.isfinite(chi2)), "sharded grid non-finite chi2"
+    delta = float(np.max(np.abs(chi2 - chi2_ref)
+                         / np.maximum(np.abs(chi2_ref), 1e-300)))
+    assert delta < 1e-6, \
+        f"sharded grid diverged from unsharded (rel {delta:.2e})"
+    rate = len(pts) / wall
+    from pint_tpu import flops as fl
+
+    nfree = len(model.free_params) - 2
+    flops = fl.wls_grid_flops(len(pts), n_toas, nfree, n_iter=3,
+                              n_lin=int(part.get("n_linear", 0)))
+    ndev = int(mesh.devices.size)
+    _emit_metric({
+        "metric": "grid_pts_per_sec_sharded",
+        "value": round(rate, 2),
+        "unit": f"grid points/s ((M2,SINI) {n_side}x{n_side}, "
+                f"{n_toas} TOAs, 3 GN iters/pt, sharded over {ndev} "
+                f"device(s) via the mesh layer, "
+                f"sharded==unsharded rel {delta:.1e}, "
+                f"backend={backend}, compile={compile_s:.1f}s"
+                f"/warm {warm_s:.1f}s"
+                + _mfu_str(flops, wall, backend) + ")",
+        "vs_baseline": round(rate / (9.0 / 176.437), 1),
+        "backend": backend,
+        "compile_s": _cold_warm(compile_s, warm_s),
+        "flops": flops,
+        "mesh": {**(mesh_desc(mesh) or {}),
+                 "sharded_unsharded_rel_delta": delta},
+    })
+
+
+def bench_pta_sharded(jnp, backend):
+    """The batched PTA fit sharded over the pulsar axis through the
+    mesh layer, at a pulsar count that does NOT divide the device
+    count — the phantom-member pad path is part of the measurement.
+    Structured ``mesh`` field + sharded==unsharded delta recorded."""
+    from pint_tpu.models.builder import get_model
+    from pint_tpu.parallel import PTABatch, make_mesh, mesh_desc
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    n_psr = 20  # on 8 devices: pads to 24 (phantom members exercised)
+    n_toas = 200
+    binaries = [
+        "",
+        "BINARY ELL1\nPB 12.5 1\nA1 9.2 1\nTASC 54500.5 1\n"
+        "EPS1 1e-5 1\nEPS2 -2e-5 1\n",
+        "BINARY DD\nPB 8.3 1\nA1 6.1 1\nT0 54500.2 1\nECC 0.17 1\n"
+        "OM 110.0 1\n",
+    ]
+    noise = ("EFAC -f L-wide 1.1\nEQUAD -f L-wide 0.4\n"
+             "ECORR -f L-wide 0.6\nTNRedAmp -13.0\nTNRedGam 3.0\n"
+             "TNRedC 10\n")
+
+    def build_pairs():
+        rng = np.random.default_rng(0)
+        pairs = []
+        for i in range(n_psr):
+            f0 = 100.0 + 400.0 * rng.random()
+            par = (f"PSR FAKE{i:02d}\nRAJ {i % 24:02d}:10:00\n"
+                   f"DECJ {(i * 3) % 60 - 30:+03d}:00:00\nF0 {f0!r} 1\n"
+                   f"F1 -1e-15 1\nPEPOCH 54500\nDM {10 + i * 0.5} 1\n"
+                   "TZRMJD 54500\nTZRSITE @\nTZRFRQ 1400\n"
+                   "UNITS TDB\nEPHEM builtin\n") \
+                + binaries[i % len(binaries)] + noise
+            m = get_model(par)
+            t = make_fake_toas_uniform(
+                53000, 56000, n_toas, m, obs="gbt", error_us=1.0,
+                add_noise=True, rng=np.random.default_rng(i),
+                freq_mhz=np.where(np.arange(n_toas) % 2 == 0, 1400.0,
+                                  800.0),
+                flags={"f": "L-wide"})
+            pairs.append((m, t))
+        return pairs
+
+    mesh = make_mesh("pulsar")
+    ref = PTABatch(build_pairs())
+    _, chi2_ref, _ = ref.fit_gls(maxiter=3)
+    chi2_ref = np.asarray(chi2_ref)
+    batch = PTABatch(build_pairs())
+    compile_s = _timed_compile(
+        lambda: batch.fit_gls(maxiter=3, mesh=mesh))
+    chi2 = np.asarray(batch.fit_gls(maxiter=3, mesh=mesh)[1])
+    delta = float(np.max(np.abs(chi2 - chi2_ref)
+                         / np.maximum(np.abs(chi2_ref), 1e-300)))
+    assert delta < 1e-5, \
+        f"sharded PTA fit diverged from unsharded (rel {delta:.2e})"
+    batch_w = PTABatch(build_pairs())
+    warm_s, _ = _timed_compile2(
+        lambda: batch_w.fit_gls(maxiter=3, mesh=mesh))
+    t0 = time.time()
+    _, chi2_t, _ = batch.fit_gls(maxiter=3, mesh=mesh)
+    np.asarray(chi2_t)
+    wall = time.time() - t0
+    fits = n_psr / wall
+    from pint_tpu import flops as fl
+
+    flops = fl.pta_batch_flops(n_psr, n_toas, len(batch.free_names),
+                               batch._noise_basis_width(), n_iter=3,
+                               n_lin=len(batch._partition[0]))
+    ndev = int(mesh.devices.size)
+    _emit_metric({
+        "metric": "pta_batch_fits_per_sec_sharded",
+        "value": round(fits, 2),
+        "unit": f"pulsar GLS fits/s ({n_psr} pulsars "
+                f"(isolated+ELL1+DD, ECORR+rednoise) x {n_toas} TOAs "
+                f"sharded over {ndev} device(s) via the mesh layer "
+                f"(phantom-padded to a device multiple), "
+                f"sharded==unsharded rel {delta:.1e}, "
+                f"backend={backend}, compile={compile_s:.1f}s"
+                f"/warm {warm_s:.1f}s"
+                + _mfu_str(flops, wall, backend) + ")",
+        "vs_baseline": round(fits / 0.05, 1),
+        "backend": backend,
+        "compile_s": _cold_warm(compile_s, warm_s),
+        "flops": flops,
+        "mesh": {**(mesh_desc(mesh) or {}),
+                 "sharded_unsharded_rel_delta": delta},
+    })
+
+
 def bench_guard(jnp, backend):
     """Guard overhead: steady-state wall of ONE jitted GLS step with
     the health pytree riding the program (PINT_TPU_GUARD default) vs
@@ -770,10 +922,28 @@ _METRICS = {
     "mcmc": bench_mcmc,
     "os": bench_os,
     "pta": bench_pta,
+    "grid_sharded": bench_grid_sharded,
+    "pta_sharded": bench_pta_sharded,
     "guard_overhead": bench_guard,
     "profile_overhead": bench_profile_overhead,
     "gls": bench_gls,
 }
+
+
+def _sharded_env(name):
+    """For the ``*_sharded`` metrics: force a multi-device host
+    platform BEFORE jax initializes.  The flag only affects the Host
+    (CPU) platform — on a real TPU it is inert and the mesh uses the
+    chips — so a CPU round still measures a real 8-way partition
+    instead of a degenerate 1-device mesh."""
+    import os
+
+    if not name.endswith("_sharded"):
+        return
+    flag = "--xla_force_host_platform_device_count=8"
+    cur = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in cur:
+        os.environ["XLA_FLAGS"] = (cur + " " + flag).strip()
 
 
 def _force_cpu_if_requested():
@@ -794,6 +964,7 @@ def _run_one(name):
     """Child-process entry: run a single metric inline."""
     import os
 
+    _sharded_env(name)  # before jax import: device-count env is final
     _force_cpu_if_requested()
     import jax
     import jax.numpy as jnp

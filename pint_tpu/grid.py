@@ -174,7 +174,16 @@ def _make_fit_one(prepared, resids, grid_params, fit_params, n_steps):
     return fit_one, partition_record
 
 
-def make_grid_fn(toas, model, grid_params, n_steps=3):
+def _grid_rules():
+    """The grid-axis partition-rule table: the one data leaf crossing
+    the jit boundary is the (npoints, k) grid-value array, sharded on
+    its point axis (everything else is baked into the grid trace)."""
+    from jax.sharding import PartitionSpec as P
+
+    return ((r"^grid_values$", P("grid")),)
+
+
+def make_grid_fn(toas, model, grid_params, n_steps=3, mesh=None):
     """Compile once, call many times: returns (fn, fit_params,
     partition) where fn(grid_values (n,k)) -> (chi2 (n,), fitted
     (n, nfree)) and partition records the structure choice this build
@@ -185,7 +194,16 @@ def make_grid_fn(toas, model, grid_params, n_steps=3):
     The jitted grid is registry-shared (compile_cache.shared_jit): the
     grid program bakes its dataset in as constants, so the key carries
     a CONTENT fingerprint — a rebuilt grid over the same data, params
-    and step count reuses the previous trace and executable."""
+    and step count reuses the previous trace and executable.
+
+    mesh: a device mesh (:func:`pint_tpu.parallel.mesh.make_mesh`,
+    axis ``grid``) — grid points are padded to a device multiple
+    (edge-repeated; outputs sliced back to the requested count) and
+    sharded over the mesh.  The mesh participates in the jit key, so a
+    second same-shaped sharded call compiles nothing; ``mesh=None``
+    keys and behaves exactly as before."""
+    from pint_tpu.parallel import mesh as _mesh
+
     resids = Residuals(toas, model)
     prepared = resids.prepared
     grid_params = list(grid_params)
@@ -205,24 +223,46 @@ def make_grid_fn(toas, model, grid_params, n_steps=3):
            # the gates change the traced program (partition + frozen
            # leaves derive deterministically from them + the free set)
            hybrid_design_default(), frozen_delay_default(),
-           _cc.fingerprint((resids._data(), prepared.model.values)))
-    return _cc.shared_jit(
+           _cc.fingerprint((resids._data(), prepared.model.values))) \
+        + _mesh.mesh_jit_key(mesh)
+    jitted = _cc.shared_jit(
         jax.vmap(fit_one), key=key, fn_token="grid.make_grid_fn",
-        label=f"grid.fit_one:{'+'.join(grid_params)}"), fit_params, \
-        partition
+        label=f"grid.fit_one:{'+'.join(grid_params)}"
+              + (":sharded" if mesh is not None else ""))
+    jitted.set_mesh(_mesh.mesh_desc(mesh))
+    if mesh is None:
+        return jitted, fit_params, partition
+
+    ndev = _mesh.axis_size(mesh, "grid")
+    rules = _grid_rules()
+
+    def sharded_fn(grid_values):
+        n = int(np.shape(grid_values)[0])
+        n_pad = _mesh.pad_to_multiple(n, ndev)
+        _mesh.record_pad_waste("grid", n, n_pad)
+        gv = _mesh.pad_leading(grid_values, n_pad, mode="edge")
+        gv = _mesh.shard_args(mesh, rules, {"grid_values": gv})[
+            "grid_values"]
+        chi2, fitted = jitted(gv)
+        return chi2[:n], fitted[:n]
+
+    return sharded_fn, fit_params, partition
 
 
 def grid_chisq_vectorized(
-    toas, model, grid_params, grid_values, n_steps=3, chunk=None
+    toas, model, grid_params, grid_values, n_steps=3, chunk=None,
+    mesh=None
 ):
     """chi^2 over an (npoints, len(grid_params)) array of grid values.
 
     Returns (chi2 array (npoints,), fitted free params (npoints, nfree)).
     The whole grid runs as vmap(fit_one) in one jit; ``chunk`` bounds
-    device memory for very large grids.
+    device memory for very large grids; ``mesh`` shards the point axis
+    over devices (see :func:`make_grid_fn`).
     """
     grid_values = jnp.asarray(grid_values, dtype=jnp.float64)
-    fn, _, _ = make_grid_fn(toas, model, grid_params, n_steps)
+    fn, _, _ = make_grid_fn(toas, model, grid_params, n_steps,
+                            mesh=mesh)
     if chunk is None or grid_values.shape[0] <= chunk:
         chi2, fitted = fn(grid_values)
     else:
@@ -236,7 +276,7 @@ def grid_chisq_vectorized(
 
 
 def grid_chisq_tuple(toas, model, param_names, points, n_steps=3,
-                     chunk=None):
+                     chunk=None, mesh=None):
     """chi^2 at an explicit list of parameter tuples instead of a dense
     mesh (reference: gridutils.tuple_chisq, gridutils.py:588) — e.g.
     the points of a Monte-Carlo scan or a confidence contour.
@@ -251,18 +291,19 @@ def grid_chisq_tuple(toas, model, param_names, points, n_steps=3,
     Returns (chi2 (npoints,), fitted free params (npoints, nfree))."""
     return grid_chisq_vectorized(
         toas, model, list(param_names), np.asarray(points, np.float64),
-        n_steps=n_steps, chunk=chunk)
+        n_steps=n_steps, chunk=chunk, mesh=mesh)
 
 
 def grid_chisq(toas, model, param_names, param_arrays, n_steps=3,
-               chunk=None):
+               chunk=None, mesh=None):
     """Dense mesh grid like the reference API: param_arrays are 1-D axes;
     returns chi2 with shape (len(axis1), len(axis2), ...).  Per-point
     failure semantics: see grid_chisq_tuple."""
     axes = [np.asarray(a, dtype=np.float64) for a in param_arrays]
-    mesh = np.array(list(itertools.product(*axes)))
+    pts = np.array(list(itertools.product(*axes)))
     chi2, _ = grid_chisq_vectorized(
-        toas, model, param_names, mesh, n_steps=n_steps, chunk=chunk
+        toas, model, param_names, pts, n_steps=n_steps, chunk=chunk,
+        mesh=mesh
     )
     return chi2.reshape([len(a) for a in axes])
 

@@ -306,25 +306,66 @@ class CommonProcess:
                     detail=f"lnlike({log10_amp}, {gamma}) non-finite")
             return float(out)
 
-    def lnlike_grid(self, log10_amps, gammas):
+    #: lnlike_grid partition rules: the two point-axis arrays ride the
+    #: ``grid`` axis; every stacked-array/basis leaf is explicitly
+    #: replicated (each device evaluates its grid points against the
+    #: full array), so rule resolution covers EVERY leaf of the call
+    _GRID_RULES = (
+        (r"^(log10_amps|gammas)$", "grid"),
+        (r"^(r|sigma|U_full|phi_noise|orf|freqs)$", None),
+    )
+
+    def lnlike_grid(self, log10_amps, gammas, mesh=None):
         """(A, G) log-likelihood surface over the outer product of the
         two 1-d grids — one vmapped program.  Non-finite grid points
         are counted (``guard.trip.gw_lnlike_grid``) and warned about,
-        never silently returned as a clean-looking surface."""
+        never silently returned as a clean-looking surface.
+
+        mesh: a device mesh (axis ``grid``) — the flattened point axis
+        is padded to a device multiple (edge-repeated; the pad points
+        are sliced off the returned surface) and sharded; the mesh
+        participates in the jit key, so a second same-shaped sharded
+        call compiles nothing and ``mesh=None`` behaves exactly as
+        before."""
+        from jax.sharding import PartitionSpec as P
+
+        from pint_tpu.parallel import mesh as _mesh
+
         log10_amps = np.atleast_1d(np.asarray(log10_amps, np.float64))
         gammas = np.atleast_1d(np.asarray(gammas, np.float64))
         aa, gg = np.meshgrid(log10_amps, gammas, indexing="ij")
-        fn = _cc.shared_jit(_crn_lnlike_vec,
-                            key=("gw.common.lnlike_grid",),
-                            fn_token="gw.common.lnlike_grid")
+        fn = _cc.shared_jit(
+            _crn_lnlike_vec,
+            key=("gw.common.lnlike_grid",) + _mesh.mesh_jit_key(mesh),
+            fn_token="gw.common.lnlike_grid",
+            label="gw.common.lnlike_grid"
+                  + (":sharded" if mesh is not None else ""))
+        fn.set_mesh(_mesh.mesh_desc(mesh))
+        n_pts = aa.size
+        amps_flat, gams_flat = (jnp.asarray(aa.ravel()),
+                                jnp.asarray(gg.ravel()))
+        args = {
+            "r": self.r, "sigma": self.sigma, "U_full": self.U_full,
+            "phi_noise": self.phi_noise, "orf": self.orf,
+            "freqs": self.freqs, "df": self.df,
+            "n_toa": jnp.float64(self.n_toa_total),
+            "log10_amps": amps_flat, "gammas": gams_flat,
+        }
+        if mesh is not None:
+            ndev = _mesh.axis_size(mesh, "grid")
+            n_pad = _mesh.pad_to_multiple(n_pts, ndev)
+            _mesh.record_pad_waste("grid", n_pts, n_pad)
+            for k in ("log10_amps", "gammas"):
+                args[k] = _mesh.pad_leading(args[k], n_pad,
+                                            mode="edge")
+            rules = tuple(
+                (pat, P(ax) if ax else None)
+                for pat, ax in self._GRID_RULES)
+            args = _mesh.shard_args(mesh, rules, args)
         with span("gw.common.lnlike_grid", n_pulsars=self.n_pulsars,
-                  n_points=aa.size):
-            out, _health = fn(
-                self.r, self.sigma, self.U_full, self.phi_noise,
-                self.orf, self.freqs, self.df,
-                jnp.float64(self.n_toa_total),
-                jnp.asarray(aa.ravel()), jnp.asarray(gg.ravel()))
-        surf = np.asarray(out).reshape(aa.shape)
+                  n_points=n_pts, sharded=mesh is not None):
+            out, _health = fn(*args.values())
+        surf = np.asarray(out)[:n_pts].reshape(aa.shape)
         n_bad = int(np.count_nonzero(~np.isfinite(surf)))
         if n_bad:
             import warnings

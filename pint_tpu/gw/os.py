@@ -208,34 +208,56 @@ class OptimalStatistic:
             np.asarray(gwb_phi(self.freqs, 1.0, self.gamma, self.df)))
 
     # -- the one-shot OS ------------------------------------------------------
-    def _pair_arrays(self, mesh):
-        """(ii, jj, gvals, wmask) as device arrays, zero-padded to a
-        device-count multiple and sharded over the mesh's first axis
-        when one is given."""
-        ii, jj, gvals = self._ii, self._jj, self._gvals
-        wmask = np.ones(len(ii), dtype=bool)
-        if mesh is not None:
-            ndev = int(mesh.devices.size)
-            pad = (-len(ii)) % ndev
-            if pad:
-                ii = np.concatenate([ii, np.zeros(pad, np.int64)])
-                jj = np.concatenate([jj, np.ones(pad, np.int64)])
-                gvals = np.concatenate([gvals, np.zeros(pad)])
-                wmask = np.concatenate([wmask, np.zeros(pad, bool)])
-        arrs = (jnp.asarray(ii), jnp.asarray(jj), jnp.asarray(gvals),
-                jnp.asarray(wmask))
-        if mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
+    #: pair-axis partition rules: the four per-pair arrays ride the
+    #: ``pair`` axis (a 1-d mesh of any name serves — see
+    #: parallel.mesh.resolve_axis); everything per-pulsar is
+    #: replicated and handled by _os_program's inner vmap
+    @staticmethod
+    def _pair_rules():
+        from jax.sharding import PartitionSpec as P
 
-            shard = NamedSharding(mesh, P(mesh.axis_names[0]))
-            arrs = tuple(jax.device_put(a, shard) for a in arrs)
-        return arrs
+        return ((r"^(ii|jj|gvals|wmask)$", P("pair")),)
+
+    def _pair_arrays(self, mesh):
+        """(ii, jj, gvals, wmask) as device arrays, padded to a
+        device-count multiple (pad pairs: index (0, 1) — a valid pair
+        — at zero ORF weight with ``wmask=False``, inert in every
+        weighted reduction) and sharded over the mesh's pair axis
+        through the shared partition-rule layer."""
+        from pint_tpu.parallel import mesh as _mesh
+
+        arrs = {
+            "ii": jnp.asarray(self._ii), "jj": jnp.asarray(self._jj),
+            "gvals": jnp.asarray(self._gvals),
+            "wmask": jnp.asarray(np.ones(len(self._ii), dtype=bool)),
+        }
+        if mesh is not None:
+            ndev = _mesh.axis_size(mesh, "pair")
+            n_pad = _mesh.pad_to_multiple(len(self._ii), ndev)
+            _mesh.record_pad_waste("pair", len(self._ii), n_pad)
+            arrs["ii"] = _mesh.pad_leading(arrs["ii"], n_pad, fill=0)
+            arrs["jj"] = _mesh.pad_leading(arrs["jj"], n_pad, fill=1)
+            arrs["gvals"] = _mesh.pad_leading(arrs["gvals"], n_pad,
+                                              mode="zero")
+            arrs["wmask"] = _mesh.pad_leading(arrs["wmask"], n_pad,
+                                              fill=False)
+            arrs = _mesh.shard_args(mesh, self._pair_rules(), arrs)
+        return arrs["ii"], arrs["jj"], arrs["gvals"], arrs["wmask"]
 
     def compute(self, mesh=None) -> OSResult:
         """Evaluate the OS over every pair; optionally shard the pair
         axis over a device mesh (:func:`pint_tpu.parallel.pulsar_mesh`
-        works — the axis name is immaterial, pairs ride it)."""
-        fn = _cc.shared_jit(_os_program, key=("gw.os.program",))
+        works — the axis name is immaterial, pairs ride it).  The mesh
+        participates in the jit key: one registry entry per layout, a
+        second same-shaped sharded call compiles nothing."""
+        from pint_tpu.parallel import mesh as _mesh
+
+        fn = _cc.shared_jit(
+            _os_program,
+            key=("gw.os.program",) + _mesh.mesh_jit_key(mesh),
+            label="gw.os.program"
+                  + (":sharded" if mesh is not None else ""))
+        fn.set_mesh(_mesh.mesh_desc(mesh))
         ii, jj, gvals, wmask = self._pair_arrays(mesh)
         with span("gw.os.compute", n_pulsars=self.n_pulsars,
                   n_pairs=self.n_pairs, nmodes=self.nmodes,

@@ -8,4 +8,8 @@ dimension; XLA inserts the collectives for the normal-equation
 reductions.
 """
 
-from pint_tpu.parallel.pta import PTABatch, pulsar_mesh  # noqa: F401
+from pint_tpu.parallel.mesh import (  # noqa: F401
+    AXIS_NAMES, make_mesh, match_partition_rules, mesh_desc,
+    mesh_jit_key, pad_leading, pad_to_multiple, shard_args)
+from pint_tpu.parallel.pta import (  # noqa: F401
+    PTA_BATCH_RULES, PTABatch, pulsar_mesh)

@@ -1,0 +1,382 @@
+"""The one mesh/PartitionSpec layer over every batch axis.
+
+The domain's parallelism is data-parallel along four named axes
+(SURVEY section 2.9; ROADMAP open item 1): **pulsar** (the PTA batch),
+**grid** (chi^2 / likelihood grid points), **walker** (MCMC ensemble
+members), and **pair** (optimal-statistic pulsar pairs).  Before this
+module each sharded call site hand-rolled its own ``NamedSharding``
+plumbing (``gw/os.py`` padded pairs, ``parallel/pta.py`` sniffed
+shapes); everything now goes through one registry of *partition
+rules* — regex patterns over flattened data-pytree key paths mapped to
+:class:`jax.sharding.PartitionSpec` (the ``match_partition_rules``
+shape of the pjit exemplars in SNIPPETS.md [2]):
+
+- scalar / single-element leaves are replicated (``PS()``) without
+  consulting the table;
+- the first rule whose pattern ``re.search``-matches the ``/``-joined
+  key path wins;
+- a non-scalar leaf no rule matches is an explicit :class:`ValueError`
+  naming the path — silent replication of a batch-axis array is how
+  sharding bugs hide;
+- call sites can prepend ``overrides`` without touching the base
+  table.
+
+Padding follows the repo's existing sentinel/zero-weight masking
+conventions per axis (documented in docs/sharding.md):
+
+==========  ==============================================================
+axis        pad-to-device-multiple contract
+==========  ==============================================================
+``pulsar``  phantom members cloned from the last real pulsar with their
+            ``free_mask`` row zeroed (no parameter moves); results are
+            sliced back to ``n_real`` rows on the host before any
+            merge/write-back/checkpoint path sees them
+``grid``    grid points edge-repeated; chi^2/fitted outputs sliced back
+``pair``    zero-index pairs with ``wmask=False`` zero weights (the
+            gw/os convention), inert in every weighted reduction
+``walker``  **never padded** — stretch moves couple walkers, so a
+            phantom walker would change real proposals; the ensemble
+            size must divide the device count (raise, don't pad)
+==========  ==============================================================
+
+Sharding participates in every jit key through :func:`mesh_jit_key`
+without breaking the zero-recompile contract: a mesh resolves to one
+extra registry entry (a second same-shaped sharded call performs zero
+new XLA compiles), and ``mesh=None`` keys exactly as before, so the
+single-device program is bit-identical to the pre-mesh behavior.
+
+Telemetry: ``mesh.sharded_calls`` counts :func:`shard_args`
+invocations that actually placed data on a mesh;
+``mesh.pad_waste_frac`` gauges the phantom-row overhead of the most
+recent padded batch (see docs/telemetry.md).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+import numpy as np
+
+from pint_tpu import telemetry
+
+__all__ = [
+    "AXIS_NAMES", "make_mesh", "mesh_desc", "mesh_jit_key",
+    "resolve_axis", "axis_size", "match_partition_rules",
+    "named_tree_map", "tree_paths", "pad_to_multiple", "pad_leading",
+    "record_pad_waste", "shard_args", "replicate",
+]
+
+#: the canonical batch axes of this codebase (a mesh may use any
+#: subset, and other names are allowed for experiments)
+AXIS_NAMES = ("pulsar", "grid", "walker", "pair")
+
+
+# --------------------------------------------------------------------------
+# mesh construction
+# --------------------------------------------------------------------------
+
+def make_mesh(axes="pulsar", n_devices=None, shape=None):
+    """A device mesh with named axes.
+
+    axes: one axis name or a sequence of names (``("pulsar", "grid")``
+    for a 2-d mesh).  n_devices: cap on the devices used (default:
+    all).  shape: per-axis device counts for multi-axis meshes; for a
+    1-d mesh it defaults to every selected device.  The product of
+    ``shape`` must equal the selected device count."""
+    import jax
+    from jax.sharding import Mesh
+
+    if isinstance(axes, str):
+        axes = (axes,)
+    axes = tuple(axes)
+    devs = jax.devices()
+    if n_devices is not None:
+        if len(devs) < int(n_devices):
+            raise ValueError(
+                f"make_mesh: asked for {n_devices} devices, have "
+                f"{len(devs)}")
+        devs = devs[: int(n_devices)]
+    if shape is None:
+        if len(axes) != 1:
+            raise ValueError(
+                "make_mesh: a multi-axis mesh needs an explicit shape")
+        shape = (len(devs),)
+    shape = tuple(int(s) for s in shape)
+    if len(shape) != len(axes):
+        raise ValueError(
+            f"make_mesh: shape {shape} does not match axes {axes}")
+    n = int(np.prod(shape))
+    if n != len(devs):
+        raise ValueError(
+            f"make_mesh: shape {shape} needs {n} devices, selected "
+            f"{len(devs)}")
+    return Mesh(np.array(devs).reshape(shape), axes)
+
+
+def mesh_desc(mesh) -> Optional[dict]:
+    """Structured record of a mesh for bench metrics and the profiling
+    program registry: ``{"devices": N, "axes": {name: size, ...}}``
+    (None for no mesh)."""
+    if mesh is None:
+        return None
+    return {
+        "devices": int(mesh.devices.size),
+        "axes": {str(name): int(size)
+                 for name, size in zip(mesh.axis_names,
+                                       mesh.devices.shape)},
+    }
+
+
+def mesh_jit_key(mesh) -> tuple:
+    """The sharding part of a shared_jit key: ``()`` for no mesh (so
+    single-device keys are unchanged from the pre-mesh layout), else a
+    stable ``("mesh", ((axis, size), ...))`` tuple.  One mesh = one
+    registry entry = zero new XLA compiles on the second same-shaped
+    sharded call."""
+    if mesh is None:
+        return ()
+    return ("mesh", tuple(
+        (str(name), int(size))
+        for name, size in zip(mesh.axis_names, mesh.devices.shape)))
+
+
+def resolve_axis(mesh, axis: str) -> str:
+    """The mesh axis a canonical axis name rides.  An exact name match
+    wins; a 1-d mesh serves ANY axis under its own name (the gw/os
+    contract: "the axis name is immaterial, pairs ride it", so a
+    ``pulsar_mesh`` can shard the pair axis); a multi-axis mesh
+    missing the name is an error — guessing which axis to ride would
+    silently mis-shard."""
+    names = tuple(str(n) for n in mesh.axis_names)
+    if axis in names:
+        return axis
+    if len(names) == 1:
+        return names[0]
+    raise ValueError(
+        f"mesh axes {names} do not include {axis!r}; name the axis "
+        "explicitly when building a multi-axis mesh")
+
+
+def axis_size(mesh, axis: str) -> int:
+    """Device count along a (resolved) canonical axis; 1 for no mesh."""
+    if mesh is None:
+        return 1
+    name = resolve_axis(mesh, axis)
+    return int(mesh.devices.shape[list(
+        str(n) for n in mesh.axis_names).index(name)])
+
+
+# --------------------------------------------------------------------------
+# key-path walking
+# --------------------------------------------------------------------------
+
+def _is_leaf(v):
+    # arrays and scalars are leaves; containers recurse.  None is a
+    # structural hole (absent tzr batch) — kept as a leaf so rebuilt
+    # trees keep their shape, never matched against rules.  A
+    # PartitionSpec is a tuple SUBCLASS but is a resolved rule, not a
+    # container (match_partition_rules returns trees of them).
+    if type(v).__name__ == "PartitionSpec":
+        return True
+    return not isinstance(v, (dict, list, tuple))
+
+
+def _items(tree):
+    """(key, child) pairs of one container level.  Dict keys and
+    NamedTuple field names keep their names; plain sequences use
+    indices — so a rule can say ``^batch/ticks`` instead of
+    ``^2/0``."""
+    if isinstance(tree, dict):
+        return list(tree.items())
+    if isinstance(tree, tuple) and hasattr(tree, "_fields"):
+        return list(zip(tree._fields, tree))
+    return list(enumerate(tree))
+
+
+def _rebuild(tree, children):
+    if isinstance(tree, dict):
+        return type(tree)(zip([k for k, _ in _items(tree)], children))
+    if isinstance(tree, tuple) and hasattr(tree, "_fields"):
+        return type(tree)(*children)
+    return type(tree)(children)
+
+
+def named_tree_map(fn, tree, prefix=""):
+    """Map ``fn(path, leaf) -> new_leaf`` over a pytree of
+    dicts/(named)tuples/lists, with ``path`` the ``/``-joined key
+    chain (the SNIPPETS.md [2] ``named_tree_map`` shape).  ``None``
+    leaves pass through untouched."""
+    if _is_leaf(tree):
+        return tree if tree is None else fn(prefix, tree)
+    children = [
+        named_tree_map(fn, child,
+                       f"{prefix}/{key}" if prefix else str(key))
+        for key, child in _items(tree)
+    ]
+    return _rebuild(tree, children)
+
+
+def tree_paths(tree) -> list:
+    """Flattened ``(path, leaf)`` list (non-None leaves only)."""
+    out = []
+
+    def visit(path, leaf):
+        out.append((path, leaf))
+        return leaf
+
+    named_tree_map(visit, tree)
+    return out
+
+
+# --------------------------------------------------------------------------
+# the partition-rule table
+# --------------------------------------------------------------------------
+
+def replicate():
+    """An explicitly-replicated PartitionSpec (``PS()``)."""
+    from jax.sharding import PartitionSpec as PS
+
+    return PS()
+
+
+def _is_scalar_leaf(leaf) -> bool:
+    shape = np.shape(leaf)
+    return len(shape) == 0 or int(np.prod(shape)) == 1
+
+
+def _rule_resolver(rules, overrides=None):
+    """``resolve(path, leaf) -> PartitionSpec`` over a rule table.
+    Overrides are consulted first (the per-call-site escape hatch);
+    scalar and single-element leaves replicate without consulting the
+    table (SNIPPETS.md [2]); any other unmatched leaf raises."""
+    table = list(overrides or ()) + list(rules)
+    compiled = [(re.compile(pat), spec) for pat, spec in table]
+
+    def resolve(path, leaf):
+        if _is_scalar_leaf(leaf):
+            return replicate()
+        for pat, spec in compiled:
+            if pat.search(path) is not None:
+                return replicate() if spec is None else spec
+        raise ValueError(
+            f"no partition rule matches data leaf {path!r} "
+            f"(shape {np.shape(leaf)}); add a rule or an explicit "
+            "replicate() entry — silent replication of a batch-axis "
+            "array is how sharding bugs hide")
+
+    return resolve
+
+
+def match_partition_rules(rules, tree, *, overrides=None):
+    """Resolve a rule table over a data pytree.
+
+    rules / overrides: sequences of ``(pattern, PartitionSpec)``.
+    Returns a same-structure pytree of PartitionSpecs (see
+    :func:`_rule_resolver` for the matching semantics)."""
+    return named_tree_map(_rule_resolver(rules, overrides), tree)
+
+
+def _resolve_spec(mesh, spec):
+    """A rule's PartitionSpec with canonical axis names mapped onto
+    the mesh's real axes (:func:`resolve_axis`)."""
+    from jax.sharding import PartitionSpec as PS
+
+    parts = []
+    for entry in spec:
+        if entry is None:
+            parts.append(None)
+        elif isinstance(entry, (list, tuple)):
+            parts.append(tuple(resolve_axis(mesh, a) for a in entry))
+        else:
+            parts.append(resolve_axis(mesh, str(entry)))
+    return PS(*parts)
+
+
+def shard_args(mesh, rules, tree, *, overrides=None):
+    """Resolve the rule table over ``tree`` and ``device_put`` every
+    leaf onto the mesh (NamedSharding).  ``mesh=None`` returns the
+    tree unchanged — the single-device path stays bit-identical.
+
+    Every sharded-axis length must already be a device-count multiple
+    (use :func:`pad_to_multiple` / :func:`pad_leading` first); a
+    non-divisible axis is reported with its path rather than jax's
+    anonymous shape error."""
+    if mesh is None:
+        return tree
+    import jax
+    from jax.sharding import NamedSharding
+
+    resolve = _rule_resolver(rules, overrides)
+    sizes = dict(zip((str(n) for n in mesh.axis_names),
+                     (int(s) for s in mesh.devices.shape)))
+
+    def put(path, leaf):
+        resolved = _resolve_spec(mesh, resolve(path, leaf))
+        for dim, entry in enumerate(resolved):
+            axes = (entry,) if isinstance(entry, str) else (entry or ())
+            need = int(np.prod([sizes[a] for a in axes])) if axes else 1
+            if need > 1 and np.shape(leaf)[dim] % need:
+                raise ValueError(
+                    f"leaf {path!r} axis {dim} (length "
+                    f"{np.shape(leaf)[dim]}) is not a multiple of the "
+                    f"{entry!r} mesh extent {need}; pad it first "
+                    "(mesh.pad_to_multiple / pad_leading)")
+        return jax.device_put(leaf, NamedSharding(mesh, resolved))
+
+    out = named_tree_map(put, tree)
+    telemetry.counter_add("mesh.sharded_calls")
+    return out
+
+
+# --------------------------------------------------------------------------
+# pad-to-device-multiple helpers
+# --------------------------------------------------------------------------
+
+def pad_to_multiple(n: int, multiple: int) -> int:
+    """Smallest count >= n divisible by ``multiple``."""
+    n, multiple = int(n), max(1, int(multiple))
+    return n + (-n) % multiple
+
+
+def pad_leading(arr, n_target: int, mode: str = "edge", fill=None):
+    """Pad an array's leading axis up to ``n_target`` rows.
+
+    mode="edge" repeats the final row (the TOA-axis convention of
+    ``parallel/pta._pad_batch`` — a clone is always finite);
+    mode="zero" appends zeros (inert under zero-weight masking);
+    ``fill=`` overrides with a constant (the gw/os pair-index
+    convention, e.g. ``jj`` pads with 1 so pad pairs stay valid
+    index pairs)."""
+    import jax.numpy as jnp
+
+    arr = jnp.asarray(arr)
+    pad = int(n_target) - arr.shape[0]
+    if pad < 0:
+        raise ValueError(
+            f"pad_leading: target {n_target} < length {arr.shape[0]}")
+    if pad == 0:
+        return arr
+    if fill is not None:
+        tail = jnp.full((pad,) + arr.shape[1:], fill, dtype=arr.dtype)
+    elif mode == "edge":
+        tail = jnp.repeat(arr[-1:], pad, axis=0)
+    elif mode == "zero":
+        tail = jnp.zeros((pad,) + arr.shape[1:], dtype=arr.dtype)
+    else:
+        raise ValueError(f"pad_leading: unknown mode {mode!r}")
+    return jnp.concatenate([arr, tail], axis=0)
+
+
+def record_pad_waste(axis: str, n_real: int, n_padded: int):
+    """Telemetry for phantom-row overhead: the fraction of the padded
+    batch that is padding (``mesh.pad_waste_frac`` gauge — the most
+    recent sharded batch, honestly 0.0 when it needed no padding;
+    ``mesh.pad_rows`` counter, cumulative)."""
+    n_real, n_padded = int(n_real), int(n_padded)
+    frac = 0.0 if n_padded <= 0 else (n_padded - n_real) / n_padded
+    telemetry.gauge_set("mesh.pad_waste_frac", round(frac, 6))
+    telemetry.gauge_set(f"mesh.pad_waste_frac.{axis}", round(frac, 6))
+    if n_padded > n_real:
+        telemetry.counter_add("mesh.pad_rows", float(n_padded - n_real))
+    return frac

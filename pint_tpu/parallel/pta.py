@@ -30,19 +30,38 @@ from pint_tpu import telemetry
 from pint_tpu.compile_cache import merge_ctx as _merge_ctx
 from pint_tpu.fitter import wls_gn_solve
 from pint_tpu.models.timing_model import PreparedModel
+from pint_tpu.parallel import mesh as _mesh
 from pint_tpu.residuals import Residuals
 from pint_tpu.telemetry import span
 
-__all__ = ["PTABatch", "pulsar_mesh"]
+__all__ = ["PTABatch", "pulsar_mesh", "PTA_BATCH_RULES"]
 
 
 def pulsar_mesh(n_devices=None):
-    """A 1-d device mesh over the 'pulsar' axis."""
-    from jax.sharding import Mesh
+    """A 1-d device mesh over the 'pulsar' axis
+    (:func:`pint_tpu.parallel.mesh.make_mesh`).  Historical clamping
+    semantics kept: asking for more devices than exist returns a mesh
+    over what is available (``jax.devices()[:n]``), it does not raise
+    — a pod-sized count in a laptop smoke run must still work."""
+    if n_devices is not None:
+        n_devices = min(int(n_devices), len(jax.devices()))
+    return _mesh.make_mesh("pulsar", n_devices=n_devices)
 
-    devs = np.array(jax.devices() if n_devices is None
-                    else jax.devices()[:n_devices])
-    return Mesh(devs, ("pulsar",))
+
+from jax.sharding import PartitionSpec as _P
+
+#: the batched-fit partition-rule table: every argument of the vmapped
+#: fit carries a leading pulsar axis (the stacked data pytree), so
+#: each named root maps to ``PS('pulsar')``; scalars (guard_eps)
+#: replicate by the scalar rule.  Named per root rather than one
+#: ``.*`` catch-all so a future non-batched argument fails loudly
+#: instead of riding the pulsar axis by accident.
+PTA_BATCH_RULES = (
+    (r"^(values0|base_values|valid|free_mask)(/|$)", _P("pulsar")),
+    (r"^(batch|ctx|tzr_batch|tzr_ctx)(/|$)", _P("pulsar")),
+    (r"^(U|phi|dm_data|dm_error|dm_valid)(/|$)", _P("pulsar")),
+    (r"^guard_eps$", None),
+)
 
 
 def _pad_batch(batch, n_max):
@@ -777,25 +796,33 @@ class PTABatch:
                      None),
         )
 
-    def _batched_fit_jit(self, kind, maxiter):
-        """ONE jitted batched fit per (kind, maxiter), memoized on the
-        instance and shared across same-structure batches through the
-        process registry.  This replaces the old per-call
+    def _batched_fit_jit(self, kind, maxiter, mesh=None):
+        """ONE jitted batched fit per (kind, maxiter, mesh), memoized
+        on the instance and shared across same-structure batches
+        through the process registry.  This replaces the old per-call
         ``jax.jit(lambda *a: fit(*a))`` — a fresh jitted callable (and
         a full retrace + XLA compile of the entire PTA program) on
-        EVERY fit invocation."""
+        EVERY fit invocation.  The mesh participates in the key
+        (:func:`pint_tpu.parallel.mesh.mesh_jit_key`): one registry
+        entry per mesh layout, so a second same-shaped sharded call
+        compiles nothing and the profiler records sharded and
+        unsharded runs separately."""
         with_health = _guard.enabled()
+        mesh_key = _mesh.mesh_jit_key(mesh)
         cache = getattr(self, "_fit_jit_cache", None)
         if cache is None:
             cache = self._fit_jit_cache = {}
-        got = cache.get((kind, maxiter, with_health))
+        got = cache.get((kind, maxiter, with_health, mesh_key))
         if got is None:
-            got = cache[(kind, maxiter, with_health)] = _cc.shared_jit(
+            got = cache[(kind, maxiter, with_health, mesh_key)] = \
+                _cc.shared_jit(
                 self._build_fit(kind, maxiter, with_health),
                 key=("pta.batched", kind, int(maxiter), with_health,
-                     self._structure_key()),
+                     self._structure_key()) + mesh_key,
                 fn_token="pta.batched_fit",
-                label=f"pta.batched_fit:{kind}")
+                label=f"pta.batched_fit:{kind}"
+                      + (":sharded" if mesh is not None else ""))
+            got.set_mesh(_mesh.mesh_desc(mesh))
             # per-call analytic cost for the profiler's reconciliation:
             # one batched fit = n_psr independent GLS fits
             try:
@@ -818,12 +845,11 @@ class PTABatch:
         while True:
             U, phi = self._gather_noise()
             dm_data, dm_error, dm_valid = self._gather_dm()
-            fit = self._batched_fit_jit("wideband", maxiter)
+            fit = self._batched_fit_jit("wideband", maxiter, mesh)
             out = self._run_batched(
-                fit, (self.values0, self.base_values, self.batch,
-                      self.ctx, self.tzr_batch, self.tzr_ctx,
-                      self.valid, self.free_mask, U, phi, dm_data,
-                      dm_error, dm_valid),
+                fit, {**self._base_args(), "U": U, "phi": phi,
+                      "dm_data": dm_data, "dm_error": dm_error,
+                      "dm_valid": dm_valid},
                 mesh, checkpoint, n_lin=len(self._partition_wb[0]))
             if not self._kepler_depth_guard():
                 return out
@@ -836,25 +862,37 @@ class PTABatch:
         (gridutils.py:166-391).  Sharding semantics match fit_wls."""
         while True:
             U, phi = self._gather_noise()
-            fit = self._batched_fit_jit("gls", maxiter)
+            fit = self._batched_fit_jit("gls", maxiter, mesh)
             out = self._run_batched(
-                fit, (self.values0, self.base_values, self.batch,
-                      self.ctx, self.tzr_batch, self.tzr_ctx,
-                      self.valid, self.free_mask, U, phi),
+                fit, {**self._base_args(), "U": U, "phi": phi},
                 mesh, checkpoint)
             if not self._kepler_depth_guard():
                 return out
+
+    def _base_args(self):
+        """The named stacked-data pytree every batched fit kind shares
+        — the keys are what :data:`PTA_BATCH_RULES` patterns match
+        against (``batch/ticks``, ``ctx/SpindownPhase/...``)."""
+        return {
+            "values0": self.values0, "base_values": self.base_values,
+            "batch": self.batch, "ctx": self.ctx,
+            "tzr_batch": self.tzr_batch, "tzr_ctx": self.tzr_ctx,
+            "valid": self.valid, "free_mask": self.free_mask,
+        }
 
     def _run_batched(self, fit, args, mesh, checkpoint=None,
                      n_lin=None):
         """Run the jitted batched fit (optionally mesh-sharded over the
         pulsar axis) and write fitted values back (only genuinely-free
-        params).  n_lin: analytic-column count of the partition the
-        traced step actually uses (structure-aware FLOP accounting —
-        the wideband step follows _partition_wb, not _partition)."""
+        params).  args: the NAMED stacked-data dict (insertion order =
+        positional order of the vmapped fit).  n_lin: analytic-column
+        count of the partition the traced step actually uses
+        (structure-aware FLOP accounting — the wideband step follows
+        _partition_wb, not _partition)."""
         with span("pta.batched_fit", n_pulsars=self.n_pulsars,
                   n_max=self.n_max, n_free=len(self.free_names),
-                  sharded=mesh is not None):
+                  sharded=mesh is not None,
+                  mesh=_mesh.mesh_desc(mesh)):
             return self._run_batched_inner(fit, args, mesh, checkpoint,
                                            n_lin=n_lin)
 
@@ -864,25 +902,36 @@ class PTABatch:
 
     def _run_batched_inner(self, fit, args, mesh, checkpoint=None,
                            n_lin=None):
+        n_real = self.n_pulsars
         if mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
+            # pad the PULSAR axis to a device multiple (the TOA axis
+            # is already padded per pulsar): phantom members are edge
+            # clones of the last real pulsar — always finite, so they
+            # can't trip the guard — with their free_mask rows zeroed
+            # (fully masked: no phantom parameter moves), and every
+            # result/health row past n_real is sliced off below before
+            # any merge/write-back/checkpoint path can see it
+            ndev = _mesh.axis_size(mesh, "pulsar")
+            k_pad = _mesh.pad_to_multiple(n_real, ndev)
+            if k_pad != n_real:
+                args = {
+                    k: (None if v is None else _mesh.named_tree_map(
+                        lambda _p, leaf: _mesh.pad_leading(
+                            leaf, k_pad, mode="edge"), v))
+                    for k, v in args.items()
+                }
+                args["free_mask"] = args["free_mask"].at[n_real:].set(
+                    0.0)
+            _mesh.record_pad_waste("pulsar", n_real, k_pad)
+            args = _mesh.shard_args(mesh, PTA_BATCH_RULES, args)
+            if k_pad != n_real:
+                raw_fit = fit
 
-            shard = NamedSharding(mesh, P("pulsar"))
-            rep = NamedSharding(mesh, P())
-
-            def shard_tree(tree):
-                return jax.tree.map(
-                    lambda x: jax.device_put(
-                        x, shard if hasattr(x, "ndim") and x.ndim >= 1
-                        and x.shape[0] == self.n_pulsars else rep
-                    ),
-                    tree,
-                )
-
-            args = tuple(
-                shard_tree(a) if a is not None else None for a in args
-            )
-        vec, chi2, cov, health = fit(*args, jnp.float64(0.0))
+                def fit(*a):
+                    vec, chi2, cov, health = raw_fit(*a)
+                    return (vec[:n_real], chi2[:n_real], cov[:n_real],
+                            jax.tree.map(lambda x: x[:n_real], health))
+        vec, chi2, cov, health = fit(*args.values(), jnp.float64(0.0))
         telemetry.counter_add("guard.checks")
         bad = _guard.batch_bad(health)
         rung = "baseline"
@@ -900,7 +949,8 @@ class PTABatch:
             for name, eps in self._guard_jitter_rungs:
                 if not fixable.any():
                     break
-                v2, c2, k2, h2 = fit(*args, jnp.float64(eps))
+                v2, c2, k2, h2 = fit(*args.values(),
+                                     jnp.float64(eps))
                 fixed = fixable & ~_guard.batch_bad(h2)
                 if fixed.any():
                     telemetry.counter_add(f"guard.rung.{name}",
@@ -1065,11 +1115,9 @@ class PTABatch:
         after the fit (guard.save_checkpoint), validated on restore
         against this batch's structure fingerprint."""
         while True:
-            fit = self._batched_fit_jit("wls", maxiter)
-            out = self._run_batched(
-                fit, (self.values0, self.base_values, self.batch,
-                      self.ctx, self.tzr_batch, self.tzr_ctx,
-                      self.valid, self.free_mask), mesh, checkpoint)
+            fit = self._batched_fit_jit("wls", maxiter, mesh)
+            out = self._run_batched(fit, self._base_args(), mesh,
+                                    checkpoint)
             if not self._kepler_depth_guard():
                 return out
 

@@ -112,11 +112,12 @@ class ProgramStats:
     __slots__ = ("label", "key_hash", "calls", "compiles", "arg_bytes",
                  "result_bytes", "trace_s", "dispatch_s", "device_s",
                  "hist", "analytic_flops", "xla_flops", "xla_bytes",
-                 "cost_checked")
+                 "cost_checked", "mesh")
 
     def __init__(self, label, key_hash):
         self.label = label
         self.key_hash = key_hash
+        self.mesh = None           # parallel.mesh.mesh_desc record
         self.calls = 0
         self.compiles = 0          # calls during which a compile ticked
         self.arg_bytes = 0
@@ -148,6 +149,7 @@ class ProgramStats:
             "analytic_flops": self.analytic_flops,
             "xla_flops": self.xla_flops,
             "xla_bytes": self.xla_bytes,
+            "mesh": self.mesh,
         }
 
 
@@ -306,6 +308,15 @@ class _ProfiledProgram:
         self._stats.analytic_flops = float(flops_per_call)
         return self
 
+    def set_mesh(self, desc):
+        """Record the device mesh this program runs over
+        (:func:`pint_tpu.parallel.mesh.mesh_desc` — device count +
+        axis layout; None for single-device).  Shown by
+        ``pinttrace --programs`` / ``datacheck --profile`` so the
+        record says what actually ran sharded."""
+        self._stats.mesh = desc
+        return self
+
 
 def wrap_program(jitted, *, key, label):
     """Wrap a jitted callable in the profiling proxy, registering (or
@@ -427,6 +438,14 @@ def _fmt_ms(s):
     return "-" if s is None else f"{s * 1e3:.2f}"
 
 
+def _fmt_mesh(desc):
+    """Compact mesh layout: ``pulsar8`` / ``pulsar4·grid2`` / ``-``."""
+    if not desc or not desc.get("axes"):
+        return "-"
+    return "·".join(f"{name}{size}"
+                    for name, size in desc["axes"].items())
+
+
 def table_lines(snapshots=None, indent=""):
     """Render program records as table lines — the ONE place the
     format lives, shared by ``datacheck --profile`` (in-process
@@ -438,7 +457,7 @@ def table_lines(snapshots=None, indent=""):
     lines = [
         f"{indent}{'PROGRAM':<34s} {'CALLS':>6s} {'COMP':>5s} "
         f"{'DEV_P50MS':>9s} {'DEV_P99MS':>9s} {'DEV_TOT_S':>9s} "
-        f"{'ARGS':>9s} {'FLOPS(XLA)':>11s}"
+        f"{'ARGS':>9s} {'FLOPS(XLA)':>11s} {'MESH':>12s}"
     ]
     for s in sorted(snaps, key=lambda s: -(s.get("device_s") or 0.0)):
         name = f"{s['label']}#{s['key']}"
@@ -452,6 +471,7 @@ def table_lines(snapshots=None, indent=""):
             f"{_fmt_ms(s.get('device_p99_s')):>9s} "
             f"{(s.get('device_s') or 0.0):>9.4f} "
             f"{_fmt_bytes(s.get('arg_bytes')):>9s} "
-            f"{('%.3g' % xf) if xf else '-':>11s}"
+            f"{('%.3g' % xf) if xf else '-':>11s} "
+            f"{_fmt_mesh(s.get('mesh')):>12s}"
         )
     return lines

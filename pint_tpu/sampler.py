@@ -71,7 +71,8 @@ def _stretch_half(key, active, other, lnp_active, lnpost_v, a):
     return new, new_lnp, accept
 
 
-def run_mcmc(lnpost, x0, nsteps, key=None, a=2.0, thin=1, jit_key=None):
+def run_mcmc(lnpost, x0, nsteps, key=None, a=2.0, thin=1, jit_key=None,
+             mesh=None):
     """Run an ensemble chain.
 
     lnpost: f(vec[ndim]) -> scalar log-posterior (jax-traceable).
@@ -85,8 +86,18 @@ def run_mcmc(lnpost, x0, nsteps, key=None, a=2.0, thin=1, jit_key=None):
     re-run on the same sampler reuses one trace instead of recompiling
     the full chain program per call), or an explicit ``jit_key`` when
     the caller can vouch for a broader identity (MCMCFitter passes a
-    content fingerprint so two identically-configured fitters share)."""
+    content fingerprint so two identically-configured fitters share).
+
+    mesh: a device mesh (axis ``walker``) — the walker axis is held on
+    the mesh via ``with_sharding_constraint`` inside the scanned step,
+    so every posterior evaluation of every step runs device-parallel.
+    The ensemble is NEVER padded: stretch moves couple walkers (a
+    phantom would change real proposals), so nwalkers must be a
+    multiple of 2x the walker-axis device count — raise, don't pad.
+    The mesh is part of the jit key (it changes the traced program);
+    ``mesh=None`` keys and traces exactly as before."""
     from pint_tpu import compile_cache as _cc
+    from pint_tpu.parallel import mesh as _mesh
 
     x0 = jnp.asarray(x0, dtype=jnp.float64)
     nw = x0.shape[0]
@@ -94,6 +105,24 @@ def run_mcmc(lnpost, x0, nsteps, key=None, a=2.0, thin=1, jit_key=None):
         raise ValueError("nwalkers must be even (red-black split)")
     if key is None:
         key = jax.random.PRNGKey(0)
+    constrain = None
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        ndev = _mesh.axis_size(mesh, "walker")
+        if nw % (2 * ndev):
+            raise ValueError(
+                f"run_mcmc: nwalkers={nw} must be a multiple of 2x "
+                f"the walker-axis device count ({ndev}); the ensemble "
+                "cannot be padded — stretch moves couple walkers, so "
+                "a phantom walker would change real proposals")
+        walker_sharding = NamedSharding(
+            mesh, P(_mesh.resolve_axis(mesh, "walker")))
+
+        def constrain(arr):
+            return jax.lax.with_sharding_constraint(arr,
+                                                    walker_sharding)
+
     lnpost_v = jax.vmap(lnpost)
     half = nw // 2
 
@@ -112,8 +141,15 @@ def run_mcmc(lnpost, x0, nsteps, key=None, a=2.0, thin=1, jit_key=None):
             x = jnp.concatenate([first, second])
             lnp = jnp.concatenate([lnp1, lnp2])
             acc = jnp.concatenate([acc1, acc2])
+            if constrain is not None:
+                # hold the walker axis on the mesh across scan steps
+                # (without the constraint XLA is free to gather the
+                # carry onto one device between iterations)
+                x = constrain(x)
             return (x, lnp), (x, lnp, jnp.mean(acc))
 
+        if constrain is not None:
+            x0 = constrain(x0)
         (xf, lnpf), ys = jax.lax.scan(step, (x0, lnpost_v(x0)), keys)
         # on-device chain health, riding the same compiled program:
         # positions must stay finite, and at least one walker must end
@@ -124,10 +160,15 @@ def run_mcmc(lnpost, x0, nsteps, key=None, a=2.0, thin=1, jit_key=None):
         return (xf, lnpf), ys, health
 
     # nw/a are baked into the stored closure — they must be part of
-    # the key, not left to aval-driven retracing of a stale closure
+    # the key, not left to aval-driven retracing of a stale closure;
+    # the mesh changes the traced program (the sharding constraint),
+    # so it keys too
     runner = _cc.shared_jit(
-        scan_chain, key=("sampler.run_mcmc", nw, float(a)),
+        scan_chain,
+        key=("sampler.run_mcmc", nw, float(a))
+            + _mesh.mesh_jit_key(mesh),
         fn_token=jit_key if jit_key is not None else lnpost)
+    runner.set_mesh(_mesh.mesh_desc(mesh))
     keys = jax.random.split(key, nsteps)
     (xf, lnpf), (chain, lnps, accs), (pos_ok, lnp_ok) = runner(x0, keys)
     # the health tuple always rides the program (two trailing
@@ -157,11 +198,13 @@ class EnsembleSampler:
     (reference: EmceeSampler, sampler.py:60): hold (lnpost, nwalkers),
     initialize walkers from a ball or from priors, run, expose chains."""
 
-    def __init__(self, lnpost, nwalkers=32, seed=0, jit_key=None):
+    def __init__(self, lnpost, nwalkers=32, seed=0, jit_key=None,
+                 mesh=None):
         self.lnpost = lnpost
         self.nwalkers = int(nwalkers)
         self.key = jax.random.PRNGKey(seed)
         self.jit_key = jit_key  # registry identity override (run_mcmc)
+        self.mesh = mesh        # walker-axis device mesh (run_mcmc)
         self.chain = None
         self.lnprob = None
         self.acceptance = None
@@ -180,7 +223,7 @@ class EnsembleSampler:
         self.key, sub = jax.random.split(self.key)
         self.chain, self.lnprob, self.acceptance = run_mcmc(
             self.lnpost, x0, int(nsteps), key=sub, thin=thin,
-            jit_key=self.jit_key
+            jit_key=self.jit_key, mesh=self.mesh
         )
         return self.chain
 
@@ -239,7 +282,8 @@ class EnsembleSampler:
             step = int(min(chunk, maxsteps - total))
             self.key, sub = jax.random.split(self.key)
             chain, lnprob, acc = run_mcmc(self.lnpost, x, step, key=sub,
-                                          jit_key=self.jit_key)
+                                          jit_key=self.jit_key,
+                                          mesh=self.mesh)
             chains.append(np.asarray(chain))
             lnprobs.append(np.asarray(lnprob))
             accs.append((float(np.mean(np.asarray(acc))), step))

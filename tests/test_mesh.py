@@ -1,0 +1,472 @@
+"""Mesh/PartitionSpec layer tests (pint_tpu/parallel/mesh.py).
+
+Host-side pieces (rule resolution, key paths, padding, mesh keys) run
+in-process on the single CPU device; the real multi-device behavior —
+sharded == unsharded for the grid, the batched PTA fit (incl. the
+phantom-pulsar pad), lnlike_grid and the walker axis, plus
+zero-recompile with a mesh in the jit key — runs on 8 FORCED host
+devices in a subprocess (``XLA_FLAGS=--xla_force_host_platform_
+device_count=8`` must be set before jax initializes; the same pattern
+the chaos kill test proved).
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import pint_tpu  # noqa: F401  (x64 setup)
+from pint_tpu.parallel import mesh as M
+
+jax = pytest.importorskip("jax")
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+
+# --------------------------------------------------------------------------
+# rule resolution
+# --------------------------------------------------------------------------
+
+class TestPartitionRules:
+    def tree(self):
+        from collections import namedtuple
+
+        NT = namedtuple("NT", ["ticks", "err"])
+        return {
+            "batch": NT(np.zeros((4, 8)), np.ones((4, 8))),
+            "free_mask": np.ones((4, 2)),
+            "eps": np.float64(0.0),
+            "none_slot": None,
+            "seq": [np.zeros((4, 3))],
+        }
+
+    RULES = (
+        (r"^(batch|seq)(/|$)", P("pulsar")),
+        (r"^free_mask$", P("pulsar")),
+    )
+
+    def test_match_and_scalar_replicate(self):
+        specs = M.match_partition_rules(self.RULES, self.tree())
+        assert specs["batch"].ticks == P("pulsar")
+        assert specs["batch"].err == P("pulsar")
+        assert specs["seq"][0] == P("pulsar")
+        # scalar leaves replicate without consulting the table
+        assert specs["eps"] == P()
+        # None passes through as a structural hole
+        assert specs["none_slot"] is None
+
+    def test_namedtuple_field_paths(self):
+        paths = [p for p, _ in M.tree_paths(self.tree())]
+        assert "batch/ticks" in paths and "batch/err" in paths
+        assert "seq/0" in paths
+
+    def test_unmatched_leaf_raises_with_path(self):
+        bad = {"mystery": np.zeros((4, 2))}
+        with pytest.raises(ValueError, match="mystery"):
+            M.match_partition_rules(self.RULES, bad)
+
+    def test_override_wins_over_base_rule(self):
+        specs = M.match_partition_rules(
+            self.RULES, self.tree(),
+            overrides=((r"^free_mask$", None),))
+        assert specs["free_mask"] == P()  # None spec = replicate
+        # other leaves still follow the base table
+        assert specs["batch"].ticks == P("pulsar")
+
+    def test_first_match_wins(self):
+        rules = ((r"ticks", P("grid")),) + self.RULES
+        specs = M.match_partition_rules(rules, self.tree())
+        assert specs["batch"].ticks == P("grid")
+        assert specs["batch"].err == P("pulsar")
+
+    def test_pta_rule_table_covers_real_batch(self):
+        """Every leaf of a real stacked PTA-batch pytree resolves —
+        the acceptance the rule table exists for."""
+        from pint_tpu.parallel import PTA_BATCH_RULES
+
+        batch = _tiny_batch(2)
+        args = {k: v for k, v in batch._base_args().items()
+                if v is not None}
+        specs = M.match_partition_rules(PTA_BATCH_RULES, args)
+        flat = M.tree_paths(specs)
+        assert len(flat) > 10
+        # every non-scalar stacked leaf rides the pulsar axis
+        named = dict(M.tree_paths(args))
+        for path, spec in flat:
+            if np.size(named[path]) > 1:
+                assert tuple(spec) == ("pulsar",), path
+
+
+# --------------------------------------------------------------------------
+# pad helpers
+# --------------------------------------------------------------------------
+
+class TestPadding:
+    def test_pad_to_multiple(self):
+        assert M.pad_to_multiple(68, 8) == 72
+        assert M.pad_to_multiple(8, 8) == 8
+        assert M.pad_to_multiple(0, 8) == 0
+        assert M.pad_to_multiple(5, 1) == 5
+
+    def test_pad_leading_modes(self):
+        a = np.arange(6.0).reshape(3, 2)
+        edge = np.asarray(M.pad_leading(a, 5))
+        assert edge.shape == (5, 2)
+        assert np.all(edge[3:] == a[-1])
+        zero = np.asarray(M.pad_leading(a, 5, mode="zero"))
+        assert np.all(zero[3:] == 0.0)
+        filled = np.asarray(M.pad_leading(np.arange(3), 5, fill=7))
+        assert np.all(filled[3:] == 7)
+        # no-op and error cases
+        assert np.asarray(M.pad_leading(a, 3)).shape == (3, 2)
+        with pytest.raises(ValueError, match="target"):
+            M.pad_leading(a, 2)
+
+    def test_record_pad_waste_gauge(self):
+        from pint_tpu import telemetry
+
+        frac = M.record_pad_waste("pulsar", 68, 72)
+        assert frac == pytest.approx(4 / 72)
+        assert telemetry.gauges()["mesh.pad_waste_frac"] == \
+            pytest.approx(4 / 72, abs=1e-6)
+
+
+# --------------------------------------------------------------------------
+# mesh construction + keys
+# --------------------------------------------------------------------------
+
+class TestMeshConstruction:
+    def test_make_mesh_and_desc(self):
+        m = M.make_mesh("grid")
+        assert M.mesh_desc(m)["axes"] == {"grid": len(jax.devices())}
+        assert M.mesh_desc(None) is None
+
+    def test_jit_key_stability(self):
+        m = M.make_mesh("pulsar")
+        assert M.mesh_jit_key(None) == ()
+        assert M.mesh_jit_key(m) == M.mesh_jit_key(M.make_mesh("pulsar"))
+        assert M.mesh_jit_key(m) != M.mesh_jit_key(M.make_mesh("grid"))
+
+    def test_resolve_axis_one_d_serves_any(self):
+        m = M.make_mesh("pulsar")
+        assert M.resolve_axis(m, "pair") == "pulsar"
+        assert M.axis_size(None, "pulsar") == 1
+
+    def test_multi_axis_needs_shape(self):
+        with pytest.raises(ValueError, match="shape"):
+            M.make_mesh(("pulsar", "grid"))
+
+    def test_too_many_devices_raises(self):
+        with pytest.raises(ValueError, match="devices"):
+            M.make_mesh("grid", n_devices=len(jax.devices()) + 1)
+
+    def test_shard_args_none_mesh_is_identity(self):
+        t = {"x": np.arange(4.0)}
+        assert M.shard_args(None, (), t) is t
+
+    def test_shard_args_divisibility_error_names_path(self):
+        m = M.make_mesh("pulsar")
+        if len(jax.devices()) == 1:
+            pytest.skip("needs >1 device to make a non-divisible axis")
+        with pytest.raises(ValueError, match="x"):
+            M.shard_args(m, ((r"^x$", P("pulsar")),),
+                         {"x": np.arange(3.0)})
+
+
+# --------------------------------------------------------------------------
+# single-device sharded paths (full multi-device suite runs below in a
+# subprocess with 8 forced host devices)
+# --------------------------------------------------------------------------
+
+def _tiny_model_toas(i=0, n=30, noise=""):
+    from pint_tpu.models.builder import get_model
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    par = (f"PSR MESHT{i}\nRAJ {5 + i}:00:00\nDECJ 20:00:00\n"
+           f"F0 {100.0 + 7.0 * i} 1\nF1 -1e-15 1\nPEPOCH 55000\n"
+           f"DM {10.0 + i} 1\nTZRMJD 55000\nTZRFRQ 1400\nTZRSITE @\n"
+           "UNITS TDB\nEPHEM builtin\n") + noise
+    m = get_model(par)
+    t = make_fake_toas_uniform(
+        54500, 55500, n, m, obs="gbt", error_us=1.0, add_noise=True,
+        rng=np.random.default_rng(i),
+        flags={"f": "L-wide"} if noise else None)
+    m.values["DM"] += 1e-3
+    return m, t
+
+
+def _tiny_batch(k=2):
+    from pint_tpu.parallel import PTABatch
+
+    return PTABatch([_tiny_model_toas(i) for i in range(k)])
+
+
+class TestSingleDeviceMesh:
+    def test_grid_mesh_matches_unsharded(self):
+        from pint_tpu.grid import make_grid_fn
+
+        m, t = _tiny_model_toas(0)
+        gv = np.linspace(m.values["F0"] - 1e-9, m.values["F0"] + 1e-9,
+                         5)[:, None]
+        fn, _, _ = make_grid_fn(t, m, ["F0"], n_steps=2)
+        fn_s, _, _ = make_grid_fn(t, m, ["F0"], n_steps=2,
+                                  mesh=M.make_mesh("grid"))
+        c_u = np.asarray(fn(np.asarray(gv))[0])
+        c_s = np.asarray(fn_s(np.asarray(gv))[0])
+        assert c_s.shape == (5,)
+        assert np.allclose(c_u, c_s, rtol=1e-8)
+
+    def test_pta_mesh_matches_unsharded(self):
+        b = _tiny_batch(2)
+        _, c_u, _ = b.fit_wls(maxiter=2)
+        b2 = _tiny_batch(2)
+        _, c_s, _ = b2.fit_wls(maxiter=2, mesh=M.make_mesh("pulsar"))
+        assert np.allclose(np.asarray(c_u), np.asarray(c_s),
+                           rtol=1e-8)
+
+    def test_walker_divisibility_raises(self):
+        import jax.numpy as jnp
+
+        from pint_tpu.sampler import run_mcmc
+
+        mesh = M.make_mesh("walker")
+        ndev = len(jax.devices())
+        nw = 2 * ndev + 2  # even but not a multiple of 2*ndev...
+        if nw % (2 * ndev) == 0:
+            pytest.skip("device count makes every even nw divisible")
+        with pytest.raises(ValueError, match="walker"):
+            run_mcmc(lambda x: -0.5 * jnp.sum(x ** 2),
+                     np.zeros((nw, 2)), 3, jit_key=("mesh-div-t",),
+                     mesh=mesh)
+
+    def test_profiling_records_mesh(self):
+        from pint_tpu import profiling
+
+        b = _tiny_batch(2)
+        with profiling.profiled():  # calls must tick for table_lines
+            b.fit_wls(maxiter=2, mesh=M.make_mesh("pulsar"))
+        recs = [s for s in profiling.programs()
+                if s["label"].startswith("pta.batched_fit:wls:sharded")]
+        assert recs and recs[-1]["mesh"]["axes"] == {
+            "pulsar": len(jax.devices())}
+        # the shared table formatter shows the layout
+        table = "\n".join(profiling.table_lines(recs))
+        assert f"pulsar{len(jax.devices())}" in table
+
+    def test_datacheck_mesh_section(self):
+        from pint_tpu.datacheck import _mesh_section
+
+        lines = _mesh_section()
+        text = "\n".join(lines)
+        assert "PROBLEM" not in text and "ERROR" not in text
+        assert "rule table over the stacked PTA pytree" in text
+        assert "sharded == unsharded" in text
+
+    def test_datacheck_cli_mesh_flag(self, capsys):
+        from pint_tpu.datacheck import main
+
+        assert main(["--mesh"]) == 0
+        out = capsys.readouterr().out
+        assert "Mesh layer (--mesh):" in out
+
+
+# --------------------------------------------------------------------------
+# the multi-device suite: 8 forced host devices in a subprocess
+# --------------------------------------------------------------------------
+
+_MULTIDEV_SCRIPT = r'''
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import pint_tpu
+from pint_tpu import telemetry
+from pint_tpu.models.builder import get_model
+from pint_tpu.parallel import PTABatch, make_mesh, pulsar_mesh
+from pint_tpu.simulation import make_fake_toas_uniform
+
+telemetry.compile_stats()  # compile listener before any compile
+assert len(jax.devices()) == 8, len(jax.devices())
+print("OK_DEVICES")
+
+
+def compile_events():
+    return telemetry.counter_get("jit.compile_events")
+
+
+def mk(i, n=24, noise=""):
+    par = (f"PSR MD{i}\nRAJ {5 + i}:00:00\nDECJ 20:00:00\n"
+           f"F0 {100.0 + 7.0 * i} 1\nF1 -1e-15 1\nPEPOCH 55000\n"
+           f"DM {10.0 + i} 1\nTZRMJD 55000\nTZRFRQ 1400\nTZRSITE @\n"
+           "UNITS TDB\nEPHEM builtin\n") + noise
+    m = get_model(par)
+    t = make_fake_toas_uniform(
+        54500, 55500, n, m, obs="gbt", error_us=1.0, add_noise=True,
+        rng=np.random.default_rng(i),
+        flags={"f": "L-wide"} if noise else None)
+    m.values["DM"] += 1e-3
+    return m, t
+
+
+# --- grid: 5 points pad to 8, sharded == unsharded, zero-recompile ---
+from pint_tpu.grid import make_grid_fn
+
+m, t = mk(0, n=30)
+gv = np.linspace(m.values["F0"] - 1e-9, m.values["F0"] + 1e-9,
+                 5)[:, None]
+fn_u, _, _ = make_grid_fn(t, m, ["F0"], n_steps=2)
+c_u = np.asarray(fn_u(np.asarray(gv))[0])
+gmesh = make_mesh("grid")
+fn_s, _, _ = make_grid_fn(t, m, ["F0"], n_steps=2, mesh=gmesh)
+c_s = np.asarray(fn_s(np.asarray(gv))[0])
+assert c_s.shape == (5,)
+assert np.allclose(c_u, c_s, rtol=1e-8), (c_u, c_s)
+print("OK_GRID_SHARDED_EQ")
+e0 = compile_events()
+fn_s2, _, _ = make_grid_fn(t, m, ["F0"], n_steps=2, mesh=gmesh)
+c_s2 = np.asarray(fn_s2(np.asarray(gv))[0])
+assert compile_events() == e0, "sharded grid recompiled"
+assert np.allclose(c_s, c_s2)
+print("OK_GRID_ZERO_RECOMPILE")
+
+# --- PTA WLS: 5 pulsars on 8 devices -> phantom pad to 8 ------------
+pairs_u = [mk(i) for i in range(5)]
+b_u = PTABatch(pairs_u)
+v_u, c0, _ = b_u.fit_wls(maxiter=2)
+b_s = PTABatch([mk(i) for i in range(5)])
+pmesh = pulsar_mesh()
+v_s, c1, _ = b_s.fit_wls(maxiter=2, mesh=pmesh)
+assert np.asarray(c1).shape == (5,)
+assert np.allclose(np.asarray(c0), np.asarray(c1), rtol=1e-8)
+assert np.allclose(np.asarray(v_u), np.asarray(v_s), rtol=1e-8)
+# written-back values agree too (phantoms never written back)
+for pu, ps in zip(b_u.prepareds, b_s.prepareds):
+    assert np.isclose(pu.model.values["F0"], ps.model.values["F0"],
+                      rtol=0, atol=1e-9)
+frac = telemetry.gauges()["mesh.pad_waste_frac.pulsar"]
+assert abs(frac - 3.0 / 8.0) < 1e-9, frac
+print("OK_PTA_PHANTOM_PAD")
+e0 = compile_events()
+b_s2 = PTABatch([mk(i) for i in range(5)])
+b_s2.fit_wls(maxiter=2, mesh=pmesh)
+assert compile_events() == e0, "second sharded PTA fit recompiled"
+print("OK_PTA_ZERO_RECOMPILE")
+
+# --- PTA GLS with correlated noise + phantom pad --------------------
+noise = ("EFAC -f L-wide 1.1\nEQUAD -f L-wide 0.4\n"
+         "ECORR -f L-wide 0.6\nTNRedAmp -13.0\nTNRedGam 3.0\n"
+         "TNRedC 4\n")
+gls_u = PTABatch([mk(10 + i, noise=noise) for i in range(3)])
+_, cg0, _ = gls_u.fit_gls(maxiter=2)
+gls_s = PTABatch([mk(10 + i, noise=noise) for i in range(3)])
+_, cg1, _ = gls_s.fit_gls(maxiter=2, mesh=pmesh)
+assert np.allclose(np.asarray(cg0), np.asarray(cg1), rtol=1e-6)
+print("OK_PTA_GLS_SHARDED")
+
+# --- lnlike_grid over the grid axis ---------------------------------
+from pint_tpu.simulation import make_fake_pta
+
+gw_pairs = make_fake_pta(2, 25, start_mjd=54000.0,
+                         duration_days=1200.0, seed=3,
+                         name_prefix="MDGW")
+from pint_tpu.gw.common import CommonProcess
+
+cp = CommonProcess(gw_pairs, nmodes=3)
+amps = np.linspace(-14.5, -13.5, 3)
+gams = np.linspace(3.5, 5.0, 2)
+s_u = cp.lnlike_grid(amps, gams)
+s_s = cp.lnlike_grid(amps, gams, mesh=make_mesh("grid"))
+scale = np.max(np.abs(s_u))
+assert np.all(np.abs(s_u - s_s) <= 1e-8 * scale), (s_u, s_s)
+print("OK_LNLIKE_GRID_SHARDED")
+
+# --- walkers: with_sharding_constraint inside the scanned chain -----
+from pint_tpu.sampler import run_mcmc
+
+
+def lnpost(x):
+    return -0.5 * jnp.sum(x ** 2)
+
+
+x0 = np.random.default_rng(0).normal(size=(16, 2))
+cw_u, _, _ = run_mcmc(lnpost, x0, 25, jit_key=("md-walk",))
+wmesh = make_mesh("walker")
+cw_s, _, _ = run_mcmc(lnpost, x0, 25, jit_key=("md-walk",),
+                      mesh=wmesh)
+assert np.allclose(np.asarray(cw_u), np.asarray(cw_s), atol=1e-12)
+print("OK_WALKER_SHARDED")
+e0 = compile_events()
+cw_s2, _, _ = run_mcmc(lnpost, x0, 25, jit_key=("md-walk",),
+                       mesh=wmesh)
+assert compile_events() == e0, "second sharded chain recompiled"
+print("OK_WALKER_ZERO_RECOMPILE")
+
+# --- OS pair axis through the shared layer --------------------------
+from pint_tpu.simulation import add_gwb, pta_injection_seed
+
+gw_pairs2 = make_fake_pta(
+    4, 25, start_mjd=54000.0, duration_days=1200.0, seed=5,
+    name_prefix="MDOS",
+    extra_par="TNRedAmp -13.7\nTNRedGam 4.33\nTNRedC 3\n")
+add_gwb([t for _, t in gw_pairs2], [m for m, _ in gw_pairs2], 2e-14,
+        rng=pta_injection_seed(5, 4), nmodes=3)
+os_ = PTABatch(gw_pairs2).optimal_statistic(nmodes=3)
+r_u = os_.compute()
+r_s = os_.compute(mesh=make_mesh("pair"))  # 6 pairs pad to 8
+assert abs(r_s.ahat2 - r_u.ahat2) <= 1e-6 * max(
+    abs(r_u.ahat2), r_u.sigma_ahat2)
+print("OK_OS_SHARDED")
+
+# --- the program records say what ran sharded -----------------------
+from pint_tpu import profiling
+
+by_label = {s["label"]: s for s in profiling.programs()}
+assert by_label["grid.fit_one:F0:sharded"]["mesh"]["axes"] == \
+    {"grid": 8}
+assert by_label["pta.batched_fit:wls:sharded"]["mesh"]["axes"] == \
+    {"pulsar": 8}
+assert by_label["gw.os.program:sharded"]["mesh"]["axes"] == \
+    {"pair": 8}
+# table_lines only shows programs with profiled CALLS — run one
+# sharded call under the gate, then the MESH column must say so
+with profiling.profiled():
+    os_.compute(mesh=make_mesh("pair"))
+table = "\n".join(profiling.table_lines())
+assert "pair8" in table, table
+print("OK_PROGRAM_MESH_RECORDS")
+print("ALL_OK")
+'''
+
+_MARKERS = (
+    "OK_DEVICES", "OK_GRID_SHARDED_EQ", "OK_GRID_ZERO_RECOMPILE",
+    "OK_PTA_PHANTOM_PAD", "OK_PTA_ZERO_RECOMPILE",
+    "OK_PTA_GLS_SHARDED", "OK_LNLIKE_GRID_SHARDED",
+    "OK_WALKER_SHARDED", "OK_WALKER_ZERO_RECOMPILE", "OK_OS_SHARDED",
+    "OK_PROGRAM_MESH_RECORDS", "ALL_OK",
+)
+
+
+def test_multidevice_sharded_suite(tmp_path):
+    """grid / PTA (phantom pad) / GLS / lnlike_grid / walkers / OS all
+    sharded == unsharded on 8 forced host devices, zero new compiles
+    on second same-shaped sharded calls, and the profiling registry
+    recording the mesh per program."""
+    script = tmp_path / "multidev.py"
+    script.write_text(_MULTIDEV_SCRIPT)
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(pint_tpu.__file__)))
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                   + " --xla_force_host_platform_device_count=8").strip(),
+        PYTHONPATH=repo_root + os.pathsep
+        + os.environ.get("PYTHONPATH", ""),
+    )
+    env.pop("PINT_TPU_FAULTS", None)
+    r = subprocess.run([sys.executable, str(script)], env=env,
+                       capture_output=True, text=True, timeout=540)
+    assert r.returncode == 0, (r.stdout[-4000:], r.stderr[-4000:])
+    for marker in _MARKERS:
+        assert marker in r.stdout, (marker, r.stdout[-4000:])
