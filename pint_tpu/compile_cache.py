@@ -5,7 +5,7 @@ Every recorded bench round shows XLA compile time dwarfing compute on
 the fit hot path (PERF.md: ~30 s compiles feeding fits that then run in
 milliseconds) — and the seed design paid it again on every process
 start, every new ``Fitter`` instance, and every TOA-count change.  This
-module is the single place that cost is amortized, in four layers:
+module is the single place that cost is amortized, in five layers:
 
 1. **Persistent on-disk XLA compilation cache** —
    :func:`enable_persistent_cache` turns on
@@ -37,6 +37,21 @@ module is the single place that cost is amortized, in four layers:
    fit shapes offline (the ``pintwarm`` CLI / ``datacheck --warm``) to
    pre-populate the persistent cache, so the first real fit of a fresh
    process pays a disk read instead of a 30-second compile.
+5. **AOT executable serialization** — :func:`export_executables` /
+   :func:`import_executables` serialize the compiled registry programs
+   themselves (manifest keyed by stable identity x jit key x
+   jax-version/backend/topology, per-backend codec), so a fresh
+   process serves :func:`shared_jit` lookups from deserialized
+   executables: no trace, no lowering, zero uncached backend compiles
+   before its first fit (``pintwarm --export/--import``, ``datacheck
+   --aot``, bench ``cold_start_s``).
+
+This module also owns the scan-vs-unroll choice for fixed-count GN
+iteration loops inside traces (:func:`iterate_fixed`,
+``$PINT_TPU_SCAN_ITERS``): scanning the iteration body shrinks the
+HLO the backend compiles by roughly the iteration count — the grid
+and batched-PTA programs route through it, with the flag in their jit
+keys.
 
 The split/merge helpers (:func:`split_ctx` / :func:`merge_ctx`) carry
 the prepare-time component ctx across the jit boundary: array leaves
@@ -62,11 +77,17 @@ __all__ = [
     "bucket_size", "pad_toas", "PAD_ERROR_US",
     "split_ctx", "merge_ctx", "fingerprint",
     "model_structure_key", "donation_argnums", "warmup",
+    "scan_iters_default", "iterate_fixed",
+    "export_executables", "import_executables", "aot_store_stats",
+    "clear_aot_store", "aot_cold_start_probe",
 ]
 
 _CACHE_ENV = "PINT_TPU_CACHE_DIR"
 _BUCKET_ENV = "PINT_TPU_BUCKET_TOAS"
+_SCAN_ENV = "PINT_TPU_SCAN_ITERS"
 _DEFAULT_CACHE_DIR = os.path.join("~", ".cache", "pint_tpu", "xla")
+_AOT_MANIFEST = "manifest.json"
+_AOT_FORMAT = 1
 
 _lock = threading.RLock()
 
@@ -184,11 +205,13 @@ def cache_entries():
 
 
 def _reset_for_tests():
-    """Forget the enable decision and empty the registry (tests)."""
+    """Forget the enable decision and empty the registry and the
+    imported-executable store (tests)."""
     global _cache_dir_state
     with _lock:
         _cache_dir_state = None
         _registry.clear()
+        _aot_store.clear()
 
 
 # --------------------------------------------------------------------------
@@ -213,6 +236,119 @@ def _derive_label(fn, key):
     if isinstance(key, tuple) and key and isinstance(key[0], str):
         return key[0]
     return getattr(fn, "__qualname__", None) or "program"
+
+
+# --------------------------------------------------------------------------
+# AOT executable store (import side; export/import live further down)
+# --------------------------------------------------------------------------
+
+#: stable-hash -> {"compiled": jax.stages.Compiled, "label", "file"}
+#: populated by import_executables(); consulted by shared_jit on a
+#: registry miss
+_aot_store: dict = {}
+
+
+def _stable_identity(identity) -> str:
+    """Cross-process-stable form of a registry identity: fn_token
+    strings pass through; function objects map to module.qualname
+    (stable for the same code version — the manifest's jax/version
+    fields gate everything else)."""
+    if isinstance(identity, str):
+        return identity
+    mod = getattr(identity, "__module__", "") or ""
+    qual = (getattr(identity, "__qualname__", None)
+            or getattr(identity, "__name__", None) or repr(identity))
+    return f"{mod}.{qual}"
+
+
+def _aot_hash(identity, key) -> str:
+    """Content hash of (stable identity, key) — the manifest key an
+    exported executable is filed (and later matched) under.  Library
+    keys are tuples of strings/ints/bools/tuples, so their repr is
+    deterministic across processes."""
+    return hashlib.blake2b(
+        repr((_stable_identity(identity), key)).encode(),
+        digest_size=16).hexdigest()
+
+
+class _AotProgram:
+    """A registry entry served by deserialized AOT executables.
+
+    One registry entry serves MULTIPLE shapes (keys are
+    structure-only), so the store hands over a LIST of loaded
+    executables — one per exported spec.  ``__call__`` tries them
+    (move-to-front, so steady-state serving is first-try): a
+    shape/aval mismatch (TypeError/ValueError, raised host-side
+    before execution) is a SOFT miss — that call falls through to the
+    plain jit (``jit.aot_shape_misses``) and the executables stay
+    live for the shapes they DO match.  Any other exception is a
+    runtime failure: the entry demotes permanently
+    (``jit.aot_import_rejects`` + an ``aot_demotion`` telemetry
+    record — and if a donated buffer was consumed the jit fallback
+    will fail loudly on its own).  Every other attribute (``lower``
+    for AOT warmup, etc.) forwards to the underlying jit."""
+
+    __slots__ = ("_compiled", "_jit", "_dead")
+
+    def __init__(self, compiled, jit):
+        # accept one executable or a list of them
+        self._compiled = (list(compiled)
+                          if isinstance(compiled, (list, tuple))
+                          else [compiled])
+        self._jit = jit
+        self._dead = False
+
+    def __call__(self, *args, **kwargs):
+        if not self._dead and not kwargs:
+            for i, comp in enumerate(self._compiled):
+                try:
+                    out = comp(*args)
+                except (TypeError, ValueError):
+                    # aval mismatch, raised before execution: this
+                    # executable serves a different shape of the same
+                    # program — keep trying / fall through
+                    continue
+                except Exception as e:
+                    self._dead = True
+                    telemetry.counter_add("jit.aot_import_rejects")
+                    telemetry.emit({
+                        "type": "aot_demotion",
+                        "error": f"{type(e).__name__}: {e}"[:500],
+                    })
+                    break
+                else:
+                    if i:
+                        self._compiled.insert(
+                            0, self._compiled.pop(i))
+                    telemetry.counter_add("jit.aot_served_calls")
+                    return out
+            else:
+                telemetry.counter_add("jit.aot_shape_misses")
+        return self._jit(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(object.__getattribute__(self, "_jit"), name)
+
+
+def aot_store_stats() -> dict:
+    """{"entries", "hits", "misses", "rejects", "served_calls"} of the
+    imported-executable store (datacheck/tests)."""
+    return {
+        "entries": len(_aot_store),
+        "hits": int(telemetry.counter_get("jit.aot_import_hits")),
+        "misses": int(telemetry.counter_get("jit.aot_import_misses")),
+        "rejects": int(telemetry.counter_get("jit.aot_import_rejects")),
+        "shape_misses": int(
+            telemetry.counter_get("jit.aot_shape_misses")),
+        "served_calls": int(
+            telemetry.counter_get("jit.aot_served_calls")),
+    }
+
+
+def clear_aot_store():
+    """Drop every imported executable (tests)."""
+    with _lock:
+        _aot_store.clear()
 
 
 def shared_jit(fn, *, key, fn_token=None, donate_argnums=None,
@@ -270,14 +406,73 @@ def shared_jit(fn, *, key, fn_token=None, donate_argnums=None,
         _entry.__name__ = getattr(fn, "__name__", "shared_jit_entry")
         _entry.__qualname__ = getattr(fn, "__qualname__",
                                       _entry.__name__)
+        target = jax.jit(_entry, **kwargs)
+        # imported-executable store: a fresh process that ran
+        # import_executables() serves this key from a deserialized
+        # Compiled — no trace, no backend compile.  Misses are only
+        # counted while a store is loaded (a normal session must not
+        # tick them on every registry build).
+        if _aot_store:
+            got_aot = _aot_store.get(_aot_hash(identity, key))
+            if got_aot is not None:
+                telemetry.counter_add("jit.aot_import_hits")
+                target = _AotProgram(got_aot["compiled"], target)
+            else:
+                telemetry.counter_add("jit.aot_import_misses")
         jitted = profiling.wrap_program(
-            jax.jit(_entry, **kwargs), key=key,
+            target, key=key,
             label=label if label is not None else _derive_label(fn, key))
         _registry[full_key] = jitted
         cap = _registry_cap()
         while len(_registry) > cap:
             _registry.popitem(last=False)
         return jitted
+
+
+def scan_iters_default() -> bool:
+    """Whether fixed-count iteration loops inside traces run as
+    ``jax.lax.scan`` (the default — HLO size ~1/n_steps of the
+    unrolled trace, which is what the cold-compile budget pays for)
+    or as the historical python unroll
+    (``$PINT_TPU_SCAN_ITERS=0/off/unroll`` — the per-program escape
+    hatch when a backend fuses the unrolled body better).  The choice
+    changes the traced program, so every caller folds it into its
+    shared-jit key."""
+    raw = os.environ.get(_SCAN_ENV)
+    if raw is None or not raw.strip():
+        return True
+    return raw.strip().lower() not in ("0", "off", "false", "no",
+                                       "unroll")
+
+
+def iterate_fixed(body, init, n_steps, scan=None):
+    """Run ``carry = body(carry)`` exactly ``n_steps`` times inside a
+    trace — the one implementation of the fixed-count Gauss-Newton
+    iteration loop shared by the grid and batched-PTA step programs.
+
+    scan=True: ``lax.scan`` with the iterate as carry (one traced body
+    + a loop, so the HLO the backend compiles shrinks by roughly the
+    iteration count); scan=False: the python unroll (n_steps copies of
+    the body in the HLO — XLA can fuse across iterations, at compile
+    cost linear in the count).  ``scan=None`` follows
+    :func:`scan_iters_default`.  Callers must resolve the flag at
+    trace-BUILD time and put it in their jit key: the two variants are
+    different programs."""
+    if int(n_steps) <= 0:
+        return init
+    if scan is None:
+        scan = scan_iters_default()
+    if not scan:
+        for _ in range(int(n_steps)):
+            init = body(init)
+        return init
+    import jax
+
+    def step(carry, _):
+        return body(carry), None
+
+    out, _ = jax.lax.scan(step, init, None, length=int(n_steps))
+    return out
 
 
 def registry_stats():
@@ -350,8 +545,9 @@ def model_structure_key(model) -> str:
 
 def fingerprint(tree) -> str:
     """Content fingerprint of a pytree of arrays/scalars/strings —
-    for registry keys where data IS baked into the trace (the grid
-    path closes over its dataset).  Hashing is by array bytes, so two
+    for identities derived from data CONTENT (checkpoint validation;
+    historically the grid's baked-dataset registry key, retired when
+    the grid went data-dynamic).  Hashing is by array bytes, so two
     numerically identical datasets fingerprint equal."""
     h = hashlib.blake2b(digest_size=16)
 
@@ -576,6 +772,26 @@ EPHEM builtin
 """
 
 
+def fitter_class(kind):
+    """The fitter class for a warm/verify ``kind`` token — the ONE
+    kind->class map shared by :func:`warmup`,
+    :func:`aot_cold_start_probe`, and the ``pintwarm`` CLI."""
+    from pint_tpu.downhill import DownhillGLSFitter, DownhillWLSFitter
+    from pint_tpu.fitter import GLSFitter, WLSFitter
+
+    classes = {
+        "wls": WLSFitter,
+        "gls": GLSFitter,
+        "downhill_wls": DownhillWLSFitter,
+        "downhill_gls": DownhillGLSFitter,
+    }
+    try:
+        return classes[kind]
+    except KeyError:
+        raise ValueError(f"unknown fitter kind {kind!r}; expected one "
+                         f"of {sorted(classes)}") from None
+
+
 def _warm_pairs(n_toas, kind, seed=0):
     from pint_tpu.models.builder import get_model
     from pint_tpu.simulation import make_fake_toas_uniform
@@ -591,7 +807,7 @@ def _warm_pairs(n_toas, kind, seed=0):
 
 
 def warmup(toa_counts=(500, 1000), kinds=("wls", "gls"), bucket=None,
-           progress=None, pairs=None):
+           progress=None, pairs=None, jobs=None):
     """AOT-compile (``jit.lower().compile()``) the standard fit shapes,
     populating the persistent cache for future processes.  Returns a
     list of {"kind", "n_toas", "bucket", "compile_s"} records.
@@ -604,31 +820,26 @@ def warmup(toa_counts=(500, 1000), kinds=("wls", "gls"), bucket=None,
 
     pairs: optional explicit [(model, toas), ...] to warm a real
     dataset's shapes instead of the synthetic standards (the
-    ``pintwarm --par/--tim`` path)."""
-    from pint_tpu.downhill import DownhillGLSFitter, DownhillWLSFitter
-    from pint_tpu.fitter import GLSFitter, WLSFitter
-
-    fitter_of = {
-        "wls": WLSFitter,
-        "gls": GLSFitter,
-        "downhill_wls": DownhillWLSFitter,
-        "downhill_gls": DownhillGLSFitter,
-    }
+    ``pintwarm --par/--tim`` path).  jobs: prebuilt [(kind, model,
+    toas), ...] — overrides toa_counts/kinds/pairs so a caller that
+    already built the datasets (pintwarm --export's dress-rehearsal
+    pass) never simulates them twice."""
     if bucket is None:
         bucket = bucketing_default()
     out = []
-    jobs = []
-    if pairs is not None:
-        for kind in kinds:
-            for model, toas in pairs:
-                jobs.append((kind, model, toas))
-    else:
-        for kind in kinds:
-            for n in toa_counts:
-                model, toas = _warm_pairs(n, kind)
-                jobs.append((kind, model, toas))
+    if jobs is None:
+        jobs = []
+        if pairs is not None:
+            for kind in kinds:
+                for model, toas in pairs:
+                    jobs.append((kind, model, toas))
+        else:
+            for kind in kinds:
+                for n in toa_counts:
+                    model, toas = _warm_pairs(n, kind)
+                    jobs.append((kind, model, toas))
     for kind, model, toas in jobs:
-        cls = fitter_of[kind]
+        cls = fitter_class(kind)
         n_in = len(toas)
         if bucket:
             toas = pad_toas(toas)
@@ -649,3 +860,420 @@ def warm_timed(fn):
     t0 = time.perf_counter()
     fn()
     return time.perf_counter() - t0
+
+
+# --------------------------------------------------------------------------
+# layer 5: AOT executable serialization (zero-retrace cold start)
+# --------------------------------------------------------------------------
+
+def _aot_env() -> dict:
+    """The version/topology fields an exported executable is valid
+    under — per-entry in the manifest, so a partially-stale directory
+    rejects entry-by-entry instead of all-or-nothing."""
+    import jax
+    import jaxlib
+
+    return {
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "backend": jax.default_backend(),
+        "n_devices": len(jax.devices()),
+    }
+
+
+def _aot_codec() -> str:
+    """Which serialization codec this backend gets.
+
+    - ``pjrt`` (``jax.experimental.serialize_executable`` — the whole
+      compiled executable, zero trace AND zero backend compile on
+      import) on TPU/GPU, the backends whose PJRT clients implement
+      executable deserialization.
+    - ``stablehlo`` (``jax.export`` — the lowered module) on CPU:
+      XLA:CPU cannot reload its in-process-JITed executables (measured
+      on jaxlib 0.4.36: fresh payloads segfault the importing process,
+      cache-served ones fail symbol resolution), so the import side
+      re-compiles the module instead — skipping the expensive pint_tpu
+      python trace/lowering, with the backend compile served from the
+      persistent XLA cache that export pre-seeds
+      (:func:`export_executables`).
+
+    ``$PINT_TPU_AOT_CODEC`` overrides (testing / a future backend)."""
+    raw = os.environ.get("PINT_TPU_AOT_CODEC", "").strip().lower()
+    if raw in ("pjrt", "stablehlo"):
+        return raw
+    import jax
+
+    return ("pjrt" if jax.default_backend() in
+            ("tpu", "gpu", "cuda", "rocm") else "stablehlo")
+
+
+def _unwrap_jit(proxy):
+    """The raw ``jax.jit`` object under a registry entry (through the
+    profiling proxy and, on an imported entry, the _AotProgram)."""
+    target = getattr(proxy, "_jitted", proxy)
+    if isinstance(target, _AotProgram):
+        target = target._jit
+    return target
+
+
+_aot_pytrees_registered = False
+
+
+def _register_aot_pytrees():
+    """Teach ``jax.export`` to serialize the library's NamedTuple
+    pytrees (they ride the arg/result trees of every fit program).
+    Idempotent; a jax without the registration API degrades to
+    per-entry skip at export (the ValueError lands in the entry's
+    ``skipped`` record)."""
+    global _aot_pytrees_registered
+    if _aot_pytrees_registered:
+        return
+    _aot_pytrees_registered = True
+    try:
+        import jax.export as _jexp
+
+        reg = _jexp.register_namedtuple_serialization
+    except Exception:
+        return
+    from pint_tpu.dd import DD
+    from pint_tpu.guard import Health, SolveDiag
+    from pint_tpu.linalg import StructuredU, WoodburyPre
+    from pint_tpu.toa import TOABatch
+
+    for cls in (TOABatch, StructuredU, WoodburyPre, SolveDiag, Health,
+                DD):
+        try:
+            reg(cls,
+                serialized_name=f"pint_tpu.{cls.__name__}")
+        except Exception:
+            pass  # already registered (or an exotic jax): keep going
+
+
+def _prime_custom_calls():
+    """Force-register jaxlib's lazily-registered LAPACK FFI custom-call
+    targets by LOWERING (never compiling/running) one tiny instance of
+    each decomposition the fit programs use.  Without this, a
+    deserialized module whose custom calls were never lowered in this
+    process resolves them to garbage — measured as a hard SEGFAULT on
+    jaxlib 0.4.36 CPU — so the import path runs it once before the
+    first deserialized module is loaded."""
+    import jax
+    import jax.numpy as jnp
+    import jax.scipy.linalg as jsl
+
+    spec = jax.ShapeDtypeStruct((2, 2), jnp.float64)
+    for fn in (jnp.linalg.cholesky, jnp.linalg.eigh,
+               lambda m: jnp.linalg.svd(m, full_matrices=False),
+               jsl.lu, lambda m: jsl.solve_triangular(m, m)):
+        try:
+            jax.jit(fn).lower(spec)
+        except Exception:
+            pass  # a missing decomposition just stays unprimed
+
+
+def _spec_desc(spec):
+    """Human-readable (and manifest-stable) summary of an argument
+    spec pytree: leaf shapes/dtypes, flattened."""
+    import jax
+
+    out = []
+    for leaf in jax.tree_util.tree_leaves(spec):
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            out.append([list(leaf.shape), str(leaf.dtype)])
+        else:
+            out.append(repr(leaf))
+    return out
+
+
+def export_executables(path, progress=None):
+    """Serialize every shared-jit registry program whose argument spec
+    has been observed this session (called or ``lower``-ed — the
+    profiling proxy records it) into ``path``: one pickled executable
+    payload per entry plus a ``manifest.json`` keyed by the stable
+    (identity, jit key) hash, stamped with the jax/jaxlib version,
+    backend, and device count it is valid under.
+
+    Returns ``{"exported": [records], "skipped": [(label, why)]}``.
+    Codec per backend (:func:`_aot_codec`): ``pjrt`` serializes the
+    compiled executable itself; ``stablehlo`` serializes the lowered
+    module via ``jax.export`` AND pre-seeds the persistent XLA cache
+    (when one is active) with exactly the module the import side will
+    compile, so its backend compile is a disk read.  Programs the
+    backend cannot serialize are skipped per-entry, never fatally.
+    Repeated exports into one directory merge by hash as long as the
+    environment matches; an environment change rewrites the manifest
+    from scratch (stale entries would only ever be rejected at
+    import)."""
+    import pickle
+
+    import jax
+
+    path = os.path.abspath(os.path.expanduser(os.fspath(path)))
+    os.makedirs(path, exist_ok=True)
+    env = _aot_env()
+    codec = _aot_codec()
+    _register_aot_pytrees()
+    with _lock:
+        entries = list(_registry.items())
+    exported, skipped = [], []
+    for (identity, key), proxy in entries:
+        label = getattr(getattr(proxy, "stats", None), "label", None) \
+            or _stable_identity(identity)
+        specs = getattr(proxy, "aot_specs", None)
+        if not specs:
+            skipped.append((label, "no recorded argument spec "
+                                   "(never called or lowered)"))
+            continue
+        ah = _aot_hash(identity, key)
+        # one payload per recorded shape: a structure-only registry
+        # entry legitimately serves several aval sets (a warm sweep
+        # over TOA counts), and each needs its own executable
+        for k, spec in enumerate(specs):
+            fname = f"aot-{ah}-{k}.bin"
+            try:
+                if codec == "pjrt":
+                    from jax.experimental import (
+                        serialize_executable as _se,
+                    )
+
+                    compiled = proxy.lower(*spec).compile()
+                    payload, in_tree, out_tree = _se.serialize(
+                        compiled)
+                    blob = pickle.dumps({"payload": payload,
+                                         "in_tree": in_tree,
+                                         "out_tree": out_tree})
+                else:
+                    import jax.export as _jexp
+
+                    ex = _jexp.export(_unwrap_jit(proxy))(*spec)
+                    blob = bytes(ex.serialize())
+                    if cache_dir():
+                        # seed the persistent cache with the exact
+                        # module the import side will jit — its
+                        # backend compile becomes a cache hit, so the
+                        # cold replica's uncached-compile count stays
+                        # zero
+                        jax.jit(_jexp.deserialize(blob).call).lower(
+                            *spec).compile()
+            except Exception as e:
+                skipped.append((label, f"{type(e).__name__}: {e}"))
+                continue
+            with open(os.path.join(path, fname), "wb") as fh:
+                fh.write(blob)
+            rec = {"hash": ah,
+                   "identity": _stable_identity(identity),
+                   "label": label, "file": fname, "bytes": len(blob),
+                   "codec": codec, "avals": _spec_desc(spec), **env}
+            exported.append(rec)
+            if progress is not None:
+                progress(f"exported {label} ({codec}, "
+                         f"{len(blob)} bytes)")
+    _write_manifest(path, env, exported)
+    telemetry.counter_add("compile_cache.aot_exports", len(exported))
+    return {"exported": exported, "skipped": skipped}
+
+
+def _write_manifest(path, env, new_entries):
+    """Merge ``new_entries`` into the directory manifest (by hash;
+    same-environment only) and atomic-write it."""
+    import json
+
+    manifest_path = os.path.join(path, _AOT_MANIFEST)
+    merged = {}
+
+    def mkey(e):
+        # one entry per (program, shape): the hash alone collides
+        # across the several aval sets one registry entry serves
+        return (e["hash"], repr(e.get("avals")))
+
+    try:
+        with open(manifest_path) as fh:
+            old = json.load(fh)
+        if old.get("format") == _AOT_FORMAT:
+            for e in old.get("entries", []):
+                # keep only entries this environment could still
+                # serve; a version bump invalidates the whole batch
+                if all(e.get(k) == env[k] for k in env):
+                    merged[mkey(e)] = e
+    except (OSError, ValueError):
+        pass
+    for e in new_entries:
+        merged[mkey(e)] = e
+    doc = {"format": _AOT_FORMAT, **env,
+           "entries": sorted(merged.values(),
+                             key=lambda e: (e["hash"], e["file"]))}
+    tmp = manifest_path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh, indent=1)
+    os.replace(tmp, manifest_path)
+
+
+def import_executables(path, progress=None):
+    """Load AOT-serialized executables from ``path`` into the store
+    :func:`shared_jit` consults: a later registry build whose (stable
+    identity, key) hash matches serves the deserialized executable —
+    no trace, no XLA backend compile.
+
+    Per-entry graceful rejection (``jit.aot_import_rejects``): a
+    jax/jaxlib version, backend, or device-count mismatch, an unknown
+    or backend-unsupported codec, an unreadable payload, or a failed
+    deserialization skips THAT entry and the key retraces as usual.
+    A missing/empty directory returns ``{"loaded": 0, ...}`` without
+    error.  Returns ``{"loaded", "rejected": [(label, why)],
+    "path"}``."""
+    import json
+    import pickle
+
+    path = os.path.abspath(os.path.expanduser(os.fspath(path)))
+    manifest_path = os.path.join(path, _AOT_MANIFEST)
+    rejected = []
+    try:
+        with open(manifest_path) as fh:
+            doc = json.load(fh)
+    except OSError:
+        return {"loaded": 0, "rejected": [], "path": path,
+                "detail": "no manifest"}
+    except ValueError as e:
+        telemetry.counter_add("jit.aot_import_rejects")
+        return {"loaded": 0, "rejected": [("manifest", str(e))],
+                "path": path}
+    if doc.get("format") != _AOT_FORMAT:
+        telemetry.counter_add("jit.aot_import_rejects")
+        return {"loaded": 0,
+                "rejected": [("manifest",
+                              f"format {doc.get('format')!r} != "
+                              f"{_AOT_FORMAT}")],
+                "path": path}
+    import jax
+
+    env = _aot_env()
+    _register_aot_pytrees()
+    pjrt_ok = jax.default_backend() in ("tpu", "gpu", "cuda", "rocm")
+    primed = False
+    loaded = 0
+    for e in doc.get("entries", []):
+        label = e.get("label", e.get("hash", "?"))
+        mismatch = [k for k in env if e.get(k) != env[k]]
+        if mismatch:
+            telemetry.counter_add("jit.aot_import_rejects")
+            rejected.append(
+                (label, "environment mismatch: " + ", ".join(
+                    f"{k}={e.get(k)!r}!={env[k]!r}" for k in mismatch)))
+            continue
+        codec = e.get("codec", "pjrt")
+        if codec == "pjrt" and not pjrt_ok:
+            # XLA:CPU cannot reload serialized executables (jaxlib
+            # 0.4.36: deserialization of a fresh payload SEGFAULTS the
+            # process — not even catchable), so a cpu-backend pjrt
+            # entry is rejected before any payload bytes are touched
+            telemetry.counter_add("jit.aot_import_rejects")
+            rejected.append(
+                (label, f"pjrt codec unsupported on "
+                        f"{jax.default_backend()} backend"))
+            continue
+        if codec not in ("pjrt", "stablehlo"):
+            telemetry.counter_add("jit.aot_import_rejects")
+            rejected.append((label, f"unknown codec {codec!r}"))
+            continue
+        with _lock:
+            rec = _aot_store.get(e["hash"])
+            if rec is not None and e["file"] in rec["files"]:
+                continue  # already loaded (repeated import call)
+        try:
+            with open(os.path.join(path, e["file"]), "rb") as fh:
+                raw = fh.read()
+            if codec == "pjrt":
+                from jax.experimental import (
+                    serialize_executable as _se,
+                )
+
+                blob = pickle.loads(raw)
+                compiled = _se.deserialize_and_load(
+                    blob["payload"], blob["in_tree"],
+                    blob["out_tree"])
+            else:
+                import jax.export as _jexp
+
+                if not primed:
+                    # lazily-registered LAPACK custom-call targets
+                    # must exist BEFORE a deserialized module runs
+                    # (see _prime_custom_calls: unprimed == segfault)
+                    _prime_custom_calls()
+                    primed = True
+                compiled = jax.jit(_jexp.deserialize(raw).call)
+        except Exception as exc:
+            telemetry.counter_add("jit.aot_import_rejects")
+            rejected.append((label, f"{type(exc).__name__}: {exc}"))
+            continue
+        with _lock:
+            # one store record per program hash, holding EVERY
+            # exported shape's executable (the _AotProgram tries them)
+            rec = _aot_store.setdefault(
+                e["hash"], {"compiled": [], "files": [],
+                            "label": label, "codec": codec})
+            rec["compiled"].append(compiled)
+            rec["files"].append(e["file"])
+        loaded += 1
+        if progress is not None:
+            progress(f"imported {label} ({codec})")
+    telemetry.gauge_set("compile_cache.aot_store", len(_aot_store))
+    return {"loaded": loaded, "rejected": rejected, "path": path}
+
+
+def aot_cold_start_probe(mode, path, kind="wls", n_toas=500,
+                         maxiter=3, t_start=None):
+    """The export/import half of a fresh-process cold-start
+    measurement — the ONE implementation behind ``bench.py``'s
+    ``cold_start_s`` children and ``datacheck --aot``'s.
+
+    mode="export": build the standard warm (model, toas) pair, run the
+    first fit cold, then serialize this process's executables (and, via
+    ``$PINT_TPU_CACHE_DIR``, leave the eager-op stragglers in the
+    persistent XLA cache).  mode="import": pre-load the executables,
+    then build the same pair and run the first fit — the zero-compile
+    path under test.  Returns a record with wall seconds, the chi^2
+    (json round-trips f64 exactly, so equality checks are
+    bit-identity), and the compile/AOT telemetry the caller asserts
+    on.
+
+    t_start: a ``time.time()`` taken as early as the child could
+    manage (before the jax/pint_tpu imports) so ``wall_s`` covers the
+    interpreter+import cost too; None falls back to probe-call-to-fit
+    (callers that only compare the two modes).  The headline bench
+    metric uses the PARENT-measured subprocess wall regardless — the
+    only clock that honestly includes process startup."""
+    t0 = time.perf_counter()
+    telemetry.compile_stats()  # listener before any compile
+    # the persistent cache must be live BEFORE the first eager-op
+    # compile (module-level jits fire at import of the fitter stack),
+    # or the early stragglers land outside it and the probe's
+    # uncached count lies; env-gated like the fit path ($PINT_TPU_CACHE_DIR)
+    _auto_enable()
+    imported = {"loaded": 0, "rejected": []}
+    if mode == "import":
+        imported = import_executables(path)
+    model, toas = _warm_pairs(n_toas, kind)
+    f = fitter_class(kind)(toas, model)
+    chi2 = f.fit_toas(maxiter=maxiter)
+    wall = (time.time() - t_start if t_start is not None
+            else time.perf_counter() - t0)
+    rec = {"mode": mode, "kind": kind, "n_toas": int(n_toas),
+           "wall_s": round(wall, 3), "chi2": float(chi2),
+           "loaded": imported.get("loaded", 0),
+           "rejected": len(imported.get("rejected", []))}
+    if mode == "export":
+        # the fit above compiled (and spec-recorded) everything the
+        # import side will need; serialize it
+        out = export_executables(path)
+        rec["exported"] = len(out["exported"])
+        rec["skipped"] = len(out["skipped"])
+    cs = telemetry.compile_stats()
+    rec.update({
+        "backend_compiles": cs["backend_events"],
+        "uncached_backend_compiles": cs["uncached_backend_events"],
+        "cache_hits": cs["cache_hits"],
+        "aot_hits": cs["aot_hits"],
+        "aot_rejects": cs["aot_rejects"],
+        "monitoring": cs["source"] == "jax.monitoring",
+    })
+    return rec

@@ -171,7 +171,13 @@ def datacheck_report(ephem="builtin", sites=("gbt", "ao", "jb", "pks",
         f"{cs['seconds']:.2f}s this session (source: {cs['source']}; "
         f"backend compiles {cs['backend_events']} / "
         f"{cs['backend_seconds']:.2f}s, disk-cache hits "
-        f"{cs['cache_hits']} saving {cs['cache_saved_seconds']:.2f}s)")
+        f"{cs['cache_hits']} saving {cs['cache_saved_seconds']:.2f}s, "
+        f"{cs['uncached_backend_events']} uncached)")
+    if cs["aot_hits"] or cs["aot_misses"] or cs["aot_rejects"]:
+        lines.append(
+            f"  AOT executables: {cs['aot_hits']} served, "
+            f"{cs['aot_misses']} miss(es), {cs['aot_rejects']} "
+            "reject(s) (compile_cache.import_executables)")
     from pint_tpu import guard as _guard
 
     lines.append(
@@ -600,6 +606,115 @@ def _profile_section():
     return lines
 
 
+def _aot_child(mode, path):
+    """Child entry for the --aot smoke (one fresh interpreter per
+    probe run): prints the probe record as a JSON line."""
+    import json
+
+    from pint_tpu.compile_cache import aot_cold_start_probe
+
+    print(json.dumps(aot_cold_start_probe(
+        mode, path, kind="wls", n_toas=64, maxiter=2)), flush=True)
+    return 0
+
+
+def _aot_section():
+    """AOT executable-serialization smoke (--aot): export this
+    machine's fit executables from one fresh subprocess, import them
+    in a second, and verify the served fit is bit-identical with zero
+    UNCACHED XLA backend compiles; then exercise the graceful
+    per-entry reject on a deliberately version-skewed manifest entry.
+    Diagnostic: reports, never raises."""
+    import json
+    import subprocess
+    import sys as _sys
+    import tempfile
+
+    lines = ["AOT executable serialization (--aot):"]
+    try:
+        from pint_tpu import compile_cache
+
+        with tempfile.TemporaryDirectory(
+                prefix="pint_tpu_aot_") as d:
+            env = dict(os.environ)
+            env["PINT_TPU_CACHE_DIR"] = os.path.join(d, "xla")
+
+            def child(mode):
+                r = subprocess.run(
+                    [_sys.executable, "-m", "pint_tpu.datacheck",
+                     "--aot-child", mode, d],
+                    capture_output=True, text=True, env=env,
+                    timeout=300)
+                if r.returncode != 0:
+                    raise RuntimeError(
+                        f"{mode} child rc={r.returncode}: "
+                        f"{(r.stderr or '')[-300:]}")
+                recs = [ln for ln in r.stdout.splitlines()
+                        if ln.startswith("{")]
+                return json.loads(recs[-1])
+
+            exp = child("export")
+            lines.append(
+                f"  export: {exp['exported']} executable(s) "
+                f"serialized ({exp['skipped']} skipped), first fit "
+                f"{exp['wall_s']:.1f}s cold")
+            imp = child("import")
+            identical = imp["chi2"] == exp["chi2"]
+            zero = imp["uncached_backend_compiles"] == 0
+            served = imp["aot_hits"] > 0
+            ok = identical and served and (zero
+                                           or not imp["monitoring"])
+            lines.append(
+                f"  fresh-process import: {imp['loaded']} loaded, "
+                f"{imp['aot_hits']} AOT hit(s), "
+                f"{imp['uncached_backend_compiles']} uncached backend "
+                f"compile(s), first fit {imp['wall_s']:.1f}s")
+            lines.append(
+                "  fit equality: chi2 "
+                + ("bit-identical" if identical else
+                   f"DIFFERS ({imp['chi2']!r} != {exp['chi2']!r})")
+                + "; zero-uncached-compile contract "
+                + ("OK" if zero else "VIOLATED")
+                + (" -> OK" if ok else " -> PROBLEM"))
+
+            # graceful reject: clone one manifest entry with a skewed
+            # jax version — the import must skip IT (counter ticks)
+            # and still load the rest, never raise
+            man_path = os.path.join(d, "manifest.json")
+            with open(man_path) as fh:
+                doc = json.load(fh)
+            if doc.get("entries"):
+                skew = dict(doc["entries"][0])
+                skew["hash"] = "f" * 32
+                skew["jax"] = "0.0.0-version-skew"
+                doc["entries"].append(skew)
+                with open(man_path, "w") as fh:
+                    json.dump(doc, fh)
+                from pint_tpu import telemetry
+
+                before = telemetry.counter_get(
+                    "jit.aot_import_rejects")
+                got = compile_cache.import_executables(d)
+                ticked = telemetry.counter_get(
+                    "jit.aot_import_rejects") - before
+                reasons = [w for _, w in got["rejected"]]
+                graceful = (got["loaded"] >= 1 and ticked >= 1
+                            and any("mismatch" in w for w in reasons))
+                compile_cache.clear_aot_store()
+                lines.append(
+                    f"  version-skewed entry: {len(got['rejected'])} "
+                    f"rejected / {got['loaded']} still loaded, "
+                    f"reject counter +{int(ticked)} -> "
+                    + ("OK (graceful per-entry fallback)"
+                       if graceful else "PROBLEM"))
+            else:
+                lines.append("  version-skew check skipped (nothing "
+                             "exported on this backend)")
+    except Exception as e:  # diagnostic must never take the report down
+        lines.append(f"  ERROR {type(e).__name__}: {e}")
+    return lines
+
+
 def _last_session_compile_lines():
     """Compile/span stats aggregated from the $PINT_TPU_TRACE file, if
     one exists and parses.  The sink appends, so the totals cover every
@@ -666,7 +781,17 @@ def main(argv=None):
                         "mesh construction, partition-rule resolution "
                         "over a real PTA batch pytree, sharded == "
                         "unsharded fit comparison")
+    p.add_argument("--aot", action="store_true",
+                   help="run the AOT executable-serialization smoke: "
+                        "export -> fresh-subprocess import -> "
+                        "bit-identical fit with zero uncached XLA "
+                        "backend compiles, plus the version-skew "
+                        "graceful-reject path")
+    p.add_argument("--aot-child", nargs=2, metavar=("MODE", "DIR"),
+                   default=None, help=argparse.SUPPRESS)
     args = p.parse_args(argv)
+    if args.aot_child is not None:
+        return _aot_child(*args.aot_child)
     for line in datacheck_report(args.ephem):
         print(line)
     if args.faults:
@@ -677,6 +802,9 @@ def main(argv=None):
             print(line)
     if args.mesh:
         for line in _mesh_section():
+            print(line)
+    if args.aot:
+        for line in _aot_section():
             print(line)
     if args.warm:
         from pint_tpu import compile_cache

@@ -58,17 +58,10 @@ class _DownhillMixin:
             donate_argnums=_cc.donation_argnums((0,)),
             label=f"downhill.halving:{type(self).__name__}")
 
-    def warm_compile(self):
-        """AOT-compile the halving step (the downhill hot path) plus
-        the residuals accessors the fit epilogue reports through."""
-        vec = jnp.zeros(len(self._traced_free), dtype=jnp.float64)
-        base = self.prepared._values_pytree()
-        lowered = self._halving_jit.lower(vec, base, self._fit_data)
-        total = _cc.warm_timed(lowered.compile)
-        warm_resids = getattr(self.resids, "warm_compile", None)
-        if warm_resids is not None:
-            total += warm_resids()
-        return total
+    def _warm_entry(self):
+        """warm_compile AOT-compiles the halving step — the downhill
+        hot path (fitter.Fitter.warm_compile supplies the loop)."""
+        return self._halving_jit
 
     def _chi2_at(self, values, data):
         return self.resids.chi2_at(values, data)

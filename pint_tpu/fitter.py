@@ -511,16 +511,25 @@ class Fitter:
         return resid_and_design(self._traced_free, vec,
                                 self._partition, resid_of, linear_of)
 
+    def _warm_entry(self):
+        """The registry program ``warm_compile`` AOT-compiles —
+        subclass hook (the downhill family warms its halving step, the
+        program its fit loop actually drives)."""
+        return self._step_jit
+
     def warm_compile(self):
         """AOT-compile (lower().compile()) the fit step AND the
         residuals accessors the fit epilogue reports through (chi^2,
         weighted RMS) for this problem's shapes, without running a fit
         — with the persistent cache enabled this writes the
         executables to disk, so a future process's first fit is
-        disk reads end to end.  Returns compile seconds."""
+        disk reads end to end.  Lowering through the registry proxy
+        also records the argument spec AOT export serializes from
+        (compile_cache.export_executables), so a warmed-but-never-run
+        process can still export.  Returns compile seconds."""
         vec = jnp.zeros(len(self._traced_free), dtype=jnp.float64)
         base = self.prepared._values_pytree()
-        lowered = self._step_jit.lower(vec, base, self._fit_data)
+        lowered = self._warm_entry().lower(vec, base, self._fit_data)
         total = _cc.warm_timed(lowered.compile)
         warm_resids = getattr(self.resids, "warm_compile", None)
         if warm_resids is not None:

@@ -27,9 +27,26 @@ __all__ = ["grid_chisq", "grid_chisq_vectorized", "make_grid_fn",
            "grid_chisq_derived_tuple"]
 
 
-def _make_fit_one(prepared, resids, grid_params, fit_params, n_steps):
-    """Build the pure function grid_values -> (chi2, fitted_values).
-    Returns ``(fit_one, partition_record)``."""
+def _make_fit_one(prepared, resids, grid_params, fit_params, n_steps,
+                  scan=None):
+    """Build the pure per-point function ``fit_one(grid_vec, dyn) ->
+    (chi2, fitted_values)`` plus its dynamic-leaf pytree ``dyn``.
+    Returns ``(fit_one, dyn, partition_record)``.
+
+    Everything dataset-derived — the residual data pytree, the base
+    parameter values, the starting fit vector, and the host-side
+    frozen-noise precomputes (sigma / Woodbury Cholesky / noise gram)
+    — rides ``dyn`` as DYNAMIC arguments of the trace, the same
+    ``fn(values, data)`` contract every other step program honors.
+    The trace bakes in only structure, so (a) the shared-jit key needs
+    no content fingerprint (two same-shaped grids over different data
+    share one executable) and (b) XLA's constant folder never sees the
+    (n_toa, n_basis) dataset it used to chew through on every grid
+    compile (the BENCH_r04/r05 stall).
+
+    scan: the fixed-count GN iteration style
+    (:func:`pint_tpu.compile_cache.iterate_fixed` — resolved by the
+    CALLER at build time and folded into the jit key)."""
 
     base_values = {k: jnp.float64(v) for k, v in prepared.model.values.items()}
     correlated = prepared.model.has_correlated_errors
@@ -98,89 +115,112 @@ def _make_fit_one(prepared, resids, grid_params, fit_params, n_steps):
             gram_const = noise_gram_precompute(sigma_const, U_const,
                                                phi_const)
 
-    def values_of(fit_vec, grid_vec):
-        values = dict(base_values)
-        for i, name in enumerate(grid_params):
-            values[name] = grid_vec[i]
-        for i, name in enumerate(fit_params):
-            values[name] = fit_vec[i]
-        return values
-
-    def rj_of(fit_vec, grid_vec):
-        """(r, J) over fit_params at one grid point — the hybrid
-        analytic/AD build (fitter.resid_and_design)."""
-        from pint_tpu.fitter import resid_and_design
-
-        grid_sub = {name: grid_vec[i]
-                    for i, name in enumerate(grid_params)}
-
-        def resid_of(sub):
-            values = dict(base_values)
-            values.update(grid_sub)
-            values.update(sub)
-            return resids.time_resids_at(values, data)
-
-        def linear_of(sub):
-            values = dict(base_values)
-            values.update(grid_sub)
-            values.update(sub)
-            return resids.linear_design_at(values, data, partition[0])
-
-        return resid_and_design(fit_params, fit_vec, partition,
-                                resid_of, linear_of)
-
-    def gn_step(fit_vec, grid_vec):
-        values = values_of(fit_vec, grid_vec)
-        sigma = (sigma_const if sigma_const is not None
-                 else resids.sigma_at(values, data))
-        rj = rj_of(fit_vec, grid_vec)
-        if correlated:
-            from pint_tpu.linalg import gls_normal_solve
-
-            if pre is not None:
-                U, phi = U_const, phi_const
-            else:
-                U, phi = resids._noise_basis_phi_at(values, data)
-            dpar, *_ = gls_normal_solve(rj[0], rj[1], sigma, U, phi,
-                                        pre=pre, gram=gram_const)
-            return fit_vec + dpar
-        from pint_tpu.fitter import wls_gn_solve
-
-        new_vec, _, _, _ = wls_gn_solve(None, fit_vec, sigma, rj=rj)
-        return new_vec
-
+    # which optional leaves dyn carries is a function of STRUCTURE
+    # (sigma_frozen/correlated above), never of values — so the traced
+    # program's shape is covered by the structural key
     fit0 = jnp.array(
         [prepared.model.values[k] for k in fit_params], dtype=jnp.float64
     )
+    dyn = {"data": data, "base_values": base_values, "fit0": fit0}
+    if sigma_const is not None:
+        dyn["sigma_const"] = sigma_const
+    if pre is not None:
+        # U_const is data["U_ext"] by construction (the eager extended
+        # basis) — the trace reads it from the data pytree; only the
+        # precomputed Cholesky/phi/gram need their own leaves
+        dyn["pre"] = pre
+        dyn["phi_const"] = phi_const
+        dyn["gram_const"] = gram_const
+    has_sigma = sigma_const is not None
+    has_pre = pre is not None
 
-    def fit_one(grid_vec):
-        vec = fit0
+    def fit_one(grid_vec, d):
+        base = d["base_values"]
+        data = d["data"]
+
+        def values_of(fit_vec):
+            values = dict(base)
+            for i, name in enumerate(grid_params):
+                values[name] = grid_vec[i]
+            for i, name in enumerate(fit_params):
+                values[name] = fit_vec[i]
+            return values
+
+        def rj_of(fit_vec):
+            """(r, J) over fit_params at one grid point — the hybrid
+            analytic/AD build (fitter.resid_and_design)."""
+            from pint_tpu.fitter import resid_and_design
+
+            grid_sub = {name: grid_vec[i]
+                        for i, name in enumerate(grid_params)}
+
+            def resid_of(sub):
+                values = dict(base)
+                values.update(grid_sub)
+                values.update(sub)
+                return resids.time_resids_at(values, data)
+
+            def linear_of(sub):
+                values = dict(base)
+                values.update(grid_sub)
+                values.update(sub)
+                return resids.linear_design_at(values, data,
+                                               partition[0])
+
+            return resid_and_design(fit_params, fit_vec, partition,
+                                    resid_of, linear_of)
+
+        def gn_step(fit_vec):
+            values = values_of(fit_vec)
+            sigma = (d["sigma_const"] if has_sigma
+                     else resids.sigma_at(values, data))
+            rj = rj_of(fit_vec)
+            if correlated:
+                from pint_tpu.linalg import gls_normal_solve
+
+                if has_pre:
+                    U, phi = data["U_ext"], d["phi_const"]
+                    dpar, *_ = gls_normal_solve(
+                        rj[0], rj[1], sigma, U, phi, pre=d["pre"],
+                        gram=d["gram_const"])
+                else:
+                    U, phi = resids._noise_basis_phi_at(values, data)
+                    dpar, *_ = gls_normal_solve(rj[0], rj[1], sigma,
+                                                U, phi)
+                return fit_vec + dpar
+            from pint_tpu.fitter import wls_gn_solve
+
+            new_vec, _, _, _ = wls_gn_solve(None, fit_vec, sigma,
+                                            rj=rj)
+            return new_vec
+
+        vec = d["fit0"]
         if fit_params:  # all-params-gridded case: plain chi2 evaluation
-            for _ in range(n_steps):  # unrolled: small fixed count
-                vec = gn_step(vec, grid_vec)
-        values = values_of(vec, grid_vec)
-        if pre is not None:
+            vec = _cc.iterate_fixed(gn_step, vec, n_steps, scan=scan)
+        values = values_of(vec)
+        if has_pre:
             from pint_tpu.linalg import woodbury_chi2_logdet_pre
 
             r = resids.time_resids_at(values, data)
-            chi2, _ = woodbury_chi2_logdet_pre(r, pre)
-        elif sigma_const is not None and not correlated:
+            chi2, _ = woodbury_chi2_logdet_pre(r, d["pre"])
+        elif has_sigma and not correlated:
             r = resids.time_resids_at(values, data)
-            chi2 = jnp.sum((r / sigma_const) ** 2)
+            chi2 = jnp.sum((r / d["sigma_const"]) ** 2)
         else:
             chi2 = resids.chi2_at(values, data)
         return chi2, vec
 
-    return fit_one, partition_record
+    return fit_one, dyn, partition_record
 
 
 def _grid_rules():
-    """The grid-axis partition-rule table: the one data leaf crossing
-    the jit boundary is the (npoints, k) grid-value array, sharded on
-    its point axis (everything else is baked into the grid trace)."""
+    """The grid-axis partition-rule table: the (npoints, k) grid-value
+    array is sharded on its point axis; the dataset pytree (``dyn`` —
+    batch, ctx, noise precomputes) is replicated onto every device."""
     from jax.sharding import PartitionSpec as P
 
-    return ((r"^grid_values$", P("grid")),)
+    return ((r"^grid_values$", P("grid")),
+            (r"^dyn(/|$)", None))
 
 
 def make_grid_fn(toas, model, grid_params, n_steps=3, mesh=None):
@@ -191,17 +231,21 @@ def make_grid_fn(toas, model, grid_params, n_steps=3, mesh=None):
     and datacheck introspection).  Lets callers (bench, repeated
     scans) reuse the jitted program.
 
-    The jitted grid is registry-shared (compile_cache.shared_jit): the
-    grid program bakes its dataset in as constants, so the key carries
-    a CONTENT fingerprint — a rebuilt grid over the same data, params
-    and step count reuses the previous trace and executable.
+    The jitted grid is registry-shared (compile_cache.shared_jit) on a
+    STRUCTURE-ONLY key: the dataset (and every host-side precompute
+    derived from it) rides the trace as dynamic leaves, so two
+    same-shaped grids over DIFFERENT data — or over different base
+    values — share one trace and one executable, and a rebuild over
+    new data never recompiles.  (The content-fingerprint key the
+    baked-constant design needed is retired with it.)
 
     mesh: a device mesh (:func:`pint_tpu.parallel.mesh.make_mesh`,
     axis ``grid``) — grid points are padded to a device multiple
     (edge-repeated; outputs sliced back to the requested count) and
-    sharded over the mesh.  The mesh participates in the jit key, so a
-    second same-shaped sharded call compiles nothing; ``mesh=None``
-    keys and behaves exactly as before."""
+    sharded over the mesh, the dataset replicated.  The mesh
+    participates in the jit key, so a second same-shaped sharded call
+    compiles nothing; ``mesh=None`` keys and behaves exactly as
+    before."""
     from pint_tpu.parallel import mesh as _mesh
 
     resids = Residuals(toas, model)
@@ -216,25 +260,33 @@ def make_grid_fn(toas, model, grid_params, n_steps=3, mesh=None):
         # class post-fit; a vmapped grid point cannot).
         resids.ensure_kepler_depth(float("nan"))
     fit_params = [p for p in model.free_timing_params if p not in grid_params]
-    fit_one, partition = _make_fit_one(prepared, resids, grid_params,
-                                       fit_params, n_steps)
+    scan = _cc.scan_iters_default()
+    fit_one, dyn, partition = _make_fit_one(
+        prepared, resids, grid_params, fit_params, n_steps, scan=scan)
     key = ("grid.fit_one", resids._structure_key(),
            tuple(grid_params), tuple(fit_params), int(n_steps),
            # the gates change the traced program (partition + frozen
-           # leaves derive deterministically from them + the free set)
-           hybrid_design_default(), frozen_delay_default(),
-           _cc.fingerprint((resids._data(), prepared.model.values))) \
+           # leaves derive deterministically from them + the free set;
+           # scan-vs-unroll is a different iteration body)
+           hybrid_design_default(), frozen_delay_default(), scan) \
         + _mesh.mesh_jit_key(mesh)
     jitted = _cc.shared_jit(
-        jax.vmap(fit_one), key=key, fn_token="grid.make_grid_fn",
+        jax.vmap(fit_one, in_axes=(0, None)), key=key,
+        fn_token="grid.make_grid_fn",
         label=f"grid.fit_one:{'+'.join(grid_params)}"
               + (":sharded" if mesh is not None else ""))
     jitted.set_mesh(_mesh.mesh_desc(mesh))
     if mesh is None:
-        return jitted, fit_params, partition
+        def fn(grid_values):
+            return jitted(grid_values, dyn)
+
+        return fn, fit_params, partition
 
     ndev = _mesh.axis_size(mesh, "grid")
     rules = _grid_rules()
+    # the dataset is call-invariant: replicate it onto the mesh ONCE
+    # at build time, not per call (only the grid values vary)
+    dyn_sharded = _mesh.shard_args(mesh, rules, {"dyn": dyn})["dyn"]
 
     def sharded_fn(grid_values):
         n = int(np.shape(grid_values)[0])
@@ -243,7 +295,7 @@ def make_grid_fn(toas, model, grid_params, n_steps=3, mesh=None):
         gv = _mesh.pad_leading(grid_values, n_pad, mode="edge")
         gv = _mesh.shard_args(mesh, rules, {"grid_values": gv})[
             "grid_values"]
-        chi2, fitted = jitted(gv)
+        chi2, fitted = jitted(gv, dyn_sharded)
         return chi2[:n], fitted[:n]
 
     return sharded_fn, fit_params, partition

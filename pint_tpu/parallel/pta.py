@@ -550,7 +550,7 @@ class PTABatch:
 
     def _fit_one(self, vec0, base_values, batch, ctx, tzr_batch,
                  tzr_ctx, valid, free_mask, guard_eps, maxiter,
-                 with_health):
+                 with_health, scan=True):
         merged = _merge_ctx(ctx, self.static_ctx)
         values0 = dict(base_values)
         for i, name in enumerate(self.free_names):
@@ -568,15 +568,14 @@ class PTABatch:
             return self._rj_one(v, base_values, batch, ctx, tzr_batch,
                                 tzr_ctx, valid, free_mask)
 
-        def body(carry, _):
+        def body(carry):
             vec, _ = carry
             new_vec, chi2, dpar, cov = wls_gn_solve(
                 None, vec, err, rcond=guard_eps, rj=rj(vec))
-            return (new_vec, chi2), None
+            return (new_vec, chi2)
 
-        (vec, _), _ = jax.lax.scan(
-            body, (vec0, jnp.float64(0.0)), None, length=maxiter
-        )
+        vec, _ = _cc.iterate_fixed(
+            body, (vec0, jnp.float64(0.0)), maxiter, scan=scan)
         if not with_health:
             _, chi2, _, cov = wls_gn_solve(None, vec, err,
                                            rcond=guard_eps, rj=rj(vec))
@@ -617,7 +616,7 @@ class PTABatch:
 
     def _fit_one_gls(self, vec0, base_values, batch, ctx, tzr_batch,
                      tzr_ctx, valid, free_mask, U, phi, guard_eps,
-                     maxiter, with_health):
+                     maxiter, with_health, scan=True):
         from pint_tpu.linalg import gls_normal_solve
 
         merged = _merge_ctx(ctx, self.static_ctx)
@@ -637,16 +636,15 @@ class PTABatch:
             return self._rj_one(v, base_values, batch, ctx, tzr_batch,
                                 tzr_ctx, valid, free_mask)
 
-        def body(carry, _):
+        def body(carry):
             vec, _ = carry
             r, J = rj(vec)
             dpar, cov, _, chi2 = gls_normal_solve(
                 r, J, err, U, phi, guard_eps=guard_eps)
-            return (vec + dpar, chi2), None
+            return (vec + dpar, chi2)
 
-        (vec, _), _ = jax.lax.scan(
-            body, (vec0, jnp.float64(0.0)), None, length=maxiter
-        )
+        vec, _ = _cc.iterate_fixed(
+            body, (vec0, jnp.float64(0.0)), maxiter, scan=scan)
         r, J = rj(vec)
         if not with_health:
             _, cov, ncoef, chi2 = gls_normal_solve(
@@ -700,7 +698,7 @@ class PTABatch:
     def _fit_one_wb(self, vec0, base_values, batch, ctx, tzr_batch,
                     tzr_ctx, valid, free_mask, U, phi, dm_data,
                     dm_error, dm_valid, guard_eps, maxiter,
-                    with_health):
+                    with_health, scan=True):
         """One pulsar's wideband GLS fit: stacked [time; DM] residual
         with the correlated-noise basis acting on the time block only
         (zero rows under the DM block), same normal equations as
@@ -724,16 +722,15 @@ class PTABatch:
                                 tzr_ctx, valid, free_mask,
                                 dm_extra=(dm_data, dm_error, dm_valid))
 
-        def body(carry, _):
+        def body(carry):
             vec, _ = carry
             r, J = rj(vec)
             dpar, cov, _, chi2 = gls_normal_solve(
                 r, J, err, U_wb, phi, guard_eps=guard_eps)
-            return (vec + dpar, chi2), None
+            return (vec + dpar, chi2)
 
-        (vec, _), _ = jax.lax.scan(
-            body, (vec0, jnp.float64(0.0)), None, length=maxiter
-        )
+        vec, _ = _cc.iterate_fixed(
+            body, (vec0, jnp.float64(0.0)), maxiter, scan=scan)
         r, J = rj(vec)
         if not with_health:
             _, cov, _, chi2 = gls_normal_solve(
@@ -767,7 +764,7 @@ class PTABatch:
             ))
         return got
 
-    def _build_fit(self, kind, maxiter, with_health):
+    def _build_fit(self, kind, maxiter, with_health, scan=True):
         tzr_ax = 0 if self.tzr_batch is not None else None
         tcx_ax = 0 if self.tzr_ctx is not None else None
         # guard_eps is the LAST argument, broadcast over pulsars
@@ -777,7 +774,7 @@ class PTABatch:
             return jax.vmap(
                 lambda v, b, bt, c, tb, tc, m, fm, ge: self._fit_one(
                     v, b, bt, c, tb, tc, m, fm, ge, maxiter,
-                    with_health
+                    with_health, scan=scan
                 ),
                 in_axes=(0, 0, 0, 0, tzr_ax, tcx_ax, 0, 0, None),
             )
@@ -785,40 +782,46 @@ class PTABatch:
             return jax.vmap(
                 lambda v, b, bt, c, tb, tc, m, fm, uu, ph, ge:
                 self._fit_one_gls(v, b, bt, c, tb, tc, m, fm, uu, ph,
-                                  ge, maxiter, with_health),
+                                  ge, maxiter, with_health, scan=scan),
                 in_axes=(0, 0, 0, 0, tzr_ax, tcx_ax, 0, 0, 0, 0, None),
             )
         return jax.vmap(
             lambda v, b, bt, c, tb, tc, m, fm, uu, ph, dd, de, dv, ge:
             self._fit_one_wb(v, b, bt, c, tb, tc, m, fm, uu, ph,
-                             dd, de, dv, ge, maxiter, with_health),
+                             dd, de, dv, ge, maxiter, with_health,
+                             scan=scan),
             in_axes=(0, 0, 0, 0, tzr_ax, tcx_ax, 0, 0, 0, 0, 0, 0, 0,
                      None),
         )
 
     def _batched_fit_jit(self, kind, maxiter, mesh=None):
-        """ONE jitted batched fit per (kind, maxiter, mesh), memoized
-        on the instance and shared across same-structure batches
-        through the process registry.  This replaces the old per-call
-        ``jax.jit(lambda *a: fit(*a))`` — a fresh jitted callable (and
-        a full retrace + XLA compile of the entire PTA program) on
-        EVERY fit invocation.  The mesh participates in the key
+        """ONE jitted batched fit per (kind, maxiter, mesh, iteration
+        style), memoized on the instance and shared across
+        same-structure batches through the process registry.  This
+        replaces the old per-call ``jax.jit(lambda *a: fit(*a))`` — a
+        fresh jitted callable (and a full retrace + XLA compile of the
+        entire PTA program) on EVERY fit invocation.  The mesh
+        participates in the key
         (:func:`pint_tpu.parallel.mesh.mesh_jit_key`): one registry
         entry per mesh layout, so a second same-shaped sharded call
         compiles nothing and the profiler records sharded and
-        unsharded runs separately."""
+        unsharded runs separately.  So does the scan-vs-unroll GN
+        iteration style (``$PINT_TPU_SCAN_ITERS``,
+        :func:`pint_tpu.compile_cache.iterate_fixed`): the two are
+        different traced programs."""
         with_health = _guard.enabled()
+        scan = _cc.scan_iters_default()
         mesh_key = _mesh.mesh_jit_key(mesh)
         cache = getattr(self, "_fit_jit_cache", None)
         if cache is None:
             cache = self._fit_jit_cache = {}
-        got = cache.get((kind, maxiter, with_health, mesh_key))
+        got = cache.get((kind, maxiter, with_health, scan, mesh_key))
         if got is None:
-            got = cache[(kind, maxiter, with_health, mesh_key)] = \
+            got = cache[(kind, maxiter, with_health, scan, mesh_key)] = \
                 _cc.shared_jit(
-                self._build_fit(kind, maxiter, with_health),
+                self._build_fit(kind, maxiter, with_health, scan=scan),
                 key=("pta.batched", kind, int(maxiter), with_health,
-                     self._structure_key()) + mesh_key,
+                     scan, self._structure_key()) + mesh_key,
                 fn_token="pta.batched_fit",
                 label=f"pta.batched_fit:{kind}"
                       + (":sharded" if mesh is not None else ""))

@@ -153,11 +153,11 @@ class ProgramStats:
         }
 
 
-#: program id -> ProgramStats, LRU order.  Bounded: registry keys can
-#: embed dataset fingerprints (the grid path), so a warm service
-#: cycling datasets would otherwise grow this forever — the same
-#: reasoning behind compile_cache's registry cap, sized above it so
-#: stats outlive the jit entries they describe.
+#: program id -> ProgramStats, LRU order.  Bounded by the same
+#: reasoning as compile_cache's registry cap (keys are structural now
+#: — the grid's dataset fingerprint is retired — but a long-lived
+#: service still cycles structures), sized above it so stats outlive
+#: the jit entries they describe.
 _programs: "OrderedDict[str, ProgramStats]" = OrderedDict()
 
 _PROGRAMS_CAP = 512
@@ -276,23 +276,93 @@ def _profiled_call(jitted, st, args, kwargs):
     return out
 
 
+def _arg_spec(args):
+    """The abstract argument spec of one call: array leaves become
+    ``jax.ShapeDtypeStruct`` (keeping a NamedSharding when the caller
+    committed one — sharded programs must re-lower against the same
+    layout); non-array leaves (python scalars, None holes) pass
+    through verbatim so weak-typed avals survive.  This is what AOT
+    export re-lowers each program from
+    (:func:`pint_tpu.compile_cache.export_executables`)."""
+    import jax
+
+    def to_spec(x):
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            sharding = getattr(x, "sharding", None)
+            if not isinstance(sharding, jax.sharding.NamedSharding):
+                sharding = None
+            try:
+                return jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                            sharding=sharding)
+            except Exception:
+                return jax.ShapeDtypeStruct(x.shape, x.dtype)
+        return x
+
+    return jax.tree.map(to_spec, args)
+
+
 class _ProfiledProgram:
     """Callable proxy around a registry jit entry.  Gate off: one
     branch, then the raw call (no sync — async dispatch preserved).
     Gate on: phase-split timing at the device boundary.  Every other
     attribute (``lower`` for AOT warmup, etc.) forwards to the
-    underlying jitted callable."""
+    underlying jitted callable.
 
-    __slots__ = ("_jitted", "_stats")
+    The proxy also records abstract argument specs — the shapes AOT
+    export re-lowers this program from.  One registry entry serves
+    MULTIPLE shapes (keys are structure-only; jax's aval cache
+    specializes underneath), so the spec record is a list: the hot
+    ``__call__`` path captures only the first call's spec (one slot
+    load + None check steady-state), while the cold ``lower()`` path
+    (AOT warmup sweeps every warmed shape through it) appends each
+    distinct spec it sees."""
+
+    __slots__ = ("_jitted", "_stats", "_aot_specs")
+
+    #: distinct shapes exportable per program — a warm sweep is a
+    #: handful; anything bigger means a caller forgot to bucket
+    _AOT_SPEC_CAP = 8
 
     def __init__(self, jitted, stats):
         object.__setattr__(self, "_jitted", jitted)
         object.__setattr__(self, "_stats", stats)
+        object.__setattr__(self, "_aot_specs", None)
+
+    def _record_spec(self, args):
+        try:
+            spec = _arg_spec(args)
+        except Exception:
+            object.__setattr__(self, "_aot_specs", [])  # don't retry
+            return
+        specs = object.__getattribute__(self, "_aot_specs")
+        if specs is None:
+            specs = []
+            object.__setattr__(self, "_aot_specs", specs)
+        if len(specs) < self._AOT_SPEC_CAP and \
+                all(repr(spec) != repr(s) for s in specs):
+            specs.append(spec)
 
     def __call__(self, *args, **kwargs):
+        if self._aot_specs is None and not kwargs:
+            self._record_spec(args)
         if not enabled():
             return self._jitted(*args, **kwargs)
         return _profiled_call(self._jitted, self._stats, args, kwargs)
+
+    def lower(self, *args, **kwargs):
+        """Forward to the jit's ``lower``, recording the spec — AOT
+        warmup (`warm_compile`) lowers without ever calling, and a
+        multi-shape warm sweep must leave every shape exportable."""
+        if not kwargs:
+            self._record_spec(args)
+        return self._jitted.lower(*args, **kwargs)
+
+    @property
+    def aot_specs(self):
+        """The recorded argument specs (list), or None when the
+        program was never called/lowered (or capture failed)."""
+        specs = object.__getattribute__(self, "_aot_specs")
+        return specs if specs else None
 
     def __getattr__(self, name):
         return getattr(object.__getattribute__(self, "_jitted"), name)

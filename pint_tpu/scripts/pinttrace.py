@@ -198,13 +198,21 @@ def programs_table(records):
 
 #: metrics where a SMALLER value is better (everything else in the
 #: suite is a rate)
-_LOWER_IS_BETTER = {"guard_overhead", "profile_overhead"}
+_LOWER_IS_BETTER = {"guard_overhead", "profile_overhead",
+                    "cold_start_s"}
 
 #: absolute slack (same units as the metric — percentage points for
-#: the overhead metrics) under the lower-is-better comparison: a
-#: multiplicative tolerance is meaningless around a near-zero or
-#: negative best (overhead jitters about 0 on a quiet host)
+#: the overhead metrics, seconds for cold_start_s) under the
+#: lower-is-better comparison: a multiplicative tolerance is
+#: meaningless around a near-zero or negative best (overhead jitters
+#: about 0 on a quiet host)
 _LOWER_ABS_SLACK = 2.0
+
+#: absolute slack [s] for the per-metric compile_s.cold series: cold
+#: compile on a loaded host jitters by a second or two; a regression
+#: alarm should mean "the trace got structurally bigger", not "the
+#: host was busy"
+_COMPILE_ABS_SLACK = 2.0
 
 
 def _parse_round(path):
@@ -267,8 +275,18 @@ def check_regression(paths, tolerance=0.5, streak=2):
     were fallback-served or produced nothing flags FALLBACK-STREAK
     (the r03-r05 hung-tunnel pathology: the chip was lost and nobody
     alarmed).  A metric that ever produced a real value but is absent
-    from the latest round flags MISSING.  Returns ``(lines, rc)``
-    with rc nonzero iff anything was flagged."""
+    from the latest round flags MISSING.
+
+    Each metric's ``compile_s.cold`` field is additionally tracked as
+    a first-class LOWER-is-better series (``<metric>:compile_s.cold``,
+    absolute slack like the overhead metrics) — a compile-time
+    regression alarms exactly like a throughput one, because compile
+    time is what gates reclaiming the chip (ROADMAP item 5).  The
+    compile series never MISSING-flags (not every metric records a
+    compile, and fallback rounds compile for a different backend).
+
+    Returns ``(lines, rc)`` with rc nonzero iff anything was
+    flagged."""
     rounds = []   # (label, round_no, metrics)
     for i, path in enumerate(paths):
         try:
@@ -368,6 +386,44 @@ def check_regression(paths, tolerance=0.5, streak=2):
     if not best:
         lines.append("NOTE no non-fallback metric values anywhere in "
                      "the trajectory")
+
+    # compile-time trajectory: compile_s.cold per metric, lower is
+    # better.  Only non-fallback records enter the series (a CPU
+    # fallback compiles a different backend's program), and a metric
+    # whose latest round carries no cold number is skipped, never
+    # MISSING-flagged.
+    cbest: dict = {}    # metric -> (cold_s, round_no)
+    clatest: dict = {}  # metric -> (cold_s, round_no, in_last_round)
+    last_rno = rounds[-1][1]
+    for _, rno, metrics in rounds:
+        for rec in metrics:
+            name = rec.get("metric")
+            cs = rec.get("compile_s")
+            cold = cs.get("cold") if isinstance(cs, dict) else None
+            if name is None or cold is None or _is_fallback(rec):
+                continue
+            clatest[name] = (cold, rno, rno == last_rno)
+            cur = cbest.get(name)
+            if cur is None or cold < cur[0]:
+                cbest[name] = (cold, rno)
+    for name in sorted(cbest):
+        best_cold, best_rno = cbest[name]
+        cold, rno, in_last = clatest[name]
+        series = f"{name}:compile_s.cold"
+        if not in_last:
+            continue
+        floor = best_cold + max(abs(best_cold) * tolerance,
+                                _COMPILE_ABS_SLACK)
+        if cold > floor:
+            flagged = True
+            lines.append(
+                f"REGRESSION {series}: latest {cold:g}s (r{rno:02d}) "
+                f"vs best {best_cold:g}s (r{best_rno:02d}), slack "
+                f"{floor - best_cold:g}s")
+        else:
+            lines.append(
+                f"OK {series}: latest {cold:g}s (r{rno:02d}), best "
+                f"{best_cold:g}s (r{best_rno:02d})")
     return lines, 1 if flagged else 0
 
 

@@ -1,4 +1,5 @@
-"""pintwarm: AOT-warm the persistent XLA compilation cache.
+"""pintwarm: AOT-warm the persistent XLA compilation cache and
+export/import serialized executables.
 
 ``pintwarm`` ``lower().compile()``s the standard fit shapes (or a real
 dataset's shapes via ``--par/--tim``) into the on-disk compilation
@@ -9,12 +10,22 @@ compile-amortization story; the online half is the in-process shared
 jit registry plus TOA-count bucketing (``--no-bucket`` to warm exact
 sizes instead of bucketed ones).
 
+``--export DIR`` additionally serializes the warmed executables
+themselves (``compile_cache.export_executables`` — manifest + pickled
+PJRT payloads), and ``--import DIR`` pre-loads them in a fresh process
+and then runs REAL verification fits over the requested shapes,
+reporting the AOT hit and uncached-backend-compile counters — the
+zero-retrace cold-start path (docs/compile_cache.md, "AOT executable
+serialization").
+
 Examples::
 
     pintwarm                           # standard WLS+GLS shapes
     pintwarm --toas 500,1000,5000 --kinds gls,downhill_gls
     pintwarm --par J0613.par --tim J0613.tim
     PINT_TPU_CACHE_DIR=/fast/cache pintwarm
+    pintwarm --export /fast/aot       # warm + serialize executables
+    pintwarm --import /fast/aot       # cold replica: deserialize + verify
 """
 
 from __future__ import annotations
@@ -56,10 +67,23 @@ def main(argv=None):
                         "(requires --tim)")
     p.add_argument("--tim", default=None,
                    help="tim file for --par")
+    p.add_argument("--export", dest="export_dir", metavar="DIR",
+                   default=None,
+                   help="after warmup, serialize the compiled "
+                        "executables to DIR (manifest + payloads) for "
+                        "a fresh process to --import")
+    p.add_argument("--import", dest="import_dir", metavar="DIR",
+                   default=None,
+                   help="pre-load serialized executables from DIR, "
+                        "then run real verification fits over the "
+                        "requested shapes and report the AOT/compile "
+                        "counters (instead of compiling)")
     args = p.parse_args(argv)
 
     if (args.par is None) != (args.tim is None):
         p.error("--par and --tim must be given together")
+    if args.export_dir and args.import_dir:
+        p.error("--export and --import are mutually exclusive")
 
     from pint_tpu import compile_cache
 
@@ -89,15 +113,91 @@ def main(argv=None):
         print("note: warming BUCKETED shapes — they serve fits made "
               "with bucket=True or PINT_TPU_BUCKET_TOAS=1",
               file=sys.stderr)
-    records = compile_cache.warmup(
-        toa_counts=counts, kinds=kinds, bucket=bucket,
-        progress=print, pairs=pairs)
+
+    if args.import_dir:
+        return _import_and_verify(args.import_dir, kinds, counts,
+                                  bucket, pairs)
+
+    # build each (kind, model, toas) job ONCE: warmup and the export
+    # path's dress-rehearsal fits share the same datasets
+    jobs = _jobs(kinds, counts, pairs)
+    records = compile_cache.warmup(jobs=jobs, bucket=bucket,
+                                   progress=print)
 
     total = sum(r["compile_s"] for r in records)
     print(f"warmed {len(records)} shape(s) in {total:.1f}s of compile")
     if cache:
         print(f"persistent cache: {compile_cache.cache_entries()} "
               "entries after warmup")
+    if args.export_dir:
+        # dress-rehearsal fits: warmup only lower().compile()s, so the
+        # tiny execute-time eager kernels (output conversions etc.)
+        # never hit the persistent cache — one real fit per shape
+        # leaves the cold replica genuinely zero-uncached-compile
+        for kind, model, toas in jobs:
+            _make_fitter(kind, model, toas, bucket).fit_toas(maxiter=2)
+        out = compile_cache.export_executables(args.export_dir,
+                                               progress=print)
+        print(f"exported {len(out['exported'])} executable(s) to "
+              f"{args.export_dir} "
+              f"({len(out['skipped'])} skipped)")
+        for label, why in out["skipped"]:
+            print(f"  skipped {label}: {why}", file=sys.stderr)
+    return 0
+
+
+def _jobs(kinds, counts, pairs):
+    """The (kind, model, toas) triples a warm/verify pass covers."""
+    from pint_tpu.compile_cache import _warm_pairs
+
+    out = []
+    for kind in kinds:
+        if pairs is not None:
+            out.extend((kind, m, t) for m, t in pairs)
+        else:
+            for n in counts:
+                model, toas = _warm_pairs(n, kind)
+                out.append((kind, model, toas))
+    return out
+
+
+def _make_fitter(kind, model, toas, bucket):
+    from pint_tpu import compile_cache
+
+    if bucket:
+        toas = compile_cache.pad_toas(toas)
+    return compile_cache.fitter_class(kind)(toas, model)
+
+
+def _import_and_verify(import_dir, kinds, counts, bucket, pairs):
+    """The ``--import`` path: deserialize the AOT manifest, then run a
+    real fit per requested shape (warmup's lower().compile() would
+    bypass the imported executables — only __call__ dispatch serves
+    them) and report the served/compile counters.  Exit 0 even when
+    entries were rejected: graceful per-entry fallback to retrace is
+    the contract, and the printed counters say what happened."""
+    import time as _time
+
+    from pint_tpu import compile_cache, telemetry
+
+    telemetry.compile_stats()  # listener before anything compiles
+    got = compile_cache.import_executables(import_dir, progress=print)
+    print(f"imported {got['loaded']} executable(s) from "
+          f"{import_dir} ({len(got['rejected'])} rejected)")
+    for label, why in got["rejected"]:
+        print(f"  rejected {label}: {why}", file=sys.stderr)
+
+    for kind, model, toas in _jobs(kinds, counts, pairs):
+        f = _make_fitter(kind, model, toas, bucket)
+        t0 = _time.perf_counter()
+        f.fit_toas(maxiter=2)
+        print(f"verified {kind} n_toas={len(f.toas)}: first fit "
+              f"{_time.perf_counter() - t0:.2f}s")
+    cs = telemetry.compile_stats()
+    print(f"aot: {cs['aot_hits']} hit(s), {cs['aot_misses']} miss(es),"
+          f" {cs['aot_rejects']} reject(s); backend compiles "
+          f"{cs['backend_events']} ({cs['uncached_backend_events']} "
+          f"uncached, {cs['cache_hits']} disk-cache hit(s))")
     return 0
 
 

@@ -1,0 +1,569 @@
+"""Compile-time attack tests (ISSUE 9): scanned GN iterations,
+data-dynamic grid traces, and AOT-serialized executables.
+
+Covers the three fronts plus their satellites: scan == unroll
+equivalence over the WLS/GLS/wideband/PTA-batch zoo (including the
+Kepler depth-guard re-key path), grid executable sharing across
+datasets on the structure-only key, the AOT export -> import round
+trip (in-process, fresh-process with the zero-uncached-compile
+contract, mesh-in-the-key, and the graceful version-skew reject), and
+the pinttrace compile-time regression series.  All CPU (the conftest
+forces 8 host devices), tier-1-fast shapes.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from pint_tpu import compile_cache, telemetry
+from pint_tpu.grid import grid_chisq_vectorized, make_grid_fn
+from pint_tpu.models.builder import get_model
+from pint_tpu.simulation import make_fake_toas_uniform
+
+WLS_PAR = """PSR TSTAOT
+RAJ 18:57:36.39
+DECJ 09:43:17.2
+F0 186.494 1
+F1 -6.2e-16 1
+PEPOCH 54000
+DM 13.3 1
+TZRMJD 54000
+TZRSITE @
+TZRFRQ 1400
+UNITS TDB
+EPHEM builtin
+"""
+
+#: correlated-noise variant: the grid's frozen-noise Woodbury/gram
+#: precompute path (narrow Fourier basis keeps the trace small)
+GLS_PAR = WLS_PAR.replace(
+    "UNITS TDB",
+    "EFAC -f L-wide 1.1\nTNRedAmp -13.5\nTNRedGam 3.3\nTNRedC 5\n"
+    "UNITS TDB")
+
+
+def _mk(par, n, seed):
+    model = get_model(par)
+    # two receivers so the DM column stays well-conditioned (a
+    # single-frequency DM column is degenerate with the phase offset
+    # and amplifies codegen-order roundoff through the SVD)
+    freqs = np.where(np.arange(n) % 2 == 0, 1400.0, 800.0)
+    toas = make_fake_toas_uniform(
+        53000.0, 56500.0, n, model, freq_mhz=freqs, obs="gbt",
+        error_us=1.0, add_noise=True, rng=np.random.default_rng(seed),
+        flags={"f": "L-wide"})
+    return model, toas
+
+
+def _monitoring_live():
+    return telemetry.compile_stats()["source"] == "jax.monitoring"
+
+
+def _backend_compiles():
+    telemetry.compile_stats()
+    return telemetry.counter_get("jit.backend_compile_events")
+
+
+# --------------------------------------------------------------------------
+# front 1: scan-vs-unroll GN iterations
+# --------------------------------------------------------------------------
+
+class TestIterateFixed:
+    def test_modes_agree_trivial(self):
+        body = lambda c: c * 2.0 + 1.0  # noqa: E731
+        a = compile_cache.iterate_fixed(body, jnp.float64(1.0), 4,
+                                        scan=True)
+        b = compile_cache.iterate_fixed(body, jnp.float64(1.0), 4,
+                                        scan=False)
+        assert float(a) == float(b) == 31.0
+
+    def test_zero_steps_is_identity(self):
+        x = jnp.arange(3.0)
+        assert compile_cache.iterate_fixed(lambda c: c + 1, x, 0) is x
+
+    def test_env_default(self, monkeypatch):
+        monkeypatch.delenv("PINT_TPU_SCAN_ITERS", raising=False)
+        assert compile_cache.scan_iters_default() is True
+        for tok in ("0", "off", "unroll", "no"):
+            monkeypatch.setenv("PINT_TPU_SCAN_ITERS", tok)
+            assert compile_cache.scan_iters_default() is False
+        monkeypatch.setenv("PINT_TPU_SCAN_ITERS", "1")
+        assert compile_cache.scan_iters_default() is True
+
+
+class TestScanUnrollZoo:
+    """scan == unroll over the fit zoo.  The two variants are the same
+    op sequence under different XLA codegen (scan compiles the body
+    once; the unroll lets XLA fuse across iterations), so fitted
+    parameter vectors agree to ~1e-12 relative and chi^2 — which sits
+    a gradient away from the fitted point — to ~1e-8."""
+
+    def _grid_both(self, par, n, seed, monkeypatch):
+        model, toas = _mk(par, n, seed)
+        pts = np.array([[model.values["F0"] + k * 1e-13,
+                         model.values["F1"]] for k in range(3)])
+        monkeypatch.delenv("PINT_TPU_SCAN_ITERS", raising=False)
+        c_scan, v_scan = grid_chisq_vectorized(
+            toas, model, ["F0", "F1"], pts, n_steps=3)
+        monkeypatch.setenv("PINT_TPU_SCAN_ITERS", "0")
+        c_unroll, v_unroll = grid_chisq_vectorized(
+            toas, model, ["F0", "F1"], pts, n_steps=3)
+        return c_scan, v_scan, c_unroll, v_unroll
+
+    def test_grid_wls(self, monkeypatch):
+        cs, vs, cu, vu = self._grid_both(WLS_PAR, 80, 0, monkeypatch)
+        np.testing.assert_allclose(vs, vu, rtol=1e-12, atol=1e-300)
+        np.testing.assert_allclose(cs, cu, rtol=1e-8)
+
+    def test_grid_gls(self, monkeypatch):
+        cs, vs, cu, vu = self._grid_both(GLS_PAR, 64, 1, monkeypatch)
+        np.testing.assert_allclose(vs, vu, rtol=1e-12, atol=1e-300)
+        np.testing.assert_allclose(cs, cu, rtol=1e-8)
+
+    def _batch(self, wideband=False):
+        from pint_tpu.parallel.pta import PTABatch
+
+        pairs = []
+        for i in range(2):
+            binary = ("BINARY DD\nPB 8.3 1\nA1 6.1 1\nT0 54500.2 1\n"
+                      "ECC 0.17 1\nOM 110.0 1\n" if i == 0 else "")
+            par = (f"PSR ZOO{i}\nRAJ {10 + i}:10:00\nDECJ 05:00:00\n"
+                   f"F0 {150.0 + 30 * i} 1\nF1 -1e-15 1\n"
+                   f"PEPOCH 54500\nDM {10 + i} 1\nTZRMJD 54500\n"
+                   "TZRSITE @\nTZRFRQ 1400\nUNITS TDB\n"
+                   "EPHEM builtin\n") + binary \
+                + ("DMDATA 1\n" if wideband and i == 1 else "")
+            m = get_model(par)
+            t = make_fake_toas_uniform(
+                53500, 55500, 40, m, obs="gbt", error_us=1.0,
+                add_noise=True, rng=np.random.default_rng(i),
+                freq_mhz=np.where(np.arange(40) % 2 == 0, 1400.0,
+                                  800.0),
+                wideband=(wideband and i == 1), dm_error=2e-4)
+            pairs.append((m, t))
+        return PTABatch(pairs)
+
+    def test_pta_batch_wls(self, monkeypatch):
+        monkeypatch.delenv("PINT_TPU_SCAN_ITERS", raising=False)
+        b1 = self._batch()
+        v1, c1, _ = b1.fit_wls(maxiter=3)
+        monkeypatch.setenv("PINT_TPU_SCAN_ITERS", "unroll")
+        b2 = self._batch()
+        v2, c2, _ = b2.fit_wls(maxiter=3)
+        np.testing.assert_allclose(np.asarray(v1), np.asarray(v2),
+                                   rtol=1e-12, atol=1e-300)
+        np.testing.assert_allclose(np.asarray(c1), np.asarray(c2),
+                                   rtol=1e-8)
+
+    def test_pta_batch_wideband(self, monkeypatch):
+        monkeypatch.delenv("PINT_TPU_SCAN_ITERS", raising=False)
+        b1 = self._batch(wideband=True)
+        v1, c1, _ = b1.fit_wideband(maxiter=2)
+        monkeypatch.setenv("PINT_TPU_SCAN_ITERS", "unroll")
+        b2 = self._batch(wideband=True)
+        v2, c2, _ = b2.fit_wideband(maxiter=2)
+        np.testing.assert_allclose(np.asarray(v1), np.asarray(v2),
+                                   rtol=1e-12, atol=1e-300)
+        np.testing.assert_allclose(np.asarray(c1), np.asarray(c2),
+                                   rtol=1e-8)
+
+    def test_pta_kepler_depth_rekey(self, monkeypatch):
+        """The depth-guard re-key path: forcing a deeper Kepler unroll
+        restacks the ctx and re-keys the batched traces — scan and
+        unroll must still agree through the NEW key (the flag rides
+        both generations of the trace)."""
+        monkeypatch.delenv("PINT_TPU_SCAN_ITERS", raising=False)
+        b1 = self._batch()
+        for r in b1.resids:
+            r.ensure_kepler_depth(0.9)
+        b1._restack_after_depth_change()
+        v1, c1, _ = b1.fit_wls(maxiter=2)
+        monkeypatch.setenv("PINT_TPU_SCAN_ITERS", "0")
+        b2 = self._batch()
+        for r in b2.resids:
+            r.ensure_kepler_depth(0.9)
+        b2._restack_after_depth_change()
+        v2, c2, _ = b2.fit_wls(maxiter=2)
+        np.testing.assert_allclose(np.asarray(v1), np.asarray(v2),
+                                   rtol=1e-12, atol=1e-300)
+        np.testing.assert_allclose(np.asarray(c1), np.asarray(c2),
+                                   rtol=1e-8)
+
+
+# --------------------------------------------------------------------------
+# front 2: data-dynamic grid traces (structure-only key)
+# --------------------------------------------------------------------------
+
+class TestGridDataDynamic:
+    def test_two_datasets_one_executable(self):
+        """Two same-shaped datasets share ONE grid executable (the
+        retired content-fingerprint key forced a recompile here), and
+        the shared result matches a fresh-registry computation
+        exactly."""
+        m1, t1 = _mk(WLS_PAR, 80, 10)
+        pts1 = np.array([[m1.values["F0"] + k * 1e-13,
+                          m1.values["F1"]] for k in range(3)])
+        grid_chisq_vectorized(t1, m1, ["F0", "F1"], pts1, n_steps=2)
+        before = _backend_compiles()
+        hits0 = compile_cache.registry_stats()["hits"]
+        m2, t2 = _mk(WLS_PAR, 80, 11)  # different data, same shape
+        pts2 = pts1 + 2e-13
+        c2, _ = grid_chisq_vectorized(t2, m2, ["F0", "F1"], pts2,
+                                      n_steps=2)
+        assert compile_cache.registry_stats()["hits"] > hits0
+        if _monitoring_live():
+            assert _backend_compiles() - before == 0
+        compile_cache.clear_registry()
+        c2_fresh, _ = grid_chisq_vectorized(t2, m2, ["F0", "F1"],
+                                            pts2, n_steps=2)
+        np.testing.assert_array_equal(c2, c2_fresh)
+
+    def test_edited_values_share_too(self):
+        """Editing base parameter values between builds must not
+        recompile either — values ride the dynamic leaves (under the
+        old fingerprint key they forced a rebuild-equals-recompile)."""
+        m, t = _mk(WLS_PAR, 80, 12)
+        pts = np.array([[m.values["F0"], m.values["F1"]]])
+        grid_chisq_vectorized(t, m, ["F0", "F1"], pts, n_steps=2)
+        before = _backend_compiles()
+        m.values["DM"] += 1e-4
+        c, _ = grid_chisq_vectorized(t, m, ["F0", "F1"], pts,
+                                     n_steps=2)
+        if _monitoring_live():
+            assert _backend_compiles() - before == 0
+        assert np.all(np.isfinite(c))
+
+
+# --------------------------------------------------------------------------
+# front 3: AOT executable serialization
+# --------------------------------------------------------------------------
+
+@pytest.fixture
+def clean_aot():
+    compile_cache.clear_aot_store()
+    yield
+    compile_cache.clear_aot_store()
+
+
+class TestAotRoundTrip:
+    def test_in_process_round_trip(self, tmp_path, clean_aot):
+        """export -> clear registry -> import -> rebuild: the rebuilt
+        programs serve from the store (aot hits + served calls) and
+        the fit result is identical."""
+        from pint_tpu.fitter import WLSFitter
+
+        m1, t1 = _mk(WLS_PAR, 64, 20)
+        f1 = WLSFitter(t1, m1)
+        chi2_traced = f1.fit_toas(maxiter=2)
+        out = compile_cache.export_executables(tmp_path)
+        assert len(out["exported"]) >= 1, out["skipped"]
+        assert (tmp_path / "manifest.json").exists()
+
+        compile_cache.clear_registry()
+        got = compile_cache.import_executables(tmp_path)
+        assert got["loaded"] == len(out["exported"])
+        assert not got["rejected"]
+        hits0 = compile_cache.aot_store_stats()["hits"]
+        m2, t2 = _mk(WLS_PAR, 64, 20)  # identical dataset
+        f2 = WLSFitter(t2, m2)
+        chi2_aot = f2.fit_toas(maxiter=2)
+        stats = compile_cache.aot_store_stats()
+        assert stats["hits"] > hits0
+        assert stats["served_calls"] > 0
+        assert chi2_aot == chi2_traced  # bit-identical
+
+    def test_fresh_process_zero_uncached(self, tmp_path):
+        """THE acceptance regression: a fresh process reaching its
+        first completed fit through import_executables performs ZERO
+        uncached XLA backend compiles, with the result bit-identical
+        to the traced path."""
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PINT_TPU_CACHE_DIR"] = str(tmp_path / "xla")
+
+        def child(mode):
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import json\n"
+                 "from pint_tpu.compile_cache import "
+                 "aot_cold_start_probe\n"
+                 f"print(json.dumps(aot_cold_start_probe({mode!r}, "
+                 f"{str(tmp_path)!r}, kind='wls', n_toas=64, "
+                 "maxiter=2)))"],
+                capture_output=True, text=True, env=env, timeout=300)
+            assert r.returncode == 0, r.stderr[-800:]
+            return json.loads(
+                [ln for ln in r.stdout.splitlines()
+                 if ln.startswith("{")][-1])
+
+        exp = child("export")
+        assert exp["exported"] >= 1
+        imp = child("import")
+        assert imp["loaded"] == exp["exported"]
+        assert imp["chi2"] == exp["chi2"]  # bit-identical
+        assert imp["aot_hits"] > 0
+        if imp["monitoring"]:
+            assert imp["uncached_backend_compiles"] == 0
+
+    def test_mesh_in_key_round_trip(self, tmp_path, clean_aot):
+        """A mesh-sharded grid (8 forced host devices) round-trips:
+        the mesh is part of the stable key, the sharded executable
+        serves on import, and an unsharded build of the same grid is a
+        MISS (different key)."""
+        from pint_tpu.parallel import make_mesh
+
+        mesh = make_mesh("grid")
+        m, t = _mk(WLS_PAR, 64, 30)
+        pts = np.array([[m.values["F0"] + k * 1e-13, m.values["F1"]]
+                        for k in range(8)])
+        fn, _, _ = make_grid_fn(t, m, ["F0", "F1"], n_steps=2,
+                                mesh=mesh)
+        chi2_ref = np.asarray(fn(jnp.asarray(pts))[0])
+        out = compile_cache.export_executables(tmp_path)
+        sharded = [e for e in out["exported"]
+                   if "sharded" in e["label"]]
+        assert sharded, (out["exported"], out["skipped"])
+
+        compile_cache.clear_registry()
+        got = compile_cache.import_executables(tmp_path)
+        assert got["loaded"] >= 1
+        hits0 = compile_cache.aot_store_stats()["hits"]
+        m2, t2 = _mk(WLS_PAR, 64, 30)
+        fn2, _, _ = make_grid_fn(t2, m2, ["F0", "F1"], n_steps=2,
+                                 mesh=make_mesh("grid"))
+        chi2_aot = np.asarray(fn2(jnp.asarray(pts))[0])
+        assert compile_cache.aot_store_stats()["hits"] > hits0
+        np.testing.assert_array_equal(chi2_aot, chi2_ref)
+        # same grid WITHOUT the mesh: different key -> store miss
+        misses0 = compile_cache.aot_store_stats()["misses"]
+        make_grid_fn(t2, m2, ["F0", "F1"], n_steps=2)
+        assert compile_cache.aot_store_stats()["misses"] > misses0
+
+    def test_multi_shape_entry_serves_both(self, tmp_path, clean_aot):
+        """One registry entry (structure-only key) serves MULTIPLE
+        TOA counts: warm-sweeping two shapes exports one executable
+        per shape, and the imported store serves BOTH — the
+        pintwarm-default (--toas 500,1000) scenario that a
+        single-spec export used to break."""
+        from pint_tpu.fitter import WLSFitter
+
+        m1, t1 = _mk(WLS_PAR, 64, 50)
+        m2, t2 = _mk(WLS_PAR, 96, 51)
+        f1 = WLSFitter(t1, m1)
+        f1.warm_compile()
+        chi2_a = f1.fit_toas(maxiter=2)
+        f2 = WLSFitter(t2, m2)
+        f2.warm_compile()
+        chi2_b = f2.fit_toas(maxiter=2)
+        out = compile_cache.export_executables(tmp_path)
+        step = [e for e in out["exported"]
+                if e["label"].startswith("fitter.step")]
+        assert len(step) == 2  # one payload per shape, same hash
+        assert len({e["hash"] for e in step}) == 1
+
+        compile_cache.clear_registry()
+        got = compile_cache.import_executables(tmp_path)
+        assert not got["rejected"]
+        m1b, t1b = _mk(WLS_PAR, 64, 50)
+        m2b, t2b = _mk(WLS_PAR, 96, 51)
+        assert WLSFitter(t1b, m1b).fit_toas(maxiter=2) == chi2_a
+        served_mid = compile_cache.aot_store_stats()["served_calls"]
+        assert served_mid > 0
+        assert WLSFitter(t2b, m2b).fit_toas(maxiter=2) == chi2_b
+        stats = compile_cache.aot_store_stats()
+        assert stats["served_calls"] > served_mid
+        assert stats["rejects"] == 0  # no demotion either way
+
+    def test_unexported_shape_is_soft_miss(self, tmp_path,
+                                           clean_aot):
+        """A shape the manifest does NOT carry falls through to the
+        jit for that call only (jit.aot_shape_misses) — the
+        executables stay live for the shape that WAS exported."""
+        from pint_tpu.fitter import WLSFitter
+
+        m1, t1 = _mk(WLS_PAR, 64, 60)
+        f1 = WLSFitter(t1, m1)
+        chi2_a = f1.fit_toas(maxiter=2)
+        compile_cache.export_executables(tmp_path)
+
+        compile_cache.clear_registry()
+        compile_cache.import_executables(tmp_path)
+        m2, t2 = _mk(WLS_PAR, 96, 61)  # never exported
+        misses0 = compile_cache.aot_store_stats()["shape_misses"]
+        assert np.isfinite(WLSFitter(t2, m2).fit_toas(maxiter=2))
+        stats = compile_cache.aot_store_stats()
+        assert stats["shape_misses"] > misses0
+        assert stats["rejects"] == 0  # soft miss, not a demotion
+        # the exported shape still serves
+        m1b, t1b = _mk(WLS_PAR, 64, 60)
+        served0 = stats["served_calls"]
+        assert WLSFitter(t1b, m1b).fit_toas(maxiter=2) == chi2_a
+        assert compile_cache.aot_store_stats()["served_calls"] \
+            > served0
+
+    def test_version_skew_graceful_reject(self, tmp_path, clean_aot):
+        """A deliberately version-skewed manifest entry is rejected
+        per-entry (counter ticks, reason recorded) while the healthy
+        entries still load — never an exception."""
+        from pint_tpu.fitter import WLSFitter
+
+        m, t = _mk(WLS_PAR, 64, 40)
+        WLSFitter(t, m).fit_toas(maxiter=2)
+        out = compile_cache.export_executables(tmp_path)
+        assert out["exported"]
+        man = tmp_path / "manifest.json"
+        doc = json.loads(man.read_text())
+        skew = dict(doc["entries"][0])
+        skew["hash"] = "e" * 32
+        skew["jax"] = "0.0.0-skew"
+        doc["entries"].append(skew)
+        man.write_text(json.dumps(doc))
+
+        before = telemetry.counter_get("jit.aot_import_rejects")
+        got = compile_cache.import_executables(tmp_path)
+        assert got["loaded"] == len(out["exported"])
+        assert len(got["rejected"]) == 1
+        assert "mismatch" in got["rejected"][0][1]
+        assert telemetry.counter_get("jit.aot_import_rejects") > before
+
+    def test_missing_dir_is_graceful(self, tmp_path, clean_aot):
+        got = compile_cache.import_executables(tmp_path / "absent")
+        assert got["loaded"] == 0
+
+    def test_pjrt_rejected_on_cpu(self, tmp_path, clean_aot):
+        """A pjrt-codec entry must be rejected on the CPU backend
+        BEFORE its payload is touched (deserializing one can segfault
+        the process on XLA:CPU)."""
+        import jax
+
+        if jax.default_backend() != "cpu":
+            pytest.skip("cpu-only pathology")
+        env = compile_cache._aot_env()
+        man = {"format": 1, **env, "entries": [{
+            "hash": "a" * 32, "identity": "x", "label": "fake",
+            "file": "aot-nope.bin", "bytes": 0, "codec": "pjrt",
+            "avals": [], **env}]}
+        (tmp_path / "manifest.json").write_text(json.dumps(man))
+        got = compile_cache.import_executables(tmp_path)
+        assert got["loaded"] == 0
+        assert "unsupported" in got["rejected"][0][1]
+
+
+# --------------------------------------------------------------------------
+# satellite: pinttrace compile-time regression series
+# --------------------------------------------------------------------------
+
+class TestCompileSeries:
+    def _round(self, tmp_path, n, metrics):
+        p = tmp_path / f"BENCH_r{n:02d}.json"
+        p.write_text(json.dumps({"n": n, "metrics": metrics}))
+        return str(p)
+
+    def test_cold_compile_regression_flags(self, tmp_path):
+        from pint_tpu.scripts.pinttrace import check_regression
+
+        rec = {"metric": "gls_toas_per_sec", "value": 1000.0,
+               "backend": "cpu"}
+        paths = [
+            self._round(tmp_path, 1,
+                        [{**rec, "compile_s": {"cold": 5.0,
+                                               "warm": 0.0}}]),
+            self._round(tmp_path, 2,
+                        [{**rec, "compile_s": {"cold": 12.0,
+                                               "warm": 0.0}}]),
+        ]
+        lines, rc = check_regression(paths)
+        assert rc == 1
+        assert any("REGRESSION gls_toas_per_sec:compile_s.cold"
+                   in ln for ln in lines)
+
+    def test_cold_compile_improvement_ok(self, tmp_path):
+        from pint_tpu.scripts.pinttrace import check_regression
+
+        rec = {"metric": "gls_toas_per_sec", "value": 1000.0,
+               "backend": "cpu"}
+        paths = [
+            self._round(tmp_path, 1,
+                        [{**rec, "compile_s": {"cold": 5.0,
+                                               "warm": 0.0}}]),
+            self._round(tmp_path, 2,
+                        [{**rec, "compile_s": {"cold": 2.4,
+                                               "warm": 0.0}}]),
+        ]
+        lines, rc = check_regression(paths)
+        assert rc == 0
+        assert any("OK gls_toas_per_sec:compile_s.cold" in ln
+                   for ln in lines)
+
+    def test_metric_without_compile_not_flagged(self, tmp_path):
+        from pint_tpu.scripts.pinttrace import check_regression
+
+        paths = [
+            self._round(tmp_path, 1,
+                        [{"metric": "guard_overhead", "value": 0.5,
+                          "backend": "cpu", "compile_s": None}]),
+        ]
+        lines, rc = check_regression(paths)
+        assert rc == 0
+        assert not any("compile_s.cold" in ln for ln in lines)
+
+    def test_cold_start_s_lower_is_better(self, tmp_path):
+        from pint_tpu.scripts.pinttrace import check_regression
+
+        paths = [
+            self._round(tmp_path, 1,
+                        [{"metric": "cold_start_s", "value": 2.0,
+                          "backend": "cpu"}]),
+            self._round(tmp_path, 2,
+                        [{"metric": "cold_start_s", "value": 30.0,
+                          "backend": "cpu"}]),
+        ]
+        lines, rc = check_regression(paths)
+        assert rc == 1
+        assert any(ln.startswith("REGRESSION cold_start_s")
+                   for ln in lines)
+
+
+# --------------------------------------------------------------------------
+# satellite: pintwarm --export / --import CLI
+# --------------------------------------------------------------------------
+
+class TestPintwarmAotCLI:
+    def test_export_then_import(self, tmp_path, capsys, monkeypatch,
+                                clean_aot):
+        from pint_tpu.scripts.pintwarm import main
+
+        compile_cache._reset_for_tests()
+        try:
+            rc = main(["--toas", "64", "--kinds", "wls",
+                       "--cache-dir", str(tmp_path / "xla"),
+                       "--export", str(tmp_path / "aot")])
+            assert rc == 0
+            out = capsys.readouterr().out
+            assert "exported" in out
+            assert (tmp_path / "aot" / "manifest.json").exists()
+
+            compile_cache._reset_for_tests()
+            monkeypatch.setenv("PINT_TPU_CACHE_DIR",
+                               str(tmp_path / "xla"))
+            rc = main(["--toas", "64", "--kinds", "wls",
+                       "--import", str(tmp_path / "aot")])
+            assert rc == 0
+            out = capsys.readouterr().out
+            assert "imported" in out
+            assert "aot:" in out
+        finally:
+            compile_cache._reset_for_tests()
+
+    def test_export_import_exclusive(self, tmp_path):
+        from pint_tpu.scripts.pintwarm import main
+
+        with pytest.raises(SystemExit):
+            main(["--export", str(tmp_path), "--import",
+                  str(tmp_path)])
