@@ -255,9 +255,14 @@ def _phase_split(fn):
 
 def _emit_metric(rec):
     """One benchmark record: stdout JSON line + telemetry sink mirror
-    (one source of truth for the parent AND the trace file)."""
+    (one source of truth for the parent AND the trace file).  The
+    active run id (each metric runs under a ``bench.<name>`` run
+    scope) rides the row, so BENCH rows join the trace ledger."""
     from pint_tpu import telemetry
 
+    rid = telemetry.current_run_id()
+    if rid is not None and "run" not in rec:
+        rec = {**rec, "run": rid}
     print(json.dumps(rec), flush=True)
     telemetry.emit({"type": "metric", **rec})
 
@@ -1063,18 +1068,30 @@ def _run_one(name):
         # BENCH_r*.json never silently passes off CPU numbers as TPU
         backend += "-fallback"
 
+    rid = None
     try:
-        with span("bench.metric", metric=name, backend=backend):
+        # the run-ledger scope: every span/program/health/iter_trace
+        # record the metric produces joins its BENCH row by run_id
+        with telemetry.run_scope("bench." + name,
+                                 backend=backend) as run, \
+                span("bench.metric", metric=name, backend=backend):
+            rid = run.run_id
             _METRICS[name](jnp, backend)
         telemetry.flush()
         return 0
     except Exception as e:
-        _emit_metric({
+        # the scope has already exited (its run record carries the
+        # exception status) — re-attach its id explicitly so the
+        # FAILED row still joins the ledger
+        rec = {
             "metric": name, "value": None,
             "unit": f"FAILED: {type(e).__name__}: {e}",
             "vs_baseline": None,
             "backend": backend, "compile_s": None, "flops": None,
-        })
+        }
+        if rid is not None:
+            rec["run"] = rid
+        _emit_metric(rec)
         telemetry.flush()
         # sentinel: "failed but the JSON line was printed" — any other
         # nonzero (unhandled import error rc=1, signal death rc<0)

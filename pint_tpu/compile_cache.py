@@ -77,7 +77,8 @@ __all__ = [
     "bucket_size", "pad_toas", "PAD_ERROR_US",
     "split_ctx", "merge_ctx", "fingerprint",
     "model_structure_key", "donation_argnums", "warmup",
-    "scan_iters_default", "iterate_fixed",
+    "scan_iters_default", "iterate_fixed", "iter_trace_default",
+    "gn_trace_record", "decode_gn_trace",
     "export_executables", "import_executables", "aot_store_stats",
     "clear_aot_store", "aot_cold_start_probe",
 ]
@@ -85,6 +86,7 @@ __all__ = [
 _CACHE_ENV = "PINT_TPU_CACHE_DIR"
 _BUCKET_ENV = "PINT_TPU_BUCKET_TOAS"
 _SCAN_ENV = "PINT_TPU_SCAN_ITERS"
+_ITER_TRACE_ENV = "PINT_TPU_ITER_TRACE"
 _DEFAULT_CACHE_DIR = os.path.join("~", ".cache", "pint_tpu", "xla")
 _AOT_MANIFEST = "manifest.json"
 _AOT_FORMAT = 1
@@ -414,11 +416,17 @@ def shared_jit(fn, *, key, fn_token=None, donate_argnums=None,
         # tick them on every registry build).
         if _aot_store:
             got_aot = _aot_store.get(_aot_hash(identity, key))
+            label_str = label if label is not None \
+                else _derive_label(fn, key)
             if got_aot is not None:
                 telemetry.counter_add("jit.aot_import_hits")
+                telemetry.emit({"type": "aot", "event": "import_hit",
+                                "label": label_str})
                 target = _AotProgram(got_aot["compiled"], target)
             else:
                 telemetry.counter_add("jit.aot_import_misses")
+                telemetry.emit({"type": "aot", "event": "import_miss",
+                                "label": label_str})
         jitted = profiling.wrap_program(
             target, key=key,
             label=label if label is not None else _derive_label(fn, key))
@@ -445,7 +453,30 @@ def scan_iters_default() -> bool:
                                        "unroll")
 
 
-def iterate_fixed(body, init, n_steps, scan=None):
+def iter_trace_default() -> bool:
+    """Whether fixed-count iteration loops additionally materialize a
+    per-iteration convergence record out of the scan
+    (``$PINT_TPU_ITER_TRACE``, default OFF).
+
+    PR 8 moved the Gauss-Newton iterations inside ``lax.scan``, which
+    erased per-iteration visibility exactly where convergence
+    pathologies live (guard-ladder escalations, Kepler depth refits,
+    near-singular normal equations).  With the gate on, the scan's
+    ``ys`` carry a small per-iteration record (chi^2, step norm,
+    max |dparam|, an on-device ok bit) as a stacked array, decoded
+    host-side lazily (:func:`decode_gn_trace`) and emitted as
+    ``iter_trace`` telemetry records.  The gate CHANGES the traced
+    program, so — like ``$PINT_TPU_SCAN_ITERS`` and
+    ``$PINT_TPU_GUARD`` — it is part of every affected shared-jit key
+    (grid per-point refit, the three batched-PTA loops, the fitter
+    step keys); gate-off traces are byte-identical to the ungated
+    programs and the zero-recompile contract holds per gate value
+    (``tools/check_jit_gates.py`` lints the gate->key coverage)."""
+    raw = os.environ.get(_ITER_TRACE_ENV, "").strip().lower()
+    return raw in ("1", "true", "yes", "on")
+
+
+def iterate_fixed(body, init, n_steps, scan=None, trace_of=None):
     """Run ``carry = body(carry)`` exactly ``n_steps`` times inside a
     trace — the one implementation of the fixed-count Gauss-Newton
     iteration loop shared by the grid and batched-PTA step programs.
@@ -457,22 +488,115 @@ def iterate_fixed(body, init, n_steps, scan=None):
     cost linear in the count).  ``scan=None`` follows
     :func:`scan_iters_default`.  Callers must resolve the flag at
     trace-BUILD time and put it in their jit key: the two variants are
-    different programs."""
+    different programs.
+
+    trace_of: optional ``fn(prev_carry, new_carry) -> record pytree``
+    (the flight-recorder hook, :func:`iter_trace_default`).  When
+    given, returns ``(carry, trace)`` where ``trace`` stacks one
+    record per iteration along a new leading axis — the scan's ``ys``
+    on the scan path, python-side accumulation + ``stack`` on the
+    unroll path, so the two modes produce the identical record.
+    ``n_steps <= 0`` returns ``(init, None)``."""
+    traced = trace_of is not None
     if int(n_steps) <= 0:
-        return init
+        return (init, None) if traced else init
     if scan is None:
         scan = scan_iters_default()
-    if not scan:
-        for _ in range(int(n_steps)):
-            init = body(init)
-        return init
     import jax
 
-    def step(carry, _):
-        return body(carry), None
+    if not scan:
+        records = []
+        for _ in range(int(n_steps)):
+            new = body(init)
+            if traced:
+                records.append(trace_of(init, new))
+            init = new
+        if not traced:
+            return init
+        import jax.numpy as jnp
 
-    out, _ = jax.lax.scan(step, init, None, length=int(n_steps))
-    return out
+        return init, jax.tree.map(lambda *xs: jnp.stack(xs), *records)
+
+    def step(carry, _):
+        new = body(carry)
+        return new, (trace_of(carry, new) if traced else None)
+
+    out, ys = jax.lax.scan(step, init, None, length=int(n_steps))
+    return (out, ys) if traced else out
+
+
+def gn_trace_record(prev_vec, new_vec, chi2):
+    """The ONE per-iteration Gauss-Newton trace record (built inside a
+    trace; grid and batched-PTA loops pass this to
+    :func:`iterate_fixed`'s ``trace_of``): chi^2 at this iteration's
+    input point, the step 2-norm, the largest single-parameter move,
+    and a cheap on-device ok bit (finite chi^2 AND finite step — the
+    in-loop analogue of the Health verdict's hot-path read; the full
+    guard record still rides the post-loop solve).  Decoded by
+    :func:`decode_gn_trace`."""
+    import jax.numpy as jnp
+
+    d = new_vec - prev_vec
+    return {
+        "chi2": chi2,
+        "step_norm": jnp.sqrt(jnp.sum(d * d)),
+        "max_dpar": jnp.max(jnp.abs(d)),
+        "ok": jnp.logical_and(jnp.isfinite(chi2),
+                              jnp.all(jnp.isfinite(new_vec))),
+    }
+
+
+def decode_gn_trace(trace, guard_eps=0.0, rung="baseline"):
+    """Decode a stacked on-device iteration trace (the pytree
+    :func:`iterate_fixed` returned) into host-side per-iteration
+    dicts — called LAZILY, only when a consumer actually wants the
+    record (a telemetry sink is attached, or the caller reads
+    ``.iter_trace``), because the ``np.asarray`` here is the device
+    sync the gated design otherwise avoids.
+
+    Leaves shaped ``(n_steps,)`` (a single fit) decode exactly;
+    leaves shaped ``(batch, n_steps)`` (a vmapped grid or PTA batch)
+    reduce per iteration — chi^2 median/min/max across the batch, max
+    step norm, max |dparam|, all-ok plus the bad-member count — so a
+    10^4-point grid's record stays a handful of numbers per
+    iteration.  Returns ``[]`` for ``trace=None``."""
+    if trace is None:
+        return []
+    t = {k: np.asarray(v) for k, v in trace.items()}
+    chi2, sn, md, ok = t["chi2"], t["step_norm"], t["max_dpar"], t["ok"]
+    entries = []
+    common = {"guard_eps": float(guard_eps), "rung": rung}
+    if chi2.ndim == 1:
+        for i in range(chi2.shape[0]):
+            entries.append({
+                "i": i, "chi2": float(chi2[i]),
+                "step_norm": float(sn[i]),
+                "max_dpar": float(md[i]), "ok": bool(ok[i]),
+                **common,
+            })
+        return entries
+    # batched: reduce the leading axes down to one batch axis
+    flat = {k: v.reshape(-1, v.shape[-1]) for k, v in t.items()}
+    chi2, sn, md, ok = (flat["chi2"], flat["step_norm"],
+                        flat["max_dpar"], flat["ok"])
+    for i in range(chi2.shape[-1]):
+        c = chi2[:, i]
+        finite = c[np.isfinite(c)]
+        entries.append({
+            "i": i,
+            "chi2": float(np.median(finite)) if finite.size else
+            float("nan"),
+            "chi2_min": float(np.min(finite)) if finite.size else
+            float("nan"),
+            "chi2_max": float(np.max(finite)) if finite.size else
+            float("nan"),
+            "step_norm": float(np.max(sn[:, i])),
+            "max_dpar": float(np.max(md[:, i])),
+            "ok": bool(np.all(ok[:, i])),
+            "n_bad": int(np.sum(~ok[:, i])),
+            **common,
+        })
+    return entries
 
 
 def registry_stats():

@@ -188,6 +188,23 @@ def datacheck_report(ephem="builtin", sites=("gbt", "ao", "jb", "pks",
         f"{int(telemetry.counter_get('guard.checkpoint_saves'))} "
         f"saved / {int(telemetry.counter_get('guard.checkpoint_resumes'))} "
         "resumed")
+    runs = telemetry.runs_summary()
+    lines.append(
+        f"  run ledger: {runs['completed']} completed / "
+        f"{runs['failed']} failed / {runs['in_flight']} in flight "
+        "this session ($PINT_TPU_ITER_TRACE for per-iteration "
+        "traces; datacheck --runs smokes the join)")
+    try:
+        from pint_tpu import metrics_http
+
+        mport = metrics_http.port()
+    except Exception:
+        mport = None
+    lines.append(
+        "  metrics endpoint: "
+        + (f"live on port {mport} (/metrics, /healthz)" if mport
+           else "off (set $PINT_TPU_METRICS_PORT for a Prometheus "
+                "scrape surface)"))
     for tline in _last_session_compile_lines():
         lines.append(tline)
 
@@ -606,6 +623,101 @@ def _profile_section():
     return lines
 
 
+def _runs_section():
+    """Run-ledger smoke (--runs): one small fit under a temporary
+    trace sink with the flight recorder and profiling on, then the
+    ledger join — the fit's run_id must connect >= 4 record types
+    (run, span, health, iter_trace, program).  Diagnostic: reports,
+    never raises."""
+    import json
+    import os
+    import tempfile
+
+    from pint_tpu import profiling, telemetry
+
+    lines = ["Run ledger (--runs):"]
+    prev_gate = os.environ.get("PINT_TPU_ITER_TRACE")
+    # the smoke swaps the sink; the user's env-configured sink (and
+    # span enablement) must come back afterwards — configure() CLOSES
+    # a replaced owned sink, so this is restore-or-destroy
+    prev_sink = telemetry.sink_info()
+    fd, sink_path = tempfile.mkstemp(prefix="pint_tpu_runs_",
+                                     suffix=".jsonl")
+    os.close(fd)
+    try:
+        import numpy as np
+
+        from pint_tpu.compile_cache import WARM_WLS_PAR
+        from pint_tpu.fitter import WLSFitter
+        from pint_tpu.models.builder import get_model
+        from pint_tpu.scripts.pinttrace import (convergence_table,
+                                                join_runs)
+        from pint_tpu.simulation import make_fake_toas_uniform
+
+        os.environ["PINT_TPU_ITER_TRACE"] = "1"
+        model = get_model(WARM_WLS_PAR)
+        toas = make_fake_toas_uniform(
+            53000.0, 54000.0, 60, model, freq_mhz=1400.0, obs="gbt",
+            error_us=1.0, add_noise=True,
+            rng=np.random.default_rng(0))
+        with open(sink_path, "w") as sink:
+            telemetry.configure(sink=sink)
+            try:
+                with profiling.profiled(True):
+                    f = WLSFitter(toas, model)
+                    f.fit_toas(maxiter=3)
+                telemetry.flush()
+            finally:
+                if prev_sink["path"] is not None:
+                    telemetry.configure(sink=prev_sink["path"],
+                                        enabled=prev_sink["enabled"])
+                elif prev_sink["sink"] is not None:
+                    telemetry.configure(sink=prev_sink["sink"],
+                                        enabled=prev_sink["enabled"])
+                else:
+                    telemetry.configure(sink=None,
+                                        enabled=prev_sink["enabled"])
+        records = [json.loads(ln) for ln in open(sink_path)
+                   if ln.strip()]
+        runs = join_runs(records)
+        fit_runs = [(rid, info) for rid, info in runs.items()
+                    if (info["run"] or {}).get("kind") == "fit"]
+        if not fit_runs:
+            lines.append("  PROBLEM: no fit run record in the trace")
+            return lines
+        rid, info = fit_runs[-1]
+        joined = set(info["types"])
+        # the program record is a cumulative flush mirror — it joins
+        # through its per-record `runs` list, not the emit-time tag
+        for rec in records:
+            if rec.get("type") == "program" \
+                    and rid in (rec.get("runs") or ()):
+                joined.add("program")
+        need = {"run", "span", "health", "iter_trace"}
+        ok = need.issubset(joined) and len(joined) >= 4
+        lines.append(
+            f"  one fit -> run {rid}: record types joined = "
+            f"{sorted(joined)} -> "
+            + ("OK" if ok else f"PROBLEM (need >= 4 incl. {sorted(need)})"))
+        n_iter = info["n_iter"]
+        lines.append(f"  iteration trace: {n_iter} entries "
+                     + ("OK" if n_iter >= 1 else "PROBLEM"))
+        for ln in convergence_table(records, rid):
+            lines.append("    " + ln)
+    except Exception as e:  # diagnostic must never take the report down
+        lines.append(f"  ERROR {type(e).__name__}: {e}")
+    finally:
+        if prev_gate is None:
+            os.environ.pop("PINT_TPU_ITER_TRACE", None)
+        else:
+            os.environ["PINT_TPU_ITER_TRACE"] = prev_gate
+        try:
+            os.unlink(sink_path)
+        except OSError:
+            pass
+    return lines
+
+
 def _aot_child(mode, path):
     """Child entry for the --aot smoke (one fresh interpreter per
     probe run): prints the probe record as a JSON line."""
@@ -787,6 +899,11 @@ def main(argv=None):
                         "bit-identical fit with zero uncached XLA "
                         "backend compiles, plus the version-skew "
                         "graceful-reject path")
+    p.add_argument("--runs", action="store_true",
+                   help="run the run-ledger smoke: one fit under a "
+                        "temp trace sink must reconstruct with >= 4 "
+                        "record types joined by run_id, and its "
+                        "per-iteration convergence table renders")
     p.add_argument("--aot-child", nargs=2, metavar=("MODE", "DIR"),
                    default=None, help=argparse.SUPPRESS)
     args = p.parse_args(argv)
@@ -796,6 +913,9 @@ def main(argv=None):
         print(line)
     if args.faults:
         for line in _faults_section():
+            print(line)
+    if args.runs:
+        for line in _runs_section():
             print(line)
     if args.profile:
         for line in _profile_section():

@@ -52,6 +52,7 @@ class _DownhillMixin:
             key=("downhill.halving", type(self).__name__,
                  self._traced_free, self.max_halvings,
                  getattr(self, "threshold", None), self._guard_on,
+                 self._iter_trace,
                  self._partition, self._frozen_names,
                  self._noise_frozen,
                  self.resids._structure_key()),
@@ -109,7 +110,7 @@ class _DownhillMixin:
         chi2_new = jnp.where(ok, chi2_new, chi2_old)
         return vec + lam * dpar, chi2_old, chi2_new, cov, health
 
-    def _iterate(self, maxiter, guard_eps=0.0):
+    def _iterate(self, maxiter, guard_eps=0.0, rung="baseline"):
         """One ladder rung of the downhill loop (fitter.Fitter._iterate
         contract): the in-trace lambda-halving already rejects
         chi^2-raising and NaN steps, so the guard's job here is the
@@ -133,6 +134,12 @@ class _DownhillMixin:
             n_iter += 1
             if np.isfinite(float(chi2_old)):
                 last_good = vec_in
+            if self._iter_trace:
+                # the flight-recorder entry reads the ACCEPTED chi^2
+                # (chi2_new — what the halving search served), so a
+                # stalled search shows as chi2 plateau + zero step
+                self._note_iteration(float(chi2_new), vec_in, vec,
+                                     health, guard_eps, rung)
             self._check_step_health(health, last_good, n_iter)
             if float(chi2_old) - float(chi2_new) \
                     < self.min_chi2_decrease:
@@ -143,16 +150,21 @@ class _DownhillMixin:
     def fit_toas(self, maxiter=20, fit_noise=False, noise_maxiter=100):
         if not self.model.free_timing_params:
             raise ValueError("no free timing parameters to fit")
-        with span("downhill_fit", fitter=type(self).__name__,
-                  n_toa=len(self.toas),
-                  n_free=len(self.model.free_timing_params),
-                  maxiter=maxiter) as sp:
+        with telemetry.run_scope(
+                "fit", fitter=type(self).__name__,
+                n_toa=len(self.toas),
+                fingerprint=self._inputs_fingerprint()), \
+            span("downhill_fit", fitter=type(self).__name__,
+                 n_toa=len(self.toas),
+                 n_free=len(self.model.free_timing_params),
+                 maxiter=maxiter) as sp:
             if tuple(self.model.free_timing_params) != getattr(
                     self, "_traced_free", ()):
                 self._retrace()
             else:
                 telemetry.counter_add("fitter.jit_cache_hits")
                 self._refresh_frozen()
+            self._iter_entries = [] if self._iter_trace else None
             vec, cov_np, n_iter, health, rung = \
                 self._fit_with_depth_guard(
                     lambda: self._guard_rungs(maxiter))
@@ -162,6 +174,7 @@ class _DownhillMixin:
             sp.set(n_iter=n_iter, converged=self.converged,
                    flops_est=flops_est)
             self._record_guard(rung, health, sp)
+            self._emit_iter_trace(rung)
             self._update_fit_meta()
             self._post_fit()
         if fit_noise:

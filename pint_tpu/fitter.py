@@ -469,6 +469,14 @@ class Fitter:
         # same trace; the on/off flag changes the traced program and is
         # part of the key
         self._guard_on = _guard.enabled()
+        # flight-recorder gate: the single-fitter loop is host-driven
+        # (one _step_jit call per iteration), so the per-iteration
+        # record accumulates host-side and the step PROGRAM is
+        # gate-invariant — but the gate still keys uniformly with the
+        # grid/PTA programs it DOES re-trace, so the gate->key lint
+        # (tools/check_jit_gates.py) stays one rule with no per-site
+        # exemptions and a future in-trace fitter loop can't miss it
+        self._iter_trace = _cc.iter_trace_default()
         leaves = self._partition_setup()
         self._fit_data = self._inject_frozen(
             {**self.resids._data(), "guard_eps": np.float64(0.0)},
@@ -490,6 +498,7 @@ class Fitter:
         the env gates through them."""
         return ("fitter.step", type(self).__name__, self._traced_free,
                 getattr(self, "threshold", None), self._guard_on,
+                self._iter_trace,
                 self._partition, self._frozen_names, self._noise_frozen,
                 self.resids._structure_key())
 
@@ -582,17 +591,73 @@ class Fitter:
     def _guard_rungs(self, maxiter):
         """The degradation ladder for this fitter: baseline, then (when
         the guard is on) escalating jitter, then an optional downgrade
-        (GLS fitters fall back to a WLS solve — `_downgrade_rung`)."""
+        (GLS fitters fall back to a WLS solve — `_downgrade_rung`).
+        Each rung tells ``_iterate`` its own name, so the flight
+        recorder's per-iteration entries carry the serving rung and
+        guard_eps — an escalation is visible IN the iteration trace,
+        not just as the final GUARD_RUNG verdict."""
         rungs = [("baseline", lambda: self._iterate(maxiter))]
         if self._guard_on:
             for name, eps in self._guard_jitter_rungs:
                 rungs.append(
                     (name,
-                     lambda e=eps: self._iterate(maxiter, guard_eps=e)))
+                     lambda e=eps, n=name: self._iterate(
+                         maxiter, guard_eps=e, rung=n)))
             down = self._downgrade_rung(maxiter)
             if down is not None:
                 rungs.append(down)
         return rungs
+
+    # -- flight recorder ------------------------------------------------------
+    def _note_iteration(self, chi2_f, vec_in, vec_new, health,
+                        guard_eps, rung):
+        """One per-iteration convergence entry
+        (``$PINT_TPU_ITER_TRACE``): the single-fitter loop already
+        syncs chi^2 per iteration, so the extra device read here is
+        the step vector it is about to read back anyway.  ``ok``
+        reads the guard's packed bit when the guard is on (already
+        synced by `_check_step_health`), the finiteness of
+        (chi^2, step) otherwise."""
+        d = np.asarray(vec_new) - vec_in
+        if health:
+            ok = bool(np.asarray(health.ok))
+        else:
+            ok = bool(np.isfinite(chi2_f) and np.all(np.isfinite(d)))
+        entries = getattr(self, "_iter_entries", None)
+        if entries is None:
+            entries = self._iter_entries = []
+        entries.append({
+            "i": len(entries), "chi2": chi2_f,
+            "step_norm": float(np.sqrt(np.sum(d * d))),
+            "max_dpar": float(np.max(np.abs(d))) if d.size else 0.0,
+            "ok": ok, "guard_eps": float(guard_eps), "rung": rung,
+        })
+
+    def _emit_iter_trace(self, rung):
+        """Publish the fit's accumulated iteration record: the
+        ``iter_trace`` attribute always (gate on), one JSONL record
+        when a sink is attached."""
+        entries = getattr(self, "_iter_entries", None)
+        if not entries:
+            return
+        self.iter_trace = list(entries)
+        telemetry.emit(telemetry.iter_trace_record(
+            f"fitter.step:{type(self).__name__}", self.iter_trace,
+            kind="fit", rung=rung, n_toa=len(self.toas),
+            n_free=len(self._traced_free)))
+
+    def _inputs_fingerprint(self):
+        """Cheap run-ledger identity of this fit's inputs: a hash of
+        the residuals structure key, the TOA count, and the free set
+        — NOT a content fingerprint (hashing the dataset per fit
+        would cost more than the fit's host side), but enough to say
+        "these two runs fit the same problem shape"."""
+        import hashlib
+
+        return hashlib.blake2b(
+            repr((self.resids._structure_key(), len(self.toas),
+                  tuple(self.model.free_timing_params))).encode(),
+            digest_size=8).hexdigest()
 
     def _downgrade_rung(self, maxiter):
         """Hook: the final ladder rung (GLS fitters downgrade to WLS)."""
@@ -600,10 +665,15 @@ class Fitter:
 
     def _record_guard(self, rung, health, sp):
         """Publish the fit's guard outcome: ``fit_rung``/``fit_health``
-        attributes always; fit meta + a warning when a degraded rung
-        served (a degraded fit must be loud, never silent)."""
+        attributes always; a ``{"type": "health"}`` ledger record
+        (joined to the run by the emit-time tag); fit meta + a warning
+        when a degraded rung served (a degraded fit must be loud,
+        never silent)."""
         self.fit_rung = rung
         self.fit_health = _guard.to_record(health)
+        telemetry.emit({"type": "health",
+                        "context": type(self).__name__,
+                        "rung": rung, **self.fit_health})
         if rung != "baseline":
             self.model.meta["GUARD_RUNG"] = rung
             if sp is not None:
@@ -618,10 +688,11 @@ class Fitter:
             # degraded one from before the data was fixed
             self.model.meta.pop("GUARD_RUNG", None)
 
-    def _iterate(self, maxiter, guard_eps=0.0):
+    def _iterate(self, maxiter, guard_eps=0.0, rung="baseline"):
         """Run the Gauss-Newton loop once (one ladder rung).  Returns
         (vec, cov, extras, n_iter, health); raises guard.StepDiverged
-        with the last finite-chi^2 parameter state on a bad verdict."""
+        with the last finite-chi^2 parameter state on a bad verdict.
+        ``rung`` labels this attempt's flight-recorder entries."""
         vec = jnp.array(
             [self.model.values[k] for k in self._traced_free],
             dtype=jnp.float64,
@@ -648,6 +719,9 @@ class Fitter:
                 # chi2 is evaluated at the INPUT vector — that vector
                 # is the proven-good state
                 last_good = vec_in
+            if self._iter_trace:
+                self._note_iteration(chi2_f, vec_in, vec, health,
+                                     guard_eps, rung)
             self._check_step_health(health, last_good, n_iter)
             if chi2_prev is not None and \
                     abs(float(chi2_prev) - chi2_f) \
@@ -669,10 +743,14 @@ class Fitter:
                 "no free timing parameters to fit (mark them with a '1' "
                 "fit flag in the par file or clear Param.frozen)"
             )
-        with span("fit_toas", fitter=type(self).__name__,
-                  n_toa=len(self.toas),
-                  n_free=len(self.model.free_timing_params),
-                  maxiter=maxiter) as sp:
+        with telemetry.run_scope(
+                "fit", fitter=type(self).__name__,
+                n_toa=len(self.toas),
+                fingerprint=self._inputs_fingerprint()), \
+            span("fit_toas", fitter=type(self).__name__,
+                 n_toa=len(self.toas),
+                 n_free=len(self.model.free_timing_params),
+                 maxiter=maxiter) as sp:
             if tuple(self.model.free_timing_params) != getattr(
                     self, "_traced_free", ()):
                 self._retrace()
@@ -682,6 +760,7 @@ class Fitter:
                 # precomputed delay leaves (data, not a retrace) — the
                 # partition re-keys only when the free SET changes
                 self._refresh_frozen()
+            self._iter_entries = [] if self._iter_trace else None
             vec, cov_np, n_iter, health, rung = \
                 self._fit_with_depth_guard(
                     lambda: self._guard_rungs(maxiter))
@@ -690,6 +769,7 @@ class Fitter:
             telemetry.counter_add("fit.flops_est", flops_est)
             sp.set(n_iter=n_iter, flops_est=flops_est)
             self._record_guard(rung, health, sp)
+            self._emit_iter_trace(rung)
             self._update_fit_meta()
             self._post_fit()
             return float(self.resids.chi2)
@@ -888,7 +968,19 @@ class GLSFitter(Fitter):
         def downgrade():
             wls = WLSFitter(self.toas, self.model,
                             residuals=self.resids)
-            return wls._iterate(maxiter)
+            out = wls._iterate(maxiter, rung="wls")
+            # the downgrade iterations run on a throwaway fitter —
+            # the SERVED rung's entries must land in THIS fitter's
+            # flight record, or the one case the recorder exists to
+            # explain (every jitter rung failed) records nothing
+            served = getattr(wls, "_iter_entries", None)
+            if served:
+                if getattr(self, "_iter_entries", None) is None:
+                    self._iter_entries = []
+                for e in served:
+                    self._iter_entries.append(
+                        {**e, "i": len(self._iter_entries)})
+            return out
 
         return ("wls", downgrade)
 

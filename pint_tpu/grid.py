@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from pint_tpu import compile_cache as _cc
+from pint_tpu import telemetry
 from pint_tpu.models.timing_model import frozen_delay_default, \
     hybrid_design_default
 from pint_tpu.residuals import Residuals
@@ -28,9 +29,14 @@ __all__ = ["grid_chisq", "grid_chisq_vectorized", "make_grid_fn",
 
 
 def _make_fit_one(prepared, resids, grid_params, fit_params, n_steps,
-                  scan=None):
+                  scan=None, trace=False):
     """Build the pure per-point function ``fit_one(grid_vec, dyn) ->
-    (chi2, fitted_values)`` plus its dynamic-leaf pytree ``dyn``.
+    (chi2, fitted_values)`` — or, with ``trace`` (the
+    ``$PINT_TPU_ITER_TRACE`` flight recorder, resolved by the CALLER
+    and folded into the jit key), ``(chi2, fitted_values,
+    iter_trace)`` where the trace stacks one
+    :func:`pint_tpu.compile_cache.gn_trace_record` per GN iteration —
+    plus its dynamic-leaf pytree ``dyn``.
     Returns ``(fit_one, dyn, partition_record)``.
 
     Everything dataset-derived — the residual data pytree, the base
@@ -171,6 +177,11 @@ def _make_fit_one(prepared, resids, grid_params, fit_params, n_steps,
                                     resid_of, linear_of)
 
         def gn_step(fit_vec):
+            """One GN refit step -> (new_vec, chi2 at the input
+            point).  The solvers compute chi^2 regardless (it is one
+            reduction of the whitened residual they already hold), so
+            the gate-off caller dropping it leaves the traced program
+            identical to the pre-flight-recorder build."""
             values = values_of(fit_vec)
             sigma = (d["sigma_const"] if has_sigma
                      else resids.sigma_at(values, data))
@@ -180,23 +191,35 @@ def _make_fit_one(prepared, resids, grid_params, fit_params, n_steps,
 
                 if has_pre:
                     U, phi = data["U_ext"], d["phi_const"]
-                    dpar, *_ = gls_normal_solve(
+                    dpar, _cov, _nc, chi2 = gls_normal_solve(
                         rj[0], rj[1], sigma, U, phi, pre=d["pre"],
                         gram=d["gram_const"])
                 else:
                     U, phi = resids._noise_basis_phi_at(values, data)
-                    dpar, *_ = gls_normal_solve(rj[0], rj[1], sigma,
-                                                U, phi)
-                return fit_vec + dpar
+                    dpar, _cov, _nc, chi2 = gls_normal_solve(
+                        rj[0], rj[1], sigma, U, phi)
+                return fit_vec + dpar, chi2
             from pint_tpu.fitter import wls_gn_solve
 
-            new_vec, _, _, _ = wls_gn_solve(None, fit_vec, sigma,
-                                            rj=rj)
-            return new_vec
+            new_vec, chi2, _, _ = wls_gn_solve(None, fit_vec, sigma,
+                                               rj=rj)
+            return new_vec, chi2
 
         vec = d["fit0"]
+        tr = None
         if fit_params:  # all-params-gridded case: plain chi2 evaluation
-            vec = _cc.iterate_fixed(gn_step, vec, n_steps, scan=scan)
+            if trace:
+                def body(carry):
+                    return gn_step(carry[0])
+
+                (vec, _), tr = _cc.iterate_fixed(
+                    body, (vec, jnp.float64(jnp.inf)), n_steps,
+                    scan=scan,
+                    trace_of=lambda p, n: _cc.gn_trace_record(
+                        p[0], n[0], n[1]))
+            else:
+                vec = _cc.iterate_fixed(lambda v: gn_step(v)[0], vec,
+                                        n_steps, scan=scan)
         values = values_of(vec)
         if has_pre:
             from pint_tpu.linalg import woodbury_chi2_logdet_pre
@@ -208,6 +231,8 @@ def _make_fit_one(prepared, resids, grid_params, fit_params, n_steps,
             chi2 = jnp.sum((r / d["sigma_const"]) ** 2)
         else:
             chi2 = resids.chi2_at(values, data)
+        if trace:
+            return chi2, vec, tr
         return chi2, vec
 
     return fit_one, dyn, partition_record
@@ -261,24 +286,54 @@ def make_grid_fn(toas, model, grid_params, n_steps=3, mesh=None):
         resids.ensure_kepler_depth(float("nan"))
     fit_params = [p for p in model.free_timing_params if p not in grid_params]
     scan = _cc.scan_iters_default()
+    trace = _cc.iter_trace_default()
     fit_one, dyn, partition = _make_fit_one(
-        prepared, resids, grid_params, fit_params, n_steps, scan=scan)
+        prepared, resids, grid_params, fit_params, n_steps, scan=scan,
+        trace=trace)
+    label = (f"grid.fit_one:{'+'.join(grid_params)}"
+             + (":sharded" if mesh is not None else ""))
     key = ("grid.fit_one", resids._structure_key(),
            tuple(grid_params), tuple(fit_params), int(n_steps),
            # the gates change the traced program (partition + frozen
            # leaves derive deterministically from them + the free set;
-           # scan-vs-unroll is a different iteration body)
-           hybrid_design_default(), frozen_delay_default(), scan) \
+           # scan-vs-unroll is a different iteration body; the
+           # iter-trace gate adds the per-iteration ys output)
+           hybrid_design_default(), frozen_delay_default(), scan,
+           trace) \
         + _mesh.mesh_jit_key(mesh)
     jitted = _cc.shared_jit(
         jax.vmap(fit_one, in_axes=(0, None)), key=key,
         fn_token="grid.make_grid_fn",
-        label=f"grid.fit_one:{'+'.join(grid_params)}"
-              + (":sharded" if mesh is not None else ""))
+        label=label)
     jitted.set_mesh(_mesh.mesh_desc(mesh))
+
+    def _unpack(out, n=None):
+        """Strip (and publish) the flight-recorder trace from a grid
+        call's outputs; ``n`` slices padded point rows off every
+        output (the sharded path).  The trace stays on device until a
+        telemetry sink actually wants the decoded record."""
+        if trace:
+            chi2, fitted, tr = out
+        else:
+            (chi2, fitted), tr = out, None
+        if n is not None:
+            chi2, fitted = chi2[:n], fitted[:n]
+            if tr is not None:
+                tr = jax.tree.map(lambda x: x[:n], tr)
+        if tr is not None:
+            fn.last_iter_trace = tr
+            if telemetry.sink_active():
+                telemetry.emit(telemetry.iter_trace_record(
+                    label, _cc.decode_gn_trace(tr), kind="grid",
+                    n_points=int(np.shape(chi2)[0]),
+                    n_steps=int(n_steps)))
+        return chi2, fitted
+
     if mesh is None:
         def fn(grid_values):
-            return jitted(grid_values, dyn)
+            with telemetry.run_scope("grid",
+                                     grid_params=list(grid_params)):
+                return _unpack(jitted(grid_values, dyn))
 
         return fn, fit_params, partition
 
@@ -288,17 +343,19 @@ def make_grid_fn(toas, model, grid_params, n_steps=3, mesh=None):
     # at build time, not per call (only the grid values vary)
     dyn_sharded = _mesh.shard_args(mesh, rules, {"dyn": dyn})["dyn"]
 
-    def sharded_fn(grid_values):
-        n = int(np.shape(grid_values)[0])
-        n_pad = _mesh.pad_to_multiple(n, ndev)
-        _mesh.record_pad_waste("grid", n, n_pad)
-        gv = _mesh.pad_leading(grid_values, n_pad, mode="edge")
-        gv = _mesh.shard_args(mesh, rules, {"grid_values": gv})[
-            "grid_values"]
-        chi2, fitted = jitted(gv, dyn_sharded)
-        return chi2[:n], fitted[:n]
+    def fn(grid_values):
+        with telemetry.run_scope("grid",
+                                 grid_params=list(grid_params),
+                                 sharded=True):
+            n = int(np.shape(grid_values)[0])
+            n_pad = _mesh.pad_to_multiple(n, ndev)
+            _mesh.record_pad_waste("grid", n, n_pad)
+            gv = _mesh.pad_leading(grid_values, n_pad, mode="edge")
+            gv = _mesh.shard_args(mesh, rules, {"grid_values": gv})[
+                "grid_values"]
+            return _unpack(jitted(gv, dyn_sharded), n=n)
 
-    return sharded_fn, fit_params, partition
+    return fn, fit_params, partition
 
 
 def grid_chisq_vectorized(
@@ -315,15 +372,21 @@ def grid_chisq_vectorized(
     grid_values = jnp.asarray(grid_values, dtype=jnp.float64)
     fn, _, _ = make_grid_fn(toas, model, grid_params, n_steps,
                             mesh=mesh)
-    if chunk is None or grid_values.shape[0] <= chunk:
-        chi2, fitted = fn(grid_values)
-    else:
-        outs = [
-            fn(grid_values[i : i + chunk])
-            for i in range(0, grid_values.shape[0], chunk)
-        ]
-        chi2 = jnp.concatenate([o[0] for o in outs])
-        fitted = jnp.concatenate([o[1] for o in outs])
+    # ONE ledger run for the whole surface: the per-call scopes the
+    # grid callable opens join this outer one, so a chunked grid is
+    # one run (with one iter_trace record per chunk), not one per
+    # chunk
+    with telemetry.run_scope("grid", grid_params=list(grid_params),
+                             n_points=int(grid_values.shape[0])):
+        if chunk is None or grid_values.shape[0] <= chunk:
+            chi2, fitted = fn(grid_values)
+        else:
+            outs = [
+                fn(grid_values[i : i + chunk])
+                for i in range(0, grid_values.shape[0], chunk)
+            ]
+            chi2 = jnp.concatenate([o[0] for o in outs])
+            fitted = jnp.concatenate([o[1] for o in outs])
     return np.asarray(chi2), np.asarray(fitted)
 
 

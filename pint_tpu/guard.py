@@ -314,11 +314,20 @@ def run_ladder(rungs, *, context):
                 last_good = sd.last_good
             telemetry.counter_add("guard.trips")
             telemetry.counter_add(f"guard.trip.{sd.kind}")
+            # ledger record: which rung failed, and how — joined to
+            # the active run by the emit-time tag, so `pinttrace
+            # --runs` shows the escalation path, not just the final
+            # serving rung
+            telemetry.emit({"type": "guard_trip", "context": context,
+                            "rung": name, "kind": sd.kind,
+                            "n_iter": sd.n_iter})
             if sd.kind == "input":
                 break
             continue
         if tried:  # a degraded rung is serving — count which
             telemetry.counter_add(f"guard.rung.{name}")
+            telemetry.emit({"type": "guard_rung", "context": context,
+                            "rung": name, "after": list(tried)})
         return result, name
     raise FitDivergedError(
         context,

@@ -362,8 +362,11 @@ class CommonProcess:
                 (pat, P(ax) if ax else None)
                 for pat, ax in self._GRID_RULES)
             args = _mesh.shard_args(mesh, rules, args)
-        with span("gw.common.lnlike_grid", n_pulsars=self.n_pulsars,
-                  n_points=n_pts, sharded=mesh is not None):
+        with telemetry.run_scope("lnlike_grid",
+                                 n_pulsars=self.n_pulsars,
+                                 n_points=n_pts), \
+            span("gw.common.lnlike_grid", n_pulsars=self.n_pulsars,
+                 n_points=n_pts, sharded=mesh is not None):
             out, _health = fn(*args.values())
         surf = np.asarray(out)[:n_pts].reshape(aa.shape)
         n_bad = int(np.count_nonzero(~np.isfinite(surf)))

@@ -1,0 +1,195 @@
+"""Live /metrics endpoint: the telemetry layer's scrape surface.
+
+A stdlib-``http.server`` background thread exporting every telemetry
+counter, numeric gauge, and :class:`~pint_tpu.telemetry.LogHistogram`
+in Prometheus text exposition format (0.0.4), plus the run ledger's
+in-flight/completed gauges — the surface the warm fitting service
+(ROADMAP item 2) sits behind, and the live view of a long grid or
+MCMC run that the JSONL sink only shows after the fact.
+
+Default **off**.  Activation:
+
+- ``PINT_TPU_METRICS_PORT=9464`` — started at first import of
+  :mod:`pint_tpu.telemetry` (``0``/``off`` disable; a failed bind
+  warns and never breaks imports).
+- programmatic: ``metrics_http.start(port=0)`` (0 = an ephemeral
+  port; the bound port is returned and exposed by :func:`port`).
+
+Binds ``127.0.0.1`` by default (``PINT_TPU_METRICS_HOST`` overrides —
+a scrape endpoint exposed beyond localhost is a deployment decision,
+not a default).  Every request renders a fresh snapshot under the
+telemetry locks, so concurrent fits can never tear a histogram's
+percentiles (telemetry.LogHistogram.percentiles reads its state
+once).  Paths:
+
+- ``GET /metrics`` — Prometheus text format.
+- ``GET /healthz`` — one JSON object: uptime, run-ledger summary,
+  compile stats.
+
+Metric naming: ``pint_tpu_`` + the telemetry name with every
+non-``[a-zA-Z0-9_]`` character mapped to ``_``; counters get the
+conventional ``_total`` suffix; histograms export as summaries
+(``{quantile="0.5|0.95|0.99"}`` + ``_sum`` + ``_count``).
+Non-numeric gauges (e.g. ``compile_cache.dir``) are skipped — a
+label-valued export can join later if a consumer needs it.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import re
+import threading
+import time
+
+from pint_tpu import telemetry
+
+__all__ = ["start", "stop", "port", "render_prometheus",
+           "PORT_ENV", "HOST_ENV"]
+
+PORT_ENV = "PINT_TPU_METRICS_PORT"
+HOST_ENV = "PINT_TPU_METRICS_HOST"
+
+_lock = threading.Lock()
+_server = None
+_thread = None
+_t_started = None
+
+
+def _metric_name(name, suffix=""):
+    return "pint_tpu_" + re.sub(r"[^a-zA-Z0-9_]", "_", str(name)) \
+        + suffix
+
+
+def _num(value):
+    """Prometheus sample value, or None for unexportable values."""
+    if isinstance(value, bool):
+        return "1" if value else "0"
+    if isinstance(value, (int, float)):
+        if isinstance(value, float) and math.isnan(value):
+            return "NaN"
+        return repr(float(value))
+    return None
+
+
+def render_prometheus() -> str:
+    """One snapshot of counters/gauges/histograms/run-ledger as
+    Prometheus text format.  Pure function of telemetry state (also
+    used by tests without a live server)."""
+    lines = []
+
+    def sample(name, value, mtype, suffix="", labels=""):
+        v = _num(value)
+        if v is None:
+            return
+        m = _metric_name(name, suffix)
+        lines.append(f"# TYPE {m} {mtype}")
+        lines.append(f"{m}{labels} {v}")
+
+    for name, value in sorted(telemetry.counters().items()):
+        sample(name, value, "counter", suffix="_total")
+    for name, value in sorted(telemetry.gauges().items()):
+        # histogram percentiles ride gauges() as flattened hist.*
+        # entries for the in-process readout; here they export as
+        # proper summaries below instead
+        if not name.startswith("hist."):
+            sample(name, value, "gauge")
+    for name, snap in sorted(telemetry.histograms().items()):
+        m = _metric_name("hist_" + name)
+        lines.append(f"# TYPE {m} summary")
+        for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+            v = _num(snap.get(key))
+            if v is not None:
+                lines.append(f'{m}{{quantile="{q}"}} {v}')
+        lines.append(f"{m}_sum {_num(snap.get('total', 0.0)) or 0}")
+        lines.append(f"{m}_count {int(snap.get('n', 0))}")
+    # run ledger: in_flight/completed already live in gauges/counters
+    # (runs.in_flight / runs.completed); add the scrape-time clock so
+    # a dashboard can rate() against wall time drift-free
+    sample("scrape_timestamp_seconds", time.time(), "gauge")
+    return "\n".join(lines) + "\n"
+
+
+def _healthz() -> str:
+    doc = {
+        "uptime_s": (round(time.time() - _t_started, 3)
+                     if _t_started else None),
+        "runs": telemetry.runs_summary(),
+        "compile": telemetry.compile_stats(),
+    }
+    return json.dumps(doc, separators=(",", ":"))
+
+
+def start(port=None, host=None):
+    """Start the background metrics server (idempotent: a live server
+    keeps its port).  port=None reads ``$PINT_TPU_METRICS_PORT``;
+    port=0 binds an ephemeral port.  Returns the bound port."""
+    global _server, _thread, _t_started
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    with _lock:
+        if _server is not None:
+            return _server.server_address[1]
+        if port is None:
+            raw = os.environ.get(PORT_ENV, "").strip()
+            try:
+                port = int(raw)
+            except ValueError:
+                raise ValueError(
+                    f"{PORT_ENV}={raw!r} is not a port number") from None
+        if host is None:
+            host = os.environ.get(HOST_ENV, "").strip() or "127.0.0.1"
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server API)
+                path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                if path in ("/", "/metrics"):
+                    body = render_prometheus().encode()
+                    ctype = ("text/plain; version=0.0.4; "
+                             "charset=utf-8")
+                elif path == "/healthz":
+                    body = _healthz().encode()
+                    ctype = "application/json"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # scrapes must not spam
+                pass
+
+        server = ThreadingHTTPServer((host, int(port)), _Handler)
+        server.daemon_threads = True
+        thread = threading.Thread(target=server.serve_forever,
+                                  name="pint-tpu-metrics",
+                                  daemon=True)
+        thread.start()
+        _server, _thread, _t_started = server, thread, time.time()
+        bound = server.server_address[1]
+        telemetry.gauge_set("metrics_http.port", bound)
+        return bound
+
+
+def stop():
+    """Shut the server down (tests / clean service teardown)."""
+    global _server, _thread, _t_started
+    with _lock:
+        server, thread = _server, _thread
+        _server = _thread = _t_started = None
+    if server is not None:
+        server.shutdown()
+        server.server_close()
+    if thread is not None:
+        thread.join(timeout=5)
+
+
+def port():
+    """The live server's bound port, or None when stopped."""
+    with _lock:
+        return _server.server_address[1] if _server is not None \
+            else None

@@ -548,9 +548,26 @@ class PTABatch:
             resid_fn(vec), sigma, chi2, dpar, cov, diag, valid=valid,
             inputs_ok=_guard.batch_input_finite(batch, valid))
 
+    @staticmethod
+    def _iterate_gn(body, vec0, maxiter, scan, trace):
+        """Drive one pulsar's fixed-count GN loop through
+        :func:`compile_cache.iterate_fixed` — the ONE place the three
+        batched fit kinds resolve the flight-recorder gate.  Returns
+        ``(vec, tr)`` with ``tr=None`` when the gate is off (the
+        gate-off trace is byte-identical to the ungated build)."""
+        init = (vec0, jnp.float64(0.0))
+        if trace:
+            (vec, _), tr = _cc.iterate_fixed(
+                body, init, maxiter, scan=scan,
+                trace_of=lambda p, n: _cc.gn_trace_record(
+                    p[0], n[0], n[1]))
+            return vec, tr
+        vec, _ = _cc.iterate_fixed(body, init, maxiter, scan=scan)
+        return vec, None
+
     def _fit_one(self, vec0, base_values, batch, ctx, tzr_batch,
                  tzr_ctx, valid, free_mask, guard_eps, maxiter,
-                 with_health, scan=True):
+                 with_health, scan=True, trace=False):
         merged = _merge_ctx(ctx, self.static_ctx)
         values0 = dict(base_values)
         for i, name in enumerate(self.free_names):
@@ -574,18 +591,19 @@ class PTABatch:
                 None, vec, err, rcond=guard_eps, rj=rj(vec))
             return (new_vec, chi2)
 
-        vec, _ = _cc.iterate_fixed(
-            body, (vec0, jnp.float64(0.0)), maxiter, scan=scan)
+        vec, tr = self._iterate_gn(body, vec0, maxiter, scan, trace)
         if not with_health:
             _, chi2, _, cov = wls_gn_solve(None, vec, err,
                                            rcond=guard_eps, rj=rj(vec))
-            return vec, chi2, cov, ()
+            out = (vec, chi2, cov, ())
+            return out + (tr,) if trace else out
         _, chi2, dpar, cov, diag = wls_gn_solve(
             None, vec, err, rcond=guard_eps, with_health=True,
             rj=rj(vec))
         health = self._step_health_one(resid_fn, vec, err, sigma, chi2,
                                        dpar, cov, diag, batch, valid)
-        return vec, chi2, cov, health
+        out = (vec, chi2, cov, health)
+        return out + (tr,) if trace else out
 
     def _gather_noise(self):
         """Static per-pulsar noise bases for the batched GLS path:
@@ -616,7 +634,7 @@ class PTABatch:
 
     def _fit_one_gls(self, vec0, base_values, batch, ctx, tzr_batch,
                      tzr_ctx, valid, free_mask, U, phi, guard_eps,
-                     maxiter, with_health, scan=True):
+                     maxiter, with_health, scan=True, trace=False):
         from pint_tpu.linalg import gls_normal_solve
 
         merged = _merge_ctx(ctx, self.static_ctx)
@@ -643,18 +661,19 @@ class PTABatch:
                 r, J, err, U, phi, guard_eps=guard_eps)
             return (vec + dpar, chi2)
 
-        vec, _ = _cc.iterate_fixed(
-            body, (vec0, jnp.float64(0.0)), maxiter, scan=scan)
+        vec, tr = self._iterate_gn(body, vec0, maxiter, scan, trace)
         r, J = rj(vec)
         if not with_health:
             _, cov, ncoef, chi2 = gls_normal_solve(
                 r, J, err, U, phi, guard_eps=guard_eps)
-            return vec, chi2, cov, ()
+            out = (vec, chi2, cov, ())
+            return out + (tr,) if trace else out
         dpar, cov, ncoef, chi2, diag = gls_normal_solve(
             r, J, err, U, phi, guard_eps=guard_eps, with_health=True)
         health = self._step_health_one(resid_fn, vec, err, sigma, chi2,
                                        dpar, cov, diag, batch, valid)
-        return vec, chi2, cov, health
+        out = (vec, chi2, cov, health)
+        return out + (tr,) if trace else out
 
     # -- wideband (stacked TOA + DM) path -------------------------------------
     def _gather_dm(self):
@@ -698,7 +717,7 @@ class PTABatch:
     def _fit_one_wb(self, vec0, base_values, batch, ctx, tzr_batch,
                     tzr_ctx, valid, free_mask, U, phi, dm_data,
                     dm_error, dm_valid, guard_eps, maxiter,
-                    with_health, scan=True):
+                    with_health, scan=True, trace=False):
         """One pulsar's wideband GLS fit: stacked [time; DM] residual
         with the correlated-noise basis acting on the time block only
         (zero rows under the DM block), same normal equations as
@@ -729,13 +748,13 @@ class PTABatch:
                 r, J, err, U_wb, phi, guard_eps=guard_eps)
             return (vec + dpar, chi2)
 
-        vec, _ = _cc.iterate_fixed(
-            body, (vec0, jnp.float64(0.0)), maxiter, scan=scan)
+        vec, tr = self._iterate_gn(body, vec0, maxiter, scan, trace)
         r, J = rj(vec)
         if not with_health:
             _, cov, _, chi2 = gls_normal_solve(
                 r, J, err, U_wb, phi, guard_eps=guard_eps)
-            return vec, chi2, cov, ()
+            out = (vec, chi2, cov, ())
+            return out + (tr,) if trace else out
         dpar, cov, _, chi2, diag = gls_normal_solve(
             r, J, err, U_wb, phi, guard_eps=guard_eps,
             with_health=True)
@@ -743,7 +762,8 @@ class PTABatch:
         health = _guard.step_health(
             r, err, chi2, dpar, cov, diag, valid=stacked_valid,
             inputs_ok=_guard.batch_input_finite(batch, valid))
-        return vec, chi2, cov, health
+        out = (vec, chi2, cov, health)
+        return out + (tr,) if trace else out
 
     # -- batched-fit construction (memoized; registry-shared) -----------------
     def _structure_key(self):
@@ -764,7 +784,8 @@ class PTABatch:
             ))
         return got
 
-    def _build_fit(self, kind, maxiter, with_health, scan=True):
+    def _build_fit(self, kind, maxiter, with_health, scan=True,
+                   trace=False):
         tzr_ax = 0 if self.tzr_batch is not None else None
         tcx_ax = 0 if self.tzr_ctx is not None else None
         # guard_eps is the LAST argument, broadcast over pulsars
@@ -774,7 +795,7 @@ class PTABatch:
             return jax.vmap(
                 lambda v, b, bt, c, tb, tc, m, fm, ge: self._fit_one(
                     v, b, bt, c, tb, tc, m, fm, ge, maxiter,
-                    with_health, scan=scan
+                    with_health, scan=scan, trace=trace
                 ),
                 in_axes=(0, 0, 0, 0, tzr_ax, tcx_ax, 0, 0, None),
             )
@@ -782,14 +803,15 @@ class PTABatch:
             return jax.vmap(
                 lambda v, b, bt, c, tb, tc, m, fm, uu, ph, ge:
                 self._fit_one_gls(v, b, bt, c, tb, tc, m, fm, uu, ph,
-                                  ge, maxiter, with_health, scan=scan),
+                                  ge, maxiter, with_health, scan=scan,
+                                  trace=trace),
                 in_axes=(0, 0, 0, 0, tzr_ax, tcx_ax, 0, 0, 0, 0, None),
             )
         return jax.vmap(
             lambda v, b, bt, c, tb, tc, m, fm, uu, ph, dd, de, dv, ge:
             self._fit_one_wb(v, b, bt, c, tb, tc, m, fm, uu, ph,
                              dd, de, dv, ge, maxiter, with_health,
-                             scan=scan),
+                             scan=scan, trace=trace),
             in_axes=(0, 0, 0, 0, tzr_ax, tcx_ax, 0, 0, 0, 0, 0, 0, 0,
                      None),
         )
@@ -797,7 +819,11 @@ class PTABatch:
     def _batched_fit_jit(self, kind, maxiter, mesh=None):
         """ONE jitted batched fit per (kind, maxiter, mesh, iteration
         style), memoized on the instance and shared across
-        same-structure batches through the process registry.  This
+        same-structure batches through the process registry.  Returns
+        ``(jitted_fit, iter_trace_flag)`` — the flag is resolved HERE
+        (it decides whether the program's outputs carry the 5th,
+        iteration-trace element) and threaded to the runner, so one
+        env read governs both build and unpack.  This
         replaces the old per-call ``jax.jit(lambda *a: fit(*a))`` — a
         fresh jitted callable (and a full retrace + XLA compile of the
         entire PTA program) on EVERY fit invocation.  The mesh
@@ -811,17 +837,21 @@ class PTABatch:
         different traced programs."""
         with_health = _guard.enabled()
         scan = _cc.scan_iters_default()
+        trace = _cc.iter_trace_default()
         mesh_key = _mesh.mesh_jit_key(mesh)
         cache = getattr(self, "_fit_jit_cache", None)
         if cache is None:
             cache = self._fit_jit_cache = {}
-        got = cache.get((kind, maxiter, with_health, scan, mesh_key))
+        got = cache.get((kind, maxiter, with_health, scan, trace,
+                         mesh_key))
         if got is None:
-            got = cache[(kind, maxiter, with_health, scan, mesh_key)] = \
+            got = cache[(kind, maxiter, with_health, scan, trace,
+                         mesh_key)] = \
                 _cc.shared_jit(
-                self._build_fit(kind, maxiter, with_health, scan=scan),
+                self._build_fit(kind, maxiter, with_health, scan=scan,
+                                trace=trace),
                 key=("pta.batched", kind, int(maxiter), with_health,
-                     scan, self._structure_key()) + mesh_key,
+                     scan, trace, self._structure_key()) + mesh_key,
                 fn_token="pta.batched_fit",
                 label=f"pta.batched_fit:{kind}"
                       + (":sharded" if mesh is not None else ""))
@@ -837,7 +867,7 @@ class PTABatch:
                 pass  # cost metadata only; never block the fit path
         else:
             telemetry.counter_add("pta.fit_jit_cache_hits")
-        return got
+        return got, trace
 
     def fit_wideband(self, maxiter=3, mesh=None, checkpoint=None):
         """Batched wideband fit: stacked [time; DM] residuals per
@@ -848,12 +878,14 @@ class PTABatch:
         while True:
             U, phi = self._gather_noise()
             dm_data, dm_error, dm_valid = self._gather_dm()
-            fit = self._batched_fit_jit("wideband", maxiter, mesh)
+            fit, iter_trace = self._batched_fit_jit("wideband",
+                                                    maxiter, mesh)
             out = self._run_batched(
                 fit, {**self._base_args(), "U": U, "phi": phi,
                       "dm_data": dm_data, "dm_error": dm_error,
                       "dm_valid": dm_valid},
-                mesh, checkpoint, n_lin=len(self._partition_wb[0]))
+                mesh, checkpoint, n_lin=len(self._partition_wb[0]),
+                iter_trace=iter_trace)
             if not self._kepler_depth_guard():
                 return out
 
@@ -865,10 +897,11 @@ class PTABatch:
         (gridutils.py:166-391).  Sharding semantics match fit_wls."""
         while True:
             U, phi = self._gather_noise()
-            fit = self._batched_fit_jit("gls", maxiter, mesh)
+            fit, iter_trace = self._batched_fit_jit("gls", maxiter,
+                                                    mesh)
             out = self._run_batched(
                 fit, {**self._base_args(), "U": U, "phi": phi},
-                mesh, checkpoint)
+                mesh, checkpoint, iter_trace=iter_trace)
             if not self._kepler_depth_guard():
                 return out
 
@@ -884,7 +917,7 @@ class PTABatch:
         }
 
     def _run_batched(self, fit, args, mesh, checkpoint=None,
-                     n_lin=None):
+                     n_lin=None, iter_trace=False):
         """Run the jitted batched fit (optionally mesh-sharded over the
         pulsar axis) and write fitted values back (only genuinely-free
         params).  args: the NAMED stacked-data dict (insertion order =
@@ -892,20 +925,33 @@ class PTABatch:
         count of the partition the traced step actually uses
         (structure-aware FLOP accounting — the wideband step follows
         _partition_wb, not _partition)."""
-        with span("pta.batched_fit", n_pulsars=self.n_pulsars,
-                  n_max=self.n_max, n_free=len(self.free_names),
-                  sharded=mesh is not None,
-                  mesh=_mesh.mesh_desc(mesh)):
+        with telemetry.run_scope(
+                "pta.fit", n_pulsars=self.n_pulsars,
+                n_max=self.n_max, sharded=mesh is not None), \
+            span("pta.batched_fit", n_pulsars=self.n_pulsars,
+                 n_max=self.n_max, n_free=len(self.free_names),
+                 sharded=mesh is not None,
+                 mesh=_mesh.mesh_desc(mesh)):
             return self._run_batched_inner(fit, args, mesh, checkpoint,
-                                           n_lin=n_lin)
+                                           n_lin=n_lin,
+                                           iter_trace=iter_trace)
 
     #: batched-path ladder: same escalation table as the
     #: single-pulsar fitters
     _guard_jitter_rungs = _guard.JITTER_RUNGS
 
     def _run_batched_inner(self, fit, args, mesh, checkpoint=None,
-                           n_lin=None):
+                           n_lin=None, iter_trace=False):
         n_real = self.n_pulsars
+        # iter_trace is the flag _batched_fit_jit resolved when it
+        # BUILT the program — one env read governs whether the
+        # outputs carry the 5th (iteration trace) element, so a gate
+        # flip between build and unpack cannot desynchronize them
+
+        def split(out):
+            if iter_trace:
+                return out
+            return out + (None,)
         if mesh is not None:
             # pad the PULSAR axis to a device multiple (the TOA axis
             # is already padded per pulsar): phantom members are edge
@@ -931,10 +977,13 @@ class PTABatch:
                 raw_fit = fit
 
                 def fit(*a):
-                    vec, chi2, cov, health = raw_fit(*a)
-                    return (vec[:n_real], chi2[:n_real], cov[:n_real],
-                            jax.tree.map(lambda x: x[:n_real], health))
-        vec, chi2, cov, health = fit(*args.values(), jnp.float64(0.0))
+                    # slice every output's leading (pulsar) axis back
+                    # to the real members — vec/chi2/cov, the health
+                    # pytree, and (gate on) the iteration trace alike
+                    return jax.tree.map(lambda x: x[:n_real],
+                                        raw_fit(*a))
+        vec, chi2, cov, health, tr = split(
+            fit(*args.values(), jnp.float64(0.0)))
         telemetry.counter_add("guard.checks")
         bad = _guard.batch_bad(health)
         rung = "baseline"
@@ -952,23 +1001,30 @@ class PTABatch:
             for name, eps in self._guard_jitter_rungs:
                 if not fixable.any():
                     break
-                v2, c2, k2, h2 = fit(*args.values(),
-                                     jnp.float64(eps))
+                v2, c2, k2, h2, t2 = split(fit(*args.values(),
+                                               jnp.float64(eps)))
                 fixed = fixable & ~_guard.batch_bad(h2)
                 if fixed.any():
                     telemetry.counter_add(f"guard.rung.{name}",
                                           float(fixed.sum()))
                     m = jnp.asarray(fixed)
+
+                    def merge(old, new):
+                        # broadcast the per-pulsar mask over each
+                        # leaf's trailing axes
+                        return jnp.where(
+                            m.reshape(m.shape + (1,) * (old.ndim - 1)),
+                            new, old)
+
                     vec = jnp.where(m[:, None], v2, vec)
                     chi2 = jnp.where(m, c2, chi2)
                     cov = jnp.where(m[:, None, None], k2, cov)
-                    # fit_health must describe the SERVED results —
-                    # merge the recovered pulsars' health records too
-                    health = jax.tree.map(
-                        lambda old, new: jnp.where(
-                            m.reshape(m.shape + (1,) * (old.ndim - 1)),
-                            new, old),
-                        health, h2)
+                    # fit_health (and the iteration trace) must
+                    # describe the SERVED results — merge the
+                    # recovered pulsars' records too
+                    health = jax.tree.map(merge, health, h2)
+                    if tr is not None:
+                        tr = jax.tree.map(merge, tr, t2)
                     rung = name
                     for i in np.flatnonzero(fixed):
                         rung_of[int(i)] = name
@@ -992,6 +1048,22 @@ class PTABatch:
                     p.model.values[name] = float(vec_np[k, i])
         self.fit_rung = rung
         self.fit_health = _guard.to_record(health)
+        telemetry.emit({"type": "health", "context": "PTABatch",
+                        "rung": rung, **self.fit_health})
+        # flight recorder: keep the stacked (n_pulsars, maxiter)
+        # device trace for callers; decode (one sync) only when a
+        # sink wants the record
+        self.last_iter_trace = tr
+        if tr is not None and telemetry.sink_active():
+            # a per-member merge has no single honest rung label:
+            # "mixed" + the per-member rungs map beats stamping 49
+            # baseline-served pulsars with one member's escalation
+            telemetry.emit(telemetry.iter_trace_record(
+                "pta.batched_fit",
+                _cc.decode_gn_trace(
+                    tr, rung="mixed" if rung_of else rung),
+                kind="pta", n_pulsars=self.n_pulsars,
+                rungs={str(k): v for k, v in rung_of.items()} or None))
         # the loudness contract of fitter._record_guard, per pulsar: a
         # rung-served member's exported par file must carry the
         # degradation flag (and the batch warns); a cleanly-served
@@ -1118,9 +1190,11 @@ class PTABatch:
         after the fit (guard.save_checkpoint), validated on restore
         against this batch's structure fingerprint."""
         while True:
-            fit = self._batched_fit_jit("wls", maxiter, mesh)
+            fit, iter_trace = self._batched_fit_jit("wls", maxiter,
+                                                    mesh)
             out = self._run_batched(fit, self._base_args(), mesh,
-                                    checkpoint)
+                                    checkpoint,
+                                    iter_trace=iter_trace)
             if not self._kepler_depth_guard():
                 return out
 

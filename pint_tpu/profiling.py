@@ -112,12 +112,18 @@ class ProgramStats:
     __slots__ = ("label", "key_hash", "calls", "compiles", "arg_bytes",
                  "result_bytes", "trace_s", "dispatch_s", "device_s",
                  "hist", "analytic_flops", "xla_flops", "xla_bytes",
-                 "cost_checked", "mesh")
+                 "cost_checked", "mesh", "runs")
+
+    #: recent run ids attributed to this program (ledger join); small
+    #: and ordered-unique — a warm service touches each program from
+    #: many runs, and the record only needs the recent tail
+    _RUNS_CAP = 8
 
     def __init__(self, label, key_hash):
         self.label = label
         self.key_hash = key_hash
         self.mesh = None           # parallel.mesh.mesh_desc record
+        self.runs: list = []       # recent run ids (most recent last)
         self.calls = 0
         self.compiles = 0          # calls during which a compile ticked
         self.arg_bytes = 0
@@ -150,7 +156,14 @@ class ProgramStats:
             "xla_flops": self.xla_flops,
             "xla_bytes": self.xla_bytes,
             "mesh": self.mesh,
+            "runs": list(self.runs),
         }
+
+    def note_run(self, run_id):
+        if run_id in self.runs:
+            return
+        self.runs.append(run_id)
+        del self.runs[:-self._RUNS_CAP]
 
 
 #: program id -> ProgramStats, LRU order.  Bounded by the same
@@ -254,6 +267,7 @@ def _profiled_call(jitted, st, args, kwargs):
     dispatch_s = max(call_wall - trace_s, 0.0)
     device_s = t2 - t1
     compiled = telemetry.counter_get("jit.compile_events") - e0 > 0
+    run_id = telemetry.current_run_id()
     with _lock:
         st.calls += 1
         if compiled:
@@ -264,10 +278,15 @@ def _profiled_call(jitted, st, args, kwargs):
         st.hist.record(device_s)
         st.arg_bytes += _tree_bytes(args) + _tree_bytes(kwargs)
         st.result_bytes += _tree_bytes(out)
+        if run_id is not None:
+            st.note_run(run_id)
     telemetry.counter_add("profile.calls")
     telemetry.counter_add("profile.trace_s", trace_s)
     telemetry.counter_add("profile.dispatch_s", dispatch_s)
     telemetry.counter_add("profile.device_s", device_s)
+    # the active run accumulates its own phase split (the ledger's
+    # per-fit trace/dispatch/device attribution)
+    telemetry.run_note_phase(trace_s, dispatch_s, device_s)
     # mirrored into the shared histogram surface so percentiles read
     # out through telemetry.gauges() even with spans disabled
     telemetry.hist_record(f"program.{st.label}.device_s", device_s)
@@ -345,6 +364,11 @@ class _ProfiledProgram:
     def __call__(self, *args, **kwargs):
         if self._aot_specs is None and not kwargs:
             self._record_spec(args)
+        # ledger: attribute this dispatch to the active run (one
+        # thread-local read when no run is live — gate-independent,
+        # so `pinttrace --runs` lists a run's programs even with
+        # profiling off)
+        telemetry.run_note_program(self._stats.label)
         if not enabled():
             return self._jitted(*args, **kwargs)
         return _profiled_call(self._jitted, self._stats, args, kwargs)

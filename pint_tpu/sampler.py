@@ -170,23 +170,31 @@ def run_mcmc(lnpost, x0, nsteps, key=None, a=2.0, thin=1, jit_key=None,
         fn_token=jit_key if jit_key is not None else lnpost)
     runner.set_mesh(_mesh.mesh_desc(mesh))
     keys = jax.random.split(key, nsteps)
-    (xf, lnpf), (chain, lnps, accs), (pos_ok, lnp_ok) = runner(x0, keys)
-    # the health tuple always rides the program (two trailing
-    # reductions; keeping it out of the key), but the host-side raise
-    # honors the guard gate — PINT_TPU_GUARD=0 restores raw semantics
-    if _guard.enabled():
-        telemetry.counter_add("guard.checks")
-        if not (bool(pos_ok) and bool(lnp_ok)):
-            telemetry.counter_add("guard.trips")
-            telemetry.counter_add("guard.trip.sampler")
-            raise _guard.FitDivergedError(
-                "sampler.run_mcmc",
-                health={"positions_finite": bool(pos_ok),
-                        "any_finite_lnp": bool(lnp_ok)},
-                last_good=np.asarray(x0),
-                detail="chain diverged (non-finite walker positions "
-                       "or every walker at lnp=-inf); .last_good "
-                       "carries the initial ensemble state")
+    # run-ledger scope: a chunked autocorr run opens the outer scope
+    # (run_mcmc_autocorr), so its chunks all join one run id
+    with telemetry.run_scope("mcmc", nwalkers=nw,
+                             nsteps=int(nsteps)):
+        (xf, lnpf), (chain, lnps, accs), (pos_ok, lnp_ok) = \
+            runner(x0, keys)
+        # the health tuple always rides the program (two trailing
+        # reductions; keeping it out of the key), but the host-side
+        # raise honors the guard gate — PINT_TPU_GUARD=0 restores raw
+        # semantics.  Inside the run scope so a diverged chain's run
+        # record carries the FitDivergedError status.
+        if _guard.enabled():
+            telemetry.counter_add("guard.checks")
+            if not (bool(pos_ok) and bool(lnp_ok)):
+                telemetry.counter_add("guard.trips")
+                telemetry.counter_add("guard.trip.sampler")
+                raise _guard.FitDivergedError(
+                    "sampler.run_mcmc",
+                    health={"positions_finite": bool(pos_ok),
+                            "any_finite_lnp": bool(lnp_ok)},
+                    last_good=np.asarray(x0),
+                    detail="chain diverged (non-finite walker "
+                           "positions or every walker at lnp=-inf); "
+                           ".last_good carries the initial ensemble "
+                           "state")
     if thin > 1:
         chain = chain[::thin]
         lnps = lnps[::thin]
@@ -278,38 +286,43 @@ class EnsembleSampler:
                 total = int(arrays["total"][()])
                 x = jnp.asarray(arrays["chain"][-1])
                 self.key = jnp.asarray(arrays["key"])
-        while total < maxsteps:
-            step = int(min(chunk, maxsteps - total))
-            self.key, sub = jax.random.split(self.key)
-            chain, lnprob, acc = run_mcmc(self.lnpost, x, step, key=sub,
-                                          jit_key=self.jit_key,
-                                          mesh=self.mesh)
-            chains.append(np.asarray(chain))
-            lnprobs.append(np.asarray(lnprob))
-            accs.append((float(np.mean(np.asarray(acc))), step))
-            x = chain[-1]
-            total += step
-            full = np.concatenate(chains, axis=0)
-            if checkpoint is not None:
-                _guard.save_checkpoint(
-                    checkpoint,
-                    {"chain": full,
-                     "lnprob": np.concatenate(lnprobs, axis=0),
-                     "accs": np.asarray(accs, dtype=np.float64),
-                     "total": np.int64(total),
-                     "key": np.asarray(self.key)},
-                    fingerprint=fp,
-                    meta={"maxsteps": int(maxsteps)})
-                _faults.maybe_kill("sampler.chunk")
-            tau = integrated_autocorr_time(full)
-            if (np.all(np.isfinite(tau))
-                    and total > tau_factor * np.max(tau)
-                    and tau_prev is not None
-                    and np.all(np.abs(tau - tau_prev)
-                               < rtol * np.maximum(tau, 1e-12))):
-                converged = True
-                break
-            tau_prev = tau
+        # the outer ledger scope: every chunk's run_mcmc joins ONE
+        # run id instead of minting one per chunk
+        run = telemetry.run_scope("mcmc", chunked=True,
+                                  maxsteps=int(maxsteps))
+        with run:
+            while total < maxsteps:
+                step = int(min(chunk, maxsteps - total))
+                self.key, sub = jax.random.split(self.key)
+                chain, lnprob, acc = run_mcmc(
+                    self.lnpost, x, step, key=sub,
+                    jit_key=self.jit_key, mesh=self.mesh)
+                chains.append(np.asarray(chain))
+                lnprobs.append(np.asarray(lnprob))
+                accs.append((float(np.mean(np.asarray(acc))), step))
+                x = chain[-1]
+                total += step
+                full = np.concatenate(chains, axis=0)
+                if checkpoint is not None:
+                    _guard.save_checkpoint(
+                        checkpoint,
+                        {"chain": full,
+                         "lnprob": np.concatenate(lnprobs, axis=0),
+                         "accs": np.asarray(accs, dtype=np.float64),
+                         "total": np.int64(total),
+                         "key": np.asarray(self.key)},
+                        fingerprint=fp,
+                        meta={"maxsteps": int(maxsteps)})
+                    _faults.maybe_kill("sampler.chunk")
+                tau = integrated_autocorr_time(full)
+                if (np.all(np.isfinite(tau))
+                        and total > tau_factor * np.max(tau)
+                        and tau_prev is not None
+                        and np.all(np.abs(tau - tau_prev)
+                                   < rtol * np.maximum(tau, 1e-12))):
+                    converged = True
+                    break
+                tau_prev = tau
         if not np.all(np.isfinite(tau)) and chains:
             # resumed at total >= maxsteps: the loop never ran, so tau
             # is still its placeholder — measure it from the restored

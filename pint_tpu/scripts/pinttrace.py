@@ -1,6 +1,6 @@
 """Summarize, export, and gate on pint_tpu telemetry/bench records.
 
-Four modes:
+Six modes:
 
 - ``pinttrace trace.jsonl`` — aggregate the records written by
   :mod:`pint_tpu.telemetry` (``PINT_TPU_TRACE=trace.jsonl``): spans by
@@ -20,16 +20,26 @@ Four modes:
   streaks (``--streak``) and metrics that vanished from the latest
   round, and exits nonzero on any flag so CI and the bench parent can
   gate on it.
+- ``pinttrace --runs trace.jsonl`` — the run ledger: every record
+  tagged with a ``run_id`` (spans, iteration traces, guard
+  health/rung records, AOT events, bench metric rows) joined per run,
+  one row per fit/grid/MCMC/bench entry with its duration, status,
+  compile/AOT deltas, programs, serving rung, and record-type census.
+- ``pinttrace --convergence RUN_ID trace.jsonl`` — the flight
+  recorder's per-iteration chi^2 / step-norm / guard-eps table for
+  one run's ``iter_trace`` records (omit RUN_ID for all of them).
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 __all__ = ["summarize", "chrome_trace", "programs_table",
-           "check_regression", "main"]
+           "check_regression", "runs_table", "convergence_table",
+           "main"]
 
 
 def _load(path):
@@ -76,7 +86,9 @@ def aggregate(records):
             name = rec.get("name", "?")
             for k in ("p50", "p95", "p99", "n"):
                 gauges[f"hist.{name}.{k}"] = rec.get(k)
-        elif kind in ("program", "sink_rotation", "flops_mismatch"):
+        elif kind in ("program", "sink_rotation", "flops_mismatch",
+                      "run", "iter_trace", "health", "aot",
+                      "guard_trip", "guard_rung", "aot_demotion"):
             other += 1  # aggregated by their dedicated consumers
         elif kind == "metric" or "metric" in rec:
             metrics.append(rec)
@@ -190,6 +202,136 @@ def programs_table(records):
     from pint_tpu.profiling import table_lines
 
     return table_lines(list(progs.values()))
+
+
+# --------------------------------------------------------------------------
+# --runs / --convergence: the run ledger
+# --------------------------------------------------------------------------
+
+def join_runs(records) -> dict:
+    """Group every run-tagged record by ``run_id`` — the ONE join both
+    ``--runs`` and the datacheck smoke read.  Returns run_id ->
+    {"types": {type: count}, "run": <run record or None>, "rung",
+    "n_iter", "programs", "metrics", "spans"} in first-seen order."""
+    runs: dict = {}
+    for rec in records:
+        rid = rec.get("run")
+        if rid is None:
+            continue
+        info = runs.setdefault(rid, {
+            "types": {}, "run": None, "rung": None, "n_iter": 0,
+            "programs": [], "metrics": [], "spans": 0,
+        })
+        kind = rec.get("type") or ("metric" if "metric" in rec
+                                   else "?")
+        info["types"][kind] = info["types"].get(kind, 0) + 1
+        if kind == "run":
+            info["run"] = rec
+            for p in rec.get("programs", ()):
+                if p not in info["programs"]:
+                    info["programs"].append(p)
+        elif kind == "span":
+            info["spans"] += 1
+        elif kind == "iter_trace":
+            info["n_iter"] += int(rec.get("n_iter", 0))
+        elif kind == "health":
+            info["rung"] = rec.get("rung")
+        elif kind == "metric":
+            info["metrics"].append(rec.get("metric"))
+    return runs
+
+
+def runs_table(records):
+    """Table lines for ``--runs``: one row per run id, joined over
+    every record type that carried the tag, plus a detail line naming
+    the programs/compile deltas/fingerprint so one fit reconstructs
+    end to end."""
+    runs = join_runs(records)
+    if not runs:
+        return ["(no run-tagged records — run with a PINT_TPU_TRACE "
+                "sink on a pint_tpu >= PR 10 build)"]
+    lines = [
+        f"{'RUN':<18s} {'KIND':<12s} {'DUR_S':>8s} {'STATUS':<8s} "
+        f"{'RUNG':<10s} {'ITERS':>5s} {'SPANS':>5s} RECORD_TYPES"
+    ]
+    for rid, info in runs.items():
+        run = info["run"] or {}
+        types = ",".join(f"{k}:{v}"
+                         for k, v in sorted(info["types"].items()))
+        dur = run.get("dur_s")
+        lines.append(
+            f"{rid:<18s} {str(run.get('kind', '?')):<12s} "
+            f"{(f'{dur:.3f}' if dur is not None else '-'):>8s} "
+            f"{str(run.get('status', '?')):<8s} "
+            f"{str(info['rung'] or '-'):<10s} "
+            f"{info['n_iter']:>5d} {info['spans']:>5d} {types}")
+        details = []
+        attrs = run.get("attrs") or {}
+        if attrs.get("fingerprint"):
+            details.append(f"fingerprint={attrs['fingerprint']}")
+        if run.get("compile"):
+            details.append("compile=" + ",".join(
+                f"{k}:{int(v)}" for k, v in
+                sorted(run["compile"].items())))
+        if run.get("phase_s"):
+            ph = run["phase_s"]
+            details.append(
+                "phase_s=trace:%.3f,dispatch:%.3f,device:%.3f"
+                % (ph.get("trace_s", 0), ph.get("dispatch_s", 0),
+                   ph.get("device_s", 0)))
+        if info["programs"]:
+            details.append("programs=" + ",".join(info["programs"]))
+        if info["metrics"]:
+            details.append("metrics=" + ",".join(
+                str(m) for m in info["metrics"]))
+        if details:
+            lines.append("  " + " ".join(details))
+    return lines
+
+
+def convergence_table(records, run_id=None):
+    """Table lines for ``--convergence``: each ``iter_trace`` record
+    (optionally restricted to one run) rendered as a per-iteration
+    chi^2 / step-norm / max-|dparam| / guard-eps / ok / rung table —
+    batched (grid/PTA) records carry their cross-batch reductions
+    (median chi^2, max norms, bad-member count)."""
+    recs = [r for r in records if r.get("type") == "iter_trace"
+            and (not run_id or r.get("run") == run_id)]
+    if not recs:
+        where = f" for run {run_id}" if run_id else ""
+        return [f"(no iteration-trace records{where} — set "
+                "PINT_TPU_ITER_TRACE=1 and a PINT_TPU_TRACE sink)"]
+    lines = []
+    for rec in recs:
+        head = (f"{rec.get('program', '?')} (kind={rec.get('kind')}"
+                + (f", run={rec['run']}" if rec.get("run") else ""))
+        for k in ("n_points", "n_pulsars", "n_toa"):
+            if rec.get(k) is not None:
+                head += f", {k}={rec[k]}"
+        lines.append(head + ")")
+        batched = any("n_bad" in e for e in rec.get("iters", ()))
+        hdr = (f"  {'ITER':>4s} {'CHI2':>14s} {'STEP_NORM':>11s} "
+               f"{'MAX_DPAR':>11s} {'GUARD_EPS':>9s} {'OK':>3s} "
+               f"{'RUNG':<11s}")
+        if batched:
+            hdr += f" {'N_BAD':>5s} {'CHI2_MIN':>12s} {'CHI2_MAX':>12s}"
+        lines.append(hdr)
+        for e in rec.get("iters", ()):
+            row = (f"  {e.get('i', '?'):>4} {e.get('chi2', 0):>14.6g} "
+                   f"{e.get('step_norm', 0):>11.4g} "
+                   f"{e.get('max_dpar', 0):>11.4g} "
+                   f"{e.get('guard_eps', 0):>9.2g} "
+                   f"{('yes' if e.get('ok') else 'NO'):>3s} "
+                   f"{str(e.get('rung', '-')):<11s}")
+            if batched:
+                row += (f" {e.get('n_bad', 0):>5d} "
+                        f"{e.get('chi2_min', float('nan')):>12.6g} "
+                        f"{e.get('chi2_max', float('nan')):>12.6g}")
+            lines.append(row)
+        if rec.get("rungs"):
+            lines.append("  per-member rungs: " + ", ".join(
+                f"{k}->{v}" for k, v in sorted(rec["rungs"].items())))
+    return lines
 
 
 # --------------------------------------------------------------------------
@@ -449,6 +591,16 @@ def regression_verdict(paths=None):
 # CLI
 # --------------------------------------------------------------------------
 
+def _print_lines(lines):
+    """Print table lines, treating a consumer-closed pipe
+    (``| head``) as a clean exit rather than an error."""
+    try:
+        for line in lines:
+            print(line)
+    except BrokenPipeError:
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         prog="pinttrace",
@@ -468,6 +620,15 @@ def main(argv=None):
     p.add_argument("--programs", action="store_true",
                    help="print the per-program profiling registry "
                         "table from the trace's program records")
+    p.add_argument("--runs", action="store_true",
+                   help="print the run ledger: every record type "
+                        "joined per run_id (fits, grids, MCMC, bench "
+                        "metrics)")
+    p.add_argument("--convergence", nargs="?", const="",
+                   metavar="RUN_ID",
+                   help="render the per-iteration convergence table "
+                        "from iter_trace records (optionally one "
+                        "run's)")
     p.add_argument("--check-regression", action="store_true",
                    help="perf-regression sentinel over bench rounds: "
                         "exits 1 on regression/fallback-streak/"
@@ -479,6 +640,15 @@ def main(argv=None):
                    help="trailing fallback/failed rounds that flag a "
                         "streak (default 2)")
     args = p.parse_args(argv)
+
+    # `pinttrace --convergence trace.jsonl` (RUN_ID omitted): argparse
+    # hands the trace path to the nargs='?' option and leaves the
+    # positional empty — reinterpret an existing-file "RUN_ID" as the
+    # path so both documented argument orders work
+    if args.convergence and not args.paths \
+            and os.path.exists(args.convergence):
+        args.paths = [args.convergence]
+        args.convergence = ""
 
     if args.check_regression:
         paths = args.paths
@@ -511,13 +681,12 @@ def main(argv=None):
         print(f"pinttrace: wrote {len(doc['traceEvents'])} trace "
               f"events to {args.chrome_trace}")
     elif args.programs:
-        try:
-            for line in programs_table(records):
-                print(line)
-        except BrokenPipeError:
-            import os
-
-            os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        _print_lines(programs_table(records))
+    elif args.runs:
+        _print_lines(runs_table(records))
+    elif args.convergence is not None:
+        _print_lines(convergence_table(records,
+                                          args.convergence or None))
     elif args.json:
         spans, counters, gauges, metrics, other = aggregate(records)
         print(json.dumps({
@@ -529,13 +698,7 @@ def main(argv=None):
             "metrics": metrics, "n_other": other,
         }))
     else:
-        try:
-            for line in summarize(records):
-                print(line)
-        except BrokenPipeError:  # | head closed the pipe: not an error
-            import os
-
-            os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        _print_lines(summarize(records))
     if n_bad:
         print(f"WARNING: {n_bad} unparseable line(s) skipped",
               file=sys.stderr)

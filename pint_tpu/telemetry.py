@@ -44,12 +44,15 @@ import threading
 import time
 
 __all__ = [
-    "span", "configure", "enabled", "emit", "flush",
+    "span", "configure", "enabled", "emit", "flush", "sink_active",
+    "sink_info",
     "counter_add", "counter_get", "counters", "gauge_set", "gauges",
     "LogHistogram", "hist_record", "histograms",
     "add_span_hook", "add_flush_hook",
     "record_transfer", "compile_stats", "summary", "summary_lines",
     "render_stats_lines", "reset", "xprof_trace",
+    "run_scope", "current_run_id", "new_run_id", "run_note_program",
+    "run_note_phase", "runs_summary", "iter_trace_record",
 ]
 
 _TRACE_ENV = "PINT_TPU_TRACE"
@@ -235,6 +238,7 @@ def reset():
         _state.hists.clear()
         _state.t_session = time.time()
         _tls.stack = []
+        _recent_runs.clear()
 
 
 # --------------------------------------------------------------------------
@@ -405,32 +409,55 @@ class LogHistogram:
         self.vmin = v if self.vmin is None else min(self.vmin, v)
         self.vmax = v if self.vmax is None else max(self.vmax, v)
 
+    def _estimate(self, idx, vmin, vmax):
+        if idx == 0:
+            est = self.base
+        else:  # geometric midpoint of bucket idx
+            est = self.base * _math.exp((idx - 0.5) * self._log_growth)
+        return min(max(est, vmin), vmax)
+
+    def percentiles(self, qs) -> dict:
+        """Value estimates at each percentile in ``qs`` (0-100), all
+        computed from ONE copy of the bucket table and one (n, vmin,
+        vmax) read — so the returned set is mutually consistent
+        (p50 <= p95 <= p99 always) even when a concurrent ``record``
+        or ``reset`` lands between the individual reads.  Snapshot
+        paths that flush mid-fit depend on this: the old
+        one-percentile-at-a-time readout could pair a pre-reset p50
+        with a post-reset p99."""
+        n, vmin, vmax = self.n, self.vmin, self.vmax
+        if n == 0 or vmin is None:
+            return {q: None for q in qs}
+        items = sorted(self.counts.items())
+        out = {}
+        for q in sorted(qs):
+            rank = max(1, _math.ceil(q / 100.0 * n))
+            cum = 0
+            est = vmax  # fallback if counts mutated under us
+            for idx, c in items:
+                cum += c
+                if cum >= rank:
+                    est = self._estimate(idx, vmin, vmax)
+                    break
+            out[q] = est
+        return out
+
     def percentile(self, q):
-        """Value estimate at percentile ``q`` (0-100); None if empty."""
-        if self.n == 0:
-            return None
-        rank = max(1, _math.ceil(q / 100.0 * self.n))
-        cum = 0
-        for idx in sorted(self.counts):
-            cum += self.counts[idx]
-            if cum >= rank:
-                if idx == 0:
-                    est = self.base
-                else:  # geometric midpoint of bucket idx
-                    est = self.base * _math.exp(
-                        (idx - 0.5) * self._log_growth)
-                return min(max(est, self.vmin), self.vmax)
-        return self.vmax  # unreachable (cum ends at n >= rank)
+        """Value estimate at percentile ``q`` (0-100); None if empty.
+        For several percentiles of one histogram use
+        :meth:`percentiles` — it reads the state once."""
+        return self.percentiles((q,))[q]
 
     def snapshot(self) -> dict:
+        ps = self.percentiles((50, 95, 99))
         return {
             "n": self.n,
             "total": self.total,
             "min": self.vmin,
             "max": self.vmax,
-            "p50": self.percentile(50),
-            "p95": self.percentile(95),
-            "p99": self.percentile(99),
+            "p50": ps[50],
+            "p95": ps[95],
+            "p99": ps[99],
         }
 
 
@@ -464,6 +491,241 @@ def record_transfer(arr, direction="d2h"):
 
 
 # --------------------------------------------------------------------------
+# run ledger
+# --------------------------------------------------------------------------
+#
+# Six record types flow through the sink (spans, counters, programs,
+# health, AOT, metrics) with nothing joining them per fit.  A *run* is
+# one top-level library operation — a fit, a grid, a likelihood
+# surface, an MCMC chain, a bench metric — identified by a
+# process-unique ``run_id`` minted at the entry point.  Every record
+# emitted while a run is active is tagged with it automatically
+# (:func:`emit`), so ``pinttrace --runs`` can reconstruct one fit end
+# to end: inputs fingerprint -> compile/AOT events -> phase split ->
+# per-iteration convergence -> final rung/health.
+
+#: process-unique id prefix: pid + import-time microseconds, so two
+#: concurrent processes writing one trace file can never collide
+_RUN_PREFIX = f"{os.getpid():x}{int(time.time() * 1e6) & 0xFFFFF:05x}"
+_run_seq = 0
+_runs_in_flight = 0
+
+#: recently completed run summaries (the ledger's in-memory tail —
+#: datacheck and the /metrics endpoint read it); bounded
+_RECENT_RUNS_CAP = 64
+_recent_runs: list = []
+
+#: counters whose per-run delta the run record reports (the
+#: compile/AOT half of the ledger join; names -> record field)
+_RUN_COMPILE_COUNTERS = (
+    ("jit.backend_compile_events", "backend_compiles"),
+    ("jit.persistent_cache_hits", "cache_hits"),
+    ("jit.aot_import_hits", "aot_hits"),
+    ("jit.aot_served_calls", "aot_served"),
+    ("compile_cache.registry_misses", "registry_misses"),
+    ("compile_cache.registry_hits", "registry_hits"),
+)
+
+#: cumulative / process-global record types that must NOT be
+#: attributed to whatever run happens to be active at flush time
+_RUN_UNTAGGED_TYPES = frozenset((
+    "counter", "gauge", "hist", "program", "sink_rotation",
+    "sink_rotation_failed",
+))
+
+
+class _Run:
+    """One live run: identity plus the joinable state accumulated by
+    the note hooks (programs dispatched, profiled phase split)."""
+
+    __slots__ = ("run_id", "kind", "attrs", "t0", "wall0", "programs",
+                 "_progset", "compile0", "phase")
+
+    _PROGRAMS_CAP = 32
+
+    def __init__(self, run_id, kind, attrs):
+        self.run_id = run_id
+        self.kind = kind
+        self.attrs = attrs
+        self.t0 = time.perf_counter()
+        self.wall0 = time.time()
+        self.programs: list = []
+        self._progset: set = set()
+        self.compile0 = {name: counter_get(name)
+                         for name, _ in _RUN_COMPILE_COUNTERS}
+        self.phase = None  # {"trace_s","dispatch_s","device_s"} or None
+
+    def note_program(self, label):
+        if label not in self._progset \
+                and len(self.programs) < self._PROGRAMS_CAP:
+            self._progset.add(label)
+            self.programs.append(label)
+
+    def note_phase(self, trace_s, dispatch_s, device_s):
+        if self.phase is None:
+            self.phase = {"trace_s": 0.0, "dispatch_s": 0.0,
+                          "device_s": 0.0}
+        self.phase["trace_s"] += trace_s
+        self.phase["dispatch_s"] += dispatch_s
+        self.phase["device_s"] += device_s
+
+
+def new_run_id() -> str:
+    """Mint a process-unique run id (``r<pid+epoch hex>-<seq>``)."""
+    global _run_seq
+    with _lock:
+        _run_seq += 1
+        return f"r{_RUN_PREFIX}-{_run_seq:04d}"
+
+
+def _run_stack():
+    stack = getattr(_tls, "runs", None)
+    if stack is None:
+        stack = _tls.runs = []
+    return stack
+
+
+def current_run_id():
+    """The active run's id (this thread), or None outside any run."""
+    stack = getattr(_tls, "runs", None)
+    return stack[-1].run_id if stack else None
+
+
+def run_note_program(label):
+    """Attach a dispatched program label to the active run (no-op
+    outside a run).  Called by the profiling proxy on every shared-jit
+    dispatch — one thread-local read when no run is active."""
+    stack = getattr(_tls, "runs", None)
+    if stack:
+        stack[-1].note_program(label)
+
+
+def run_note_phase(trace_s, dispatch_s, device_s):
+    """Accumulate a profiled call's phase split into the active run
+    (no-op outside a run / with profiling off)."""
+    stack = getattr(_tls, "runs", None)
+    if stack:
+        stack[-1].note_phase(trace_s, dispatch_s, device_s)
+
+
+class _RunScope:
+    """Context manager for one run.  Nested entry points JOIN the
+    active run instead of minting a new id (a fit inside a bench
+    metric, a chunked grid inside grid_chisq_vectorized): only the
+    outermost scope owns the id, emits the run record, and moves the
+    in-flight/completed ledger gauges."""
+
+    __slots__ = ("kind", "attrs", "run", "_owner")
+
+    def __init__(self, kind, attrs):
+        self.kind = kind
+        self.attrs = attrs
+        self.run = None
+        self._owner = False
+
+    def __enter__(self):
+        global _runs_in_flight
+        stack = _run_stack()
+        if stack:
+            run = stack[-1]
+        else:
+            self._owner = True
+            run = _Run(new_run_id(), self.kind, dict(self.attrs))
+            with _lock:
+                _runs_in_flight += 1
+                _state.gauges["runs.in_flight"] = _runs_in_flight
+        stack.append(run)
+        self.run = run
+        return run
+
+    def __exit__(self, exc_type, exc, tb):
+        global _runs_in_flight
+        stack = _run_stack()
+        if stack and stack[-1] is self.run:
+            stack.pop()
+        if not self._owner:
+            return False
+        run = self.run
+        status = "ok" if exc_type is None else exc_type.__name__
+        dur = time.perf_counter() - run.t0
+        delta = {field: counter_get(name) - run.compile0[name]
+                 for name, field in _RUN_COMPILE_COUNTERS}
+        rec = {
+            "type": "run",
+            "run": run.run_id,
+            "kind": run.kind,
+            "ts": round(run.wall0, 6),
+            "dur_s": round(dur, 6),
+            "status": status,
+            "compile": {k: v for k, v in delta.items() if v},
+        }
+        if run.attrs:
+            rec["attrs"] = _jsonable(run.attrs)
+        if run.programs:
+            rec["programs"] = list(run.programs)
+        if run.phase is not None:
+            rec["phase_s"] = {k: round(v, 6)
+                              for k, v in run.phase.items()}
+        with _lock:
+            _runs_in_flight = max(_runs_in_flight - 1, 0)
+            _state.gauges["runs.in_flight"] = _runs_in_flight
+            _state.counters["runs.completed"] = \
+                _state.counters.get("runs.completed", 0.0) + 1.0
+            if status != "ok":
+                _state.counters["runs.failed"] = \
+                    _state.counters.get("runs.failed", 0.0) + 1.0
+            _recent_runs.append({k: rec[k] for k in
+                                 ("run", "kind", "ts", "dur_s",
+                                  "status")})
+            del _recent_runs[:-_RECENT_RUNS_CAP]
+        emit(rec)
+        return False
+
+
+def run_scope(kind, **attrs):
+    """Open (or join) a run: the ledger identity every entry point —
+    ``fit_toas``, the grid callables, the batched PTA fits,
+    ``lnlike_grid``, ``run_mcmc``, each bench metric — wraps its work
+    in.  Nested scopes reuse the outer run's id, so one bench metric's
+    internal fits all join one ledger row.  Yields the run object
+    (``.run_id``); at the outermost exit one ``{"type": "run"}``
+    record is emitted carrying duration, status, per-run compile/AOT
+    counter deltas, the programs dispatched, and (when profiling was
+    on) the accumulated phase split."""
+    return _RunScope(kind, attrs)
+
+
+def runs_summary() -> dict:
+    """The in-memory ledger tail: ``{"in_flight", "completed",
+    "failed", "recent": [...]}`` (datacheck / the /metrics
+    endpoint)."""
+    with _lock:
+        return {
+            "in_flight": _runs_in_flight,
+            "completed": int(_state.counters.get("runs.completed", 0)),
+            "failed": int(_state.counters.get("runs.failed", 0)),
+            "recent": [dict(r) for r in _recent_runs],
+        }
+
+
+def iter_trace_record(program, entries, *, kind="fit", **extra) -> dict:
+    """Assemble one ``{"type": "iter_trace"}`` record from decoded
+    per-iteration entries (each a dict with ``i``/``chi2``/
+    ``step_norm``/``max_dpar``/``ok``/``guard_eps``/``rung`` and, on
+    batched programs, reduction extras) — the record
+    ``pinttrace --convergence`` renders.  Extra keyword fields
+    (``n_points``, ``n_pulsars``, ``rungs``) ride along; None values
+    are dropped."""
+    rec = {"type": "iter_trace", "program": program, "kind": kind,
+           "ts": round(time.time(), 6), "n_iter": len(entries),
+           "iters": [_jsonable(e) for e in entries]}
+    for k, v in extra.items():
+        if v is not None:
+            rec[k] = v
+    return rec
+
+
+# --------------------------------------------------------------------------
 # emission
 # --------------------------------------------------------------------------
 
@@ -487,11 +749,43 @@ def _jsonable(obj):
     return repr(obj)
 
 
+def sink_active() -> bool:
+    """Whether a JSONL sink is attached (cheap) — callers with
+    expensive records to assemble (iteration-trace decodes force a
+    device sync) check this before building them."""
+    return _state.sink is not None
+
+
+def sink_info() -> dict:
+    """Describe the attached sink so a caller that temporarily swaps
+    it (``datacheck --runs``) can RESTORE it afterwards:
+    ``{"path": ..., "sink": ..., "enabled": ...}`` — ``path`` for an
+    owned path-opened sink (reattach with ``configure(sink=path)``,
+    which reopens append-mode), ``sink`` for a caller-provided
+    file-like, both None when detached."""
+    with _lock:
+        return {
+            "path": _state.sink_path if _state.sink_owned else None,
+            "sink": (None if _state.sink_owned else _state.sink),
+            "enabled": _state.enabled,
+        }
+
+
 def emit(record: dict):
-    """Write one JSONL record to the sink (no-op without a sink)."""
+    """Write one JSONL record to the sink (no-op without a sink).
+
+    Records emitted while a run is active are tagged with its
+    ``run_id`` (the ledger join key) unless they carry one already or
+    are process-cumulative types (counter/gauge/hist/program flush
+    mirrors describe the whole session, not the run that happened to
+    be active at flush time)."""
     sink = _state.sink
     if sink is None:
         return
+    rid = current_run_id()
+    if rid is not None and "run" not in record \
+            and record.get("type") not in _RUN_UNTAGGED_TYPES:
+        record = {**record, "run": rid}
     try:
         line = json.dumps(_jsonable(record), separators=(",", ":"))
     except (TypeError, ValueError):
@@ -766,3 +1060,21 @@ if _env_path:
 
         print(f"pint_tpu.telemetry: cannot open {_TRACE_ENV}="
               f"{_env_path!r}: {e}", file=sys.stderr)
+
+# live metrics endpoint ($PINT_TPU_METRICS_PORT, default off): the
+# scrape surface over the counters/gauges/histograms and the run
+# ledger — see pint_tpu/metrics_http.py.  A failed bind must never
+# break library imports.
+_env_mport = os.environ.get("PINT_TPU_METRICS_PORT", "").strip()
+if _env_mport and _env_mport.lower() not in ("0", "off", "none",
+                                             "disabled"):
+    try:
+        from pint_tpu import metrics_http as _metrics_http
+
+        _metrics_http.start()
+    except Exception as e:
+        import sys
+
+        print(f"pint_tpu.telemetry: cannot start metrics endpoint "
+              f"(PINT_TPU_METRICS_PORT={_env_mport!r}): {e}",
+              file=sys.stderr)

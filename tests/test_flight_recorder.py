@@ -1,0 +1,687 @@
+"""Flight-recorder tests (ISSUE 10): per-iteration solver traces out
+of the scan, the run ledger joining every record type by run_id, and
+the live /metrics endpoint.
+
+Covers the three tentpole pieces plus the satellites: iterate_fixed's
+trace_of contract (scan == unroll record parity), gate-off
+bit-identity and gate-on zero-recompile on the fitter/grid/PTA
+programs, ledger reconstruction of one fit (>= 4 record types joined,
+guard-ladder escalation visible in the iteration trace), Prometheus
+scrape validity under concurrent fits, the single-lock histogram
+snapshot, the pinttrace --runs/--convergence CLI, the datacheck
+--runs smoke, and the tools/check_jit_gates.py lint wired into
+tier-1.  All CPU, tier-1-fast shapes.
+"""
+
+import importlib.util
+import json
+import os
+import re
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from pint_tpu import compile_cache, telemetry
+from pint_tpu.fitter import GLSFitter, WLSFitter
+from pint_tpu.grid import grid_chisq_vectorized
+from pint_tpu.models.builder import get_model
+from pint_tpu.simulation import make_fake_toas_uniform
+
+WLS_PAR = """PSR TSTFR
+RAJ 18:57:36.39
+DECJ 09:43:17.2
+F0 186.494 1
+F1 -6.2e-16 1
+PEPOCH 54000
+DM 13.3 1
+TZRMJD 54000
+TZRSITE @
+TZRFRQ 1400
+UNITS TDB
+EPHEM builtin
+"""
+
+GLS_PAR = WLS_PAR.replace(
+    "UNITS TDB",
+    "EFAC -f L-wide 1.1\nTNRedAmp -13.5\nTNRedGam 3.3\nTNRedC 5\n"
+    "UNITS TDB")
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mk(par, n=64, seed=0):
+    model = get_model(par)
+    freqs = np.where(np.arange(n) % 2 == 0, 1400.0, 800.0)
+    toas = make_fake_toas_uniform(
+        53000.0, 56500.0, n, model, freq_mhz=freqs, obs="gbt",
+        error_us=1.0, add_noise=True, rng=np.random.default_rng(seed),
+        flags={"f": "L-wide"})
+    return model, toas
+
+
+def _monitoring_live():
+    return telemetry.compile_stats()["source"] == "jax.monitoring"
+
+
+def _compile_events():
+    telemetry.compile_stats()
+    return telemetry.counter_get("jit.compile_events")
+
+
+@pytest.fixture
+def trace_sink(tmp_path):
+    """A temporary JSONL sink attached for the test; yields a reader
+    that parses what landed.  Always detaches (other tests depend on
+    the module-global sink being absent)."""
+    path = tmp_path / "trace.jsonl"
+    telemetry.configure(sink=str(path))
+
+    def read():
+        telemetry.flush()
+        with open(path) as fh:
+            return [json.loads(ln) for ln in fh if ln.strip()]
+
+    try:
+        yield read
+    finally:
+        telemetry.configure(sink=None)
+
+
+# --------------------------------------------------------------------------
+# iterate_fixed trace_of contract
+# --------------------------------------------------------------------------
+
+class TestIterateFixedTrace:
+    def test_env_default_off(self, monkeypatch):
+        monkeypatch.delenv("PINT_TPU_ITER_TRACE", raising=False)
+        assert compile_cache.iter_trace_default() is False
+        for tok in ("1", "true", "on", "yes"):
+            monkeypatch.setenv("PINT_TPU_ITER_TRACE", tok)
+            assert compile_cache.iter_trace_default() is True
+        monkeypatch.setenv("PINT_TPU_ITER_TRACE", "0")
+        assert compile_cache.iter_trace_default() is False
+
+    def test_scan_unroll_trace_parity(self):
+        def body(c):
+            return c * 2.0 + 1.0
+
+        def trace_of(prev, new):
+            return {"v": new, "d": new - prev}
+
+        out_s, tr_s = compile_cache.iterate_fixed(
+            body, jnp.float64(1.0), 4, scan=True, trace_of=trace_of)
+        out_u, tr_u = compile_cache.iterate_fixed(
+            body, jnp.float64(1.0), 4, scan=False, trace_of=trace_of)
+        assert float(out_s) == float(out_u) == 31.0
+        np.testing.assert_array_equal(np.asarray(tr_s["v"]),
+                                      np.asarray(tr_u["v"]))
+        np.testing.assert_array_equal(np.asarray(tr_s["d"]),
+                                      np.asarray(tr_u["d"]))
+        assert tr_s["v"].shape == (4,)
+
+    def test_zero_steps_returns_none_trace(self):
+        x = jnp.arange(3.0)
+        out, tr = compile_cache.iterate_fixed(
+            lambda c: c + 1, x, 0, trace_of=lambda p, n: {"v": n})
+        assert out is x and tr is None
+
+    def test_decode_single_and_batched(self):
+        tr = {"chi2": jnp.asarray([3.0, 2.0]),
+              "step_norm": jnp.asarray([0.1, 0.01]),
+              "max_dpar": jnp.asarray([0.1, 0.01]),
+              "ok": jnp.asarray([True, True])}
+        ent = compile_cache.decode_gn_trace(tr, guard_eps=1e-10,
+                                            rung="jitter")
+        assert [e["chi2"] for e in ent] == [3.0, 2.0]
+        assert ent[0]["guard_eps"] == 1e-10
+        assert ent[0]["rung"] == "jitter"
+        batched = {k: jnp.stack([v, v + 1]) for k, v in tr.items()}
+        batched["ok"] = jnp.asarray([[True, True], [True, False]])
+        ent = compile_cache.decode_gn_trace(batched)
+        assert ent[0]["chi2_min"] == 3.0 and ent[0]["chi2_max"] == 4.0
+        assert ent[1]["n_bad"] == 1 and ent[1]["ok"] is False
+        assert compile_cache.decode_gn_trace(None) == []
+
+
+# --------------------------------------------------------------------------
+# histogram snapshot consistency (satellite)
+# --------------------------------------------------------------------------
+
+class TestHistogramSnapshot:
+    def test_percentiles_one_pass_matches_individual(self):
+        h = telemetry.LogHistogram()
+        rng = np.random.default_rng(0)
+        for v in rng.lognormal(-5, 2, 500):
+            h.record(float(v))
+        ps = h.percentiles((50, 95, 99))
+        assert ps[50] == h.percentile(50)
+        assert ps[95] == h.percentile(95)
+        assert ps[99] == h.percentile(99)
+        assert ps[50] <= ps[95] <= ps[99]
+
+    def test_snapshot_monotone_under_concurrent_mutation(self):
+        h = telemetry.LogHistogram()
+        h.record(1e-3)
+        stop = threading.Event()
+
+        def writer():
+            i = 0
+            while not stop.is_set():
+                h.record(10.0 ** ((i % 7) - 5))
+                i += 1
+
+        t = threading.Thread(target=writer)
+        t.start()
+        try:
+            for _ in range(300):
+                s = h.snapshot()
+                assert s["p50"] <= s["p95"] <= s["p99"]
+        finally:
+            stop.set()
+            t.join()
+
+
+# --------------------------------------------------------------------------
+# run ledger
+# --------------------------------------------------------------------------
+
+class TestRunLedger:
+    def test_nested_scope_joins_outer_run(self, trace_sink):
+        with telemetry.run_scope("outer") as outer:
+            rid = outer.run_id
+            assert telemetry.current_run_id() == rid
+            with telemetry.run_scope("inner") as inner:
+                assert inner.run_id == rid
+            telemetry.emit({"type": "health", "ok": True})
+        assert telemetry.current_run_id() is None
+        recs = trace_sink()
+        runs = [r for r in recs if r.get("type") == "run"]
+        assert len(runs) == 1 and runs[0]["run"] == rid
+        assert runs[0]["kind"] == "outer"
+        assert runs[0]["status"] == "ok"
+        health = [r for r in recs if r.get("type") == "health"]
+        assert health[0]["run"] == rid
+
+    def test_failed_run_status(self, trace_sink):
+        with pytest.raises(RuntimeError):
+            with telemetry.run_scope("doomed"):
+                raise RuntimeError("boom")
+        runs = [r for r in trace_sink() if r.get("type") == "run"]
+        assert runs[0]["status"] == "RuntimeError"
+        assert telemetry.runs_summary()["recent"][-1]["status"] == \
+            "RuntimeError"
+
+    def test_cumulative_records_untagged(self, trace_sink):
+        telemetry.counter_add("fr.test_counter")
+        with telemetry.run_scope("r"):
+            telemetry.flush()
+        for rec in trace_sink():
+            if rec.get("type") in ("counter", "gauge", "hist"):
+                assert "run" not in rec
+
+    def test_one_fit_joins_four_record_types(self, trace_sink,
+                                             monkeypatch):
+        from pint_tpu import profiling
+        from pint_tpu.scripts.pinttrace import (convergence_table,
+                                                join_runs)
+
+        monkeypatch.setenv("PINT_TPU_ITER_TRACE", "1")
+        model, toas = _mk(GLS_PAR)
+        with profiling.profiled(True):
+            f = GLSFitter(toas, model)
+            f.fit_toas(maxiter=3)
+        recs = trace_sink()
+        runs = join_runs(recs)
+        fit = [(rid, info) for rid, info in runs.items()
+               if (info["run"] or {}).get("kind") == "fit"]
+        assert fit, "no fit run record"
+        rid, info = fit[-1]
+        types = set(info["types"])
+        assert {"run", "span", "health", "iter_trace"} <= types
+        # the cumulative program record joins through its runs list
+        prog = [r for r in recs if r.get("type") == "program"
+                and rid in (r.get("runs") or ())]
+        assert prog, "no program record attributed to the run"
+        # the run record itself names the programs + the fingerprint
+        run_rec = info["run"]
+        assert any("fitter.step" in p
+                   for p in run_rec.get("programs", ()))
+        assert run_rec["attrs"]["fingerprint"]
+        assert run_rec.get("phase_s")  # profiled => phase split
+        # iteration trace renders
+        lines = convergence_table(recs, rid)
+        assert any("fitter.step:GLSFitter" in ln for ln in lines)
+        assert info["n_iter"] == len(f.iter_trace) >= 1
+
+    def test_guard_escalation_visible_in_trace(self, trace_sink,
+                                               monkeypatch):
+        """A baseline-rung divergence escalating to the jitter rung
+        must be visible in the iteration trace (guard_eps + rung per
+        entry), the guard_trip/guard_rung records, and the health
+        record — all joined by one run id."""
+        from pint_tpu import guard as _guard
+
+        monkeypatch.setenv("PINT_TPU_ITER_TRACE", "1")
+        model, toas = _mk(WLS_PAR)
+        f = WLSFitter(toas, model)
+        orig = type(f)._iterate
+
+        def flaky(self, maxiter, guard_eps=0.0, rung="baseline"):
+            if guard_eps == 0.0:
+                raise _guard.StepDiverged(
+                    (), last_good={"F0": 1.0}, n_iter=1, kind="solve")
+            return orig(self, maxiter, guard_eps=guard_eps, rung=rung)
+
+        monkeypatch.setattr(type(f), "_iterate", flaky)
+        with pytest.warns(UserWarning, match="degradation"):
+            f.fit_toas(maxiter=2)
+        assert f.fit_rung == "jitter"
+        assert all(e["rung"] == "jitter"
+                   and e["guard_eps"] == pytest.approx(1e-10)
+                   for e in f.iter_trace)
+        recs = trace_sink()
+        rid = [r for r in recs if r.get("type") == "run"][-1]["run"]
+        trips = [r for r in recs if r.get("type") == "guard_trip"]
+        rungs = [r for r in recs if r.get("type") == "guard_rung"]
+        health = [r for r in recs if r.get("type") == "health"]
+        itrecs = [r for r in recs if r.get("type") == "iter_trace"]
+        assert trips and trips[-1]["run"] == rid
+        assert trips[-1]["rung"] == "baseline"
+        assert rungs and rungs[-1]["rung"] == "jitter"
+        assert health[-1]["rung"] == "jitter"
+        assert itrecs[-1]["run"] == rid
+        assert itrecs[-1]["iters"][0]["guard_eps"] == \
+            pytest.approx(1e-10)
+
+
+# --------------------------------------------------------------------------
+# fitter: gate-off bit-identity + gate-on zero-recompile
+# --------------------------------------------------------------------------
+
+class TestFitterGate:
+    def test_gate_on_bit_identical_and_zero_recompile(self,
+                                                      monkeypatch):
+        monkeypatch.delenv("PINT_TPU_ITER_TRACE", raising=False)
+        model0, toas0 = _mk(GLS_PAR, seed=3)
+        chi2_off = GLSFitter(toas0, model0).fit_toas(maxiter=3)
+
+        monkeypatch.setenv("PINT_TPU_ITER_TRACE", "1")
+        model1, toas1 = _mk(GLS_PAR, seed=3)
+        f1 = GLSFitter(toas1, model1)
+        chi2_on = f1.fit_toas(maxiter=3)
+        # the fitter's step program is gate-invariant: same data,
+        # same maxiter => the chi^2 is bit-identical
+        assert chi2_on == chi2_off
+        assert len(f1.iter_trace) >= 1
+
+        # second same-shaped gate-on fitter: ZERO new XLA compiles
+        before = _compile_events()
+        model2, toas2 = _mk(GLS_PAR, seed=4)
+        f2 = GLSFitter(toas2, model2)
+        f2.fit_toas(maxiter=3)
+        new = _compile_events() - before
+        if _monitoring_live():
+            assert new == 0, (
+                f"{new} compile events on the second gate-on fitter — "
+                "the iter-trace gate broke the zero-recompile contract")
+
+
+# --------------------------------------------------------------------------
+# grid: trace out of the vmapped scan
+# --------------------------------------------------------------------------
+
+class TestGridTrace:
+    def _pts(self, model, k=3):
+        return np.array([[model.values["F0"] + i * 1e-13,
+                          model.values["F1"]] for i in range(k)])
+
+    def test_gate_bit_identical_and_zero_recompile(self, trace_sink,
+                                                   monkeypatch):
+        model, toas = _mk(GLS_PAR, seed=5)
+        pts = self._pts(model)
+        monkeypatch.delenv("PINT_TPU_ITER_TRACE", raising=False)
+        c_off, v_off = grid_chisq_vectorized(
+            toas, model, ["F0", "F1"], pts, n_steps=3)
+        monkeypatch.setenv("PINT_TPU_ITER_TRACE", "1")
+        c_on, v_on = grid_chisq_vectorized(
+            toas, model, ["F0", "F1"], pts, n_steps=3)
+        np.testing.assert_array_equal(c_on, c_off)
+        np.testing.assert_array_equal(v_on, v_off)
+        # second gate-on grid over DIFFERENT data: structure-only key
+        # + the gate => shared executable, zero new compiles
+        before = _compile_events()
+        model2, toas2 = _mk(GLS_PAR, seed=6)
+        grid_chisq_vectorized(toas2, model2, ["F0", "F1"],
+                              self._pts(model2), n_steps=3)
+        new = _compile_events() - before
+        if _monitoring_live():
+            assert new == 0
+        # the trace record landed, aggregated per iteration
+        itrecs = [r for r in trace_sink()
+                  if r.get("type") == "iter_trace"
+                  and r.get("kind") == "grid"]
+        assert itrecs and itrecs[0]["n_iter"] == 3
+        e0 = itrecs[0]["iters"][0]
+        assert e0["chi2_min"] <= e0["chi2"] <= e0["chi2_max"]
+        assert e0["ok"] and e0["n_bad"] == 0
+        # and the grid run is in the ledger
+        runs = [r for r in trace_sink() if r.get("type") == "run"]
+        assert any(r["kind"] == "grid" for r in runs)
+        assert itrecs[0]["run"] in {r["run"] for r in runs}
+
+    def test_scan_unroll_record_parity(self, trace_sink, monkeypatch):
+        model, toas = _mk(WLS_PAR, seed=7)
+        pts = self._pts(model)
+        monkeypatch.setenv("PINT_TPU_ITER_TRACE", "1")
+        monkeypatch.delenv("PINT_TPU_SCAN_ITERS", raising=False)
+        grid_chisq_vectorized(toas, model, ["F0", "F1"], pts,
+                              n_steps=3)
+        monkeypatch.setenv("PINT_TPU_SCAN_ITERS", "unroll")
+        grid_chisq_vectorized(toas, model, ["F0", "F1"], pts,
+                              n_steps=3)
+        recs = [r for r in trace_sink()
+                if r.get("type") == "iter_trace"
+                and r.get("kind") == "grid"]
+        assert len(recs) == 2
+        scan_it, unroll_it = recs[0]["iters"], recs[1]["iters"]
+        assert len(scan_it) == len(unroll_it) == 3
+        # mid-convergence chi^2 sits far from the fitted point, so
+        # codegen-order roundoff shows at ~1e-8 relative — diagnostic
+        # parity, not the 1e-12 fitted-vector pin (test_aot owns
+        # that).  Post-convergence step norms are pure roundoff
+        # (~1e-12 absolute against F0~186), hence the absolute floor.
+        for a, b in zip(scan_it, unroll_it):
+            assert a["chi2"] == pytest.approx(b["chi2"], rel=1e-6)
+            assert a["step_norm"] == pytest.approx(b["step_norm"],
+                                                   rel=1e-6, abs=1e-10)
+            assert a["ok"] == b["ok"]
+
+
+# --------------------------------------------------------------------------
+# batched PTA: per-pulsar trace through the three loops
+# --------------------------------------------------------------------------
+
+def _pta_batch(wideband=False):
+    from pint_tpu.parallel.pta import PTABatch
+
+    pairs = []
+    for i in range(2):
+        par = (f"PSR FRZ{i}\nRAJ {10 + i}:10:00\nDECJ 05:00:00\n"
+               f"F0 {150.0 + 30 * i} 1\nF1 -1e-15 1\n"
+               f"PEPOCH 54500\nDM {10 + i} 1\nTZRMJD 54500\n"
+               "TZRSITE @\nTZRFRQ 1400\nUNITS TDB\nEPHEM builtin\n") \
+            + ("DMDATA 1\n" if wideband and i == 1 else "")
+        m = get_model(par)
+        t = make_fake_toas_uniform(
+            53500, 55500, 40, m, obs="gbt", error_us=1.0,
+            add_noise=True, rng=np.random.default_rng(i),
+            freq_mhz=np.where(np.arange(40) % 2 == 0, 1400.0, 800.0),
+            wideband=(wideband and i == 1), dm_error=2e-4)
+        pairs.append((m, t))
+    return PTABatch(pairs)
+
+
+class TestPTATrace:
+    def test_wls_gate_bit_identical_and_trace_shape(self, trace_sink,
+                                                    monkeypatch):
+        monkeypatch.delenv("PINT_TPU_ITER_TRACE", raising=False)
+        b0 = _pta_batch()
+        v0, c0, _ = b0.fit_wls(maxiter=3)
+        assert b0.last_iter_trace is None
+        monkeypatch.setenv("PINT_TPU_ITER_TRACE", "1")
+        b1 = _pta_batch()
+        v1, c1, _ = b1.fit_wls(maxiter=3)
+        np.testing.assert_array_equal(np.asarray(v0), np.asarray(v1))
+        np.testing.assert_array_equal(np.asarray(c0), np.asarray(c1))
+        assert {k: np.shape(x)
+                for k, x in b1.last_iter_trace.items()} == {
+            "chi2": (2, 3), "step_norm": (2, 3), "max_dpar": (2, 3),
+            "ok": (2, 3)}
+        recs = [r for r in trace_sink()
+                if r.get("type") == "iter_trace"
+                and r.get("kind") == "pta"]
+        assert recs and recs[0]["n_pulsars"] == 2
+        assert recs[0]["n_iter"] == 3
+        # final iteration's chi2 envelope brackets the served chi2s
+        last = recs[0]["iters"][-1]
+        assert last["chi2_min"] <= float(np.min(np.asarray(c1))) \
+            * (1 + 1e-6)
+
+    def test_wideband_scan_unroll_record_parity(self, monkeypatch,
+                                                trace_sink):
+        monkeypatch.setenv("PINT_TPU_ITER_TRACE", "1")
+        monkeypatch.delenv("PINT_TPU_SCAN_ITERS", raising=False)
+        b1 = _pta_batch(wideband=True)
+        b1.fit_wideband(maxiter=2)
+        t1 = {k: np.asarray(v) for k, v in b1.last_iter_trace.items()}
+        monkeypatch.setenv("PINT_TPU_SCAN_ITERS", "0")
+        b2 = _pta_batch(wideband=True)
+        b2.fit_wideband(maxiter=2)
+        t2 = {k: np.asarray(v) for k, v in b2.last_iter_trace.items()}
+        for k in t1:
+            np.testing.assert_allclose(t1[k], t2[k], rtol=1e-6,
+                                       atol=1e-15)
+
+
+# --------------------------------------------------------------------------
+# /metrics endpoint
+# --------------------------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? \S+$")
+
+
+def _scrape(port, path="/metrics"):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as resp:
+        return resp.status, resp.read().decode()
+
+
+class TestMetricsHttp:
+    def test_scrape_is_valid_prometheus_text(self):
+        from pint_tpu import metrics_http
+
+        telemetry.counter_add("fr.scrape_counter", 2)
+        telemetry.hist_record("fr.scrape_lat", 0.01)
+        port = metrics_http.start(port=0)
+        try:
+            status, body = _scrape(port)
+            assert status == 200
+            lines = [ln for ln in body.splitlines() if ln]
+            assert lines, "empty scrape"
+            for ln in lines:
+                if not ln.startswith("#"):
+                    assert _SAMPLE_RE.match(ln), ln
+            assert "pint_tpu_fr_scrape_counter_total 2.0" in body
+            assert 'pint_tpu_hist_fr_scrape_lat{quantile="0.5"}' \
+                in body
+            assert "pint_tpu_hist_fr_scrape_lat_count 1" in body
+            status, hz = _scrape(port, "/healthz")
+            doc = json.loads(hz)
+            assert "runs" in doc and "compile" in doc
+            status404, _ = 404, None
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/nope", timeout=10)
+            except urllib.error.HTTPError as e:
+                status404 = e.code
+            assert status404 == 404
+        finally:
+            metrics_http.stop()
+        assert metrics_http.port() is None
+
+    def test_scrape_survives_concurrent_fits(self):
+        from pint_tpu import metrics_http
+
+        port = metrics_http.start(port=0)
+        errors = []
+
+        def fit_worker(seed):
+            try:
+                model, toas = _mk(WLS_PAR, n=64, seed=seed)
+                WLSFitter(toas, model).fit_toas(maxiter=2)
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        def grid_worker(seed):
+            # the acceptance scenario: a scrape during a running grid
+            try:
+                model, toas = _mk(WLS_PAR, n=64, seed=seed)
+                pts = np.array([[model.values["F0"] + i * 1e-13,
+                                 model.values["F1"]]
+                                for i in range(4)])
+                grid_chisq_vectorized(toas, model, ["F0", "F1"], pts,
+                                      n_steps=2)
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=fit_worker, args=(11,)),
+                   threading.Thread(target=grid_worker, args=(12,))]
+        try:
+            for t in threads:
+                t.start()
+            saw_runs_gauge = False
+            for _ in range(6):
+                status, body = _scrape(port)
+                assert status == 200
+                for ln in body.splitlines():
+                    if ln and not ln.startswith("#"):
+                        assert _SAMPLE_RE.match(ln), ln
+                saw_runs_gauge |= "pint_tpu_runs_in_flight" in body
+        finally:
+            for t in threads:
+                t.join()
+            metrics_http.stop()
+        assert not errors
+        # fits ran under run scopes => the ledger gauge exists by the
+        # final scrape or in the summary
+        assert saw_runs_gauge or \
+            telemetry.runs_summary()["completed"] >= 2
+
+
+# --------------------------------------------------------------------------
+# pinttrace CLI: --runs / --convergence
+# --------------------------------------------------------------------------
+
+class TestPinttraceCLI:
+    def _write_trace(self, tmp_path):
+        rid = "rdeadbeef-0001"
+        recs = [
+            {"type": "span", "name": "fit_toas", "ts": 1.0,
+             "dur_s": 0.5, "depth": 0, "run": rid},
+            {"type": "health", "context": "GLSFitter",
+             "rung": "jitter", "ok": True, "run": rid},
+            {"type": "iter_trace", "program": "fitter.step:GLSFitter",
+             "kind": "fit", "n_iter": 2, "run": rid,
+             "iters": [
+                 {"i": 0, "chi2": 10.0, "step_norm": 0.1,
+                  "max_dpar": 0.1, "ok": True, "guard_eps": 0.0,
+                  "rung": "baseline"},
+                 {"i": 1, "chi2": 9.0, "step_norm": 0.01,
+                  "max_dpar": 0.01, "ok": True, "guard_eps": 1e-10,
+                  "rung": "jitter"}]},
+            {"metric": "gls_toas_per_sec", "value": 123.0,
+             "run": rid},
+            {"type": "run", "run": rid, "kind": "fit", "ts": 1.0,
+             "dur_s": 0.6, "status": "ok",
+             "compile": {"backend_compiles": 2},
+             "attrs": {"fingerprint": "abc123"},
+             "programs": ["fitter.step:GLSFitter"]},
+        ]
+        path = tmp_path / "t.jsonl"
+        path.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+        return str(path), rid
+
+    def test_runs_cli(self, tmp_path, capsys):
+        from pint_tpu.scripts.pinttrace import main
+
+        path, rid = self._write_trace(tmp_path)
+        assert main([path, "--runs"]) == 0
+        out = capsys.readouterr().out
+        assert rid in out
+        assert "jitter" in out
+        assert "fingerprint=abc123" in out
+        assert "metric:1" in out and "iter_trace:1" in out
+        assert "gls_toas_per_sec" in out
+
+    def test_convergence_cli(self, tmp_path, capsys):
+        from pint_tpu.scripts.pinttrace import main
+
+        path, rid = self._write_trace(tmp_path)
+        assert main([path, "--convergence", rid]) == 0
+        out = capsys.readouterr().out
+        assert "fitter.step:GLSFitter" in out
+        assert "baseline" in out and "jitter" in out
+        assert "1e-10" in out
+        # unknown run: clean message, not a crash
+        assert main([path, "--convergence", "nope"]) == 0
+        assert "no iteration-trace records" in capsys.readouterr().out
+
+    def test_summary_mode_counts_ledger_records_as_other(
+            self, tmp_path, capsys):
+        from pint_tpu.scripts.pinttrace import main
+
+        path, _ = self._write_trace(tmp_path)
+        assert main([path]) == 0
+        out = capsys.readouterr().out
+        assert "1 spans" in out
+
+
+# --------------------------------------------------------------------------
+# datacheck --runs smoke + the jit-gate lint (tier-1 wiring)
+# --------------------------------------------------------------------------
+
+class TestDatacheckRuns:
+    def test_runs_section_ok(self):
+        from pint_tpu.datacheck import _runs_section
+
+        lines = _runs_section()
+        text = "\n".join(lines)
+        assert "OK" in text
+        assert "PROBLEM" not in text and "ERROR" not in text
+
+
+def _load_lint():
+    spec = importlib.util.spec_from_file_location(
+        "check_jit_gates",
+        os.path.join(REPO_ROOT, "tools", "check_jit_gates.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestJitGateLint:
+    def test_repo_passes(self):
+        lint = _load_lint()
+        lines, rc = lint.check(REPO_ROOT)
+        assert rc == 0, "\n".join(
+            ln for ln in lines if not ln.startswith("OK"))
+
+    def test_missing_key_token_flags(self, tmp_path):
+        lint = _load_lint()
+        pkg = tmp_path / "pint_tpu"
+        pkg.mkdir()
+        (pkg / "bad.py").write_text(
+            "from pint_tpu import compile_cache as _cc\n"
+            "def build():\n"
+            "    scan = _cc.scan_iters_default()\n"
+            "    return _cc.shared_jit(lambda x: x, key=('bad',))\n")
+        lines, rc = lint.check(str(tmp_path))
+        assert rc == 1
+        assert any("pint_tpu/bad.py" in ln
+                   and "PINT_TPU_SCAN_ITERS" in ln for ln in lines)
+
+    def test_unclassified_env_var_flags(self, tmp_path):
+        lint = _load_lint()
+        pkg = tmp_path / "pint_tpu"
+        pkg.mkdir()
+        (pkg / "novel.py").write_text(
+            "import os\n"
+            "X = os.environ.get('PINT_TPU_TOTALLY_NEW_KNOB')\n")
+        lines, rc = lint.check(str(tmp_path))
+        assert rc == 1
+        assert any("PINT_TPU_TOTALLY_NEW_KNOB" in ln for ln in lines)
