@@ -769,6 +769,163 @@ def bench_pta_sharded(jnp, backend):
     })
 
 
+#: forced host-device counts of the weak-scaling sweep
+_WEAK_COUNTS = (2, 4, 8)
+
+
+def bench_weak_scaling(jnp, backend):
+    """Weak-scaling sweep of the two sharded metrics over forced
+    host-device counts (2/4/8): one fresh grandchild process per
+    count (the device-count flag must be final before jax
+    initializes), each measuring a sharded grid and a sharded PTA
+    batch whose WORK SCALES WITH THE COUNT (constant points/pulsars
+    per device), emitting per-count rows
+    (``grid_pts_per_sec_sharded_w{n}`` /
+    ``pta_batch_fits_per_sec_sharded_w{n}``) with
+    ``mesh.pad_waste_frac`` recorded so the regression sentinel can
+    track scaling efficiency as a series.  The 8-device rows carry
+    ``scaling_vs_2dev`` — throughput relative to the 2-device row of
+    the same metric (near-linear weak scaling ⇒ ~4x)."""
+    import re as _re
+    import subprocess
+
+    rows = []
+    for ndev in _WEAK_COUNTS:
+        env = dict(os.environ)
+        flags = _re.sub(
+            r"--xla_force_host_platform_device_count=\d+", "",
+            env.get("XLA_FLAGS", ""))
+        env["XLA_FLAGS"] = (
+            flags
+            + f" --xla_force_host_platform_device_count={ndev}"
+        ).strip()
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--weak-child", str(ndev)],
+            capture_output=True, text=True, env=env, timeout=420)
+        if r.stderr:
+            sys.stderr.write(r.stderr)
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"weak-scaling child ndev={ndev} rc={r.returncode}: "
+                f"{(r.stderr or '')[-400:]}")
+        for ln in r.stdout.splitlines():
+            if ln.startswith('{"metric"'):
+                rows.append(json.loads(ln))
+    by_metric = {}
+    for rec in rows:
+        base = rec["metric"].rsplit("_w", 1)[0]
+        ndev = int(rec["metric"].rsplit("_w", 1)[1])
+        by_metric.setdefault(base, {})[ndev] = rec
+    for base, series in by_metric.items():
+        lo = series.get(min(_WEAK_COUNTS))
+        hi = series.get(max(_WEAK_COUNTS))
+        if lo and hi and lo.get("value"):
+            hi["scaling_vs_2dev"] = round(
+                float(hi["value"]) / float(lo["value"]), 2)
+    for rec in rows:
+        _emit_metric(rec)
+
+
+def _run_weak_child(ndev):
+    """Grandchild entry for the weak-scaling sweep: measure the two
+    sharded metrics at per-device-constant work on this process's
+    forced device count, print one JSON row each."""
+    ndev = int(ndev)
+    _force_cpu_if_requested()
+    import jax
+    import jax.numpy as jnp
+
+    import pint_tpu  # noqa: F401  (x64)
+    from pint_tpu import telemetry
+    from pint_tpu.grid import make_grid_fn
+    from pint_tpu.models.builder import get_model
+    from pint_tpu.parallel import PTABatch, make_mesh, mesh_desc
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    telemetry.compile_stats()
+    assert len(jax.devices()) == ndev, (len(jax.devices()), ndev)
+    backend = jax.default_backend()
+    mesh = make_mesh("grid")
+
+    # --- grid: 48 points per device, minus one so the edge-pad path
+    # is part of every measurement (waste 1/(48 ndev) << 0.25)
+    par = ("PSR WEAK\nRAJ 5:00:00\nDECJ 20:00:00\nF0 100.0 1\n"
+           "F1 -1e-15 1\nPEPOCH 55000\nDM 10.0 1\nTZRMJD 55000\n"
+           "TZRFRQ 1400\nTZRSITE @\nUNITS TDB\nEPHEM builtin\n")
+    m = get_model(par)
+    toas = make_fake_toas_uniform(
+        53000, 56000, 500, m, obs="gbt", error_us=1.0,
+        add_noise=True, rng=np.random.default_rng(0))
+    n_pts = 48 * ndev - 1
+    f0 = m.values["F0"]
+    pts = np.stack([np.linspace(f0 - 2e-9, f0 + 2e-9, n_pts),
+                    np.linspace(-1.2e-15, -0.8e-15, n_pts)], axis=1)
+    fn, _, _ = make_grid_fn(toas, m, ["F0", "F1"], n_steps=3,
+                            mesh=mesh)
+    compile_s = _timed_compile(lambda: np.asarray(fn(pts)[0]))
+    t0 = time.time()
+    chi2 = np.asarray(fn(pts)[0])
+    wall = time.time() - t0
+    assert np.all(np.isfinite(chi2))
+    waste = telemetry.gauges().get("mesh.pad_waste_frac.grid", 0.0)
+    _emit_metric({
+        "metric": f"grid_pts_per_sec_sharded_w{ndev}",
+        "value": round(n_pts / wall, 2),
+        "unit": f"grid points/s ((F0,F1) {n_pts} pts = 48/device - 1, "
+                f"500 TOAs, 3 GN iters/pt, sharded over {ndev} forced "
+                f"host device(s), backend={backend}, "
+                f"compile={compile_s:.1f}s)",
+        "vs_baseline": None,
+        "backend": backend,
+        "compile_s": _cold_warm(compile_s, 0.0),
+        "flops": None,
+        "mesh": {**(mesh_desc(mesh) or {}),
+                 "pad_waste_frac": round(float(waste), 6)},
+    })
+
+    # --- PTA: 3 pulsars per device, minus one so the phantom-pad
+    # path is part of every measurement (waste 1/(3 ndev) <= 1/6)
+    def mk(i):
+        p = (f"PSR WK{i:02d}\nRAJ {i % 24:02d}:10:00\n"
+             f"DECJ {(i * 3) % 60 - 30:+03d}:00:00\n"
+             f"F0 {100.0 + 7.0 * i!r} 1\nF1 -1e-15 1\nPEPOCH 54500\n"
+             f"DM {10 + i * 0.5} 1\nTZRMJD 54500\nTZRSITE @\n"
+             "TZRFRQ 1400\nUNITS TDB\nEPHEM builtin\n")
+        mm = get_model(p)
+        tt = make_fake_toas_uniform(
+            53000, 56000, 150, mm, obs="gbt", error_us=1.0,
+            add_noise=True, rng=np.random.default_rng(i))
+        return mm, tt
+
+    n_psr = 3 * ndev - 1
+    pmesh = make_mesh("pulsar")
+    batch = PTABatch([mk(i) for i in range(n_psr)])
+    compile_s = _timed_compile(
+        lambda: batch.fit_wls(maxiter=3, mesh=pmesh))
+    t0 = time.time()
+    _, chi2_t, _ = batch.fit_wls(maxiter=3, mesh=pmesh)
+    np.asarray(chi2_t)
+    wall = time.time() - t0
+    waste = telemetry.gauges().get("mesh.pad_waste_frac.pulsar", 0.0)
+    _emit_metric({
+        "metric": f"pta_batch_fits_per_sec_sharded_w{ndev}",
+        "value": round(n_psr / wall, 2),
+        "unit": f"pulsar WLS fits/s ({n_psr} pulsars = 3/device - 1, "
+                f"150 TOAs each, phantom-padded and sharded over "
+                f"{ndev} forced host device(s), backend={backend}, "
+                f"compile={compile_s:.1f}s)",
+        "vs_baseline": None,
+        "backend": backend,
+        "compile_s": _cold_warm(compile_s, 0.0),
+        "flops": None,
+        "mesh": {**(mesh_desc(pmesh) or {}),
+                 "pad_waste_frac": round(float(waste), 6)},
+    })
+    telemetry.flush()
+    return 0
+
+
 def bench_cold_start(jnp, backend):
     """Fresh-process cold start through the AOT executable manifest
     (compile_cache.export_executables / import_executables): one
@@ -1008,6 +1165,7 @@ _METRICS = {
     "pta": bench_pta,
     "grid_sharded": bench_grid_sharded,
     "pta_sharded": bench_pta_sharded,
+    "weak_scaling": bench_weak_scaling,
     "cold_start": bench_cold_start,
     "guard_overhead": bench_guard,
     "profile_overhead": bench_profile_overhead,
@@ -1199,6 +1357,8 @@ def main():
         return _run_one(sys.argv[2])
     if len(sys.argv) >= 4 and sys.argv[1] == "--cold-child":
         return _run_cold_child(sys.argv[2], sys.argv[3])
+    if len(sys.argv) >= 3 and sys.argv[1] == "--weak-child":
+        return _run_weak_child(sys.argv[2])
 
     per_metric_s = float(os.environ.get(
         "PINT_TPU_BENCH_METRIC_TIMEOUT", "600"))

@@ -74,7 +74,7 @@ from pint_tpu import profiling, telemetry
 __all__ = [
     "enable_persistent_cache", "cache_dir", "cache_entries",
     "shared_jit", "registry_stats", "clear_registry",
-    "bucket_size", "pad_toas", "PAD_ERROR_US",
+    "bucket_size", "pad_toas", "apply_toa_row_plan", "PAD_ERROR_US",
     "split_ctx", "merge_ctx", "fingerprint",
     "model_structure_key", "donation_argnums", "warmup",
     "scan_iters_default", "iterate_fixed", "iter_trace_default",
@@ -845,6 +845,61 @@ def pad_toas(toas, n_target=None):
     return padded
 
 
+def apply_toa_row_plan(toas, plan):
+    """Re-lay a TOAs object per an epoch-alignment row plan
+    (:func:`pint_tpu.parallel.mesh.toa_shard_plan`): entries >= 0 are
+    source rows, ``-1`` inserts a zero-weight sentinel row — a clone
+    of the nearest PRECEDING source row, so it joins that row's
+    noise-mask groups and ECORR epoch (the :func:`pad_toas`
+    convention) and the preceding epoch block extends exactly to the
+    shard boundary, never past it.
+
+    Because pad rows are no longer a suffix, the returned object
+    carries an explicit boolean ``pad_valid`` mask (honored by
+    :class:`pint_tpu.residuals.Residuals` in place of the
+    ``arange < n_real`` convention) alongside ``n_real``.  Accepts
+    suffix-padded input (bucketed/device-padded TOAs): existing pad
+    rows keep their invalid status through the plan."""
+    plan = np.asarray(plan, dtype=np.int64)
+    n = len(toas)
+    if plan[plan >= 0].size and (plan.max() >= n or
+                                 np.any(np.bincount(
+                                     plan[plan >= 0],
+                                     minlength=n) != 1)):
+        raise ValueError(
+            "apply_toa_row_plan: plan must use every source row "
+            f"exactly once (n={n})")
+    old_valid = getattr(toas, "pad_valid", None)
+    if old_valid is None:
+        n_real = getattr(toas, "n_real", None)
+        old_valid = (np.arange(n) < n_real if n_real is not None
+                     else np.ones(n, dtype=bool))
+    old_valid = np.asarray(old_valid, dtype=bool)
+    src = np.empty(len(plan), dtype=np.int64)
+    last = 0
+    for i, p in enumerate(plan):
+        if p >= 0:
+            last = int(p)
+        src[i] = last
+    out = toas[src]
+    inserted = plan < 0
+    err = np.asarray(out.error_us, dtype=float).copy()
+    err[inserted] = PAD_ERROR_US
+    out.error_us = err
+    for i in np.flatnonzero(inserted):
+        out.flags[i]["pad"] = "1"
+        if "pp_dm" in out.flags[i]:
+            out.flags[i]["pp_dme"] = repr(PAD_ERROR_US)
+    valid = old_valid[src] & ~inserted
+    out.pad_valid = valid
+    out.n_real = int(np.count_nonzero(valid))
+    n_pad = int(np.count_nonzero(inserted))
+    if n_pad:
+        telemetry.counter_add("compile_cache.toas_padded")
+        telemetry.counter_add("compile_cache.pad_rows", float(n_pad))
+    return out
+
+
 # --------------------------------------------------------------------------
 # layer 4: AOT warmup
 # --------------------------------------------------------------------------
@@ -993,7 +1048,14 @@ def warm_timed(fn):
 def _aot_env() -> dict:
     """The version/topology fields an exported executable is valid
     under — per-entry in the manifest, so a partially-stale directory
-    rejects entry-by-entry instead of all-or-nothing."""
+    rejects entry-by-entry instead of all-or-nothing.
+
+    ``n_processes``/``devices_per_process`` make serialized
+    executables per-TOPOLOGY artifacts: a mesh program compiled on an
+    8-process pod slice lowers different collectives than the same
+    axis layout on one host, so an executable from one must never be
+    served to the other (mesh.distributed_init + mesh_jit_key carry
+    the same topology into the registry keys)."""
     import jax
     import jaxlib
 
@@ -1002,6 +1064,8 @@ def _aot_env() -> dict:
         "jaxlib": jaxlib.__version__,
         "backend": jax.default_backend(),
         "n_devices": len(jax.devices()),
+        "n_processes": int(jax.process_count()),
+        "devices_per_process": len(jax.local_devices()),
     }
 
 

@@ -432,6 +432,65 @@ def _mesh_section():
             f"  sharded == unsharded fit smoke (2 pulsars over "
             f"{len(devs)} device(s)): rel delta {delta:.1e} -> "
             + ("OK" if ok else "PROBLEM"))
+
+        # 2-D pulsar x grid: mesh construction + rule resolution over
+        # the scan pytree (BOTH axes on one data pytree — the pod
+        # layout PTABatch.chisq_grid runs), then a tiny sharded ==
+        # unsharded scan.  A misconfigured pod slice fails HERE, at
+        # diagnosis time, not mid-run.
+        from pint_tpu.parallel import PTA_GRID_RULES
+
+        # balanced split so BOTH axes actually shard when devices
+        # allow it (8 devices -> (2, 4), 1 device -> (1, 1))
+        n_psr_dev = 2 if len(devs) % 2 == 0 else 1
+        mesh2d = make_mesh(("pulsar", "grid"),
+                           shape=(n_psr_dev, len(devs) // n_psr_dev))
+        lines.append(f"  2-d mesh: {_mesh.mesh_desc(mesh2d)} "
+                     f"(jit key {_mesh.mesh_jit_key(mesh2d)}): OK")
+        pts = np.linspace(-2e-15, -5e-16, 3)[:, None]
+        scan_args = {"grid_values": pts, **{
+            k: v for k, v in batch._base_args().items()
+            if v is not None}}
+        specs2 = _mesh.match_partition_rules(PTA_GRID_RULES, scan_args)
+        flat2 = _mesh.tree_paths(specs2)
+        lines.append(
+            f"  2-d rule table over the scan pytree: {len(flat2)} "
+            "leaves all matched (grid_values -> grid axis, stacked "
+            "batch -> pulsar axis): OK")
+        c_ref = batch.chisq_grid(["F1"], pts, n_steps=2)
+        c_sh = batch2.chisq_grid(["F1"], pts, n_steps=2, mesh=mesh2d)
+        d2 = float(np.max(np.abs(c_ref - c_sh)
+                          / np.maximum(np.abs(c_ref), 1e-300)))
+        lines.append(
+            "  2-d pulsar x grid scan sharded == unsharded: rel "
+            f"delta {d2:.1e} -> " + ("OK" if d2 < 1e-6 else "PROBLEM"))
+
+        # TOA-axis Woodbury smoke: the sharded contractions of
+        # linalg must reduce to the unsharded answer
+        import jax.numpy as jnp
+
+        from pint_tpu.linalg import woodbury_chi2_logdet
+
+        rng = np.random.default_rng(0)
+        n_t = 16 * len(devs)
+        r = jnp.asarray(rng.normal(size=n_t))
+        sigma = jnp.asarray(1.0 + 0.1 * rng.random(n_t))
+        U = jnp.asarray(rng.normal(size=(n_t, 5)))
+        phi = jnp.asarray(10.0 ** rng.uniform(-2, 0, 5))
+        tmesh = make_mesh("toa")
+        shard = _mesh.RowShard(tmesh)
+        import jax
+
+        c_plain = jax.jit(woodbury_chi2_logdet)(r, sigma, U, phi)
+        c_shard = jax.jit(
+            lambda *a: woodbury_chi2_logdet(*a, toa=shard))(
+            r, sigma, U, phi)
+        dt = max(abs(float(a) - float(b)) / max(abs(float(a)), 1e-300)
+                 for a, b in zip(c_plain, c_shard))
+        lines.append(
+            f"  toa-axis sharded Woodbury (N={n_t} over {len(devs)} "
+            f"device(s)): rel delta {dt:.1e} -> "
+            + ("OK" if dt < 1e-8 else "PROBLEM"))
         from pint_tpu import telemetry
 
         lines.append(

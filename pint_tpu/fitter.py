@@ -118,7 +118,7 @@ def wideband_resid_and_design(resids, base_values, data, free, vec,
 
 
 def wls_gn_solve(resid_fn, vec, err, threshold=1e-14, rcond=None,
-                 with_health=False, rj=None):
+                 with_health=False, rj=None, toa=None):
     """One whitened, column-normalized SVD Gauss-Newton step.
 
     The shared numerical core of WLSFitter and the vmapped grid (one
@@ -132,12 +132,19 @@ def wls_gn_solve(resid_fn, vec, err, threshold=1e-14, rcond=None,
     hand.  rj: optional precomputed ``(r, J)`` — the hybrid design
     path (:func:`resid_and_design`) supplies it so the solve never
     re-runs ``jacfwd`` over the full chain; resid_fn may then be None.
+    toa: optional :class:`pint_tpu.parallel.mesh.RowShard` keeping the
+    whitened (N, P) system sharded over the TOA axis (the SVD itself
+    gathers — the win is the upstream residual/design build staying
+    sharded; the normal-equation GLS path is where the reduction
+    decomposes, see linalg.gls_normal_solve).
     """
     if rj is not None:
         r, J = rj
     else:
         r = resid_fn(vec)
         J = jax.jacfwd(resid_fn)(vec)  # (N, P) d resid / d param
+    if toa is not None:
+        r, J, err = toa.rows(r), toa.rows(J), toa.rows(err)
     w = 1.0 / err
     rw = r * w
     Jw = J * w[:, None]
@@ -172,6 +179,21 @@ class Fitter:
     (compile_cache.pad_toas) so nearby dataset sizes share one XLA
     executable.  None reads ``$PINT_TPU_BUCKET_TOAS`` (default off);
     explicit residuals suppress padding (their dataset is fixed).
+
+    mesh: an optional device mesh with a ``toa`` axis
+    (:func:`pint_tpu.parallel.mesh.make_mesh`) sharding the SEQUENCE
+    dimension of this single pulsar's fit over devices: the dataset
+    pytree is TOA-padded to a device multiple and placed with
+    NamedShardings, and the Woodbury/normal-equation contractions of
+    the step reduce shard-local with one small-(P+K) all-reduce
+    (linalg ``toa=`` / :class:`~pint_tpu.parallel.mesh.RowShard`) —
+    a 20-year dataset's O(N (P+K)^2) gram assembly parallelizes.
+    Segment-sum ECORR epoch blocks are pad-aligned to shard
+    boundaries (``mesh.toa_shard_plan`` → sentinel row insertion) or
+    the basis falls back dense, brute-force-equal either way.  The
+    mesh joins the step's jit key: a second same-shaped sharded
+    fitter performs zero new XLA compiles, and ``mesh=None`` keys
+    and behaves exactly as before.
     """
 
     #: which frozen-noise leaves this class's step consumes: every
@@ -182,15 +204,90 @@ class Fitter:
     #: reads it is pure waste on correlated-noise models.
     _noise_gram_leaves = False
 
-    def __init__(self, toas, model, residuals=None, bucket=None):
+    def __init__(self, toas, model, residuals=None, bucket=None,
+                 mesh=None):
         if bucket is None:
             bucket = _cc.bucketing_default()
-        if bucket and residuals is None:
+        self._toa_mesh = mesh
+        if mesh is not None:
+            if residuals is not None:
+                raise ValueError(
+                    "mesh= needs to pad/align the TOA axis itself; "
+                    "explicit residuals are unsupported on the "
+                    "TOA-sharded path")
+            from pint_tpu.parallel import mesh as _pm
+
+            ndev = _pm.axis_size(mesh, "toa")
+            n = len(toas)
+            if getattr(toas, "n_real", None) is not None:
+                # already padded (bucketed upstream): pad_toas would
+                # reject a conflicting re-pad target, but appending
+                # further sentinel rows through the row-plan path is
+                # exact (the plan machinery carries the pad_valid
+                # mask whether or not the pads are a suffix)
+                target = _pm.pad_to_multiple(n, ndev)
+                if target != n:
+                    toas = _cc.apply_toa_row_plan(
+                        toas, np.concatenate(
+                            [np.arange(n),
+                             np.full(target - n, -1)]))
+                _pm.record_pad_waste("toa", toas.n_real, target)
+            else:
+                target = _cc.bucket_size(n) if bucket else n
+                target = _pm.pad_to_multiple(max(target, n), ndev)
+                toas = _cc.pad_toas(toas, n_target=target)
+                _pm.record_pad_waste("toa", n, target)
+        elif bucket and residuals is None:
             toas = _cc.pad_toas(toas)
         self.toas = toas
         self.model = model
         self.resids = residuals or Residuals(toas, model)
         self.prepared = self.resids.prepared
+        if mesh is not None:
+            self._align_toa_epochs()
+
+    def _align_toa_epochs(self):
+        """Segment-sum ECORR epoch blocks must not straddle TOA-shard
+        boundaries (the segment reduction would scatter-add across
+        devices): when the dataset's epoch layout straddles, re-lay
+        the rows with sentinel pads pushing each epoch cluster inside
+        one shard (``mesh.toa_shard_plan`` +
+        ``compile_cache.apply_toa_row_plan``), rebuilding the
+        residuals over the realigned dataset; when no plan exists
+        (an epoch cluster larger than a shard), fall back to the
+        dense basis — both brute-force-equal to the unsharded fit."""
+        from pint_tpu.linalg import su_to_dense
+        from pint_tpu.parallel import mesh as _pm
+
+        ndev = _pm.axis_size(self._toa_mesh, "toa")
+        if ndev <= 1:
+            return
+        for attempt in range(2):
+            su = self.resids._U_ext
+            if not isinstance(su, StructuredU):
+                return
+            seg = np.asarray(su.seg)
+            k_e = int(su.eslot.shape[0])
+            if _pm.toa_epochs_aligned(seg, k_e, ndev):
+                return
+            if attempt == 0:
+                plan = _pm.toa_shard_plan(seg, k_e, ndev)
+                if plan is not None:
+                    telemetry.counter_add("mesh.toa_align_replans")
+                    self.toas = _cc.apply_toa_row_plan(self.toas,
+                                                       plan)
+                    self.resids = Residuals(self.toas, self.model)
+                    self.prepared = self.resids.prepared
+                    continue
+            telemetry.counter_add("mesh.ecorr_dense_fallbacks")
+            warnings.warn(
+                "ECORR epoch blocks straddle TOA-shard boundaries "
+                "and cannot be pad-aligned; serving the dense basis "
+                "for this sharded fit")
+            self.resids._U_ext = su_to_dense(su)
+            self.resids._data_cached = None
+            self.resids._structure_key_cached = None
+            return
 
     @staticmethod
     def auto(toas, model, downhill=True, bucket=None):
@@ -395,6 +492,10 @@ class Fitter:
                 self._noise_fp = fp
                 self._fit_data = {**self._fit_data,
                                   **self._noise_leaves()}
+        # refreshed leaves are host arrays — re-commit them onto the
+        # TOA mesh so the executable's input shardings stay stable
+        # (no-op unsharded; a committed leaf re-placed is free)
+        self._shard_fit_data()
 
     def _kepler_depth_guard(self):
         """Post-fit Kepler-depth verification.  The Newton unroll
@@ -477,30 +578,64 @@ class Fitter:
         # (tools/check_jit_gates.py) stays one rule with no per-site
         # exemptions and a future in-trace fitter loop can't miss it
         self._iter_trace = _cc.iter_trace_default()
+        # TOA-axis sharding: the RowShard is closed over by the step
+        # trace (its constraints change the program — the mesh rides
+        # the key below), and the dataset pytree is committed onto the
+        # mesh so a second same-shaped sharded fitter reuses both the
+        # placement and the executable
+        self._toa_shard = None
+        if self._toa_mesh is not None:
+            from pint_tpu.parallel import mesh as _pm
+
+            self._toa_shard = _pm.RowShard(self._toa_mesh)
         leaves = self._partition_setup()
         self._fit_data = self._inject_frozen(
             {**self.resids._data(), "guard_eps": np.float64(0.0)},
             leaves)
+        self._shard_fit_data()
         self._step_jit = _cc.shared_jit(
             self._step, key=self._step_key(),
             donate_argnums=_cc.donation_argnums((0,)),
-            label=f"fitter.step:{type(self).__name__}")
+            label=f"fitter.step:{type(self).__name__}"
+                  + (":sharded" if self._toa_mesh is not None else ""))
+        if self._toa_mesh is not None:
+            from pint_tpu.parallel import mesh as _pm
+
+            self._step_jit.set_mesh(_pm.mesh_desc(self._toa_mesh))
         # flops.py's per-step estimate rides the program record so the
         # profiler can reconcile it against XLA's own cost_analysis
         # (>2x disagreement -> profile.flops_mismatch)
         self._step_jit.set_analytic_flops(self._fit_flops_est(1))
+
+    def _shard_fit_data(self):
+        """Commit the fit-data pytree onto the TOA mesh (no-op
+        unsharded).  Re-run after any host-side leaf refresh — a
+        freshly-built uncommitted leaf among committed ones would
+        change the executable's input-sharding signature and force a
+        recompile."""
+        if self._toa_mesh is None:
+            return
+        from pint_tpu.parallel import mesh as _pm
+
+        self._fit_data = _pm.shard_toa_data(
+            self._toa_mesh, self._fit_data, len(self.toas))
 
     def _step_key(self):
         """Everything a trace of _step bakes in beyond the avals.
         The design partition and frozen-component list change the
         traced program (which columns are analytic, which chain
         members fold in data), so they are part of the key — as are
-        the env gates through them."""
+        the env gates through them, and the TOA mesh (the RowShard
+        constraints change the traced program;
+        mesh.mesh_jit_key also carries the process topology)."""
+        from pint_tpu.parallel import mesh as _pm
+
         return ("fitter.step", type(self).__name__, self._traced_free,
                 getattr(self, "threshold", None), self._guard_on,
                 self._iter_trace,
                 self._partition, self._frozen_names, self._noise_frozen,
-                self.resids._structure_key())
+                self.resids._structure_key()) \
+            + _pm.mesh_jit_key(self._toa_mesh)
 
     def _rj(self, vec, base_values, data):
         """(r, J) over the traced free set — the hybrid analytic/AD
@@ -812,8 +947,9 @@ class WLSFitter(Fitter):
     reference WLS path (fitter.py:1990)."""
 
     def __init__(self, toas, model, residuals=None, threshold=1e-14,
-                 bucket=None):
-        super().__init__(toas, model, residuals, bucket=bucket)
+                 bucket=None, mesh=None):
+        super().__init__(toas, model, residuals, bucket=bucket,
+                         mesh=mesh)
         self.threshold = threshold
         self._retrace()
 
@@ -841,10 +977,12 @@ class WLSFitter(Fitter):
         rj = self._rj(vec, base_values, data)
         if not self._guard_on:
             return wls_gn_solve(None, vec, sigma,
-                                self.threshold, rj=rj) + ((),)
+                                self.threshold, rj=rj,
+                                toa=self._toa_shard) + ((),)
         new_vec, chi2, dpar, cov, diag = wls_gn_solve(
             None, vec, sigma, self.threshold,
-            rcond=data["guard_eps"], with_health=True, rj=rj)
+            rcond=data["guard_eps"], with_health=True, rj=rj,
+            toa=self._toa_shard)
         health = _guard.step_health(
             rj[0], sigma, chi2, dpar, cov, diag,
             valid=data["valid"],
@@ -926,8 +1064,10 @@ class GLSFitter(Fitter):
 
     _noise_gram_leaves = True
 
-    def __init__(self, toas, model, residuals=None, bucket=None):
-        super().__init__(toas, model, residuals, bucket=bucket)
+    def __init__(self, toas, model, residuals=None, bucket=None,
+                 mesh=None):
+        super().__init__(toas, model, residuals, bucket=bucket,
+                         mesh=mesh)
         self.noise_realizations = {}
         self._retrace()
 
@@ -947,12 +1087,13 @@ class GLSFitter(Fitter):
             gram = None
         r, J = self._rj(vec, base_values, data)
         if not self._guard_on:
-            dpar, cov, ncoef, chi2 = gls_normal_solve(r, J, sigma, U,
-                                                      phi, gram=gram)
+            dpar, cov, ncoef, chi2 = gls_normal_solve(
+                r, J, sigma, U, phi, gram=gram, toa=self._toa_shard)
             return vec + dpar, chi2, dpar, cov, ncoef, ()
         dpar, cov, ncoef, chi2, diag = gls_normal_solve(
             r, J, sigma, U, phi, gram=gram,
-            guard_eps=data["guard_eps"], with_health=True)
+            guard_eps=data["guard_eps"], with_health=True,
+            toa=self._toa_shard)
         health = _guard.step_health(
             r, sigma, chi2, dpar, cov, diag, valid=data["valid"],
             inputs_ok=_guard.batch_input_finite(data["batch"],

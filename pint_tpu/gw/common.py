@@ -321,12 +321,17 @@ class CommonProcess:
         are counted (``guard.trip.gw_lnlike_grid``) and warned about,
         never silently returned as a clean-looking surface.
 
-        mesh: a device mesh (axis ``grid``) — the flattened point axis
-        is padded to a device multiple (edge-repeated; the pad points
-        are sliced off the returned surface) and sharded; the mesh
-        participates in the jit key, so a second same-shaped sharded
-        call compiles nothing and ``mesh=None`` behaves exactly as
-        before."""
+        mesh: a device mesh — the flattened point axis is padded to a
+        device multiple (edge-repeated; the pad points are sliced off
+        the returned surface) and sharded.  A 1-d mesh shards points
+        over its single axis (the original ``grid`` contract); a
+        MULTI-AXIS mesh (e.g. the 2-D ``pulsar x grid`` layout a
+        full-PTA scan shares with ``PTABatch.chisq_grid``) shards the
+        point axis over the product of ALL its axes, so the dense
+        hyperparameter surface runs as one program across the whole
+        pod slice with no idle sub-mesh.  The mesh participates in
+        the jit key, so a second same-shaped sharded call compiles
+        nothing and ``mesh=None`` behaves exactly as before."""
         from jax.sharding import PartitionSpec as P
 
         from pint_tpu.parallel import mesh as _mesh
@@ -352,14 +357,23 @@ class CommonProcess:
             "log10_amps": amps_flat, "gammas": gams_flat,
         }
         if mesh is not None:
-            ndev = _mesh.axis_size(mesh, "grid")
+            names = tuple(str(n) for n in mesh.axis_names)
+            if len(names) == 1:
+                ndev = _mesh.axis_size(mesh, "grid")
+                point_spec = P("grid")
+            else:
+                # multi-axis mesh: the point axis rides EVERY axis
+                # (one PartitionSpec dim over the axis tuple), so the
+                # full device product serves the scan
+                ndev = int(mesh.devices.size)
+                point_spec = P(names)
             n_pad = _mesh.pad_to_multiple(n_pts, ndev)
             _mesh.record_pad_waste("grid", n_pts, n_pad)
             for k in ("log10_amps", "gammas"):
                 args[k] = _mesh.pad_leading(args[k], n_pad,
                                             mode="edge")
             rules = tuple(
-                (pat, P(ax) if ax else None)
+                (pat, point_spec if ax else None)
                 for pat, ax in self._GRID_RULES)
             args = _mesh.shard_args(mesh, rules, args)
         with telemetry.run_scope("lnlike_grid",

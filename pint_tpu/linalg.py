@@ -21,6 +21,21 @@ the segment-id representation of an ECORR epoch-indicator block
 matmuls.  The dense path is the fallback for everything else — the
 GW dense-phi sector always passes dense arrays — and both paths are
 brute-force-verified equivalent (tests/test_design.py).
+
+Every contraction additionally accepts ``toa=`` — a
+:class:`pint_tpu.parallel.mesh.RowShard` pinning the TOA (N) axis
+onto a device mesh.  The O(N (P+K)^2) gram assembly then decomposes
+into per-shard partial contractions plus a small-(P+K) cross-device
+reduction (the rank-reduced Woodbury structure of arXiv 1210.0584):
+the sharding constraints make XLA's SPMD partitioner carry the
+N-axis blocks shard-local and insert one psum-class all-reduce per
+(K, K)/(P, K) product.  ``toa=None`` (the default) leaves every
+trace byte-identical to the unsharded build — the caller's jit key
+must carry the mesh (``mesh_jit_key``) exactly because the two
+builds differ.  Segment-sum ECORR epoch blocks must not straddle
+shard boundaries for the reduction to stay shard-local; the
+alignment contract lives in ``mesh.toa_shard_plan`` and the fitter
+entry (docs/sharding.md).
 """
 
 from __future__ import annotations
@@ -115,20 +130,33 @@ def su_pad_rows(su: StructuredU, n_rows: int):
     )
 
 
-def _ut_dot(U, y):
-    """``U^T @ y`` for dense or structured U; y is (N,) or (N, M)."""
+def _rows(toa, x):
+    """Apply a RowShard's leading-axis constraint (identity when
+    ``toa`` is None — the unsharded trace is byte-identical)."""
+    return x if toa is None else toa.rows(x)
+
+
+def _ut_dot(U, y, toa=None):
+    """``U^T @ y`` for dense or structured U; y is (N,) or (N, M).
+    With ``toa``, the N-axis contraction reduces per shard then
+    all-reduces over the K axis (sharding-constraint psum)."""
+    y = _rows(toa, y)
     if not isinstance(U, StructuredU):
-        return U.T @ y
+        return _rows(toa, U).T @ y
     k_e = U.eslot.shape[0]
-    seg_part = jax.ops.segment_sum(y, U.seg, num_segments=k_e + 1)[:k_e]
-    return jnp.concatenate([U.pre.T @ y, seg_part, U.post.T @ y],
+    seg_part = jax.ops.segment_sum(y, _rows(toa, U.seg),
+                                   num_segments=k_e + 1)[:k_e]
+    return jnp.concatenate([_rows(toa, U.pre).T @ y, seg_part,
+                            _rows(toa, U.post).T @ y],
                            axis=0)
 
 
-def _u_dot(U, x):
-    """``U @ x`` for dense or structured U; x is (K,) or (K, M)."""
+def _u_dot(U, x, toa=None):
+    """``U @ x`` for dense or structured U; x is (K,) or (K, M).  The
+    output carries the TOA axis, so with ``toa`` it is constrained
+    back onto the mesh (x itself is small and replicated)."""
     if not isinstance(U, StructuredU):
-        return U @ x
+        return _rows(toa, U) @ x
     k_pre = U.pre.shape[1]
     k_e = U.eslot.shape[0]
     x_pre = x[:k_pre]
@@ -137,17 +165,24 @@ def _u_dot(U, x):
     # out-of-epoch rows (seg == k_e) must gather zero
     x_e_ext = jnp.concatenate(
         [x_e, jnp.zeros((1,) + x_e.shape[1:], dtype=x_e.dtype)], axis=0)
-    return U.pre @ x_pre + x_e_ext[U.seg] + U.post @ x_post
+    return (_rows(toa, U.pre) @ x_pre + x_e_ext[_rows(toa, U.seg)]
+            + _rows(toa, U.post) @ x_post)
 
 
-def _weighted_gram(U, w):
+def _weighted_gram(U, w, toa=None):
     """``U^T diag(w) U`` for dense or structured U — THE capacity-gram
     build.  Structured path: the epoch block's products become one
     scalar segment-sum (diagonal block) plus segment-sums of the
-    weighted dense columns (cross blocks)."""
+    weighted dense columns (cross blocks).  With ``toa`` the (K, K)
+    gram assembles from shard-local partial grams plus one
+    all-reduce — the dominant saving of the sharded GLS fit."""
+    w = _rows(toa, w)
     if not isinstance(U, StructuredU):
+        U = _rows(toa, U)
         return (U.T * w[None, :]) @ U
     k_e = U.eslot.shape[0]
+    U = StructuredU(pre=_rows(toa, U.pre), seg=_rows(toa, U.seg),
+                    eslot=U.eslot, post=_rows(toa, U.post))
     pre_w = U.pre * w[:, None]
     post_w = U.post * w[:, None]
     g_pp = U.pre.T @ pre_w
@@ -208,7 +243,7 @@ def _phi_terms(phi, jitter=None):
     return jnp.diag(1.0 / phi), jnp.sum(jnp.log(phi))
 
 
-def _capacity(sigma, U, phi, jitter=None):
+def _capacity(sigma, U, phi, jitter=None, toa=None):
     """THE capacity-matrix construction every Woodbury path shares:
     ``(nvec, cho_factor(U^T N^-1 U + Phi^-1), logdet Phi)``.  A
     conditioning or masking change here reaches chi2/logdet, solve,
@@ -222,8 +257,8 @@ def _capacity(sigma, U, phi, jitter=None):
     original — the serving rung is recorded in fit meta so degraded
     results are never mistaken for clean ones."""
     phi_inv, logdet_phi = _phi_terms(phi, jitter=jitter)
-    nvec = sigma**2
-    sigma_cap = _weighted_gram(U, 1.0 / nvec) + phi_inv
+    nvec = _rows(toa, sigma**2)
+    sigma_cap = _weighted_gram(U, 1.0 / nvec, toa=toa) + phi_inv
     if jitter is not None:
         d = jnp.abs(jnp.diag(sigma_cap))
         sigma_cap = sigma_cap + jitter * jnp.diag(d)
@@ -231,7 +266,8 @@ def _capacity(sigma, U, phi, jitter=None):
     return nvec, cf, logdet_phi
 
 
-def woodbury_chi2_logdet(r, sigma, U, phi, valid=None, jitter=None):
+def woodbury_chi2_logdet(r, sigma, U, phi, valid=None, jitter=None,
+                         toa=None):
     """(chi2, logdet C) for C = diag(sigma^2) + U Phi U^T.
 
     chi2 = r^T C^-1 r via the Woodbury identity; logdet via the matrix
@@ -245,11 +281,14 @@ def woodbury_chi2_logdet(r, sigma, U, phi, valid=None, jitter=None):
     other reduction, but their log sigma^2 would shift — and, with
     EFAC free, bias — the log-likelihood).  jitter: optional traced
     scalar, the guard ladder's capacity/prior ridge (see
-    :func:`_capacity`).
+    :func:`_capacity`).  toa: optional
+    :class:`pint_tpu.parallel.mesh.RowShard` sharding the N axis over
+    a device mesh (module docstring).
     """
-    nvec, cf, logdet_phi = _capacity(sigma, U, phi, jitter=jitter)
-    ninv_r = r / nvec
-    ut_ninv_r = _ut_dot(U, ninv_r)
+    nvec, cf, logdet_phi = _capacity(sigma, U, phi, jitter=jitter,
+                                     toa=toa)
+    ninv_r = _rows(toa, r) / nvec
+    ut_ninv_r = _ut_dot(U, ninv_r, toa=toa)
     x = jax.scipy.linalg.cho_solve(cf, ut_ninv_r)
     chi2 = jnp.sum(r * ninv_r) - jnp.sum(ut_ninv_r * x)
     log_nvec = jnp.log(nvec)
@@ -263,17 +302,18 @@ def woodbury_chi2_logdet(r, sigma, U, phi, valid=None, jitter=None):
     return chi2, logdet
 
 
-def woodbury_solve(sigma, U, phi, y):
+def woodbury_solve(sigma, U, phi, y, toa=None):
     """C^-1 y for C = diag(sigma^2) + U Phi U^T, with y a vector (N,)
     or a matrix (N, M) of right-hand sides.  The cross-correlation
     engine (:mod:`pint_tpu.gw.os`) whitens residuals and GW bases
     through this; ``phi`` follows the vector/dense convention of
-    :func:`woodbury_chi2_logdet`."""
-    nvec, cf, _ = _capacity(sigma, U, phi)
+    :func:`woodbury_chi2_logdet`, ``toa`` the RowShard convention of
+    the module docstring."""
+    nvec, cf, _ = _capacity(sigma, U, phi, toa=toa)
     y2 = y if y.ndim == 2 else y[:, None]
-    ninv_y = y2 / nvec[:, None]
-    x = jax.scipy.linalg.cho_solve(cf, _ut_dot(U, ninv_y))
-    out = ninv_y - _u_dot(U, x) / nvec[:, None]
+    ninv_y = _rows(toa, y2) / nvec[:, None]
+    x = jax.scipy.linalg.cho_solve(cf, _ut_dot(U, ninv_y, toa=toa))
+    out = ninv_y - _u_dot(U, x, toa=toa) / nvec[:, None]
     return out if y.ndim == 2 else out[:, 0]
 
 
@@ -344,7 +384,7 @@ def noise_gram_precompute(sigma, U, phi):
 
 
 def gls_normal_solve(r, J, sigma, U, phi, pre=None, gram=None,
-                     guard_eps=None, with_health=False):
+                     guard_eps=None, with_health=False, toa=None):
     """Solve the noise-augmented GLS normal equations (reference:
     GLSFitter.fit_toas, fitter.py:2164-2204).
 
@@ -381,34 +421,42 @@ def gls_normal_solve(r, J, sigma, U, phi, pre=None, gram=None,
     new compiles.  with_health: additionally return a
     :class:`pint_tpu.guard.SolveDiag` (truncated-direction count +
     condition proxy from the eigh spectrum already in hand).
+
+    toa: optional :class:`pint_tpu.parallel.mesh.RowShard` — every
+    N-axis product (the J^T W J / J^T W U / U^T W U blocks and both
+    right-hand sides) assembles shard-local and all-reduces at the
+    small (P+K) edge, so a 20-year single-pulsar gram parallelizes
+    across devices (module docstring).
     """
     n_par = J.shape[1]
     nb = basis_ncols(U)
-    nvec = sigma**2
+    r = _rows(toa, r)
+    J = _rows(toa, J)
+    nvec = _rows(toa, sigma**2)
     w = 1.0 / nvec
     if gram is not None and nb:
         # constant-gram fast path: only the design-dependent blocks
         # are built per call; the (K, K) noise block is data
         Jw = J * w[:, None]
         a_jj = J.T @ Jw
-        a_ju = _ut_dot(U, Jw).T           # (P, K)
+        a_ju = _ut_dot(U, Jw, toa=toa).T  # (P, K)
         mtcm = jnp.block([[a_jj, a_ju],
                           [a_ju.T, gram]])
-        rhs = jnp.concatenate([Jw.T @ r, _ut_dot(U, w * r)])
+        rhs = jnp.concatenate([Jw.T @ r, _ut_dot(U, w * r, toa=toa)])
     elif isinstance(U, StructuredU):
         # structured normal equations: the ECORR epoch block of
         # M = [J | U] enters every product through segment-sums
         # (_ut_dot/_weighted_gram) instead of dense (N, K_e) matmuls
         Jw = J * w[:, None]
         a_jj = J.T @ Jw
-        a_ju = _ut_dot(U, Jw).T           # (P, K)
-        a_uu = _weighted_gram(U, w)
+        a_ju = _ut_dot(U, Jw, toa=toa).T  # (P, K)
+        a_uu = _weighted_gram(U, w, toa=toa)
         phi_inv, _ = _phi_terms(phi)
         mtcm = jnp.block([[a_jj, a_ju],
                           [a_ju.T, a_uu + phi_inv]])
-        rhs = jnp.concatenate([Jw.T @ r, _ut_dot(U, w * r)])
+        rhs = jnp.concatenate([Jw.T @ r, _ut_dot(U, w * r, toa=toa)])
     else:
-        M = jnp.concatenate([J, U], axis=1) if nb else J
+        M = jnp.concatenate([J, _rows(toa, U)], axis=1) if nb else J
         mtn = (M * w[:, None]).T
         if nb:
             phi_inv, _ = _phi_terms(phi)
@@ -455,12 +503,12 @@ def gls_normal_solve(r, J, sigma, U, phi, pre=None, gram=None,
                 cap = cap + guard_eps * jnp.diag(jnp.abs(jnp.diag(cap)))
             cf = jax.scipy.linalg.cho_factor(cap, lower=True)
             ninv_r = r / nvec
-            ut_ninv_r = _ut_dot(U, ninv_r)
+            ut_ninv_r = _ut_dot(U, ninv_r, toa=toa)
             x = jax.scipy.linalg.cho_solve(cf, ut_ninv_r)
             chi2 = jnp.sum(r * ninv_r) - jnp.sum(ut_ninv_r * x)
         else:
             chi2, _ = woodbury_chi2_logdet(r, sigma, U, phi,
-                                           jitter=guard_eps)
+                                           jitter=guard_eps, toa=toa)
     else:
         chi2 = jnp.sum((r / sigma) ** 2)
     out = (

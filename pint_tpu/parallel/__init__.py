@@ -9,7 +9,9 @@ reductions.
 """
 
 from pint_tpu.parallel.mesh import (  # noqa: F401
-    AXIS_NAMES, make_mesh, match_partition_rules, mesh_desc,
-    mesh_jit_key, pad_leading, pad_to_multiple, shard_args)
+    AXIS_NAMES, RowShard, distributed_init, make_mesh,
+    match_partition_rules, mesh_desc, mesh_jit_key, pad_leading,
+    pad_to_multiple, process_topology, shard_args, shard_toa_data,
+    toa_epochs_aligned, toa_shard_plan)
 from pint_tpu.parallel.pta import (  # noqa: F401
-    PTA_BATCH_RULES, PTABatch, pulsar_mesh)
+    PTA_BATCH_RULES, PTA_GRID_RULES, PTABatch, pulsar_mesh)

@@ -45,6 +45,21 @@ extra registry entry (a second same-shaped sharded call performs zero
 new XLA compiles), and ``mesh=None`` keys exactly as before, so the
 single-device program is bit-identical to the pre-mesh behavior.
 
+Beyond the four batch axes, a fifth named axis — ``toa`` — shards the
+SEQUENCE dimension inside a single pulsar: the Woodbury contractions
+of :mod:`pint_tpu.linalg` reduce their O(N (P+K)^2) gram assembly as
+per-shard partial contractions plus a small-K cross-device reduction
+(the rank-reduced decomposition of arXiv 1210.0584), expressed as
+sharding constraints (:class:`RowShard`) that GSPMD lowers to
+psum-style all-reduces.  Segment-sum ECORR epoch blocks must not
+straddle shard boundaries — :func:`toa_shard_plan` computes the
+pad-row insertion that aligns them (or reports the dense fallback).
+
+Multi-process pods initialize through :func:`distributed_init`
+(inert in a single process); the process topology participates in
+:func:`mesh_jit_key` — and, through it, in the AOT manifest — so
+serialized executables are per-topology artifacts.
+
 Telemetry: ``mesh.sharded_calls`` counts :func:`shard_args`
 invocations that actually placed data on a mesh;
 ``mesh.pad_waste_frac`` gauges the phantom-row overhead of the most
@@ -65,11 +80,103 @@ __all__ = [
     "resolve_axis", "axis_size", "match_partition_rules",
     "named_tree_map", "tree_paths", "pad_to_multiple", "pad_leading",
     "record_pad_waste", "shard_args", "replicate",
+    "distributed_init", "process_topology", "RowShard",
+    "shard_toa_data", "toa_epochs_aligned", "toa_shard_plan",
 ]
 
 #: the canonical batch axes of this codebase (a mesh may use any
-#: subset, and other names are allowed for experiments)
-AXIS_NAMES = ("pulsar", "grid", "walker", "pair")
+#: subset, and other names are allowed for experiments).  ``toa`` is
+#: the in-pulsar sequence axis (linalg Woodbury reductions), not a
+#: batch axis — it never vmaps, it shards the N dimension itself.
+AXIS_NAMES = ("pulsar", "grid", "walker", "pair", "toa")
+
+
+# --------------------------------------------------------------------------
+# multi-process scaffolding
+# --------------------------------------------------------------------------
+
+#: record of the last distributed_init() call (None = never called)
+_DISTRIBUTED: Optional[dict] = None
+
+
+def distributed_init(coordinator_address=None, num_processes=None,
+                     process_id=None, local_device_ids=None):
+    """Initialize the multi-process JAX runtime for pod-spanning
+    meshes — the ``jax.distributed.initialize`` entry of this layer.
+
+    On a multi-host pod slice, call this ONCE per process before any
+    jax computation; afterwards ``jax.devices()`` spans every process
+    and :func:`make_mesh` builds process-spanning meshes (the pjit
+    contract of SNIPPETS.md [1]: "pjit can run computations across
+    all available devices across processes").  With no arguments and
+    no cluster environment (the single-process case — every CPU dev
+    box and single-host TPU VM), this is INERT: no collective setup
+    is attempted, and the returned topology record simply says
+    ``processes=1``.
+
+    The returned record ``{"processes", "process_id",
+    "local_devices", "devices", "initialized"}`` is also what
+    :func:`mesh_jit_key` folds into every sharded jit key (and,
+    through ``compile_cache._aot_env``, into the AOT manifest): a
+    serialized executable is a per-topology artifact — an 8-process
+    pod program must never be served to a 4-process slice.
+    Idempotent: a second call returns the existing record."""
+    global _DISTRIBUTED
+    import jax
+
+    explicit = any(v is not None for v in
+                   (coordinator_address, num_processes, process_id))
+    if _DISTRIBUTED is not None:
+        if explicit and not _DISTRIBUTED["initialized"]:
+            # an earlier no-arg call ran inert; silently returning the
+            # stale single-process record would swallow the pod setup
+            # (meshes stay single-host, the AOT manifest records the
+            # wrong topology) with no error anywhere
+            raise ValueError(
+                "distributed_init already ran inert in this process "
+                "(single-process record cached); pass the coordinator "
+                "arguments on the FIRST call, before any jax "
+                "computation")
+        return _DISTRIBUTED
+    import os as _os
+
+    cluster_env = any(_os.environ.get(k) for k in
+                      ("JAX_COORDINATOR_ADDRESS",
+                       "COORDINATOR_ADDRESS", "CLOUD_TPU_TASK_ID",
+                       "TPU_WORKER_HOSTNAMES"))
+    initialized = False
+    if explicit or cluster_env:
+        kwargs = {}
+        if coordinator_address is not None:
+            kwargs["coordinator_address"] = coordinator_address
+        if num_processes is not None:
+            kwargs["num_processes"] = int(num_processes)
+        if process_id is not None:
+            kwargs["process_id"] = int(process_id)
+        if local_device_ids is not None:
+            kwargs["local_device_ids"] = local_device_ids
+        jax.distributed.initialize(**kwargs)
+        initialized = True
+    _DISTRIBUTED = {
+        "processes": int(jax.process_count()),
+        "process_id": int(jax.process_index()),
+        "local_devices": len(jax.local_devices()),
+        "devices": len(jax.devices()),
+        "initialized": initialized,
+    }
+    telemetry.gauge_set("mesh.processes", _DISTRIBUTED["processes"])
+    return _DISTRIBUTED
+
+
+def process_topology() -> dict:
+    """The process topology every sharded jit key (and the AOT
+    manifest) records: ``{"processes": P, "local_devices": D}``.
+    Works without :func:`distributed_init` (a plain single process
+    reports ``processes=1``); after it, reflects the pod."""
+    import jax
+
+    return {"processes": int(jax.process_count()),
+            "local_devices": len(jax.local_devices())}
 
 
 # --------------------------------------------------------------------------
@@ -117,15 +224,21 @@ def make_mesh(axes="pulsar", n_devices=None, shape=None):
 def mesh_desc(mesh) -> Optional[dict]:
     """Structured record of a mesh for bench metrics and the profiling
     program registry: ``{"devices": N, "axes": {name: size, ...}}``
-    (None for no mesh)."""
+    (+ ``processes`` on a multi-process topology; None for no
+    mesh)."""
     if mesh is None:
         return None
-    return {
+    out = {
         "devices": int(mesh.devices.size),
         "axes": {str(name): int(size)
                  for name, size in zip(mesh.axis_names,
                                        mesh.devices.shape)},
     }
+    topo = process_topology()
+    if topo["processes"] > 1:
+        out["processes"] = topo["processes"]
+        out["local_devices"] = topo["local_devices"]
+    return out
 
 
 def mesh_jit_key(mesh) -> tuple:
@@ -133,29 +246,50 @@ def mesh_jit_key(mesh) -> tuple:
     single-device keys are unchanged from the pre-mesh layout), else a
     stable ``("mesh", ((axis, size), ...))`` tuple.  One mesh = one
     registry entry = zero new XLA compiles on the second same-shaped
-    sharded call."""
+    sharded call.
+
+    On a multi-process runtime (:func:`distributed_init`) the key
+    additionally carries ``("procs", process_count,
+    devices_per_process)``: the SAME axis layout cut across a
+    different process topology lowers to different collectives, so a
+    pod program and a single-host program must occupy separate
+    registry entries — and separate AOT manifest entries (the
+    manifest records the topology through ``compile_cache._aot_env``).
+    Single-process keys are byte-identical to the pre-pod layout."""
     if mesh is None:
         return ()
-    return ("mesh", tuple(
+    key = ("mesh", tuple(
         (str(name), int(size))
         for name, size in zip(mesh.axis_names, mesh.devices.shape)))
+    topo = process_topology()
+    if topo["processes"] > 1:
+        key = key + (("procs", topo["processes"],
+                      topo["local_devices"]),)
+    return key
 
 
-def resolve_axis(mesh, axis: str) -> str:
+def resolve_axis(mesh, axis: str, requested_by: Optional[str] = None) \
+        -> str:
     """The mesh axis a canonical axis name rides.  An exact name match
     wins; a 1-d mesh serves ANY axis under its own name (the gw/os
     contract: "the axis name is immaterial, pairs ride it", so a
     ``pulsar_mesh`` can shard the pair axis); a multi-axis mesh
     missing the name is an error — guessing which axis to ride would
-    silently mis-shard."""
+    silently mis-shard.  ``requested_by`` names the partition rule /
+    data leaf that asked, so a misconfigured pod mesh is diagnosed at
+    the rule that tripped it, not from a bare axis name."""
     names = tuple(str(n) for n in mesh.axis_names)
     if axis in names:
         return axis
     if len(names) == 1:
         return names[0]
     raise ValueError(
-        f"mesh axes {names} do not include {axis!r}; name the axis "
-        "explicitly when building a multi-axis mesh")
+        f"mesh axes {names} do not include axis {axis!r}"
+        + (f" (requested by {requested_by})" if requested_by else "")
+        + f"; available axes on this {len(names)}-d mesh are "
+        + ", ".join(repr(n) for n in names)
+        + " — name one of them in the rule, or build the mesh with "
+        f"make_mesh(axes=(..., {axis!r}), shape=...)")
 
 
 def axis_size(mesh, axis: str) -> int:
@@ -277,9 +411,11 @@ def match_partition_rules(rules, tree, *, overrides=None):
     return named_tree_map(_rule_resolver(rules, overrides), tree)
 
 
-def _resolve_spec(mesh, spec):
+def _resolve_spec(mesh, spec, requested_by=None):
     """A rule's PartitionSpec with canonical axis names mapped onto
-    the mesh's real axes (:func:`resolve_axis`)."""
+    the mesh's real axes (:func:`resolve_axis`).  ``requested_by``
+    flows into the absent-axis diagnostic so the error names the data
+    leaf whose rule asked for the missing axis."""
     from jax.sharding import PartitionSpec as PS
 
     parts = []
@@ -287,9 +423,12 @@ def _resolve_spec(mesh, spec):
         if entry is None:
             parts.append(None)
         elif isinstance(entry, (list, tuple)):
-            parts.append(tuple(resolve_axis(mesh, a) for a in entry))
+            parts.append(tuple(
+                resolve_axis(mesh, a, requested_by=requested_by)
+                for a in entry))
         else:
-            parts.append(resolve_axis(mesh, str(entry)))
+            parts.append(resolve_axis(mesh, str(entry),
+                                      requested_by=requested_by))
     return PS(*parts)
 
 
@@ -312,7 +451,9 @@ def shard_args(mesh, rules, tree, *, overrides=None):
                      (int(s) for s in mesh.devices.shape)))
 
     def put(path, leaf):
-        resolved = _resolve_spec(mesh, resolve(path, leaf))
+        resolved = _resolve_spec(
+            mesh, resolve(path, leaf),
+            requested_by=f"the rule for data leaf {path!r}")
         for dim, entry in enumerate(resolved):
             axes = (entry,) if isinstance(entry, str) else (entry or ())
             need = int(np.prod([sizes[a] for a in axes])) if axes else 1
@@ -366,6 +507,178 @@ def pad_leading(arr, n_target: int, mode: str = "edge", fill=None):
     else:
         raise ValueError(f"pad_leading: unknown mode {mode!r}")
     return jnp.concatenate([arr, tail], axis=0)
+
+
+# --------------------------------------------------------------------------
+# TOA-axis (sequence) sharding
+# --------------------------------------------------------------------------
+
+class RowShard:
+    """Static sharding context for the leading (TOA) axis of in-trace
+    arrays — the object the Woodbury contractions of
+    :mod:`pint_tpu.linalg` receive as their ``toa=`` argument.
+
+    ``rows(x)`` pins an array's leading dimension onto the resolved
+    mesh axis with ``jax.lax.with_sharding_constraint``; XLA's SPMD
+    partitioner then carries the per-shard partial contractions and
+    inserts the small-K all-reduce at each ``U^T N^-1 U`` / ``J^T W
+    r`` reduction — the psum-over-TOA-axis decomposition of the
+    rank-reduced Woodbury algebra (arXiv 1210.0584).  Instances are
+    closed over at trace time (never passed through jit), so the mesh
+    MUST participate in the caller's jit key (``mesh_jit_key``)."""
+
+    def __init__(self, mesh, axis: str = "toa"):
+        self.mesh = mesh
+        self.axis = resolve_axis(mesh, axis,
+                                 requested_by="RowShard")
+
+    def rows(self, x):
+        """Constrain ``x``'s leading axis onto the TOA mesh axis."""
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as PS
+
+        spec = PS(*((self.axis,) + (None,) * (x.ndim - 1)))
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(self.mesh, spec))
+
+    def __repr__(self):
+        return f"RowShard({mesh_desc(self.mesh)}, axis={self.axis!r})"
+
+
+def shard_toa_data(mesh, tree, n_toa: int, axis: str = "toa"):
+    """Structural TOA-axis sharding of a fit-data pytree: every array
+    leaf gets the resolved ``toa`` mesh axis on its FIRST dimension of
+    length ``n_toa`` (the same shape-sniffing convention
+    ``parallel/pta._pad_ctx`` pads by — component ctx arrays carry the
+    TOA axis leading or trailing, batch arrays leading); every other
+    leaf replicates.  ``mesh=None`` is the identity.
+
+    ``n_toa`` must already be a multiple of the axis extent
+    (:func:`pad_to_multiple` + ``compile_cache.pad_toas`` first)."""
+    if mesh is None:
+        return tree
+    import jax
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as PS
+
+    name = resolve_axis(mesh, axis, requested_by="shard_toa_data")
+    extent = axis_size(mesh, axis)
+    if n_toa % extent:
+        raise ValueError(
+            f"shard_toa_data: TOA count {n_toa} is not a multiple of "
+            f"the {name!r} mesh extent {extent}; pad the dataset "
+            "first (compile_cache.pad_toas)")
+
+    def put(path, leaf):
+        shape = np.shape(leaf)
+        dims = [None] * len(shape)
+        for d, s in enumerate(shape):
+            if s == n_toa:
+                dims[d] = name
+                break
+        return jax.device_put(leaf,
+                              NamedSharding(mesh, PS(*dims)))
+
+    out = named_tree_map(put, tree)
+    telemetry.counter_add("mesh.sharded_calls")
+    return out
+
+
+def toa_epochs_aligned(seg, n_epoch: int, n_shards: int) -> bool:
+    """True when no ECORR epoch's row span straddles a TOA-shard
+    boundary (``seg`` length must already be a shard multiple) — the
+    condition under which the sharded segment-sum reduction stays
+    shard-local."""
+    seg = np.asarray(seg)
+    n = int(seg.shape[0])
+    n_shards = max(1, int(n_shards))
+    if n % n_shards:
+        return False
+    s = n // n_shards
+    for e in range(int(n_epoch)):
+        rows = np.flatnonzero(seg == e)
+        if rows.size and rows[0] // s != rows[-1] // s:
+            return False
+    return True
+
+
+def toa_shard_plan(seg, n_epoch: int, n_shards: int,
+                   max_grow: int = 16):
+    """Row-insertion plan aligning ECORR epoch blocks to TOA-shard
+    boundaries.
+
+    ``seg``: per-TOA int epoch ids (``StructuredU.seg`` — ``n_epoch``
+    means "no epoch").  A segment-sum epoch block whose rows straddle
+    a shard edge forces a cross-device scatter-add; this plan inserts
+    zero-weight pad rows (sentinel clones, the ``pad_toas``
+    convention) so every epoch's row span lands inside one shard.
+
+    Returns an int array ``plan`` whose entries are source-row
+    indices with ``-1`` marking an inserted pad row (clone of the
+    nearest preceding source row — which joins that row's epoch, so
+    the preceding block extends exactly TO the boundary, never past
+    it), ``len(plan)`` a multiple of ``n_shards``; or ``None`` when
+    alignment is impossible (an epoch cluster larger than a shard
+    even after ``max_grow`` target growths) — the caller falls back
+    to the dense basis.  A ``plan`` that is simply
+    ``arange(n)`` + tail pads means the layout was already aligned.
+
+    Epochs whose row spans interleave (two receivers observing the
+    same night) are merged into one cluster and moved together."""
+    seg = np.asarray(seg)
+    n = int(seg.shape[0])
+    n_shards = max(1, int(n_shards))
+    # per-epoch [min_row, max_row] spans -> merged clusters
+    spans = []
+    for e in range(int(n_epoch)):
+        rows = np.flatnonzero(seg == e)
+        if rows.size:
+            spans.append((int(rows[0]), int(rows[-1])))
+    spans.sort()
+    clusters = []
+    for lo, hi in spans:
+        if clusters and lo <= clusters[-1][1]:
+            clusters[-1][1] = max(clusters[-1][1], hi)
+        else:
+            clusters.append([lo, hi])
+    # blocks in row order: cluster spans move as units, rows between
+    # them are free singletons
+    blocks = []
+    row = 0
+    for lo, hi in clusters:
+        while row < lo:
+            blocks.append((row, 1))
+            row += 1
+        blocks.append((lo, hi - lo + 1))
+        row = hi + 1
+    while row < n:
+        blocks.append((row, 1))
+        row += 1
+    for target in range(pad_to_multiple(n, n_shards),
+                        pad_to_multiple(n, n_shards)
+                        + max_grow * n_shards + 1, n_shards):
+        if target == 0:
+            continue
+        s = target // n_shards
+        if any(length > s for _, length in blocks):
+            return None  # a cluster can never fit in one shard
+        plan = []
+        ok = True
+        for start, length in blocks:
+            pos = len(plan)
+            if length > 1 and pos // s != (pos + length - 1) // s:
+                # push the block to the next shard boundary with pads
+                plan.extend([-1] * (s - pos % s))
+            if len(plan) + length > target:
+                ok = False  # ran out of room; grow the target
+                break
+            plan.extend(range(start, start + length))
+        if not ok:
+            continue
+        plan.extend([-1] * (target - len(plan)))
+        return np.asarray(plan, dtype=np.int64)
+    return None
 
 
 def record_pad_waste(axis: str, n_real: int, n_padded: int):

@@ -34,7 +34,8 @@ from pint_tpu.parallel import mesh as _mesh
 from pint_tpu.residuals import Residuals
 from pint_tpu.telemetry import span
 
-__all__ = ["PTABatch", "pulsar_mesh", "PTA_BATCH_RULES"]
+__all__ = ["PTABatch", "pulsar_mesh", "PTA_BATCH_RULES",
+           "PTA_GRID_RULES"]
 
 
 def pulsar_mesh(n_devices=None):
@@ -62,6 +63,12 @@ PTA_BATCH_RULES = (
     (r"^(U|phi|dm_data|dm_error|dm_valid)(/|$)", _P("pulsar")),
     (r"^guard_eps$", None),
 )
+
+#: the 2-D pulsar x grid scan table (PTABatch.chisq_grid): grid-point
+#: values ride the ``grid`` mesh axis, every stacked per-pulsar leaf
+#: rides ``pulsar`` — BOTH axes resolve over the same data pytree, so
+#: a full-PTA hyperparameter scan runs as ONE program on a 2-D mesh
+PTA_GRID_RULES = ((r"^grid_values$", _P("grid")),) + PTA_BATCH_RULES
 
 
 def _pad_batch(batch, n_max):
@@ -962,15 +969,7 @@ class PTABatch:
             # any merge/write-back/checkpoint path can see it
             ndev = _mesh.axis_size(mesh, "pulsar")
             k_pad = _mesh.pad_to_multiple(n_real, ndev)
-            if k_pad != n_real:
-                args = {
-                    k: (None if v is None else _mesh.named_tree_map(
-                        lambda _p, leaf: _mesh.pad_leading(
-                            leaf, k_pad, mode="edge"), v))
-                    for k, v in args.items()
-                }
-                args["free_mask"] = args["free_mask"].at[n_real:].set(
-                    0.0)
+            args = self._phantom_pad_args(args, k_pad)
             _mesh.record_pad_waste("pulsar", n_real, k_pad)
             args = _mesh.shard_args(mesh, PTA_BATCH_RULES, args)
             if k_pad != n_real:
@@ -1098,6 +1097,24 @@ class PTABatch:
                        "indices kept their pre-fit values")
         return vec, chi2, cov
 
+    def _phantom_pad_args(self, args, k_pad):
+        """Phantom-pad every pulsar-stacked arg of ``args`` to
+        ``k_pad`` members: edge clones of the last real pulsar (always
+        finite) with their ``free_mask`` rows zeroed.  Shared by the
+        batched fits and the 2-D chi^2 scan — the ONE place the
+        phantom convention lives."""
+        n_real = self.n_pulsars
+        if k_pad == n_real:
+            return args
+        args = {
+            k: (None if v is None else _mesh.named_tree_map(
+                lambda _p, leaf: _mesh.pad_leading(
+                    leaf, k_pad, mode="edge"), v))
+            for k, v in args.items()
+        }
+        args["free_mask"] = args["free_mask"].at[n_real:].set(0.0)
+        return args
+
     def _noise_basis_width(self):
         """Widest per-pulsar noise-basis width (FLOP accounting)."""
         return max(
@@ -1197,6 +1214,178 @@ class PTABatch:
                                     iter_trace=iter_trace)
             if not self._kepler_depth_guard():
                 return out
+
+    # -- 2-D pulsar x grid chi^2 scan -----------------------------------------
+    def _build_chisq_grid(self, gnames, gidx, n_steps, kind, scan):
+        """The pure (grid-point, pulsar) chi^2 function, vmapped over
+        BOTH axes: the inner vmap is the per-pulsar fixed-count GN
+        refit (``_fit_one``/``_fit_one_gls`` with the gridded
+        parameters pinned — their free_mask entries zeroed, so their
+        design columns are exactly zero), the outer vmap runs grid
+        points.  Output (n_points, n_pulsars)."""
+        tzr_ax = 0 if self.tzr_batch is not None else None
+        tcx_ax = 0 if self.tzr_ctx is not None else None
+        gidx_j = jnp.asarray(np.asarray(gidx))
+
+        def pin(gvec, vec0, base_values, free_mask):
+            vec = vec0.at[gidx_j].set(gvec)
+            fmask = free_mask.at[gidx_j].set(0.0)
+            base = dict(base_values)
+            for j, name in enumerate(gnames):
+                base[name] = gvec[j]
+            return vec, base, fmask
+
+        if kind == "wls":
+            def one(gvec, vec0, base_values, batch, ctx, tzr_b,
+                    tzr_c, valid, free_mask, guard_eps):
+                vec, base, fmask = pin(gvec, vec0, base_values,
+                                       free_mask)
+                _, chi2, _, _ = self._fit_one(
+                    vec, base, batch, ctx, tzr_b, tzr_c, valid,
+                    fmask, guard_eps, n_steps, False, scan=scan)
+                return chi2
+
+            in_ax = (None, 0, 0, 0, 0, tzr_ax, tcx_ax, 0, 0, None)
+        else:
+            def one(gvec, vec0, base_values, batch, ctx, tzr_b,
+                    tzr_c, valid, free_mask, U, phi, guard_eps):
+                vec, base, fmask = pin(gvec, vec0, base_values,
+                                       free_mask)
+                _, chi2, _, _ = self._fit_one_gls(
+                    vec, base, batch, ctx, tzr_b, tzr_c, valid,
+                    fmask, U, phi, guard_eps, n_steps, False,
+                    scan=scan)
+                return chi2
+
+            in_ax = (None, 0, 0, 0, 0, tzr_ax, tcx_ax, 0, 0, 0, 0,
+                     None)
+        per_pulsar = jax.vmap(one, in_axes=in_ax)
+        return jax.vmap(per_pulsar,
+                        in_axes=(0,) + (None,) * (len(in_ax) - 1))
+
+    def _chisq_grid_jit(self, gnames, n_steps, kind, mesh=None):
+        """ONE jitted 2-D scan per (grid params, step count, kind,
+        mesh layout), memoized on the instance and registry-shared —
+        a second same-shaped (possibly 2-D-sharded) scan compiles
+        nothing."""
+        scan = _cc.scan_iters_default()
+        mesh_key = _mesh.mesh_jit_key(mesh)
+        cache = getattr(self, "_fit_jit_cache", None)
+        if cache is None:
+            cache = self._fit_jit_cache = {}
+        ck = ("chisq_grid", gnames, int(n_steps), kind, scan,
+              mesh_key)
+        got = cache.get(ck)
+        if got is None:
+            gidx = [self.free_names.index(p) for p in gnames]
+            got = cache[ck] = _cc.shared_jit(
+                self._build_chisq_grid(gnames, gidx, n_steps, kind,
+                                       scan),
+                key=("pta.chisq_grid", gnames, int(n_steps), kind,
+                     scan, self._structure_key()) + mesh_key,
+                fn_token="pta.chisq_grid",
+                label="pta.chisq_grid:" + "+".join(gnames)
+                      + (":sharded" if mesh is not None else ""))
+            got.set_mesh(_mesh.mesh_desc(mesh))
+        else:
+            telemetry.counter_add("pta.fit_jit_cache_hits")
+        return got
+
+    def chisq_grid(self, grid_params, grid_values, n_steps=2,
+                   mesh=None):
+        """Per-pulsar chi^2 over a shared grid of pinned parameter
+        values — the whole (pulsar x grid point) scan as ONE XLA
+        program.  Returns ``chi2 (n_pulsars, n_points)``.
+
+        grid_params: names from the batch's free union, pinned at
+        each grid point's values (their free_mask entries are zeroed
+        in-trace, so the remaining per-pulsar parameters refit by
+        ``n_steps`` Gauss-Newton iterations around them — the batched
+        counterpart of :func:`pint_tpu.grid.grid_chisq_tuple`).
+        grid_values: (n_points, len(grid_params)).
+
+        mesh: ``None`` (single program, unsharded), a 1-d mesh (the
+        PULSAR axis rides it, grid points replicate), or a 2-D
+        ``pulsar x grid`` mesh
+        (``make_mesh(("pulsar", "grid"), shape=(P, G))``) — the rule
+        table resolves BOTH axes over one data pytree, phantom-pulsar
+        padding composes with grid-point edge-padding (each axis's
+        overhead lands in its own ``mesh.pad_waste_frac.<axis>``
+        gauge), and a 68-pulsar x dense-grid scan runs as one
+        program on a pod slice.  The mesh keys the trace: a second
+        same-shaped sharded scan performs zero new XLA compiles.
+
+        Models with correlated noise scan through the batched GLS
+        step at the CURRENT noise values; gridding a noise-model
+        parameter is rejected (its basis/weights are gathered
+        host-side per call, so a gridded value would silently not
+        take effect)."""
+        gnames = tuple(grid_params)
+        for p in gnames:
+            if p not in self.free_names:
+                raise ValueError(
+                    f"chisq_grid: {p!r} is not in the batch free-"
+                    f"parameter union {tuple(self.free_names)}")
+        kind = ("gls" if self.prepareds[0].model.has_correlated_errors
+                else "wls")
+        # pulsar 0 speaks for the batch: __init__ enforces identical
+        # (superset) component structure across members
+        noise_owned = {
+            par.name
+            for c in self.prepareds[0].model.noise_components
+            for par in c.params}
+        bad = [p for p in gnames if p in noise_owned]
+        if bad:
+            raise ValueError(
+                f"chisq_grid: noise-model parameters {bad} cannot be "
+                "gridded on the batched path (their basis/weights "
+                "are gathered at current values); use the "
+                "single-pulsar grid or gw.common.lnlike_grid")
+        gv = np.atleast_2d(np.asarray(grid_values, np.float64))
+        if gv.shape[1] != len(gnames):
+            raise ValueError(
+                f"chisq_grid: grid_values shape {gv.shape} does not "
+                f"match {len(gnames)} grid parameter(s)")
+        n_pts = gv.shape[0]
+        n_real = self.n_pulsars
+        fit = self._chisq_grid_jit(gnames, n_steps, kind, mesh)
+        args = {"grid_values": jnp.asarray(gv), **self._base_args()}
+        if kind == "gls":
+            U, phi = self._gather_noise()
+            args["U"], args["phi"] = U, phi
+        n_pts_pad, k_pad = n_pts, n_real
+        if mesh is not None:
+            names = tuple(str(n) for n in mesh.axis_names)
+            if len(names) == 1:
+                # a 1-d mesh serves the PULSAR (batch) axis; the grid
+                # axis replicates — sharding both onto one axis would
+                # need the product layout a 2-D mesh expresses
+                rules = ((r"^grid_values$", None),) + PTA_BATCH_RULES
+                grid_dev, psr_dev = 1, _mesh.axis_size(mesh, "pulsar")
+            else:
+                rules = PTA_GRID_RULES
+                grid_dev = _mesh.axis_size(mesh, "grid")
+                psr_dev = _mesh.axis_size(mesh, "pulsar")
+            n_pts_pad = _mesh.pad_to_multiple(n_pts, grid_dev)
+            _mesh.record_pad_waste("grid", n_pts, n_pts_pad)
+            args["grid_values"] = _mesh.pad_leading(
+                args["grid_values"], n_pts_pad, mode="edge")
+            k_pad = _mesh.pad_to_multiple(n_real, psr_dev)
+            _mesh.record_pad_waste("pulsar", n_real, k_pad)
+            gv_arr = args.pop("grid_values")
+            args = self._phantom_pad_args(args, k_pad)
+            args = {"grid_values": gv_arr, **args}
+            args = _mesh.shard_args(mesh, rules, args)
+        with telemetry.run_scope(
+                "pta.chisq_grid", n_pulsars=n_real, n_points=n_pts,
+                sharded=mesh is not None), \
+            span("pta.chisq_grid", n_pulsars=n_real, n_points=n_pts,
+                 grid_params=list(gnames), sharded=mesh is not None,
+                 mesh=_mesh.mesh_desc(mesh)):
+            out = fit(*args.values(), jnp.float64(0.0))
+            chi2 = np.asarray(out)
+        telemetry.record_transfer(chi2)
+        return chi2[:n_pts, :n_real].T.copy()
 
     # -- checkpoint/resume ----------------------------------------------------
     def _checkpoint_fingerprint(self):

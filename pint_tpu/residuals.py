@@ -141,9 +141,18 @@ class Residuals:
         self.n_real = getattr(toas, "n_real", None) or len(toas)
         # bucketed datasets ALWAYS carry the mask (all-true at a bucket
         # boundary) so every member of a bucket shares one trace
-        # structure; unbucketed datasets carry none
+        # structure; unbucketed datasets carry none.  A dataset whose
+        # pad rows are NOT a suffix (epoch-aligned TOA sharding
+        # inserts sentinel rows at shard boundaries —
+        # compile_cache.apply_toa_row_plan) carries an explicit
+        # ``pad_valid`` mask instead of the arange convention.
         self._pad_valid = None
-        if getattr(toas, "n_real", None) is not None:
+        explicit_mask = getattr(toas, "pad_valid", None)
+        if explicit_mask is not None:
+            mask = np.asarray(explicit_mask, dtype=bool)
+            self._pad_valid = jnp.asarray(mask)
+            self.n_real = int(np.count_nonzero(mask))
+        elif getattr(toas, "n_real", None) is not None:
             self._pad_valid = jnp.asarray(
                 np.arange(len(toas)) < self.n_real)
         # dataset pytree split: array leaves travel as jit arguments,

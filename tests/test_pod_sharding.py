@@ -1,0 +1,516 @@
+"""Pod-scale sharding tests: 2-D pulsar x grid meshes, TOA-axis
+Woodbury reductions, and the multi-process scaffolding
+(pint_tpu/parallel/mesh.py + linalg.py + fitter.py mesh= entries).
+
+Host-side pieces (epoch-alignment plans, row-plan application, the
+absent-axis diagnostics, the inert distributed_init record, the
+mesh-axis lint) run in-process; the real multi-device behavior — the
+2-D `pulsar x grid` scan and the TOA-axis-sharded GLS fit, both
+sharded == unsharded with zero new compiles on the second same-shaped
+sharded call, plus segment-vs-dense equality at a shard boundary —
+runs on 8 FORCED host devices in a subprocess (the test_mesh.py
+pattern)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import pint_tpu  # noqa: F401  (x64 setup)
+from pint_tpu.parallel import mesh as M
+
+jax = pytest.importorskip("jax")
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+
+# --------------------------------------------------------------------------
+# epoch-alignment plans
+# --------------------------------------------------------------------------
+
+def _simulate_plan(seg, plan):
+    """The seg layout after apply_toa_row_plan: inserted pads clone
+    the nearest preceding source row (joining its epoch)."""
+    out = []
+    last = 0
+    for p in plan:
+        if p >= 0:
+            last = int(p)
+            out.append(seg[last])
+        else:
+            out.append(seg[last])
+    return np.asarray(out)
+
+
+class TestToaShardPlan:
+    def test_aligned_layout_detected(self):
+        # epochs of 2 at even offsets, shard size 4 (even): aligned
+        seg = np.repeat(np.arange(8), 2)
+        assert M.toa_epochs_aligned(seg, 8, 4)
+
+    def test_straddle_detected_and_planned(self):
+        # 5 epochs of 3 rows = 15 rows over 2 shards: the padded
+        # target is 16, shard size 8, and epoch 2 (rows 6-8)
+        # straddles the boundary at 8 — the planner must insert pads
+        seg = np.repeat(np.arange(5), 3)  # 15 rows
+        plan = M.toa_shard_plan(seg, 5, 2)
+        assert plan is not None
+        assert len(plan) % 2 == 0
+        assert (plan < 0).any()  # pads actually inserted
+        new_seg = _simulate_plan(seg, plan)
+        assert M.toa_epochs_aligned(new_seg, 5, 2)
+        # every source row exactly once, pads marked -1
+        src = plan[plan >= 0]
+        assert sorted(src) == list(range(15))
+
+    def test_plan_pushes_epoch_inside_shard(self):
+        # epochs of 2 over shards of 5: epoch (4,5) straddles
+        seg = np.repeat(np.arange(5), 2)  # 10 rows, 2 shards of 5
+        assert not M.toa_epochs_aligned(seg, 5, 2)
+        plan = M.toa_shard_plan(seg, 5, 2)
+        assert plan is not None
+        assert len(plan) % 2 == 0
+        new_seg = _simulate_plan(seg, plan)
+        assert M.toa_epochs_aligned(new_seg, 5, 2)
+
+    def test_impossible_epoch_returns_none(self):
+        # one epoch spanning everything can never fit in one shard
+        seg = np.zeros(16, dtype=int)
+        assert M.toa_shard_plan(seg, 1, 4, max_grow=2) is None
+
+    def test_interleaved_epochs_move_together(self):
+        # two epochs interleaved row-wise form one cluster
+        seg = np.array([0, 1, 0, 1, 2, 2, 3, 3])
+        plan = M.toa_shard_plan(seg, 4, 2)
+        assert plan is not None
+        new_seg = _simulate_plan(seg, plan)
+        assert M.toa_epochs_aligned(new_seg, 4, 2)
+
+    def test_no_epochs_trivially_aligned(self):
+        seg = np.full(12, 3)  # every row outside any epoch
+        assert M.toa_epochs_aligned(seg, 3, 4)
+
+
+# --------------------------------------------------------------------------
+# row-plan application + Residuals pad_valid contract
+# --------------------------------------------------------------------------
+
+def _tiny_model_toas(n=12, noise=""):
+    from pint_tpu.models.builder import get_model
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    par = ("PSR PODT\nRAJ 5:00:00\nDECJ 20:00:00\nF0 100.0 1\n"
+           "F1 -1e-15 1\nPEPOCH 55000\nDM 10.0 1\nTZRMJD 55000\n"
+           "TZRFRQ 1400\nTZRSITE @\nUNITS TDB\nEPHEM builtin\n") + noise
+    m = get_model(par)
+    t = make_fake_toas_uniform(
+        54500, 55500, n, m, obs="gbt", error_us=1.0, add_noise=True,
+        rng=np.random.default_rng(0),
+        flags={"f": "L-wide"} if noise else None)
+    return m, t
+
+
+class TestApplyToaRowPlan:
+    def test_midarray_pads_and_mask(self):
+        from pint_tpu.compile_cache import (PAD_ERROR_US,
+                                            apply_toa_row_plan)
+        from pint_tpu.residuals import Residuals
+
+        m, t = _tiny_model_toas(n=6)
+        plan = np.array([0, 1, 2, -1, 3, 4, 5, -1])
+        out = apply_toa_row_plan(t, plan)
+        assert len(out) == 8
+        assert out.n_real == 6
+        assert list(out.pad_valid) == [True, True, True, False,
+                                       True, True, True, False]
+        assert out.error_us[3] == PAD_ERROR_US
+        assert out.flags[3].get("pad") == "1"
+        # the pad clones its preceding row's time
+        assert out.ticks[3] == out.ticks[2]
+        # the source row's flags are NOT shared with its pad clone
+        assert "pad" not in out.flags[2]
+        r = Residuals(out, m)
+        assert r.n_real == 6
+        assert list(np.asarray(r._pad_valid)) == list(out.pad_valid)
+
+    def test_rejects_duplicate_sources(self):
+        from pint_tpu.compile_cache import apply_toa_row_plan
+
+        _, t = _tiny_model_toas(n=4)
+        with pytest.raises(ValueError, match="exactly once"):
+            apply_toa_row_plan(t, np.array([0, 0, 1, 2, 3]))
+
+    def test_mesh_accepts_prepadded_toas(self):
+        # a bucketed dataset whose boundary is NOT a device multiple
+        # (90 -> bucket 100 on 8 devices) must re-pad through the
+        # row-plan path, not crash on pad_toas' conflict check
+        from pint_tpu import compile_cache as _cc
+        from pint_tpu.fitter import WLSFitter
+
+        ndev = len(jax.devices())
+        m, t = _tiny_model_toas(n=90)
+        padded = _cc.pad_toas(t)
+        f = WLSFitter(padded, m, mesh=M.make_mesh("toa"))
+        assert len(f.toas) % ndev == 0
+        assert f.resids.n_real == 90
+        chi2_s = f.fit_toas(maxiter=2)
+        m2, t2 = _tiny_model_toas(n=90)
+        f_u = WLSFitter(t2, m2)
+        chi2_u = f_u.fit_toas(maxiter=2)
+        assert abs(chi2_s - chi2_u) <= 1e-6 * abs(chi2_u)
+
+
+# --------------------------------------------------------------------------
+# absent-axis diagnostics + multi-process scaffolding
+# --------------------------------------------------------------------------
+
+class TestResolveAxisError:
+    def test_error_names_axes_and_rule(self):
+        ndev = len(jax.devices())
+        mesh = M.make_mesh(("pulsar", "grid"), shape=(1, ndev))
+        with pytest.raises(ValueError) as e:
+            M.shard_args(mesh, ((r"^x$", P("walker")),),
+                         {"x": np.zeros(4 * ndev)})
+        msg = str(e.value)
+        assert "walker" in msg
+        assert "'pulsar'" in msg and "'grid'" in msg
+        assert "data leaf 'x'" in msg
+
+    def test_one_d_mesh_still_serves_any_axis(self):
+        mesh = M.make_mesh("pulsar")
+        assert M.resolve_axis(mesh, "toa") == "pulsar"
+
+
+class TestDistributed:
+    def test_inert_single_process(self):
+        rec = M.distributed_init()
+        assert rec["processes"] == 1
+        assert rec["initialized"] is False
+        assert rec["local_devices"] == len(jax.local_devices())
+        # idempotent
+        assert M.distributed_init() is rec
+
+    def test_explicit_args_after_inert_call_raise(self):
+        M.distributed_init()  # inert
+        with pytest.raises(ValueError, match="FIRST call"):
+            M.distributed_init(coordinator_address="host:1234",
+                               num_processes=8, process_id=0)
+
+    def test_topology_and_single_process_keys_unchanged(self):
+        topo = M.process_topology()
+        assert topo["processes"] == 1
+        key = M.mesh_jit_key(M.make_mesh("pulsar"))
+        # no "procs" entry in a single process: pre-pod keys intact
+        assert len(key) == 2 and key[0] == "mesh"
+
+    def test_aot_env_records_topology(self):
+        from pint_tpu.compile_cache import _aot_env
+
+        env = _aot_env()
+        assert env["n_processes"] == 1
+        assert env["devices_per_process"] == len(jax.local_devices())
+
+
+# --------------------------------------------------------------------------
+# the mesh-axis lint (check 4)
+# --------------------------------------------------------------------------
+
+class TestAxisLint:
+    def test_repo_passes(self):
+        sys.path.insert(0, os.path.join(_repo_root(), "tools"))
+        try:
+            import check_jit_gates as lint
+        finally:
+            sys.path.pop(0)
+        lines, rc = lint.check(_repo_root())
+        assert rc == 0, [ln for ln in lines
+                         if not ln.startswith("OK")]
+
+    def test_typoed_axis_flags(self, tmp_path):
+        sys.path.insert(0, os.path.join(_repo_root(), "tools"))
+        try:
+            import check_jit_gates as lint
+        finally:
+            sys.path.pop(0)
+        pkg = tmp_path / "pint_tpu"
+        (pkg / "parallel").mkdir(parents=True)
+        with open(os.path.join(_repo_root(), "pint_tpu", "parallel",
+                               "mesh.py")) as fh:
+            (pkg / "parallel" / "mesh.py").write_text(fh.read())
+        (pkg / "bad.py").write_text(
+            "from jax.sharding import PartitionSpec as P\n"
+            "RULES = ((r'^x$', P('pulsars')),)\n")
+        lines, rc = lint.check(str(tmp_path))
+        assert rc == 1
+        assert any("'pulsars'" in ln and "AXIS_NAMES" in ln
+                   for ln in lines)
+
+
+def _repo_root():
+    return os.path.dirname(os.path.dirname(
+        os.path.abspath(pint_tpu.__file__)))
+
+
+# --------------------------------------------------------------------------
+# single-device smokes of the sharded entries
+# --------------------------------------------------------------------------
+
+class TestChisqGridHost:
+    def test_matches_single_pulsar_grid(self):
+        from pint_tpu.grid import grid_chisq_vectorized
+        from pint_tpu.models.builder import get_model
+        from pint_tpu.parallel import PTABatch
+        from pint_tpu.simulation import make_fake_toas_uniform
+
+        def mk(i):
+            par = (f"PSR CHG{i}\nRAJ {5 + i}:00:00\nDECJ 20:00:00\n"
+                   f"F0 {100.0 + 7.0 * i} 1\nF1 -1e-15 1\n"
+                   f"PEPOCH 55000\nDM {10.0 + i} 1\nTZRMJD 55000\n"
+                   "TZRFRQ 1400\nTZRSITE @\nUNITS TDB\n"
+                   "EPHEM builtin\n")
+            m = get_model(par)
+            t = make_fake_toas_uniform(
+                54500, 55500, 20, m, obs="gbt", error_us=1.0,
+                add_noise=True, rng=np.random.default_rng(i))
+            return m, t
+
+        pairs = [mk(i) for i in range(2)]
+        b = PTABatch([(m, t) for m, t in pairs])
+        pts = np.linspace(-2e-15, -5e-16, 5)[:, None]
+        c = b.chisq_grid(["F1"], pts, n_steps=2)
+        assert c.shape == (2, 5)
+        for i, (m, t) in enumerate(pairs):
+            ref, _ = grid_chisq_vectorized(t, m, ["F1"], pts,
+                                           n_steps=2)
+            rel = np.max(np.abs(ref - c[i])
+                         / np.maximum(np.abs(ref), 1e-300))
+            assert rel < 1e-6, (i, ref, c[i])
+
+    def test_validation_errors(self):
+        from pint_tpu.parallel import PTABatch
+
+        from pint_tpu.models.builder import get_model
+        from pint_tpu.simulation import make_fake_toas_uniform
+
+        noise = ("EFAC -f L-wide 1.1\nTNRedAmp -13.0\nTNRedGam 3.0\n"
+                 "TNRedC 2\n")
+        m, t = _tiny_model_toas(n=16, noise=noise)
+        m2, t2 = _tiny_model_toas(n=16, noise=noise)
+        b = PTABatch([(m, t), (m2, t2)])
+        with pytest.raises(ValueError, match="not in the batch"):
+            b.chisq_grid(["NOPE"], np.zeros((2, 1)))
+        with pytest.raises(ValueError, match="does not match"):
+            b.chisq_grid(["F1"], np.zeros((2, 3)))
+
+    def test_noise_param_rejected_on_gls(self):
+        from pint_tpu.parallel import PTABatch
+
+        noise = ("EFAC -f L-wide 1.1\nTNRedAmp -13.0\nTNRedGam 3.0\n"
+                 "TNRedC 2\n")
+        m, t = _tiny_model_toas(n=16, noise=noise)
+        m.params["EFAC1"].frozen = False
+        m2, t2 = _tiny_model_toas(n=16, noise=noise)
+        m2.params["EFAC1"].frozen = False
+        b = PTABatch([(m, t), (m2, t2)])
+        with pytest.raises(ValueError, match="noise-model"):
+            b.chisq_grid(["EFAC1"], np.ones((2, 1)))
+
+
+# --------------------------------------------------------------------------
+# the multi-device suite: 8 forced host devices in a subprocess
+# --------------------------------------------------------------------------
+
+_POD_SCRIPT = r'''
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import pint_tpu
+from pint_tpu import telemetry
+telemetry.compile_stats()  # compile listener before any compile
+from pint_tpu.models.builder import get_model
+from pint_tpu.parallel import PTABatch, make_mesh
+from pint_tpu.parallel import mesh as M
+from pint_tpu.simulation import make_fake_toas_uniform
+
+assert len(jax.devices()) == 8, len(jax.devices())
+print("OK_DEVICES")
+
+
+def compile_events():
+    return telemetry.counter_get("jit.compile_events")
+
+
+# --- TOA-axis-sharded GLS fit: epochs straddle -> pad-aligned -------
+par = ("PSR PODGLS\nRAJ 5:00:00\nDECJ 20:00:00\nF0 100.0 1\n"
+       "F1 -1e-15 1\nPEPOCH 55000\nDM 10.0 1\nTZRMJD 55000\n"
+       "TZRFRQ 1400\nTZRSITE @\nUNITS TDB\nEPHEM builtin\n"
+       "EFAC -f L-wide 1.1\nEQUAD -f L-wide 0.4\nECORR -f L-wide 0.6\n"
+       "TNRedAmp -13.0\nTNRedGam 3.0\nTNRedC 3\n")
+
+
+def mk_gls(seed=0):
+    m = get_model(par)
+    # 60 two-TOA epochs = 120 rows: 8 shards of 15 put epoch rows
+    # (14, 15) astride the first boundary, so the sharded fitter MUST
+    # run the pad-alignment plan (120 -> 128 rows, shard size 16)
+    t = make_fake_toas_uniform(
+        54500, 55500, 60, m, obs="gbt", error_us=1.0, add_noise=True,
+        rng=np.random.default_rng(seed), flags={"f": "L-wide"},
+        multifreq=True, freq_mhz=[1400.0, 800.0])
+    m.values["DM"] += 1e-3
+    return m, t
+
+
+from pint_tpu.fitter import GLSFitter
+from pint_tpu.linalg import StructuredU, gls_normal_solve, su_to_dense
+
+m_u, t_u = mk_gls()
+f_u = GLSFitter(t_u, m_u)
+chi2_u = f_u.fit_toas(maxiter=2)
+
+tmesh = make_mesh("toa")
+m_s, t_s = mk_gls()
+f_s = GLSFitter(t_s, m_s, mesh=tmesh)
+assert telemetry.counter_get("mesh.toa_align_replans") >= 1, \
+    "epoch-alignment plan did not run"
+assert telemetry.counter_get("mesh.ecorr_dense_fallbacks") == 0
+assert len(f_s.toas) == 128 and f_s.resids.n_real == 120
+assert isinstance(f_s.resids._U_ext, StructuredU), "lost segment path"
+seg = np.asarray(f_s.resids._U_ext.seg)
+assert M.toa_epochs_aligned(seg, f_s.resids._U_ext.eslot.shape[0], 8)
+chi2_s = f_s.fit_toas(maxiter=2)
+assert abs(chi2_s - chi2_u) <= 1e-6 * abs(chi2_u), (chi2_u, chi2_s)
+assert np.isclose(f_u.model.values["F0"], f_s.model.values["F0"],
+                  rtol=0, atol=1e-10)
+print("OK_TOA_GLS_SHARDED")
+
+e0 = compile_events()
+m_s2, t_s2 = mk_gls(seed=0)
+f_s2 = GLSFitter(t_s2, m_s2, mesh=tmesh)
+f_s2.fit_toas(maxiter=2)
+assert compile_events() == e0, "second TOA-sharded GLS fit recompiled"
+print("OK_TOA_GLS_ZERO_RECOMPILE")
+
+# --- segment-sum vs dense at the shard boundary, brute-force --------
+su = f_s.resids._U_ext
+data = f_s.resids._data()
+n = len(f_s.toas)
+rng = np.random.default_rng(1)
+r = jnp.asarray(rng.normal(size=n) * 1e-6)
+sigma = jnp.asarray(1e-6 * (1.0 + 0.1 * rng.random(n)))
+J = jnp.asarray(rng.normal(size=(n, 3)))
+base = f_s.prepared._values_pytree()
+phi = np.asarray(f_s.resids._noise_basis_phi_at(base, data)[1])
+shard = M.RowShard(tmesh)
+dp_s, cov_s, nc_s, c2_s = jax.jit(
+    lambda *a: gls_normal_solve(*a, toa=shard))(r, J, sigma, su, phi)
+dp_d, cov_d, nc_d, c2_d = jax.jit(gls_normal_solve)(
+    r, J, sigma, su_to_dense(su), phi)
+assert abs(float(c2_s) - float(c2_d)) <= 1e-8 * abs(float(c2_d))
+assert np.allclose(np.asarray(dp_s), np.asarray(dp_d), rtol=1e-6,
+                   atol=1e-12)
+print("OK_SEGMENT_DENSE_SHARD_EQ")
+
+# --- 2-D pulsar x grid chisq_grid -----------------------------------
+def mk(i, n=24):
+    p = (f"PSR P2D{i}\nRAJ {5 + i}:00:00\nDECJ 20:00:00\n"
+         f"F0 {100.0 + 7.0 * i} 1\nF1 -1e-15 1\nPEPOCH 55000\n"
+         f"DM {10.0 + i} 1\nTZRMJD 55000\nTZRFRQ 1400\nTZRSITE @\n"
+         "UNITS TDB\nEPHEM builtin\n")
+    m = get_model(p)
+    t = make_fake_toas_uniform(
+        54500, 55500, n, m, obs="gbt", error_us=1.0, add_noise=True,
+        rng=np.random.default_rng(i))
+    m.values["DM"] += 1e-3
+    return m, t
+
+
+pts = np.linspace(-2e-15, -5e-16, 7)[:, None]
+b_u = PTABatch([mk(i) for i in range(5)])
+c_u = b_u.chisq_grid(["F1"], pts, n_steps=2)
+assert c_u.shape == (5, 7)
+
+mesh2d = make_mesh(("pulsar", "grid"), shape=(4, 2))
+b_s = PTABatch([mk(i) for i in range(5)])
+c_s = b_s.chisq_grid(["F1"], pts, n_steps=2, mesh=mesh2d)
+rel = np.max(np.abs(c_s - c_u) / np.maximum(np.abs(c_u), 1e-300))
+assert rel < 1e-6, rel
+g = telemetry.gauges()
+# 5 pulsars on the 4-extent axis pad to 8; 7 points on the 2-extent
+# axis pad to 8 -- each axis gauges its own waste
+assert abs(g["mesh.pad_waste_frac.pulsar"] - 3 / 8) < 1e-9
+assert abs(g["mesh.pad_waste_frac.grid"] - 1 / 8) < 1e-9
+print("OK_CHISQ_GRID_2D")
+
+e0 = compile_events()
+b_s2 = PTABatch([mk(i) for i in range(5)])
+c_s2 = b_s2.chisq_grid(["F1"], pts, n_steps=2, mesh=mesh2d)
+assert compile_events() == e0, "second 2-D scan recompiled"
+assert np.allclose(c_s2, c_s)
+print("OK_CHISQ_GRID_2D_ZERO_RECOMPILE")
+
+# --- lnlike_grid over the SAME 2-D mesh -----------------------------
+from pint_tpu.simulation import make_fake_pta
+from pint_tpu.gw.common import CommonProcess
+
+gw_pairs = make_fake_pta(2, 25, start_mjd=54000.0,
+                         duration_days=1200.0, seed=3,
+                         name_prefix="PODGW")
+cp = CommonProcess(gw_pairs, nmodes=3)
+amps = np.linspace(-14.5, -13.5, 3)
+gams = np.linspace(3.5, 5.0, 2)
+s_u = cp.lnlike_grid(amps, gams)
+s_s = cp.lnlike_grid(amps, gams, mesh=mesh2d)
+scale = np.max(np.abs(s_u))
+assert np.all(np.abs(s_u - s_s) <= 1e-8 * scale), (s_u, s_s)
+print("OK_LNLIKE_GRID_2D")
+e0 = compile_events()
+cp.lnlike_grid(amps, gams, mesh=mesh2d)
+assert compile_events() == e0, "second 2-D lnlike_grid recompiled"
+print("OK_LNLIKE_GRID_2D_ZERO_RECOMPILE")
+
+# --- the program records say what ran sharded -----------------------
+from pint_tpu import profiling
+
+by_label = {s["label"]: s for s in profiling.programs()}
+assert by_label["fitter.step:GLSFitter:sharded"]["mesh"]["axes"] == \
+    {"toa": 8}
+assert by_label["pta.chisq_grid:F1:sharded"]["mesh"]["axes"] == \
+    {"pulsar": 4, "grid": 2}
+print("OK_POD_MESH_RECORDS")
+print("ALL_OK")
+'''
+
+_POD_MARKERS = (
+    "OK_DEVICES", "OK_TOA_GLS_SHARDED", "OK_TOA_GLS_ZERO_RECOMPILE",
+    "OK_SEGMENT_DENSE_SHARD_EQ", "OK_CHISQ_GRID_2D",
+    "OK_CHISQ_GRID_2D_ZERO_RECOMPILE", "OK_LNLIKE_GRID_2D",
+    "OK_LNLIKE_GRID_2D_ZERO_RECOMPILE", "OK_POD_MESH_RECORDS",
+    "ALL_OK",
+)
+
+
+def test_pod_sharding_suite(tmp_path):
+    """TOA-axis-sharded GLS fit (epoch alignment + segment==dense at
+    a shard boundary) and the 2-D pulsar x grid scan / lnlike_grid,
+    all sharded == unsharded on 8 forced host devices with zero new
+    compiles on second same-shaped sharded calls."""
+    script = tmp_path / "pod.py"
+    script.write_text(_POD_SCRIPT)
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                   + " --xla_force_host_platform_device_count=8"
+                   ).strip(),
+        PYTHONPATH=_repo_root() + os.pathsep
+        + os.environ.get("PYTHONPATH", ""),
+    )
+    env.pop("PINT_TPU_FAULTS", None)
+    r = subprocess.run([sys.executable, str(script)], env=env,
+                       capture_output=True, text=True, timeout=540)
+    assert r.returncode == 0, (r.stdout[-4000:], r.stderr[-4000:])
+    for marker in _POD_MARKERS:
+        assert marker in r.stdout, (marker, r.stdout[-4000:])

@@ -33,6 +33,18 @@ Three checks, run as a tier-1 test (tests/test_flight_recorder.py):
    (:data:`HOST_ONLY`).  A brand-new env var fails until classified,
    which is exactly the moment to decide whether it needs key
    participation.
+
+4. **Mesh-axis coverage** — every mesh-axis name literal used in a
+   ``PartitionSpec`` rule table (or ``make_mesh``/``resolve_axis``
+   call) across library source must appear in
+   ``parallel/mesh.AXIS_NAMES``, and ``mesh_jit_key`` must derive
+   its axis entries generically from ``mesh.axis_names`` (or name
+   every known axis explicitly).  Together these make it impossible
+   for a NEW rule-table axis to miss the jit key: the generic
+   ``mesh_jit_key`` folds any axis a mesh carries into every sharded
+   key, and a typo'd or undeclared axis name in a rule table fails
+   here instead of silently mis-sharding — the same
+   stale-trace/poisoned-zero-recompile class as an unkeyed gate.
 """
 
 from __future__ import annotations
@@ -103,6 +115,10 @@ KEY_SITES = {
             "PINT_TPU_SCAN_ITERS": "scan",
             "PINT_TPU_ITER_TRACE": "trace",
         },
+        # the 2-D pulsar x grid scan resolves the scan flag itself
+        "PTABatch._chisq_grid_jit": {
+            "PINT_TPU_SCAN_ITERS": "scan",
+        },
         # the design partition rides _structure_key
         "PTABatch._structure_key": {
             "PINT_TPU_HYBRID_DESIGN": "self._partition",
@@ -167,6 +183,54 @@ HOST_ONLY = {
 }
 
 _ENV_RE = re.compile(r"PINT_TPU_[A-Z0-9_]+")
+
+#: function names whose string-literal arguments name mesh axes
+_AXIS_CALLS = {"P", "PartitionSpec", "_P", "make_mesh",
+               "resolve_axis", "axis_size", "RowShard"}
+
+
+def _axis_names_from_source(src):
+    """The AXIS_NAMES tuple parsed out of parallel/mesh.py source
+    (ast, not import — the lint must run without jax)."""
+    tree = ast.parse(src)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "AXIS_NAMES"
+                for t in node.targets):
+            return tuple(
+                e.value for e in node.value.elts
+                if isinstance(e, ast.Constant)
+                and isinstance(e.value, str))
+    return None
+
+
+def _axis_literals(src):
+    """Mesh-axis string literals used in PartitionSpec rule tables and
+    mesh-construction calls of one module: ``(lineno, name)`` pairs.
+    Only direct str/tuple-of-str arguments count — computed axis
+    names resolve at runtime through resolve_axis, which validates."""
+    out = []
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return out
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = fn.id if isinstance(fn, ast.Name) else (
+            fn.attr if isinstance(fn, ast.Attribute) else None)
+        if name not in _AXIS_CALLS:
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords
+                                      if kw.arg in ("axes", "axis")]:
+            elts = (arg.elts if isinstance(arg, (ast.Tuple, ast.List))
+                    else [arg])
+            for e in elts:
+                if isinstance(e, ast.Constant) and \
+                        isinstance(e.value, str):
+                    out.append((node.lineno, e.value))
+    return out
 
 
 def _function_source(tree, src, dotted):
@@ -266,6 +330,48 @@ def check(root):
                     f"FAIL {rel}: unclassified env var {var} — add "
                     "it to TRACE_GATES (and a KEY_SITE) if it changes "
                     "a traced program, else to HOST_ONLY")
+
+    # 4. mesh-axis coverage
+    mesh_rel = "pint_tpu/parallel/mesh.py"
+    mesh_src = sources.get(mesh_rel)
+    axis_names = (_axis_names_from_source(mesh_src)
+                  if mesh_src else None)
+    if axis_names is None:
+        failed = True
+        lines.append(f"FAIL {mesh_rel}: AXIS_NAMES literal not found "
+                     "(renamed? the axis lint needs it)")
+    else:
+        tree = ast.parse(mesh_src)
+        key_src = _function_source(tree, mesh_src, "mesh_jit_key")
+        if key_src is None:
+            failed = True
+            lines.append(f"FAIL {mesh_rel}: mesh_jit_key not found")
+        elif "axis_names" in key_src or all(
+                f'"{a}"' in key_src or f"'{a}'" in key_src
+                for a in axis_names):
+            lines.append(
+                f"OK   {mesh_rel}:mesh_jit_key covers every axis "
+                "(generic over mesh.axis_names)")
+        else:
+            failed = True
+            lines.append(
+                f"FAIL {mesh_rel}:mesh_jit_key no longer derives its "
+                "entries from mesh.axis_names and does not name every "
+                f"axis in AXIS_NAMES {axis_names} — a rule-table axis "
+                "could miss the jit key and poison the zero-recompile "
+                "contract")
+        allowed = set(axis_names)
+        for rel, src in sorted(sources.items()):
+            for lineno, name in _axis_literals(src):
+                if name in allowed:
+                    continue
+                failed = True
+                lines.append(
+                    f"FAIL {rel}:{lineno}: mesh-axis literal "
+                    f"{name!r} is not in parallel/mesh.AXIS_NAMES "
+                    f"{axis_names} — a typo'd or undeclared axis "
+                    "silently mis-shards; add it to AXIS_NAMES or "
+                    "fix the name")
     return lines, (1 if failed else 0)
 
 
