@@ -617,6 +617,110 @@ def bench_os(jnp, backend):
     })
 
 
+def bench_gwb_lnlike(jnp, backend):
+    """The stacked-array GWB likelihood at 16 pulsars — the
+    kron-structured solve (linalg.KronPhi, per-pulsar Woodbury
+    reductions + the GW-sector product-form capacity) A/B'd against
+    the dense (K, K) prior path on the same host, same arrays.  The
+    kron path is the served default ($PINT_TPU_KRON_PHI); the dense
+    rate and the kron/dense agreement ride the structured
+    ``kron_vs_dense`` field so a structural regression is visible in
+    the row, not just in the sentinel series."""
+    from pint_tpu.gw import CommonProcess
+    from pint_tpu.simulation import (add_gwb, make_fake_pta,
+                                     pta_injection_seed)
+
+    n_psr, n_toas, nmodes = 16, 200, 10
+    pairs = make_fake_pta(
+        n_psr, n_toas, seed=0,
+        extra_par="TNRedAmp -13.7\nTNRedGam 4.33\nTNRedC 10\n")
+    add_gwb([t for _, t in pairs], [m for m, _ in pairs], 2e-14,
+            rng=pta_injection_seed(0, n_psr), nmodes=nmodes)
+    crn_k = CommonProcess(pairs, nmodes=nmodes, kron=True)
+    compile_s = _timed_compile(lambda: crn_k.lnlike(-14.0, 4.33))
+    # warm: a second same-shaped array resolves through the registry
+    crn_k2 = CommonProcess(pairs, nmodes=nmodes, kron=True)
+    warm_s, _ = _timed_compile2(lambda: crn_k2.lnlike(-14.0, 4.33))
+
+    def timed_rate(crn, n_evals):
+        t0 = time.time()
+        for i in range(n_evals):
+            crn.lnlike(-14.0 + 1e-3 * i, 4.33)
+        return n_evals / (time.time() - t0)
+
+    rate_k = timed_rate(crn_k, 30)
+    crn_d = CommonProcess(pairs, nmodes=nmodes, kron=False)
+    lnl_k = crn_k.lnlike(-14.0, 4.33)
+    lnl_d = crn_d.lnlike(-14.0, 4.33)
+    rate_d = timed_rate(crn_d, 10)
+    rel = abs(lnl_k - lnl_d) / abs(lnl_d)
+    phase = _phase_split(lambda: crn_k.lnlike(-14.05, 4.33))
+    _emit_metric({
+        "metric": "gwb_lnlike_per_sec",
+        "value": round(rate_k, 2),
+        "unit": (f"GWB lnlike/s ({n_psr} pulsars x {n_toas} TOAs, "
+                 f"{nmodes} modes, HD ORF, kron path; dense "
+                 f"{rate_d:.2f}/s, speedup {rate_k / rate_d:.1f}x, "
+                 f"rel diff {rel:.1e}, backend={backend}, "
+                 f"compile={compile_s:.1f}s/warm {warm_s:.1f}s)"),
+        "vs_baseline": round(rate_k / rate_d, 1),
+        "backend": backend,
+        "compile_s": _cold_warm(compile_s, warm_s),
+        "kron_vs_dense": {
+            "kron_per_sec": round(rate_k, 2),
+            "dense_per_sec": round(rate_d, 2),
+            "speedup": round(rate_k / rate_d, 2),
+            "rel_diff": float(rel),
+            "n_psr": n_psr,
+        },
+        "phase_s": phase,
+    })
+
+
+def bench_nuts(jnp, backend):
+    """The gradient-based GWB sampler (gw/hmc): all chains one
+    vmapped scan program, per-draw cost carried by the frozen
+    noise-gram reuse.  Warm draws/s over every chain; the cold/warm
+    compile split records what the first chunk pays and that a second
+    same-shaped run pays nothing."""
+    from pint_tpu.gw import CommonProcess, GWBPosterior, run_nuts
+    from pint_tpu.simulation import (add_gwb, make_fake_pta,
+                                     pta_injection_seed)
+
+    n_psr, n_toas, nmodes = 16, 100, 10
+    n_chains, warm_draws = 4, 64
+    pairs = make_fake_pta(
+        n_psr, n_toas, seed=0,
+        extra_par="TNRedAmp -13.7\nTNRedGam 4.33\nTNRedC 10\n")
+    add_gwb([t for _, t in pairs], [m for m, _ in pairs], 2e-14,
+            rng=pta_injection_seed(0, n_psr), nmodes=nmodes)
+    post = GWBPosterior(CommonProcess(pairs, nmodes=nmodes))
+    kw = dict(num_warmup=16, num_samples=warm_draws,
+              n_chains=n_chains, chunk=16, num_leapfrog=8)
+    compile_s = _timed_compile(
+        lambda: run_nuts(post, seed=0, **kw))
+    warm_s, _ = _timed_compile2(lambda: run_nuts(post, seed=1, **kw))
+    t0 = time.time()
+    res = run_nuts(post, seed=2, **kw)
+    wall = time.time() - t0
+    total_draws = (kw["num_warmup"] + warm_draws) * n_chains
+    rate = total_draws / wall
+    phase = _phase_split(lambda: run_nuts(post, seed=3, **kw))
+    _emit_metric({
+        "metric": "nuts_draws_per_sec",
+        "value": round(rate, 2),
+        "unit": (f"NUTS draws/s ({n_psr} pulsars x {n_toas} TOAs, "
+                 f"ndim={post.ndim}, {n_chains} vmapped chains x "
+                 f"{kw['num_leapfrog']} leapfrog, accept="
+                 f"{res.accept_rate:.2f}, backend={backend}, "
+                 f"compile={compile_s:.1f}s/warm {warm_s:.1f}s)"),
+        "vs_baseline": round(rate, 1),
+        "backend": backend,
+        "compile_s": _cold_warm(compile_s, warm_s),
+        "phase_s": phase,
+    })
+
+
 def bench_grid_sharded(jnp, backend):
     """The chi^2 grid through the one mesh layer (parallel/mesh.py):
     grid points sharded over every visible device (on CPU the child
@@ -1163,6 +1267,8 @@ _METRICS = {
     "mcmc": bench_mcmc,
     "os": bench_os,
     "pta": bench_pta,
+    "gwb_lnlike": bench_gwb_lnlike,
+    "nuts": bench_nuts,
     "grid_sharded": bench_grid_sharded,
     "pta_sharded": bench_pta_sharded,
     "weak_scaling": bench_weak_scaling,

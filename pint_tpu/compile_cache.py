@@ -87,6 +87,7 @@ _CACHE_ENV = "PINT_TPU_CACHE_DIR"
 _BUCKET_ENV = "PINT_TPU_BUCKET_TOAS"
 _SCAN_ENV = "PINT_TPU_SCAN_ITERS"
 _ITER_TRACE_ENV = "PINT_TPU_ITER_TRACE"
+_KRON_PHI_ENV = "PINT_TPU_KRON_PHI"
 _DEFAULT_CACHE_DIR = os.path.join("~", ".cache", "pint_tpu", "xla")
 _AOT_MANIFEST = "manifest.json"
 _AOT_FORMAT = 1
@@ -474,6 +475,24 @@ def iter_trace_default() -> bool:
     (``tools/check_jit_gates.py`` lints the gate->key coverage)."""
     raw = os.environ.get(_ITER_TRACE_ENV, "").strip().lower()
     return raw in ("1", "true", "yes", "on")
+
+
+def kron_phi_default() -> bool:
+    """Whether the stacked-array GWB likelihood routes its dense
+    ``kron(ORF, diag(phi_gw))`` prior through the Kronecker-structured
+    solver (:class:`pint_tpu.linalg.KronPhi` — per-frequency
+    (N_psr, N_psr) blocks and per-pulsar Woodbury reductions instead
+    of one O(K^3) dense factorization; default ON) or through the
+    historical dense (K, K) path (``$PINT_TPU_KRON_PHI=0/off`` — the
+    brute-force reference the kron path is verified against).  The two
+    are different traced programs of different argument layouts, so
+    the resolved flag is part of every affected shared-jit key
+    (``gw/common.py`` — lint-checked by ``tools/check_jit_gates.py``)."""
+    raw = os.environ.get(_KRON_PHI_ENV)
+    if raw is None or not raw.strip():
+        return True
+    return raw.strip().lower() not in ("0", "off", "false", "no",
+                                       "dense")
 
 
 def iterate_fixed(body, init, n_steps, scan=None, trace_of=None):

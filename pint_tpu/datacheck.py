@@ -369,6 +369,68 @@ def _gw_section(n_psr=3, ntoa=24):
         return [f"GW engine: ERROR {type(e).__name__}: {e}"]
 
 
+def _gwb_section(n_psr=3, ntoa=24):
+    """GWB kron-likelihood + HMC smoke (--gwb): the kron-structured
+    lnlike against the dense (K, K) reference on a tiny array, a
+    gradient check against central finite differences, and a
+    2-chain/8-draw NUTS smoke (finite chain, adapted step size).
+    Diagnostic: reports, never raises."""
+    lines = ["GWB kron/HMC (--gwb):"]
+    try:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from pint_tpu import compile_cache as _cc
+        from pint_tpu.gw import CommonProcess, GWBPosterior, run_nuts
+        from pint_tpu.simulation import make_fake_pta
+
+        lines.append("  $PINT_TPU_KRON_PHI gate: "
+                     + ("kron (default)" if _cc.kron_phi_default()
+                        else "dense (gate off)"))
+        pairs = make_fake_pta(
+            n_psr, ntoa, start_mjd=54000.0, duration_days=1500.0,
+            name_prefix="GWBCHK",
+            extra_par="TNRedAmp -13.5\nTNRedGam 4.0\nTNRedC 3\n")
+        lk = CommonProcess(pairs, nmodes=3, kron=True).lnlike(
+            -14.0, 13.0 / 3.0)
+        ld = CommonProcess(pairs, nmodes=3, kron=False).lnlike(
+            -14.0, 13.0 / 3.0)
+        rel = abs(lk - ld) / abs(ld)
+        # kron vs dense on a full-rank HD ORF: 1e-10 is the tested
+        # bound; the smoke allows 10x headroom over it
+        lines.append(
+            f"  kron vs dense lnlike: rel diff {rel:.2e} "
+            + ("OK" if rel < 1e-9 else "PROBLEM"))
+        post = GWBPosterior(CommonProcess(pairs, nmodes=3))
+        data = post.data()
+        th = jnp.asarray(post.center())
+        g = float(jax.grad(
+            lambda q: post.lnprob(q, data))(th)[0])
+        h = 1e-5
+        up = th.at[0].add(h)
+        dn = th.at[0].add(-h)
+        fd = (float(post.lnprob(up, data))
+              - float(post.lnprob(dn, data))) / (2 * h)
+        grel = abs(fd - g) / max(abs(g), 1e-8)
+        lines.append(
+            f"  d lnp/d log10_A vs central differences: rel "
+            f"{grel:.2e} " + ("OK" if grel < 1e-5 else "PROBLEM"))
+        res = run_nuts(post, num_warmup=4, num_samples=4, n_chains=2,
+                       chunk=4, num_leapfrog=3, seed=0)
+        ok = (np.all(np.isfinite(res.samples))
+              and np.all(res.step_size > 0))
+        lines.append(
+            f"  NUTS smoke (2 chains x 8 draws, ndim={post.ndim}): "
+            f"accept {res.accept_rate:.2f}, step "
+            f"{np.mean(res.step_size):.3g} "
+            + ("OK" if ok else "PROBLEM"))
+        return lines
+    except Exception as e:  # diagnostic must never take the report down
+        lines.append(f"  ERROR {type(e).__name__}: {e}")
+        return lines
+
+
 def _mesh_section():
     """Mesh-layer smoke (--mesh): device inventory, mesh construction,
     partition-rule resolution over a REAL stacked PTA-batch pytree
@@ -958,6 +1020,10 @@ def main(argv=None):
                         "bit-identical fit with zero uncached XLA "
                         "backend compiles, plus the version-skew "
                         "graceful-reject path")
+    p.add_argument("--gwb", action="store_true",
+                   help="run the GWB kron/HMC smoke: kron-structured "
+                        "lnlike vs the dense reference, gradient vs "
+                        "central finite differences, tiny NUTS run")
     p.add_argument("--runs", action="store_true",
                    help="run the run-ledger smoke: one fit under a "
                         "temp trace sink must reconstruct with >= 4 "
@@ -972,6 +1038,9 @@ def main(argv=None):
         print(line)
     if args.faults:
         for line in _faults_section():
+            print(line)
+    if args.gwb:
+        for line in _gwb_section():
             print(line)
     if args.runs:
         for line in _runs_section():

@@ -16,6 +16,7 @@ Layers (docs/gw.md):
 
 from pint_tpu.gw.common import (CommonProcess, build_pulsar_data,
                                 common_tspan_s, gwb_phi)
+from pint_tpu.gw.hmc import (GWBPosterior, NUTSResult, run_nuts)
 from pint_tpu.gw.orf import (angular_separation_matrix, dipole,
                              hellings_downs, monopole, orf_matrix,
                              pair_indices, pulsar_positions)
@@ -26,4 +27,5 @@ __all__ = [
     "angular_separation_matrix", "pair_indices", "pulsar_positions",
     "CommonProcess", "build_pulsar_data", "common_tspan_s", "gwb_phi",
     "OptimalStatistic", "OSResult", "GWB_GAMMA",
+    "GWBPosterior", "NUTSResult", "run_nuts",
 ]

@@ -38,7 +38,8 @@ from pint_tpu import faults as _faults
 from pint_tpu import guard as _guard
 from pint_tpu import telemetry
 from pint_tpu.gw.orf import orf_matrix, pulsar_positions
-from pint_tpu.linalg import woodbury_chi2_logdet
+from pint_tpu.linalg import (KronPhi, kron_chi2_logdet,
+                             woodbury_chi2_logdet)
 from pint_tpu.models.noise import powerlaw, toa_fourier_basis
 from pint_tpu.residuals import MEAN_OFFSET_WEIGHT, Residuals
 from pint_tpu.telemetry import span
@@ -209,6 +210,58 @@ _crn_lnlike_vec = jax.vmap(
 )
 
 
+def _kron_lnlike_one(r, sigma, U, F, valid, phi_noise, orf, freqs, df,
+                     n_toa, log10_amp, gamma):
+    """The kron-structured twin of :func:`_crn_lnlike_one`: the same
+    stacked-array likelihood, evaluated over padded PER-PULSAR stacks
+    through :func:`pint_tpu.linalg.kron_chi2_logdet` instead of the
+    materialized dense (K, K) prior — per-pulsar Woodbury reductions
+    plus per-frequency (N_psr, N_psr) prior blocks, never an O(K^3)
+    factorization or an O(N_tot K^2) stacked gram.  Same return
+    contract (lnlike, health); brute-force-verified equal to the dense
+    path (tests/test_kron_hmc.py)."""
+    amp = 10.0 ** log10_amp
+    phi_gw = gwb_phi(freqs, amp, gamma, df)
+    kp = KronPhi(orf=orf, phi_gw=phi_gw, phi_noise=phi_noise)
+    chi2, logdet = kron_chi2_logdet(r, sigma, U, F, kp, valid=valid)
+    lnl = -0.5 * (chi2 + logdet) - 0.5 * n_toa * jnp.log(2.0 * jnp.pi)
+    health = (jnp.isfinite(chi2), jnp.isfinite(logdet))
+    return lnl, health
+
+
+_kron_lnlike_vec = jax.vmap(
+    _kron_lnlike_one,
+    in_axes=(None, None, None, None, None, None, None, None, None,
+             None, 0, 0),
+)
+
+
+def _crn_lnlike_grid_fn(r, sigma, U_full, phi_noise, orf, freqs, df,
+                        n_toa, log10_amps, gammas, pts_valid):
+    """The dense grid program: vmapped point sweep PLUS the on-device
+    non-finite count over the REAL (non-pad) points — the bad-point
+    counter no longer needs a host-side pass over the returned
+    surface, so a sharded grid never syncs per point.  ``pts_valid``
+    masks edge-repeated pad points out of the count (they duplicate a
+    real point's verdict)."""
+    lnls, _health = _crn_lnlike_vec(r, sigma, U_full, phi_noise, orf,
+                                    freqs, df, n_toa, log10_amps,
+                                    gammas)
+    n_bad = jnp.sum(jnp.where(pts_valid, ~jnp.isfinite(lnls), False))
+    return lnls, n_bad
+
+
+def _kron_lnlike_grid_fn(r, sigma, U, F, valid, phi_noise, orf, freqs,
+                         df, n_toa, log10_amps, gammas, pts_valid):
+    """The kron grid program — :func:`_crn_lnlike_grid_fn`'s
+    structured twin."""
+    lnls, _health = _kron_lnlike_vec(r, sigma, U, F, valid, phi_noise,
+                                     orf, freqs, df, n_toa,
+                                     log10_amps, gammas)
+    n_bad = jnp.sum(jnp.where(pts_valid, ~jnp.isfinite(lnls), False))
+    return lnls, n_bad
+
+
 class CommonProcess:
     """The PTA likelihood with an ORF-correlated common red process.
 
@@ -227,10 +280,11 @@ class CommonProcess:
     """
 
     def __init__(self, pairs=None, *, batch=None, nmodes=10, orf="hd",
-                 tspan_s=None, marginalize_timing=True,
+                 tspan_s=None, marginalize_timing=True, kron=None,
                  _prebuilt=None):
         with span("gw.common.build", nmodes=nmodes,
                   orf=orf if isinstance(orf, str) else "custom"):
+            self.resids = None
             if _prebuilt is not None:
                 # per-pulsar data already assembled by a sibling
                 # engine (OptimalStatistic.common_process) — skip the
@@ -238,10 +292,14 @@ class CommonProcess:
                 # eager jacfwd timing-design sweep)
                 data, pos, freqs, df = _prebuilt
             else:
-                data, pos, freqs, df, _ = build_pulsar_data(
+                data, pos, freqs, df, resids = build_pulsar_data(
                     pairs, batch=batch, nmodes=nmodes,
                     tspan_s=tspan_s,
                     marginalize_timing=marginalize_timing)
+                # kept for gradient-based samplers (gw/hmc builds its
+                # per-pulsar noise-weight maps from the prepared
+                # models); None on the _prebuilt fast path
+                self.resids = resids
             self.data = data
             self.names = [d.name for d in data]
             self.n_pulsars = len(data)
@@ -258,23 +316,96 @@ class CommonProcess:
                 np.concatenate([d.sigma for d in data]))
             self.phi_noise = jnp.asarray(
                 np.concatenate([d.phi for d in data]))
-            n_tot = self.r.shape[0]
+            self.n_toa_total = int(self.r.shape[0])
+            # ``kron=None`` follows the $PINT_TPU_KRON_PHI gate; the
+            # resolved flag is part of every lnlike/lnlike_grid jit
+            # key (the two paths are different traced programs —
+            # tools/check_jit_gates.py).  Each path's array layout is
+            # materialized LAZILY on first use: the dense stacked
+            # U_full is O(N_tot x K) of mostly block-diagonal zeros —
+            # a kron-served instance must not keep it resident (it is
+            # the allocation the kron path exists to avoid), and a
+            # dense-served instance skips the padded kron stacks.
+            self._kron = (_cc.kron_phi_default() if kron is None
+                          else bool(kron))
+            self._U_full = None
+            self._kron_data = None
+
+    @property
+    def U_full(self):
+        """The dense stacked (N_tot, K) basis — built on first access
+        (the dense lnlike path, the reference tests)."""
+        if self._U_full is None:
+            n_tot = self.n_toa_total
             kn = self.phi_noise.shape[0]
             m2 = 2 * self.nmodes
             U = np.zeros((n_tot, kn + self.n_pulsars * m2))
             row = col = 0
-            for k, d in enumerate(data):
+            for k, d in enumerate(self.data):
                 n, nb = d.U.shape
                 U[row:row + n, col:col + nb] = d.U
                 U[row:row + n, kn + k * m2: kn + (k + 1) * m2] = d.F
                 row += n
                 col += nb
-            self.U_full = jnp.asarray(U)
-            self.n_toa_total = n_tot
+            self._U_full = jnp.asarray(U)
+        return self._U_full
+
+    @property
+    def kron_data(self):
+        """Kron-structured per-pulsar stacks, built on first access:
+        the SAME model as the dense prior, carried as padded (P, ...)
+        arrays the structured solver (linalg.KronPhi) consumes.  Pad
+        rows have zero r/U/F entries (every contraction exact) and
+        PAD_SIGMA_S sigmas; pad columns carry zero weights (the
+        _PHI_FLOOR pinning) — exactness asserted in
+        tests/test_kron_hmc.py."""
+        if self._kron_data is None:
+            data = self.data
+            n_max = max(d.r.shape[0] for d in data)
+            nb_max = max(d.U.shape[1] for d in data)
+            m2 = 2 * self.nmodes
+            p = self.n_pulsars
+            r_pad = np.zeros((p, n_max))
+            sig_pad = np.full((p, n_max), PAD_SIGMA_S)
+            valid = np.zeros((p, n_max), dtype=bool)
+            U_pad = np.zeros((p, n_max, nb_max))
+            F_pad = np.zeros((p, n_max, m2))
+            phi_pad = np.zeros((p, nb_max))
+            for k, d in enumerate(data):
+                n, nb = d.U.shape
+                r_pad[k, :n] = d.r
+                sig_pad[k, :n] = d.sigma
+                valid[k, :n] = True
+                U_pad[k, :n, :nb] = d.U
+                F_pad[k, :n, :] = d.F
+                phi_pad[k, :nb] = d.phi
+            self._kron_data = {
+                "r": jnp.asarray(r_pad),
+                "sigma": jnp.asarray(sig_pad),
+                "U": jnp.asarray(U_pad), "F": jnp.asarray(F_pad),
+                "valid": jnp.asarray(valid),
+                "phi_noise": jnp.asarray(phi_pad),
+            }
+        return self._kron_data
 
     def _lnlike_jit(self):
-        return _cc.shared_jit(_crn_lnlike_one,
-                              key=("gw.common.lnlike",))
+        fn = _kron_lnlike_one if self._kron else _crn_lnlike_one
+        return _cc.shared_jit(
+            fn, key=("gw.common.lnlike", self._kron),
+            label="gw.common.lnlike" + (":kron" if self._kron else ""))
+
+    def _lnlike_args(self, log10_amp, gamma):
+        """Positional args of the active lnlike program (kron padded
+        stacks vs dense stacked arrays)."""
+        common = (self.orf, self.freqs, self.df,
+                  jnp.float64(self.n_toa_total),
+                  jnp.float64(log10_amp), jnp.float64(gamma))
+        if self._kron:
+            kd = self.kron_data
+            return (kd["r"], kd["sigma"], kd["U"], kd["F"],
+                    kd["valid"], kd["phi_noise"]) + common
+        return (self.r, self.sigma, self.U_full,
+                self.phi_noise) + common
 
     def lnlike(self, log10_amp, gamma, check=True):
         """Log-likelihood at one (log10 amplitude, spectral index).
@@ -285,12 +416,9 @@ class CommonProcess:
         handing a sampler NaN; pass check=False for raw -inf/NaN
         semantics."""
         with span("gw.common.lnlike", n_pulsars=self.n_pulsars,
-                  nmodes=self.nmodes):
+                  nmodes=self.nmodes, kron=self._kron):
             out, health = self._lnlike_jit()(
-                self.r, self.sigma, self.U_full, self.phi_noise,
-                self.orf, self.freqs, self.df,
-                jnp.float64(self.n_toa_total),
-                jnp.float64(log10_amp), jnp.float64(gamma))
+                *self._lnlike_args(log10_amp, gamma))
             # the check honors the guard gate — PINT_TPU_GUARD=0
             # restores raw -inf/NaN semantics like check=False
             if check and _guard.enabled():
@@ -306,20 +434,26 @@ class CommonProcess:
                     detail=f"lnlike({log10_amp}, {gamma}) non-finite")
             return float(out)
 
-    #: lnlike_grid partition rules: the two point-axis arrays ride the
-    #: ``grid`` axis; every stacked-array/basis leaf is explicitly
+    #: lnlike_grid partition rules: the point-axis arrays (the two
+    #: grids plus the pad-point mask of the on-device bad count) ride
+    #: the ``grid`` axis; every stacked-array/basis leaf is explicitly
     #: replicated (each device evaluates its grid points against the
-    #: full array), so rule resolution covers EVERY leaf of the call
+    #: full array), so rule resolution covers EVERY leaf of the call —
+    #: one table serves the dense and kron argument layouts
     _GRID_RULES = (
-        (r"^(log10_amps|gammas)$", "grid"),
-        (r"^(r|sigma|U_full|phi_noise|orf|freqs)$", None),
+        (r"^(log10_amps|gammas|pts_valid)$", "grid"),
+        (r"^(r|sigma|U_full|U|F|valid|phi_noise|orf|freqs)$", None),
     )
 
     def lnlike_grid(self, log10_amps, gammas, mesh=None):
         """(A, G) log-likelihood surface over the outer product of the
         two 1-d grids — one vmapped program.  Non-finite grid points
-        are counted (``guard.trip.gw_lnlike_grid``) and warned about,
-        never silently returned as a clean-looking surface.
+        are counted ON DEVICE (the count returns alongside the grid as
+        a second program output — no host-side pass over the surface,
+        so a sharded grid never syncs per point; edge-repeated pad
+        points are masked out of the count) and warned about
+        (``guard.trip.gw_lnlike_grid``), never silently returned as a
+        clean-looking surface.
 
         mesh: a device mesh — the flattened point axis is padded to a
         device multiple (edge-repeated; the pad points are sliced off
@@ -339,23 +473,35 @@ class CommonProcess:
         log10_amps = np.atleast_1d(np.asarray(log10_amps, np.float64))
         gammas = np.atleast_1d(np.asarray(gammas, np.float64))
         aa, gg = np.meshgrid(log10_amps, gammas, indexing="ij")
+        grid_fn = (_kron_lnlike_grid_fn if self._kron
+                   else _crn_lnlike_grid_fn)
         fn = _cc.shared_jit(
-            _crn_lnlike_vec,
-            key=("gw.common.lnlike_grid",) + _mesh.mesh_jit_key(mesh),
+            grid_fn,
+            key=("gw.common.lnlike_grid", self._kron)
+                + _mesh.mesh_jit_key(mesh),
             fn_token="gw.common.lnlike_grid",
             label="gw.common.lnlike_grid"
+                  + (":kron" if self._kron else "")
                   + (":sharded" if mesh is not None else ""))
         fn.set_mesh(_mesh.mesh_desc(mesh))
         n_pts = aa.size
         amps_flat, gams_flat = (jnp.asarray(aa.ravel()),
                                 jnp.asarray(gg.ravel()))
-        args = {
-            "r": self.r, "sigma": self.sigma, "U_full": self.U_full,
-            "phi_noise": self.phi_noise, "orf": self.orf,
-            "freqs": self.freqs, "df": self.df,
+        if self._kron:
+            kd = self.kron_data
+            args = {"r": kd["r"], "sigma": kd["sigma"], "U": kd["U"],
+                    "F": kd["F"], "valid": kd["valid"],
+                    "phi_noise": kd["phi_noise"]}
+        else:
+            args = {"r": self.r, "sigma": self.sigma,
+                    "U_full": self.U_full,
+                    "phi_noise": self.phi_noise}
+        args.update({
+            "orf": self.orf, "freqs": self.freqs, "df": self.df,
             "n_toa": jnp.float64(self.n_toa_total),
             "log10_amps": amps_flat, "gammas": gams_flat,
-        }
+            "pts_valid": jnp.ones(n_pts, dtype=bool),
+        })
         if mesh is not None:
             names = tuple(str(n) for n in mesh.axis_names)
             if len(names) == 1:
@@ -372,6 +518,10 @@ class CommonProcess:
             for k in ("log10_amps", "gammas"):
                 args[k] = _mesh.pad_leading(args[k], n_pad,
                                             mode="edge")
+            # pad points are edge clones — mask them out of the
+            # on-device bad count so a clone can't double-report
+            args["pts_valid"] = _mesh.pad_leading(
+                args["pts_valid"], n_pad, mode="zero")
             rules = tuple(
                 (pat, point_spec if ax else None)
                 for pat, ax in self._GRID_RULES)
@@ -380,10 +530,11 @@ class CommonProcess:
                                  n_pulsars=self.n_pulsars,
                                  n_points=n_pts), \
             span("gw.common.lnlike_grid", n_pulsars=self.n_pulsars,
-                 n_points=n_pts, sharded=mesh is not None):
-            out, _health = fn(*args.values())
+                 n_points=n_pts, sharded=mesh is not None,
+                 kron=self._kron):
+            out, n_bad_dev = fn(*args.values())
         surf = np.asarray(out)[:n_pts].reshape(aa.shape)
-        n_bad = int(np.count_nonzero(~np.isfinite(surf)))
+        n_bad = int(n_bad_dev)
         if n_bad:
             import warnings
 

@@ -1,0 +1,664 @@
+"""Gradient-based GWB posterior sampling — the "from grid to
+posterior" engine (ROADMAP item 3; the discovery-style inference
+framework of arXiv 2607.06834).
+
+The 2-D CRN grid of :class:`pint_tpu.gw.common.CommonProcess` fixes
+every pulsar's intrinsic noise and scans two hyperparameters.  This
+module makes the stacked-array likelihood a first-class gradient
+target instead: :class:`GWBPosterior` maps a parameter vector
+``theta = (gwb log10_A, gwb gamma, per-pulsar sampled noise params)``
+to the log posterior with ``jax.grad`` flowing through the
+kron-structured Woodbury solve (:func:`pint_tpu.linalg
+.kron_chi2_logdet_pre`), and :func:`run_nuts` samples it with every
+chain vmapped into ONE shared-jit scan program.
+
+Sampler design (and what it deliberately is not): ``run_nuts`` is the
+NUTS-class gradient sampler in its static-trajectory form —
+multi-step leapfrog trajectories with uniformly jittered length,
+endpoint Metropolis acceptance, dual-averaging step-size adaptation
+(Hoffman & Gelman 2014's algorithm 5) inside the scan, and a diagonal
+metric from per-parameter scales.  The no-U-turn DYNAMIC termination
+is deliberately not implemented: per-chain data-dependent trajectory
+lengths under ``vmap`` run every chain to the worst case anyway while
+breaking the fixed-shape scan that gives zero recompiles across
+chains and chunks — the static-jittered trajectory keeps the gradient
+core, the adaptation, and the shapes.
+
+Performance structure: when no sampled parameter touches sigma (the
+amp/gamma + per-pulsar red-noise configuration of the flagship run),
+the per-pulsar weighted grams are precomputed ONCE host-side
+(:func:`pint_tpu.linalg.kron_gram_precompute` — the same frozen
+noise-gram idea the PR-5 fit path uses) and ride the chunk program as
+dynamic data leaves, so one posterior gradient costs
+O(P nb^3 + (P m2)^3) with no O(N_toa) contraction at all.  Sampled
+white-noise parameters (EFAC etc.) switch the gram into the trace —
+same algebra, the gradient simply flows through it.
+
+Iteration records ride the scan's ys through
+``compile_cache.iterate_fixed(trace_of=)`` (the PR-10 flight-recorder
+hook): they ARE the chain, so they are always materialized; the
+``$PINT_TPU_ITER_TRACE`` gate controls only whether per-draw
+``iter_trace`` telemetry records are additionally emitted host-side.
+Checkpoint/resume follows the PR-4 contract (atomic writes validated
+against the posterior fingerprint; a killed run loses at most one
+chunk).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu import compile_cache as _cc
+from pint_tpu import faults as _faults
+from pint_tpu import guard as _guard
+from pint_tpu import telemetry
+from pint_tpu.gw.common import CommonProcess, gwb_phi
+from pint_tpu.linalg import (KronPhi, kron_chi2_logdet_pre,
+                             kron_gram_precompute,
+                             woodbury_chi2_logdet)
+from pint_tpu.telemetry import span
+
+__all__ = ["GWBPosterior", "run_nuts", "NUTSResult",
+           "DEFAULT_BOUNDS", "DEFAULT_SCALES"]
+
+#: prior bounds per parameter name (uniform prior; the posterior peak
+#: therefore coincides with the likelihood peak, which is what the
+#: grid-consistency acceptance compares).  Overridable per call.
+DEFAULT_BOUNDS = {
+    "gwb_log10_A": (-18.0, -11.0),
+    "gwb_gamma": (0.0, 7.0),
+    "TNREDAMP": (-20.0, -10.0),
+    "TNREDGAM": (0.0, 7.0),
+}
+_FALLBACK_BOUNDS = (-30.0, 30.0)
+
+#: diagonal-metric scales per parameter name (the sampler's mass
+#: matrix is diag(1/scale^2); log-amplitudes and spectral indices are
+#: already O(1)-scaled coordinates, which is why a fixed diagonal
+#: metric works where the raw-parameter MCMC needed per-param ball
+#: scales).  Overridable per call.
+DEFAULT_SCALES = {
+    "gwb_log10_A": 0.3,
+    "gwb_gamma": 0.4,
+    "TNREDAMP": 0.4,
+    "TNREDGAM": 0.5,
+}
+_FALLBACK_SCALE = 0.2
+
+
+def _probe_changes(fn, values, name, delta):
+    """Host-side build-time probe: does perturbing ``values[name]`` by
+    ``delta`` change ``fn(values)``?  Classifies a sampled parameter
+    as sigma-affecting (white noise) vs basis-weight-affecting (red /
+    ECORR) without hard-coding component knowledge."""
+    base = np.asarray(fn(values))
+    pert = dict(values)
+    pert[name] = float(values[name]) + delta
+    return not np.allclose(base, np.asarray(fn(pert)), rtol=0.0,
+                           atol=0.0, equal_nan=True)
+
+
+class GWBPosterior:
+    """The differentiable stacked-array GWB posterior.
+
+    theta layout: ``[gwb_log10_A, gwb_gamma] + [one entry per
+    (pulsar, name) in sample order]`` — ``sample`` names per-pulsar
+    noise parameters (default: the power-law red-noise amplitude and
+    index) included for every pulsar whose model carries them.
+
+    Built on a :class:`~pint_tpu.gw.common.CommonProcess` constructed
+    from pairs/batch (NOT the ``_prebuilt`` fast path — the per-pulsar
+    prepared models supply the in-trace noise-weight maps).  The
+    likelihood path follows the CommonProcess's kron/dense selection:
+    kron (default) evaluates through the structured solver; dense
+    exists for the gradient-equivalence tests.
+    """
+
+    def __init__(self, crn: CommonProcess,
+                 sample=("TNREDAMP", "TNREDGAM"), bounds=None,
+                 scales=None):
+        if crn.resids is None:
+            raise ValueError(
+                "GWBPosterior needs a CommonProcess built from "
+                "pairs/batch (resids attached); the _prebuilt fast "
+                "path carries no prepared models")
+        self.crn = crn
+        self.kron = bool(crn._kron)
+        self.param_names = ["gwb_log10_A", "gwb_gamma"]
+        self.noise_params = []  # (pulsar_idx, param_name)
+        self._base_values = []
+        sigma_dynamic = False
+        for k, resid in enumerate(crn.resids):
+            self._base_values.append(
+                {n: jnp.float64(float(v))
+                 for n, v in resid.model.values.items()})
+            prep = resid.prepared
+            for name in sample:
+                if name not in resid.model.values:
+                    continue
+                self.noise_params.append((k, name))
+                self.param_names.append(f"{crn.names[k]}:{name}")
+                vals = {n: float(v)
+                        for n, v in resid.model.values.items()}
+                if _probe_changes(
+                        lambda v: prep.scaled_sigma_fn(v), vals,
+                        name, 1e-3):
+                    sigma_dynamic = True
+        self.ndim = len(self.param_names)
+        self.sigma_dynamic = sigma_dynamic
+        # per-pulsar noise-weight column counts inside the extended
+        # basis (U_ext = [noise basis | offset | timing cols]): the
+        # sampled weights replace exactly the leading nb_noise entries
+        # of each padded phi row
+        self._nb_noise = [
+            int(np.asarray(r.prepared.noise_basis).shape[1])
+            for r in crn.resids]
+        b = dict(DEFAULT_BOUNDS)
+        b.update(bounds or {})
+        s = dict(DEFAULT_SCALES)
+        s.update(scales or {})
+
+        def look(table, full_name, fallback):
+            short = full_name.split(":")[-1]
+            return table.get(full_name, table.get(short, fallback))
+
+        self.bounds = np.asarray(
+            [look(b, n, _FALLBACK_BOUNDS) for n in self.param_names],
+            dtype=np.float64)
+        self.scales = np.asarray(
+            [look(s, n, _FALLBACK_SCALE) for n in self.param_names],
+            dtype=np.float64)
+        kd = crn.kron_data
+        self._data = {
+            "orf": crn.orf, "freqs": crn.freqs, "df": crn.df,
+            "n_toa": jnp.float64(crn.n_toa_total),
+            "phi0": kd["phi_noise"],
+            "lo": jnp.asarray(self.bounds[:, 0]),
+            "hi": jnp.asarray(self.bounds[:, 1]),
+        }
+        if self.kron and not sigma_dynamic:
+            # the frozen noise-gram reuse: every draw of every chain
+            # shares ONE set of per-pulsar weighted grams
+            self._data["gram"] = kron_gram_precompute(
+                kd["r"], kd["sigma"], kd["U"], kd["F"],
+                valid=kd["valid"])
+        elif self.kron:
+            self._data.update(
+                {k: kd[k] for k in ("r", "sigma", "U", "F", "valid")})
+        # scales and bounds are part of the identity: the sampler's
+        # inv_mass (scales^2) is CLOSED OVER by the chunk program (a
+        # static of the trace — shared_jit's key-must-cover contract),
+        # and a checkpoint written under different bounds must be
+        # refused, not resumed into a mixed-bounds chain
+        self.fingerprint = _cc.fingerprint((
+            "gw.hmc", self.param_names, self.kron,
+            self.sigma_dynamic,
+            np.asarray(self.scales), np.asarray(self.bounds),
+            np.asarray(kd["r"]), np.asarray(kd["sigma"]),
+            np.asarray(kd["phi_noise"]), np.asarray(crn.orf)))
+
+    # -- theta -> model ingredients ------------------------------------------
+
+    def _values_at(self, theta, k):
+        """Pulsar k's values dict with its sampled params overridden."""
+        values = dict(self._base_values[k])
+        for j, (pi, name) in enumerate(self.noise_params):
+            if pi == k:
+                values[name] = theta[2 + j]
+        return values
+
+    def _phi_noise_at(self, theta, phi0):
+        """(P, nb) padded noise-weight rows at ``theta`` — each
+        pulsar's prepared ``noise_weights_fn`` re-evaluated in-trace
+        (the host loop unrolls over pulsars at trace build), scattered
+        over the fixed offset/timing-column tail of ``phi0``."""
+        rows = []
+        for k, resid in enumerate(self.crn.resids):
+            w = resid.prepared.noise_weights_fn(self._values_at(theta,
+                                                               k))
+            rows.append(phi0[k].at[:self._nb_noise[k]].set(w))
+        return jnp.stack(rows)
+
+    def _sigma_at(self, theta, sigma0):
+        """(P, N) padded sigma rows at ``theta`` (only reached when a
+        sampled parameter is sigma-affecting)."""
+        rows = []
+        for k, resid in enumerate(self.crn.resids):
+            s = resid.prepared.scaled_sigma_fn(self._values_at(theta,
+                                                               k))
+            rows.append(sigma0[k].at[:s.shape[0]].set(s))
+        return jnp.stack(rows)
+
+    # -- the log posterior ----------------------------------------------------
+
+    def lnprob(self, theta, data):
+        """Log posterior (uniform prior inside ``bounds``) — a pure
+        traceable function of (theta, data); ``jax.grad`` flows
+        through the kron solve into every sampled parameter.  Outside
+        the bounds the value is -inf and the likelihood is evaluated
+        at the clipped point (finite everywhere, so the gradient the
+        leapfrog uses at the boundary stays usable)."""
+        lo, hi = data["lo"], data["hi"]
+        inside = jnp.all((theta >= lo) & (theta <= hi))
+        th = jnp.clip(theta, lo, hi)
+        amp = 10.0 ** th[0]
+        phi_gw = gwb_phi(data["freqs"], amp, th[1], data["df"])
+        phi_noise = self._phi_noise_at(th, data["phi0"])
+        kp = KronPhi(orf=data["orf"], phi_gw=phi_gw,
+                     phi_noise=phi_noise)
+        if self.kron and not self.sigma_dynamic:
+            chi2, logdet = kron_chi2_logdet_pre(data["gram"], kp)
+        elif self.kron:
+            sigma = self._sigma_at(th, data["sigma"])
+            gram = kron_gram_precompute(data["r"], sigma, data["U"],
+                                        data["F"],
+                                        valid=data["valid"])
+            chi2, logdet = kron_chi2_logdet_pre(gram, kp)
+        else:
+            # the dense reference path (gradient-equivalence tests):
+            # the same theta-dependent prior, materialized (K, K)
+            chi2, logdet = self._dense_chi2_logdet(th, kp)
+        lnl = (-0.5 * (chi2 + logdet)
+               - 0.5 * data["n_toa"] * jnp.log(2.0 * jnp.pi))
+        return jnp.where(inside, lnl, -jnp.inf)
+
+    def _dense_chi2_logdet(self, th, kp):
+        """Dense-path twin of the kron evaluation: stacked ragged
+        arrays, materialized prior, one (K, K) factorization — the
+        independent reference the kron gradients are verified
+        against."""
+        crn = self.crn
+        phi_parts, sig_parts = [], []
+        for k, resid in enumerate(crn.resids):
+            values = self._values_at(th, k)
+            d = crn.data[k]
+            w = resid.prepared.noise_weights_fn(values)
+            nb_n = self._nb_noise[k]
+            phi_parts.append(jnp.concatenate(
+                [w, jnp.asarray(d.phi[nb_n:])]))
+            if self.sigma_dynamic:
+                sig_parts.append(resid.prepared.scaled_sigma_fn(values))
+            else:
+                sig_parts.append(jnp.asarray(d.sigma))
+        phi_noise = jnp.concatenate(phi_parts)
+        sigma = jnp.concatenate(sig_parts)
+        kn = phi_noise.shape[0]
+        ktot = crn.U_full.shape[1]
+        gw_block = jnp.kron(kp.orf, jnp.diag(kp.phi_gw))
+        phi_dense = jnp.zeros((ktot, ktot))
+        phi_dense = phi_dense.at[:kn, :kn].set(jnp.diag(phi_noise))
+        phi_dense = phi_dense.at[kn:, kn:].set(gw_block)
+        return woodbury_chi2_logdet(crn.r, sigma, crn.U_full,
+                                    phi_dense)
+
+    def data(self):
+        """The dynamic data pytree of the chunk program."""
+        return self._data
+
+    def center(self):
+        """A reasonable chain center: bounds midpoint for the GWB
+        hyperparameters, each model's CURRENT value for sampled
+        per-pulsar parameters (clipped into bounds)."""
+        c = np.empty(self.ndim)
+        c[0] = -14.5
+        c[1] = 13.0 / 3.0
+        for j, (k, name) in enumerate(self.noise_params):
+            c[2 + j] = float(self.crn.resids[k].model.values[name])
+        return np.clip(c, self.bounds[:, 0] + 1e-6,
+                       self.bounds[:, 1] - 1e-6)
+
+    def initial_chains(self, n_chains, seed=0, center=None,
+                       ball=0.1):
+        """(n_chains, ndim) starting points: a scaled Gaussian ball
+        around :meth:`center`, clipped inside the prior support."""
+        rng = np.random.default_rng(seed)
+        c = self.center() if center is None else np.asarray(center)
+        x0 = c[None, :] + ball * self.scales[None, :] * \
+            rng.standard_normal((int(n_chains), self.ndim))
+        return np.clip(x0, self.bounds[None, :, 0] + 1e-9,
+                       self.bounds[None, :, 1] - 1e-9)
+
+
+class NUTSResult(NamedTuple):
+    """What :func:`run_nuts` returns."""
+
+    samples: np.ndarray       # (num_samples, n_chains, ndim)
+    lnprob: np.ndarray        # (num_samples, n_chains)
+    accept_rate: float        # post-warmup mean acceptance
+    step_size: np.ndarray     # (n_chains,) adapted step sizes
+    divergences: int          # post-warmup divergent transitions
+    warmup_samples: np.ndarray  # (num_warmup, n_chains, ndim)
+
+    def flat(self):
+        """(num_samples * n_chains, ndim) flattened posterior."""
+        s = np.asarray(self.samples)
+        return s.reshape(-1, s.shape[-1])
+
+    def max_posterior(self):
+        """(theta, lnp) at the best sampled point."""
+        lnp = np.asarray(self.lnprob)
+        i, j = np.unravel_index(np.argmax(lnp), lnp.shape)
+        return np.asarray(self.samples[i, j]), float(lnp[i, j])
+
+
+# dual-averaging constants (Hoffman & Gelman 2014, algorithm 5)
+_DA_GAMMA = 0.05
+_DA_T0 = 10.0
+_DA_KAPPA = 0.75
+#: energy-error threshold marking a transition divergent
+_DIVERGENCE_DH = 1000.0
+
+
+def _chunk_body(lnprob_v, inv_mass, n_leapfrog, target_accept,
+                constrain):
+    """Build the one-draw transition ``carry -> carry`` (vmapped over
+    chains) the chunk scan iterates.  Everything data-dependent
+    arrives through the carry/data pytrees; the closure holds only
+    structure (ndim-independent python floats and the vmapped
+    posterior)."""
+
+    def one_chain(key, x, lnp, g, log_eps, hbar, log_eps_bar, mu,
+                  it, data, warmup):
+        k_p, k_len, k_acc, k_next = jax.random.split(key, 4)
+        adapting = it < warmup
+        eps = jnp.where(adapting, jnp.exp(log_eps),
+                        jnp.exp(log_eps_bar))
+        p0 = jax.random.normal(k_p, x.shape) / jnp.sqrt(inv_mass)
+        n_steps = jax.random.randint(k_len, (), 1, n_leapfrog + 1)
+
+        def leap(carry, i):
+            xi, pi, gi = carry
+            active = i < n_steps
+            ph = pi + 0.5 * eps * gi
+            xn = xi + eps * inv_mass * ph
+            lnp_n, gn = jax.value_and_grad(
+                lambda q: lnprob_v(q, data))(xn)
+            pn = ph + 0.5 * eps * gn
+            new = (jnp.where(active, xn, xi),
+                   jnp.where(active, pn, pi),
+                   jnp.where(active, gn, gi))
+            return new, jnp.where(active, lnp_n, -jnp.inf)
+
+        (x1, p1, g1), lnps = jax.lax.scan(
+            leap, (x, p0, g), jnp.arange(n_leapfrog))
+        # the endpoint's log posterior is the last ACTIVE step's ys
+        # entry (inactive steps never move x) — no extra evaluation
+        lnp1 = jnp.take(lnps, n_steps - 1)
+        h0 = -lnp + 0.5 * jnp.sum(p0 * p0 * inv_mass)
+        h1 = -lnp1 + 0.5 * jnp.sum(p1 * p1 * inv_mass)
+        dh = h0 - h1
+        acc_prob = jnp.where(jnp.isfinite(dh),
+                             jnp.exp(jnp.minimum(0.0, dh)), 0.0)
+        # a trajectory that EXITS the prior support (lnp1 = -inf) is
+        # an ordinary rejection, not an integrator failure — only a
+        # finite-endpoint energy blow-up (or NaN) counts as divergent,
+        # so the diagnostic means what samplers mean by it
+        divergent = jnp.logical_or(
+            jnp.isnan(dh),
+            jnp.logical_and(-dh > _DIVERGENCE_DH,
+                            jnp.isfinite(lnp1)))
+        accept = jnp.log(jax.random.uniform(k_acc)) < dh
+        x_new = jnp.where(accept, x1, x)
+        lnp_new = jnp.where(accept, lnp1, lnp)
+        g_new = jnp.where(accept, g1, g)
+        # dual averaging (warmup only; frozen to the averaged step
+        # afterwards — all branches traced, one program)
+        t = it + 1.0
+        hbar_n = ((1.0 - 1.0 / (t + _DA_T0)) * hbar
+                  + (target_accept - acc_prob) / (t + _DA_T0))
+        log_eps_n = mu - jnp.sqrt(t) / _DA_GAMMA * hbar_n
+        eta = t ** (-_DA_KAPPA)
+        log_eps_bar_n = eta * log_eps_n + (1.0 - eta) * log_eps_bar
+        hbar = jnp.where(adapting, hbar_n, hbar)
+        log_eps = jnp.where(adapting, log_eps_n, log_eps)
+        log_eps_bar = jnp.where(adapting, log_eps_bar_n, log_eps_bar)
+        return (k_next, x_new, lnp_new, g_new, log_eps, hbar,
+                log_eps_bar, acc_prob, divergent, eps)
+
+    v_chain = jax.vmap(
+        one_chain,
+        in_axes=(0, 0, 0, 0, 0, 0, 0, 0, None, None, None))
+
+    def body(carry):
+        (keys, x, lnp, g, log_eps, hbar, log_eps_bar, mu, acc, div,
+         eps_used, it, data, warmup) = carry
+        (keys, x, lnp, g, log_eps, hbar, log_eps_bar, acc, div,
+         eps_used) = v_chain(keys, x, lnp, g, log_eps, hbar,
+                             log_eps_bar, mu, it, data, warmup)
+        if constrain is not None:
+            x = constrain(x)
+        return (keys, x, lnp, g, log_eps, hbar, log_eps_bar, mu, acc,
+                div, eps_used, it + 1.0, data, warmup)
+
+    return body
+
+
+def _draw_record(_prev, new):
+    """Per-draw flight-recorder record — also the chain itself (the
+    scan's ys ARE the samples, so the record is always materialized;
+    the $PINT_TPU_ITER_TRACE gate controls only host-side telemetry
+    emission)."""
+    (_keys, x, lnp, _g, _le, _hb, _leb, _mu, acc, div, eps_used,
+     _it, _data, _warmup) = new
+    return {"theta": x, "lnp": lnp, "accept": acc,
+            "divergent": div, "eps": eps_used,
+            "ok": jnp.all(jnp.isfinite(x), axis=-1)
+            & jnp.isfinite(lnp)}
+
+
+def run_nuts(posterior: GWBPosterior, *, num_warmup=300,
+             num_samples=500, n_chains=4, seed=0, x0=None,
+             num_leapfrog=12, target_accept=0.8, step_size0=0.02,
+             chunk=None, mesh=None, checkpoint=None):
+    """Sample a :class:`GWBPosterior`: every chain one row of ONE
+    vmapped scan program, dual-averaged step size, jittered-length
+    leapfrog trajectories (module docstring for exactly what this is
+    and is not).
+
+    The run is cut into equal ``chunk``-draw scans of one shared-jit
+    program (structure in the key, everything else dynamic): after
+    the first chunk compiles, every further chunk of every chain —
+    warmup or sampling, fresh or resumed — performs ZERO new XLA
+    compiles (telemetry-counter regression-tested).  ``mesh`` holds
+    the chain axis on the ``walker`` mesh axis via the shared
+    chain-axis rule (:func:`pint_tpu.parallel.mesh
+    .chain_constrainer`); n_chains must divide accordingly.
+
+    checkpoint: optional path — samples + full sampler state are
+    atomic-written after every chunk (PR-4 contract, validated
+    against the posterior fingerprint), and an existing file resumes
+    mid-run losing at most one chunk (``faults`` kill-site
+    ``hmc.chunk`` exercises exactly that in the chaos tests)."""
+    from pint_tpu.parallel import mesh as _mesh
+
+    total = int(num_warmup) + int(num_samples)
+    if chunk is None:
+        chunk = min(64, total)
+    chunk = max(1, int(chunk))
+    n_chunks = -(-total // chunk)
+    padded_total = n_chunks * chunk
+    constrain = _mesh.chain_constrainer(
+        mesh, n_chains, requested_by="run_nuts: n_chains")
+    scan_flag = _cc.scan_iters_default()
+    lnprob = posterior.lnprob
+    data = dict(posterior.data())
+    warmup_f = jnp.float64(num_warmup)
+    inv_mass = jnp.asarray(posterior.scales**2)
+    nd = posterior.ndim
+
+    if x0 is None:
+        x0 = posterior.initial_chains(n_chains, seed=seed)
+    x0 = jnp.asarray(x0, dtype=jnp.float64)
+    if x0.shape != (n_chains, nd):
+        raise ValueError(
+            f"run_nuts: x0 shape {x0.shape} != (n_chains, ndim) = "
+            f"({n_chains}, {nd})")
+
+    body = _chunk_body(lnprob, inv_mass, int(num_leapfrog),
+                       float(target_accept), constrain)
+
+    def chunk_program(carry):
+        return _cc.iterate_fixed(body, carry, chunk, scan=scan_flag,
+                                 trace_of=_draw_record)
+
+    runner = _cc.shared_jit(
+        chunk_program,
+        key=("gw.hmc.chunk", int(chunk), int(num_leapfrog),
+             float(target_accept), scan_flag, posterior.kron)
+            + _mesh.mesh_jit_key(mesh),
+        fn_token=("gw.hmc", posterior.fingerprint),
+        label="gw.hmc.chunk" + (":sharded" if mesh is not None
+                                else ""))
+    runner.set_mesh(_mesh.mesh_desc(mesh))
+
+    fp = _cc.fingerprint((posterior.fingerprint, int(n_chains),
+                          int(nd), int(num_leapfrog), int(chunk),
+                          int(num_warmup), int(num_samples),
+                          float(step_size0), float(target_accept)))
+
+    mu0 = jnp.full(n_chains, math.log(10.0 * float(step_size0)))
+    thetas, lnps, accs, divs, epss = [], [], [], [], []
+    done_chunks = 0
+    carry = None
+    if checkpoint is not None:
+        loaded = _guard.load_checkpoint(checkpoint, fingerprint=fp)
+        if loaded is not None:
+            arrays, _head = loaded
+            done_chunks = int(arrays["done_chunks"][()])
+            thetas = [arrays["theta"]]
+            lnps = [arrays["lnp"]]
+            accs = [arrays["accept"]]
+            divs = [arrays["divergent"]]
+            epss = [arrays["eps"]]
+            carry = (jnp.asarray(arrays["keys"]),
+                     jnp.asarray(arrays["x"]),
+                     jnp.asarray(arrays["c_lnp"]),
+                     jnp.asarray(arrays["g"]),
+                     jnp.asarray(arrays["log_eps"]),
+                     jnp.asarray(arrays["hbar"]),
+                     jnp.asarray(arrays["log_eps_bar"]),
+                     mu0,
+                     jnp.asarray(arrays["acc"]),
+                     jnp.asarray(arrays["div"]),
+                     jnp.asarray(arrays["eps_state"]),
+                     jnp.float64(float(arrays["it"][()])),
+                     data, warmup_f)
+            telemetry.counter_add("hmc.resumes")
+    if carry is None:
+        # fresh start only: the initial posterior + gradient over all
+        # chains (a resume restores these from the checkpoint)
+        keys = jax.random.split(jax.random.PRNGKey(int(seed)),
+                                n_chains)
+        lnp0, g0 = jax.vmap(jax.value_and_grad(
+            lambda q: lnprob(q, data)))(x0)
+        carry = (keys, x0, lnp0, g0,
+                 jnp.full(n_chains, math.log(float(step_size0))),
+                 jnp.zeros(n_chains),
+                 jnp.full(n_chains, math.log(float(step_size0))),
+                 mu0, jnp.zeros(n_chains),
+                 jnp.zeros(n_chains, bool),
+                 jnp.full(n_chains, float(step_size0)),
+                 jnp.float64(0.0), data, warmup_f)
+
+    iter_trace = _cc.iter_trace_default()
+    with telemetry.run_scope("hmc", chains=int(n_chains),
+                             ndim=int(nd), total=total,
+                             kron=posterior.kron), \
+            span("gw.hmc.run", chains=int(n_chains), total=total):
+        for _ci in range(done_chunks, n_chunks):
+            carry, rec = runner(carry)
+            thetas.append(np.asarray(rec["theta"]))
+            lnps.append(np.asarray(rec["lnp"]))
+            accs.append(np.asarray(rec["accept"]))
+            divs.append(np.asarray(rec["divergent"]))
+            epss.append(np.asarray(rec["eps"]))
+            # a partial final chunk still scans `chunk` draws (fixed
+            # shapes = zero recompiles) but only the first `real` are
+            # returned — the ledger reports completed draws, never
+            # the padded surplus
+            real = min(chunk, total - _ci * chunk)
+            telemetry.counter_add("hmc.draws", real * n_chains)
+            telemetry.counter_add("hmc.chunks")
+            n_div = int(np.sum(divs[-1][:real]))
+            if n_div:
+                telemetry.counter_add("hmc.divergences", n_div)
+            if iter_trace:
+                base = len(thetas[:-1]) and sum(
+                    t.shape[0] for t in thetas[:-1])
+                for i in range(real):
+                    telemetry.emit({
+                        "type": "iter_trace", "program": "gw.hmc",
+                        "i": int(base + i),
+                        "lnp": float(np.median(lnps[-1][i])),
+                        "lnp_min": float(np.min(lnps[-1][i])),
+                        "lnp_max": float(np.max(lnps[-1][i])),
+                        "accept": float(np.mean(accs[-1][i])),
+                        "eps": float(np.mean(epss[-1][i])),
+                        "n_divergent": int(np.sum(divs[-1][i])),
+                        "ok": bool(np.all(
+                            np.isfinite(thetas[-1][i]))),
+                    })
+            if checkpoint is not None:
+                (keys_c, x_c, lnp_c, g_c, le_c, hb_c, leb_c, _mu,
+                 acc_c, div_c, eps_c, it_c, _d, _w) = carry
+                _guard.save_checkpoint(
+                    checkpoint,
+                    {"theta": np.concatenate(thetas, axis=0),
+                     "lnp": np.concatenate(lnps, axis=0),
+                     "accept": np.concatenate(accs, axis=0),
+                     "divergent": np.concatenate(divs, axis=0),
+                     "eps": np.concatenate(epss, axis=0),
+                     "done_chunks": np.int64(_ci + 1),
+                     "keys": np.asarray(keys_c),
+                     "x": np.asarray(x_c),
+                     "c_lnp": np.asarray(lnp_c),
+                     "g": np.asarray(g_c),
+                     "log_eps": np.asarray(le_c),
+                     "hbar": np.asarray(hb_c),
+                     "log_eps_bar": np.asarray(leb_c),
+                     "acc": np.asarray(acc_c),
+                     "div": np.asarray(div_c),
+                     "eps_state": np.asarray(eps_c),
+                     "it": np.float64(float(it_c))},
+                    fingerprint=fp,
+                    meta={"total": total, "chunk": chunk})
+                _faults.maybe_kill("hmc.chunk")
+
+    theta_all = np.concatenate(thetas, axis=0)[:padded_total]
+    lnp_all = np.concatenate(lnps, axis=0)
+    acc_all = np.concatenate(accs, axis=0)
+    div_all = np.concatenate(divs, axis=0)
+    eps_all = np.concatenate(epss, axis=0)
+    nw = int(num_warmup)
+    ns = int(num_samples)
+    post = slice(nw, nw + ns)
+    # chain health: the guard-gated host verdict (raw semantics with
+    # $PINT_TPU_GUARD=0, like the ensemble sampler)
+    if _guard.enabled():
+        telemetry.counter_add("guard.checks")
+        ok = (np.all(np.isfinite(theta_all[post]))
+              and np.any(np.isfinite(lnp_all[post])))
+        if not ok:
+            telemetry.counter_add("guard.trips")
+            telemetry.counter_add("guard.trip.hmc")
+            raise _guard.FitDivergedError(
+                "gw.hmc.run_nuts",
+                health={"positions_finite": bool(
+                    np.all(np.isfinite(theta_all[post]))),
+                    "any_finite_lnp": bool(
+                        np.any(np.isfinite(lnp_all[post])))},
+                detail="HMC chains diverged (non-finite positions "
+                       "or every draw at lnp=-inf)")
+    return NUTSResult(
+        samples=theta_all[post],
+        lnprob=lnp_all[post],
+        accept_rate=float(np.mean(acc_all[post])),
+        step_size=np.asarray(eps_all[-1]),
+        divergences=int(np.sum(div_all[post])),
+        warmup_samples=theta_all[:nw],
+    )

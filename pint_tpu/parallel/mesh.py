@@ -82,6 +82,7 @@ __all__ = [
     "record_pad_waste", "shard_args", "replicate",
     "distributed_init", "process_topology", "RowShard",
     "shard_toa_data", "toa_epochs_aligned", "toa_shard_plan",
+    "chain_constrainer",
 ]
 
 #: the canonical batch axes of this codebase (a mesh may use any
@@ -468,6 +469,51 @@ def shard_args(mesh, rules, tree, *, overrides=None):
     out = named_tree_map(put, tree)
     telemetry.counter_add("mesh.sharded_calls")
     return out
+
+
+# --------------------------------------------------------------------------
+# chain/walker-axis sharding (the ensemble/HMC samplers)
+# --------------------------------------------------------------------------
+
+def chain_constrainer(mesh, n, *, group=1, axis="walker",
+                      requested_by="chains"):
+    """The ONE chain-axis rule of the sampler layer: a
+    ``with_sharding_constraint`` closure holding a leading
+    chain/walker axis on the mesh's ``walker`` axis across scanned
+    steps (without it XLA is free to gather the scan carry onto one
+    device between iterations), shared by the ensemble sampler's
+    walkers (:func:`pint_tpu.sampler.run_mcmc`) and gw/hmc's vmapped
+    NUTS chains — the two batch-of-chains programs must not grow
+    separate sharding conventions.
+
+    The chain axis is NEVER padded: ensemble stretch moves couple
+    walkers (a phantom would change real proposals) and an HMC chain
+    is a logical unit of the returned posterior, so ``n`` must be a
+    multiple of ``group`` x the walker-axis device count (``group=2``
+    expresses the stretch move's red-black split — each HALF shards).
+    Raises (naming ``requested_by``) rather than padding.  Returns
+    None for ``mesh=None`` — single-device traces stay byte-identical."""
+    if mesh is None:
+        return None
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    ndev = axis_size(mesh, axis)
+    n, group = int(n), max(1, int(group))
+    if n % (group * ndev):
+        raise ValueError(
+            f"{requested_by}: n={n} must be a multiple of "
+            f"{group}x the walker-axis device count ({ndev}); the "
+            "chain axis cannot be padded — a phantom member would "
+            "change real results (stretch moves couple walkers; an "
+            "HMC chain is a returned posterior unit)")
+    sharding = NamedSharding(
+        mesh, P(resolve_axis(mesh, axis, requested_by=requested_by)))
+
+    def constrain(arr):
+        return jax.lax.with_sharding_constraint(arr, sharding)
+
+    return constrain
 
 
 # --------------------------------------------------------------------------

@@ -24,7 +24,8 @@ from pint_tpu import faults as _faults
 from pint_tpu import guard as _guard
 from pint_tpu import telemetry
 
-__all__ = ["run_mcmc", "EnsembleSampler", "integrated_autocorr_time"]
+__all__ = ["run_mcmc", "EnsembleSampler", "integrated_autocorr_time",
+           "AutocorrCache"]
 
 
 def integrated_autocorr_time(chain, c=5.0):
@@ -52,6 +53,155 @@ def integrated_autocorr_time(chain, c=5.0):
         m = np.argmax(window) if window.any() else len(cumsum) - 1
         taus[d] = max(cumsum[m], 1e-12)
     return taus
+
+
+class AutocorrCache:
+    """Incremental windowed autocorrelation over a chunk-growing chain
+    — the quadratic-in-chunk-count fix for
+    :meth:`EnsembleSampler.run_mcmc_autocorr`.
+
+    The from-scratch estimator (:func:`integrated_autocorr_time`)
+    FFTs the FULL chain every chunk: over K chunks that is
+    sum_k O(k n log kn) ~ K^2 work.  Sokal's window only ever reads
+    lags up to M ~ c * tau, so this cache keeps the raw lag-product
+    prefix sums ``S(l) = sum_t x_t x_{t+l}`` for ``l < L`` (per
+    walker per dim) and updates them per chunk with ONE small FFT
+    cross-correlation of (tail-buffer + chunk) against the chunk —
+    O((L + n) log) per chunk, independent of the total chain length.
+    The walker means (which change every chunk) are folded in
+    algebraically from cached prefix/suffix/total sums, so the
+    windowed acf is EXACTLY the estimator's, not an approximation.
+
+    If the window search needs lags past ``L`` (an unconverged early
+    chain), the cache doubles ``L`` and rebuilds from the full chain
+    (``sampler.autocorr_rebuilds`` counter) — geometric growth, so
+    rebuilds happen O(log) times; every other chunk is incremental
+    (``sampler.autocorr_updates``)."""
+
+    def __init__(self, lag0=64):
+        self.lag0 = max(4, int(lag0))
+        self.n_steps = 0
+        self._S = None        # (nw, ndim, L) raw lag-product sums
+        self._total = None    # (nw, ndim) running sums
+        self._head = None     # first <= L-1 samples (t, nw, ndim)
+        self._tail = None     # last <= L-1 samples
+        self.updates = 0
+        self.rebuilds = 0
+
+    @property
+    def max_lag(self):
+        return 0 if self._S is None else self._S.shape[2]
+
+    def _delta_S(self, chunk):
+        """Raw lag-product contributions of appending ``chunk``:
+        ``dS(l) = sum_{pairs spanning the boundary or inside the
+        chunk} x_t x_{t+l}`` for every cached lag, via one padded-FFT
+        cross-correlation of (tail ++ chunk) against the chunk."""
+        L = self.max_lag
+        n = chunk.shape[0]
+        tail = self._tail if self._tail is not None else chunk[:0]
+        m0 = tail.shape[0]
+        z = np.concatenate([tail, chunk], axis=0)
+        # linear (not circular) correlation for every shift in
+        # [-(L-1), m0]: the padded length must clear both the product
+        # support and the negative-shift index range 2L
+        nfft = 1
+        while nfft < max(z.shape[0] + n, 2 * L):
+            nfft *= 2
+        zf = np.fft.rfft(z, n=nfft, axis=0)
+        cf = np.fft.rfft(chunk, n=nfft, axis=0)
+        w = np.fft.irfft(zf * np.conjugate(cf), n=nfft, axis=0)
+        # dS(l) = sum_j z[m0 - l + j] * chunk[j]  ==  w[(m0 - l) % nfft]
+        idx = (m0 - np.arange(L)) % nfft
+        return np.transpose(w[idx], (1, 2, 0))  # (nw, ndim, L)
+
+    def update(self, chunk):
+        """Fold one appended chunk (n, nwalkers, ndim) into the cache."""
+        chunk = np.asarray(chunk, np.float64)
+        if self._S is None:
+            n, nw, nd = chunk.shape
+            L = self.lag0
+            self._S = np.zeros((nw, nd, L))
+            self._total = np.zeros((nw, nd))
+            self._head = chunk[:0]
+            self._tail = chunk[:0]
+        self._S += self._delta_S(chunk)
+        self._total += chunk.sum(axis=0)
+        self.n_steps += chunk.shape[0]
+        keep = self.max_lag - 1
+        if self._head.shape[0] < keep:
+            self._head = np.concatenate(
+                [self._head, chunk], axis=0)[:keep]
+        self._tail = np.concatenate(
+            [self._tail, chunk], axis=0)[-keep:] if keep else chunk[:0]
+        self.updates += 1
+        telemetry.counter_add("sampler.autocorr_updates")
+
+    def _rebuild(self, full, L):
+        """From-scratch rebuild at a larger lag window (geometric
+        growth — the O(log)-times fallback)."""
+        full = np.asarray(full, np.float64)
+        T, nw, nd = full.shape
+        L = int(min(L, T))
+        n2 = 1 << (2 * T - 1).bit_length()
+        f = np.fft.rfft(full, n=n2, axis=0)
+        acf_raw = np.fft.irfft(f * np.conjugate(f), n=n2, axis=0)[:L]
+        self._S = np.transpose(acf_raw, (1, 2, 0))
+        self._total = full.sum(axis=0)
+        self.n_steps = T
+        self._head = full[:L - 1]
+        self._tail = full[-(L - 1):] if L > 1 else full[:0]
+        self.rebuilds += 1
+        telemetry.counter_add("sampler.autocorr_rebuilds")
+
+    def _windowed_tau(self, c):
+        """Per-dim tau from the cached window, or None where the
+        window search ran off the cached lag range."""
+        T = self.n_steps
+        Le = min(self.max_lag, T)
+        m = self._total / T  # (nw, ndim)
+        # prefix(l) = sum of first l samples, suffix(l) = last l
+        lags = np.arange(Le)
+        pre = np.zeros((Le,) + m.shape)
+        pre[1:] = np.cumsum(self._head[:Le - 1], axis=0)
+        suf = np.zeros((Le,) + m.shape)
+        if Le > 1:
+            suf[1:] = np.cumsum(self._tail[::-1][:Le - 1], axis=0)
+        g_head = self._total[None] - suf       # (Le, nw, ndim)
+        g_tail = self._total[None] - pre
+        acf_w = (np.transpose(self._S[:, :, :Le], (2, 0, 1))
+                 - m[None] * (g_head + g_tail)
+                 + (T - lags)[:, None, None] * m[None] ** 2)
+        acf = acf_w.mean(axis=1)               # (Le, ndim)
+        ndim = acf.shape[1]
+        taus = np.empty(ndim)
+        for d in range(ndim):
+            if acf[0, d] <= 0:
+                taus[d] = np.inf
+                continue
+            rho = acf[:, d] / acf[0, d]
+            cumsum = 2.0 * np.cumsum(rho) - 1.0
+            window = np.arange(Le) >= c * cumsum
+            if window.any():
+                taus[d] = max(cumsum[np.argmax(window)], 1e-12)
+            elif Le >= T:
+                # the estimator's "no window found" semantics: use
+                # the full-length cumsum (we cover every lag)
+                taus[d] = max(cumsum[-1], 1e-12)
+            else:
+                return None  # window ran past the cache — grow
+        return taus
+
+    def tau(self, full_chain, c=5.0):
+        """Integrated autocorrelation times, growing the lag window
+        from ``full_chain`` only when the search needs it.  Matches
+        :func:`integrated_autocorr_time` (same estimator, same
+        window) to FFT-reordering roundoff."""
+        while True:
+            got = self._windowed_tau(c)
+            if got is not None:
+                return got
+            self._rebuild(full_chain, max(2 * self.max_lag, 4))
 
 
 def _stretch_half(key, active, other, lnp_active, lnpost_v, a):
@@ -105,23 +255,10 @@ def run_mcmc(lnpost, x0, nsteps, key=None, a=2.0, thin=1, jit_key=None,
         raise ValueError("nwalkers must be even (red-black split)")
     if key is None:
         key = jax.random.PRNGKey(0)
-    constrain = None
-    if mesh is not None:
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        ndev = _mesh.axis_size(mesh, "walker")
-        if nw % (2 * ndev):
-            raise ValueError(
-                f"run_mcmc: nwalkers={nw} must be a multiple of 2x "
-                f"the walker-axis device count ({ndev}); the ensemble "
-                "cannot be padded — stretch moves couple walkers, so "
-                "a phantom walker would change real proposals")
-        walker_sharding = NamedSharding(
-            mesh, P(_mesh.resolve_axis(mesh, "walker")))
-
-        def constrain(arr):
-            return jax.lax.with_sharding_constraint(arr,
-                                                    walker_sharding)
+    # the shared chain-axis rule (group=2: each red-black half must
+    # shard) — raises, never pads, and is None for mesh=None
+    constrain = _mesh.chain_constrainer(
+        mesh, nw, group=2, requested_by="run_mcmc: nwalkers")
 
     lnpost_v = jax.vmap(lnpost)
     half = nw // 2
@@ -275,6 +412,10 @@ class EnsembleSampler:
         x = x0
         total = 0
         fp = None
+        # incremental windowed autocorrelation: each chunk folds into
+        # the cached lag-product prefix instead of re-FFTing the full
+        # chain (AutocorrCache — the quadratic-chunk-count fix)
+        acache = AutocorrCache(lag0=max(64, int(chunk)))
         if checkpoint is not None:
             fp = self._checkpoint_fingerprint(x0)
             loaded = _guard.load_checkpoint(checkpoint, fingerprint=fp)
@@ -286,6 +427,7 @@ class EnsembleSampler:
                 total = int(arrays["total"][()])
                 x = jnp.asarray(arrays["chain"][-1])
                 self.key = jnp.asarray(arrays["key"])
+                acache.update(arrays["chain"])
         # the outer ledger scope: every chunk's run_mcmc joins ONE
         # run id instead of minting one per chunk
         run = telemetry.run_scope("mcmc", chunked=True,
@@ -302,6 +444,7 @@ class EnsembleSampler:
                 accs.append((float(np.mean(np.asarray(acc))), step))
                 x = chain[-1]
                 total += step
+                acache.update(chains[-1])
                 full = np.concatenate(chains, axis=0)
                 if checkpoint is not None:
                     _guard.save_checkpoint(
@@ -314,7 +457,7 @@ class EnsembleSampler:
                         fingerprint=fp,
                         meta={"maxsteps": int(maxsteps)})
                     _faults.maybe_kill("sampler.chunk")
-                tau = integrated_autocorr_time(full)
+                tau = acache.tau(full)
                 if (np.all(np.isfinite(tau))
                         and total > tau_factor * np.max(tau)
                         and tau_prev is not None

@@ -343,6 +343,26 @@ def convergence_table(records, run_id=None):
 _LOWER_IS_BETTER = {"guard_overhead", "profile_overhead",
                     "cold_start_s"}
 
+#: the suite's known rate-metric series (higher is better — the
+#: sentinel's default direction).  Purely a registration list: the
+#: comparison machinery discovers series from the recorded rounds,
+#: but a metric listed here is DECLARED to be a rate, so adding a
+#: lower-is-better metric under one of these names (or forgetting to
+#: extend _LOWER_IS_BETTER for a new overhead metric) is a reviewable
+#: diff, not a silently inverted alarm.  The weak-scaling rows
+#: (``*_sharded_w{n}``) are per-device-count variants of their base
+#: series and follow the base direction.
+RATE_METRICS = frozenset({
+    "gls_toas_per_sec", "wls_chisq_grid_points_per_sec",
+    "mcmc_evals_per_sec", "pta_batch_fits_per_sec", "os_pairs_per_s",
+    "grid_pts_per_sec_sharded", "pta_batch_fits_per_sec_sharded",
+    "roofline_f64_matmul_flops",
+    # the kron-structured GWB likelihood and the vmapped NUTS sampler
+    # (gw/hmc): a kron-path regression trips the sentinel exactly
+    # like any other rate series
+    "gwb_lnlike_per_sec", "nuts_draws_per_sec",
+})
+
 #: absolute slack (same units as the metric — percentage points for
 #: the overhead metrics, seconds for cold_start_s) under the
 #: lower-is-better comparison: a multiplicative tolerance is

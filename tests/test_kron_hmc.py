@@ -1,0 +1,636 @@
+"""Kron-structured GWB likelihood + gradient-based NUTS (ISSUE 12).
+
+Oracles: brute-force dense linear algebra (the dense (K, K) prior
+path AND an extended-precision longdouble Cholesky of the literal
+covariance), central finite differences for every gradient class,
+the telemetry compile counter for the zero-recompile contract, the
+PR-3 grid peak for posterior consistency, and a deterministic kill
+fault for checkpoint/resume.
+
+Tolerance note for the ORF zoo (measured, documented in PERF.md):
+the dense reference factors the jittered prior explicitly, so on a
+RANK-DEFICIENT ORF (monopole rank 1, dipole rank 3) its own forward
+error is ~kappa*eps ~ 1e-6 at the 1e-12 jitter scale.  The kron
+path's product-form capacity never inverts the prior and stays at
+~1e-13 against the longdouble reference for the whole zoo — so
+full-rank ORFs assert kron==dense at 1e-10 and the singular ones
+assert kron==longdouble at 1e-10 (the stronger statement) plus
+kron==dense at the dense path's own noise scale.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pint_tpu import compile_cache, guard, linalg, telemetry
+from pint_tpu.gw import CommonProcess, GWBPosterior, run_nuts
+from pint_tpu.models.builder import get_model
+from pint_tpu.simulation import (add_gwb, make_fake_pta,
+                                 make_fake_toas_uniform,
+                                 pta_injection_seed)
+
+GWB_GAMMA = 13.0 / 3.0
+RED = "TNRedAmp -13.5\nTNRedGam 4.0\nTNRedC 4\n"
+
+
+def _flagged_array(n_psr, ntoa, seed, extra_par=""):
+    """A small array whose TOAs carry ``-f fake`` flags, so
+    flag-selected white-noise params (EFAC) actually bite."""
+    pairs = []
+    for i in range(n_psr):
+        ra_h = (i * 24.0 / n_psr) % 24
+        dec = int(((i * 37) % 120) - 60)
+        par = (f"PSR FK{i:02d}\nRAJ {int(ra_h):02d}:"
+               f"{int((ra_h % 1) * 60):02d}:00\nDECJ {dec:+03d}:00:00\n"
+               f"F0 {100.0 + 10 * i!r} 1\nF1 -1e-15 1\nPEPOCH 54500\n"
+               "DM 10\nTZRMJD 54500\nTZRSITE @\nTZRFRQ 1400\n"
+               "UNITS TDB\nEPHEM builtin\n" + extra_par)
+        m = get_model(par)
+        t = make_fake_toas_uniform(
+            53000, 56000, ntoa, m, obs="@", error_us=1.0,
+            add_noise=True, rng=np.random.default_rng(seed * 1000 + i),
+            flags={"f": "fake"})
+        pairs.append((m, t))
+    return pairs
+
+
+@pytest.fixture(scope="module")
+def small_array():
+    pairs = make_fake_pta(4, 40, seed=5, extra_par=RED)
+    add_gwb([t for _, t in pairs], [m for m, _ in pairs], 3e-14,
+            rng=pta_injection_seed(5, 4), nmodes=4)
+    return pairs
+
+
+@pytest.fixture(scope="module")
+def efac_array():
+    pairs = _flagged_array(4, 40, 5,
+                           extra_par=RED + "EFAC -f fake 1.1 1\n")
+    add_gwb([t for _, t in pairs], [m for m, _ in pairs], 3e-14,
+            rng=pta_injection_seed(5, 4), nmodes=4)
+    return pairs
+
+
+# --------------------------------------------------------------------------
+# longdouble reference (80-bit on x86 — resolves the f64 paths' errors)
+# --------------------------------------------------------------------------
+
+def _longdouble_chi2_logdet(r, sigma, U, phi_dense):
+    """chi2/logdet of the literal jittered covariance in extended
+    precision — the independent oracle both f64 paths are measured
+    against.  Applies the SAME per-diagonal relative jitter
+    _phi_terms does, so it evaluates the identical model."""
+    rel, floor = 1e-12, 1e-30
+    d = np.abs(np.diag(phi_dense)) + floor
+    phi_j = (np.asarray(phi_dense) + rel * np.diag(d)).astype(
+        np.longdouble)
+    Ue = np.asarray(U).astype(np.longdouble)
+    C = np.diag((np.asarray(sigma) ** 2).astype(np.longdouble)) \
+        + Ue @ phi_j @ Ue.T
+    n = C.shape[0]
+    L = np.zeros_like(C)
+    for i in range(n):
+        L[i, i] = np.sqrt(C[i, i] - np.sum(L[i, :i] ** 2))
+        L[i + 1:, i] = (C[i + 1:, i] - L[i + 1:, :i] @ L[i, :i]) \
+            / L[i, i]
+    y = np.zeros(n, np.longdouble)
+    b = np.asarray(r).astype(np.longdouble)
+    for i in range(n):
+        y[i] = (b[i] - L[i, :i] @ y[:i]) / L[i, i]
+    return float(np.sum(y ** 2)), float(2 * np.sum(np.log(np.diag(L))))
+
+
+def _stacked_dense(P, N, nb, m2, U, F):
+    Ufull = np.zeros((P * N, P * nb + P * m2))
+    for a in range(P):
+        Ufull[a * N:(a + 1) * N, a * nb:(a + 1) * nb] = U[a]
+        Ufull[a * N:(a + 1) * N,
+              P * nb + a * m2: P * nb + (a + 1) * m2] = F[a]
+    return Ufull
+
+
+class TestKronSolver:
+    """linalg.KronPhi against brute force, the dense path, and the
+    longdouble oracle."""
+
+    def _random_system(self, seed=0, P=4, N=30, nb=5, m2=6):
+        rng = np.random.default_rng(seed)
+        r = rng.standard_normal((P, N))
+        sigma = 0.5 + rng.random((P, N))
+        U = rng.standard_normal((P, N, nb))
+        F = rng.standard_normal((P, N, m2))
+        phi_n = rng.random((P, nb)) * 2.0
+        phi_gw = rng.random(m2) * 0.7
+        v = rng.standard_normal((P, 3))
+        v /= np.linalg.norm(v, axis=1)[:, None]
+        orfs = {
+            "full_rank": v @ v.T * 0.3 + np.eye(P),
+            "monopole": np.ones((P, P)),           # rank 1
+            "dipole": (v @ v.T - np.diag(np.diag(v @ v.T))
+                       + np.eye(P)),               # rank 3 of 4
+        }
+        return r, sigma, U, F, phi_n, phi_gw, orfs
+
+    def test_kron_vs_dense_and_longdouble_orf_zoo(self):
+        r, sigma, U, F, phi_n, phi_gw, orfs = self._random_system()
+        P, N = r.shape
+        nb, m2 = U.shape[2], F.shape[2]
+        r_s, sig_s = r.reshape(-1), sigma.reshape(-1)
+        Ufull = _stacked_dense(P, N, nb, m2, U, F)
+        for name, orf in orfs.items():
+            kp = linalg.KronPhi(orf=jnp.asarray(orf),
+                                phi_gw=jnp.asarray(phi_gw),
+                                phi_noise=jnp.asarray(phi_n))
+            c_k, ld_k = linalg.kron_chi2_logdet(
+                jnp.asarray(r), jnp.asarray(sigma), jnp.asarray(U),
+                jnp.asarray(F), kp)
+            phi_dense = np.asarray(linalg.kron_phi_dense(kp))
+            c_d, ld_d = linalg.woodbury_chi2_logdet(
+                jnp.asarray(r_s), jnp.asarray(sig_s),
+                jnp.asarray(Ufull), jnp.asarray(phi_dense))
+            c_ref, ld_ref = _longdouble_chi2_logdet(
+                r_s, sig_s, Ufull, phi_dense)
+            # the kron path holds 1e-10 against the extended-precision
+            # oracle for the WHOLE zoo, singular ORFs included
+            assert abs(float(c_k) - c_ref) / abs(c_ref) < 1e-10, name
+            assert abs(float(ld_k) - ld_ref) / abs(ld_ref) < 1e-10, \
+                name
+            # dense-path agreement: exact-arithmetic-identical models,
+            # so full rank agrees to 1e-10; the singular cases are
+            # bounded by the dense factorization's own kappa*eps loss
+            tol = 1e-10 if name == "full_rank" else 2e-5
+            assert abs(float(c_k) - float(c_d)) / abs(c_ref) < tol, \
+                name
+            assert abs(float(ld_k) - float(ld_d)) / abs(ld_ref) < tol, \
+                name
+
+    def test_pad_rows_and_columns_exact(self):
+        r, sigma, U, F, phi_n, phi_gw, orfs = self._random_system(1)
+        kp = linalg.KronPhi(orf=jnp.asarray(orfs["full_rank"]),
+                            phi_gw=jnp.asarray(phi_gw),
+                            phi_noise=jnp.asarray(phi_n))
+        base = linalg.kron_chi2_logdet(
+            jnp.asarray(r), jnp.asarray(sigma), jnp.asarray(U),
+            jnp.asarray(F), kp)
+        # zero-weight pad COLUMN == absent column (the _PHI_FLOOR pin)
+        rng = np.random.default_rng(9)
+        P, N, nb = U.shape
+        U_c = np.concatenate([U, rng.standard_normal((P, N, 1))],
+                             axis=2)
+        kp_c = kp._replace(phi_noise=jnp.asarray(
+            np.concatenate([phi_n, np.zeros((P, 1))], axis=1)))
+        got = linalg.kron_chi2_logdet(
+            jnp.asarray(r), jnp.asarray(sigma), jnp.asarray(U_c),
+            jnp.asarray(F), kp_c)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(base),
+                                   rtol=1e-12)
+        # zero pad ROWS with the valid mask == no rows at all
+        pad = 7
+        m2 = F.shape[2]
+        args = (np.concatenate([r, np.zeros((P, pad))], axis=1),
+                np.concatenate([sigma, np.full((P, pad), 1e16)],
+                               axis=1),
+                np.concatenate([U, np.zeros((P, pad, nb))], axis=1),
+                np.concatenate([F, np.zeros((P, pad, m2))], axis=1))
+        valid = np.concatenate([np.ones((P, N), bool),
+                                np.zeros((P, pad), bool)], axis=1)
+        got = linalg.kron_chi2_logdet(
+            *(jnp.asarray(a) for a in args), kp,
+            valid=jnp.asarray(valid))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(base),
+                                   rtol=1e-12)
+
+    def test_gram_precompute_equals_full(self):
+        r, sigma, U, F, phi_n, phi_gw, orfs = self._random_system(2)
+        kp = linalg.KronPhi(orf=jnp.asarray(orfs["full_rank"]),
+                            phi_gw=jnp.asarray(phi_gw),
+                            phi_noise=jnp.asarray(phi_n))
+        full = linalg.kron_chi2_logdet(
+            jnp.asarray(r), jnp.asarray(sigma), jnp.asarray(U),
+            jnp.asarray(F), kp)
+        pre = linalg.kron_gram_precompute(
+            jnp.asarray(r), jnp.asarray(sigma), jnp.asarray(U),
+            jnp.asarray(F))
+        got = linalg.kron_chi2_logdet_pre(pre, kp)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(full),
+                                   rtol=1e-14)
+
+    def test_grad_kron_equals_dense(self):
+        """d lnl / d (phi_gw, phi_noise, orf-scale) agree across the
+        two solvers at 1e-10 (full-rank ORF)."""
+        r, sigma, U, F, phi_n, phi_gw, orfs = self._random_system(3)
+        P, N = r.shape
+        nb, m2 = U.shape[2], F.shape[2]
+        Ufull = jnp.asarray(_stacked_dense(P, N, nb, m2, U, F))
+        r_s = jnp.asarray(r.reshape(-1))
+        sig_s = jnp.asarray(sigma.reshape(-1))
+        orf = jnp.asarray(orfs["full_rank"])
+
+        def f_kron(pg, pn):
+            kp = linalg.KronPhi(orf=orf, phi_gw=pg, phi_noise=pn)
+            c, ld = linalg.kron_chi2_logdet(
+                jnp.asarray(r), jnp.asarray(sigma), jnp.asarray(U),
+                jnp.asarray(F), kp)
+            return -0.5 * (c + ld)
+
+        def f_dense(pg, pn):
+            kp = linalg.KronPhi(orf=orf, phi_gw=pg, phi_noise=pn)
+            c, ld = linalg.woodbury_chi2_logdet(
+                r_s, sig_s, Ufull, linalg.kron_phi_dense(kp))
+            return -0.5 * (c + ld)
+
+        args = (jnp.asarray(phi_gw), jnp.asarray(phi_n))
+        gk = jax.grad(f_kron, argnums=(0, 1))(*args)
+        gd = jax.grad(f_dense, argnums=(0, 1))(*args)
+        for a, b in zip(gk, gd):
+            scale = jnp.max(jnp.abs(b))
+            assert float(jnp.max(jnp.abs(a - b))) / float(scale) \
+                < 1e-10
+
+
+class TestKronLnlike:
+    """CommonProcess-level kron/dense equivalence + the on-device
+    grid bad-point count."""
+
+    def test_lnlike_kron_equals_dense(self, small_array):
+        crn_k = CommonProcess(small_array, nmodes=4, kron=True)
+        crn_d = CommonProcess(small_array, nmodes=4, kron=False)
+        for la, g in [(-14.0, GWB_GAMMA), (-13.2, 3.0), (-15.5, 5.5)]:
+            a, b = crn_k.lnlike(la, g), crn_d.lnlike(la, g)
+            assert abs(a - b) / abs(b) < 1e-10, (la, g)
+
+    def test_lnlike_grid_kron_equals_dense(self, small_array):
+        amps = np.linspace(-15.0, -13.0, 4)
+        gams = [3.0, GWB_GAMMA]
+        sk = CommonProcess(small_array, nmodes=4,
+                           kron=True).lnlike_grid(amps, gams)
+        sd = CommonProcess(small_array, nmodes=4,
+                           kron=False).lnlike_grid(amps, gams)
+        np.testing.assert_allclose(sk, sd, rtol=1e-10)
+
+    @pytest.mark.parametrize("orf", ["monopole", "dipole"])
+    def test_singular_orf_lnlike(self, small_array, orf):
+        """Rank-deficient ORFs: kron is finite and agrees with dense
+        at the dense factorization's own noise scale (the kron path
+        itself is 1e-10-accurate — TestKronSolver's longdouble
+        oracle)."""
+        a = CommonProcess(small_array, nmodes=4, orf=orf,
+                          kron=True).lnlike(-14.0, GWB_GAMMA)
+        b = CommonProcess(small_array, nmodes=4, orf=orf,
+                          kron=False).lnlike(-14.0, GWB_GAMMA)
+        assert np.isfinite(a) and np.isfinite(b)
+        assert abs(a - b) / abs(b) < 2e-5
+
+    def test_grid_bad_count_on_device(self, small_array):
+        """The non-finite grid-point counter rides the program output:
+        value regression-tested against the host recount and the
+        guard counter, kron and dense."""
+        amps = np.linspace(-15.0, -13.0, 3)
+        gams = [GWB_GAMMA, np.nan]  # one whole NaN column
+        for kron in (True, False):
+            crn = CommonProcess(small_array, nmodes=4, kron=kron)
+            before = telemetry.counter_get(
+                "guard.trip.gw_lnlike_grid")
+            with pytest.warns(UserWarning, match="non-finite"):
+                surf = crn.lnlike_grid(amps, gams)
+            n_bad_host = int(np.count_nonzero(~np.isfinite(surf)))
+            assert n_bad_host == len(amps)
+            delta = telemetry.counter_get(
+                "guard.trip.gw_lnlike_grid") - before
+            assert delta == n_bad_host, kron
+
+    def test_zero_recompile_second_array_kron(self, small_array):
+        crn1 = CommonProcess(small_array, nmodes=4, kron=True)
+        crn1.lnlike(-14.0, GWB_GAMMA)
+        telemetry.compile_stats()
+        before = telemetry.counter_get("jit.compile_events")
+        hits_before = compile_cache.registry_stats()["hits"]
+        pairs2 = make_fake_pta(4, 40, seed=11, extra_par=RED)
+        crn2 = CommonProcess(pairs2, nmodes=4, kron=True)
+        assert np.isfinite(crn2.lnlike(-14.0, GWB_GAMMA))
+        assert compile_cache.registry_stats()["hits"] > hits_before
+        if telemetry.compile_stats()["source"] == "jax.monitoring":
+            assert telemetry.counter_get(
+                "jit.compile_events") - before == 0
+
+
+class TestGradients:
+    """jax.grad of the posterior vs central finite differences over
+    every parameter class, kron AND dense paths (the ISSUE's
+    gradient-correctness satellite)."""
+
+    @pytest.fixture(scope="class")
+    def posteriors(self, efac_array):
+        crn_k = CommonProcess(efac_array, nmodes=4, kron=True)
+        crn_d = CommonProcess(efac_array, nmodes=4, kron=False)
+        sample = ("TNREDAMP", "TNREDGAM", "EFAC1")
+        return (GWBPosterior(crn_k, sample=sample),
+                GWBPosterior(crn_d, sample=sample))
+
+    def test_efac_classified_sigma_dynamic(self, posteriors):
+        pk, _ = posteriors
+        assert pk.sigma_dynamic
+        assert any(n.endswith("EFAC1") for n in pk.param_names)
+
+    def test_lnprob_and_grad_kron_equals_dense(self, posteriors):
+        pk, pd = posteriors
+        th = jnp.asarray(pk.center())
+        lk = float(pk.lnprob(th, pk.data()))
+        ld = float(pd.lnprob(th, pd.data()))
+        assert abs(lk - ld) / abs(ld) < 1e-10
+        gk = np.asarray(jax.grad(
+            lambda q: pk.lnprob(q, pk.data()))(th))
+        gd = np.asarray(jax.grad(
+            lambda q: pd.lnprob(q, pd.data()))(th))
+        scale = np.max(np.abs(gd))
+        assert np.max(np.abs(gk - gd)) / scale < 1e-10
+
+    @pytest.mark.parametrize("which", ["gwb_log10_A", "gwb_gamma",
+                                       "FK00:TNREDAMP",
+                                       "FK00:EFAC1"])
+    def test_grad_vs_central_differences(self, posteriors, which):
+        """(amp, gamma, red-noise amp, EFAC) on the 4-pulsar array:
+        analytic gradient within 1e-6 relative of central finite
+        differences (h = 1e-5; measured agreement ~1e-8)."""
+        for post in posteriors:
+            i = post.param_names.index(which)
+            data = post.data()
+            th = np.asarray(post.center())
+            g = float(jax.grad(
+                lambda q: post.lnprob(q, data))(jnp.asarray(th))[i])
+            h = 1e-5
+            xp, xm = th.copy(), th.copy()
+            xp[i] += h
+            xm[i] -= h
+            fd = (float(post.lnprob(jnp.asarray(xp), data))
+                  - float(post.lnprob(jnp.asarray(xm), data))) \
+                / (2 * h)
+            assert abs(fd - g) / max(abs(g), 1e-8) < 1e-6, \
+                (which, post.kron, fd, g)
+
+    def test_out_of_bounds_is_minus_inf(self, posteriors):
+        pk, _ = posteriors
+        th = np.asarray(pk.center())
+        th[0] = -30.0  # far below the amplitude prior
+        assert float(pk.lnprob(jnp.asarray(th), pk.data())) == -np.inf
+
+
+class TestRunNuts:
+    @pytest.fixture(scope="class")
+    def posterior(self, small_array):
+        return GWBPosterior(CommonProcess(small_array, nmodes=4))
+
+    def test_posterior_peak_consistent_with_grid(self, small_array,
+                                                 posterior):
+        """The acceptance consistency check in miniature: the sampled
+        posterior's amplitude peak lands on the PR-3 grid peak."""
+        crn = posterior.crn
+        amps = np.linspace(-15.5, -12.5, 13)
+        lnl = crn.lnlike_grid(amps, [GWB_GAMMA])[:, 0]
+        grid_peak = amps[int(np.argmax(lnl))]
+        res = run_nuts(posterior, num_warmup=80, num_samples=120,
+                       n_chains=2, chunk=50, num_leapfrog=6, seed=3)
+        flat = res.flat()
+        assert res.samples.shape == (120, 2, posterior.ndim)
+        assert 0.05 < res.accept_rate <= 1.0
+        # peak of the sampled amplitude marginal vs the grid peak
+        # (short chain: generous window, but it must not wander off)
+        samp_peak = np.median(flat[:, 0])
+        assert abs(samp_peak - grid_peak) < 1.0, (samp_peak,
+                                                  grid_peak)
+        # and the best sampled point beats every grid point (the
+        # posterior also optimizes the per-pulsar noise)
+        assert res.max_posterior()[1] >= lnl.max() - 1.0
+
+    def test_zero_recompile_after_first_draw(self, posterior):
+        """Acceptance: ZERO new XLA compiles after the first draw
+        across all chains — later chunks AND a second same-shaped run
+        resolve from the registry."""
+        kw = dict(num_warmup=8, num_samples=8, n_chains=2, chunk=4,
+                  num_leapfrog=4)
+        run_nuts(posterior, seed=0, **kw)
+        telemetry.compile_stats()
+        before = telemetry.counter_get("jit.compile_events")
+        run_nuts(posterior, seed=9, **kw)  # 4 chunks, same shapes
+        if telemetry.compile_stats()["source"] == "jax.monitoring":
+            assert telemetry.counter_get(
+                "jit.compile_events") - before == 0
+
+    def test_iter_trace_records(self, posterior, tmp_path,
+                                monkeypatch):
+        """$PINT_TPU_ITER_TRACE=1 emits per-draw hmc records into the
+        ledger (and does NOT change the traced program)."""
+        trace = tmp_path / "t.jsonl"
+        monkeypatch.setenv("PINT_TPU_ITER_TRACE", "1")
+        telemetry.configure(sink=str(trace))
+        try:
+            run_nuts(posterior, num_warmup=4, num_samples=4,
+                     n_chains=2, chunk=4, num_leapfrog=3, seed=1)
+            telemetry.flush()
+        finally:
+            telemetry.configure(sink=None)
+        import json
+
+        recs = [json.loads(ln) for ln in
+                trace.read_text().splitlines()]
+        its = [r for r in recs if r.get("type") == "iter_trace"
+               and r.get("program") == "gw.hmc"]
+        assert len(its) == 8
+        assert all(np.isfinite(r["lnp"]) for r in its)
+        assert {"accept", "eps", "n_divergent", "ok"} <= set(its[0])
+
+    def test_mesh_sharded_matches_unsharded(self, posterior):
+        """Chains held on the walker mesh axis (the conftest 8-device
+        host platform) sample the identical chain as the unsharded
+        program."""
+        from pint_tpu.parallel import mesh as M
+
+        mesh = M.make_mesh("walker")
+        nc = int(mesh.devices.size)
+        kw = dict(num_warmup=4, num_samples=6, n_chains=nc, chunk=5,
+                  num_leapfrog=3)
+        a = run_nuts(posterior, seed=4, **kw)
+        b = run_nuts(posterior, seed=4, mesh=mesh, **kw)
+        np.testing.assert_allclose(np.asarray(a.samples),
+                                   np.asarray(b.samples), rtol=1e-9)
+
+    def test_chain_divisibility_raises(self, posterior):
+        from pint_tpu.parallel import mesh as M
+
+        mesh = M.make_mesh("walker")
+        ndev = int(mesh.devices.size)
+        with pytest.raises(ValueError, match="walker-axis"):
+            run_nuts(posterior, num_warmup=2, num_samples=2,
+                     n_chains=ndev + 1, mesh=mesh)
+
+    def test_checkpoint_resume_completes(self, posterior, tmp_path):
+        """In-process resume: a checkpoint from a partial run (cut by
+        limiting chunks via a fresh run) continues to the identical
+        final chain — the carry (rng keys included) round-trips."""
+        ck = tmp_path / "hmc.npz"
+        kw = dict(num_warmup=6, num_samples=10, n_chains=2, chunk=4,
+                  num_leapfrog=3, seed=7)
+        full = run_nuts(posterior, **kw)
+        # write a checkpoint by running WITH checkpoint, then delete
+        # the last chunks' worth and resume
+        run_nuts(posterior, checkpoint=str(ck), **kw)
+        arrays, _ = guard.load_checkpoint(ck)
+        assert int(arrays["done_chunks"][()]) == 4
+        resumed = run_nuts(posterior, checkpoint=str(ck), **kw)
+        np.testing.assert_allclose(np.asarray(resumed.samples),
+                                   np.asarray(full.samples))
+
+
+_KILL_SCRIPT = """
+import sys
+import numpy as np
+from pint_tpu.simulation import make_fake_pta
+from pint_tpu.gw import CommonProcess, GWBPosterior, run_nuts
+
+pairs = make_fake_pta(3, 30, seed=4,
+                      extra_par="TNRedAmp -13.5\\nTNRedGam 4.0\\nTNRedC 3\\n")
+post = GWBPosterior(CommonProcess(pairs, nmodes=3))
+res = run_nuts(post, num_warmup=6, num_samples=10, n_chains=2,
+               chunk=4, num_leapfrog=3, seed=0,
+               checkpoint=sys.argv[1])
+print("SAMPLES", res.samples.shape[0])
+"""
+
+
+@pytest.mark.chaos
+class TestKillAndResume:
+    def test_hmc_kill_then_resume(self, tmp_path):
+        """Acceptance: kill-and-resume loses <= 1 checkpoint chunk.
+        A deterministic kill after 2 checkpointed chunks; the resumed
+        process completes the full draw count."""
+        script = tmp_path / "driver.py"
+        script.write_text(_KILL_SCRIPT)
+        ck = tmp_path / "hmc.npz"
+        import pint_tpu
+
+        repo_root = os.path.dirname(os.path.dirname(
+            pint_tpu.__file__))
+        pypath = repo_root + os.pathsep + os.environ.get(
+            "PYTHONPATH", "")
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=pypath,
+                   PINT_TPU_FAULTS="kill:after=2:site=hmc.chunk")
+        r1 = subprocess.run([sys.executable, str(script), str(ck)],
+                            env=env, capture_output=True, text=True,
+                            timeout=300)
+        assert r1.returncode == 137, (r1.stdout, r1.stderr)
+        arrays, _ = guard.load_checkpoint(ck)
+        # 2 of 4 chunks survived — exactly <= 1 chunk behind the kill
+        assert int(arrays["done_chunks"][()]) == 2
+        env2 = dict(os.environ, JAX_PLATFORMS="cpu",
+                    PYTHONPATH=pypath)
+        env2.pop("PINT_TPU_FAULTS", None)
+        r2 = subprocess.run([sys.executable, str(script), str(ck)],
+                            env=env2, capture_output=True, text=True,
+                            timeout=300)
+        assert r2.returncode == 0, (r2.stdout, r2.stderr)
+        assert "SAMPLES 10" in r2.stdout
+        arrays, _ = guard.load_checkpoint(ck)
+        assert int(arrays["done_chunks"][()]) == 4
+
+
+class TestAutocorrCache:
+    def test_matches_from_scratch_every_chunk(self):
+        from pint_tpu.sampler import (AutocorrCache,
+                                      integrated_autocorr_time)
+
+        rng = np.random.default_rng(0)
+        nsteps, nw, nd = 900, 8, 3
+        phi = np.array([0.0, 0.7, 0.9])
+        x = np.zeros((nsteps, nw, nd))
+        for t in range(1, nsteps):
+            x[t] = phi * x[t - 1] + rng.standard_normal((nw, nd))
+        cache = AutocorrCache(lag0=64)
+        accum = []
+        for i in range(0, nsteps, 100):
+            chunk = x[i:i + 100]
+            cache.update(chunk)
+            accum.append(chunk)
+            full = np.concatenate(accum, axis=0)
+            np.testing.assert_allclose(
+                cache.tau(full), integrated_autocorr_time(full),
+                rtol=1e-8)
+        # the point of the cache: incremental updates dominate, the
+        # full-chain rebuild happened O(log)-many (here: one) time
+        assert cache.updates == 9
+        assert cache.rebuilds <= 2
+
+    def test_short_chain_no_window_semantics(self):
+        """When no Sokal window exists, the estimator falls back to
+        the full-length cumsum — the cache must reproduce that (it
+        grows to cover every lag rather than guessing)."""
+        from pint_tpu.sampler import (AutocorrCache,
+                                      integrated_autocorr_time)
+
+        rng = np.random.default_rng(1)
+        # strongly correlated short chain: window > chain length
+        x = np.cumsum(rng.standard_normal((120, 4, 2)), axis=0)
+        cache = AutocorrCache(lag0=16)
+        cache.update(x[:60])
+        cache.update(x[60:])
+        np.testing.assert_allclose(cache.tau(x),
+                                   integrated_autocorr_time(x),
+                                   rtol=1e-8)
+
+    def test_run_mcmc_autocorr_uses_cache(self):
+        from pint_tpu.sampler import EnsembleSampler
+
+        def lnpost(v):
+            return -0.5 * jnp.sum(v ** 2)
+
+        before_up = telemetry.counter_get("sampler.autocorr_updates")
+        s = EnsembleSampler(lnpost, nwalkers=8, seed=0,
+                            jit_key=("kron-hmc-autocorr",))
+        x0 = s.initial_ball(jnp.zeros(2), 0.1 * jnp.ones(2))
+        chain, converged, tau = s.run_mcmc_autocorr(
+            x0, chunk=40, maxsteps=160)
+        assert np.all(np.isfinite(tau))
+        assert telemetry.counter_get(
+            "sampler.autocorr_updates") - before_up >= 2
+
+
+class TestSentinelSeries:
+    def test_new_metrics_registered_as_rates(self):
+        from pint_tpu.scripts import pinttrace
+
+        assert "gwb_lnlike_per_sec" in pinttrace.RATE_METRICS
+        assert "nuts_draws_per_sec" in pinttrace.RATE_METRICS
+        assert not (pinttrace.RATE_METRICS
+                    & pinttrace._LOWER_IS_BETTER)
+
+    def test_kron_regression_trips_sentinel(self, tmp_path):
+        """A gwb_lnlike_per_sec / nuts_draws_per_sec collapse across
+        rounds exits nonzero — the kron path is a guarded series."""
+        import json
+
+        from pint_tpu.scripts.pinttrace import check_regression
+
+        def write(n, rows):
+            p = tmp_path / f"BENCH_r{n:02d}.json"
+            p.write_text(json.dumps({"n": n, "metrics": rows}))
+            return p
+
+        rows1 = [{"metric": "gwb_lnlike_per_sec", "value": 150.0,
+                  "backend": "cpu"},
+                 {"metric": "nuts_draws_per_sec", "value": 9.0,
+                  "backend": "cpu"}]
+        rows2 = [{"metric": "gwb_lnlike_per_sec", "value": 11.0,
+                  "backend": "cpu"},   # the dense-path floor: kron off
+                 {"metric": "nuts_draws_per_sec", "value": 9.1,
+                  "backend": "cpu"}]
+        paths = [write(1, rows1), write(2, rows2)]
+        lines, rc = check_regression(paths)
+        assert rc == 1
+        assert any("REGRESSION gwb_lnlike_per_sec" in ln
+                   for ln in lines)
+        assert any("OK nuts_draws_per_sec" in ln for ln in lines)

@@ -66,6 +66,7 @@ TRACE_GATES = {
     "PINT_TPU_HYBRID_DESIGN": ("hybrid_design_default()",),
     "PINT_TPU_FROZEN_DELAY": ("frozen_delay_default()",),
     "PINT_TPU_SEGMENT_ECORR": ("segment_ecorr_default()",),
+    "PINT_TPU_KRON_PHI": ("kron_phi_default()",),
 }
 
 #: key sites: file -> {dotted function path: {gate: token that must
@@ -131,6 +132,27 @@ KEY_SITES = {
             "PINT_TPU_SEGMENT_ECORR": "StructuredU",
         },
     },
+    "pint_tpu/gw/common.py": {
+        # the kron/dense prior selection is a different traced
+        # program (different argument layouts entirely); the gate
+        # resolves once at CommonProcess build into self._kron, which
+        # both lnlike keys carry
+        "CommonProcess._lnlike_jit": {
+            "PINT_TPU_KRON_PHI": "self._kron",
+        },
+        "CommonProcess.lnlike_grid": {
+            "PINT_TPU_KRON_PHI": "self._kron",
+        },
+    },
+    "pint_tpu/gw/hmc.py": {
+        # the HMC chunk scan resolves the scan flag itself and keys
+        # it (scan vs unroll are different programs); the kron flag
+        # rides the key via posterior.kron (resolved upstream at
+        # CommonProcess build)
+        "run_nuts": {
+            "PINT_TPU_SCAN_ITERS": "scan_flag",
+        },
+    },
 }
 
 #: modules that call a gate resolver AND build shared-jit keys but
@@ -158,6 +180,14 @@ EXEMPT = {
     ("pint_tpu/residuals.py", "PINT_TPU_GUARD"):
         "residuals accessors compute no health output; the guard "
         "gate never reaches their traces",
+    ("pint_tpu/gw/hmc.py", "PINT_TPU_ITER_TRACE"):
+        "HMC per-draw records always ride the scan ys (they ARE the "
+        "returned chain, gate on or off — one traced program); the "
+        "gate controls only host-side iter_trace telemetry emission",
+    ("pint_tpu/gw/hmc.py", "PINT_TPU_GUARD"):
+        "chain health is read from the returned draws host-side (the "
+        "sampler.py convention); the gate changes only the host-side "
+        "raise, never the traced chunk program",
 }
 
 #: known host-only PINT_TPU_* env vars: they change behavior outside
