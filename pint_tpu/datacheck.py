@@ -229,6 +229,30 @@ def datacheck_report(ephem="builtin", sites=("gbt", "ao", "jb", "pks",
         f"{rs['hits']} hit(s) / {rs['misses']} miss(es) this session "
         f"(cap {rs['cap']})")
 
+    # -- serving layer: knobs + readiness ------------------------------------
+    from pint_tpu import telemetry as _tel
+    from pint_tpu.serve.state import serve_config
+
+    scfg = serve_config()
+    g = _tel.gauges()
+    if "serve.ready" in g:
+        state = ("warm" if g.get("serve.aot_warm")
+                 else "COLD (a load balancer must gate on /readyz)")
+        lines.append(
+            f"Serving: replica live ({state}), queue depth "
+            f"{int(g.get('serve.queue_depth', 0))}, "
+            f"{int(_tel.counter_get('serve.requests'))} request(s) "
+            "served this session")
+    else:
+        lines.append(
+            "Serving: no replica in this process (pintserve; "
+            "--serve runs the smoke)")
+    lines.append(
+        f"  knobs: flush {scfg['flush_ms']:g}ms, max_batch "
+        f"{scfg['max_batch']}, queue_max {scfg['queue_max']}, "
+        f"deadline {scfg['deadline_ms']:g}ms, grid chunk "
+        f"{scfg['grid_chunk']} ($PINT_TPU_SERVE_*; docs/serving.md)")
+
     # -- structure-aware hot path: design partition + hybrid smoke ------------
     lines.extend(_design_section())
 
@@ -839,6 +863,147 @@ def _runs_section():
     return lines
 
 
+def _serve_section():
+    """Warm-service smoke (--serve): boot a replica on an ephemeral
+    port, exercise one request of each type, assert two same-bucket
+    requests coalesce into one batched dispatch (``serve.coalesced``
+    moves), run a checkpointed grid job to completion, and saturate a
+    1-deep queue to see the 429 + Retry-After shed path (and no 500s
+    anywhere).  Diagnostic: reports, never raises."""
+    import threading
+    import time as _time
+
+    from pint_tpu import telemetry
+
+    lines = ["Warm service (--serve):"]
+    srv = srv2 = None
+    try:
+        from pint_tpu.compile_cache import WARM_WLS_PAR
+        from pint_tpu.serve.client import request_json
+        from pint_tpu.serve.server import Server
+
+        srv = Server(flush_ms=100.0, max_batch=4, queue_max=32,
+                     deadline_ms=0)
+        port = srv.start(port=0)
+        s, doc, _ = request_json("127.0.0.1", port, "GET", "/readyz")
+        cold_ok = s == 503
+        for i, name in enumerate(("smk0", "smk1")):
+            s, info, _ = request_json(
+                "127.0.0.1", port, "POST", "/v1/load",
+                {"dataset": name, "par": WARM_WLS_PAR,
+                 "toas": {"n": 50, "seed": i}})
+            assert s == 200, info
+        lines.append(f"  datasets: 2 loaded (bucket {info['bucket']},"
+                     f" {info['kind']}); cold /readyz 503 -> "
+                     + ("OK" if cold_ok else "PROBLEM"))
+        srv.warmup("smk0", ops=("fit",), sizes=(1, 2), maxiter=2)
+        s, doc, _ = request_json("127.0.0.1", port, "GET", "/readyz")
+        lines.append("  explicit warmup: /readyz now "
+                     + (f"{s} -> OK" if s == 200
+                        else f"{s} -> PROBLEM"))
+
+        # one request of each type
+        s1, fit, _ = request_json(
+            "127.0.0.1", port, "POST", "/v1/fit",
+            {"dataset": "smk0", "maxiter": 2}, timeout=300)
+        s2, res, _ = request_json(
+            "127.0.0.1", port, "POST", "/v1/residuals",
+            {"dataset": "smk0"}, timeout=300)
+        s3, lnl, _ = request_json(
+            "127.0.0.1", port, "POST", "/v1/lnlike",
+            {"dataset": "smk0"}, timeout=300)
+        ok = all(x == 200 for x in (s1, s2, s3))
+        lines.append(
+            f"  fit chi2={fit.get('chi2'):.2f} "
+            f"({fit.get('status')}), residual rms "
+            f"{res.get('rms_s', 0) * 1e6:.2f}us, lnlike "
+            f"{lnl.get('lnlike'):.1f} -> "
+            + ("OK" if ok else "PROBLEM"))
+
+        # coalescing: two same-bucket fits inside one flush window
+        before = telemetry.counter_get("serve.coalesced")
+        out = [None, None]
+
+        def fire(i, name):
+            out[i] = request_json(
+                "127.0.0.1", port, "POST", "/v1/fit",
+                {"dataset": name, "maxiter": 2}, timeout=300)
+
+        ts = [threading.Thread(target=fire, args=(i, n))
+              for i, n in enumerate(("smk0", "smk1"))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        moved = telemetry.counter_get("serve.coalesced") - before
+        both = all(o is not None and o[0] == 200 for o in out)
+        occ = (out[0][1].get("batch") or {}).get("occupancy")
+        lines.append(
+            f"  coalescing: 2 same-bucket fits -> occupancy {occ}, "
+            f"serve.coalesced +{moved:g} -> "
+            + ("OK" if moved >= 1 and both else "PROBLEM"))
+
+        # checkpointed grid job
+        s, job, _ = request_json(
+            "127.0.0.1", port, "POST", "/v1/jobs",
+            {"kind": "grid", "dataset": "smk0", "job": "smokegrid",
+             "params": ["F0"], "n_steps": 1, "chunk": 3,
+             "axes": {"F0": {"start": 186.4940815669,
+                             "stop": 186.4940815671, "n": 6}}})
+        deadline = _time.time() + 120
+        while _time.time() < deadline:
+            s, job, _ = request_json("127.0.0.1", port, "GET",
+                                     "/v1/jobs/smokegrid")
+            if job.get("state") in ("done", "failed"):
+                break
+            _time.sleep(0.25)
+        lines.append(
+            f"  grid job: {job.get('state')} "
+            f"({(job.get('progress') or {}).get('done')} pts, "
+            f"min chi2 {(job.get('result') or {}).get('min_chi2')}) "
+            "-> " + ("OK" if job.get("state") == "done"
+                     else f"PROBLEM ({job.get('error')})"))
+
+        # shed path: saturate a 1-deep queue behind a slow flush
+        srv2 = Server(flush_ms=500.0, max_batch=2, queue_max=1)
+        p2 = srv2.start(port=0)
+        srv2.registry.load("shed", par=WARM_WLS_PAR,
+                           toas={"n": 50, "seed": 0})
+        shed_out = []
+
+        def burst(_):
+            shed_out.append(request_json(
+                "127.0.0.1", p2, "POST", "/v1/fit",
+                {"dataset": "shed", "maxiter": 2}, timeout=300))
+
+        ts = [threading.Thread(target=burst, args=(i,))
+              for i in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        codes = sorted(o[0] for o in shed_out)
+        n429 = codes.count(429)
+        n5xx = sum(1 for c in codes if c >= 500 and c != 503)
+        retry = [o[2].get("retry-after") for o in shed_out
+                 if o[0] == 429]
+        lines.append(
+            f"  load shedding: burst of 4 into queue_max=1 -> "
+            f"{codes}, Retry-After {retry[:1]}, "
+            f"{n429} shed, {n5xx} server error(s) -> "
+            + ("OK" if n429 >= 1 and n5xx == 0 else "PROBLEM"))
+    except Exception as e:  # diagnostic must never take the report down
+        lines.append(f"  ERROR {type(e).__name__}: {e}")
+    finally:
+        for s_ in (srv, srv2):
+            if s_ is not None:
+                try:
+                    s_.stop()
+                except Exception:
+                    pass
+    return lines
+
+
 def _aot_child(mode, path):
     """Child entry for the --aot smoke (one fresh interpreter per
     probe run): prints the probe record as a JSON line."""
@@ -1024,6 +1189,13 @@ def main(argv=None):
                    help="run the GWB kron/HMC smoke: kron-structured "
                         "lnlike vs the dense reference, gradient vs "
                         "central finite differences, tiny NUTS run")
+    p.add_argument("--serve", action="store_true",
+                   help="run the warm-service smoke: boot a replica "
+                        "on an ephemeral port, one request of each "
+                        "type, coalescing of two same-bucket "
+                        "requests asserted via serve.coalesced, a "
+                        "checkpointed grid job, and the 429 shed "
+                        "path under a saturated queue")
     p.add_argument("--runs", action="store_true",
                    help="run the run-ledger smoke: one fit under a "
                         "temp trace sink must reconstruct with >= 4 "
@@ -1044,6 +1216,9 @@ def main(argv=None):
             print(line)
     if args.runs:
         for line in _runs_section():
+            print(line)
+    if args.serve:
+        for line in _serve_section():
             print(line)
     if args.profile:
         for line in _profile_section():

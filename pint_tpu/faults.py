@@ -28,6 +28,9 @@ Fault classes (spec grammar: comma-separated ``name[:key=val...]``):
 - ``kill[:after=N][:site=S][:code=C]`` — deterministic process death:
   the Nth call to :func:`maybe_kill` at site ``S`` hard-exits (default
   code 137), simulating a mid-chain kill for checkpoint/resume tests.
+  Known sites: ``sampler.chunk`` (mid-MCMC-chain) and ``serve.flush``
+  (the warm fitting service — mid-batch dispatch and the grid-job
+  chunk loop, so a killed replica's resume story is testable).
 
 Faults activate via the environment variable (read per call, so a
 subprocess harness controls them) or programmatically

@@ -23,8 +23,12 @@ percentiles (telemetry.LogHistogram.percentiles reads its state
 once).  Paths:
 
 - ``GET /metrics`` — Prometheus text format.
-- ``GET /healthz`` — one JSON object: uptime, run-ledger summary,
-  compile stats.
+- ``GET /healthz`` — one JSON object: uptime, readiness, run-ledger
+  summary, compile stats.
+- ``GET /readyz`` — load-balancer readiness: 200 only for a warm
+  serving process (:func:`readiness` — AOT import or explicit warmup
+  complete), 503 otherwise.  A cold replica must not receive
+  traffic.
 
 Metric naming: ``pint_tpu_`` + the telemetry name with every
 non-``[a-zA-Z0-9_]`` character mapped to ``_``; counters get the
@@ -46,7 +50,7 @@ import time
 from pint_tpu import telemetry
 
 __all__ = ["start", "stop", "port", "render_prometheus",
-           "PORT_ENV", "HOST_ENV"]
+           "readiness", "PORT_ENV", "HOST_ENV"]
 
 PORT_ENV = "PINT_TPU_METRICS_PORT"
 HOST_ENV = "PINT_TPU_METRICS_HOST"
@@ -111,10 +115,36 @@ def render_prometheus() -> str:
     return "\n".join(lines) + "\n"
 
 
+def readiness():
+    """Load-balancer readiness verdict: ``(ready, doc)``.
+
+    A SERVING process (one that built a :class:`pint_tpu.serve.Server`
+    — detected by the ``serve.ready`` gauge) is ready only after its
+    AOT import or an explicit warmup completed (``serve.aot_warm``):
+    a cold replica must not receive traffic — its first requests
+    would each pay a full XLA compile.  A process with no serving
+    layer returns ``(None, ...)``: /readyz answers 503 there, which
+    is correct (nothing is serving), while /healthz keeps reporting
+    liveness for either kind of process."""
+    g = telemetry.gauges()
+    if "serve.ready" not in g:
+        return None, {"ready": None,
+                      "detail": "no serving layer in this process"}
+    started = bool(g.get("serve.ready"))
+    warm = bool(g.get("serve.aot_warm"))
+    ready = started and warm
+    return ready, {"ready": ready, "started": started,
+                   "aot_warm": warm,
+                   "queue_depth": g.get("serve.queue_depth", 0)}
+
+
 def _healthz() -> str:
+    ready, rdoc = readiness()
     doc = {
         "uptime_s": (round(time.time() - _t_started, 3)
                      if _t_started else None),
+        "ready": ready,
+        "readiness": rdoc,
         "runs": telemetry.runs_summary(),
         "compile": telemetry.compile_stats(),
     }
@@ -144,6 +174,7 @@ def start(port=None, host=None):
         class _Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (http.server API)
                 path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                status = 200
                 if path in ("/", "/metrics"):
                     body = render_prometheus().encode()
                     ctype = ("text/plain; version=0.0.4; "
@@ -151,10 +182,18 @@ def start(port=None, host=None):
                 elif path == "/healthz":
                     body = _healthz().encode()
                     ctype = "application/json"
+                elif path == "/readyz":
+                    # the LB gate: 200 only for a warm serving
+                    # process (AOT import / explicit warmup done)
+                    ready, doc = readiness()
+                    status = 200 if ready else 503
+                    body = json.dumps(
+                        doc, separators=(",", ":")).encode()
+                    ctype = "application/json"
                 else:
                     self.send_error(404)
                     return
-                self.send_response(200)
+                self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()

@@ -314,13 +314,40 @@ class PTABatch:
                     "construction"
                 )
             pairs = make_superset_models(pairs)
-        self.prepareds: List[PreparedModel] = []
-        self.resids: List[Residuals] = []
+        prepareds: List[PreparedModel] = []
+        resids: List[Residuals] = []
         for model, toas in pairs:
             prep = model.prepare(toas)
-            self.prepareds.append(prep)
-            self.resids.append(Residuals(toas, prep,
-                                         track_mode="nearest"))
+            prepareds.append(prep)
+            resids.append(Residuals(toas, prep,
+                                    track_mode="nearest"))
+        self._init_from_prepared(prepareds, resids)
+
+    @classmethod
+    def from_prepared(cls, prepareds: Sequence[PreparedModel],
+                      resids: Sequence[Residuals]) -> "PTABatch":
+        """Build a batch over ALREADY-prepared pulsars, skipping the
+        ``model.prepare(toas)`` pass — the serving fast path
+        (:mod:`pint_tpu.serve`): a warm replica caches one
+        (PreparedModel, Residuals) pair per dataset and stacks a fresh
+        batch per coalesced flush, so the per-flush host cost is the
+        stacking alone, never a re-prepare.
+
+        Members must share identical component structure and
+        free-parameter names (the serving layer groups by structure
+        fingerprint; this constructor does NOT run the superset
+        alignment of ``__init__``).  The same prepared pair may appear
+        several times (occupancy padding) — stacking only reads its
+        arrays."""
+        self = cls.__new__(cls)
+        self._init_from_prepared(list(prepareds), list(resids))
+        return self
+
+    def _init_from_prepared(self, prepareds, resids):
+        """Shared tail of construction: everything downstream of the
+        per-pulsar prepare step (free-union, padding, stacking)."""
+        self.prepareds = prepareds
+        self.resids = resids
         # free parameters: the union across pulsars, with a per-pulsar
         # 0/1 mask; a parameter outside a pulsar's own free list stays
         # pinned at that pulsar's value (its design column is exactly
@@ -418,13 +445,20 @@ class PTABatch:
             [float(p.model.values[n]) for n in self.free_names]
             for p in self.prepareds
         ]))
-        self._full_values = [
-            p._values_pytree() for p in self.prepareds
-        ]
-        self.base_values = jax.tree.map(
-            lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
-            *self._full_values,
-        )
+        self.base_values = self._stack_values()
+
+    def _stack_values(self):
+        """Stacked per-pulsar values pytree ({name: (k,) row}), built
+        host-side in ONE numpy pass: values are python floats, and
+        stacking ~30 params x k members through eager per-scalar
+        ``jnp.float64``/``jnp.stack`` dispatches costs tens of ms per
+        batch build — the serving hot path builds a batch per flush."""
+        return {
+            name: jnp.asarray(np.array(
+                [float(p.model.values[name]) for p in self.prepareds],
+                dtype=np.float64))
+            for name in self.prepareds[0].model.values
+        }
 
     # -- single-pulsar pure functions (vmapped below) -------------------------
     def _values_at(self, vec_or_sub, base_values, free_mask):
@@ -1039,13 +1073,22 @@ class PTABatch:
                 n_lin=(len(self._partition[0]) if n_lin is None
                        else n_lin)))
         bad_idx = [] if bad is None else list(np.flatnonzero(bad))
+        # write-back reads the mask host-side: per-element jnp
+        # indexing here costs ~0.3 ms x (k x P) eager dispatches per
+        # batch — measurable at serving rates
+        fm = np.asarray(self.free_mask)
         for k, p in enumerate(self.prepareds):
             if k in bad_idx:
                 continue  # never write a diverged pulsar's values
             for i, name in enumerate(self.free_names):
-                if float(self.free_mask[k, i]):
+                if fm[k, i]:
                     p.model.values[name] = float(vec_np[k, i])
         self.fit_rung = rung
+        #: member index -> serving rung name for rung-served members
+        #: (the aliasing-safe readout: model.meta is shared when one
+        #: model occupies several batch rows, e.g. the serving layer's
+        #: occupancy padding/dedup)
+        self.fit_rungs = dict(rung_of)
         self.fit_health = _guard.to_record(health)
         telemetry.emit({"type": "health", "context": "PTABatch",
                         "rung": rung, **self.fit_health})
@@ -1173,13 +1216,7 @@ class PTABatch:
             [float(p.model.values[n]) for n in self.free_names]
             for p in self.prepareds
         ]))
-        self._full_values = [
-            p._values_pytree() for p in self.prepareds
-        ]
-        self.base_values = jax.tree.map(
-            lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
-            *self._full_values,
-        )
+        self.base_values = self._stack_values()
         self._structure_key_cached = None
         self._fit_jit_cache = {}
 
@@ -1196,6 +1233,73 @@ class PTABatch:
         return f(vals, self.base_values, self.batch, self.ctx,
                  self.tzr_batch, self.tzr_ctx, self.valid,
                  self.free_mask)
+
+    # -- no-fit evaluation programs (the serving layer's ops) -----------------
+    def _chisq_one(self, vec, base_values, batch, ctx, tzr_batch,
+                   tzr_ctx, valid, free_mask):
+        """White-noise-weighted chi^2 for one pulsar at a free-param
+        vector — no refit; correlated noise enters only through the
+        EFAC/EQUAD/ECORR-scaled sigmas.  The pure function under
+        :meth:`chisq`."""
+        values = self._values_at(vec, base_values, free_mask)
+        r = self._resid_one_values(values, batch, ctx, tzr_batch,
+                                   tzr_ctx, valid)
+        merged = _merge_ctx(ctx, self.static_ctx)
+        sigma = self._sigma_one(values, batch, merged)
+        err = jnp.where(valid, sigma, 1e30)
+        return jnp.sum((r / err) ** 2)
+
+    def _eval_jit(self, which):
+        """ONE jitted no-fit evaluation program per (kind, structure):
+        ``"resid"`` -> padded residuals, ``"chisq"`` -> per-pulsar
+        weighted chi^2.  Routed through the shared registry (keys
+        ``pta.resid`` / ``pta.chisq``) so a second same-structure call
+        — the serving layer's residual/lnlike ops — performs zero new
+        XLA compiles, and the AOT export/import path covers them like
+        the batched fits."""
+        cache = getattr(self, "_fit_jit_cache", None)
+        if cache is None:
+            cache = self._fit_jit_cache = {}
+        got = cache.get(("eval", which))
+        if got is None:
+            tzr_ax = 0 if self.tzr_batch is not None else None
+            tcx_ax = 0 if self.tzr_ctx is not None else None
+            one = (self._resid_one if which == "resid"
+                   else self._chisq_one)
+            got = cache[("eval", which)] = _cc.shared_jit(
+                jax.vmap(one,
+                         in_axes=(0, 0, 0, 0, tzr_ax, tcx_ax, 0, 0)),
+                key=("pta." + which, self._structure_key()),
+                fn_token="pta." + which,
+                label="pta." + which)
+        else:
+            telemetry.counter_add("pta.fit_jit_cache_hits")
+        return got
+
+    def _eval_shared(self, which, values=None):
+        vals = self.values0 if values is None else jnp.asarray(values)
+        fn = self._eval_jit(which)
+        with telemetry.run_scope("pta." + which,
+                                 n_pulsars=self.n_pulsars), \
+                span("pta." + which, n_pulsars=self.n_pulsars):
+            out = np.asarray(fn(vals, self.base_values, self.batch,
+                                self.ctx, self.tzr_batch, self.tzr_ctx,
+                                self.valid, self.free_mask))
+        telemetry.record_transfer(out)
+        return out
+
+    def chisq(self, values=None):
+        """(n_pulsars,) weighted chi^2 at stacked free-parameter rows
+        ``values`` ((k, P); default the current ``values0``) through
+        one shared program, no fitting — the serving layer's lnlike op
+        (``lnlike = -chi2/2`` up to the white-noise normalization)."""
+        return self._eval_shared("chisq", values)
+
+    def residuals_shared(self, values=None):
+        """(n_pulsars, n_max) padded residuals through the ONE shared
+        registry program — the serving layer's residual op (the eager
+        :meth:`residuals` stays for ad-hoc/gradient use)."""
+        return self._eval_shared("resid", values)
 
     def fit_wls(self, maxiter=3, mesh=None, checkpoint=None):
         """Batched WLS Gauss-Newton fit of every pulsar; returns

@@ -339,9 +339,11 @@ def convergence_table(records, run_id=None):
 # --------------------------------------------------------------------------
 
 #: metrics where a SMALLER value is better (everything else in the
-#: suite is a rate)
+#: suite is a rate).  cold_replica_warm_s is the serving twin of
+#: cold_start_s: fresh pintserve replica, AOT import -> first served
+#: fit over HTTP.
 _LOWER_IS_BETTER = {"guard_overhead", "profile_overhead",
-                    "cold_start_s"}
+                    "cold_start_s", "cold_replica_warm_s"}
 
 #: the suite's known rate-metric series (higher is better — the
 #: sentinel's default direction).  Purely a registration list: the
@@ -361,6 +363,9 @@ RATE_METRICS = frozenset({
     # (gw/hmc): a kron-path regression trips the sentinel exactly
     # like any other rate series
     "gwb_lnlike_per_sec", "nuts_draws_per_sec",
+    # the warm fitting service's mixed-stream throughput (pint_tpu/
+    # serve): a coalescing/batching regression trips the sentinel
+    "serve_reqs_per_sec",
 })
 
 #: absolute slack (same units as the metric — percentage points for

@@ -1,0 +1,57 @@
+"""Warm fitting service: the production front door (ROADMAP item 1).
+
+Composes the stack's serving-enablers into one long-lived process:
+
+- **TOA bucketing** (PR 2) quantizes request shapes to 64·1.25^k, so
+  unrelated requests become same-program work;
+- the **PTA batch path** (PR 7/11) fits many pulsars as one device
+  program, so same-bucket requests coalesce into ONE dispatch
+  (:mod:`~pint_tpu.serve.batcher` — deadline-based flush,
+  ``$PINT_TPU_SERVE_FLUSH_MS``);
+- **AOT-serialized executables** (PR 8) make a replica's first served
+  fit run with zero uncached XLA backend compiles — the export
+  directory is the deploy artifact N replicas share
+  (``pintserve --export`` / ``--import``);
+- the **guard ladder** (PR 4) degrades a diverging request to its
+  serving rung instead of failing it, per batch member;
+- **admission control** (:mod:`~pint_tpu.serve.admission`) bounds the
+  device queue and sheds with 429 + Retry-After;
+- **jobs** (:mod:`~pint_tpu.serve.jobs`) run grid/MCMC work behind
+  job-id polling with PR-4 checkpointed resume;
+- the **run ledger + /metrics endpoint** (PR 10) record every
+  request (``serve.*`` counters, per-request phase splits), so the
+  service's p99 story is measurable, not asserted.
+
+Entry points: the ``pintserve`` CLI (:mod:`pint_tpu.serve.cli`), the
+embeddable :class:`~pint_tpu.serve.server.Server`, and
+``bench.py``'s ``serve_reqs_per_sec`` / ``cold_replica_warm_s``
+metrics.  See docs/serving.md for the request lifecycle and the
+deploy recipe.
+"""
+
+from pint_tpu.serve.state import (  # noqa: F401
+    DatasetRegistry,
+    DeadlineMiss,
+    Request,
+    ServeError,
+    Shed,
+    serve_config,
+    size_class_for,
+    size_classes,
+)
+
+__all__ = [
+    "Server", "DatasetRegistry", "Request", "ServeError", "Shed",
+    "DeadlineMiss", "serve_config", "size_classes",
+    "size_class_for",
+]
+
+
+def __getattr__(name):
+    # Server pulls in the batcher/jobs stack; keep `import
+    # pint_tpu.serve` light for consumers that only need the types
+    if name == "Server":
+        from pint_tpu.serve.server import Server
+
+        return Server
+    raise AttributeError(name)

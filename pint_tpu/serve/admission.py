@@ -1,0 +1,51 @@
+"""Admission control: bound the device queue, shed load, honor
+deadlines.
+
+A long-lived replica's failure mode under overload is an unbounded
+queue: every request is eventually served, every response is useless
+(its client timed out long ago), and the process OOMs on buffered
+work.  This layer refuses work at the front door instead:
+
+- **Queue bound** — at most ``queue_max`` requests may be pending in
+  the coalescing batcher (``$PINT_TPU_SERVE_QUEUE_MAX``).  Request
+  ``queue_max + 1`` is **shed**: a structured
+  :class:`~pint_tpu.serve.state.Shed` that the HTTP layer maps to
+  ``429`` with a ``Retry-After`` hint derived from the flush cadence
+  (~two flush periods: by then the queue has drained at least one
+  full batch per group).  Shedding is O(1) host work — a saturated
+  replica stays responsive ABOUT being saturated.
+- **Per-request deadlines** — a request may carry ``deadline_ms``
+  (default ``$PINT_TPU_SERVE_DEADLINE_MS``; 0 disables).  A request
+  whose deadline expires while still queued is answered ``504``
+  without touching the device (the work never started, so retrying
+  elsewhere is safe); the miss ticks ``serve.deadline_misses``.
+
+Neither knob ever reaches a traced program — admission decisions are
+pure host arithmetic over queue depth and wall clocks.
+"""
+
+from __future__ import annotations
+
+from pint_tpu import telemetry
+from pint_tpu.serve.state import Shed
+
+__all__ = ["admit", "retry_after_s"]
+
+
+def retry_after_s(flush_ms) -> float:
+    """The Retry-After hint for a shed: ~two flush periods, floored
+    at 50 ms (a 0-ms dev flush must not advertise retry-immediately
+    to a client loop)."""
+    return max(2.0 * float(flush_ms) / 1e3, 0.05)
+
+
+def admit(n_pending, queue_max, flush_ms):
+    """Raise :class:`Shed` when the pending queue is at its bound;
+    otherwise admit (return None).  Called under the batcher lock so
+    the bound is exact, never racy."""
+    if queue_max and n_pending >= int(queue_max):
+        telemetry.counter_add("serve.sheds")
+        raise Shed(
+            f"device queue saturated ({n_pending} pending >= "
+            f"queue_max {queue_max})",
+            retry_after_s=retry_after_s(flush_ms))

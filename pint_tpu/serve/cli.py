@@ -1,0 +1,160 @@
+"""``pintserve``: boot a warm fitting replica (or build its deploy
+artifact).
+
+Examples::
+
+    # dev replica on an ephemeral port, warmed by compiling
+    pintserve --port 0 --warm
+
+    # build the deploy artifact: dress-rehearse the serve programs,
+    # serialize them, exit
+    PINT_TPU_CACHE_DIR=/fast/xla pintserve --export /fast/aot
+
+    # production replica: import the artifact, reach warm serving
+    # with zero uncached XLA backend compiles, expose Prometheus
+    PINT_TPU_CACHE_DIR=/fast/xla PINT_TPU_METRICS_PORT=9464 \\
+        pintserve --import /fast/aot --port 8470 \\
+        --dataset J1855=J1855.par,J1855.tim
+
+Knobs default from ``$PINT_TPU_SERVE_*`` (flush deadline, max batch,
+queue bound, default request deadline, job dir, AOT dir); flags
+override.  See docs/serving.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+__all__ = ["main"]
+
+
+def main(argv=None):
+    from pint_tpu.serve.state import (
+        AOT_DIR_ENV,
+        HOST_ENV,
+        PORT_ENV,
+        serve_config,
+    )
+
+    p = argparse.ArgumentParser(
+        prog="pintserve",
+        description="Warm fitting service: coalesced batched "
+                    "fit/residual/lnlike serving + async jobs")
+    p.add_argument("--host", default=None,
+                   help=f"bind host (default ${HOST_ENV} or "
+                        "127.0.0.1)")
+    p.add_argument("--port", type=int, default=None,
+                   help=f"bind port (default ${PORT_ENV} or 8470; "
+                        "0 = ephemeral)")
+    p.add_argument("--flush-ms", type=float, default=None,
+                   help="coalescing flush deadline "
+                        "[$PINT_TPU_SERVE_FLUSH_MS, default 5]")
+    p.add_argument("--max-batch", type=int, default=None,
+                   help="max members per batched dispatch "
+                        "[$PINT_TPU_SERVE_MAX_BATCH, default 8]")
+    p.add_argument("--queue-max", type=int, default=None,
+                   help="admission bound on pending requests "
+                        "[$PINT_TPU_SERVE_QUEUE_MAX, default 64]")
+    p.add_argument("--deadline-ms", type=float, default=None,
+                   help="default per-request deadline, 0 = none "
+                        "[$PINT_TPU_SERVE_DEADLINE_MS]")
+    p.add_argument("--job-dir", default=None,
+                   help="job/checkpoint directory "
+                        "[$PINT_TPU_SERVE_JOB_DIR]")
+    p.add_argument("--import", dest="import_dir", metavar="DIR",
+                   default=None,
+                   help="AOT manifest to import at boot (zero-"
+                        f"uncached-compile cold start) "
+                        f"[${AOT_DIR_ENV}]")
+    p.add_argument("--export", dest="export_dir", metavar="DIR",
+                   default=None,
+                   help="dress-rehearse the serve programs, "
+                        "serialize executables to DIR, exit (the "
+                        "deploy artifact for --import replicas)")
+    p.add_argument("--warm", action="store_true",
+                   help="explicit warmup at boot (compile every "
+                        "(op, size-class) program now instead of on "
+                        "first request); implied by --export")
+    p.add_argument("--dataset", action="append", default=[],
+                   metavar="ID=PAR[,TIM]",
+                   help="register a dataset at boot: par file path "
+                        "(+ optional tim path; synthetic TOAs "
+                        "otherwise); repeatable")
+    args = p.parse_args(argv)
+
+    from pint_tpu import telemetry
+    from pint_tpu.serve.server import Server
+
+    cfg = serve_config(flush_ms=args.flush_ms,
+                       max_batch=args.max_batch,
+                       queue_max=args.queue_max,
+                       deadline_ms=args.deadline_ms)
+    aot_dir = args.import_dir or os.environ.get(AOT_DIR_ENV) or None
+    srv = Server(flush_ms=cfg["flush_ms"],
+                 max_batch=cfg["max_batch"],
+                 queue_max=cfg["queue_max"],
+                 deadline_ms=cfg["deadline_ms"],
+                 job_dir=args.job_dir, aot_dir=aot_dir)
+
+    for spec in args.dataset:
+        name, _, paths = spec.partition("=")
+        if not paths:
+            p.error(f"--dataset {spec!r}: expected ID=PAR[,TIM]")
+        par_path, _, tim_path = paths.partition(",")
+        with open(par_path) as fh:
+            par = fh.read()
+        info = srv.registry.load(name, par,
+                                 tim=tim_path or None)
+        print(f"pintserve: dataset {name}: {info['n_toas']} TOAs "
+              f"(bucket {info['bucket']}, {info['kind']})",
+              file=sys.stderr)
+
+    report = srv.startup(warm=args.warm or bool(args.export_dir),
+                         progress=lambda s: print(
+                             f"pintserve: {s}", file=sys.stderr))
+    if report is not None:
+        print(f"pintserve: AOT import: {report.get('loaded', 0)} "
+              f"executable(s), {len(report.get('rejected', []))} "
+              "rejected", file=sys.stderr)
+
+    if args.export_dir:
+        from pint_tpu import compile_cache as _cc
+
+        out = _cc.export_executables(
+            args.export_dir,
+            progress=lambda s: print(f"pintserve: {s}",
+                                     file=sys.stderr))
+        print(f"pintserve: exported {len(out['exported'])} "
+              f"executable(s) to {args.export_dir} "
+              f"({len(out['skipped'])} skipped)", file=sys.stderr)
+        srv.stop()
+        return 0
+
+    host = args.host or os.environ.get(HOST_ENV, "").strip() \
+        or "127.0.0.1"
+    raw_port = os.environ.get(PORT_ENV, "").strip()
+    port = args.port if args.port is not None else (
+        int(raw_port) if raw_port else 8470)
+    bound = srv.start(host, port)
+    ready = bool(telemetry.gauges().get("serve.aot_warm"))
+    print(f"pintserve: serving on {host}:{bound} "
+          f"(flush {cfg['flush_ms']}ms, max_batch "
+          f"{cfg['max_batch']}, queue_max {cfg['queue_max']}; "
+          f"{'warm' if ready else 'COLD — /readyz will gate'})",
+          file=sys.stderr, flush=True)
+    try:
+        import time
+
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        print("pintserve: shutting down", file=sys.stderr)
+    finally:
+        srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,80 @@
+"""Minimal JSON-over-HTTP client for the serving front door.
+
+Stdlib ``http.client`` with keep-alive — the helper every in-repo
+consumer (bench load generator, datacheck smoke, tests, examples)
+uses so none of them hand-rolls HTTP.  Production clients can use any
+HTTP stack; the wire format is plain JSON.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+
+__all__ = ["ServeClient", "request_json"]
+
+
+class ServeClient:
+    """One keep-alive connection to a replica."""
+
+    def __init__(self, host="127.0.0.1", port=8470, timeout=60.0):
+        self.host = host
+        self.port = int(port)
+        self.timeout = timeout
+        self._conn = None
+
+    def _connection(self):
+        if self._conn is None:
+            self._conn = http.client.HTTPConnection(
+                self.host, self.port, timeout=self.timeout)
+        return self._conn
+
+    def request(self, method, path, body=None):
+        """Returns ``(status, parsed_json, headers_dict)``; retries
+        once on a dropped keep-alive connection."""
+        payload = (None if body is None
+                   else json.dumps(body).encode())
+        headers = {"Content-Type": "application/json"}
+        for attempt in (0, 1):
+            conn = self._connection()
+            try:
+                conn.request(method, path, body=payload,
+                             headers=headers)
+                resp = conn.getresponse()
+                raw = resp.read()
+                break
+            except (http.client.HTTPException, ConnectionError,
+                    BrokenPipeError, OSError):
+                self.close()
+                if attempt:
+                    raise
+        try:
+            obj = json.loads(raw) if raw else {}
+        except ValueError:
+            obj = {"raw": raw.decode(errors="replace")}
+        return resp.status, obj, {k.lower(): v
+                                  for k, v in resp.getheaders()}
+
+    # convenience verbs
+    def get(self, path):
+        return self.request("GET", path)
+
+    def post(self, path, body):
+        return self.request("POST", path, body)
+
+    def close(self):
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except OSError:
+                pass
+            self._conn = None
+
+
+def request_json(host, port, method, path, body=None, timeout=60.0):
+    """One-shot request (fresh connection, closed after)."""
+    c = ServeClient(host, port, timeout=timeout)
+    try:
+        return c.request(method, path, body)
+    finally:
+        c.close()

@@ -1,0 +1,420 @@
+"""Async jobs: long device work behind job-id polling, with
+checkpointed resume.
+
+A grid scan or a sampler chain does not belong on the request/response
+path — a client should not hold an HTTP connection open for minutes,
+and a replica restart must not throw the work away.  This layer gives
+long work the submit/poll/resume shape:
+
+- ``POST /v1/jobs`` validates a spec, persists it as
+  ``<job_dir>/<id>.json`` (atomic write), and enqueues it; the
+  response is the job document (state ``queued``).  The client may
+  supply the ``job`` id — resubmitting the SAME id after a replica
+  death is the resume path.
+- ``GET /v1/jobs/<id>`` returns the live document: state
+  (``queued|running|done|failed``), progress, and the result when
+  done.
+- Every job checkpoints through the PR-4 path
+  (:func:`pint_tpu.guard.save_checkpoint` — atomic tmp+replace, a
+  structure fingerprint validated on restore): the **grid** kind
+  saves after every chunk of points, so a killed replica resumes
+  losing at most one chunk; the **mcmc** kind rides
+  :meth:`pint_tpu.sampler.EnsembleSampler.run_mcmc_autocorr`'s
+  built-in per-chunk checkpoint (the NUTS/HMC jobs of ``gw/hmc`` plug
+  into the same submit/poll/checkpoint plumbing by adding a kind).
+
+Job kinds:
+
+- ``grid`` — chi^2 over an explicit point list (or dense axes) of a
+  registered dataset, ``grid_chisq_tuple`` per chunk
+  (``$PINT_TPU_SERVE_GRID_CHUNK`` points each; the grid programs are
+  data-dynamic, so chunk boundaries never retrace).  The chunk loop is
+  a ``serve.flush`` kill site — the chaos harness kills mid-job and
+  asserts the resume loses <= 1 chunk.
+- ``mcmc`` — an ensemble chain over the dataset's white-noise
+  posterior (``-chi^2/2`` through the shared ``pta.chisq`` pure
+  function), checkpointed per chunk by the sampler itself.
+
+Jobs run on ONE worker thread (device work serializes anyway); the
+job model is deliberately isolated from the registry — a grid run
+deep-copies its dataset's model (so a fitting request flushed
+concurrently can never observe the grid's parameter pins) and an
+mcmc run snapshots the values into its stacked batch at build time;
+both snapshots happen under ``state.SERVING_LOCK`` so they can never
+capture the batcher thread's transient mid-flush write-back.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+import queue
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+from pint_tpu import telemetry
+
+__all__ = ["JobStore", "run_job", "main"]
+
+#: result payloads are capped like residual payloads — a 10^5-point
+#: grid reports its minimum and shape, not every chi^2
+RESULT_POINT_CAP = 4096
+
+#: hard bound on grid-job size, checked ARITHMETICALLY before any
+#: axis is materialized: submit validation runs on the HTTP event
+#: loop, and a hostile {"n": 1e9} axis spec must be a 400, not an
+#: allocation that stalls the whole replica
+MAX_GRID_POINTS = 1_000_000
+
+
+def _atomic_write_json(path, doc):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh, indent=1)
+    os.replace(tmp, path)
+
+
+def _grid_points(spec) -> np.ndarray:
+    """The (n_points, n_params) array of a grid spec: explicit
+    ``values`` rows, or dense ``axes`` ({name: {start, stop, n}} in
+    ``params`` order)."""
+    params = list(spec.get("params") or ())
+    if not params:
+        raise ValueError("grid job needs 'params' (parameter names)")
+    if spec.get("values") is not None:
+        pts = np.asarray(spec["values"], dtype=np.float64)
+        pts = np.atleast_2d(pts)
+        if pts.shape[1] != len(params):
+            raise ValueError(
+                f"grid values shape {pts.shape} does not match "
+                f"{len(params)} parameter(s)")
+        if pts.shape[0] > MAX_GRID_POINTS:
+            raise ValueError(
+                f"grid too large (> {MAX_GRID_POINTS} points); "
+                "split it into several jobs")
+        return pts
+    axes_spec = spec.get("axes")
+    if not isinstance(axes_spec, dict):
+        raise ValueError("grid job needs 'values' rows or 'axes'")
+    # size check BEFORE any allocation (see MAX_GRID_POINTS)
+    total = 1
+    for name in params:
+        a = axes_spec.get(name)
+        if not isinstance(a, dict):
+            raise ValueError(f"axes entry for {name!r} missing")
+        n = int(a["n"])
+        if n < 1:
+            raise ValueError(f"axes entry for {name!r}: n {n} < 1")
+        total *= n
+        if total > MAX_GRID_POINTS:
+            raise ValueError(
+                f"grid too large (> {MAX_GRID_POINTS} points); "
+                "split it into several jobs")
+    axes = []
+    for name in params:
+        a = axes_spec[name]
+        axes.append(np.linspace(float(a["start"]), float(a["stop"]),
+                                int(a["n"])))
+    grids = np.meshgrid(*axes, indexing="ij")
+    return np.stack([g.ravel() for g in grids], axis=1)
+
+
+def _check_grid_params(ds, params):
+    for p in params:
+        if p not in ds.model.free_params:
+            raise ValueError(
+                f"grid parameter {p!r} is not free in dataset "
+                f"{ds.dataset_id!r}")
+
+
+def run_job(registry, doc, job_dir, grid_chunk=16, progress=None):
+    """Run one job document to completion (resuming from its
+    checkpoint when one exists); returns the result dict.  Raises on
+    failure — the worker (or the CLI child) records the failure
+    state."""
+    kind = doc["kind"]
+    spec = doc["spec"]
+    if kind == "grid":
+        return _run_grid(registry, doc, job_dir, grid_chunk, progress)
+    if kind == "mcmc":
+        return _run_mcmc(registry, doc, job_dir, progress)
+    raise ValueError(f"unknown job kind {kind!r} "
+                     "(supported: grid, mcmc)")
+
+
+def _run_grid(registry, doc, job_dir, grid_chunk, progress):
+    from pint_tpu import compile_cache as _cc
+    from pint_tpu import faults as _faults
+    from pint_tpu import guard as _guard
+    from pint_tpu.grid import grid_chisq_tuple
+
+    spec = doc["spec"]
+    ds = registry.get(spec["dataset"])
+    params = list(spec["params"])
+    _check_grid_params(ds, params)
+    points = _grid_points(spec)
+    n_steps = int(spec.get("n_steps", 2))
+    chunk = int(spec.get("chunk", grid_chunk))
+    n = points.shape[0]
+    # jobs never touch the registry's model: a concurrent fit flush
+    # must not see grid-pinned values (and vice versa).  The snapshot
+    # itself happens under SERVING_LOCK so it can never capture the
+    # transient mid-flush write-back state of the batcher thread.
+    from pint_tpu.serve.state import SERVING_LOCK
+
+    with SERVING_LOCK:
+        model = copy.deepcopy(ds.model)
+    ckpt = os.path.join(job_dir, doc["job"] + ".ckpt.npz")
+    fp = _cc.fingerprint((ds.structure, tuple(params),
+                          points.shape, n_steps, chunk))
+    chi2 = np.full(n, np.nan)
+    done = 0
+    loaded = _guard.load_checkpoint(ckpt, fingerprint=fp)
+    if loaded is not None:
+        arrays, _head = loaded
+        done = int(arrays["n_done"][()])
+        chi2[:done] = arrays["chi2"][:done]
+        doc["resumed_from"] = done
+        telemetry.counter_add("serve.job_resumes")
+    while done < n:
+        # the chaos kill site: a mid-job death here loses at most the
+        # chunk in flight — everything before it is checkpointed
+        _faults.maybe_kill("serve.flush")
+        hi = min(done + chunk, n)
+        c, _fitted = grid_chisq_tuple(ds.toas, model, params,
+                                      points[done:hi],
+                                      n_steps=n_steps)
+        chi2[done:hi] = np.asarray(c)
+        done = hi
+        _guard.save_checkpoint(
+            ckpt, {"chi2": chi2, "n_done": np.int64(done)},
+            fingerprint=fp, meta={"job": doc["job"]})
+        doc["progress"] = {"done": done, "total": n}
+        if progress is not None:
+            progress(doc)
+    finite = np.isfinite(chi2)
+    result = {
+        "n_points": int(n),
+        "n_finite": int(finite.sum()),
+        "min_chi2": (float(np.nanmin(chi2)) if finite.any()
+                     else None),
+        "argmin": (
+            {p: float(v) for p, v in
+             zip(params, points[int(np.nanargmin(chi2))])}
+            if finite.any() else None),
+    }
+    if n <= RESULT_POINT_CAP:
+        result["chi2"] = [float(x) for x in chi2]
+    try:
+        os.unlink(ckpt)  # done: the checkpoint has served its purpose
+    except OSError:
+        pass
+    return result
+
+
+def _run_mcmc(registry, doc, job_dir, progress):
+    import jax
+
+    from pint_tpu.parallel.pta import PTABatch
+    from pint_tpu.sampler import EnsembleSampler
+
+    spec = doc["spec"]
+    ds = registry.get(spec["dataset"])
+    nwalkers = int(spec.get("nwalkers", 16))
+    maxsteps = int(spec.get("maxsteps", 500))
+    chunk = int(spec.get("chunk", 100))
+    scale = float(spec.get("scale", 1e-8))
+    # the stacked batch snapshots the model's values at build time
+    # (values0/base_values device rows; the chain only ever reads
+    # those) — build it under SERVING_LOCK so the snapshot can't
+    # capture a concurrent flush's transient write-back
+    from pint_tpu.serve.state import SERVING_LOCK
+
+    with SERVING_LOCK:
+        batch = PTABatch.from_prepared([ds.prepared], [ds.resid])
+
+    def _sl(tree):
+        return (None if tree is None
+                else jax.tree.map(lambda a: a[0], tree))
+
+    args = (_sl(batch.base_values), _sl(batch.batch), _sl(batch.ctx),
+            _sl(batch.tzr_batch), _sl(batch.tzr_ctx), batch.valid[0],
+            batch.free_mask[0])
+
+    def lnpost(vec):
+        return -0.5 * batch._chisq_one(vec, *args)
+
+    s = EnsembleSampler(lnpost, nwalkers=nwalkers,
+                        seed=int(spec.get("seed", 0)),
+                        jit_key=("serve.mcmc", ds.structure))
+    center = np.asarray(batch.values0[0])
+    x0 = s.initial_ball(center, scale * (np.abs(center) + 1e-12))
+    ckpt = os.path.join(job_dir, doc["job"] + ".ckpt.npz")
+    chain, converged, tau = s.run_mcmc_autocorr(
+        x0, chunk=chunk, maxsteps=maxsteps, checkpoint=ckpt)
+    flat = s.flatchain(burn=min(len(chain) // 4, 100))
+    return {
+        "n_steps": int(np.asarray(chain).shape[0]),
+        "converged": bool(converged),
+        "tau_max": (float(np.max(tau))
+                    if np.all(np.isfinite(tau)) else None),
+        "acceptance": float(s.acceptance),
+        "mean": {p: float(m) for p, m in
+                 zip(batch.free_names, flat.mean(axis=0))},
+        "std": {p: float(v) for p, v in
+                zip(batch.free_names, flat.std(axis=0))},
+    }
+
+
+class JobStore:
+    """Persistent job documents + one worker thread.
+
+    ``job_dir`` holds one ``<id>.json`` per job (the document of
+    record — it survives the process) and the job's checkpoint.  A
+    replica restart rebuilds its view lazily from disk: resubmitting
+    a completed id returns the stored result; resubmitting an
+    interrupted id re-enqueues it and the kind's checkpoint resume
+    picks up where the dead replica stopped."""
+
+    def __init__(self, registry, job_dir=None, grid_chunk=16):
+        from pint_tpu.serve.state import JOB_DIR_ENV
+
+        self.registry = registry
+        self.job_dir = (job_dir or os.environ.get(JOB_DIR_ENV)
+                        or tempfile.mkdtemp(prefix="pintserve_jobs_"))
+        os.makedirs(self.job_dir, exist_ok=True)
+        self.grid_chunk = int(grid_chunk)
+        self._q: queue.Queue = queue.Queue()
+        self._lock = threading.Lock()
+        self._stopped = False
+        self._thread = threading.Thread(
+            target=self._worker, name="pintserve-jobs", daemon=True)
+        self._thread.start()
+
+    def _doc_path(self, job_id):
+        if not str(job_id).replace("-", "").replace("_", "").isalnum():
+            raise ValueError(f"invalid job id {job_id!r}")
+        return os.path.join(self.job_dir, str(job_id) + ".json")
+
+    def _write(self, doc):
+        _atomic_write_json(self._doc_path(doc["job"]), doc)
+
+    def submit(self, spec) -> dict:
+        """Validate + persist + enqueue one job spec; returns the job
+        document.  Client-supplied ``job`` ids make resubmission the
+        resume path; a finished id returns its stored document
+        without re-running."""
+        if not isinstance(spec, dict):
+            raise ValueError("job spec must be a JSON object")
+        kind = spec.get("kind")
+        if kind not in ("grid", "mcmc"):
+            raise ValueError(
+                f"unknown job kind {kind!r} (supported: grid, mcmc)")
+        ds = self.registry.get(spec.get("dataset"))  # must exist
+        if kind == "grid":
+            # validate geometry + parameter names up front: a bad
+            # spec is the submitter's 400, not a later job failure
+            _check_grid_params(ds, list(spec.get("params") or ()))
+            _grid_points(spec)
+        job_id = str(spec.get("job") or f"job{int(time.time() * 1e3):x}"
+                     f"{os.getpid() % 997:03d}")
+        spec = {**spec, "job": job_id}
+        existing = self.status(job_id)
+        if existing is not None and existing.get("state") == "done":
+            return existing  # resume-complete: never re-run
+        doc = {"job": job_id, "kind": kind, "state": "queued",
+               "spec": spec, "submitted_ts": round(time.time(), 3),
+               "progress": (existing or {}).get("progress")}
+        with self._lock:
+            self._write(doc)
+        self._q.put(job_id)
+        telemetry.counter_add("serve.jobs_submitted")
+        return doc
+
+    def status(self, job_id) -> dict | None:
+        """The job document, or None for an unknown id."""
+        try:
+            with open(self._doc_path(job_id)) as fh:
+                return json.load(fh)
+        except (OSError, ValueError):
+            return None
+
+    def stop(self, timeout=10.0):
+        self._stopped = True
+        self._q.put(None)
+        self._thread.join(timeout=timeout)
+
+    def _worker(self):
+        while True:
+            job_id = self._q.get()
+            if job_id is None or self._stopped:
+                return
+            doc = self.status(job_id)
+            if doc is None:
+                continue
+            doc["state"] = "running"
+            doc["started_ts"] = round(time.time(), 3)
+            with self._lock:
+                self._write(doc)
+
+            def _progress(d):
+                with self._lock:
+                    self._write(d)
+
+            try:
+                with telemetry.run_scope("serve.job", job=job_id,
+                                         job_kind=doc["kind"]):
+                    result = run_job(self.registry, doc, self.job_dir,
+                                     grid_chunk=self.grid_chunk,
+                                     progress=_progress)
+                doc["state"] = "done"
+                doc["result"] = result
+                telemetry.counter_add("serve.jobs_done")
+            except Exception as e:  # job failure is a document state,
+                doc["state"] = "failed"  # never a worker death
+                doc["error"] = f"{type(e).__name__}: {e}"
+                telemetry.counter_add("serve.jobs_failed")
+            doc["finished_ts"] = round(time.time(), 3)
+            with self._lock:
+                self._write(doc)
+
+
+def main(argv=None):
+    """Hidden CLI for the chaos harness: run ONE job inline in this
+    process (``python -m pint_tpu.serve.jobs JOB_DIR SPEC_JSON``) —
+    the subprocess the kill-site tests murder and restart.  The spec
+    must carry a ``par`` entry (the dataset is registered in-process).
+    Prints the final job document as JSON."""
+    import sys
+
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 2:
+        print("usage: python -m pint_tpu.serve.jobs JOB_DIR SPEC_JSON",
+              file=sys.stderr)
+        return 2
+    job_dir, spec_raw = argv
+    spec = json.loads(spec_raw)
+    from pint_tpu.serve.state import DatasetRegistry, serve_config
+
+    registry = DatasetRegistry()
+    registry.load(spec["dataset"], par=spec.pop("par"),
+                  toas=spec.pop("toas", None))
+    doc = {"job": str(spec.get("job", "chaosjob")),
+           "kind": spec.get("kind", "grid"), "state": "running",
+           "spec": spec}
+    result = run_job(registry, doc, job_dir,
+                     grid_chunk=serve_config()["grid_chunk"])
+    doc["state"] = "done"
+    doc["result"] = result
+    _atomic_write_json(os.path.join(job_dir, doc["job"] + ".json"),
+                       doc)
+    print(json.dumps(doc))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,453 @@
+"""The asyncio HTTP front door of the warm fitting service.
+
+A long-lived replica process: stdlib-only HTTP/1.1 over
+``asyncio.start_server`` (keep-alive, JSON bodies), with the
+coalescing batcher (:mod:`pint_tpu.serve.batcher`) as the data plane
+and the job store (:mod:`pint_tpu.serve.jobs`) for long work.  The
+event loop never runs device code — handlers await
+``concurrent.futures`` futures the batcher thread fulfills, so a slow
+batch stalls nothing but its own clients.
+
+Routes (all JSON):
+
+- ``POST /v1/load``        — register a dataset (par text + tim path
+  or synthetic TOA spec); control plane, allowed before readiness.
+- ``POST /v1/fit``         — coalesced batched fit (``dataset``,
+  ``maxiter``, ``values`` start overrides, ``deadline_ms``).
+- ``POST /v1/residuals``   — coalesced batched residuals.
+- ``POST /v1/lnlike``      — coalesced batched white-noise lnlike.
+- ``POST /v1/jobs``        — submit a grid/mcmc job; ``GET
+  /v1/jobs/<id>`` polls it.
+- ``GET /healthz``         — the metrics_http health document plus
+  serving state.
+- ``GET /readyz``          — 200 only after the AOT import (or an
+  explicit warmup) completed: the load-balancer gate that keeps
+  traffic off a cold replica.
+- ``GET /metrics``         — Prometheus text (same renderer as the
+  standalone metrics port; ``serve.*`` series included).
+- ``GET /v1/stats``        — the serve counters/gauges as JSON.
+
+Status discipline: 429 + Retry-After on shed, 504 on a missed
+deadline, 503 + Retry-After on shutdown or an internal failure, 400
+on a malformed request — **no handler path returns 500**, and a
+diverging fit is a 200 whose body names its guard rung.  Keeping a
+COLD replica out of rotation is the load balancer's job via
+``/readyz``; a direct request to a cold replica is served (paying
+its compiles) rather than refused, so dev loops and smoke tests need
+no warmup ceremony.
+
+Cold start: ``Server.startup()`` imports AOT-serialized executables
+(``compile_cache.import_executables``) when an export directory is
+configured, and/or runs an explicit warmup
+(:func:`pint_tpu.serve.state.warm_serve`); the ``serve.aot_warm`` and
+``serve.ready`` gauges drive ``/readyz`` (shared logic:
+:func:`pint_tpu.metrics_http.readiness`).  The export directory is
+the deploy artifact: one ``pintserve --export`` rehearsal produces
+the manifest N replicas import, each reaching its first served fit
+with zero uncached XLA backend compiles.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+import time
+
+from pint_tpu import telemetry
+from pint_tpu.serve.batcher import CoalescingBatcher
+from pint_tpu.serve.jobs import JobStore
+from pint_tpu.serve.state import (
+    DatasetRegistry,
+    ServeError,
+    serve_config,
+    size_classes,
+    warm_serve,
+)
+
+__all__ = ["Server", "cold_replica_probe"]
+
+_REASONS = {200: "OK", 400: "Bad Request", 404: "Not Found",
+            405: "Method Not Allowed", 429: "Too Many Requests",
+            503: "Service Unavailable", 504: "Gateway Timeout"}
+
+#: absolute ceiling on request bodies (a front door must bound them)
+_MAX_BODY = 8 << 20
+
+
+class Server:
+    """One replica: registry + batcher + jobs + HTTP listener."""
+
+    def __init__(self, flush_ms=None, max_batch=None, queue_max=None,
+                 deadline_ms=None, grid_chunk=None, job_dir=None,
+                 aot_dir=None):
+        cfg = serve_config(flush_ms=flush_ms, max_batch=max_batch,
+                           queue_max=queue_max,
+                           deadline_ms=deadline_ms,
+                           grid_chunk=grid_chunk)
+        self.cfg = cfg
+        self.aot_dir = aot_dir
+        self.registry = DatasetRegistry()
+        self.batcher = CoalescingBatcher(
+            flush_ms=cfg["flush_ms"], max_batch=cfg["max_batch"],
+            queue_max=cfg["queue_max"])
+        self.jobs = JobStore(self.registry, job_dir=job_dir,
+                             grid_chunk=cfg["grid_chunk"])
+        self.aot_report = None
+        self._warm = False
+        self._loop = None
+        self._aserver = None
+        self._thread = None
+        self._port = None
+        self._started = threading.Event()
+        telemetry.gauge_set("serve.ready", 0.0)
+        telemetry.gauge_set("serve.aot_warm", 0.0)
+        telemetry.gauge_set("serve.flush_ms", cfg["flush_ms"])
+        telemetry.gauge_set("serve.max_batch", cfg["max_batch"])
+        telemetry.gauge_set("serve.queue_max", cfg["queue_max"])
+
+    # -- lifecycle ----------------------------------------------------------
+    def startup(self, warm=False, warm_dataset=None, progress=None):
+        """Bring the replica to serving state: import the AOT
+        manifest when configured (counts as warm when it loads
+        executables), and/or run an explicit warmup flush sweep.
+        Idempotent; sets the ``serve.aot_warm`` readiness gauge."""
+        warmed = False
+        if self.aot_dir:
+            from pint_tpu import compile_cache as _cc
+
+            self.aot_report = _cc.import_executables(
+                self.aot_dir, progress=progress)
+            if self.aot_report.get("loaded", 0) > 0:
+                warmed = True
+        if warm:
+            ds_id = warm_dataset
+            if ds_id is None:
+                from pint_tpu.compile_cache import WARM_WLS_PAR
+
+                ds_id = "_warm"
+                if ds_id not in self.registry.ids():
+                    self.registry.load(ds_id, par=WARM_WLS_PAR,
+                                       toas={"n": 64, "seed": 0})
+            warm_serve(self.registry, ds_id, self.cfg["max_batch"],
+                       ops=("fit",), maxiter=3)
+            warmed = True
+        self.mark_warm(warmed)
+        telemetry.gauge_set("serve.ready", 1.0)
+        return self.aot_report
+
+    def mark_warm(self, warm=True):
+        """Flip the readiness gauge (``/readyz`` gates on it): a
+        replica is warm after an AOT import or an explicit warmup."""
+        self._warm = bool(warm)
+        telemetry.gauge_set("serve.aot_warm", 1.0 if warm else 0.0)
+
+    def warmup(self, dataset_id, ops=("fit",), sizes=None, maxiter=3):
+        """Explicit warmup against a registered dataset (compiles —
+        or AOT-serves — every (op, size-class) program), then marks
+        the replica warm."""
+        out = warm_serve(self.registry, dataset_id,
+                         self.cfg["max_batch"], ops=ops, sizes=sizes,
+                         maxiter=maxiter)
+        self.mark_warm(True)
+        return out
+
+    def start(self, host="127.0.0.1", port=0) -> int:
+        """Start the listener on a background thread; returns the
+        bound port (port=0 binds an ephemeral one)."""
+        if self._thread is not None:
+            return self._port
+        self._thread = threading.Thread(
+            target=self._run_loop, args=(host, int(port)),
+            name="pintserve-http", daemon=True)
+        self._thread.start()
+        if not self._started.wait(timeout=30):
+            raise RuntimeError("pintserve listener failed to start")
+        telemetry.gauge_set("serve.ready", 1.0)
+        return self._port
+
+    def _run_loop(self, host, port):
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+
+        async def _boot():
+            self._aserver = await asyncio.start_server(
+                self._handle, host, port)
+            self._port = self._aserver.sockets[0].getsockname()[1]
+            telemetry.gauge_set("serve.port", self._port)
+            self._started.set()
+
+        try:
+            self._loop.run_until_complete(_boot())
+            self._loop.run_forever()
+        finally:
+            try:
+                if self._aserver is not None:
+                    self._aserver.close()
+                    self._loop.run_until_complete(
+                        self._aserver.wait_closed())
+            finally:
+                self._loop.close()
+
+    def run(self, host="127.0.0.1", port=8470):
+        """Blocking serve (the CLI path): start + wait forever."""
+        self.start(host, port)
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            self.stop()
+
+    def stop(self):
+        """Stop listener, batcher, and job worker (idempotent — a
+        second call must be a no-op, not a closed-loop error)."""
+        loop, self._loop = self._loop, None
+        if loop is not None and not loop.is_closed():
+            try:
+                loop.call_soon_threadsafe(loop.stop)
+            except RuntimeError:
+                pass  # closed between the check and the call
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        self.batcher.stop()
+        self.jobs.stop()
+        telemetry.gauge_set("serve.ready", 0.0)
+
+    # -- HTTP plumbing ------------------------------------------------------
+    async def _handle(self, reader, writer):
+        try:
+            while True:
+                line = await reader.readline()
+                if not line or line in (b"\r\n", b"\n"):
+                    return
+                try:
+                    method, path, _ = line.decode(
+                        "latin1").split(None, 2)
+                except ValueError:
+                    return
+                headers = {}
+                while True:
+                    h = await reader.readline()
+                    if h in (b"\r\n", b"\n", b""):
+                        break
+                    k, _, v = h.decode("latin1").partition(":")
+                    headers[k.strip().lower()] = v.strip()
+                n = int(headers.get("content-length", 0) or 0)
+                if n > _MAX_BODY:
+                    return
+                body = await reader.readexactly(n) if n else b""
+                status, payload, ctype, extra = await self._route(
+                    method.upper(), path.split("?", 1)[0], body)
+                keep = headers.get("connection",
+                                   "keep-alive").lower() != "close"
+                head = [f"HTTP/1.1 {status} "
+                        f"{_REASONS.get(status, 'OK')}",
+                        f"Content-Type: {ctype}",
+                        f"Content-Length: {len(payload)}"]
+                head += [f"{k}: {v}" for k, v in extra]
+                head.append("Connection: "
+                            + ("keep-alive" if keep else "close"))
+                writer.write(("\r\n".join(head) + "\r\n\r\n")
+                             .encode() + payload)
+                await writer.drain()
+                if not keep:
+                    return
+        except (asyncio.IncompleteReadError, ConnectionError,
+                asyncio.CancelledError):
+            pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+    @staticmethod
+    def _json(status, obj, extra=()):
+        return (status, json.dumps(obj).encode(), "application/json",
+                list(extra))
+
+    def _err(self, exc: ServeError):
+        extra = []
+        if exc.retry_after_s is not None:
+            extra.append(("Retry-After",
+                          str(max(1, int(round(exc.retry_after_s
+                                               + 0.5))))))
+        body = {"error": type(exc).__name__, "detail": exc.detail}
+        if exc.retry_after_s is not None:
+            body["retry_after_ms"] = int(exc.retry_after_s * 1e3)
+        return self._json(exc.status, body, extra)
+
+    async def _route(self, method, path, body):
+        try:
+            return await self._route_inner(method, path, body)
+        except ServeError as e:
+            return self._err(e)
+        except (ValueError, KeyError, TypeError) as e:
+            return self._json(400, {"error": "BadRequest",
+                                    "detail": str(e)})
+        except Exception as e:  # noqa: BLE001 — the no-500 contract:
+            # an unexpected failure is a structured, retryable 503
+            telemetry.counter_add("serve.errors")
+            return self._err(ServeError(
+                f"{type(e).__name__}: {e}", retry_after_s=1.0))
+
+    async def _route_inner(self, method, path, body):
+        path = path.rstrip("/") or "/"
+        if method == "GET":
+            if path == "/healthz":
+                return self._json(200, self._health_doc())
+            if path == "/readyz":
+                from pint_tpu import metrics_http
+
+                ready, doc = metrics_http.readiness()
+                if ready:
+                    return self._json(200, doc)
+                return self._json(503, doc, [("Retry-After", "1")])
+            if path == "/metrics":
+                from pint_tpu import metrics_http
+
+                return (200, metrics_http.render_prometheus()
+                        .encode(),
+                        "text/plain; version=0.0.4; charset=utf-8",
+                        [])
+            if path == "/":
+                return self._json(200, {"routes": [
+                    "POST /v1/load", "POST /v1/fit",
+                    "POST /v1/residuals", "POST /v1/lnlike",
+                    "POST /v1/jobs", "GET /v1/jobs/<id>",
+                    "GET /healthz", "GET /readyz", "GET /metrics",
+                    "GET /v1/stats",
+                ]})
+            if path == "/v1/stats":
+                return self._json(200, self._stats_doc())
+            if path.startswith("/v1/jobs/"):
+                doc = self.jobs.status(path.rsplit("/", 1)[1])
+                if doc is None:
+                    return self._json(404, {"error": "NotFound"})
+                return self._json(200, doc)
+            return self._json(404, {"error": "NotFound"})
+        if method != "POST":
+            return self._json(405, {"error": "MethodNotAllowed"})
+        params = json.loads(body.decode() or "{}")
+        if path == "/v1/load":
+            loop = asyncio.get_running_loop()
+            info = await loop.run_in_executor(
+                None, lambda: self.registry.load(
+                    params.get("dataset"), params.get("par"),
+                    toas=params.get("toas"), tim=params.get("tim"),
+                    flags=params.get("flags")))
+            return self._json(200, info)
+        if path == "/v1/jobs":
+            return self._json(200, self.jobs.submit(params))
+        if path in ("/v1/fit", "/v1/residuals", "/v1/lnlike"):
+            op = path.rsplit("/", 1)[1]
+            req = self.registry.build_request(
+                op, params, self.cfg["deadline_ms"])
+            fut = self.batcher.submit(req)  # Shed -> 429 upstream
+            try:
+                result = await asyncio.wait_for(
+                    asyncio.wrap_future(fut),
+                    timeout=max(self.cfg["flush_ms"] / 1e3, 0.05)
+                    + 600.0)
+            except asyncio.TimeoutError:
+                raise ServeError("batch dispatch timed out",
+                                 retry_after_s=5.0) from None
+            return self._json(200, result)
+        return self._json(404, {"error": "NotFound"})
+
+    # -- documents ----------------------------------------------------------
+    def _health_doc(self):
+        from pint_tpu import metrics_http
+
+        ready, rdoc = metrics_http.readiness()
+        return {
+            "ready": ready,
+            "readiness": rdoc,
+            "runs": telemetry.runs_summary(),
+            "compile": telemetry.compile_stats(),
+            "serve": self._stats_doc(),
+        }
+
+    def _stats_doc(self):
+        ctr = telemetry.counters()
+        g = telemetry.gauges()
+        serve_ctr = {k: v for k, v in ctr.items()
+                     if k.startswith("serve.")}
+        serve_g = {k: v for k, v in g.items()
+                   if k.startswith(("serve.", "hist.serve."))}
+        return {
+            "config": dict(self.cfg),
+            "queue_depth": self.batcher.depth(),
+            "datasets": self.registry.ids(),
+            "size_classes": list(size_classes(self.cfg["max_batch"])),
+            "counters": serve_ctr,
+            "gauges": serve_g,
+            "aot": ({"loaded": self.aot_report.get("loaded"),
+                     "rejected": len(self.aot_report.get(
+                         "rejected", []))}
+                    if self.aot_report else None),
+        }
+
+
+def cold_replica_probe(mode, path, t_start=None, maxiter=3):
+    """The serve-layer cold-start probe (the ``cold_replica_warm_s``
+    bench child; mirrors ``compile_cache.aot_cold_start_probe``).
+
+    mode="export": boot a replica, register the standard warm
+    dataset, serve one fit over real HTTP (the dress rehearsal that
+    records every program + eager-op shape), then serialize this
+    process's executables into ``path`` — the deploy artifact.
+    mode="import": pre-load ``path``, boot a fresh replica, serve the
+    SAME first fit — the zero-uncached-compile path under test.
+    Returns a record with wall seconds, the served chi^2 (bit-exact
+    across JSON), and the compile/AOT counters."""
+    t0 = time.perf_counter()
+    telemetry.compile_stats()  # listener before any compile
+    from pint_tpu import compile_cache as _cc
+
+    _cc._auto_enable()
+    imported = {"loaded": 0, "rejected": []}
+    srv = Server(flush_ms=2.0, max_batch=1, queue_max=32,
+                 aot_dir=(path if mode == "import" else None))
+    srv.startup(warm=False)
+    if mode == "import":
+        imported = srv.aot_report or imported
+    port = srv.start(port=0)
+    try:
+        srv.registry.load("warm", par=_cc.WARM_WLS_PAR,
+                          toas={"n": 64, "seed": 0})
+        from pint_tpu.serve.client import request_json
+
+        status, resp, _ = request_json(
+            "127.0.0.1", port, "POST", "/v1/fit",
+            {"dataset": "warm", "maxiter": maxiter}, timeout=300.0)
+        if status != 200 or resp.get("status") not in ("ok",
+                                                       "degraded"):
+            raise RuntimeError(
+                f"probe fit failed: HTTP {status} {resp}")
+    finally:
+        srv.stop()
+    wall = (time.time() - t_start if t_start is not None
+            else time.perf_counter() - t0)
+    rec = {"mode": mode, "wall_s": round(wall, 3),
+           "chi2": float(resp["chi2"]),
+           "loaded": imported.get("loaded", 0),
+           "rejected": len(imported.get("rejected", []))}
+    if mode == "export":
+        out = _cc.export_executables(path)
+        rec["exported"] = len(out["exported"])
+        rec["skipped"] = len(out["skipped"])
+    cs = telemetry.compile_stats()
+    rec.update({
+        "backend_compiles": cs["backend_events"],
+        "uncached_backend_compiles": cs["uncached_backend_events"],
+        "cache_hits": cs["cache_hits"],
+        "aot_hits": cs["aot_hits"],
+        "aot_rejects": cs["aot_rejects"],
+        "monitoring": cs["source"] == "jax.monitoring",
+    })
+    return rec

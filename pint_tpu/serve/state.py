@@ -1,0 +1,701 @@
+"""Serving data plane: datasets, requests, and batched dispatch.
+
+The request lifecycle of the warm fitting service
+(:mod:`pint_tpu.serve`):
+
+1. A dataset is **registered** once (``POST /v1/load`` or
+   ``pintserve --dataset``): the par file is parsed, the TOAs are
+   padded to their geometric bucket (``compile_cache.bucket_size``,
+   64·1.25^k), the model is ``prepare()``-d and a ``Residuals`` is
+   built — all the per-pulsar host work happens HERE, never per
+   request.
+2. A **request** (fit / residuals / lnlike) references a dataset id
+   plus per-request knobs (start-value overrides, ``maxiter``, a
+   deadline).  It is assigned a **group key** — ``(op, fitter kind,
+   bucket, structure fingerprint, maxiter)`` — the identity of the
+   ONE compiled device program that can serve it.
+3. The coalescing batcher (:mod:`pint_tpu.serve.batcher`) holds
+   same-group requests up to a flush deadline, then hands the group to
+   :func:`dispatch_batch`: member count is padded up to a geometric
+   **size class** (1, 2, 4, ... ``max_batch`` — occupancy padding
+   clones the last member, results sliced off), the cached prepared
+   pairs are stacked into a :class:`~pint_tpu.parallel.pta.PTABatch`
+   via ``from_prepared`` (no re-prepare), and ONE batched device call
+   serves every member.  Per-member results are bit-identical to a
+   batch-of-1 fit of the same request (the vmapped program computes
+   members independently), so coalescing is invisible to clients.
+
+Bounded compile surface: the only device programs this layer ever
+builds are the existing PTA-batch registry keys (``pta.batched_fit``,
+``pta.chisq``, ``pta.resid``) at (bucket x size-class x structure)
+points — quantized on BOTH data axes, so a warm replica (or an
+AOT-import manifest) covers the whole request space with a handful of
+executables and a served flush after the first performs zero new XLA
+compiles.  Every ``PINT_TPU_SERVE_*`` knob is host-only by
+construction (enforced by ``tools/check_jit_gates.py``).
+
+Degradation contract: a member that trips the guard ladder is served
+at its rung (``status="degraded"``, the rung named); a member that
+diverges past every rung — or carries fault-injected data — gets
+``status="diverged"`` with its health record while its batch-mates
+are served normally (the per-pulsar ladder merge of
+``PTABatch._run_batched``).  No request outcome is ever a 500.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import os
+import threading
+import time
+
+import numpy as np
+
+from pint_tpu import telemetry
+
+__all__ = [
+    "ServeError", "Shed", "DeadlineMiss",
+    "Dataset", "DatasetRegistry", "Request",
+    "serve_config", "size_classes", "size_class_for",
+    "dispatch_batch", "warm_serve", "clear_batch_cache",
+    "FLUSH_MS_ENV", "MAX_BATCH_ENV", "QUEUE_MAX_ENV", "DEADLINE_MS_ENV",
+    "GRID_CHUNK_ENV", "PORT_ENV", "HOST_ENV", "JOB_DIR_ENV",
+    "AOT_DIR_ENV",
+]
+
+# host-only knobs (tools/check_jit_gates.py HOST_ONLY): none of these
+# may change a traced program — the batcher's compiled surface is the
+# existing PTA-batch keys, quantized by bucket and size class
+FLUSH_MS_ENV = "PINT_TPU_SERVE_FLUSH_MS"
+MAX_BATCH_ENV = "PINT_TPU_SERVE_MAX_BATCH"
+QUEUE_MAX_ENV = "PINT_TPU_SERVE_QUEUE_MAX"
+DEADLINE_MS_ENV = "PINT_TPU_SERVE_DEADLINE_MS"
+GRID_CHUNK_ENV = "PINT_TPU_SERVE_GRID_CHUNK"
+PORT_ENV = "PINT_TPU_SERVE_PORT"
+HOST_ENV = "PINT_TPU_SERVE_HOST"
+JOB_DIR_ENV = "PINT_TPU_SERVE_JOB_DIR"
+AOT_DIR_ENV = "PINT_TPU_SERVE_AOT_DIR"
+
+#: residual payloads are capped (a 10k-TOA dataset must not ship a
+#: megabyte of JSON per request); the full array stays device-side
+RESID_PAYLOAD_CAP = 256
+
+
+def _env_num(name, default):
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+def serve_config(**overrides) -> dict:
+    """The serving knobs: env defaults overlaid with explicit
+    (non-None) overrides — the one place the ``PINT_TPU_SERVE_*``
+    defaults live."""
+    cfg = {
+        "flush_ms": _env_num(FLUSH_MS_ENV, 5.0),
+        "max_batch": int(_env_num(MAX_BATCH_ENV, 8)),
+        "queue_max": int(_env_num(QUEUE_MAX_ENV, 64)),
+        "deadline_ms": _env_num(DEADLINE_MS_ENV, 0.0),
+        "grid_chunk": int(_env_num(GRID_CHUNK_ENV, 16)),
+    }
+    cfg.update({k: v for k, v in overrides.items() if v is not None})
+    return cfg
+
+
+# --------------------------------------------------------------------------
+# structured request outcomes (never a 500)
+# --------------------------------------------------------------------------
+
+class ServeError(Exception):
+    """A structured serving refusal: carries the HTTP status and an
+    optional Retry-After hint.  Every error path of the service maps
+    to one of these — an unexpected exception becomes the base class
+    (503), never a 500."""
+
+    status = 503
+
+    def __init__(self, detail, retry_after_s=None):
+        self.detail = str(detail)
+        self.retry_after_s = retry_after_s
+        super().__init__(self.detail)
+
+
+class Shed(ServeError):
+    """Admission control refused the request (queue saturated):
+    429 + Retry-After."""
+
+    status = 429
+
+
+class DeadlineMiss(ServeError):
+    """The request's deadline expired before its batch dispatched:
+    504 (the work was never started — safe to retry)."""
+
+    status = 504
+
+
+# --------------------------------------------------------------------------
+# size classes: quantized batch occupancy
+# --------------------------------------------------------------------------
+
+def size_classes(max_batch) -> tuple:
+    """Geometric member-count classes (1, 2, 4, ... max_batch): the
+    pulsar-axis analogue of the TOA buckets.  Each (bucket, class)
+    pair is ONE compiled program; occupancy padding clones the last
+    member up to the class size so batch occupancy can vary without
+    minting new executables."""
+    out = []
+    c = 1
+    while c < int(max_batch):
+        out.append(c)
+        c *= 2
+    out.append(int(max_batch))
+    return tuple(out)
+
+
+def size_class_for(n, max_batch) -> int:
+    """Smallest size class >= n (n above max_batch is the caller's
+    bug — the batcher never pops more than max_batch)."""
+    for c in size_classes(max_batch):
+        if n <= c:
+            return c
+    raise ValueError(f"batch of {n} exceeds max_batch={max_batch}")
+
+
+# --------------------------------------------------------------------------
+# datasets
+# --------------------------------------------------------------------------
+
+_dataset_tokens = iter(range(1, 1 << 62))
+
+
+class Dataset:
+    """One registered pulsar dataset: the prepared, bucket-padded
+    (model, toas) pair every request against this id reuses.  The
+    registry values/meta are snapshotted so fit write-backs can be
+    rolled back after every flush — served datasets are immutable.
+    ``token`` is process-unique (keys the stacked-batch cache; a
+    reloaded dataset gets a fresh token, so stale stacks can never be
+    served)."""
+
+    __slots__ = ("dataset_id", "model", "toas", "prepared", "resid",
+                 "bucket", "n_real", "kind", "structure", "token",
+                 "noise_owned", "_values_snapshot", "_rung_snapshot")
+
+    def __init__(self, dataset_id, model, toas):
+        from pint_tpu import compile_cache as _cc
+        from pint_tpu.residuals import Residuals
+
+        self.dataset_id = str(dataset_id)
+        self.n_real = len(toas)
+        toas = _cc.pad_toas(toas)
+        self.model = model
+        self.toas = toas
+        self.bucket = len(toas)
+        self.prepared = model.prepare(toas)
+        self.resid = Residuals(toas, self.prepared,
+                               track_mode="nearest")
+        self.kind = "gls" if model.has_correlated_errors else "wls"
+        # the group fingerprint: component structure + the exact
+        # free-parameter set (the PTA batch free-union must be stable
+        # across flush compositions) + the bucket
+        self.structure = _cc.fingerprint((
+            _cc.model_structure_key(model),
+            tuple(model.free_params), self.bucket))
+        self.noise_owned = {
+            par.name for c in model.noise_components
+            for par in c.params}
+        self.token = next(_dataset_tokens)
+        self._values_snapshot = dict(model.values)
+        self._rung_snapshot = model.meta.get("GUARD_RUNG")
+
+    def restore(self):
+        """Roll the model back to its registry state (values + guard
+        rung flag) after a flush's write-back."""
+        self.model.values.clear()
+        self.model.values.update(self._values_snapshot)
+        if self._rung_snapshot is None:
+            self.model.meta.pop("GUARD_RUNG", None)
+        else:
+            self.model.meta["GUARD_RUNG"] = self._rung_snapshot
+
+    def info(self) -> dict:
+        return {"dataset": self.dataset_id, "n_toas": self.n_real,
+                "bucket": self.bucket, "kind": self.kind,
+                "free_params": list(self.model.free_params),
+                "structure": self.structure}
+
+
+#: synthetic-TOA spec defaults for /v1/load without a tim file
+_TOA_SPEC_DEFAULTS = {
+    "n": 64, "start_mjd": 53000.0, "duration_days": 1500.0,
+    "freq_mhz": 1400.0, "obs": "gbt", "error_us": 1.0, "seed": 0,
+    "add_noise": True,
+}
+
+
+class DatasetRegistry:
+    """id -> :class:`Dataset`; the control plane the data plane serves
+    from.  Registration is the expensive host-side work (parse,
+    prepare, pad) and happens outside the request hot path.
+
+    ``generation`` increments on every (re)load — it keys the stacked
+    batch cache, so replacing a dataset can never serve a stale
+    stack."""
+
+    def __init__(self):
+        self._datasets: dict = {}
+        self.generation = 0
+
+    def load(self, dataset_id, par, toas=None, tim=None,
+             flags=None) -> dict:
+        """Register a dataset: ``par`` is par-file text; the TOAs come
+        from ``tim`` (a server-local ``.tim`` path) or a synthetic
+        spec dict (``{"n", "start_mjd", "duration_days", "error_us",
+        "freq_mhz", "obs", "seed", "add_noise"}``; missing keys
+        default).  Returns the dataset info dict.  Re-registering an
+        id replaces it."""
+        from pint_tpu.models.builder import get_model
+
+        model = get_model(par)
+        if tim is not None:
+            from pint_tpu.toa import get_TOAs
+
+            toas_obj = get_TOAs(tim)
+        else:
+            from pint_tpu.simulation import make_fake_toas_uniform
+
+            spec = dict(_TOA_SPEC_DEFAULTS)
+            spec.update(toas or {})
+            toas_obj = make_fake_toas_uniform(
+                float(spec["start_mjd"]),
+                float(spec["start_mjd"]) + float(spec["duration_days"]),
+                int(spec["n"]), model,
+                freq_mhz=float(spec["freq_mhz"]),
+                obs=str(spec["obs"]),
+                error_us=float(spec["error_us"]),
+                add_noise=bool(spec["add_noise"]),
+                rng=np.random.default_rng(int(spec["seed"])),
+                flags=flags)
+        ds = Dataset(dataset_id, model, toas_obj)
+        self._datasets[ds.dataset_id] = ds
+        self.generation += 1
+        telemetry.counter_add("serve.datasets_loaded")
+        telemetry.gauge_set("serve.datasets", len(self._datasets))
+        return ds.info()
+
+    def get(self, dataset_id) -> Dataset:
+        try:
+            return self._datasets[str(dataset_id)]
+        except KeyError:
+            raise ValueError(
+                f"unknown dataset {dataset_id!r} (register it via "
+                "/v1/load first)") from None
+
+    def ids(self):
+        return sorted(self._datasets)
+
+    def build_request(self, op, params, default_deadline_ms=0.0
+                      ) -> "Request":
+        """Validate one request body into a :class:`Request` (raises
+        ValueError on a malformed request — the 400 path)."""
+        if op not in ("fit", "residuals", "lnlike"):
+            raise ValueError(f"unknown op {op!r}")
+        if not isinstance(params, dict):
+            raise ValueError("request body must be a JSON object")
+        ds = self.get(params.get("dataset"))
+        maxiter = int(params.get("maxiter", 3)) if op == "fit" else 0
+        if op == "fit" and not 1 <= maxiter <= 50:
+            raise ValueError(f"maxiter {maxiter} out of range [1, 50]")
+        overrides = params.get("values") or {}
+        if not isinstance(overrides, dict):
+            raise ValueError("'values' must be an object")
+        for name, v in overrides.items():
+            if name not in ds.model.values:
+                raise ValueError(
+                    f"override {name!r} is not a parameter of "
+                    f"dataset {ds.dataset_id!r}")
+            if name in ds.noise_owned:
+                raise ValueError(
+                    f"override {name!r} is a noise-model parameter — "
+                    "the GLS basis/weights are gathered at registry "
+                    "values (the chisq_grid restriction)")
+            float(v)  # must be numeric
+        deadline_ms = float(params.get("deadline_ms",
+                                       default_deadline_ms) or 0.0)
+        deadline = (time.time() + deadline_ms / 1e3
+                    if deadline_ms > 0 else None)
+        return Request(op, ds, params, maxiter, deadline)
+
+
+class Request:
+    """One in-flight request: its dataset, knobs, coalescing group
+    key, and the future its response lands on."""
+
+    __slots__ = ("op", "dataset", "params", "maxiter", "deadline",
+                 "group_key", "future", "t_submit", "t_enqueue")
+
+    def __init__(self, op, dataset, params, maxiter, deadline):
+        self.op = op
+        self.dataset = dataset
+        self.params = params
+        self.maxiter = maxiter
+        self.deadline = deadline
+        self.group_key = (op, dataset.kind, dataset.bucket,
+                          dataset.structure, maxiter)
+        self.future = concurrent.futures.Future()
+        self.t_submit = time.perf_counter()
+        self.t_enqueue = None
+
+
+# --------------------------------------------------------------------------
+# batched dispatch: the device hot path
+# --------------------------------------------------------------------------
+
+def _finish_error(req, exc):
+    if not req.future.set_running_or_notify_cancel():
+        return
+    req.future.set_exception(exc)
+
+
+#: stacked-batch LRU: the serving hot path's memoization.  One entry
+#: per (ordered member-token tuple) — a steady request mix re-serves
+#: the same hot member combinations, so the per-flush stacking cost
+#:(~3 ms/member of eager device puts) collapses to a dict hit.
+#: Entries hold pristine (values0, base_values) refs so per-request
+#: overrides (which REPLACE those attributes) roll back on the next
+#: hit.  Mutated only under :data:`SERVING_LOCK`.  Skipped entirely
+#: while fault injection is active: a corrupt stack must neither be
+#: cached nor masked by a clean cached one.
+_batch_cache: "dict" = {}
+_BATCH_CACHE_CAP = 64
+
+#: serializes every touch of the shared serving state — the stacked
+#: batch cache, the cached PTABatch objects, and the registry models'
+#: write-back/rollback window.  Normally only the batcher thread
+#: dispatches, but explicit warmup (:func:`warm_serve` from the boot
+#: thread, possibly while the listener already accepts requests) and
+#: the jobs worker (which snapshots a dataset's model for its own
+#: isolated copy) must not observe — or tear — a flush in progress.
+SERVING_LOCK = threading.RLock()
+
+
+def clear_batch_cache():
+    _batch_cache.clear()
+
+
+def _stacked_batch(sorted_datasets):
+    from pint_tpu import faults as _faults
+    from pint_tpu.parallel.pta import PTABatch
+
+    if _faults.any_active():
+        return PTABatch.from_prepared(
+            [d.prepared for d in sorted_datasets],
+            [d.resid for d in sorted_datasets])
+    key = tuple(d.token for d in sorted_datasets)
+    got = _batch_cache.get(key)
+    if got is not None:
+        batch, pristine = got
+        batch.values0, batch.base_values = pristine
+        telemetry.counter_add("serve.batch_cache_hits")
+        return batch
+    batch = PTABatch.from_prepared(
+        [d.prepared for d in sorted_datasets],
+        [d.resid for d in sorted_datasets])
+    _batch_cache[key] = (batch, (batch.values0, batch.base_values))
+    while len(_batch_cache) > _BATCH_CACHE_CAP:
+        del _batch_cache[next(iter(_batch_cache))]
+    telemetry.counter_add("serve.batch_cache_misses")
+    return batch
+
+
+def _apply_overrides(batch, members, rows):
+    """Patch per-request start-value overrides into the stacked
+    ``values0`` / ``base_values`` rows (never into the shared model
+    objects — two requests on one dataset may override differently
+    inside one flush).  ``rows[k]`` is member k's stacked row."""
+    import jax.numpy as jnp
+
+    if not any(m.params.get("values") for m in members):
+        return
+    v0 = np.asarray(batch.values0).copy()
+    base = dict(batch.base_values)
+    patched = {}
+    for k, m in enumerate(members):
+        for name, val in (m.params.get("values") or {}).items():
+            val = float(val)
+            if name in batch.free_names:
+                v0[rows[k], batch.free_names.index(name)] = val
+            if name in base:
+                arr = patched.get(name)
+                if arr is None:
+                    arr = patched[name] = np.asarray(base[name]).copy()
+                arr[rows[k]] = val
+    batch.values0 = jnp.asarray(v0)
+    for name, arr in patched.items():
+        base[name] = jnp.asarray(arr)
+    batch.base_values = base
+
+
+def _health_slice(health, k):
+    """Member k's rows of a batched host-side health record dict."""
+    return {name: (v[k] if isinstance(v, list) and k < len(v) else v)
+            for name, v in (health or {}).items()}
+
+
+def _member_values(batch, vec_np, k, ds):
+    """The fitted values a member's response reports: the dataset's
+    OWN free parameters (the union may be wider on a mixed group)."""
+    own = set(ds.model.free_params)
+    return {name: float(vec_np[k, i])
+            for i, name in enumerate(batch.free_names) if name in own}
+
+
+def _run_fit(batch, live, rows, maxiter):
+    """The batched fit plus per-member outcome assembly.  A
+    FitDivergedError is the PER-MEMBER degradation path here, never a
+    request failure: healthy members are served from the partial
+    results the error carries."""
+    from pint_tpu import guard as _guard
+
+    kind_fit = (batch.fit_gls
+                if batch.prepareds[0].model.has_correlated_errors
+                else batch.fit_wls)
+    bad, health = set(), {}
+    try:
+        vec, chi2, cov = kind_fit(maxiter=maxiter)
+    except _guard.FitDivergedError as e:
+        if e.results is None:
+            raise
+        vec, chi2, cov = e.results
+        bad = set(int(i) for i in (e.bad_indices or ()))
+        health = e.health or {}
+    vec_np = np.asarray(vec)
+    chi2_np = np.asarray(chi2)
+    # per-ROW rung readout (batch.fit_rungs), never model.meta: with
+    # dedup/occupancy padding one model may occupy several rows, and
+    # its shared meta dict would report the LAST row's rung for all
+    rungs = getattr(batch, "fit_rungs", {})
+    out = []
+    for k, req in enumerate(live):
+        row = rows[k]
+        rung = rungs.get(row)
+        if row in bad:
+            telemetry.counter_add("serve.diverged")
+            out.append({
+                "status": "diverged",
+                "rung": rung,
+                "detail": "fit diverged past every guard rung; "
+                          "values unchanged",
+                "health": _health_slice(health, row),
+            })
+            continue
+        if rung is not None:
+            telemetry.counter_add("serve.degraded")
+        out.append({
+            "status": "degraded" if rung else "ok",
+            "rung": rung,
+            "chi2": float(chi2_np[row]),
+            "values": _member_values(batch, vec_np, row, req.dataset),
+        })
+    return out
+
+
+def _run_residuals(batch, live, rows):
+    r = batch.residuals_shared()
+    out = []
+    for k, req in enumerate(live):
+        n = req.dataset.n_real
+        row = np.asarray(r[rows[k], :n], dtype=np.float64)
+        rec = {"status": "ok", "n": int(n),
+               "rms_s": float(np.sqrt(np.mean(row ** 2)))}
+        if n <= RESID_PAYLOAD_CAP:
+            rec["resid_s"] = [float(x) for x in row]
+        else:
+            rec["resid_s_truncated"] = RESID_PAYLOAD_CAP
+            rec["resid_s"] = [float(x)
+                              for x in row[:RESID_PAYLOAD_CAP]]
+        out.append(rec)
+    return out
+
+
+def _run_lnlike(batch, live, rows):
+    chi2 = batch.chisq()
+    return [{"status": "ok", "chi2": float(chi2[rows[k]]),
+             "lnlike": -0.5 * float(chi2[rows[k]])}
+            for k in range(len(live))]
+
+
+def dispatch_batch(group_key, reqs, max_batch):
+    """Serve one coalesced group as ONE batched device call.
+
+    The batcher's flush handler: drops deadline-expired members
+    (504), pads the member count to a size class, stacks the cached
+    prepared pairs (``PTABatch.from_prepared`` — no re-prepare),
+    applies per-request value overrides into the stacked rows, runs
+    the op's shared program, and fulfills every member's future with
+    a structured outcome.  Model write-backs are rolled back before
+    returning, so served datasets stay immutable.
+
+    Also the chaos kill site ``serve.flush``: a deterministic
+    mid-batch kill (``PINT_TPU_FAULTS=kill:site=serve.flush``)
+    exercises the restart/resubmit story."""
+    from pint_tpu import faults as _faults
+
+    _faults.maybe_kill("serve.flush")
+    op = group_key[0]
+    now = time.time()
+    live = []
+    for r in reqs:
+        if r.deadline is not None and now > r.deadline:
+            telemetry.counter_add("serve.deadline_misses")
+            _finish_error(r, DeadlineMiss(
+                "deadline expired before the batch dispatched"))
+        else:
+            live.append(r)
+    if not live:
+        return
+    t_build0 = time.perf_counter()
+    # request dedup: same-dataset requests with identical value
+    # overrides are the SAME computation — they share one stacked row
+    # (and therefore one slice of device work), and a hot-dataset
+    # burst collapses to a small batch.  Dedup also shrinks the
+    # member-combination space from multisets to subsets, so the
+    # stacked-batch cache reaches steady-state hits within a few
+    # flushes even on a mixed stream.
+    unique: dict = {}
+    uniq = []
+    req_uniq = []
+    for r in live:
+        ov = r.params.get("values") or {}
+        okey = (r.dataset.token,
+                tuple(sorted((n, float(v)) for n, v in ov.items())))
+        idx = unique.get(okey)
+        if idx is None:
+            idx = unique[okey] = len(uniq)
+            uniq.append(r)
+        req_uniq.append(idx)
+    if len(uniq) < len(live):
+        telemetry.counter_add("serve.deduped",
+                              float(len(live) - len(uniq)))
+    size = size_class_for(len(uniq), max_batch)
+    members = uniq + [uniq[-1]] * (size - len(uniq))
+    datasets = {id(m.dataset): m.dataset for m in members}
+    # canonical member order (by dataset id): flush composition
+    # becomes order-insensitive, so the stacked-batch cache hits on
+    # any permutation of a hot member set
+    order = sorted(range(size),
+                   key=lambda k: (members[k].dataset.dataset_id, k))
+    member_rows = [0] * size
+    for rank, k in enumerate(order):
+        member_rows[k] = rank
+    rows = [member_rows[i] for i in req_uniq]
+    with SERVING_LOCK:
+        try:
+            batch = _stacked_batch(
+                [members[k].dataset for k in order])
+            _apply_overrides(batch, members, member_rows)
+            build_s = time.perf_counter() - t_build0
+            with telemetry.run_scope(
+                    "serve.batch", op=op, bucket=group_key[2],
+                    occupancy=len(live), unique=len(uniq),
+                    size=size) as run:
+                batch_run = run.run_id
+                t_dev0 = time.perf_counter()
+                if op == "fit":
+                    results = _run_fit(batch, live, rows,
+                                       group_key[4])
+                elif op == "residuals":
+                    results = _run_residuals(batch, live, rows)
+                else:
+                    results = _run_lnlike(batch, live, rows)
+                device_s = time.perf_counter() - t_dev0
+        finally:
+            for ds in datasets.values():
+                ds.restore()
+    telemetry.counter_add("serve.batches")
+    if len(live) > 1:
+        telemetry.counter_add("serve.coalesced", float(len(live) - 1))
+    telemetry.hist_record("serve.batch_occupancy", float(len(live)))
+    total_req = telemetry.counter_get("serve.requests")
+    if total_req:
+        telemetry.gauge_set(
+            "serve.coalesce_ratio",
+            telemetry.counter_get("serve.coalesced") / total_req)
+    t_done = time.perf_counter()
+    dev_share = device_s / len(live)
+    build_share = build_s / len(live)
+    for k, req in enumerate(live):
+        rec = dict(results[k])
+        queue_s = (t_build0 - req.t_enqueue
+                   if req.t_enqueue is not None else 0.0)
+        wall_s = t_done - req.t_submit
+        rec["batch"] = {"run": batch_run, "occupancy": len(live),
+                        "unique": len(uniq), "size": size,
+                        "bucket": group_key[2]}
+        rec["phase_s"] = {"queue": round(queue_s, 6),
+                          "build": round(build_share, 6),
+                          "device": round(dev_share, 6),
+                          "total": round(wall_s, 6)}
+        # one ledger record per request, joined to the batch's run id
+        # (which owns the compile/phase attribution) — `pinttrace`
+        # shows request rows whose wall is device-dominated at
+        # healthy occupancy.  A full run_scope per request would cost
+        # two lock+emit round-trips at serving rates; the batch-level
+        # scope already carries the run semantics.
+        if telemetry.sink_active():
+            telemetry.emit({"type": "serve_request", "op": op,
+                            "run": batch_run,
+                            "dataset": req.dataset.dataset_id,
+                            "status": rec.get("status"),
+                            "queue_s": round(queue_s, 6),
+                            "device_s": round(dev_share, 6),
+                            "wall_s": round(wall_s, 6)})
+        telemetry.hist_record("serve.queue_s", max(queue_s, 0.0))
+        telemetry.hist_record("serve.device_s", dev_share)
+        telemetry.hist_record("serve.wall_s", wall_s)
+        if req.future.set_running_or_notify_cancel():
+            req.future.set_result(rec)
+
+
+def warm_serve(registry, dataset_id, max_batch, ops=("fit",),
+               sizes=None, maxiter=3):
+    """Explicit warmup: run one synchronous flush per (op, size
+    class) against a registered dataset, compiling (or AOT-serving)
+    every program the configured request space can reach.  The
+    export rehearsal (``pintserve --export``) and a replica booted
+    with ``--warm`` both run this; a cold replica that imported an
+    AOT manifest instead reaches the same state with zero uncached
+    compiles.  Returns per-program records."""
+    out = []
+    classes = sizes if sizes is not None else size_classes(max_batch)
+    ds = registry.get(dataset_id)
+    # distinct per-member start-value jitter: without it the dedup
+    # pass would collapse c identical warm requests into ONE stacked
+    # row and the size-c program would never build.  The jitter is
+    # dynamic data (same program), far below fit precision, and the
+    # warm results are discarded anyway.
+    jit_name = ds.model.free_params[0]
+    jit_base = float(ds.model.values[jit_name])
+    for op in ops:
+        for c in classes:
+            reqs = [registry.build_request(
+                op, {"dataset": dataset_id, "maxiter": maxiter,
+                     "values": {jit_name: jit_base
+                                + (abs(jit_base) + 1.0)
+                                * 1e-13 * i}})
+                for i in range(c)]
+            for r in reqs:
+                r.t_enqueue = time.perf_counter()
+            t0 = time.perf_counter()
+            dispatch_batch(reqs[0].group_key, reqs, max_batch)
+            for r in reqs:
+                r.future.result()  # surface warmup failures loudly
+            out.append({"op": op, "size": c,
+                        "wall_s": round(time.perf_counter() - t0, 3)})
+    telemetry.counter_add("serve.warm_flushes", float(len(out)))
+    return out

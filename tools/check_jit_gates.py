@@ -210,6 +210,25 @@ HOST_ONLY = {
     # bucketing pads the DATASET host-side; the padded shape reaches
     # the key through the avals/structure, not through the gate
     "PINT_TPU_BUCKET_TOAS",
+    # the warm fitting service (pint_tpu/serve/): every knob is
+    # host-only BY DESIGN — the batcher must never create traced
+    # programs beyond the existing PTA-batch registry keys
+    # (pta.batched_fit / pta.chisq / pta.resid), whose identities are
+    # carried by bucket, size class, structure, and maxiter through
+    # the ordinary aval/key machinery.  Flush cadence, queue bounds,
+    # deadlines, ports, and directories shape WHEN and HOW MANY
+    # requests share a program, never the program itself
+    # (tests/test_serve.py asserts the zero-new-compile contract on a
+    # repeated same-bucket flush).
+    "PINT_TPU_SERVE_FLUSH_MS", "PINT_TPU_SERVE_MAX_BATCH",
+    "PINT_TPU_SERVE_QUEUE_MAX", "PINT_TPU_SERVE_DEADLINE_MS",
+    "PINT_TPU_SERVE_GRID_CHUNK", "PINT_TPU_SERVE_PORT",
+    "PINT_TPU_SERVE_HOST", "PINT_TPU_SERVE_JOB_DIR",
+    "PINT_TPU_SERVE_AOT_DIR",
+    # the token the regex extracts from the docstring wildcard
+    # spelling ``PINT_TPU_SERVE_*`` (prose about the family, not a
+    # variable); every real member is enumerated above
+    "PINT_TPU_SERVE_",
 }
 
 _ENV_RE = re.compile(r"PINT_TPU_[A-Z0-9_]+")
