@@ -253,6 +253,24 @@ def datacheck_report(ephem="builtin", sites=("gbt", "ao", "jb", "pks",
         f"deadline {scfg['deadline_ms']:g}ms, grid chunk "
         f"{scfg['grid_chunk']} ($PINT_TPU_SERVE_*; docs/serving.md)")
 
+    # -- trace-safety: recompile sanitizer state ------------------------------
+    from pint_tpu.lint import sanitizer as _san
+
+    sst = _san.stats()
+    if sst["mode"] == "off":
+        lines.append(
+            "Recompile sanitizer: off "
+            "($PINT_TPU_RECOMPILE_SANITIZER=warn|raise; docs/lint.md; "
+            "--lint runs the smoke)")
+    else:
+        lines.append(
+            f"Recompile sanitizer: {sst['mode']}"
+            + (f", ARMED ({sst['armed_note']})" if sst["armed"]
+               else ", unarmed")
+            + f" — {sst['compiles']} attributed compile(s), "
+              f"{sst['violations']} violation(s) "
+              f"({sst['same_shape_recompiles']} same-shape)")
+
     # -- structure-aware hot path: design partition + hybrid smoke ------------
     lines.extend(_design_section())
 
@@ -567,7 +585,11 @@ def _mesh_section():
         shard = _mesh.RowShard(tmesh)
         import jax
 
+        # pintlint: allow=PTL101 -- one-shot diagnostic comparing a
+        # plain vs TOA-sharded trace; polluting the registry with
+        # throwaway smoke programs would skew its stats
         c_plain = jax.jit(woodbury_chi2_logdet)(r, sigma, U, phi)
+        # pintlint: allow=PTL101 -- same one-shot diagnostic, sharded arm
         c_shard = jax.jit(
             lambda *a: woodbury_chi2_logdet(*a, toa=shard))(
             r, sigma, U, phi)
@@ -861,6 +883,100 @@ def _runs_section():
         except OSError:
             pass
     return lines
+
+
+def _lint_section():
+    """Trace-safety smoke (--lint): the static analyzer over the
+    source tree this installation was loaded from (skipped when the
+    docs/ tree is absent — an installed wheel), then the runtime
+    recompile sanitizer exercised both ways: a warm armed fit must
+    pass, and a forced same-shape recompile (registry cleared, same
+    fit repeated) must be caught and attributed.  Diagnostic:
+    reports, never raises."""
+    lines = ["Trace safety (--lint):"]
+    try:
+        import numpy as np
+
+        from pint_tpu import compile_cache
+        from pint_tpu.compile_cache import WARM_WLS_PAR
+        from pint_tpu.fitter import WLSFitter
+        from pint_tpu.lint import sanitizer, static
+        from pint_tpu.models.builder import get_model
+        from pint_tpu.simulation import make_fake_toas_uniform
+
+        # -- static half ------------------------------------------------
+        root = static.repo_root()
+        if os.path.isdir(os.path.join(root, "docs")):
+            findings, notes = static.run(root)
+            lines.append(
+                f"  static analyzer: {len(static.RULES)} rules, "
+                f"{len(notes)} key-site tokens verified, "
+                f"{len(findings)} finding(s) -> "
+                + ("OK" if not findings else "PROBLEM"))
+            for f in findings[:5]:
+                lines.append(f"    {f.file}:{f.line}: {f.rule} "
+                             f"{f.message}")
+        else:
+            lines.append("  static analyzer: skipped (no source "
+                         "tree next to this installation; run "
+                         "pintlint from a checkout)")
+
+        # -- runtime half -----------------------------------------------
+        model = get_model(WARM_WLS_PAR)
+        toas = make_fake_toas_uniform(
+            53000.0, 54000.0, 60, model, freq_mhz=1400.0, obs="gbt",
+            error_us=1.0, add_noise=True,
+            rng=np.random.default_rng(0))
+        # seed under an ACTIVE (unarmed) sanitizer from a cleared
+        # registry: the cold compiles record their arg-spec
+        # fingerprints (benign kind 'first'), so the forced recompile
+        # below classifies as the real same_shape_recompile — without
+        # this the seeding compiles are invisible and the smoke could
+        # only ever demonstrate the weaker armed-'first' path
+        prev_mode = sanitizer.mode()
+        compile_cache.clear_registry()
+        sanitizer.configure("warn")
+        try:
+            WLSFitter(toas, model).fit_toas(maxiter=3)  # ensure warm
+        finally:
+            sanitizer.configure(prev_mode)
+        v0 = int(_tel_counter("sanitizer.violations"))
+        with sanitizer.sanitized(mode="raise"):
+            WLSFitter(toas, get_model(WARM_WLS_PAR)).fit_toas(
+                maxiter=3)
+        lines.append("  warm fit under armed raise-mode sanitizer: "
+                     "no violation -> OK")
+        compile_cache.clear_registry()
+        caught = None
+        try:
+            with sanitizer.sanitized(mode="raise"):
+                WLSFitter(toas, get_model(WARM_WLS_PAR)).fit_toas(
+                    maxiter=3)
+        except sanitizer.RecompileError as e:
+            caught = str(e)
+        if caught:
+            last = (sanitizer.ledger() or [{}])[-1]
+            lines.append(
+                "  forced recompile (registry cleared): caught, "
+                f"attributed to {last.get('program', '?')} "
+                f"(kind {last.get('kind', '?')}) -> OK")
+        else:
+            lines.append("  forced recompile: NOT caught "
+                         "-> PROBLEM (is jax.monitoring available? "
+                         f"listener={sanitizer.stats()['listener']})")
+        dv = int(_tel_counter("sanitizer.violations")) - v0
+        lines.append(f"  sanitizer counters: +{dv} violation(s) "
+                     f"during the smoke, ledger depth "
+                     f"{sanitizer.stats()['ledger_len']}")
+    except Exception as e:  # diagnostic must never take the report down
+        lines.append(f"  ERROR {type(e).__name__}: {e}")
+    return lines
+
+
+def _tel_counter(name):
+    from pint_tpu import telemetry
+
+    return telemetry.counter_get(name)
 
 
 def _serve_section():
@@ -1201,6 +1317,12 @@ def main(argv=None):
                         "temp trace sink must reconstruct with >= 4 "
                         "record types joined by run_id, and its "
                         "per-iteration convergence table renders")
+    p.add_argument("--lint", action="store_true",
+                   help="run the trace-safety smoke: the pintlint "
+                        "static analyzer over the source tree, a "
+                        "warm fit under the armed recompile "
+                        "sanitizer, and a forced same-shape "
+                        "recompile that must be caught + attributed")
     p.add_argument("--aot-child", nargs=2, metavar=("MODE", "DIR"),
                    default=None, help=argparse.SUPPRESS)
     args = p.parse_args(argv)
@@ -1228,6 +1350,12 @@ def main(argv=None):
             print(line)
     if args.aot:
         for line in _aot_section():
+            print(line)
+    # last among the smokes: the forced-recompile drill clears the
+    # shared-jit registry, which would make every later section
+    # re-trace its programs and skew the hit/miss counters it reports
+    if args.lint:
+        for line in _lint_section():
             print(line)
     if args.warm:
         from pint_tpu import compile_cache

@@ -186,6 +186,9 @@ def backend_f64_is_ieee(backend=None):
         err = (a - (s - bb)) + (b - bb)  # Knuth two_sum error term
         return s, err
 
+    # pintlint: allow=PTL101 -- backend-pinned precision probe: the
+    # explicit backend= targeting has no shared_jit equivalent, and
+    # the probe must run on the device under test, not the default
     jprobe = jax.jit(probe, backend=backend)
     # pairs whose exact sum needs > 53 bits: the error term is nonzero
     # under IEEE and must reconstruct the exact value

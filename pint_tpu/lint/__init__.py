@@ -1,0 +1,32 @@
+"""pintlint: trace-safety analysis for the shared-jit architecture.
+
+Two halves, one contract.  Everything fast in this repo rests on
+traced programs being *shared* — one executable per (structure key x
+gate state x mesh layout), reused across fitters, requests, and
+processes.  The static half (:mod:`pint_tpu.lint.static`, the
+``pintlint`` CLI) proves at review time that source code cannot break
+that contract silently: gates ride their keys, nothing bypasses the
+registry, traced functions stay free of host reads, telemetry names
+stay documented.  The runtime half (:mod:`pint_tpu.lint.sanitizer`,
+``$PINT_TPU_RECOMPILE_SANITIZER``) watches the live process for the
+failures no static rule can see — an XLA compile in a process that
+believed itself warm — and attributes every compile to the program
+that caused it.
+
+``static`` is stdlib-only and importable without jax (also loadable
+by file path — ``tools/check_jit_gates.py`` does exactly that);
+``sanitizer`` needs only :mod:`pint_tpu.telemetry`.  Neither is
+imported here eagerly: the profiling hot path imports the sanitizer
+directly, and pulling the analyzer into every ``pint_tpu.lint``
+import would be dead weight for a serving replica.
+"""
+
+__all__ = ["static", "sanitizer"]
+
+
+def __getattr__(name):
+    if name in __all__:
+        import importlib
+
+        return importlib.import_module(f"{__name__}.{name}")
+    raise AttributeError(name)

@@ -1,0 +1,455 @@
+"""pintlint, runtime half: the recompile sanitizer
+(``$PINT_TPU_RECOMPILE_SANITIZER``).
+
+The static analyzer proves the *source* cannot break the shared-trace
+contract; this module watches the *process*.  The failure it exists
+for is the one no AST rule can see: a warm replica — or a bench
+steady-state loop, or the second same-shaped fitter — performs an XLA
+compile it should not have needed.  Today that failure is only
+visible as a global counter delta (``telemetry.compile_stats()``),
+which says *that* something compiled but never *what*: the debugging
+session starts from zero every time.  The sanitizer attributes every
+backend compile to the registry program that triggered it, classifies
+it, and — when armed — turns it into a structured violation instead
+of a silent latency cliff.
+
+Mechanics.  The profiling proxy around every registry program
+(:func:`pint_tpu.profiling.wrap_program`) brackets each dispatch in a
+thread-local scope; a ``jax.monitoring`` duration listener marks the
+innermost scope when a ``backend_compile`` event fires (compilation
+is synchronous on the dispatching thread, so attribution is exact).
+After the underlying call returns, the proxy hands the scope back
+here, where the compile is classified against a per-program history
+of argument-spec fingerprints:
+
+- ``first`` — the program's first compile at this spec.  Expected on
+  any cold path.
+- ``new_shape`` — a known program compiled for a spec it had not
+  seen.  Expected while unarmed (structure-only keys serve several
+  aval sets); a violation while armed (a warm process has no business
+  meeting new shapes).
+- ``same_shape_recompile`` — a program compiled AGAIN for a spec it
+  had already compiled.  Always a violation: the registry entry was
+  evicted, the key aliased, or jax's trace cache was invalidated —
+  the stale-trace/recompile bug class the whole architecture exists
+  to prevent.
+- compiles with no scope on the thread (eager ops, code outside the
+  registry) are counted ``unattributed`` and become violations only
+  while armed.
+
+Modes (host-only knob, never part of any jit key): ``off`` (default
+— the proxy hot path pays one module-attribute check), ``warn``
+(violations tick counters, emit ``{"type": "sanitizer"}`` records,
+and ``warnings.warn``), ``raise`` (additionally raise
+:class:`RecompileError` from the dispatching call AFTER the result
+is computed — never from inside jax's compile machinery).
+
+Arming: :func:`arm` after warmup declares "this process believes
+itself warm; any compile from here on is a bug".  The serving replica
+arms itself after its AOT import / warmup sweep when the mode knob is
+set (docs/serving.md); tests and datacheck use the
+:func:`sanitized` context manager.  Every compile — armed or not —
+lands in a bounded in-memory ledger (:func:`ledger`) and the
+telemetry sink, so ``pinttrace --sanitizer`` reconstructs the compile
+story of a run after the fact.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import warnings
+from collections import OrderedDict, deque
+
+from pint_tpu import telemetry
+
+__all__ = [
+    "MODE_ENV", "RecompileError", "mode", "configure", "active",
+    "arm", "disarm", "armed", "sanitized", "begin_dispatch",
+    "end_dispatch", "stats", "ledger", "violations", "reset",
+]
+
+MODE_ENV = "PINT_TPU_RECOMPILE_SANITIZER"
+
+_MODES = ("off", "warn", "raise")
+
+#: hot-path flag read by the profiling proxy: one attribute load per
+#: dispatch when the sanitizer is off.  Kept in sync with _mode by
+#: configure()/sanitized().
+ACTIVE = False
+
+_lock = threading.RLock()
+_tls = threading.local()
+
+_mode = "off"
+_armed = False
+_armed_note = None
+_listener_state = "uninstalled"   # uninstalled | jax.monitoring | fallback
+
+_LEDGER_CAP = 256
+_ledger: "deque" = deque(maxlen=_LEDGER_CAP)
+_violations: list = []
+_VIOLATIONS_CAP = 64
+
+#: program id -> set of arg-spec fingerprints already compiled.
+#: LRU-capped like the profiling registry (a long-lived service
+#: cycles structures); fingerprints per program capped too — past the
+#: cap a program is treated as open-ended (no same-shape verdicts),
+#: which only under-reports, never false-positives.
+_history: "OrderedDict[str, set]" = OrderedDict()
+_HISTORY_CAP = 512
+_SPECS_PER_PROGRAM_CAP = 64
+
+
+class RecompileError(RuntimeError):
+    """An armed process compiled, or any process re-compiled a
+    program for a spec it had already compiled.  Raised from the
+    dispatching call (raise mode) after the underlying computation
+    finished — the result of the call is intact, the raise is the
+    contract's alarm."""
+
+
+class _Scope:
+    __slots__ = ("label", "key_hash", "compile_s", "n_compiles",
+                 "cached")
+
+    def __init__(self, label, key_hash):
+        self.label = label
+        self.key_hash = key_hash
+        self.compile_s = 0.0
+        self.n_compiles = 0
+        self.cached = False
+
+
+def _parse_mode(raw) -> str:
+    tok = str(raw or "").strip().lower()
+    if tok in ("", "0", "off", "none", "false", "disabled"):
+        return "off"
+    if tok in ("raise", "strict", "fatal"):
+        return "raise"
+    # "1"/"on"/"true"/"warn"/anything else explicit -> observe mode
+    return "warn"
+
+
+def mode() -> str:
+    """The active mode: "off", "warn", or "raise"."""
+    return _mode
+
+
+def active() -> bool:
+    return ACTIVE
+
+
+def _on_duration(event, duration, **kw):
+    """The jax.monitoring compile listener.  Registration is
+    permanent (jax.monitoring has no deregister), so the mode guard
+    lives here: an "off" sanitizer must not count anything — without
+    it, every post-sanitized() compile in the process would tick
+    sanitizer.unattributed_compiles against a sanitizer that is off."""
+    if not ACTIVE or "compil" not in event:
+        return
+    stack = getattr(_tls, "stack", None)
+    scope = stack[-1] if stack else None
+    if "backend_compile" in event:
+        if scope is not None:
+            scope.n_compiles += 1
+            scope.compile_s += float(duration)
+        else:
+            _note_unattributed(float(duration))
+    elif "compile_time_saved" in event and scope is not None:
+        # the persistent disk cache served this executable:
+        # still a registry/trace-cache miss, but cheaper
+        scope.cached = True
+
+
+def _install_listener():
+    """Register the compile listener with ``jax.monitoring`` (once).
+    When the API is absent the sanitizer degrades to "fallback":
+    scopes never see compiles, stats says so, nothing crashes."""
+    global _listener_state
+    with _lock:
+        if _listener_state != "uninstalled":
+            return _listener_state
+        try:
+            from jax import monitoring as _mon
+
+            reg = _mon.register_event_duration_secs_listener
+        except Exception:
+            _listener_state = "fallback"
+            return _listener_state
+
+        try:
+            reg(_on_duration)
+            _listener_state = "jax.monitoring"
+        except Exception:
+            _listener_state = "fallback"
+        # keep telemetry's own compile counters coherent alongside
+        telemetry.compile_stats()
+        return _listener_state
+
+
+def configure(mode=None):
+    """Set the sanitizer mode; ``mode=None`` re-resolves the env var.
+    Returns the active mode.  Activating installs the jax.monitoring
+    listener (graceful fallback when absent)."""
+    global _mode, ACTIVE
+    with _lock:
+        _mode = _parse_mode(os.environ.get(MODE_ENV)
+                            if mode is None else mode)
+        ACTIVE = _mode != "off"
+        if ACTIVE:
+            _install_listener()
+    return _mode
+
+
+def arm(note="armed"):
+    """Declare the process warm: from here on EVERY compile is a
+    violation (warn/raise per mode).  Implies the sanitizer is
+    active — an explicit arm() while the mode knob is off enables
+    warn mode (the caller asked for watching; off would make arm a
+    silent no-op)."""
+    global _armed, _armed_note
+    with _lock:
+        if not ACTIVE:
+            configure("warn")
+        _armed = True
+        _armed_note = str(note)
+    telemetry.gauge_set("sanitizer.armed", 1.0)
+    telemetry.emit({"type": "sanitizer", "event": "armed",
+                    "note": str(note)})
+    return True
+
+
+def disarm():
+    global _armed, _armed_note
+    with _lock:
+        _armed = False
+        _armed_note = None
+    telemetry.gauge_set("sanitizer.armed", 0.0)
+
+
+def armed() -> bool:
+    return _armed
+
+
+@contextlib.contextmanager
+def sanitized(mode="raise", arm_now=True):
+    """Sanitizer forced to ``mode`` (armed by default) inside the
+    block, previous state fully restored after — the test/datacheck/
+    bench harness entry point."""
+    global _mode, ACTIVE, _armed, _armed_note
+    with _lock:
+        prev = (_mode, ACTIVE, _armed, _armed_note)
+    configure(mode)
+    if arm_now:
+        arm(note="sanitized()")
+    try:
+        yield
+    finally:
+        with _lock:
+            _mode, ACTIVE, _armed, _armed_note = prev
+        telemetry.gauge_set("sanitizer.armed",
+                            1.0 if _armed else 0.0)
+
+
+# --------------------------------------------------------------------------
+# the dispatch protocol (called by profiling._ProfiledProgram)
+# --------------------------------------------------------------------------
+
+def begin_dispatch(stats):
+    """Push a dispatch scope for one profiled-proxy call.  ``stats``
+    is the program's :class:`~pint_tpu.profiling.ProgramStats`."""
+    scope = _Scope(stats.label, stats.key_hash)
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    stack.append(scope)
+    return scope
+
+
+def _spec_fingerprint(args, kwargs):
+    """Cheap stable fingerprint of a call's abstract argument spec.
+    Only computed on the compile path (dispatches that compiled
+    nothing never pay it)."""
+    try:
+        from pint_tpu import profiling
+
+        spec = profiling._arg_spec(args)
+        kspec = (profiling._arg_spec(tuple(sorted(kwargs.items())))
+                 if kwargs else None)
+        return repr((spec, kspec))
+    except Exception:
+        return None
+
+
+def end_dispatch(scope, args, kwargs):
+    """Pop the scope; classify any compiles it absorbed.  Returns an
+    exception instance to raise (raise mode + violation), a warning
+    message string (warn mode + violation), or None — the caller
+    raises/warns OUTSIDE its finally block so the sanitizer can
+    never mask an in-flight exception from the call itself (a
+    warnings-as-errors filter may still escalate the warn-mode
+    warning after the result computed — the filter's own request)."""
+    stack = getattr(_tls, "stack", None)
+    if stack:
+        try:
+            stack.remove(scope)
+        except ValueError:
+            pass
+    if scope.n_compiles == 0 and not scope.cached:
+        return None
+    # scope.cached with zero backend compiles: the persistent disk
+    # cache served a rebuilt executable — still a registry/trace-cache
+    # miss (the violation class), just cheaper; classify it like a
+    # compile instead of dropping it
+    fp = _spec_fingerprint(args, kwargs)
+    pid = f"{scope.label}#{scope.key_hash}"
+    with _lock:
+        hist = _history.get(pid)
+        if hist is None:
+            hist = _history[pid] = set()
+            while len(_history) > _HISTORY_CAP:
+                _history.popitem(last=False)
+        else:
+            _history.move_to_end(pid)
+        known = fp is not None and fp in hist
+        if fp is not None and not known and \
+                len(hist) < _SPECS_PER_PROGRAM_CAP:
+            hist.add(fp)
+        if known:
+            kind = "same_shape_recompile"
+        elif len(hist) <= 1:
+            kind = "first"
+        else:
+            kind = "new_shape"
+        is_violation = known or _armed
+        armed_now, note = _armed, _armed_note
+    telemetry.counter_add("sanitizer.compiles", scope.n_compiles)
+    record = {
+        "type": "sanitizer", "event": "compile",
+        "program": scope.label, "key": scope.key_hash, "kind": kind,
+        "n_compiles": scope.n_compiles,
+        "compile_s": round(scope.compile_s, 6),
+        "cache_served": scope.cached,
+        "armed": armed_now, "violation": is_violation,
+    }
+    with _lock:
+        _ledger.append(record)
+    if not is_violation:
+        telemetry.emit(record)
+        return None
+    telemetry.counter_add("sanitizer.violations")
+    if kind == "same_shape_recompile":
+        telemetry.counter_add("sanitizer.same_shape_recompiles")
+    why = ("recompiled a spec it had already compiled (registry "
+           "eviction, key aliasing, or trace-cache invalidation)"
+           if kind == "same_shape_recompile" else
+           f"compiled while the process was armed ({note})")
+    msg = (f"recompile sanitizer: program {scope.label}"
+           f"#{scope.key_hash} {why} — {scope.n_compiles} backend "
+           f"compile(s), {scope.compile_s:.3f}s"
+           + (" (served from the persistent disk cache)"
+              if scope.cached else ""))
+    record["message"] = msg
+    telemetry.emit(record)
+    with _lock:
+        if len(_violations) < _VIOLATIONS_CAP:
+            _violations.append(record)
+    if _mode == "raise":
+        return RecompileError(msg)
+    return msg
+
+
+def _note_unattributed(seconds):
+    """A backend compile with no registry dispatch on this thread:
+    eager ops, raw-jit escapes, or jax internals.  Counted always;
+    a violation record only while armed (no exception — there is no
+    dispatching proxy to raise from)."""
+    telemetry.counter_add("sanitizer.unattributed_compiles")
+    if not _armed:
+        return
+    record = {
+        "type": "sanitizer", "event": "compile",
+        "program": "(unattributed)", "key": "-",
+        "kind": "unattributed", "n_compiles": 1,
+        "compile_s": round(float(seconds), 6),
+        "cache_served": False, "armed": True, "violation": True,
+        "message": "recompile sanitizer: backend compile outside "
+                   "any registry program while armed — eager op or "
+                   "raw-jit escape (run pintlint PTL101)",
+    }
+    telemetry.counter_add("sanitizer.violations")
+    with _lock:
+        _ledger.append(record)
+        if len(_violations) < _VIOLATIONS_CAP:
+            _violations.append(record)
+    telemetry.emit(record)
+    if _mode != "off":
+        # the strictest mode must not be QUIETER than warn: there is
+        # no dispatching proxy to raise from, so raise mode warns too.
+        # The warn happens inside jax's monitoring listener — swallow
+        # a warnings-as-errors escalation rather than break the
+        # compile that triggered it.
+        try:
+            warnings.warn(record["message"], RuntimeWarning,
+                          stacklevel=2)
+        except Exception:
+            pass
+
+
+# --------------------------------------------------------------------------
+# readout
+# --------------------------------------------------------------------------
+
+def ledger(tail=None) -> list:
+    """The bounded in-memory compile ledger (every attributed compile,
+    violation or not), oldest first."""
+    with _lock:
+        out = list(_ledger)
+    return out[-tail:] if tail else out
+
+
+def violations() -> list:
+    with _lock:
+        return list(_violations)
+
+
+def stats() -> dict:
+    """One-call readout for /v1/stats, datacheck, and tests."""
+    with _lock:
+        return {
+            "mode": _mode,
+            "armed": _armed,
+            "armed_note": _armed_note,
+            "listener": _listener_state,
+            "compiles": int(telemetry.counter_get(
+                "sanitizer.compiles")),
+            "violations": int(telemetry.counter_get(
+                "sanitizer.violations")),
+            "same_shape_recompiles": int(telemetry.counter_get(
+                "sanitizer.same_shape_recompiles")),
+            "unattributed_compiles": int(telemetry.counter_get(
+                "sanitizer.unattributed_compiles")),
+            "programs_tracked": len(_history),
+            "ledger_len": len(_ledger),
+        }
+
+
+def reset():
+    """Drop history/ledger/violations and disarm (tests).  Mode and
+    listener survive — re-resolve with configure()."""
+    global _armed, _armed_note
+    with _lock:
+        _history.clear()
+        _ledger.clear()
+        del _violations[:]
+        _armed = False
+        _armed_note = None
+    telemetry.gauge_set("sanitizer.armed", 0.0)
+
+
+# resolve the env knob at import so harness subprocesses that export
+# PINT_TPU_RECOMPILE_SANITIZER before python starts are live without
+# any code change; in-process callers use configure()/sanitized()
+configure(None)

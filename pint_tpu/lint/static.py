@@ -1,0 +1,1014 @@
+"""pintlint, static half: the unified trace-safety analyzer.
+
+Every correctness contract this repo built around the shared-jit
+registry is easy to hold and easy to break silently: a trace gate left
+out of a key serves a STALE program when the gate flips; a raw
+``jax.jit`` call bypasses the registry and with it profiling, AOT
+export, and the zero-recompile contract; a fresh ``lambda`` handed to
+``shared_jit`` without ``fn_token`` has fresh identity per call, so
+the registry misses every time (the exact PR-2 ``jax.jit(lambda *a:
+fit(*a))`` bug); an ``os.environ`` read inside a traced function bakes
+one process's gate state into a shared executable; an undocumented
+telemetry counter is invisible to the people reading docs/telemetry.md
+to debug an incident.  Each of these was found the hard way at least
+once (CHANGES.md); this module makes all of them checkable, in one
+rule framework, as a tier-1 test and a CLI (``pintlint``).
+
+The module is deliberately self-contained and stdlib-only (``ast``,
+``os``, ``re``): it must run without jax and without importing
+``pint_tpu`` (whose ``__init__`` imports jax), so CI, the
+``tools/check_jit_gates.py`` compatibility shim, and editors can load
+it by file path.  The runtime half — the recompile sanitizer that
+watches the same contracts while the process is live — is
+:mod:`pint_tpu.lint.sanitizer`.
+
+Rules (select/ignore by id; see docs/lint.md for the catalog):
+
+- **PTL001 gate-key-site** — every registered trace-changing gate's
+  declared key-construction functions carry the token that folds the
+  gate into the shared-jit key (:data:`KEY_SITES`).
+- **PTL002 gate-callsite-sweep** — a module that reads a gate resolver
+  AND builds shared-jit keys must be a declared KEY_SITE or EXEMPT
+  with a recorded reason.
+- **PTL003 env-classification** — every ``PINT_TPU_*`` name in library
+  source is a registered trace gate or a known host-only knob.
+- **PTL004 mesh-axis** — PartitionSpec-rule axis literals exist in
+  ``parallel/mesh.AXIS_NAMES``; ``mesh_jit_key`` stays generic.
+- **PTL101 raw-jit** — ``jax.jit``/``jax.pmap``/``pjit`` calls outside
+  the registry module: the program escapes profiling, the AOT store,
+  and the zero-recompile contract.  Suppress per-site with an inline
+  allow comment carrying a reason.
+- **PTL102 anonymous-shared-jit** — ``shared_jit(lambda ...)`` without
+  ``fn_token``: lambda identity is fresh per call, so every call is a
+  registry miss that builds (and compiles) a new entry.
+- **PTL103 env-in-trace** — ``os.environ``/``os.getenv`` read inside a
+  function passed to a tracing transform: the gate must resolve at
+  key-build time, not trace time (a traced read bakes one process's
+  state into a shared executable and never re-reads).
+- **PTL104 host-sync-in-trace** — ``.item()`` / ``jax.device_get``
+  inside a traced function: forces a host sync (or a tracer-leak
+  error) inside the program.
+- **PTL201 undocumented-telemetry** — every literal counter / gauge /
+  histogram name in library source appears in docs/telemetry.md
+  (family wildcards, brace/slash lists, ``<kind>`` placeholders and
+  ``..._suffix`` elisions in the doc all count).
+
+Suppression: an inline comment on the flagged line (or the line
+directly above) of the form ``# pintlint: allow=PTL101 -- reason``.
+The reason is mandatory — an allow without one is itself a finding
+(PTL000), the same "exemption without a reason is a lint bug"
+discipline :data:`EXEMPT` already enforces.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from collections import OrderedDict, namedtuple
+
+__all__ = [
+    "Finding", "RULES", "run", "check", "main", "repo_root",
+    "TRACE_GATES", "KEY_SITES", "EXEMPT", "HOST_ONLY",
+    "RAW_JIT_EXEMPT_FILES", "TRACING_CALLS",
+]
+
+#: one analyzer verdict.  ``line`` is 1-based (0 = whole file).
+Finding = namedtuple("Finding", "rule file line message")
+
+
+# --------------------------------------------------------------------------
+# gate / env / exemption tables (the check_jit_gates registry, moved
+# here verbatim; tools/check_jit_gates.py re-exports them)
+# --------------------------------------------------------------------------
+
+#: trace-changing gates: env var -> source tokens that resolve it.
+#: A file "uses" the gate when any token appears in its source.
+TRACE_GATES = {
+    "PINT_TPU_GUARD": ("_guard.enabled()", "guard.enabled()"),
+    "PINT_TPU_SCAN_ITERS": ("scan_iters_default()",),
+    "PINT_TPU_ITER_TRACE": ("iter_trace_default()",),
+    "PINT_TPU_HYBRID_DESIGN": ("hybrid_design_default()",),
+    "PINT_TPU_FROZEN_DELAY": ("frozen_delay_default()",),
+    "PINT_TPU_SEGMENT_ECORR": ("segment_ecorr_default()",),
+    "PINT_TPU_KRON_PHI": ("kron_phi_default()",),
+}
+
+#: key sites: file -> {dotted function path: {gate: token that must
+#: appear in that function's source}}.  The token is how the gate
+#: rides the key at that site (a resolver call, or the local/attr
+#: name its trace-build-time resolution was stored under).
+KEY_SITES = {
+    "pint_tpu/fitter.py": {
+        "Fitter._step_key": {
+            "PINT_TPU_GUARD": "self._guard_on",
+            "PINT_TPU_ITER_TRACE": "self._iter_trace",
+            # the design gates enter through the partition/frozen
+            # tuples they deterministically derive
+            "PINT_TPU_HYBRID_DESIGN": "self._partition",
+            "PINT_TPU_FROZEN_DELAY": "self._frozen_names",
+        },
+    },
+    "pint_tpu/downhill.py": {
+        "_DownhillMixin._retrace": {
+            "PINT_TPU_GUARD": "self._guard_on",
+            "PINT_TPU_ITER_TRACE": "self._iter_trace",
+            "PINT_TPU_HYBRID_DESIGN": "self._partition",
+            "PINT_TPU_FROZEN_DELAY": "self._frozen_names",
+        },
+    },
+    "pint_tpu/lmfitter.py": {
+        "LMFitter._retrace": {
+            "PINT_TPU_GUARD": "self._guard_on",
+            "PINT_TPU_HYBRID_DESIGN": "self._partition",
+            "PINT_TPU_FROZEN_DELAY": "self._frozen_names",
+        },
+        "PowellFitter._retrace": {
+            "PINT_TPU_FROZEN_DELAY": "self._frozen_names",
+        },
+    },
+    "pint_tpu/grid.py": {
+        "make_grid_fn": {
+            "PINT_TPU_SCAN_ITERS": "scan",
+            "PINT_TPU_ITER_TRACE": "trace",
+            "PINT_TPU_HYBRID_DESIGN": "hybrid_design_default()",
+            "PINT_TPU_FROZEN_DELAY": "frozen_delay_default()",
+        },
+    },
+    "pint_tpu/parallel/pta.py": {
+        "PTABatch._batched_fit_jit": {
+            "PINT_TPU_GUARD": "with_health",
+            "PINT_TPU_SCAN_ITERS": "scan",
+            "PINT_TPU_ITER_TRACE": "trace",
+        },
+        # the 2-D pulsar x grid scan resolves the scan flag itself
+        "PTABatch._chisq_grid_jit": {
+            "PINT_TPU_SCAN_ITERS": "scan",
+        },
+        # the design partition rides _structure_key
+        "PTABatch._structure_key": {
+            "PINT_TPU_HYBRID_DESIGN": "self._partition",
+        },
+    },
+    "pint_tpu/residuals.py": {
+        # segment-ECORR changes every Woodbury trace; it keys through
+        # the StructuredU-vs-dense bit of the structure key
+        "Residuals._structure_key": {
+            "PINT_TPU_SEGMENT_ECORR": "StructuredU",
+        },
+    },
+    "pint_tpu/gw/common.py": {
+        # the kron/dense prior selection is a different traced
+        # program (different argument layouts entirely); the gate
+        # resolves once at CommonProcess build into self._kron, which
+        # both lnlike keys carry
+        "CommonProcess._lnlike_jit": {
+            "PINT_TPU_KRON_PHI": "self._kron",
+        },
+        "CommonProcess.lnlike_grid": {
+            "PINT_TPU_KRON_PHI": "self._kron",
+        },
+    },
+    "pint_tpu/gw/hmc.py": {
+        # the HMC chunk scan resolves the scan flag itself and keys
+        # it (scan vs unroll are different programs); the kron flag
+        # rides the key via posterior.kron (resolved upstream at
+        # CommonProcess build)
+        "run_nuts": {
+            "PINT_TPU_SCAN_ITERS": "scan_flag",
+        },
+    },
+}
+
+#: modules that call a gate resolver AND build shared-jit keys but
+#: are deliberately NOT key sites for it — each with the reason the
+#: exemption is sound.  An exemption without a reason is a lint bug.
+EXEMPT = {
+    ("pint_tpu/sampler.py", "PINT_TPU_GUARD"):
+        "chain health always rides the traced program (kept OUT of "
+        "the key by design); guard gate is honored host-side only",
+    ("pint_tpu/gw/common.py", "PINT_TPU_GUARD"):
+        "lnlike health always rides the traced program; the gate "
+        "changes only the host-side raise",
+    ("pint_tpu/datacheck.py", "*"):
+        "reporting only: resolvers are read to PRINT gate state, "
+        "never to build a traced program",
+    ("pint_tpu/models/timing_model.py", "*"):
+        "defines the design-gate resolvers; its own shared_jit use "
+        "is none (prepare() is host-side)",
+    ("pint_tpu/compile_cache.py", "*"):
+        "defines scan/iter-trace resolvers and the registry itself; "
+        "iterate_fixed receives the resolved flag from callers",
+    ("pint_tpu/fitter.py", "PINT_TPU_SCAN_ITERS"):
+        "the single-pulsar fit loop is host-driven (no iterate_fixed "
+        "inside its trace)",
+    ("pint_tpu/residuals.py", "PINT_TPU_GUARD"):
+        "residuals accessors compute no health output; the guard "
+        "gate never reaches their traces",
+    ("pint_tpu/gw/hmc.py", "PINT_TPU_ITER_TRACE"):
+        "HMC per-draw records always ride the scan ys (they ARE the "
+        "returned chain, gate on or off — one traced program); the "
+        "gate controls only host-side iter_trace telemetry emission",
+    ("pint_tpu/gw/hmc.py", "PINT_TPU_GUARD"):
+        "chain health is read from the returned draws host-side (the "
+        "sampler.py convention); the gate changes only the host-side "
+        "raise, never the traced chunk program",
+    ("pint_tpu/lint/static.py", "*"):
+        "the lint's own rule tables spell every gate token and key "
+        "idiom as string literals; it builds no traced program",
+}
+
+#: known host-only PINT_TPU_* env vars: they change behavior outside
+#: any traced program (paths, timeouts, reporting, process harness),
+#: so key participation is not required.
+HOST_ONLY = {
+    "PINT_TPU_CACHE_DIR", "PINT_TPU_CLOCK_DIR", "PINT_TPU_IERS_DIR",
+    "PINT_TPU_EPHEM_DIR", "PINT_TPU_EPHEM_BUILTIN",
+    "PINT_TPU_NO_BUILTIN_DATA", "PINT_TPU_OBS", "PINT_TPU_LOG",
+    "PINT_TPU_TRACE", "PINT_TPU_TRACE_MAX_MB", "PINT_TPU_PROFILE",
+    "PINT_TPU_METRICS_PORT", "PINT_TPU_METRICS_HOST",
+    "PINT_TPU_JIT_REGISTRY_CAP", "PINT_TPU_DONATE_CPU",
+    "PINT_TPU_AOT_CODEC", "PINT_TPU_FAULTS",
+    "PINT_TPU_PROBE_TIMEOUT", "PINT_TPU_PROBE_RETRIES",
+    "PINT_TPU_PROBE_BACKOFF",
+    "PINT_TPU_BENCH_CPU", "PINT_TPU_BENCH_FALLBACK",
+    "PINT_TPU_BENCH_PROBE_TIMEOUT", "PINT_TPU_BENCH_METRIC_TIMEOUT",
+    "PINT_TPU_BENCH_FALLBACK_TIMEOUT",
+    "PINT_TPU_MEASURED_PEAK_F64", "PINT_TPU_MEASURED_PEAK_BACKEND",
+    # bucketing pads the DATASET host-side; the padded shape reaches
+    # the key through the avals/structure, not through the gate
+    "PINT_TPU_BUCKET_TOAS",
+    # the warm fitting service (pint_tpu/serve/): every knob is
+    # host-only BY DESIGN — the batcher must never create traced
+    # programs beyond the existing PTA-batch registry keys
+    # (pta.batched_fit / pta.chisq / pta.resid), whose identities are
+    # carried by bucket, size class, structure, and maxiter through
+    # the ordinary aval/key machinery.  Flush cadence, queue bounds,
+    # deadlines, ports, and directories shape WHEN and HOW MANY
+    # requests share a program, never the program itself
+    # (tests/test_serve.py asserts the zero-new-compile contract on a
+    # repeated same-bucket flush).
+    "PINT_TPU_SERVE_FLUSH_MS", "PINT_TPU_SERVE_MAX_BATCH",
+    "PINT_TPU_SERVE_QUEUE_MAX", "PINT_TPU_SERVE_DEADLINE_MS",
+    "PINT_TPU_SERVE_GRID_CHUNK", "PINT_TPU_SERVE_PORT",
+    "PINT_TPU_SERVE_HOST", "PINT_TPU_SERVE_JOB_DIR",
+    "PINT_TPU_SERVE_AOT_DIR",
+    # the token the regex extracts from the docstring wildcard
+    # spelling ``PINT_TPU_SERVE_*`` (prose about the family, not a
+    # variable); every real member is enumerated above
+    "PINT_TPU_SERVE_",
+    # the recompile sanitizer (pint_tpu/lint/sanitizer.py) observes
+    # compiles; it never creates or alters a traced program, so the
+    # mode knob cannot need key participation
+    "PINT_TPU_RECOMPILE_SANITIZER",
+}
+
+#: files where raw jax.jit is the point, not a registry bypass —
+#: reason recorded, same discipline as EXEMPT.
+RAW_JIT_EXEMPT_FILES = {
+    "pint_tpu/compile_cache.py":
+        "the registry itself: shared_jit's jax.jit is the ONE "
+        "sanctioned call, and the AOT import/export codecs must "
+        "wrap deserialized executables directly",
+}
+
+#: call names whose function-valued arguments are traced.  Both bare
+#: names (``vmap`` after ``from jax import vmap``) and attribute tails
+#: (``jax.vmap``, ``lax.scan``) resolve here.
+TRACING_CALLS = {
+    "jit", "pmap", "pjit", "vmap", "jacfwd", "jacrev", "grad",
+    "value_and_grad", "scan", "while_loop", "fori_loop", "cond",
+    "switch", "checkpoint", "shared_jit", "iterate_fixed",
+}
+
+_ENV_RE = re.compile(r"PINT_TPU_[A-Z0-9_]+")
+
+#: function names whose string-literal arguments name mesh axes
+_AXIS_CALLS = {"P", "PartitionSpec", "_P", "make_mesh",
+               "resolve_axis", "axis_size", "RowShard"}
+
+_ALLOW_RE = re.compile(
+    r"#\s*pintlint:\s*allow=([A-Z0-9,]+)\s*(?:--\s*(\S.*))?")
+
+_TELEMETRY_FNS = {"counter_add", "gauge_set", "hist_record"}
+
+
+# --------------------------------------------------------------------------
+# source loading + suppression
+# --------------------------------------------------------------------------
+
+class _Ctx:
+    """Parsed view of one source tree: relpath -> source / AST /
+    per-line allow directives."""
+
+    def __init__(self, root):
+        self.root = root
+        self.sources: "OrderedDict[str, str]" = OrderedDict()
+        self.trees: dict = {}
+        self.lines: dict = {}         # rel -> list of source lines
+        self.allows: dict = {}        # rel -> {line: set(rule ids)}
+        self.bad_allows: list = []    # (rel, line) missing a reason
+        py_files = []
+        for base in ("pint_tpu",):
+            for dirpath, dirnames, filenames in os.walk(
+                    os.path.join(root, base)):
+                dirnames[:] = [d for d in dirnames
+                               if d != "__pycache__"]
+                py_files.extend(os.path.join(dirpath, f)
+                                for f in filenames if f.endswith(".py"))
+        for path in sorted(py_files):
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            with open(path) as fh:
+                src = fh.read()
+            self.sources[rel] = src
+            self.lines[rel] = src.splitlines()
+            try:
+                self.trees[rel] = ast.parse(src)
+            except SyntaxError:
+                self.trees[rel] = None
+            allows = {}
+            for lineno, line in enumerate(self.lines[rel], 1):
+                m = _ALLOW_RE.search(line)
+                if not m:
+                    continue
+                if not m.group(2):
+                    self.bad_allows.append((rel, lineno))
+                allows[lineno] = set(m.group(1).split(","))
+            if allows:
+                self.allows[rel] = allows
+        doc_path = os.path.join(root, "docs", "telemetry.md")
+        try:
+            with open(doc_path) as fh:
+                self.telemetry_doc = fh.read()
+        except OSError:
+            self.telemetry_doc = None
+
+    def allowed(self, rel, line, rule) -> bool:
+        """Whether an allow directive covers ``rule`` at ``line``:
+        trailing on the flagged line itself, or anywhere in the
+        contiguous comment block directly above it (multi-line
+        reasons are encouraged)."""
+        allows = self.allows.get(rel)
+        if not allows:
+            return False
+
+        def hit(at):
+            ids = allows.get(at)
+            return bool(ids and (rule in ids or "*" in ids))
+
+        if hit(line):
+            return True
+        src_lines = self.lines.get(rel) or []
+        at = line - 1
+        while at >= 1 and at <= len(src_lines) and \
+                src_lines[at - 1].lstrip().startswith("#"):
+            if hit(at):
+                return True
+            at -= 1
+        return False
+
+
+def _function_source(tree, src, dotted):
+    """Source segment of a (possibly class-nested) function."""
+    parts = dotted.split(".")
+    node = tree
+    for name in parts:
+        found = None
+        for child in ast.walk(node) if node is tree else \
+                ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef,
+                                  ast.ClassDef)) \
+                    and child.name == name:
+                found = child
+                break
+        if found is None:
+            return None
+        node = found
+    return ast.get_source_segment(src, node)
+
+
+def _call_name(node):
+    """The terminal name of a Call's callee: ``jax.jit`` -> ``jit``,
+    ``shared_jit`` -> ``shared_jit``; None for computed callees."""
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+def _attr_path(node):
+    """Dotted path of an Attribute/Name chain (``jax.experimental.
+    pjit`` -> "jax.experimental.pjit"), or None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_exempt(rel, gate):
+    return (rel, gate) in EXEMPT or (rel, "*") in EXEMPT
+
+
+# --------------------------------------------------------------------------
+# PTL001-004: the migrated jit-gate / env / mesh checks
+# --------------------------------------------------------------------------
+
+def _rule_gate_key_site(ctx, notes):
+    out = []
+    for rel, funcs in sorted(KEY_SITES.items()):
+        src = ctx.sources.get(rel)
+        if src is None:
+            out.append(Finding("PTL001", rel, 0,
+                               "key-site file missing"))
+            continue
+        tree = ctx.trees.get(rel)
+        for dotted, needs in sorted(funcs.items()):
+            seg = _function_source(tree, src, dotted) if tree else None
+            if seg is None:
+                out.append(Finding(
+                    "PTL001", rel, 0,
+                    f"{dotted}: key function not found (renamed? "
+                    "update KEY_SITES)"))
+                continue
+            for gate, token in sorted(needs.items()):
+                if token in seg:
+                    notes.append(f"OK   {rel}:{dotted}: {gate} via "
+                                 f"{token!r}")
+                else:
+                    out.append(Finding(
+                        "PTL001", rel, 0,
+                        f"{dotted}: {gate} token {token!r} missing "
+                        "from the key function — a flipped gate "
+                        "would serve a stale trace"))
+    return out
+
+
+def _rule_gate_callsite_sweep(ctx, notes):
+    out = []
+    for rel, src in sorted(ctx.sources.items()):
+        if "shared_jit(" not in src:
+            continue
+        for gate, tokens in sorted(TRACE_GATES.items()):
+            if not any(tok in src for tok in tokens):
+                continue
+            declared = gate in {
+                g for funcs in (KEY_SITES.get(rel) or {}).values()
+                for g in funcs}
+            if declared or _is_exempt(rel, gate):
+                continue
+            out.append(Finding(
+                "PTL002", rel, 0,
+                f"reads trace gate {gate} and builds shared-jit "
+                "keys, but is neither a declared KEY_SITE nor "
+                "EXEMPT (with a reason) for it"))
+    return out
+
+
+def _rule_env_classification(ctx, notes):
+    out = []
+    known = set(TRACE_GATES) | HOST_ONLY
+    for rel, src in sorted(ctx.sources.items()):
+        for var in sorted(set(_ENV_RE.findall(src))):
+            if var not in known:
+                out.append(Finding(
+                    "PTL003", rel, 0,
+                    f"unclassified env var {var} — add it to "
+                    "TRACE_GATES (and a KEY_SITE) if it changes a "
+                    "traced program, else to HOST_ONLY"))
+    return out
+
+
+def _axis_names_from_source(src):
+    """The AXIS_NAMES tuple parsed out of parallel/mesh.py source
+    (ast, not import — the lint must run without jax)."""
+    tree = ast.parse(src)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "AXIS_NAMES"
+                for t in node.targets):
+            return tuple(
+                e.value for e in node.value.elts
+                if isinstance(e, ast.Constant)
+                and isinstance(e.value, str))
+    return None
+
+
+def _axis_literals(tree):
+    """Mesh-axis string literals used in PartitionSpec rule tables and
+    mesh-construction calls of one module: ``(lineno, name)`` pairs.
+    Only direct str/tuple-of-str arguments count — computed axis
+    names resolve at runtime through resolve_axis, which validates."""
+    out = []
+    if tree is None:
+        return out
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        if name not in _AXIS_CALLS:
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords
+                                      if kw.arg in ("axes", "axis")]:
+            elts = (arg.elts if isinstance(arg, (ast.Tuple, ast.List))
+                    else [arg])
+            for e in elts:
+                if isinstance(e, ast.Constant) and \
+                        isinstance(e.value, str):
+                    out.append((node.lineno, e.value))
+    return out
+
+
+def _rule_mesh_axis(ctx, notes):
+    out = []
+    mesh_rel = "pint_tpu/parallel/mesh.py"
+    mesh_src = ctx.sources.get(mesh_rel)
+    axis_names = (_axis_names_from_source(mesh_src)
+                  if mesh_src else None)
+    if axis_names is None:
+        out.append(Finding(
+            "PTL004", mesh_rel, 0,
+            "AXIS_NAMES literal not found (renamed? the axis lint "
+            "needs it)"))
+        return out
+    tree = ctx.trees.get(mesh_rel)
+    key_src = _function_source(tree, mesh_src, "mesh_jit_key")
+    if key_src is None:
+        out.append(Finding("PTL004", mesh_rel, 0,
+                           "mesh_jit_key not found"))
+    elif "axis_names" in key_src or all(
+            f'"{a}"' in key_src or f"'{a}'" in key_src
+            for a in axis_names):
+        notes.append(
+            f"OK   {mesh_rel}:mesh_jit_key covers every axis "
+            "(generic over mesh.axis_names)")
+    else:
+        out.append(Finding(
+            "PTL004", mesh_rel, 0,
+            "mesh_jit_key no longer derives its entries from "
+            "mesh.axis_names and does not name every axis in "
+            f"AXIS_NAMES {axis_names} — a rule-table axis could "
+            "miss the jit key and poison the zero-recompile "
+            "contract"))
+    allowed = set(axis_names)
+    for rel, tree in sorted(ctx.trees.items()):
+        for lineno, name in _axis_literals(tree):
+            if name in allowed:
+                continue
+            out.append(Finding(
+                "PTL004", rel, lineno,
+                f"mesh-axis literal {name!r} is not in "
+                f"parallel/mesh.AXIS_NAMES {axis_names} — a typo'd "
+                "or undeclared axis silently mis-shards; add it to "
+                "AXIS_NAMES or fix the name"))
+    return out
+
+
+# --------------------------------------------------------------------------
+# PTL101/102: registry-bypass rules
+# --------------------------------------------------------------------------
+
+def _jax_jit_imports(tree):
+    """Bare names this module binds to jax's jit/pmap via
+    ``from jax import jit`` (incl. aliases) — bare ``pjit`` is
+    always matched, these two only when actually imported, so an
+    unrelated local ``jit()`` helper stays clean."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module and \
+                (node.module == "jax" or node.module.startswith("jax.")):
+            for alias in node.names:
+                if alias.name in ("jit", "pmap"):
+                    names.add(alias.asname or alias.name)
+    return names
+
+
+def _raw_jit_hit(expr, bare_names):
+    """The offending dotted path if ``expr`` names a raw tracing
+    entry point (call target, bare decorator, or partial() arg)."""
+    path = _attr_path(expr)
+    if path is None:
+        return None
+    if path in ("jax.jit", "jax.pmap", "pjit") or \
+            path.endswith(".pjit") or path in bare_names:
+        return path
+    return None
+
+
+def _rule_raw_jit(ctx, notes):
+    out = []
+    for rel, tree in sorted(ctx.trees.items()):
+        if tree is None or rel in RAW_JIT_EXEMPT_FILES:
+            continue
+        bare = _jax_jit_imports(tree)
+        hits = []   # (lineno, path, spelling)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                path = _raw_jit_hit(node.func, bare)
+                if path is not None:
+                    hits.append((node.lineno, path, f"{path}()"))
+                elif _call_name(node) == "partial":
+                    # partial(jax.jit, ...) builds the same raw
+                    # program factory one hop removed
+                    for arg in node.args:
+                        p = _raw_jit_hit(arg, bare)
+                        if p is not None:
+                            hits.append((node.lineno, p,
+                                         f"partial({p}, ...)"))
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                # bare @jax.jit decorators are Attributes, not Calls
+                for dec in node.decorator_list:
+                    p = _raw_jit_hit(dec, bare)
+                    if p is not None:
+                        hits.append((dec.lineno, p, f"@{p}"))
+        for lineno, path, spelling in hits:
+            out.append(Finding(
+                "PTL101", rel, lineno,
+                f"raw {spelling} bypasses compile_cache."
+                "shared_jit — the program escapes the registry "
+                "(profiling, AOT export/import, zero-recompile "
+                "contract); route through shared_jit or add an "
+                "inline allow with the reason"))
+    return out
+
+
+def _rule_anonymous_shared_jit(ctx, notes):
+    out = []
+    for rel, tree in sorted(ctx.trees.items()):
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or \
+                    _call_name(node) != "shared_jit":
+                continue
+            if not node.args or not isinstance(node.args[0],
+                                               ast.Lambda):
+                continue
+            if any(kw.arg == "fn_token" for kw in node.keywords):
+                continue
+            out.append(Finding(
+                "PTL102", rel, node.lineno,
+                "shared_jit(lambda ...) without fn_token: a lambda "
+                "built at the call site has fresh identity per "
+                "call, so the registry misses every time and "
+                "re-traces (the PR-2 jax.jit(lambda *a: fit(*a)) "
+                "bug class) — pass fn_token naming the computation"))
+    return out
+
+
+# --------------------------------------------------------------------------
+# PTL103/104: traced-function hygiene
+# --------------------------------------------------------------------------
+
+def _decorated_by_transform(node):
+    """Whether a def carries a tracing-transform decorator:
+    ``@jax.jit``, ``@jit``, ``@jax.jit(...)``, or
+    ``@partial(jax.jit, ...)``."""
+    for dec in node.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = target.attr if isinstance(target, ast.Attribute) \
+            else getattr(target, "id", None)
+        if name in TRACING_CALLS:
+            return True
+        if isinstance(dec, ast.Call) and name == "partial":
+            for arg in dec.args:
+                inner = arg.attr if isinstance(arg, ast.Attribute) \
+                    else getattr(arg, "id", None)
+                if inner in TRACING_CALLS:
+                    return True
+    return False
+
+
+def _traced_functions(tree):
+    """Function bodies traced by a jax transform in this module:
+    local ``def``s whose NAME is passed to a tracing call, defs
+    decorated with a transform, plus lambdas passed directly.
+    Conservative by construction — only bare-name and inline-lambda
+    arguments resolve."""
+    if tree is None:
+        return []
+    traced_names = set()
+    lambdas = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _call_name(node) not in TRACING_CALLS:
+            continue
+        cands = list(node.args) + [
+            kw.value for kw in node.keywords
+            if kw.arg in ("body", "fun", "f", "cond_fun", "body_fun")]
+        for arg in cands:
+            if isinstance(arg, ast.Name):
+                traced_names.add(arg.id)
+            elif isinstance(arg, ast.Lambda):
+                lambdas.append(arg)
+    defs = [node for node in ast.walk(tree)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and (node.name in traced_names
+                 or _decorated_by_transform(node))]
+    return defs + lambdas
+
+
+def _rule_env_in_trace(ctx, notes):
+    out = []
+    for rel, tree in sorted(ctx.trees.items()):
+        for fn in _traced_functions(tree):
+            label = getattr(fn, "name", "<lambda>")
+            for node in ast.walk(fn):
+                bad = None
+                if isinstance(node, ast.Attribute) and \
+                        node.attr == "environ" and \
+                        isinstance(node.value, ast.Name) and \
+                        node.value.id == "os":
+                    bad = "os.environ"
+                elif isinstance(node, ast.Call) and \
+                        _call_name(node) == "getenv":
+                    bad = "os.getenv()"
+                if bad is None:
+                    continue
+                out.append(Finding(
+                    "PTL103", rel, node.lineno,
+                    f"{bad} read inside traced function "
+                    f"{label!r}: the value is baked into the "
+                    "shared executable at trace time and never "
+                    "re-read — resolve the gate at key-build time "
+                    "and fold it into the jit key"))
+    return out
+
+
+def _rule_host_sync_in_trace(ctx, notes):
+    out = []
+    for rel, tree in sorted(ctx.trees.items()):
+        for fn in _traced_functions(tree):
+            label = getattr(fn, "name", "<lambda>")
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                bad = None
+                if isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "item" and not node.args:
+                    bad = ".item()"
+                elif _attr_path(node.func) == "jax.device_get":
+                    bad = "jax.device_get()"
+                if bad is None:
+                    continue
+                out.append(Finding(
+                    "PTL104", rel, node.lineno,
+                    f"{bad} inside traced function {label!r}: "
+                    "forces a host sync (or a tracer-leak error) "
+                    "inside the program — keep host reads outside "
+                    "the trace, or return the value and read it "
+                    "after dispatch"))
+    return out
+
+
+# --------------------------------------------------------------------------
+# PTL201: telemetry-name doc coverage
+# --------------------------------------------------------------------------
+
+def _literal_telemetry_names(tree):
+    """(lineno, name) for every literal first argument of a
+    counter_add / gauge_set / hist_record call.  f-strings and
+    computed names are skipped — they are families whose static
+    prefix the doc covers with a wildcard row."""
+    out = []
+    if tree is None:
+        return out
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or \
+                _call_name(node) not in _TELEMETRY_FNS:
+            continue
+        if not node.args:
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            out.append((node.lineno, arg.value))
+    return out
+
+
+def _expand_braces(tok):
+    """``a.{b,c}_d`` -> ["a.b_d", "a.c_d"] (one level per pass,
+    fixed-point)."""
+    toks = [tok]
+    while True:
+        nxt = []
+        changed = False
+        for t in toks:
+            m = re.search(r"\{([^{}]*)\}", t)
+            if m is None:
+                nxt.append(t)
+                continue
+            changed = True
+            for alt in m.group(1).split(","):
+                nxt.append(t[:m.start()] + alt + t[m.end():])
+        toks = nxt
+        if not changed:
+            return toks
+
+
+class _DocVocab:
+    """Matcher over the telemetry doc's code-span vocabulary.
+
+    Doc spellings understood (all appear in docs/telemetry.md today):
+    exact names; brace lists ``registry_{hits,misses}``; slash lists
+    ``backend_probe.attempts/ok/failures``; ``<kind>`` placeholders
+    (one dotted-segment wildcard); ``family.*`` wildcards; and
+    ``..._misses`` elisions (same prefix as a sibling row)."""
+
+    def __init__(self, doc):
+        self.exact = set()
+        self.prefixes = []
+        self.regexes = []
+        self.suffixes = []
+        for raw in re.findall(r"`([^`\s]+)`", doc or ""):
+            for tok in _expand_braces(raw):
+                parts = tok.split("/")
+                head = parts[0]
+                stem = (head.rsplit(".", 1)[0] + "."
+                        if "." in head else "")
+                for i, t in enumerate(parts):
+                    name = t if i == 0 or "." in t else stem + t
+                    self._add(name)
+
+    def _add(self, tok):
+        if tok.startswith("..."):
+            self.suffixes.append(tok[3:])
+        elif tok.endswith(".*"):
+            self.prefixes.append(tok[:-1])   # keep the dot
+        elif "<" in tok:
+            pat = re.escape(tok)
+            pat = re.sub(r"<[^<>]*>", r"[A-Za-z0-9_]+", pat)
+            self.regexes.append(re.compile(pat + r"\Z"))
+        else:
+            self.exact.add(tok)
+
+    def covers(self, name) -> bool:
+        if name in self.exact:
+            return True
+        if any(name.startswith(p) for p in self.prefixes):
+            return True
+        if any(name.endswith(s) for s in self.suffixes):
+            return True
+        return any(r.match(name) for r in self.regexes)
+
+
+def _rule_undocumented_telemetry(ctx, notes):
+    out = []
+    all_names = []
+    for rel, tree in sorted(ctx.trees.items()):
+        for lineno, name in _literal_telemetry_names(tree):
+            if "." in name:       # library convention: dotted names
+                all_names.append((rel, lineno, name))
+    if not all_names:
+        return out
+    if ctx.telemetry_doc is None:
+        if not os.path.isdir(os.path.join(ctx.root, "docs")):
+            # installed wheel, not a checkout: the doc is not
+            # shipped, so its absence is a skip, not a finding
+            notes.append("SKIP PTL201: no docs/ tree at this root "
+                         "(installed package?) — run from a checkout "
+                         "to verify telemetry-name coverage")
+            return out
+        out.append(Finding(
+            "PTL201", "docs/telemetry.md", 0,
+            "telemetry doc missing but library source emits "
+            f"{len(all_names)} literal counter/gauge/hist names"))
+        return out
+    vocab = _DocVocab(ctx.telemetry_doc)
+    seen = set()
+    for rel, lineno, name in all_names:
+        if name in seen or vocab.covers(name):
+            continue
+        seen.add(name)
+        out.append(Finding(
+            "PTL201", rel, lineno,
+            f"telemetry name {name!r} is not documented in "
+            "docs/telemetry.md — add a row (family wildcards like "
+            f"`{name.rsplit('.', 1)[0]}.*` count)"))
+    return out
+
+
+# --------------------------------------------------------------------------
+# the rule registry + runner
+# --------------------------------------------------------------------------
+
+#: id -> (title, fn).  Order is report order.
+RULES = OrderedDict([
+    ("PTL001", ("gate-key-site", _rule_gate_key_site)),
+    ("PTL002", ("gate-callsite-sweep", _rule_gate_callsite_sweep)),
+    ("PTL003", ("env-classification", _rule_env_classification)),
+    ("PTL004", ("mesh-axis", _rule_mesh_axis)),
+    ("PTL101", ("raw-jit", _rule_raw_jit)),
+    ("PTL102", ("anonymous-shared-jit", _rule_anonymous_shared_jit)),
+    ("PTL103", ("env-in-trace", _rule_env_in_trace)),
+    ("PTL104", ("host-sync-in-trace", _rule_host_sync_in_trace)),
+    ("PTL201", ("undocumented-telemetry", _rule_undocumented_telemetry)),
+])
+
+
+def repo_root(start=None):
+    """Locate the source tree this module belongs to: the directory
+    holding the ``pint_tpu`` package that contains this file."""
+    here = start or os.path.dirname(os.path.abspath(__file__))
+    return os.path.dirname(os.path.dirname(here))
+
+
+def run(root=None, select=None, ignore=None):
+    """Run the analyzer over the tree at ``root``.
+
+    Returns ``(findings, notes)``: surviving :class:`Finding`s in
+    report order, and the human "OK" notes the gate rules emit for
+    verified key-site tokens.  ``select``/``ignore`` are iterables of
+    rule ids; suppressed-by-comment findings are filtered here, and a
+    malformed allow (no reason) surfaces as PTL000."""
+    root = root or repo_root()
+    ctx = _Ctx(root)
+    selected = set(select) if select else set(RULES) | {"PTL000"}
+    if ignore:
+        selected -= set(ignore)
+    findings, notes = [], []
+    for rule_id, (_title, fn) in RULES.items():
+        if rule_id not in selected:
+            continue
+        for f in fn(ctx, notes):
+            if not ctx.allowed(f.file, f.line, f.rule):
+                findings.append(f)
+    if "PTL000" in selected:
+        for rel, lineno in ctx.bad_allows:
+            findings.append(Finding(
+                "PTL000", rel, lineno,
+                "pintlint allow directive without a reason — spell "
+                "it `# pintlint: allow=<id> -- why this is sound`"))
+    return findings, notes
+
+
+def check(root):
+    """Back-compat entry preserved for ``tools/check_jit_gates.py``
+    and its tier-1 tests: returns ``(lines, rc)`` — "OK"-prefixed
+    notes plus one "FAIL ..." line per finding, rc nonzero iff any
+    finding survived."""
+    findings, notes = run(root)
+    lines = list(notes)
+    for f in findings:
+        where = f"{f.file}:{f.line}" if f.line else f.file
+        lines.append(f"FAIL {where}: [{f.rule}] {f.message}")
+    return lines, (1 if findings else 0)
+
+
+def main(argv=None):
+    """CLI body shared by ``pintlint`` and the tools shim."""
+    import argparse
+    import json as _json
+
+    p = argparse.ArgumentParser(
+        prog="pintlint",
+        description="pint_tpu trace-safety static analyzer "
+                    "(docs/lint.md)")
+    p.add_argument("root", nargs="?", default=None,
+                   help="source tree to analyze (default: the tree "
+                        "this installation was loaded from)")
+    p.add_argument("--select", default=None,
+                   help="comma-separated rule ids to run")
+    p.add_argument("--ignore", default=None,
+                   help="comma-separated rule ids to skip")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable findings")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="suppress the OK notes")
+    args = p.parse_args(argv)
+    if args.list_rules:
+        for rule_id, (title, fn) in RULES.items():
+            print(f"{rule_id}  {title}")
+        return 0
+    select = args.select.split(",") if args.select else None
+    ignore = args.ignore.split(",") if args.ignore else None
+    findings, notes = run(args.root, select=select, ignore=ignore)
+    if args.json:
+        print(_json.dumps([f._asdict() for f in findings], indent=2))
+        return 1 if findings else 0
+    if not args.quiet and not findings:
+        for ln in notes:
+            print(ln)
+    for f in findings:
+        where = f"{f.file}:{f.line}" if f.line else f.file
+        print(f"{where}: {f.rule} {f.message}")
+    verdict = (f"FAILED ({len(findings)} findings)" if findings
+               else "OK")
+    print(f"pintlint: {verdict} ({len(notes)} key-site tokens "
+          f"verified, {len(RULES)} rules)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    raise SystemExit(main(sys.argv[1:]))

@@ -533,6 +533,10 @@ class PreparedModel:
         self.noise_basis = jnp.asarray(
             np.concatenate(parts, axis=1) if parts else np.zeros((n, 0))
         )
+        # pintlint: allow=PTL101 -- legacy per-instance phase accessor
+        # (pre-registry API surface, pintk/polycos); the fit hot path
+        # never touches it — it routes through Residuals' shared
+        # programs
         self._phase_jit = jax.jit(self._phase_raw)
 
     # -- noise interface ------------------------------------------------------

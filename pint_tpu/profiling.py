@@ -49,9 +49,11 @@ import hashlib
 import os
 import threading
 import time
+import warnings
 from collections import OrderedDict
 
 from pint_tpu import telemetry
+from pint_tpu.lint import sanitizer as _sanitizer
 
 __all__ = [
     "PROFILE_ENV", "enabled", "configure", "profiled",
@@ -369,9 +371,34 @@ class _ProfiledProgram:
         # so `pinttrace --runs` lists a run's programs even with
         # profiling off)
         telemetry.run_note_program(self._stats.label)
-        if not enabled():
-            return self._jitted(*args, **kwargs)
-        return _profiled_call(self._jitted, self._stats, args, kwargs)
+        if not _sanitizer.ACTIVE:
+            if not enabled():
+                return self._jitted(*args, **kwargs)
+            return _profiled_call(self._jitted, self._stats, args,
+                                  kwargs)
+        # recompile sanitizer live: bracket the dispatch in a
+        # thread-local scope so the compile listener can attribute
+        # any backend compile to THIS program; a violation surfaces
+        # (raise or warning) only after the underlying call finished,
+        # OUTSIDE the finally, so the sanitizer can never mask an
+        # in-flight exception from the call itself.  Under a
+        # warnings-as-errors filter the warn-mode warning escalates
+        # to an error AFTER the result computed — that is the
+        # filter's explicit request, not a sanitizer crash
+        scope = _sanitizer.begin_dispatch(self._stats)
+        try:
+            if not enabled():
+                out = self._jitted(*args, **kwargs)
+            else:
+                out = _profiled_call(self._jitted, self._stats, args,
+                                     kwargs)
+        finally:
+            outcome = _sanitizer.end_dispatch(scope, args, kwargs)
+        if isinstance(outcome, Exception):
+            raise outcome
+        if outcome is not None:
+            warnings.warn(outcome, RuntimeWarning, stacklevel=2)
+        return out
 
     def lower(self, *args, **kwargs):
         """Forward to the jit's ``lower``, recording the spec — AOT

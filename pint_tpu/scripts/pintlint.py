@@ -1,0 +1,22 @@
+"""``pintlint`` console entry point.
+
+Thin wrapper: the analyzer body lives in
+:mod:`pint_tpu.lint.static` (stdlib-only, also loadable by file path
+— ``tools/check_jit_gates.py`` and editors do exactly that).  This
+module exists so the installed console script resolves through the
+package like every other ``pint*`` tool.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def main(argv=None):
+    from pint_tpu.lint import static
+
+    return static.main(sys.argv[1:] if argv is None else argv)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

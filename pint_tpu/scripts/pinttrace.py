@@ -1,6 +1,6 @@
 """Summarize, export, and gate on pint_tpu telemetry/bench records.
 
-Six modes:
+Seven modes:
 
 - ``pinttrace trace.jsonl`` — aggregate the records written by
   :mod:`pint_tpu.telemetry` (``PINT_TPU_TRACE=trace.jsonl``): spans by
@@ -28,6 +28,11 @@ Six modes:
 - ``pinttrace --convergence RUN_ID trace.jsonl`` — the flight
   recorder's per-iteration chi^2 / step-norm / guard-eps table for
   one run's ``iter_trace`` records (omit RUN_ID for all of them).
+- ``pinttrace --sanitizer trace.jsonl`` — the recompile-sanitizer
+  story (``{"type": "sanitizer"}`` records, docs/lint.md): which
+  programs compiled, classified first / new-shape /
+  same-shape-recompile / unattributed, and every violation an armed
+  process recorded.
 """
 
 from __future__ import annotations
@@ -39,7 +44,7 @@ import sys
 
 __all__ = ["summarize", "chrome_trace", "programs_table",
            "check_regression", "runs_table", "convergence_table",
-           "main"]
+           "sanitizer_table", "main"]
 
 
 def _load(path):
@@ -88,7 +93,8 @@ def aggregate(records):
                 gauges[f"hist.{name}.{k}"] = rec.get(k)
         elif kind in ("program", "sink_rotation", "flops_mismatch",
                       "run", "iter_trace", "health", "aot",
-                      "guard_trip", "guard_rung", "aot_demotion"):
+                      "guard_trip", "guard_rung", "aot_demotion",
+                      "sanitizer"):
             other += 1  # aggregated by their dedicated consumers
         elif kind == "metric" or "metric" in rec:
             metrics.append(rec)
@@ -626,6 +632,49 @@ def _print_lines(lines):
         os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
 
 
+def sanitizer_table(records):
+    """Render the recompile-sanitizer story of a trace: per-program
+    compile census (first / new-shape / same-shape-recompile /
+    unattributed, docs/lint.md) plus one line per violation.  The
+    records are ``{"type": "sanitizer"}`` events the runtime half
+    emits on every attributed compile, arm, and violation."""
+    events = [r for r in records if r.get("type") == "sanitizer"]
+    compiles = [r for r in events if r.get("event") == "compile"]
+    arms = [r for r in events if r.get("event") == "armed"]
+    if not events:
+        return ["(no sanitizer records — set "
+                "PINT_TPU_RECOMPILE_SANITIZER=warn|raise or use "
+                "sanitizer.sanitized())"]
+    per = {}
+    for r in compiles:
+        key = f"{r.get('program', '?')}#{r.get('key', '-')}"
+        st = per.setdefault(key, {"n": 0, "s": 0.0, "kinds": {},
+                                  "violations": 0})
+        st["n"] += int(r.get("n_compiles", 1))
+        st["s"] += float(r.get("compile_s", 0.0))
+        kind = r.get("kind", "?")
+        st["kinds"][kind] = st["kinds"].get(kind, 0) + 1
+        if r.get("violation"):
+            st["violations"] += 1
+    n_viol = sum(st["violations"] for st in per.values())
+    lines = [f"{len(compiles)} attributed compile event(s) across "
+             f"{len(per)} program(s), {n_viol} violation(s), "
+             f"{len(arms)} arm event(s)"]
+    lines.append(f"{'PROGRAM':<40s} {'COMPILES':>8s} {'SECONDS':>8s} "
+                 f"{'VIOL':>5s}  KINDS")
+    for key, st in sorted(per.items(),
+                          key=lambda kv: -kv[1]["violations"]):
+        name = key if len(key) <= 40 else key[:37] + "..."
+        kinds = ",".join(f"{k}x{v}" for k, v in
+                         sorted(st["kinds"].items()))
+        lines.append(f"{name:<40s} {st['n']:>8d} {st['s']:>8.3f} "
+                     f"{st['violations']:>5d}  {kinds}")
+    for r in compiles:
+        if r.get("violation") and r.get("message"):
+            lines.append(f"VIOLATION: {r['message']}")
+    return lines
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         prog="pinttrace",
@@ -654,6 +703,10 @@ def main(argv=None):
                    help="render the per-iteration convergence table "
                         "from iter_trace records (optionally one "
                         "run's)")
+    p.add_argument("--sanitizer", action="store_true",
+                   help="print the recompile-sanitizer story: "
+                        "per-program compile census + every "
+                        "violation record (docs/lint.md)")
     p.add_argument("--check-regression", action="store_true",
                    help="perf-regression sentinel over bench rounds: "
                         "exits 1 on regression/fallback-streak/"
@@ -709,6 +762,8 @@ def main(argv=None):
         _print_lines(programs_table(records))
     elif args.runs:
         _print_lines(runs_table(records))
+    elif args.sanitizer:
+        _print_lines(sanitizer_table(records))
     elif args.convergence is not None:
         _print_lines(convergence_table(records,
                                           args.convergence or None))

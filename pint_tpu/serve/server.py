@@ -134,7 +134,22 @@ class Server:
             warmed = True
         self.mark_warm(warmed)
         telemetry.gauge_set("serve.ready", 1.0)
+        self._arm_sanitizer(warmed)
         return self.aot_report
+
+    @staticmethod
+    def _arm_sanitizer(warmed):
+        """Arm the recompile sanitizer once the replica believes
+        itself warm — from here on a steady-state XLA compile is a
+        counted (warn) or raised (raise-mode, surfaces as that
+        request's structured 503) violation with the offending
+        program named.  Opt-in via $PINT_TPU_RECOMPILE_SANITIZER;
+        a replica that never warmed must not arm (its first flushes
+        legitimately compile)."""
+        from pint_tpu.lint import sanitizer as _san
+
+        if warmed and _san.mode() != "off":
+            _san.arm(note="serve.startup")
 
     def mark_warm(self, warm=True):
         """Flip the readiness gauge (``/readyz`` gates on it): a
@@ -150,6 +165,7 @@ class Server:
                          self.cfg["max_batch"], ops=ops, sizes=sizes,
                          maxiter=maxiter)
         self.mark_warm(True)
+        self._arm_sanitizer(True)
         return out
 
     def start(self, host="127.0.0.1", port=0) -> int:
@@ -390,7 +406,20 @@ class Server:
                      "rejected": len(self.aot_report.get(
                          "rejected", []))}
                     if self.aot_report else None),
+            "sanitizer": self._sanitizer_doc(),
         }
+
+    @staticmethod
+    def _sanitizer_doc():
+        from pint_tpu.lint import sanitizer as _san
+
+        if _san.mode() == "off":
+            return {"mode": "off"}
+        doc = _san.stats()
+        doc["recent_violations"] = [
+            {k: v.get(k) for k in ("program", "kind", "compile_s")}
+            for v in _san.violations()[-5:]]
+        return doc
 
 
 def cold_replica_probe(mode, path, t_start=None, maxiter=3):

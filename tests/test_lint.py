@@ -1,0 +1,655 @@
+"""pintlint tests: the unified trace-safety analyzer
+(pint_tpu/lint/static.py) and the runtime recompile sanitizer
+(pint_tpu/lint/sanitizer.py).
+
+Static half: the repo itself passes every rule (the tier-1 wiring —
+CI fails the moment a rule does); each new rule is exercised on a bad
+fixture (flagged) and a good fixture (clean); inline allow directives
+suppress with a reason and are themselves flagged without one
+(PTL000); the telemetry-doc vocabulary matcher understands every doc
+spelling (brace/slash lists, <kind> placeholders, ..._suffix
+elisions, family wildcards); the tools/check_jit_gates.py shim keeps
+its historical contract (check(root) -> (lines, rc), table names).
+
+Runtime half: compiles are attributed to the dispatching registry
+program via the thread-local scope (exact even from worker threads);
+a warm armed fit passes in raise mode; a forced same-shape recompile
+(registry cleared) raises RecompileError naming the program; warn
+mode warns instead; new shapes are benign unarmed and violations
+armed; the sanitized() context restores state; the serve replica arms
+itself after warmup when the knob is set.  All CPU, tier-1-fast
+shapes.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+from pint_tpu import compile_cache, telemetry
+from pint_tpu.compile_cache import WARM_WLS_PAR
+from pint_tpu.fitter import WLSFitter
+from pint_tpu.lint import sanitizer, static
+from pint_tpu.models.builder import get_model
+from pint_tpu.simulation import make_fake_toas_uniform
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+MESH_PY = os.path.join(REPO_ROOT, "pint_tpu", "parallel", "mesh.py")
+
+
+def _fixture_tree(tmp_path, files, with_mesh=True, with_doc=True):
+    """A minimal analyzable tree: pint_tpu/<name> -> source."""
+    pkg = tmp_path / "pint_tpu"
+    pkg.mkdir(exist_ok=True)
+    if with_mesh:
+        (pkg / "parallel").mkdir(exist_ok=True)
+        with open(MESH_PY) as fh:
+            (pkg / "parallel" / "mesh.py").write_text(fh.read())
+    if with_doc:
+        (tmp_path / "docs").mkdir(exist_ok=True)
+        # the copied mesh.py emits mesh.* names; a family row keeps
+        # the fixture's PTL201 surface limited to the files under test
+        (tmp_path / "docs" / "telemetry.md").write_text(
+            "| `fixture.documented` | a documented counter |\n"
+            "| `mesh.*` | mesh family (copied rule-table module) |\n")
+    for name, src in files.items():
+        path = pkg / name
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(src)
+    return str(tmp_path)
+
+
+def _rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# --------------------------------------------------------------------------
+# static: the repo itself is clean (tier-1 wiring)
+# --------------------------------------------------------------------------
+
+class TestRepoClean:
+    def test_all_rules_pass_on_repo(self):
+        findings, notes = static.run(REPO_ROOT)
+        assert not findings, "\n".join(
+            f"{f.file}:{f.line}: {f.rule} {f.message}"
+            for f in findings)
+        # the migrated gate rule still verifies the key-site tokens
+        assert sum(1 for ln in notes if ln.startswith("OK")) >= 20
+
+    def test_cli_main_ok(self, capsys):
+        rc = static.main([REPO_ROOT, "-q"])
+        assert rc == 0
+        assert "pintlint: OK" in capsys.readouterr().out
+
+    def test_cli_json_and_list_rules(self, capsys):
+        assert static.main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rule_id in static.RULES:
+            assert rule_id in out
+        rc = static.main([REPO_ROOT, "--json"])
+        assert rc == 0
+        assert json.loads(capsys.readouterr().out) == []
+
+
+# --------------------------------------------------------------------------
+# static: rule fixtures
+# --------------------------------------------------------------------------
+
+class TestRawJit:
+    def test_flags_raw_jit(self, tmp_path):
+        root = _fixture_tree(tmp_path, {"bad.py": (
+            "import jax\n"
+            "f = jax.jit(lambda x: x)\n")})
+        findings, _ = static.run(root, select=["PTL101"])
+        assert [f.rule for f in findings] == ["PTL101"]
+        assert findings[0].file == "pint_tpu/bad.py"
+        assert findings[0].line == 2
+
+    def test_flags_decorator_and_partial_spellings(self, tmp_path):
+        root = _fixture_tree(tmp_path, {"bad.py": (
+            "from functools import partial\n"
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return x\n"
+            "g = partial(jax.jit, static_argnums=0)\n")})
+        findings, _ = static.run(root, select=["PTL101"])
+        assert [f.line for f in findings] == [3, 6]
+        assert "@jax.jit" in findings[0].message
+        assert "partial(jax.jit, ...)" in findings[1].message
+
+    def test_flags_bare_jit_imported_from_jax(self, tmp_path):
+        root = _fixture_tree(tmp_path, {"bad.py": (
+            "from jax import jit\n"
+            "f = jit(lambda x: x)\n")})
+        findings, _ = static.run(root, select=["PTL101"])
+        assert [f.line for f in findings] == [2]
+
+    def test_local_jit_helper_clean(self, tmp_path):
+        root = _fixture_tree(tmp_path, {"ok.py": (
+            "def jit(fn):\n"
+            "    return fn\n"
+            "f = jit(lambda x: x)\n")})
+        findings, _ = static.run(root, select=["PTL101"])
+        assert not findings
+
+    def test_allow_with_reason_suppresses(self, tmp_path):
+        root = _fixture_tree(tmp_path, {"ok.py": (
+            "import jax\n"
+            "# pintlint: allow=PTL101 -- one-shot probe, no reuse\n"
+            "f = jax.jit(lambda x: x)\n")})
+        findings, _ = static.run(root, select=["PTL101"])
+        assert not findings
+
+    def test_allow_in_comment_block_above(self, tmp_path):
+        root = _fixture_tree(tmp_path, {"ok.py": (
+            "import jax\n"
+            "# pintlint: allow=PTL101 -- reason up top of a\n"
+            "# multi-line explanation block\n"
+            "f = jax.jit(lambda x: x)\n")})
+        findings, _ = static.run(root, select=["PTL101"])
+        assert not findings
+
+    def test_allow_without_reason_is_ptl000(self, tmp_path):
+        root = _fixture_tree(tmp_path, {"bad.py": (
+            "import jax\n"
+            "# pintlint: allow=PTL101\n"
+            "f = jax.jit(lambda x: x)\n")})
+        findings, _ = static.run(root, select=["PTL101", "PTL000"])
+        assert _rules_of(findings) == {"PTL000"}
+        # default run (no select) surfaces it too
+        findings, _ = static.run(root)
+        assert "PTL000" in _rules_of(findings)
+
+    def test_ptl000_honors_select_and_ignore(self, tmp_path):
+        root = _fixture_tree(tmp_path, {"bad.py": (
+            "import jax\n"
+            "# pintlint: allow=PTL101\n"
+            "f = jax.jit(lambda x: x)\n")})
+        findings, _ = static.run(root, select=["PTL101"])
+        assert not findings  # PTL000 not selected
+        findings, _ = static.run(root, ignore=["PTL000"])
+        assert "PTL000" not in _rules_of(findings)
+
+    def test_exempt_file_passes(self, tmp_path):
+        root = _fixture_tree(tmp_path, {"compile_cache.py": (
+            "import jax\n"
+            "f = jax.jit(lambda x: x)\n")})
+        findings, _ = static.run(root, select=["PTL101"])
+        assert not findings
+
+
+class TestAnonymousSharedJit:
+    def test_lambda_without_fn_token_flags(self, tmp_path):
+        root = _fixture_tree(tmp_path, {"bad.py": (
+            "from pint_tpu.compile_cache import shared_jit\n"
+            "f = shared_jit(lambda x: x, key=('k',))\n")})
+        findings, _ = static.run(root, select=["PTL102"])
+        assert [f.rule for f in findings] == ["PTL102"]
+        assert "fn_token" in findings[0].message
+
+    def test_lambda_with_fn_token_clean(self, tmp_path):
+        root = _fixture_tree(tmp_path, {"ok.py": (
+            "from pint_tpu.compile_cache import shared_jit\n"
+            "f = shared_jit(lambda x: x, key=('k',),\n"
+            "               fn_token='mod.thing')\n")})
+        findings, _ = static.run(root, select=["PTL102"])
+        assert not findings
+
+
+class TestTracedFunctionHygiene:
+    def test_env_read_in_traced_fn_flags(self, tmp_path):
+        root = _fixture_tree(tmp_path, {"bad.py": (
+            "import os\n"
+            "import jax\n"
+            "def body(c, _):\n"
+            "    if os.environ.get('PINT_TPU_GUARD'):\n"
+            "        c = c + 1\n"
+            "    return c, None\n"
+            "out = jax.lax.scan(body, 0, None, length=3)\n")})
+        findings, _ = static.run(root, select=["PTL103"])
+        assert [f.rule for f in findings] == ["PTL103"]
+        assert "body" in findings[0].message
+
+    def test_env_read_in_host_fn_clean(self, tmp_path):
+        root = _fixture_tree(tmp_path, {"ok.py": (
+            "import os\n"
+            "def resolver():\n"
+            "    return os.environ.get('PINT_TPU_GUARD')\n")})
+        findings, _ = static.run(root, select=["PTL103"])
+        assert not findings
+
+    def test_item_in_traced_fn_flags(self, tmp_path):
+        root = _fixture_tree(tmp_path, {"bad.py": (
+            "import jax\n"
+            "def fn(x):\n"
+            "    return x.sum().item()\n"
+            "g = jax.vmap(fn)\n")})
+        findings, _ = static.run(root, select=["PTL104"])
+        assert [f.rule for f in findings] == ["PTL104"]
+
+    def test_item_outside_trace_clean(self, tmp_path):
+        root = _fixture_tree(tmp_path, {"ok.py": (
+            "def host_read(x):\n"
+            "    return x.sum().item()\n")})
+        findings, _ = static.run(root, select=["PTL104"])
+        assert not findings
+
+    def test_env_read_in_decorator_jitted_fn_flags(self, tmp_path):
+        root = _fixture_tree(tmp_path, {"bad.py": (
+            "import os\n"
+            "from functools import partial\n"
+            "import jax\n"
+            "@partial(jax.jit, static_argnums=0)\n"
+            "def f(n, x):\n"
+            "    if os.getenv('PINT_TPU_GUARD'):\n"
+            "        return x\n"
+            "    return -x\n"
+            "@jax.jit\n"
+            "def g(x):\n"
+            "    return x.sum().item()\n")})
+        findings, _ = static.run(root, select=["PTL103", "PTL104"])
+        assert _rules_of(findings) == {"PTL103", "PTL104"}
+
+
+class TestTelemetryDocCoverage:
+    def test_undocumented_name_flags(self, tmp_path):
+        root = _fixture_tree(tmp_path, {"bad.py": (
+            "from pint_tpu import telemetry\n"
+            "telemetry.counter_add('totally.new.counter')\n")})
+        findings, _ = static.run(root, select=["PTL201"])
+        assert [f.rule for f in findings] == ["PTL201"]
+        assert "totally.new.counter" in findings[0].message
+
+    def test_documented_and_wildcard_clean(self, tmp_path):
+        root = _fixture_tree(tmp_path, {"ok.py": (
+            "from pint_tpu import telemetry\n"
+            "telemetry.counter_add('fixture.documented')\n"
+            "telemetry.gauge_set('covered.by.wildcard', 1.0)\n")})
+        (tmp_path / "docs" / "telemetry.md").write_text(
+            "| `fixture.documented` | row |\n"
+            "| `covered.*` | family row |\n"
+            "| `mesh.*` | the copied rule-table module |\n")
+        findings, _ = static.run(root, select=["PTL201"])
+        assert not findings
+
+    def test_no_docs_tree_skips_with_note(self, tmp_path):
+        root = _fixture_tree(tmp_path, {"mod.py": (
+            "from pint_tpu import telemetry\n"
+            "telemetry.counter_add('fixture.undocumented')\n")},
+            with_doc=False)
+        findings, notes = static.run(root, select=["PTL201"])
+        assert not findings
+        assert any("SKIP PTL201" in n for n in notes)
+
+    def test_docs_tree_without_doc_still_flags(self, tmp_path):
+        root = _fixture_tree(tmp_path, {"mod.py": (
+            "from pint_tpu import telemetry\n"
+            "telemetry.counter_add('fixture.undocumented')\n")},
+            with_doc=False)
+        os.makedirs(os.path.join(root, "docs"))
+        findings, _ = static.run(root, select=["PTL201"])
+        assert [f.rule for f in findings] == ["PTL201"]
+        assert "telemetry doc missing" in findings[0].message
+
+    def test_fstring_names_skipped(self, tmp_path):
+        root = _fixture_tree(tmp_path, {"ok.py": (
+            "from pint_tpu import telemetry\n"
+            "kind = 'x'\n"
+            "telemetry.counter_add(f'family.{kind}')\n")})
+        findings, _ = static.run(root, select=["PTL201"])
+        assert not findings
+
+    def test_vocab_spellings(self):
+        vocab = static._DocVocab(
+            "text `compile_cache.registry_{hits,misses}` and "
+            "`backend_probe.attempts/ok/failures` and "
+            "`guard.trip.<kind>` and `serve.*` and `..._saved` end")
+        for name in ("compile_cache.registry_hits",
+                     "compile_cache.registry_misses",
+                     "backend_probe.attempts", "backend_probe.ok",
+                     "backend_probe.failures",
+                     "guard.trip.anything_at_all",
+                     "serve.requests", "thing.time_saved"):
+            assert vocab.covers(name), name
+        for name in ("compile_cache.registry_evictions",
+                     "backend_probe.retries", "guard.other"):
+            assert not vocab.covers(name), name
+
+
+# --------------------------------------------------------------------------
+# static: the migrated gate rules + the shim contract
+# --------------------------------------------------------------------------
+
+def _load_shim():
+    spec = importlib.util.spec_from_file_location(
+        "check_jit_gates_shim",
+        os.path.join(REPO_ROOT, "tools", "check_jit_gates.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestShimCompat:
+    def test_check_repo_passes(self):
+        shim = _load_shim()
+        lines, rc = shim.check(REPO_ROOT)
+        assert rc == 0, "\n".join(
+            ln for ln in lines if not ln.startswith("OK"))
+
+    def test_tables_reexported(self):
+        # the shim loads static.py by FILE PATH (no jax import), so
+        # its tables are equal, not identical, to the package module's
+        shim = _load_shim()
+        assert shim.TRACE_GATES == static.TRACE_GATES
+        assert "PINT_TPU_GUARD" in shim.TRACE_GATES
+        assert "PINT_TPU_RECOMPILE_SANITIZER" in shim.HOST_ONLY
+        assert shim.KEY_SITES and shim.EXEMPT
+
+    def test_missing_key_token_still_flags(self, tmp_path):
+        shim = _load_shim()
+        root = _fixture_tree(tmp_path, {"bad.py": (
+            "from pint_tpu import compile_cache as _cc\n"
+            "def build():\n"
+            "    scan = _cc.scan_iters_default()\n"
+            "    return _cc.shared_jit(f, key=('bad',))\n")})
+        lines, rc = shim.check(root)
+        assert rc == 1
+        assert any("pint_tpu/bad.py" in ln
+                   and "PINT_TPU_SCAN_ITERS" in ln for ln in lines)
+
+    def test_unclassified_env_var_still_flags(self, tmp_path):
+        shim = _load_shim()
+        root = _fixture_tree(tmp_path, {"novel.py": (
+            "import os\n"
+            "X = os.environ.get('PINT_TPU_TOTALLY_NEW_KNOB')\n")})
+        lines, rc = shim.check(root)
+        assert rc == 1
+        assert any("PINT_TPU_TOTALLY_NEW_KNOB" in ln for ln in lines)
+
+
+# --------------------------------------------------------------------------
+# runtime: the recompile sanitizer
+# --------------------------------------------------------------------------
+
+def _mk_fit_pair(n=60, seed=0):
+    model = get_model(WARM_WLS_PAR)
+    toas = make_fake_toas_uniform(
+        53000.0, 54000.0, n, model, freq_mhz=1400.0, obs="gbt",
+        error_us=1.0, add_noise=True,
+        rng=np.random.default_rng(seed))
+    return model, toas
+
+
+@pytest.fixture()
+def clean_sanitizer():
+    sanitizer.reset()
+    yield
+    sanitizer.reset()
+    sanitizer.configure("off")
+
+
+def _monitoring_live():
+    return telemetry.compile_stats()["source"] == "jax.monitoring"
+
+
+class TestSanitizer:
+    def test_off_by_default(self):
+        assert sanitizer.mode() in ("off", "warn", "raise")
+        if not os.environ.get(sanitizer.MODE_ENV):
+            sanitizer.configure(None)
+            assert sanitizer.mode() == "off"
+            assert not sanitizer.ACTIVE
+
+    def test_mode_parsing(self):
+        assert sanitizer._parse_mode("") == "off"
+        assert sanitizer._parse_mode("0") == "off"
+        assert sanitizer._parse_mode("off") == "off"
+        assert sanitizer._parse_mode("warn") == "warn"
+        assert sanitizer._parse_mode("1") == "warn"
+        assert sanitizer._parse_mode("raise") == "raise"
+        assert sanitizer._parse_mode("strict") == "raise"
+
+    def test_warm_armed_fit_passes_raise_mode(self, clean_sanitizer):
+        model, toas = _mk_fit_pair()
+        WLSFitter(toas, model).fit_toas(maxiter=3)  # warm the registry
+        with sanitizer.sanitized(mode="raise"):
+            f = WLSFitter(toas, get_model(WARM_WLS_PAR))
+            f.fit_toas(maxiter=3)  # same structure: zero compiles
+        assert not sanitizer.violations()
+
+    def test_forced_recompile_raises_with_attribution(
+            self, clean_sanitizer):
+        if not _monitoring_live():
+            pytest.skip("jax.monitoring unavailable")
+        model, toas = _mk_fit_pair()
+        WLSFitter(toas, model).fit_toas(maxiter=3)
+        compile_cache.clear_registry()
+        with pytest.raises(sanitizer.RecompileError) as exc:
+            with sanitizer.sanitized(mode="raise"):
+                WLSFitter(toas, get_model(WARM_WLS_PAR)).fit_toas(
+                    maxiter=3)
+        # the violation names a real registry program
+        assert "#" in str(exc.value)
+        assert sanitizer.ledger()
+        last = sanitizer.ledger()[-1]
+        assert last["violation"]
+        assert last["program"] != "(unattributed)"
+
+    def test_same_shape_recompile_classified(self, clean_sanitizer):
+        """With the sanitizer active across BOTH fits, the registry
+        eviction is classified as the always-a-violation
+        same_shape_recompile kind — even unarmed."""
+        if not _monitoring_live():
+            pytest.skip("jax.monitoring unavailable")
+        model, toas = _mk_fit_pair()
+        sanitizer.configure("warn")
+        try:
+            WLSFitter(toas, model).fit_toas(maxiter=3)
+            compile_cache.clear_registry()
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                WLSFitter(toas, get_model(WARM_WLS_PAR)).fit_toas(
+                    maxiter=3)
+        finally:
+            sanitizer.configure("off")
+        kinds = {r["kind"] for r in sanitizer.ledger()
+                 if r["violation"]}
+        assert "same_shape_recompile" in kinds
+        assert any("recompiled a spec" in str(w.message)
+                   for w in caught)
+        assert not sanitizer.armed()  # unarmed the whole time
+
+    def test_cold_compiles_benign_unarmed(self, clean_sanitizer):
+        if not _monitoring_live():
+            pytest.skip("jax.monitoring unavailable")
+        compile_cache.clear_registry()
+        sanitizer.configure("warn")
+        try:
+            model, toas = _mk_fit_pair(n=61, seed=3)
+            WLSFitter(toas, model).fit_toas(maxiter=3)
+        finally:
+            sanitizer.configure("off")
+        recs = sanitizer.ledger()
+        assert recs, "cold fit must attribute compiles"
+        assert all(r["kind"] == "first" for r in recs)
+        assert not any(r["violation"] for r in recs)
+
+    def test_disk_cache_served_rebuild_classified(self,
+                                                  clean_sanitizer):
+        """A registry miss served by the persistent compilation cache
+        emits only compile_time_saved (no backend_compile): zero
+        compiles but cached=True must still be classified — it is the
+        same violation class, just cheaper."""
+        sanitizer.configure("warn")
+        try:
+            sanitizer.arm(note="cache-test")
+
+            class _Stats:
+                label = "fixture.prog"
+                key_hash = "deadbeef"
+
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                scope = sanitizer.begin_dispatch(_Stats())
+                scope.cached = True  # as _on_duration would set it
+                msg = sanitizer.end_dispatch(scope, (), {})
+            assert msg is not None and "disk cache" in msg
+            last = sanitizer.ledger()[-1]
+            assert last["cache_served"] and last["violation"]
+        finally:
+            sanitizer.disarm()
+            sanitizer.configure("off")
+
+    def test_listener_silent_when_off(self, clean_sanitizer):
+        """jax.monitoring has no deregister, so the permanently
+        registered listener must gate on ACTIVE itself: an off
+        sanitizer counts nothing (a post-sanitized() compile must
+        not tick sanitizer.unattributed_compiles)."""
+        sanitizer.configure("off")
+        before = telemetry.counters().get(
+            "sanitizer.unattributed_compiles", 0.0)
+        sanitizer._on_duration("/jax/backend_compile_time_secs", 0.25)
+        assert telemetry.counters().get(
+            "sanitizer.unattributed_compiles", 0.0) == before
+        assert not sanitizer.ledger()
+        sanitizer.configure("warn")
+        try:
+            sanitizer._on_duration(
+                "/jax/backend_compile_time_secs", 0.25)
+            assert telemetry.counters().get(
+                "sanitizer.unattributed_compiles", 0.0) == before + 1
+        finally:
+            sanitizer.configure("off")
+
+    def test_sanitized_restores_state(self, clean_sanitizer):
+        sanitizer.configure("off")
+        with sanitizer.sanitized(mode="raise"):
+            assert sanitizer.mode() == "raise"
+            assert sanitizer.armed()
+            assert sanitizer.ACTIVE
+        assert sanitizer.mode() == "off"
+        assert not sanitizer.armed()
+        assert not sanitizer.ACTIVE
+
+    def test_arm_implies_active(self, clean_sanitizer):
+        sanitizer.configure("off")
+        sanitizer.arm(note="test")
+        try:
+            assert sanitizer.ACTIVE
+            assert sanitizer.mode() == "warn"
+            assert sanitizer.stats()["armed_note"] == "test"
+        finally:
+            sanitizer.disarm()
+            sanitizer.configure("off")
+
+    def test_stats_and_gauge(self, clean_sanitizer):
+        sanitizer.configure("warn")
+        try:
+            st = sanitizer.stats()
+            assert st["mode"] == "warn"
+            assert st["listener"] in ("jax.monitoring", "fallback")
+            sanitizer.arm(note="g")
+            assert telemetry.gauges().get("sanitizer.armed") == 1.0
+            sanitizer.disarm()
+            assert telemetry.gauges().get("sanitizer.armed") == 0.0
+        finally:
+            sanitizer.configure("off")
+
+    def test_trace_records_and_pinttrace_table(self, clean_sanitizer,
+                                               tmp_path):
+        if not _monitoring_live():
+            pytest.skip("jax.monitoring unavailable")
+        from pint_tpu.scripts.pinttrace import sanitizer_table
+
+        sink_path = tmp_path / "trace.jsonl"
+        prev = telemetry.sink_info()
+        model, toas = _mk_fit_pair()
+        # cold fit WITH the sanitizer active so its compiles seed the
+        # per-program spec history — the later eviction then
+        # classifies as same_shape_recompile, not "first"
+        compile_cache.clear_registry()
+        sanitizer.configure("warn")
+        WLSFitter(toas, model).fit_toas(maxiter=3)
+        sanitizer.configure("off")
+        compile_cache.clear_registry()
+        with open(sink_path, "w") as sink:
+            telemetry.configure(sink=sink)
+            try:
+                sanitizer.configure("warn")
+                with warnings.catch_warnings():
+                    warnings.simplefilter("ignore")
+                    WLSFitter(toas, get_model(WARM_WLS_PAR)).fit_toas(
+                        maxiter=3)
+                sanitizer.configure("off")
+                telemetry.flush()
+            finally:
+                if prev["path"] is not None:
+                    telemetry.configure(sink=prev["path"],
+                                        enabled=prev["enabled"])
+                else:
+                    telemetry.configure(sink=prev["sink"],
+                                        enabled=prev["enabled"])
+        records = [json.loads(ln) for ln in open(sink_path)
+                   if ln.strip()]
+        san = [r for r in records if r.get("type") == "sanitizer"]
+        assert san, "sanitizer records must reach the sink"
+        lines = sanitizer_table(records)
+        text = "\n".join(lines)
+        assert "violation" in text.lower()
+        assert "same_shape_recompile" in text
+
+    def test_empty_trace_table(self):
+        from pint_tpu.scripts.pinttrace import sanitizer_table
+
+        lines = sanitizer_table([{"type": "span", "name": "x"}])
+        assert "no sanitizer records" in lines[0]
+
+
+class TestServeArming:
+    def test_startup_arms_when_knob_set(self, clean_sanitizer,
+                                        tmp_path):
+        from pint_tpu.serve.server import Server
+
+        sanitizer.configure("warn")
+        try:
+            srv = Server(job_dir=str(tmp_path / "jobs"))
+            srv.startup(warm=True)
+            assert sanitizer.armed()
+            assert sanitizer.stats()["armed_note"] == "serve.startup"
+            doc = srv._stats_doc()
+            assert doc["sanitizer"]["mode"] == "warn"
+            assert doc["sanitizer"]["armed"] is True
+        finally:
+            sanitizer.disarm()
+            sanitizer.configure("off")
+
+    def test_startup_does_not_arm_when_off(self, clean_sanitizer,
+                                           tmp_path):
+        from pint_tpu.serve.server import Server
+
+        sanitizer.configure("off")
+        srv = Server(job_dir=str(tmp_path / "jobs2"))
+        srv.startup(warm=True)
+        assert not sanitizer.armed()
+        assert srv._stats_doc()["sanitizer"] == {"mode": "off"}
+
+
+# --------------------------------------------------------------------------
+# datacheck --lint smoke
+# --------------------------------------------------------------------------
+
+class TestDatacheckLint:
+    def test_lint_section_ok(self, clean_sanitizer):
+        from pint_tpu.datacheck import _lint_section
+
+        lines = _lint_section()
+        text = "\n".join(lines)
+        assert "PROBLEM" not in text and "ERROR" not in text
+        assert "static analyzer" in text
+        assert "caught" in text

@@ -1,427 +1,54 @@
-"""Lint: every ``$PINT_TPU_*`` env gate that changes a traced program
-must appear in the corresponding shared-jit key.
+"""Compatibility shim: the jit-gate lint grew into the unified
+trace-safety analyzer at :mod:`pint_tpu.lint.static` (the
+``pintlint`` CLI) — rule ids PTL001-PTL004 are the four checks that
+used to live here (gate->key coverage, new-call-site sweep, env-var
+classification, mesh-axis coverage), now joined by the
+registry-bypass, traced-function-hygiene, and telemetry-doc rules.
 
-The failure mode this guards against is SILENT and nasty: a gate like
-``$PINT_TPU_ITER_TRACE`` or ``$PINT_TPU_SCAN_ITERS`` changes the
-program a trace builds, but the process-level shared-jit registry
-(:func:`pint_tpu.compile_cache.shared_jit`) serves entries by KEY —
-if the gate is read at trace-build time but left out of the key,
-flipping the gate serves the STALE program from the registry with no
-error anywhere (the same latent-hole class the fitter's ``_retrace``
-closed for free-set changes).  PR 8's scan flag, PR 4's guard flag,
-PR 5's design gates, and PR 10's iter-trace flag all carry this
-obligation; this lint makes it checkable.
-
-Three checks, run as a tier-1 test (tests/test_flight_recorder.py):
-
-1. **Key-site coverage** — for each registered trace-changing gate,
-   the declared key-construction functions must contain the token
-   that carries the gate into the key (``self._guard_on``, ``scan``,
-   ``trace``, ...).  Function sources come from ``ast`` (qualname
-   walk + ``get_source_segment``), so a refactor that renames or
-   drops a token fails here.
-2. **New-call-site sweep** — any module that calls a gate resolver
-   (``iter_trace_default()``, ``guard.enabled()``, ...) AND builds
-   shared-jit keys must be declared in :data:`KEY_SITES` or
-   :data:`EXEMPT` (with a recorded justification).  Adding a gate
-   read to a new jit-building module trips the lint until the author
-   states where the gate lands in the key — the "silent stale-trace
-   bug" can no longer be committed absent-mindedly.
-3. **Env-var classification** — every ``PINT_TPU_[A-Z0-9_]+`` name
-   appearing in library source must be classified as either a
-   registered trace gate or a known host-only variable
-   (:data:`HOST_ONLY`).  A brand-new env var fails until classified,
-   which is exactly the moment to decide whether it needs key
-   participation.
-
-4. **Mesh-axis coverage** — every mesh-axis name literal used in a
-   ``PartitionSpec`` rule table (or ``make_mesh``/``resolve_axis``
-   call) across library source must appear in
-   ``parallel/mesh.AXIS_NAMES``, and ``mesh_jit_key`` must derive
-   its axis entries generically from ``mesh.axis_names`` (or name
-   every known axis explicitly).  Together these make it impossible
-   for a NEW rule-table axis to miss the jit key: the generic
-   ``mesh_jit_key`` folds any axis a mesh carries into every sharded
-   key, and a typo'd or undeclared axis name in a rule table fails
-   here instead of silently mis-sharding — the same
-   stale-trace/poisoned-zero-recompile class as an unkeyed gate.
+This file keeps the historical entry points alive for callers that
+load it by path or with ``tools/`` on ``sys.path``
+(tests/test_flight_recorder.py, tests/test_pod_sharding.py, CI
+one-liners): ``check(root) -> (lines, rc)`` and the table names
+(``TRACE_GATES``/``KEY_SITES``/``EXEMPT``/``HOST_ONLY``) re-export
+from the analyzer.  The analyzer module is loaded by FILE PATH, not
+package import — the lint must keep running without jax, and
+importing ``pint_tpu`` would pull it in.
 """
 
 from __future__ import annotations
 
-import ast
+import importlib.util
 import os
-import re
 import sys
+
+_STATIC_PY = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "pint_tpu", "lint", "static.py")
+
+
+def _load_static():
+    mod = sys.modules.get("_pintlint_static")
+    if mod is not None:
+        return mod
+    spec = importlib.util.spec_from_file_location(
+        "_pintlint_static", _STATIC_PY)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["_pintlint_static"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_static = _load_static()
 
 __all__ = ["check", "main", "TRACE_GATES", "KEY_SITES", "EXEMPT",
            "HOST_ONLY"]
 
-#: trace-changing gates: env var -> source tokens that resolve it.
-#: A file "uses" the gate when any token appears in its source.
-TRACE_GATES = {
-    "PINT_TPU_GUARD": ("_guard.enabled()", "guard.enabled()"),
-    "PINT_TPU_SCAN_ITERS": ("scan_iters_default()",),
-    "PINT_TPU_ITER_TRACE": ("iter_trace_default()",),
-    "PINT_TPU_HYBRID_DESIGN": ("hybrid_design_default()",),
-    "PINT_TPU_FROZEN_DELAY": ("frozen_delay_default()",),
-    "PINT_TPU_SEGMENT_ECORR": ("segment_ecorr_default()",),
-    "PINT_TPU_KRON_PHI": ("kron_phi_default()",),
-}
+TRACE_GATES = _static.TRACE_GATES
+KEY_SITES = _static.KEY_SITES
+EXEMPT = _static.EXEMPT
+HOST_ONLY = _static.HOST_ONLY
 
-#: key sites: file -> {dotted function path: {gate: token that must
-#: appear in that function's source}}.  The token is how the gate
-#: rides the key at that site (a resolver call, or the local/attr
-#: name its trace-build-time resolution was stored under).
-KEY_SITES = {
-    "pint_tpu/fitter.py": {
-        "Fitter._step_key": {
-            "PINT_TPU_GUARD": "self._guard_on",
-            "PINT_TPU_ITER_TRACE": "self._iter_trace",
-            # the design gates enter through the partition/frozen
-            # tuples they deterministically derive
-            "PINT_TPU_HYBRID_DESIGN": "self._partition",
-            "PINT_TPU_FROZEN_DELAY": "self._frozen_names",
-        },
-    },
-    "pint_tpu/downhill.py": {
-        "_DownhillMixin._retrace": {
-            "PINT_TPU_GUARD": "self._guard_on",
-            "PINT_TPU_ITER_TRACE": "self._iter_trace",
-            "PINT_TPU_HYBRID_DESIGN": "self._partition",
-            "PINT_TPU_FROZEN_DELAY": "self._frozen_names",
-        },
-    },
-    "pint_tpu/lmfitter.py": {
-        "LMFitter._retrace": {
-            "PINT_TPU_GUARD": "self._guard_on",
-            "PINT_TPU_HYBRID_DESIGN": "self._partition",
-            "PINT_TPU_FROZEN_DELAY": "self._frozen_names",
-        },
-        "PowellFitter._retrace": {
-            "PINT_TPU_FROZEN_DELAY": "self._frozen_names",
-        },
-    },
-    "pint_tpu/grid.py": {
-        "make_grid_fn": {
-            "PINT_TPU_SCAN_ITERS": "scan",
-            "PINT_TPU_ITER_TRACE": "trace",
-            "PINT_TPU_HYBRID_DESIGN": "hybrid_design_default()",
-            "PINT_TPU_FROZEN_DELAY": "frozen_delay_default()",
-        },
-    },
-    "pint_tpu/parallel/pta.py": {
-        "PTABatch._batched_fit_jit": {
-            "PINT_TPU_GUARD": "with_health",
-            "PINT_TPU_SCAN_ITERS": "scan",
-            "PINT_TPU_ITER_TRACE": "trace",
-        },
-        # the 2-D pulsar x grid scan resolves the scan flag itself
-        "PTABatch._chisq_grid_jit": {
-            "PINT_TPU_SCAN_ITERS": "scan",
-        },
-        # the design partition rides _structure_key
-        "PTABatch._structure_key": {
-            "PINT_TPU_HYBRID_DESIGN": "self._partition",
-        },
-    },
-    "pint_tpu/residuals.py": {
-        # segment-ECORR changes every Woodbury trace; it keys through
-        # the StructuredU-vs-dense bit of the structure key
-        "Residuals._structure_key": {
-            "PINT_TPU_SEGMENT_ECORR": "StructuredU",
-        },
-    },
-    "pint_tpu/gw/common.py": {
-        # the kron/dense prior selection is a different traced
-        # program (different argument layouts entirely); the gate
-        # resolves once at CommonProcess build into self._kron, which
-        # both lnlike keys carry
-        "CommonProcess._lnlike_jit": {
-            "PINT_TPU_KRON_PHI": "self._kron",
-        },
-        "CommonProcess.lnlike_grid": {
-            "PINT_TPU_KRON_PHI": "self._kron",
-        },
-    },
-    "pint_tpu/gw/hmc.py": {
-        # the HMC chunk scan resolves the scan flag itself and keys
-        # it (scan vs unroll are different programs); the kron flag
-        # rides the key via posterior.kron (resolved upstream at
-        # CommonProcess build)
-        "run_nuts": {
-            "PINT_TPU_SCAN_ITERS": "scan_flag",
-        },
-    },
-}
-
-#: modules that call a gate resolver AND build shared-jit keys but
-#: are deliberately NOT key sites for it — each with the reason the
-#: exemption is sound.  An exemption without a reason is a lint bug.
-EXEMPT = {
-    ("pint_tpu/sampler.py", "PINT_TPU_GUARD"):
-        "chain health always rides the traced program (kept OUT of "
-        "the key by design); guard gate is honored host-side only",
-    ("pint_tpu/gw/common.py", "PINT_TPU_GUARD"):
-        "lnlike health always rides the traced program; the gate "
-        "changes only the host-side raise",
-    ("pint_tpu/datacheck.py", "*"):
-        "reporting only: resolvers are read to PRINT gate state, "
-        "never to build a traced program",
-    ("pint_tpu/models/timing_model.py", "*"):
-        "defines the design-gate resolvers; its own shared_jit use "
-        "is none (prepare() is host-side)",
-    ("pint_tpu/compile_cache.py", "*"):
-        "defines scan/iter-trace resolvers and the registry itself; "
-        "iterate_fixed receives the resolved flag from callers",
-    ("pint_tpu/fitter.py", "PINT_TPU_SCAN_ITERS"):
-        "the single-pulsar fit loop is host-driven (no iterate_fixed "
-        "inside its trace)",
-    ("pint_tpu/residuals.py", "PINT_TPU_GUARD"):
-        "residuals accessors compute no health output; the guard "
-        "gate never reaches their traces",
-    ("pint_tpu/gw/hmc.py", "PINT_TPU_ITER_TRACE"):
-        "HMC per-draw records always ride the scan ys (they ARE the "
-        "returned chain, gate on or off — one traced program); the "
-        "gate controls only host-side iter_trace telemetry emission",
-    ("pint_tpu/gw/hmc.py", "PINT_TPU_GUARD"):
-        "chain health is read from the returned draws host-side (the "
-        "sampler.py convention); the gate changes only the host-side "
-        "raise, never the traced chunk program",
-}
-
-#: known host-only PINT_TPU_* env vars: they change behavior outside
-#: any traced program (paths, timeouts, reporting, process harness),
-#: so key participation is not required.
-HOST_ONLY = {
-    "PINT_TPU_CACHE_DIR", "PINT_TPU_CLOCK_DIR", "PINT_TPU_IERS_DIR",
-    "PINT_TPU_EPHEM_DIR", "PINT_TPU_EPHEM_BUILTIN",
-    "PINT_TPU_NO_BUILTIN_DATA", "PINT_TPU_OBS", "PINT_TPU_LOG",
-    "PINT_TPU_TRACE", "PINT_TPU_TRACE_MAX_MB", "PINT_TPU_PROFILE",
-    "PINT_TPU_METRICS_PORT", "PINT_TPU_METRICS_HOST",
-    "PINT_TPU_JIT_REGISTRY_CAP", "PINT_TPU_DONATE_CPU",
-    "PINT_TPU_AOT_CODEC", "PINT_TPU_FAULTS",
-    "PINT_TPU_PROBE_TIMEOUT", "PINT_TPU_PROBE_RETRIES",
-    "PINT_TPU_PROBE_BACKOFF",
-    "PINT_TPU_BENCH_CPU", "PINT_TPU_BENCH_FALLBACK",
-    "PINT_TPU_BENCH_PROBE_TIMEOUT", "PINT_TPU_BENCH_METRIC_TIMEOUT",
-    "PINT_TPU_BENCH_FALLBACK_TIMEOUT",
-    "PINT_TPU_MEASURED_PEAK_F64", "PINT_TPU_MEASURED_PEAK_BACKEND",
-    # bucketing pads the DATASET host-side; the padded shape reaches
-    # the key through the avals/structure, not through the gate
-    "PINT_TPU_BUCKET_TOAS",
-    # the warm fitting service (pint_tpu/serve/): every knob is
-    # host-only BY DESIGN — the batcher must never create traced
-    # programs beyond the existing PTA-batch registry keys
-    # (pta.batched_fit / pta.chisq / pta.resid), whose identities are
-    # carried by bucket, size class, structure, and maxiter through
-    # the ordinary aval/key machinery.  Flush cadence, queue bounds,
-    # deadlines, ports, and directories shape WHEN and HOW MANY
-    # requests share a program, never the program itself
-    # (tests/test_serve.py asserts the zero-new-compile contract on a
-    # repeated same-bucket flush).
-    "PINT_TPU_SERVE_FLUSH_MS", "PINT_TPU_SERVE_MAX_BATCH",
-    "PINT_TPU_SERVE_QUEUE_MAX", "PINT_TPU_SERVE_DEADLINE_MS",
-    "PINT_TPU_SERVE_GRID_CHUNK", "PINT_TPU_SERVE_PORT",
-    "PINT_TPU_SERVE_HOST", "PINT_TPU_SERVE_JOB_DIR",
-    "PINT_TPU_SERVE_AOT_DIR",
-    # the token the regex extracts from the docstring wildcard
-    # spelling ``PINT_TPU_SERVE_*`` (prose about the family, not a
-    # variable); every real member is enumerated above
-    "PINT_TPU_SERVE_",
-}
-
-_ENV_RE = re.compile(r"PINT_TPU_[A-Z0-9_]+")
-
-#: function names whose string-literal arguments name mesh axes
-_AXIS_CALLS = {"P", "PartitionSpec", "_P", "make_mesh",
-               "resolve_axis", "axis_size", "RowShard"}
-
-
-def _axis_names_from_source(src):
-    """The AXIS_NAMES tuple parsed out of parallel/mesh.py source
-    (ast, not import — the lint must run without jax)."""
-    tree = ast.parse(src)
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Assign) and any(
-                isinstance(t, ast.Name) and t.id == "AXIS_NAMES"
-                for t in node.targets):
-            return tuple(
-                e.value for e in node.value.elts
-                if isinstance(e, ast.Constant)
-                and isinstance(e.value, str))
-    return None
-
-
-def _axis_literals(src):
-    """Mesh-axis string literals used in PartitionSpec rule tables and
-    mesh-construction calls of one module: ``(lineno, name)`` pairs.
-    Only direct str/tuple-of-str arguments count — computed axis
-    names resolve at runtime through resolve_axis, which validates."""
-    out = []
-    try:
-        tree = ast.parse(src)
-    except SyntaxError:
-        return out
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        fn = node.func
-        name = fn.id if isinstance(fn, ast.Name) else (
-            fn.attr if isinstance(fn, ast.Attribute) else None)
-        if name not in _AXIS_CALLS:
-            continue
-        for arg in list(node.args) + [kw.value for kw in node.keywords
-                                      if kw.arg in ("axes", "axis")]:
-            elts = (arg.elts if isinstance(arg, (ast.Tuple, ast.List))
-                    else [arg])
-            for e in elts:
-                if isinstance(e, ast.Constant) and \
-                        isinstance(e.value, str):
-                    out.append((node.lineno, e.value))
-    return out
-
-
-def _function_source(tree, src, dotted):
-    """Source segment of a (possibly class-nested) function."""
-    parts = dotted.split(".")
-    node = tree
-    for name in parts:
-        found = None
-        for child in ast.walk(node) if node is tree else \
-                ast.iter_child_nodes(node):
-            if isinstance(child, (ast.FunctionDef,
-                                  ast.AsyncFunctionDef,
-                                  ast.ClassDef)) \
-                    and child.name == name:
-                found = child
-                break
-        if found is None:
-            return None
-        node = found
-    return ast.get_source_segment(src, node)
-
-
-def _is_exempt(rel, gate):
-    return (rel, gate) in EXEMPT or (rel, "*") in EXEMPT
-
-
-def check(root):
-    """Run all three checks over the repo at ``root``.  Returns
-    ``(lines, rc)`` — rc nonzero iff anything failed."""
-    lines = []
-    failed = False
-    py_files = []
-    for base in ("pint_tpu",):
-        for dirpath, dirnames, filenames in os.walk(
-                os.path.join(root, base)):
-            dirnames[:] = [d for d in dirnames
-                           if d != "__pycache__"]
-            py_files.extend(os.path.join(dirpath, f)
-                            for f in filenames if f.endswith(".py"))
-    sources = {}
-    for path in sorted(py_files):
-        rel = os.path.relpath(path, root).replace(os.sep, "/")
-        with open(path) as fh:
-            sources[rel] = fh.read()
-
-    # 1. key-site coverage
-    for rel, funcs in sorted(KEY_SITES.items()):
-        src = sources.get(rel)
-        if src is None:
-            failed = True
-            lines.append(f"FAIL {rel}: key-site file missing")
-            continue
-        tree = ast.parse(src)
-        for dotted, needs in sorted(funcs.items()):
-            seg = _function_source(tree, src, dotted)
-            if seg is None:
-                failed = True
-                lines.append(f"FAIL {rel}:{dotted}: key function not "
-                             "found (renamed? update KEY_SITES)")
-                continue
-            for gate, token in sorted(needs.items()):
-                if token in seg:
-                    lines.append(f"OK   {rel}:{dotted}: {gate} via "
-                                 f"{token!r}")
-                else:
-                    failed = True
-                    lines.append(
-                        f"FAIL {rel}:{dotted}: {gate} token "
-                        f"{token!r} missing from the key function — "
-                        "a flipped gate would serve a stale trace")
-
-    # 2. new-call-site sweep
-    for rel, src in sorted(sources.items()):
-        if "shared_jit(" not in src:
-            continue
-        for gate, tokens in sorted(TRACE_GATES.items()):
-            if not any(tok in src for tok in tokens):
-                continue
-            declared = gate in {
-                g for funcs in (KEY_SITES.get(rel) or {}).values()
-                for g in funcs}
-            if declared or _is_exempt(rel, gate):
-                continue
-            failed = True
-            lines.append(
-                f"FAIL {rel}: reads trace gate {gate} and builds "
-                "shared-jit keys, but is neither a declared KEY_SITE "
-                "nor EXEMPT (with a reason) for it")
-
-    # 3. env-var classification
-    known = set(TRACE_GATES) | HOST_ONLY
-    for rel, src in sorted(sources.items()):
-        for var in sorted(set(_ENV_RE.findall(src))):
-            if var not in known:
-                failed = True
-                lines.append(
-                    f"FAIL {rel}: unclassified env var {var} — add "
-                    "it to TRACE_GATES (and a KEY_SITE) if it changes "
-                    "a traced program, else to HOST_ONLY")
-
-    # 4. mesh-axis coverage
-    mesh_rel = "pint_tpu/parallel/mesh.py"
-    mesh_src = sources.get(mesh_rel)
-    axis_names = (_axis_names_from_source(mesh_src)
-                  if mesh_src else None)
-    if axis_names is None:
-        failed = True
-        lines.append(f"FAIL {mesh_rel}: AXIS_NAMES literal not found "
-                     "(renamed? the axis lint needs it)")
-    else:
-        tree = ast.parse(mesh_src)
-        key_src = _function_source(tree, mesh_src, "mesh_jit_key")
-        if key_src is None:
-            failed = True
-            lines.append(f"FAIL {mesh_rel}: mesh_jit_key not found")
-        elif "axis_names" in key_src or all(
-                f'"{a}"' in key_src or f"'{a}'" in key_src
-                for a in axis_names):
-            lines.append(
-                f"OK   {mesh_rel}:mesh_jit_key covers every axis "
-                "(generic over mesh.axis_names)")
-        else:
-            failed = True
-            lines.append(
-                f"FAIL {mesh_rel}:mesh_jit_key no longer derives its "
-                "entries from mesh.axis_names and does not name every "
-                f"axis in AXIS_NAMES {axis_names} — a rule-table axis "
-                "could miss the jit key and poison the zero-recompile "
-                "contract")
-        allowed = set(axis_names)
-        for rel, src in sorted(sources.items()):
-            for lineno, name in _axis_literals(src):
-                if name in allowed:
-                    continue
-                failed = True
-                lines.append(
-                    f"FAIL {rel}:{lineno}: mesh-axis literal "
-                    f"{name!r} is not in parallel/mesh.AXIS_NAMES "
-                    f"{axis_names} — a typo'd or undeclared axis "
-                    "silently mis-shards; add it to AXIS_NAMES or "
-                    "fix the name")
-    return lines, (1 if failed else 0)
+check = _static.check
 
 
 def main(argv=None):
