@@ -1147,16 +1147,44 @@ def _serve_stream_worker(port, indices, barrier, q):
         resp = conn.getresponse()
         r = _json.loads(resp.read())
         ph = r.get("phase_s") or {}
+        # the client keeps only the response's total wall (for the
+        # client-vs-span-record agreement assert) — the phase
+        # decomposition itself is read from the trace_span records
+        # the replica emits, the same source /slo and pinttrace use
         out.append((op, ds, resp.status, r.get("status"),
                     repr(r["chi2"]) if op == "fit" and "chi2" in r
                     else None,
-                    float(ph.get("total", 0.0)),
-                    float(ph.get("device", 0.0)),
-                    float(ph.get("build", 0.0)),
-                    float(ph.get("queue", 0.0))))
+                    float(ph.get("total", 0.0))))
     t1 = _t.time()
     conn.close()
     q.put({"t0": t0, "t1": t1, "results": out})
+
+
+def _serve_span_stats(trace_path):
+    """Per-request phase decomposition from the replica's
+    ``trace_span`` records (docs/serving.md): returns (walls, phases)
+    where walls is the per-request total list and phases maps each
+    phase name to its per-request list.  This is the ONE source of
+    the bench's latency decomposition — the same records /slo's
+    quantiles and ``pinttrace --chrome-trace`` are built from."""
+    walls = []
+    phases = {"queue": [], "coalesce": [], "build": [], "device": [],
+              "writeback": []}
+    with open(trace_path) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("type") != "trace_span" or \
+                    rec.get("name") != "serve.request":
+                continue
+            ph = rec.get("phase_s") or {}
+            walls.append(float(ph.get("total",
+                                      rec.get("dur_s", 0.0))))
+            for k in phases:
+                phases[k].append(float(ph.get(k, 0.0)))
+    return walls, phases
 
 
 def bench_serve(jnp, backend):
@@ -1173,8 +1201,17 @@ def bench_serve(jnp, backend):
     the ratio measures dispatch amortization + dedup, not compiles or
     first-combination stacking.  The record asserts the coalescing
     contract: every fit chi^2 in the coalesced arm is bit-identical
-    to the batch-1 arm's for the same dataset."""
+    to the batch-1 arm's for the same dataset.
+
+    The latency decomposition (p99, device/queue fractions) is read
+    from the replica's per-request ``trace_span`` records — the same
+    source /slo and ``pinttrace --chrome-trace`` consume — with the
+    client-observed totals asserted to agree with the records on the
+    measured pass, so the bench can never drift from what operators
+    actually see.  The record-derived p99 is also emitted as the
+    ``slo_p99_ms`` sentinel series (lower is better)."""
     import multiprocessing
+    import tempfile
 
     from pint_tpu import telemetry
     from pint_tpu.compile_cache import WARM_WLS_PAR
@@ -1184,7 +1221,7 @@ def bench_serve(jnp, backend):
     n_workers = 32
     datasets = _SERVE_DATASETS
 
-    def run_arm(max_batch, flush_ms):
+    def run_arm(max_batch, flush_ms, trace_path):
         srv = Server(flush_ms=flush_ms, max_batch=max_batch,
                      queue_max=4096, deadline_ms=0)
         port = srv.start(port=0)
@@ -1218,12 +1255,21 @@ def bench_serve(jnp, backend):
             # member-combination stacks cached, every program built;
             # pass 2 is the measurement.  A real replica serves in
             # steady state; cold-start cost is cold_replica_warm_s's
-            # metric, not this one's.
+            # metric, not this one's.  The span sink attaches only
+            # for the measured pass (and the operator's sink, if any,
+            # is restored after), so the records ARE the pass.
             stream_pass()
+            prev = telemetry.sink_info()
+            telemetry.configure(sink=trace_path)
             c0 = {k: telemetry.counter_get(k)
                   for k in ("serve.requests", "serve.batches",
                             "serve.coalesced")}
-            reports = stream_pass()
+            try:
+                reports = stream_pass()
+            finally:
+                telemetry.configure(
+                    sink=prev["path"] or prev["sink"],
+                    enabled=prev["enabled"])
             stats = {k: telemetry.counter_get(k) - c0[k]
                      for k in c0}
         finally:
@@ -1238,11 +1284,24 @@ def bench_serve(jnp, backend):
         for row in rows:
             if row[4] is not None:
                 chi2_of.setdefault(row[1], set()).add(row[4])
-        walls = sorted(row[5] for row in rows)
-        devices = [row[6] for row in rows]
-        builds = [row[7] for row in rows]
-        queues = [row[8] for row in rows]
+        # the per-request decomposition, from the span records
+        walls, phases = _serve_span_stats(trace_path)
+        assert len(walls) == len(rows), \
+            (f"span records ({len(walls)}) != served responses "
+             f"({len(rows)}): a request span was dropped")
+        walls = sorted(walls)
         p99 = walls[int(0.99 * (len(walls) - 1))] if walls else 0.0
+        # agreement: the client-observed totals and the sink's span
+        # records must tell the same story (they are the same
+        # measurement, delivered through two paths)
+        client = sorted(row[5] for row in rows)
+        p99_client = client[int(0.99 * (len(client) - 1))]
+        assert abs(p99 - p99_client) <= max(0.02 * p99_client, 1e-4), \
+            (f"record-derived p99 {p99:.6f}s disagrees with "
+             f"client-observed p99 {p99_client:.6f}s")
+        devices = phases["device"]
+        builds = phases["build"]
+        queues = phases["queue"]
         service = sum(devices) + sum(builds)
         return {
             "rps": n_req / wall,
@@ -1265,8 +1324,11 @@ def bench_serve(jnp, backend):
             "chi2": chi2_of,
         }
 
-    one = run_arm(max_batch=1, flush_ms=0.0)
-    coal = run_arm(max_batch=8, flush_ms=2.0)
+    with tempfile.TemporaryDirectory(prefix="pint_tpu_srvtr_") as td:
+        one = run_arm(max_batch=1, flush_ms=0.0,
+                      trace_path=os.path.join(td, "one.jsonl"))
+        coal = run_arm(max_batch=8, flush_ms=2.0,
+                       trace_path=os.path.join(td, "coal.jsonl"))
     speedup = coal["rps"] / one["rps"]
     # the coalescing contract: batched members bit-identical to
     # batch-of-1 fits (each arm must also be internally deterministic)
@@ -1308,6 +1370,23 @@ def bench_serve(jnp, backend):
             "queue_frac": round(coal["queue_frac"], 3),
             "bit_identical": True,
         },
+    })
+    # the SLO engine's headline number as a first-class sentinel
+    # series (lower is better, absolute slack — pinttrace
+    # _LOWER_IS_BETTER): record-derived, so the sentinel gates on
+    # exactly what /slo reports
+    _emit_metric({
+        "metric": "slo_p99_ms",
+        "value": round(coal["p99_wall_s"] * 1e3, 2),
+        "unit": (f"ms p99 served wall (coalesced arm, {n_req} reqs, "
+                 f"from per-request trace_span records — the /slo "
+                 f"quantile source; batch-1 arm "
+                 f"{one['p99_wall_s'] * 1e3:.1f}ms; "
+                 f"backend={backend})"),
+        "vs_baseline": None,
+        "backend": backend,
+        "compile_s": None,
+        "flops": None,
     })
 
 
@@ -1531,6 +1610,94 @@ def bench_profile_overhead(jnp, backend):
     })
 
 
+def bench_trace_overhead(jnp, backend):
+    """A/B cost of request-scoped tracing on the serve path: the SAME
+    coalesced mixed stream with the span sink attached vs detached,
+    interleaved (B/A/A') min-of-reps on the stream wall — the
+    guard_overhead methodology.  The A/A' series (two untraced
+    passes) is the same-host noise floor; the acceptance budget is
+    'below the floor' — span assembly is a few dict builds + one
+    buffered group write per flush, amortized over the batch."""
+    import multiprocessing
+    import tempfile
+
+    from pint_tpu import telemetry
+    from pint_tpu.compile_cache import WARM_WLS_PAR
+    from pint_tpu.serve.server import Server
+
+    n_req = 160
+    n_workers = 16
+    reps = 2
+    srv = Server(flush_ms=2.0, max_batch=8, queue_max=4096,
+                 deadline_ms=0)
+    port = srv.start(port=0)
+    try:
+        for i, d in enumerate(_SERVE_DATASETS):
+            srv.registry.load(d, par=WARM_WLS_PAR,
+                              toas={"n": 64, "seed": i})
+        srv.warmup("psr0", ops=("fit", "lnlike", "residuals"),
+                   maxiter=2)
+        ctx = multiprocessing.get_context("spawn")
+
+        def stream_pass():
+            barrier = ctx.Barrier(n_workers)
+            queue = ctx.Queue()
+            shards = [list(range(w, n_req, n_workers))
+                      for w in range(n_workers)]
+            procs = [ctx.Process(target=_serve_stream_worker,
+                                 args=(port, shard, barrier, queue))
+                     for shard in shards]
+            for p in procs:
+                p.start()
+            reports = [queue.get(timeout=300)
+                       for _ in range(n_workers)]
+            for p in procs:
+                p.join(timeout=60)
+            return (max(r["t1"] for r in reports)
+                    - min(r["t0"] for r in reports))
+
+        prev = telemetry.sink_info()
+        with tempfile.TemporaryDirectory(
+                prefix="pint_tpu_trov_") as td:
+            trace_path = os.path.join(td, "trace.jsonl")
+            stream_pass()   # steady state (untimed)
+            t_on, t_off, t_off2 = [], [], []
+            try:
+                for _ in range(reps):
+                    telemetry.configure(sink=trace_path)
+                    t_on.append(stream_pass())
+                    telemetry.configure(sink=None, enabled=False)
+                    t_off.append(stream_pass())
+                    t_off2.append(stream_pass())
+            finally:
+                telemetry.configure(sink=prev["path"] or prev["sink"],
+                                    enabled=prev["enabled"])
+            n_spans = sum(1 for ln in open(trace_path)
+                          if '"trace_span"' in ln)
+    finally:
+        srv.stop()
+    wall_on, wall_off = min(t_on), min(t_off)
+    overhead_pct = (wall_on - wall_off) / wall_off * 100.0
+    noise_pct = abs(min(t_off2) - wall_off) / wall_off * 100.0
+    assert n_spans >= n_req, \
+        f"traced passes recorded {n_spans} spans for {n_req} requests"
+    _emit_metric({
+        "metric": "trace_overhead_pct",
+        "value": round(overhead_pct, 2),
+        "unit": (f"% stream-wall overhead of request-scoped tracing "
+                 f"({n_req}-req coalesced mixed stream, min of "
+                 f"{reps} interleaved passes: {wall_on:.3f}s traced "
+                 f"({n_spans} spans) vs {wall_off:.3f}s untraced; "
+                 f"A/A noise floor {noise_pct:.1f}%, budget: below "
+                 f"the floor, backend={backend})"),
+        "vs_baseline": None,
+        "backend": backend,
+        "compile_s": None,
+        "flops": None,
+        "noise_floor_pct": round(noise_pct, 2),
+    })
+
+
 #: run order: the roofline first (its measured matmul peak becomes the
 #: honest MFU denominator for everything after it), then
 #: proven-cheapest compile first, heaviest (GLS) last, so a mid-run
@@ -1551,6 +1718,7 @@ _METRICS = {
     "serve_cold": bench_serve_cold,
     "guard_overhead": bench_guard,
     "profile_overhead": bench_profile_overhead,
+    "trace_overhead": bench_trace_overhead,
     "gls": bench_gls,
 }
 

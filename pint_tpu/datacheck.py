@@ -1059,6 +1059,45 @@ def _serve_section():
             f"serve.coalesced +{moved:g} -> "
             + ("OK" if moved >= 1 and both else "PROBLEM"))
 
+        # request-scoped tracing: every 2xx op response carries a
+        # traceparent + Server-Timing phase decomposition, and a
+        # client-minted traceparent is continued, not replaced
+        tp = out[0][2].get("traceparent", "")
+        st_hdr = out[0][2].get("server-timing", "")
+        ph = out[0][1].get("phase_s") or {}
+        phased = all(k in ph for k in ("queue", "coalesce", "build",
+                                       "device", "writeback"))
+        cont_id = "ab" * 16
+        s, r, _h = request_json(
+            "127.0.0.1", port, "POST", "/v1/fit",
+            {"dataset": "smk0", "maxiter": 2}, timeout=300,
+            headers={"traceparent":
+                     f"00-{cont_id}-{'cd' * 8}-01"})
+        cont = (s == 200
+                and (r.get("trace") or {}).get("trace_id") == cont_id)
+        lines.append(
+            "  tracing: traceparent "
+            + (tp[:16] + "... " if tp else "MISSING ")
+            + ("Server-Timing on, " if st_hdr else
+               "Server-Timing MISSING, ")
+            + f"{len(ph)} phase(s), client trace "
+            + ("continued -> OK" if tp and st_hdr and phased and cont
+               else "dropped -> PROBLEM"))
+
+        # SLO engine + queue introspection surfaces
+        s_slo, slo, _ = request_json("127.0.0.1", port, "GET", "/slo")
+        s_st, stats_doc, _ = request_json("127.0.0.1", port, "GET",
+                                          "/v1/stats")
+        qblock = (stats_doc or {}).get("queue") or {}
+        slo_ok = (s_slo == 200 and slo.get("verdict") is not None
+                  and s_st == 200 and "depth" in qblock
+                  and "slo" in (stats_doc or {}))
+        lines.append(
+            f"  slo: verdict {slo.get('verdict')!r}, /v1/stats "
+            f"queue depth={qblock.get('depth')} "
+            f"drain={qblock.get('drain_rate_rps')}/s -> "
+            + ("OK" if slo_ok else "PROBLEM"))
+
         # checkpointed grid job
         s, job, _ = request_json(
             "127.0.0.1", port, "POST", "/v1/jobs",

@@ -31,6 +31,13 @@ Fault classes (spec grammar: comma-separated ``name[:key=val...]``):
   Known sites: ``sampler.chunk`` (mid-MCMC-chain) and ``serve.flush``
   (the warm fitting service — mid-batch dispatch and the grid-job
   chunk loop, so a killed replica's resume story is testable).
+- ``slow_flush[:ms=N][:site=S]`` — deterministic latency injection:
+  every call to :func:`maybe_delay` at site ``S`` (default: any site)
+  sleeps ``ms`` milliseconds (default 50).  The serve plane's batched
+  dispatch calls it at ``serve.flush``, so an injected slow flush
+  drives per-request latency past a declared SLO objective — the
+  harness the ``/slo`` verdict-flip and admission-degrade tests run
+  on.
 
 Faults activate via the environment variable (read per call, so a
 subprocess harness controls them) or programmatically
@@ -41,6 +48,7 @@ injection ticks ``faults.injected`` / ``faults.injected.<name>``.
 from __future__ import annotations
 
 import os
+import time
 
 import numpy as np
 
@@ -48,7 +56,7 @@ from pint_tpu import telemetry
 
 __all__ = ["parse", "config", "active", "any_active", "inject", "clear",
            "corrupt_batch", "corrupt_orf", "corrupt_clock_rows",
-           "maybe_kill"]
+           "maybe_kill", "maybe_delay"]
 
 ENV = "PINT_TPU_FAULTS"
 
@@ -196,3 +204,17 @@ def maybe_kill(site):
         _tick("kill")
         telemetry.flush()
         os._exit(int(p.get("code", 137)))
+
+
+def maybe_delay(site):
+    """``slow_flush``: sleep ``ms`` milliseconds at the named site
+    (host-side only — the delay happens before any device work, so it
+    is pure added latency, never a traced-program change)."""
+    p = active("slow_flush")
+    if p is None:
+        return
+    want = p.get("site")
+    if want is not None and want != site:
+        return
+    _tick("slow_flush")
+    time.sleep(float(p.get("ms", 50.0)) / 1e3)

@@ -48,6 +48,11 @@ Rules (select/ignore by id; see docs/lint.md for the catalog):
 - **PTL104 host-sync-in-trace** — ``.item()`` / ``jax.device_get``
   inside a traced function: forces a host sync (or a tracer-leak
   error) inside the program.
+- **PTL105 trace-propagation** — a serve-plane handler constructs a
+  ``Request`` / calls ``build_request`` / submits a job without
+  passing the inbound trace context: the request is orphaned from
+  its distributed trace (a defensively-minted id keeps records
+  flowing but severs the client's traceparent linkage).
 - **PTL201 undocumented-telemetry** — every literal counter / gauge /
   histogram name in library source appears in docs/telemetry.md
   (family wildcards, brace/slash lists, ``<kind>`` placeholders and
@@ -261,6 +266,10 @@ HOST_ONLY = {
     # compiles; it never creates or alters a traced program, so the
     # mode knob cannot need key participation
     "PINT_TPU_RECOMPILE_SANITIZER",
+    # the SLO engine (pint_tpu/obs/slo.py) classifies request
+    # latencies AFTER dispatch — objectives shape verdicts and the
+    # admission queue bound, never a traced program
+    "PINT_TPU_SLO_P99_MS", "PINT_TPU_SLO_AVAIL",
 }
 
 #: files where raw jax.jit is the point, not a registry bypass —
@@ -770,6 +779,63 @@ def _rule_host_sync_in_trace(ctx, notes):
 
 
 # --------------------------------------------------------------------------
+# PTL105: serve-plane trace-context propagation
+# --------------------------------------------------------------------------
+
+#: call shapes that admit a request into the serve plane, with the
+#: positional slot the ``trace`` parameter occupies (a call passing
+#: at least that many positionals carried it positionally).  Matching
+#: is by terminal callee name — serve-plane files only, so an
+#: unrelated ``submit`` elsewhere in the library never matches.
+_TRACE_CARRIERS = {
+    # ServeState.build_request(op, params, default_deadline_ms, trace)
+    "build_request": 4,
+    # Request(op, dataset, params, maxiter, deadline, trace)
+    "Request": 6,
+    # JobStore.submit(spec, trace)
+    "submit": 2,
+}
+
+
+def _rule_trace_context(ctx, notes):
+    """PTL105: a serve-plane call that admits a request (or job)
+    without the inbound trace id drops the client's traceparent —
+    the defensive mint in ``Request.__init__`` keeps span records
+    flowing, but the distributed trace silently forks."""
+    out = []
+    for rel, tree in sorted(ctx.trees.items()):
+        if tree is None or not rel.startswith("pint_tpu/serve/"):
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_name(node)
+            slot = _TRACE_CARRIERS.get(name)
+            if slot is None:
+                continue
+            if name == "submit":
+                # only job-store submissions carry trace; executor
+                # submit() and the like do not
+                path = _attr_path(node.func) or ""
+                if not path.endswith("jobs.submit"):
+                    continue
+            if any(kw.arg == "trace" for kw in node.keywords) or \
+                    any(kw.arg is None for kw in node.keywords):
+                continue   # explicit trace=..., or **kwargs passthrough
+            if len(node.args) >= slot:
+                continue   # carried positionally
+            out.append(Finding(
+                "PTL105", rel, node.lineno,
+                f"serve-plane {name}() without the inbound trace "
+                "context: the request/job is minted a fresh trace id "
+                "and the client's traceparent linkage is silently "
+                "dropped — pass trace= from obs.trace.from_headers "
+                "(or the job doc), or add an inline allow with the "
+                "reason"))
+    return out
+
+
+# --------------------------------------------------------------------------
 # PTL201: telemetry-name doc coverage
 # --------------------------------------------------------------------------
 
@@ -909,6 +975,7 @@ RULES = OrderedDict([
     ("PTL102", ("anonymous-shared-jit", _rule_anonymous_shared_jit)),
     ("PTL103", ("env-in-trace", _rule_env_in_trace)),
     ("PTL104", ("host-sync-in-trace", _rule_host_sync_in_trace)),
+    ("PTL105", ("trace-propagation", _rule_trace_context)),
     ("PTL201", ("undocumented-telemetry", _rule_undocumented_telemetry)),
 ])
 

@@ -133,9 +133,16 @@ def readiness():
     started = bool(g.get("serve.ready"))
     warm = bool(g.get("serve.aot_warm"))
     ready = started and warm
+    # the SLO degrade hook is informational here, NOT a readiness
+    # input: a degraded replica still serves (with a tighter queue
+    # bound) — pulling it from rotation would turn a partial
+    # brown-out into a full outage.  Warmth is a latch on the server
+    # side (Server.mark_warm), so ready can never flap 200 -> 503
+    # once warm while the process serves.
     return ready, {"ready": ready, "started": started,
                    "aot_warm": warm,
-                   "queue_depth": g.get("serve.queue_depth", 0)}
+                   "queue_depth": g.get("serve.queue_depth", 0),
+                   "slo_degraded": bool(g.get("slo.degraded", 0.0))}
 
 
 def _healthz() -> str:

@@ -1,0 +1,203 @@
+"""Fleet aggregation: N replicas' ``/metrics`` + ``/slo`` merged
+into one snapshot.
+
+ROADMAP item 2 (multi-replica fleet behind a router, sustained-SLO
+soak) needs a single pane over many replicas.  This module is that
+substrate: :func:`scrape` pulls one replica's Prometheus text and SLO
+document over plain HTTP, :func:`merge` folds any number of scrapes
+into one fleet view —
+
+- **counters sum** (they are monotone per-replica totals),
+- **gauges** keep per-replica values plus min/max/sum (a mean of
+  ``serve.queue_depth`` hides the hot replica; the spread is the
+  signal),
+- **latency histograms merge bucket-wise**: the ``/slo`` document
+  carries raw geometric bucket tables in LogHistogram geometry, so
+  fleet quantiles are recomputed from the summed buckets by the SAME
+  estimator a single replica uses
+  (:func:`pint_tpu.obs.slo.quantiles_from_buckets`) — not averaged
+  p99s, which would be meaningless,
+- the fleet **SLO verdict is worst-of** (one violating replica makes
+  the fleet violated; a fleet is as healthy as its sickest member).
+
+Exposed as ``pinttrace --fleet host:port,host:port,...``.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import urllib.request
+
+from pint_tpu.obs import slo as _slo
+
+__all__ = ["scrape", "merge", "fleet_snapshot", "parse_prometheus",
+           "format_fleet"]
+
+_SAMPLE_RE = re.compile(
+    r"^([A-Za-z_:][A-Za-z0-9_:]*)(\{[^}]*\})?\s+(\S+)\s*$")
+
+#: worst-of ordering for fleet verdicts (higher is worse).
+_VERDICT_RANK = {"no_data": 0, "ok": 1, "violated": 2}
+
+
+def parse_prometheus(text) -> dict:
+    """Prometheus text exposition -> ``{"counters": {name: v},
+    "gauges": {name: v}, "samples": {full_line_key: v}}``.  Counters
+    are recognized by the ``_total`` suffix (how
+    :func:`pint_tpu.metrics_http.render_prometheus` marks them);
+    labeled samples (histogram quantiles) keep their label string in
+    the key so merge can track them per-series."""
+    out = {"counters": {}, "gauges": {}, "samples": {}}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        name, labels, raw = m.group(1), m.group(2) or "", m.group(3)
+        try:
+            value = float(raw)
+        except ValueError:
+            continue
+        key = name + labels
+        out["samples"][key] = value
+        if labels:
+            continue
+        if name.endswith("_total"):
+            out["counters"][name] = value
+        else:
+            out["gauges"][name] = value
+    return out
+
+
+def scrape(target, timeout=5.0) -> dict:
+    """One replica's observability surface: ``{"target", "metrics",
+    "slo", "error"}``.  A dead replica yields an ``error`` entry
+    instead of raising — a fleet view with one replica down is still
+    a fleet view (and the down replica is exactly what it should
+    show)."""
+    target = str(target).strip()
+    base = f"http://{target}"
+    doc = {"target": target, "metrics": None, "slo": None,
+           "error": None}
+    try:
+        with urllib.request.urlopen(base + "/metrics",
+                                    timeout=timeout) as r:
+            doc["metrics"] = parse_prometheus(
+                r.read().decode("utf-8", "replace"))
+        with urllib.request.urlopen(base + "/slo",
+                                    timeout=timeout) as r:
+            doc["slo"] = json.loads(r.read().decode("utf-8"))
+    except Exception as e:  # noqa: BLE001 - any transport failure
+        doc["error"] = f"{type(e).__name__}: {e}"
+    return doc
+
+
+def _merge_slo(slos) -> dict:
+    """Bucket-wise merge of the replicas' /slo windows."""
+    merged = {"windows": {}, "verdict": "no_data", "degraded": False,
+              "objectives": None}
+    worst = "no_data"
+    for snap in slos:
+        if not snap:
+            continue
+        if merged["objectives"] is None:
+            merged["objectives"] = snap.get("objectives")
+        merged["degraded"] = (merged["degraded"]
+                              or bool(snap.get("degraded")))
+        v = snap.get("verdict", "no_data")
+        if _VERDICT_RANK.get(v, 0) > _VERDICT_RANK[worst]:
+            worst = v
+        for label, wdoc in (snap.get("windows") or {}).items():
+            cell = merged["windows"].setdefault(
+                label, {"n": 0, "errors": 0, "slow": 0,
+                        "buckets": {}, "burn_rate": 0.0})
+            cell["n"] += int(wdoc.get("n", 0))
+            cell["errors"] += int(wdoc.get("errors", 0))
+            cell["slow"] += int(wdoc.get("slow", 0))
+            cell["burn_rate"] = max(cell["burn_rate"],
+                                    float(wdoc.get("burn_rate", 0.0)))
+            for idx, c in (wdoc.get("buckets") or {}).items():
+                cell["buckets"][idx] = (cell["buckets"].get(idx, 0)
+                                        + int(c))
+    for cell in merged["windows"].values():
+        qs = _slo.quantiles_from_buckets(cell["buckets"])
+        cell["p50_ms"] = None if qs[50] is None else qs[50] * 1e3
+        cell["p99_ms"] = None if qs[99] is None else qs[99] * 1e3
+        n = cell["n"]
+        cell["availability"] = (None if n == 0
+                                else 1.0 - cell["errors"] / n)
+    merged["verdict"] = worst
+    return merged
+
+
+def merge(snapshots) -> dict:
+    """Fold replica scrapes into ONE fleet snapshot: summed counters,
+    min/max/sum gauges, bucket-wise merged SLO windows, worst-of
+    verdict."""
+    live = [s for s in snapshots if s.get("error") is None]
+    counters = {}
+    gauges = {}
+    for snap in live:
+        metrics = snap.get("metrics") or {}
+        for name, v in (metrics.get("counters") or {}).items():
+            counters[name] = counters.get(name, 0.0) + v
+        for name, v in (metrics.get("gauges") or {}).items():
+            cell = gauges.setdefault(
+                name, {"min": v, "max": v, "sum": 0.0, "n": 0})
+            cell["min"] = min(cell["min"], v)
+            cell["max"] = max(cell["max"], v)
+            cell["sum"] += v
+            cell["n"] += 1
+    slo = _merge_slo([s.get("slo") for s in live])
+    return {
+        "replicas": len(snapshots),
+        "replicas_up": len(live),
+        "down": [{"target": s["target"], "error": s["error"]}
+                 for s in snapshots if s.get("error") is not None],
+        "counters": dict(sorted(counters.items())),
+        "gauges": dict(sorted(gauges.items())),
+        "slo": slo,
+        "verdict": (slo["verdict"] if live else "no_data"),
+    }
+
+
+def fleet_snapshot(targets, timeout=5.0) -> dict:
+    """Scrape every ``host:port`` in ``targets`` and merge: the
+    ``pinttrace --fleet`` document (per-replica scrapes kept under
+    ``"scrapes"`` for drill-down)."""
+    scrapes = [scrape(t, timeout=timeout) for t in targets]
+    doc = merge(scrapes)
+    doc["targets"] = [s["target"] for s in scrapes]
+    doc["scrapes"] = scrapes
+    return doc
+
+
+def format_fleet(doc) -> list:
+    """Human-readable fleet summary lines."""
+    lines = [
+        f"fleet: {doc['replicas_up']}/{doc['replicas']} replicas up"
+        f"  verdict={doc['verdict']}"
+        + ("  DEGRADED" if doc["slo"].get("degraded") else "")]
+    for d in doc.get("down", []):
+        lines.append(f"  down {d['target']}: {d['error']}")
+    for label in ("1m", "10m", "1h"):
+        w = doc["slo"]["windows"].get(label)
+        if not w or not w["n"]:
+            continue
+        p99 = (f"{w['p99_ms']:.2f}ms" if w.get("p99_ms") is not None
+               else "-")
+        avail = (f"{w['availability']:.4f}"
+                 if w.get("availability") is not None else "-")
+        lines.append(
+            f"  {label:>3}: n={w['n']}  p99={p99}  avail={avail}  "
+            f"burn={w['burn_rate']:.2f}")
+    picks = [k for k in sorted(doc["counters"])
+             if k.startswith("pint_tpu_serve_")
+             or k.startswith("pint_tpu_slo_")
+             or k.startswith("pint_tpu_obs_")]
+    for name in picks[:16]:
+        lines.append(f"  {name} = {doc['counters'][name]:g}")
+    return lines

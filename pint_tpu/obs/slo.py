@@ -1,0 +1,378 @@
+"""SLO engine: rolling-window latency quantiles, availability, and
+error-budget burn for the serve plane.
+
+Objectives are declared by environment (host-only knobs — they shape
+admission policy, never a traced program):
+
+- ``$PINT_TPU_SLO_P99_MS`` — per-op p99 latency objective in ms
+  (unset/0 disables the latency objective),
+- ``$PINT_TPU_SLO_AVAIL`` — availability objective as a fraction
+  (e.g. ``0.999``; unset disables).
+
+Every served request outcome is recorded into per-second buckets of
+geometric latency counts (the same bucket geometry as
+:class:`pint_tpu.telemetry.LogHistogram`, so fleet aggregation can
+merge replica histograms bucket-wise).  Three rolling windows —
+**1 m / 10 m / 1 h** — are merged on demand from those buckets:
+per-op p50/p95/p99, availability, and the **burn rate** = fraction of
+the error budget consumed per unit of budget:
+
+- availability burn = ``err_fraction / (1 - avail_objective)``,
+- latency burn = ``slow_fraction / 0.01`` (a p99 objective grants a
+  1% slow budget by definition).
+
+A burn of 1.0 spends the budget exactly at the rate it accrues;
+sustained burn >= :data:`DEGRADE_BURN` on the 1-minute window trips
+the **degrade hook**: admission shrinks ``queue_max`` (see
+:func:`effective_queue_max`) so the replica sheds early instead of
+queueing work it will miss the objective on — trading 429s (cheap,
+retryable) for deadline misses (wasted device work).  The hook
+releases once the fast-window burn falls back under 1.0.
+
+Verdicts: ``ok`` (objectives met), ``violated`` (an objective missed
+in some window with data), ``no_data`` (nothing recorded / no
+objectives declared).  ``/slo`` serves the full snapshot; the verdict
+and burn gauges ride ``/metrics`` and ``/v1/stats``.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+import time
+
+from pint_tpu import telemetry
+
+__all__ = [
+    "SloTracker", "tracker", "reset", "record", "objectives",
+    "effective_queue_max", "quantiles_from_buckets",
+    "P99_ENV", "AVAIL_ENV", "WINDOWS", "DEGRADE_BURN",
+]
+
+P99_ENV = "PINT_TPU_SLO_P99_MS"
+AVAIL_ENV = "PINT_TPU_SLO_AVAIL"
+
+#: (label, seconds) rolling windows, fastest first — the 1 m window
+#: drives the degrade hook, the slower ones catch slow burns.
+WINDOWS = (("1m", 60), ("10m", 600), ("1h", 3600))
+
+#: 1-minute burn rate that trips the admission degrade hook.  2x is
+#: the classic fast-burn page threshold: at 2x the whole budget is
+#: gone in half the objective period, so acting early is cheap
+#: relative to waiting.
+DEGRADE_BURN = 2.0
+
+#: queue_max multiplier while degraded (see effective_queue_max).
+DEGRADE_QUEUE_SCALE = 0.5
+
+_BASE = telemetry.LogHistogram.BASE
+_LOG_GROWTH = math.log(telemetry.LogHistogram.GROWTH)
+
+
+def _bucket_idx(latency_s):
+    v = float(latency_s)
+    if v <= _BASE:
+        return 0
+    return 1 + int(math.log(v / _BASE) / _LOG_GROWTH)
+
+
+def _bucket_value(idx):
+    if idx <= 0:
+        return _BASE
+    return _BASE * math.exp((idx - 0.5) * _LOG_GROWTH)
+
+
+def quantiles_from_buckets(buckets, qs=(50, 95, 99)):
+    """Percentile estimates (seconds) from a ``{idx: count}`` table
+    in LogHistogram geometry — shared by the tracker and by fleet
+    aggregation, so a bucket-wise merged fleet histogram reads out
+    through the exact same estimator as a single replica's."""
+    items = sorted((int(i), int(c)) for i, c in buckets.items())
+    n = sum(c for _, c in items)
+    if n == 0:
+        return {q: None for q in qs}
+    out = {}
+    for q in sorted(qs):
+        rank = max(1, math.ceil(q / 100.0 * n))
+        cum = 0
+        est = _bucket_value(items[-1][0])
+        for idx, c in items:
+            cum += c
+            if cum >= rank:
+                est = _bucket_value(idx)
+                break
+        out[q] = est
+    return out
+
+
+def objectives():
+    """The declared objectives: ``{"p99_ms": float|None,
+    "avail": float|None}`` (None = objective not declared)."""
+    def _f(env):
+        raw = os.environ.get(env, "").strip()
+        if not raw:
+            return None
+        try:
+            v = float(raw)
+        except ValueError:
+            return None
+        return v if v > 0 else None
+    avail = _f(AVAIL_ENV)
+    if avail is not None and avail >= 1.0:
+        avail = None  # a 100% objective has a zero budget: undefined burn
+    return {"p99_ms": _f(P99_ENV), "avail": avail}
+
+
+class _SecBucket:
+    """One second's outcomes: per-op (count, errors, slow, latency
+    bucket table)."""
+
+    __slots__ = ("ops",)
+
+    def __init__(self):
+        self.ops = {}  # op -> [n, err, slow, {idx: count}]
+
+
+class SloTracker:
+    """Rolling-window SLO accounting.  ``time_fn`` is injectable so
+    tests can drive the windows with a fake clock."""
+
+    def __init__(self, p99_ms=None, avail=None, time_fn=time.time):
+        if p99_ms is None and avail is None:
+            obj = objectives()
+            p99_ms, avail = obj["p99_ms"], obj["avail"]
+        self.p99_ms = p99_ms
+        self.avail = avail
+        self._time = time_fn
+        self._lock = threading.Lock()
+        self._buckets = {}  # int(second) -> _SecBucket
+        self._horizon = WINDOWS[-1][1]
+        self._degraded = False
+        self._verdict_cache = (None, -1.0)  # (snapshot, asof)
+
+    # -- recording ----------------------------------------------------------
+    def record(self, op, latency_s, ok=True):
+        """One request outcome.  Failed requests (sheds, deadline
+        misses, serve errors) count against availability; their
+        latency is excluded from the quantiles (a shed's 0 ms must
+        not improve p99)."""
+        now = int(self._time())
+        slow = (ok and self.p99_ms is not None
+                and latency_s * 1e3 > self.p99_ms)
+        idx = _bucket_idx(latency_s) if ok else None
+        with self._lock:
+            sec = self._buckets.get(now)
+            if sec is None:
+                sec = self._buckets[now] = _SecBucket()
+                self._prune_locked(now)
+            cell = sec.ops.get(op)
+            if cell is None:
+                cell = sec.ops[op] = [0, 0, 0, {}]
+            cell[0] += 1
+            if not ok:
+                cell[1] += 1
+            if slow:
+                cell[2] += 1
+            if idx is not None:
+                cell[3][idx] = cell[3].get(idx, 0) + 1
+        telemetry.counter_add("slo.requests")
+        if not ok:
+            telemetry.counter_add("slo.errors")
+
+    def _prune_locked(self, now):
+        if len(self._buckets) <= self._horizon + 2:
+            return
+        cutoff = now - self._horizon
+        for sec in [s for s in self._buckets if s < cutoff]:
+            del self._buckets[sec]
+
+    # -- windows ------------------------------------------------------------
+    def _window_locked(self, now, seconds):
+        """Merged per-op cells over the last ``seconds``."""
+        cutoff = now - seconds
+        ops = {}
+        for sec, bucket in self._buckets.items():
+            if sec <= cutoff or sec > now:
+                continue
+            for op, (n, err, slow, hist) in bucket.ops.items():
+                cell = ops.get(op)
+                if cell is None:
+                    cell = ops[op] = [0, 0, 0, {}]
+                cell[0] += n
+                cell[1] += err
+                cell[2] += slow
+                for idx, c in hist.items():
+                    cell[3][idx] = cell[3].get(idx, 0) + c
+        return ops
+
+    def _window_doc(self, ops):
+        doc = {"ops": {}, "n": 0, "errors": 0, "slow": 0}
+        total = [0, 0, 0, {}]
+        for op, (n, err, slow, hist) in sorted(ops.items()):
+            qs = quantiles_from_buckets(hist)
+            doc["ops"][op] = {
+                "n": n, "errors": err, "slow": slow,
+                "p50_ms": None if qs[50] is None else qs[50] * 1e3,
+                "p95_ms": None if qs[95] is None else qs[95] * 1e3,
+                "p99_ms": None if qs[99] is None else qs[99] * 1e3,
+                "buckets": {str(i): c for i, c in sorted(hist.items())},
+            }
+            total[0] += n
+            total[1] += err
+            total[2] += slow
+            for idx, c in hist.items():
+                total[3][idx] = total[3].get(idx, 0) + c
+        n, err, slow, hist = total
+        qs = quantiles_from_buckets(hist)
+        doc["n"], doc["errors"], doc["slow"] = n, err, slow
+        doc["p99_ms"] = None if qs[99] is None else qs[99] * 1e3
+        doc["availability"] = None if n == 0 else 1.0 - err / n
+        doc["buckets"] = {str(i): c for i, c in sorted(hist.items())}
+        # burn rates against the declared objectives
+        burns = []
+        if n:
+            if self.avail is not None:
+                burns.append((err / n) / (1.0 - self.avail))
+            if self.p99_ms is not None:
+                ok_n = n - err
+                if ok_n:
+                    burns.append((slow / ok_n) / 0.01)
+        doc["burn_rate"] = max(burns) if burns else 0.0
+        # verdict for this window
+        if n == 0 or (self.avail is None and self.p99_ms is None):
+            doc["verdict"] = "no_data"
+        else:
+            bad = False
+            if self.avail is not None \
+                    and doc["availability"] < self.avail:
+                bad = True
+            if self.p99_ms is not None and doc["p99_ms"] is not None \
+                    and doc["p99_ms"] > self.p99_ms:
+                bad = True
+            doc["verdict"] = "violated" if bad else "ok"
+        return doc
+
+    def snapshot(self) -> dict:
+        """The full ``/slo`` document: per-window per-op quantiles,
+        availability, burn rates, objectives, overall verdict, and
+        the raw geometric buckets fleet aggregation merges."""
+        now = int(self._time())
+        with self._lock:
+            windows = {label: self._window_locked(now, seconds)
+                       for label, seconds in WINDOWS}
+            degraded = self._degraded
+        doc = {"objectives": {"p99_ms": self.p99_ms,
+                              "avail": self.avail},
+               "windows": {}, "degraded": degraded, "ts": now}
+        worst = "no_data"
+        rank = {"no_data": 0, "ok": 1, "violated": 2}
+        for label, ops in windows.items():
+            wdoc = self._window_doc(ops)
+            doc["windows"][label] = wdoc
+            if rank[wdoc["verdict"]] > rank[worst]:
+                worst = wdoc["verdict"]
+        doc["verdict"] = worst
+        self._export_gauges(doc)
+        with self._lock:
+            self._verdict_cache = (doc, self._time())
+        return doc
+
+    def _export_gauges(self, doc):
+        w1 = doc["windows"].get("1m", {})
+        if w1.get("p99_ms") is not None:
+            telemetry.gauge_set("slo.p99_ms", w1["p99_ms"])
+        if w1.get("availability") is not None:
+            telemetry.gauge_set("slo.availability",
+                                w1["availability"])
+        for label, wdoc in doc["windows"].items():
+            telemetry.gauge_set(f"slo.burn_rate.{label}",
+                                wdoc.get("burn_rate", 0.0))
+        telemetry.gauge_set("slo.degraded",
+                            1.0 if doc["degraded"] else 0.0)
+        telemetry.gauge_set(
+            "slo.queue_scale",
+            DEGRADE_QUEUE_SCALE if doc["degraded"] else 1.0)
+
+    # -- degrade hook -------------------------------------------------------
+    def maybe_degrade(self) -> bool:
+        """Refresh the degrade verdict from the 1 m burn rate —
+        rate-limited to once per second so the admission hot path
+        stays O(1).  Returns the current degraded flag."""
+        now = self._time()
+        with self._lock:
+            cached, asof = self._verdict_cache
+            fresh = cached is not None and now - asof < 1.0
+            degraded = self._degraded
+        if fresh:
+            return degraded
+        snap = self.snapshot()  # refreshes cache + gauges
+        burn_1m = snap["windows"]["1m"]["burn_rate"]
+        with self._lock:
+            was = self._degraded
+            if not was and burn_1m >= DEGRADE_BURN:
+                self._degraded = True
+            elif was and burn_1m < 1.0:
+                self._degraded = False
+            now_deg = self._degraded
+        if now_deg and not was:
+            telemetry.counter_add("slo.degrades")
+            telemetry.gauge_set("slo.degraded", 1.0)
+        elif was and not now_deg:
+            telemetry.counter_add("slo.recoveries")
+            telemetry.gauge_set("slo.degraded", 0.0)
+        return now_deg
+
+    def effective_queue_max(self, queue_max) -> int:
+        """Admission's queue bound under the degrade hook: shrunk to
+        ``DEGRADE_QUEUE_SCALE`` of the configured bound while the
+        1-minute burn is hot, restored on recovery.  0 (unbounded)
+        degrades to a bound of 8 — an unbounded queue is exactly the
+        failure mode the hook exists to prevent."""
+        if not self.maybe_degrade():
+            return int(queue_max)
+        if not queue_max:
+            return 8
+        return max(1, int(int(queue_max) * DEGRADE_QUEUE_SCALE))
+
+    def verdict_doc(self) -> dict:
+        """The compact form riding ``/v1/stats``."""
+        snap = self.snapshot()
+        return {
+            "verdict": snap["verdict"],
+            "degraded": snap["degraded"],
+            "burn_rate": {label: w["burn_rate"]
+                          for label, w in snap["windows"].items()},
+            "objectives": snap["objectives"],
+        }
+
+
+_tracker = None
+_tracker_lock = threading.Lock()
+
+
+def tracker() -> SloTracker:
+    """The process singleton (objectives read from env at first
+    use)."""
+    global _tracker
+    with _tracker_lock:
+        if _tracker is None:
+            _tracker = SloTracker()
+        return _tracker
+
+
+def reset(p99_ms=None, avail=None, time_fn=time.time) -> SloTracker:
+    """Replace the singleton (tests; objective changes)."""
+    global _tracker
+    with _tracker_lock:
+        _tracker = SloTracker(p99_ms=p99_ms, avail=avail,
+                              time_fn=time_fn)
+        return _tracker
+
+
+def record(op, latency_s, ok=True):
+    tracker().record(op, latency_s, ok=ok)
+
+
+def effective_queue_max(queue_max) -> int:
+    return tracker().effective_queue_max(queue_max)

@@ -1,0 +1,232 @@
+"""Request-scoped trace context for the serve plane.
+
+The process-scoped telemetry of :mod:`pint_tpu.telemetry` answers
+"what did this replica do"; this module answers "where did request X's
+11 ms go" — which is non-trivial precisely because the serve plane
+coalesces many requests into ONE batched device call.  The design:
+
+- A W3C-style ``traceparent`` id (``00-<32 hex>-<16 hex>-01``) is
+  **minted at admission** — or accepted from the client's
+  ``traceparent`` header, so a caller that already lives inside a
+  distributed trace keeps its id — and carried on the
+  :class:`~pint_tpu.serve.state.Request` through batcher → flush →
+  batched dispatch → response.
+- The batched device call is recorded as ONE shared span
+  (``serve.batch.device``) whose ``links`` list names every member
+  request's ``(trace, span)``; each member emits its own request span
+  linking back to the device span id.  A coalesced batch is therefore
+  reconstructable as a tree: 1 device span fanning into N request
+  spans (``pinttrace --chrome-trace`` draws the fan-out as flow
+  arrows).
+- Every 2xx response carries the ``traceparent`` plus a
+  ``Server-Timing`` phase decomposition (queue wait, coalesce hold,
+  stack/build, device, write-back) so the latency budget is
+  client-visible without touching the sink.
+
+Trace ids are **host-only** bookkeeping: they ride request objects
+and response headers, never enter a traced program, and cannot change
+any compiled shape — the zero-recompile contract is untouched.
+
+Span records land in the JSONL sink via
+:func:`pint_tpu.telemetry.emit_group` so one flush's device span and
+its member request spans are written atomically: rotation can only
+happen at a group boundary, never between a batch's begin and its
+members (``--chrome-trace`` never sees a dangling track).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+import time
+
+from pint_tpu import telemetry
+
+__all__ = [
+    "TraceContext", "from_headers", "mint", "new_span_id",
+    "parse_traceparent",
+    "server_timing", "response_headers", "device_span_record",
+    "request_span_record", "collect_programs", "note_program",
+]
+
+#: ``version-traceid-spanid-flags``; only version 00 is emitted, any
+#: parseable version is accepted (W3C forward-compat rule).
+_TRACEPARENT_RE = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$")
+
+#: Server-Timing phase order (decomposition of a request's wall time).
+PHASES = ("queue", "coalesce", "build", "device", "writeback")
+
+
+def _hex(nbytes):
+    return os.urandom(nbytes).hex()
+
+
+class TraceContext:
+    """One request's position in a trace: the 128-bit trace id shared
+    by every span of the request's story, this hop's 64-bit span id,
+    and the parent span id when the caller supplied one."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id")
+
+    def __init__(self, trace_id, span_id=None, parent_id=None):
+        self.trace_id = trace_id
+        self.span_id = span_id or _hex(8)
+        self.parent_id = parent_id
+
+    def traceparent(self) -> str:
+        """The W3C serialization carried on the response header."""
+        return f"00-{self.trace_id}-{self.span_id}-01"
+
+    def to_doc(self) -> dict:
+        """The JSON-facing form riding result records."""
+        return {"trace_id": self.trace_id, "span_id": self.span_id,
+                "traceparent": self.traceparent()}
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return f"TraceContext({self.traceparent()!r})"
+
+
+def new_span_id() -> str:
+    """A fresh 64-bit span id (the shared device span of a batch)."""
+    return _hex(8)
+
+
+def mint() -> TraceContext:
+    """A fresh root context (no client traceparent)."""
+    telemetry.counter_add("obs.traces_minted")
+    return TraceContext(_hex(16))
+
+
+def parse_traceparent(value):
+    """``(trace_id, span_id)`` from a traceparent header, or ``None``
+    when malformed (malformed headers mint a fresh trace rather than
+    poisoning the sink with unparseable ids)."""
+    m = _TRACEPARENT_RE.match(str(value or "").strip().lower())
+    if not m:
+        return None
+    trace_id, span_id = m.group(2), m.group(3)
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None  # the all-zero ids are invalid per spec
+    return trace_id, span_id
+
+
+def from_headers(headers) -> TraceContext:
+    """The admission-time context: continue the client's trace when a
+    valid ``traceparent`` header is present (its span id becomes our
+    parent), else mint a root.  ``headers`` is the lowercase-keyed
+    dict the HTTP layer parsed."""
+    parsed = parse_traceparent((headers or {}).get("traceparent"))
+    if parsed is None:
+        return mint()
+    trace_id, parent_span = parsed
+    telemetry.counter_add("obs.traces_continued")
+    return TraceContext(trace_id, parent_id=parent_span)
+
+
+# -- response decoration ----------------------------------------------------
+
+def server_timing(phase_s) -> str:
+    """The ``Server-Timing`` header value for one request's phase
+    decomposition (durations in ms, W3C ``name;dur=`` syntax)."""
+    parts = []
+    for name in PHASES:
+        if name in (phase_s or {}):
+            parts.append(f"{name};dur={phase_s[name] * 1e3:.3f}")
+    return ", ".join(parts)
+
+
+def response_headers(doc):
+    """Extra response headers for a result doc that carries trace
+    and/or phase decoration; empty list otherwise."""
+    extra = []
+    trace = (doc or {}).get("trace")
+    if isinstance(trace, dict) and trace.get("traceparent"):
+        extra.append(("traceparent", trace["traceparent"]))
+    timing = server_timing((doc or {}).get("phase_s"))
+    if timing:
+        extra.append(("Server-Timing", timing))
+    return extra
+
+
+# -- span records -----------------------------------------------------------
+
+def device_span_record(span_id, ts, dur_s, links, **attrs) -> dict:
+    """The ONE shared span of a batched device call.  ``links`` names
+    every member request's ``{"trace", "span"}`` so the fan-out is
+    reconstructable; the record carries no trace id of its own (it
+    belongs to N traces at once)."""
+    rec = {"type": "trace_span", "name": "serve.batch.device",
+           "span": span_id, "ts": ts, "dur_s": dur_s,
+           "links": list(links)}
+    rec.update(attrs)
+    return rec
+
+
+def request_span_record(ctx, ts, dur_s, device_span, phase_s,
+                        **attrs) -> dict:
+    """One member request's span: its own (trace, span, parent) plus
+    a link back to the shared device span it rode."""
+    rec = {"type": "trace_span", "name": "serve.request",
+           "trace": ctx.trace_id, "span": ctx.span_id,
+           "ts": ts, "dur_s": dur_s,
+           "links": [{"span": device_span}],
+           "phase_s": dict(phase_s or {})}
+    if ctx.parent_id:
+        rec["parent"] = ctx.parent_id
+    rec.update(attrs)
+    return rec
+
+
+# -- profiler join ----------------------------------------------------------
+# dispatch_batch brackets its device phase in collect_programs(); the
+# profiling proxy notes each program label it dispatches (hook
+# registered below — profiling cannot import this module, the obs
+# package initializer imports back from pint_tpu).  The device span
+# then names the programs that actually ran for the batch.
+
+_tls = threading.local()
+
+
+def note_program(label):
+    """Record one dispatched program label into the active collection
+    scope (no-op outside one — a single thread-local read)."""
+    sink = getattr(_tls, "programs", None)
+    if sink is not None and label not in sink:
+        sink.append(label)
+
+
+class collect_programs:
+    """Context manager collecting program labels dispatched on THIS
+    thread; ``.labels`` holds them after exit."""
+
+    def __init__(self):
+        self.labels = []
+
+    def __enter__(self):
+        self._prev = getattr(_tls, "programs", None)
+        _tls.programs = self.labels
+        return self
+
+    def __exit__(self, *exc):
+        _tls.programs = self._prev
+        return False
+
+
+def _install_profiler_hook():
+    try:
+        from pint_tpu import profiling
+        profiling.set_trace_hook(note_program)
+    except Exception:  # pragma: no cover - profiling always importable
+        pass
+
+
+_install_profiler_hook()
+
+
+def now_pair():
+    """``(wall, perf)`` clock pair — span records carry wall-clock
+    ``ts`` (joinable across replicas) while durations come from the
+    monotonic clock."""
+    return time.time(), time.perf_counter()

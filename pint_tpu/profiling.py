@@ -58,12 +58,29 @@ from pint_tpu.lint import sanitizer as _sanitizer
 __all__ = [
     "PROFILE_ENV", "enabled", "configure", "profiled",
     "wrap_program", "programs", "table_lines", "reset",
-    "sample_memory", "flush_programs",
+    "sample_memory", "flush_programs", "set_trace_hook",
 ]
 
 PROFILE_ENV = "PINT_TPU_PROFILE"
 
 _lock = threading.RLock()
+
+#: program-label hook the request tracer registers
+#: (:mod:`pint_tpu.obs.trace` — it must register itself because the
+#: obs package initializer imports back from pint_tpu, so profiling
+#: cannot import it).  Called with the program label on EVERY proxied
+#: dispatch; the tracer's implementation is a single thread-local
+#: read when no collection scope is active, so the hot path stays
+#: gate-independent cheap.
+_trace_note_program = None
+
+
+def set_trace_hook(fn):
+    """Register (or clear, with ``None``) the per-dispatch program
+    label hook — lets a batched device span name the programs that
+    actually ran for it."""
+    global _trace_note_program
+    _trace_note_program = fn
 
 #: None = follow the env var (read per call — a dict lookup, so a
 #: subprocess harness or a with-block controls it); True/False = forced
@@ -371,6 +388,8 @@ class _ProfiledProgram:
         # so `pinttrace --runs` lists a run's programs even with
         # profiling off)
         telemetry.run_note_program(self._stats.label)
+        if _trace_note_program is not None:
+            _trace_note_program(self._stats.label)
         if not _sanitizer.ACTIVE:
             if not enabled():
                 return self._jitted(*args, **kwargs)

@@ -387,7 +387,8 @@ class EnsembleSampler:
             (ident, self.nwalkers, int(np.shape(x0)[-1])))
 
     def run_mcmc_autocorr(self, x0, chunk=100, maxsteps=5000,
-                          tau_factor=50.0, rtol=0.1, checkpoint=None):
+                          tau_factor=50.0, rtol=0.1, checkpoint=None,
+                          checkpoint_meta=None):
         """Run in chunks until converged by the emcee criterion
         (reference: event_optimize run_sampler_autocorr): stop when the
         chain is longer than ``tau_factor`` integrated autocorrelation
@@ -398,7 +399,9 @@ class EnsembleSampler:
         checkpoint: optional path — chain state (samples, log-probs,
         rng key, step count) is atomic-written after every chunk, and
         an existing checkpoint at the path resumes the run mid-chain
-        (a killed 10^5-step job loses at most one chunk).  Resume is
+        (a killed 10^5-step job loses at most one chunk).
+        ``checkpoint_meta`` entries (e.g. a serve job's trace id) ride
+        the checkpoint header, so a resumed job keeps its trace.  Resume is
         validated against the posterior's jit fingerprint
         (:meth:`_checkpoint_fingerprint`); a mismatch raises
         :class:`pint_tpu.guard.CheckpointMismatchError` rather than
@@ -455,7 +458,8 @@ class EnsembleSampler:
                          "total": np.int64(total),
                          "key": np.asarray(self.key)},
                         fingerprint=fp,
-                        meta={"maxsteps": int(maxsteps)})
+                        meta={"maxsteps": int(maxsteps),
+                              **(checkpoint_meta or {})})
                     _faults.maybe_kill("sampler.chunk")
                 tau = acache.tau(full)
                 if (np.all(np.isfinite(tau))

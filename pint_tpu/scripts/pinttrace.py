@@ -1,15 +1,20 @@
 """Summarize, export, and gate on pint_tpu telemetry/bench records.
 
-Seven modes:
+Eight modes:
 
 - ``pinttrace trace.jsonl`` — aggregate the records written by
   :mod:`pint_tpu.telemetry` (``PINT_TPU_TRACE=trace.jsonl``): spans by
   name (count/total/mean/max), final counter/gauge/histogram values,
   and any benchmark metric records routed through the same sink.
-- ``pinttrace --chrome-trace out.json trace.jsonl`` — export the span
-  tree as Chrome ``trace_event`` JSON (load in Perfetto /
-  ``chrome://tracing``): spans become complete ("X") duration events
-  with nesting preserved, metrics become instant events.
+- ``pinttrace --chrome-trace out.json trace.jsonl [more.jsonl ...]``
+  — export the span tree as Chrome ``trace_event`` JSON (load in
+  Perfetto / ``chrome://tracing``): spans become complete ("X")
+  duration events with nesting preserved, metrics become instant
+  events.  The serve plane's ``trace_span`` records render as
+  per-request tracks keyed by trace id, with each batched device
+  call drawn once and fanned into its requests via flow arrows;
+  extra trace paths (one sink per replica) land in separate process
+  lanes.
 - ``pinttrace --programs trace.jsonl`` — the per-program registry
   table (``{"type": "program"}`` records the profiling layer mirrors
   on flush): key, calls, compiles, device-time p50/p99, bytes.
@@ -33,6 +38,11 @@ Seven modes:
   programs compiled, classified first / new-shape /
   same-shape-recompile / unattributed, and every violation an armed
   process recorded.
+- ``pinttrace --fleet host:port,host:port,...`` — scrape N live
+  replicas' ``/metrics`` + ``/slo`` endpoints and print ONE merged
+  fleet snapshot: counters summed, SLO histogram windows merged
+  bucket-wise with the quantiles recomputed over the merge, verdict
+  worst-of across replicas (:mod:`pint_tpu.obs.fleet`).
 """
 
 from __future__ import annotations
@@ -94,7 +104,7 @@ def aggregate(records):
         elif kind in ("program", "sink_rotation", "flops_mismatch",
                       "run", "iter_trace", "health", "aot",
                       "guard_trip", "guard_rung", "aot_demotion",
-                      "sanitizer"):
+                      "sanitizer", "trace_span"):
             other += 1  # aggregated by their dedicated consumers
         elif kind == "metric" or "metric" in rec:
             metrics.append(rec)
@@ -130,6 +140,92 @@ def summarize(records):
 # --chrome-trace: trace_event JSON export
 # --------------------------------------------------------------------------
 
+#: pid for the request-scoped tracks (one Perfetto "process" lane per
+#: replica file, offset so they never collide with the ordinary span
+#: tracks, which use pid = 1 + replica)
+_TRACE_PID_BASE = 100
+
+
+def _flow_id(dev_span, trace_id):
+    """Stable numeric flow-event id for one (device span, request)
+    edge of the batch fan-out."""
+    return (int(str(dev_span)[:12] or "0", 16)
+            ^ int(str(trace_id)[:12] or "0", 16)) & 0x7FFFFFFF
+
+
+def _trace_span_events(rec, tids, metas, replica):
+    """Chrome events for one ``trace_span`` record: request spans land
+    on a per-trace-id track; the shared device span lands on a
+    ``batches`` track with a flow-event edge ("s" -> "f") to every
+    request it served, so Perfetto draws the 1-device-span ->
+    N-request-spans fan-out as arrows.  Request spans additionally
+    expand their phase decomposition (queue/coalesce/build/device/
+    writeback) as child slices on their own track."""
+    pid = _TRACE_PID_BASE + replica
+    if pid not in metas:
+        metas[pid] = [{"name": "process_name", "ph": "M", "pid": pid,
+                       "tid": 0, "args": {"name": "serve requests"
+                                          + (f" (replica {replica})"
+                                             if replica else "")}},
+                      {"name": "thread_name", "ph": "M", "pid": pid,
+                       "tid": 1, "args": {"name": "batches"}}]
+    ts = float(rec.get("ts", 0.0)) * 1e6
+    dur = float(rec.get("dur_s", 0.0)) * 1e6
+    events = []
+    if rec.get("name") == "serve.batch.device":
+        # the shared span: one slice on the batches track + one flow
+        # start per linked request
+        events.append({"name": "serve.batch.device", "cat": "trace",
+                       "ph": "X", "ts": ts, "dur": dur, "pid": pid,
+                       "tid": 1,
+                       "args": {k: rec[k] for k in
+                                ("span", "op", "run", "bucket",
+                                 "occupancy", "size", "programs")
+                                if rec.get(k) is not None}})
+        for link in rec.get("links") or ():
+            events.append({"name": "batch", "cat": "trace",
+                           "ph": "s", "ts": ts, "pid": pid, "tid": 1,
+                           "id": _flow_id(rec.get("span"),
+                                          link.get("trace"))})
+        return events
+    # request span: own track keyed by trace id
+    trace_id = str(rec.get("trace") or rec.get("span") or "?")
+    key = (pid, trace_id)
+    if key not in tids:
+        tids[key] = 16 + len(tids)
+        metas[pid].append({"name": "thread_name", "ph": "M",
+                           "pid": pid, "tid": tids[key],
+                           "args": {"name": f"req {trace_id[:8]}"}})
+    tid = tids[key]
+    args = {k: rec[k] for k in ("trace", "span", "op", "run",
+                                "dataset", "status")
+            if rec.get(k) is not None}
+    events.append({"name": rec.get("name", "serve.request"),
+                   "cat": "trace", "ph": "X", "ts": ts, "dur": dur,
+                   "pid": pid, "tid": tid, "args": args})
+    # phase decomposition as child slices (time containment on the
+    # same track renders them nested under the request slice)
+    t = ts
+    for phase in ("queue", "coalesce", "build", "device",
+                  "writeback"):
+        ph_s = (rec.get("phase_s") or {}).get(phase)
+        if ph_s is None:
+            continue
+        events.append({"name": phase, "cat": "trace.phase",
+                       "ph": "X", "ts": t, "dur": float(ph_s) * 1e6,
+                       "pid": pid, "tid": tid, "args": {}})
+        t += float(ph_s) * 1e6
+    # flow finish binding this request back to its device span
+    for link in rec.get("links") or ():
+        if link.get("span"):
+            events.append({"name": "batch", "cat": "trace",
+                           "ph": "f", "bp": "e",
+                           "ts": ts + max(dur, 1.0), "pid": pid,
+                           "tid": tid,
+                           "id": _flow_id(link["span"], trace_id)})
+    return events
+
+
 def chrome_trace(records) -> dict:
     """Convert span/metric records into Chrome ``trace_event`` format
     (the JSON-object form: {"traceEvents": [...]}).
@@ -140,11 +236,22 @@ def chrome_trace(records) -> dict:
     and duration preserve exactly (depth/parent ride along in
     ``args``).  Metric records become instant ("i") events.  Counter
     flushes become counter ("C") samples so cumulative counters plot
-    as time series."""
+    as time series.  ``trace_span`` records (the serve plane's
+    request-scoped tracing, docs/serving.md) render as per-request
+    tracks keyed by trace id with the shared batched device call as
+    one slice fanning into its requests via flow arrows; records from
+    multiple replica files (multi-path load annotates ``_replica``)
+    land in separate process lanes."""
     events = []
+    tids: dict = {}    # (pid, trace_id) -> tid for request tracks
+    metas: dict = {}   # pid -> metadata events (lazily created)
     for rec in records:
         kind = rec.get("type")
-        if kind == "span":
+        replica = int(rec.get("_replica", 0))
+        if kind == "trace_span":
+            events.extend(_trace_span_events(rec, tids, metas,
+                                             replica))
+        elif kind == "span":
             ts = float(rec.get("ts", 0.0))
             dur = float(rec.get("dur_s", 0.0))
             ev = {
@@ -153,7 +260,7 @@ def chrome_trace(records) -> dict:
                 "ph": "X",
                 "ts": ts * 1e6,
                 "dur": dur * 1e6,
-                "pid": 1,
+                "pid": 1 + replica,
                 # span nesting is per-thread; one track per thread so
                 # concurrent spans can't garble time-containment
                 # (records from before the tid field land on track 1)
@@ -190,7 +297,8 @@ def chrome_trace(records) -> dict:
                 "args": {"value": rec.get("value")},
             })
     events.sort(key=lambda e: e["ts"])
-    return {"traceEvents": events, "displayTimeUnit": "ms"}
+    head = [m for pid in sorted(metas) for m in metas[pid]]
+    return {"traceEvents": head + events, "displayTimeUnit": "ms"}
 
 
 # --------------------------------------------------------------------------
@@ -347,9 +455,14 @@ def convergence_table(records, run_id=None):
 #: metrics where a SMALLER value is better (everything else in the
 #: suite is a rate).  cold_replica_warm_s is the serving twin of
 #: cold_start_s: fresh pintserve replica, AOT import -> first served
-#: fit over HTTP.
+#: fit over HTTP.  slo_p99_ms is the served-stream p99 latency as the
+#: SLO engine measures it (bench records it from the same span
+#: records /slo reads); trace_overhead_pct is the A/B cost of span
+#: emission on the serve path, gated with absolute slack exactly like
+#: guard_overhead because it jitters about 0 on a quiet host.
 _LOWER_IS_BETTER = {"guard_overhead", "profile_overhead",
-                    "cold_start_s", "cold_replica_warm_s"}
+                    "cold_start_s", "cold_replica_warm_s",
+                    "slo_p99_ms", "trace_overhead_pct"}
 
 #: the suite's known rate-metric series (higher is better — the
 #: sentinel's default direction).  Purely a registration list: the
@@ -711,6 +824,15 @@ def main(argv=None):
                    help="perf-regression sentinel over bench rounds: "
                         "exits 1 on regression/fallback-streak/"
                         "missing metric")
+    p.add_argument("--fleet", metavar="HOST:PORT,...",
+                   help="scrape N live replicas' /metrics + /slo and "
+                        "print one merged fleet snapshot (summed "
+                        "counters, bucket-merged SLO windows, "
+                        "worst-of verdict); --json emits the raw "
+                        "merged document")
+    p.add_argument("--timeout", type=float, default=5.0,
+                   help="per-replica scrape timeout for --fleet "
+                        "(default 5s)")
     p.add_argument("--tolerance", type=float, default=0.5,
                    help="allowed fractional slack vs the best "
                         "non-fallback value (default 0.5)")
@@ -718,6 +840,23 @@ def main(argv=None):
                    help="trailing fallback/failed rounds that flag a "
                         "streak (default 2)")
     args = p.parse_args(argv)
+
+    if args.fleet:
+        from pint_tpu.obs import fleet as _fleet
+
+        targets = [t.strip() for t in args.fleet.split(",")
+                   if t.strip()]
+        if not targets:
+            print("pinttrace: --fleet needs at least one host:port",
+                  file=sys.stderr)
+            return 2
+        doc = _fleet.fleet_snapshot(targets, timeout=args.timeout)
+        if args.json:
+            print(json.dumps(doc))
+        else:
+            _print_lines(_fleet.format_fleet(doc))
+        # all replicas down is an operational alarm, not a render
+        return 0 if doc.get("replicas_up") else 2
 
     # `pinttrace --convergence trace.jsonl` (RUN_ID omitted): argparse
     # hands the trace path to the nargs='?' option and leaves the
@@ -745,12 +884,24 @@ def main(argv=None):
         return rc
 
     if not args.paths:
-        p.error("a trace file is required (or use --check-regression)")
-    try:
-        records, n_bad = _load(args.paths[0])
-    except OSError as e:
-        print(f"pinttrace: {e}", file=sys.stderr)
-        return 2
+        p.error("a trace file is required (or use "
+                "--check-regression / --fleet)")
+    # multiple traces concatenate (e.g. one sink per replica); each
+    # record remembers its file so --chrome-trace can keep replicas
+    # in separate process lanes
+    records, n_bad = [], 0
+    for i, path in enumerate(args.paths):
+        try:
+            recs, bad = _load(path)
+        except OSError as e:
+            print(f"pinttrace: {e}", file=sys.stderr)
+            return 2
+        if len(args.paths) > 1:
+            for r in recs:
+                if isinstance(r, dict):
+                    r["_replica"] = i
+        records.extend(recs)
+        n_bad += bad
 
     if args.chrome_trace:
         doc = chrome_trace(records)

@@ -32,20 +32,32 @@ from pint_tpu.serve.state import Shed
 __all__ = ["admit", "retry_after_s"]
 
 
-def retry_after_s(flush_ms) -> float:
-    """The Retry-After hint for a shed: ~two flush periods, floored
-    at 50 ms (a 0-ms dev flush must not advertise retry-immediately
-    to a client loop)."""
+def retry_after_s(flush_ms, n_pending=0, drain_rate=0.0) -> float:
+    """The Retry-After hint for a shed, floored at 50 ms (a 0-ms dev
+    flush must not advertise retry-immediately to a client loop) and
+    capped at 30 s.
+
+    With an **observed drain rate** (requests/s actually served over
+    the batcher's recent flush history) the hint is the time to drain
+    the CURRENT backlog — ``n_pending / drain_rate`` — which tracks
+    real service capacity under load.  Before the first flush has
+    completed (no observation yet) it falls back to the static
+    ~two-flush-period guess."""
+    if drain_rate > 0.0 and n_pending > 0:
+        return min(max(n_pending / drain_rate, 0.05), 30.0)
     return max(2.0 * float(flush_ms) / 1e3, 0.05)
 
 
-def admit(n_pending, queue_max, flush_ms):
+def admit(n_pending, queue_max, flush_ms, drain_rate=0.0):
     """Raise :class:`Shed` when the pending queue is at its bound;
     otherwise admit (return None).  Called under the batcher lock so
-    the bound is exact, never racy."""
+    the bound is exact, never racy.  ``queue_max`` is the caller's
+    EFFECTIVE bound — the SLO degrade hook may have shrunk it below
+    the configured value."""
     if queue_max and n_pending >= int(queue_max):
         telemetry.counter_add("serve.sheds")
         raise Shed(
             f"device queue saturated ({n_pending} pending >= "
             f"queue_max {queue_max})",
-            retry_after_s=retry_after_s(flush_ms))
+            retry_after_s=retry_after_s(flush_ms, n_pending,
+                                        drain_rate))

@@ -31,10 +31,15 @@ import threading
 import time
 
 from pint_tpu import telemetry
+from pint_tpu.obs import slo as _slo
 from pint_tpu.serve import admission
-from pint_tpu.serve.state import ServeError, dispatch_batch
+from pint_tpu.serve.state import ServeError, Shed, dispatch_batch
 
 __all__ = ["CoalescingBatcher"]
+
+#: drain-rate window: flushes completed in the last N seconds feed
+#: the observed requests/s that Retry-After hints derive from
+_DRAIN_WINDOW_S = 5.0
 
 
 class CoalescingBatcher:
@@ -49,9 +54,11 @@ class CoalescingBatcher:
         self.queue_max = int(queue_max)
         self._dispatch = dispatch or (
             lambda key, reqs: dispatch_batch(key, reqs,
-                                             self.max_batch))
+                                             self.max_batch,
+                                             flush_ms=self.flush_ms))
         self._pending: dict = {}   # group key -> [Request] (FIFO)
         self._n_pending = 0
+        self._drained: list = []   # (t_done, n_reqs) recent flushes
         self._cond = threading.Condition()
         self._stopped = False
         self._thread = threading.Thread(
@@ -62,12 +69,25 @@ class CoalescingBatcher:
     def submit(self, req):
         """Admit and enqueue one request; returns its future.  Raises
         :class:`~pint_tpu.serve.state.Shed` when the queue is at its
-        bound and :class:`ServeError` after :meth:`stop`."""
+        bound and :class:`ServeError` after :meth:`stop`.
+
+        The bound admission checks against is the SLO engine's
+        *effective* queue_max — shrunk while the 1-minute error-budget
+        burn is hot (:func:`pint_tpu.obs.slo.effective_queue_max`), so
+        a replica missing its objective sheds early instead of
+        queueing work it will also miss on.  Sheds count against the
+        op's availability."""
+        eff_queue_max = _slo.effective_queue_max(self.queue_max)
         with self._cond:
             if self._stopped:
                 raise ServeError("server is shutting down")
-            admission.admit(self._n_pending, self.queue_max,
-                            self.flush_ms)
+            try:
+                admission.admit(self._n_pending, eff_queue_max,
+                                self.flush_ms,
+                                drain_rate=self._drain_rate_locked())
+            except Shed:
+                _slo.record(req.op, 0.0, ok=False)
+                raise
             req.t_enqueue = time.perf_counter()
             self._pending.setdefault(req.group_key, []).append(req)
             self._n_pending += 1
@@ -80,6 +100,43 @@ class CoalescingBatcher:
     def depth(self) -> int:
         with self._cond:
             return self._n_pending
+
+    def _drain_rate_locked(self) -> float:
+        """Observed service rate (requests/s) over the recent flush
+        history; 0.0 before the first flush completes."""
+        now = time.perf_counter()
+        self._drained = [(t, n) for t, n in self._drained
+                         if now - t <= _DRAIN_WINDOW_S]
+        if not self._drained:
+            return 0.0
+        n = sum(c for _, c in self._drained)
+        span = max(now - self._drained[0][0], self._flush_s(), 1e-3)
+        return n / span
+
+    def queue_info(self) -> dict:
+        """The ``/v1/stats`` queue block: current depth, oldest
+        queued request's age, per-group occupancy, observed drain
+        rate."""
+        with self._cond:
+            now = time.perf_counter()
+            oldest = None
+            groups = {}
+            for key, reqs in self._pending.items():
+                label = ":".join(str(x) for x in key[:3])
+                groups[label] = len(reqs)
+                if reqs and (oldest is None
+                             or reqs[0].t_enqueue < oldest):
+                    oldest = reqs[0].t_enqueue
+            return {
+                "depth": self._n_pending,
+                "oldest_age_s": (None if oldest is None
+                                 else round(now - oldest, 6)),
+                "groups": groups,
+                "drain_rate_rps": round(self._drain_rate_locked(), 3),
+                "queue_max": self.queue_max,
+                "queue_max_effective":
+                    _slo.effective_queue_max(self.queue_max),
+            }
 
     def stop(self, timeout=10.0):
         """Stop the worker; pending requests fail with a structured
@@ -157,5 +214,13 @@ class CoalescingBatcher:
                 err = (e if isinstance(e, ServeError)
                        else ServeError(f"{type(e).__name__}: {e}"))
                 for r in reqs:
+                    _slo.record(r.op, 0.0, ok=False)
                     if r.future.set_running_or_notify_cancel():
                         r.future.set_exception(err)
+            finally:
+                # flush completed (served or failed): the requests
+                # left the queue either way — that is the drain rate
+                # Retry-After hints are derived from
+                with self._cond:
+                    self._drained.append(
+                        (time.perf_counter(), len(reqs)))

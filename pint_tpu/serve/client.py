@@ -29,12 +29,15 @@ class ServeClient:
                 self.host, self.port, timeout=self.timeout)
         return self._conn
 
-    def request(self, method, path, body=None):
+    def request(self, method, path, body=None, headers=None):
         """Returns ``(status, parsed_json, headers_dict)``; retries
-        once on a dropped keep-alive connection."""
+        once on a dropped keep-alive connection.  ``headers`` are
+        extra request headers (e.g. a ``traceparent`` to continue a
+        distributed trace across the front door)."""
         payload = (None if body is None
                    else json.dumps(body).encode())
-        headers = {"Content-Type": "application/json"}
+        headers = {"Content-Type": "application/json",
+                   **(headers or {})}
         for attempt in (0, 1):
             conn = self._connection()
             try:
@@ -59,8 +62,8 @@ class ServeClient:
     def get(self, path):
         return self.request("GET", path)
 
-    def post(self, path, body):
-        return self.request("POST", path, body)
+    def post(self, path, body, headers=None):
+        return self.request("POST", path, body, headers=headers)
 
     def close(self):
         if self._conn is not None:
@@ -71,10 +74,11 @@ class ServeClient:
             self._conn = None
 
 
-def request_json(host, port, method, path, body=None, timeout=60.0):
+def request_json(host, port, method, path, body=None, timeout=60.0,
+                 headers=None):
     """One-shot request (fresh connection, closed after)."""
     c = ServeClient(host, port, timeout=timeout)
     try:
-        return c.request(method, path, body)
+        return c.request(method, path, body, headers=headers)
     finally:
         c.close()

@@ -192,7 +192,8 @@ def _run_grid(registry, doc, job_dir, grid_chunk, progress):
         done = hi
         _guard.save_checkpoint(
             ckpt, {"chi2": chi2, "n_done": np.int64(done)},
-            fingerprint=fp, meta={"job": doc["job"]})
+            fingerprint=fp, meta={"job": doc["job"],
+                                  "trace": doc.get("trace")})
         doc["progress"] = {"done": done, "total": n}
         if progress is not None:
             progress(doc)
@@ -255,7 +256,9 @@ def _run_mcmc(registry, doc, job_dir, progress):
     x0 = s.initial_ball(center, scale * (np.abs(center) + 1e-12))
     ckpt = os.path.join(job_dir, doc["job"] + ".ckpt.npz")
     chain, converged, tau = s.run_mcmc_autocorr(
-        x0, chunk=chunk, maxsteps=maxsteps, checkpoint=ckpt)
+        x0, chunk=chunk, maxsteps=maxsteps, checkpoint=ckpt,
+        checkpoint_meta={"job": doc["job"],
+                         "trace": doc.get("trace")})
     flat = s.flatchain(burn=min(len(chain) // 4, 100))
     return {
         "n_steps": int(np.asarray(chain).shape[0]),
@@ -303,11 +306,17 @@ class JobStore:
     def _write(self, doc):
         _atomic_write_json(self._doc_path(doc["job"]), doc)
 
-    def submit(self, spec) -> dict:
+    def submit(self, spec, trace=None) -> dict:
         """Validate + persist + enqueue one job spec; returns the job
         document.  Client-supplied ``job`` ids make resubmission the
         resume path; a finished id returns its stored document
-        without re-running."""
+        without re-running.
+
+        ``trace`` is the admission-time trace id: it is stamped into
+        the document AND into every checkpoint header the job writes,
+        so a job resumed after a replica death keeps its original
+        trace (the resubmit's own trace id does NOT replace it — the
+        story of the work is one trace)."""
         if not isinstance(spec, dict):
             raise ValueError("job spec must be a JSON object")
         kind = spec.get("kind")
@@ -328,7 +337,8 @@ class JobStore:
             return existing  # resume-complete: never re-run
         doc = {"job": job_id, "kind": kind, "state": "queued",
                "spec": spec, "submitted_ts": round(time.time(), 3),
-               "progress": (existing or {}).get("progress")}
+               "progress": (existing or {}).get("progress"),
+               "trace": (existing or {}).get("trace") or trace}
         with self._lock:
             self._write(doc)
         self._q.put(job_id)
@@ -365,9 +375,11 @@ class JobStore:
                 with self._lock:
                     self._write(d)
 
+            attrs = {"job": job_id, "job_kind": doc["kind"]}
+            if doc.get("trace"):
+                attrs["trace"] = doc["trace"]
             try:
-                with telemetry.run_scope("serve.job", job=job_id,
-                                         job_kind=doc["kind"]):
+                with telemetry.run_scope("serve.job", **attrs):
                     result = run_job(self.registry, doc, self.job_dir,
                                      grid_chunk=self.grid_chunk,
                                      progress=_progress)

@@ -24,8 +24,21 @@ Routes (all JSON):
   explicit warmup) completed: the load-balancer gate that keeps
   traffic off a cold replica.
 - ``GET /metrics``         — Prometheus text (same renderer as the
-  standalone metrics port; ``serve.*`` series included).
-- ``GET /v1/stats``        — the serve counters/gauges as JSON.
+  standalone metrics port; ``serve.*`` and ``slo.*`` series
+  included).
+- ``GET /slo``             — the SLO engine's full snapshot: rolling
+  1m/10m/1h per-op quantiles, availability, burn rates, verdict
+  (:mod:`pint_tpu.obs.slo`).
+- ``GET /v1/stats``        — the serve counters/gauges as JSON, plus
+  the ``queue`` block (depth, oldest-request age, per-group
+  occupancy, observed drain rate) and the compact ``slo`` verdict.
+
+Every op response (fit/residuals/lnlike) carries a ``traceparent``
+header (the request's trace id — minted at admission or continued
+from the client's own header) and a ``Server-Timing`` phase
+decomposition (queue/coalesce/build/device/writeback), so "where did
+my 11 ms go" is answerable per response even though the device work
+was shared by a coalesced batch (:mod:`pint_tpu.obs.trace`).
 
 Status discipline: 429 + Retry-After on shed, 504 on a missed
 deadline, 503 + Retry-After on shutdown or an internal failure, 400
@@ -55,6 +68,8 @@ import threading
 import time
 
 from pint_tpu import telemetry
+from pint_tpu.obs import slo as _slo
+from pint_tpu.obs import trace as _obs_trace
 from pint_tpu.serve.batcher import CoalescingBatcher
 from pint_tpu.serve.jobs import JobStore
 from pint_tpu.serve.state import (
@@ -95,6 +110,7 @@ class Server:
                              grid_chunk=cfg["grid_chunk"])
         self.aot_report = None
         self._warm = False
+        self._warm_lock = threading.Lock()
         self._loop = None
         self._aserver = None
         self._thread = None
@@ -152,10 +168,19 @@ class Server:
             _san.arm(note="serve.startup")
 
     def mark_warm(self, warm=True):
-        """Flip the readiness gauge (``/readyz`` gates on it): a
-        replica is warm after an AOT import or an explicit warmup."""
-        self._warm = bool(warm)
-        telemetry.gauge_set("serve.aot_warm", 1.0 if warm else 0.0)
+        """Latch the readiness gauge (``/readyz`` gates on it): a
+        replica is warm after an AOT import or an explicit warmup.
+        Warmth is a LATCH — ``mark_warm(False)`` from a concurrent
+        ``startup(warm=False)`` must never un-warm a replica another
+        thread just warmed, or ``/readyz`` would flap 200 -> 503
+        under a load balancer mid-rollout.  The lock makes the
+        read-or-write-then-export sequence atomic: without it a
+        concurrent ``mark_warm(False)`` could read the pre-warm value
+        and overwrite a just-latched True."""
+        with self._warm_lock:
+            self._warm = bool(warm) or self._warm
+            telemetry.gauge_set("serve.aot_warm",
+                                1.0 if self._warm else 0.0)
 
     def warmup(self, dataset_id, ops=("fit",), sizes=None, maxiter=3):
         """Explicit warmup against a registered dataset (compiles —
@@ -183,8 +208,13 @@ class Server:
         return self._port
 
     def _run_loop(self, host, port):
-        self._loop = asyncio.new_event_loop()
-        asyncio.set_event_loop(self._loop)
+        # the teardown below uses a LOCAL loop reference: stop() nulls
+        # self._loop from another thread, so dereferencing the
+        # attribute here would race it (AttributeError noise in every
+        # test teardown)
+        loop = asyncio.new_event_loop()
+        self._loop = loop
+        asyncio.set_event_loop(loop)
 
         async def _boot():
             self._aserver = await asyncio.start_server(
@@ -194,16 +224,25 @@ class Server:
             self._started.set()
 
         try:
-            self._loop.run_until_complete(_boot())
-            self._loop.run_forever()
+            loop.run_until_complete(_boot())
+            loop.run_forever()
         finally:
             try:
                 if self._aserver is not None:
                     self._aserver.close()
-                    self._loop.run_until_complete(
+                    loop.run_until_complete(
                         self._aserver.wait_closed())
+                # drain connection-handler tasks so interpreter exit
+                # never logs "Task was destroyed but it is pending"
+                pending = [t for t in asyncio.all_tasks(loop)
+                           if not t.done()]
+                for t in pending:
+                    t.cancel()
+                if pending:
+                    loop.run_until_complete(asyncio.gather(
+                        *pending, return_exceptions=True))
             finally:
-                self._loop.close()
+                loop.close()
 
     def run(self, host="127.0.0.1", port=8470):
         """Blocking serve (the CLI path): start + wait forever."""
@@ -256,7 +295,8 @@ class Server:
                     return
                 body = await reader.readexactly(n) if n else b""
                 status, payload, ctype, extra = await self._route(
-                    method.upper(), path.split("?", 1)[0], body)
+                    method.upper(), path.split("?", 1)[0], body,
+                    headers)
                 keep = headers.get("connection",
                                    "keep-alive").lower() != "close"
                 head = [f"HTTP/1.1 {status} "
@@ -297,9 +337,10 @@ class Server:
             body["retry_after_ms"] = int(exc.retry_after_s * 1e3)
         return self._json(exc.status, body, extra)
 
-    async def _route(self, method, path, body):
+    async def _route(self, method, path, body, headers=None):
         try:
-            return await self._route_inner(method, path, body)
+            return await self._route_inner(method, path, body,
+                                           headers or {})
         except ServeError as e:
             return self._err(e)
         except (ValueError, KeyError, TypeError) as e:
@@ -311,11 +352,13 @@ class Server:
             return self._err(ServeError(
                 f"{type(e).__name__}: {e}", retry_after_s=1.0))
 
-    async def _route_inner(self, method, path, body):
+    async def _route_inner(self, method, path, body, headers):
         path = path.rstrip("/") or "/"
         if method == "GET":
             if path == "/healthz":
                 return self._json(200, self._health_doc())
+            if path == "/slo":
+                return self._json(200, _slo.tracker().snapshot())
             if path == "/readyz":
                 from pint_tpu import metrics_http
 
@@ -326,6 +369,9 @@ class Server:
             if path == "/metrics":
                 from pint_tpu import metrics_http
 
+                # burn-rate/quantile gauges are computed on demand:
+                # refresh them so a scrape always reads current windows
+                _slo.tracker().snapshot()
                 return (200, metrics_http.render_prometheus()
                         .encode(),
                         "text/plain; version=0.0.4; charset=utf-8",
@@ -336,7 +382,7 @@ class Server:
                     "POST /v1/residuals", "POST /v1/lnlike",
                     "POST /v1/jobs", "GET /v1/jobs/<id>",
                     "GET /healthz", "GET /readyz", "GET /metrics",
-                    "GET /v1/stats",
+                    "GET /slo", "GET /v1/stats",
                 ]})
             if path == "/v1/stats":
                 return self._json(200, self._stats_doc())
@@ -358,11 +404,18 @@ class Server:
                     flags=params.get("flags")))
             return self._json(200, info)
         if path == "/v1/jobs":
-            return self._json(200, self.jobs.submit(params))
+            ctx = _obs_trace.from_headers(headers)
+            doc = self.jobs.submit(params, trace=ctx.trace_id)
+            return self._json(200, doc,
+                              [("traceparent", ctx.traceparent())])
         if path in ("/v1/fit", "/v1/residuals", "/v1/lnlike"):
             op = path.rsplit("/", 1)[1]
+            # admission: the trace context is minted HERE (or
+            # continued from the client's traceparent) and rides the
+            # request through batcher -> flush -> response
+            ctx = _obs_trace.from_headers(headers)
             req = self.registry.build_request(
-                op, params, self.cfg["deadline_ms"])
+                op, params, self.cfg["deadline_ms"], trace=ctx)
             fut = self.batcher.submit(req)  # Shed -> 429 upstream
             try:
                 result = await asyncio.wait_for(
@@ -372,7 +425,8 @@ class Server:
             except asyncio.TimeoutError:
                 raise ServeError("batch dispatch timed out",
                                  retry_after_s=5.0) from None
-            return self._json(200, result)
+            return self._json(200, result,
+                              _obs_trace.response_headers(result))
         return self._json(404, {"error": "NotFound"})
 
     # -- documents ----------------------------------------------------------
@@ -398,6 +452,8 @@ class Server:
         return {
             "config": dict(self.cfg),
             "queue_depth": self.batcher.depth(),
+            "queue": self.batcher.queue_info(),
+            "slo": _slo.tracker().verdict_doc(),
             "datasets": self.registry.ids(),
             "size_classes": list(size_classes(self.cfg["max_batch"])),
             "counters": serve_ctr,

@@ -52,6 +52,8 @@ import time
 import numpy as np
 
 from pint_tpu import telemetry
+from pint_tpu.obs import slo as _slo
+from pint_tpu.obs import trace as _obs_trace
 
 __all__ = [
     "ServeError", "Shed", "DeadlineMiss",
@@ -299,10 +301,16 @@ class DatasetRegistry:
     def ids(self):
         return sorted(self._datasets)
 
-    def build_request(self, op, params, default_deadline_ms=0.0
-                      ) -> "Request":
+    def build_request(self, op, params, default_deadline_ms=0.0,
+                      trace=None) -> "Request":
         """Validate one request body into a :class:`Request` (raises
-        ValueError on a malformed request — the 400 path)."""
+        ValueError on a malformed request — the 400 path).
+
+        ``trace`` is the admission-time
+        :class:`~pint_tpu.obs.trace.TraceContext` (continued from the
+        client's ``traceparent`` header or freshly minted); every
+        serve-plane call site must pass it — pintlint rule PTL105
+        flags a handler that drops it."""
         if op not in ("fit", "residuals", "lnlike"):
             raise ValueError(f"unknown op {op!r}")
         if not isinstance(params, dict):
@@ -329,17 +337,19 @@ class DatasetRegistry:
                                        default_deadline_ms) or 0.0)
         deadline = (time.time() + deadline_ms / 1e3
                     if deadline_ms > 0 else None)
-        return Request(op, ds, params, maxiter, deadline)
+        return Request(op, ds, params, maxiter, deadline, trace=trace)
 
 
 class Request:
     """One in-flight request: its dataset, knobs, coalescing group
-    key, and the future its response lands on."""
+    key, trace context, and the future its response lands on."""
 
     __slots__ = ("op", "dataset", "params", "maxiter", "deadline",
-                 "group_key", "future", "t_submit", "t_enqueue")
+                 "group_key", "future", "t_submit", "t_submit_wall",
+                 "t_enqueue", "trace")
 
-    def __init__(self, op, dataset, params, maxiter, deadline):
+    def __init__(self, op, dataset, params, maxiter, deadline,
+                 trace=None):
         self.op = op
         self.dataset = dataset
         self.params = params
@@ -349,7 +359,11 @@ class Request:
                           dataset.structure, maxiter)
         self.future = concurrent.futures.Future()
         self.t_submit = time.perf_counter()
+        self.t_submit_wall = time.time()
         self.t_enqueue = None
+        # every request rides a trace (defensive mint: a caller that
+        # somehow bypassed admission still yields traceable spans)
+        self.trace = trace if trace is not None else _obs_trace.mint()
 
 
 # --------------------------------------------------------------------------
@@ -530,7 +544,8 @@ def _run_lnlike(batch, live, rows):
             for k in range(len(live))]
 
 
-def dispatch_batch(group_key, reqs, max_batch):
+def dispatch_batch(group_key, reqs, max_batch, flush_ms=0.0,
+                   record_slo=True):
     """Serve one coalesced group as ONE batched device call.
 
     The batcher's flush handler: drops deadline-expired members
@@ -541,18 +556,33 @@ def dispatch_batch(group_key, reqs, max_batch):
     a structured outcome.  Model write-backs are rolled back before
     returning, so served datasets stay immutable.
 
+    Observability: the device call is recorded as ONE shared
+    ``trace_span`` fanning into a per-member request span each (one
+    atomic :func:`~pint_tpu.telemetry.emit_group`), every member's
+    result carries its ``trace`` doc plus a ``phase_s`` decomposition
+    — ``queue`` (backlog wait beyond the coalescing hold), ``coalesce``
+    (the deliberate flush hold, bounded by ``flush_ms``), ``build``
+    (stack/override share), ``device``, ``writeback`` — and each
+    outcome lands in the SLO tracker (``record_slo=False`` for warmup
+    flushes, whose compile-heavy walls must not burn the budget).
+
     Also the chaos kill site ``serve.flush``: a deterministic
     mid-batch kill (``PINT_TPU_FAULTS=kill:site=serve.flush``)
-    exercises the restart/resubmit story."""
+    exercises the restart/resubmit story, and the slow-flush delay
+    site (``PINT_TPU_FAULTS=slow_flush:ms=...``) the SLO-violation
+    one."""
     from pint_tpu import faults as _faults
 
     _faults.maybe_kill("serve.flush")
+    _faults.maybe_delay("serve.flush")
     op = group_key[0]
     now = time.time()
     live = []
     for r in reqs:
         if r.deadline is not None and now > r.deadline:
             telemetry.counter_add("serve.deadline_misses")
+            if record_slo:
+                _slo.record(op, 0.0, ok=False)
             _finish_error(r, DeadlineMiss(
                 "deadline expired before the batch dispatched"))
         else:
@@ -603,8 +633,10 @@ def dispatch_batch(group_key, reqs, max_batch):
             with telemetry.run_scope(
                     "serve.batch", op=op, bucket=group_key[2],
                     occupancy=len(live), unique=len(uniq),
-                    size=size) as run:
+                    size=size) as run, \
+                    _obs_trace.collect_programs() as progs:
                 batch_run = run.run_id
+                t_dev0_wall = time.time()
                 t_dev0 = time.perf_counter()
                 if op == "fit":
                     results = _run_fit(batch, live, rows,
@@ -629,37 +661,67 @@ def dispatch_batch(group_key, reqs, max_batch):
     t_done = time.perf_counter()
     dev_share = device_s / len(live)
     build_share = build_s / len(live)
+    # write-back: guard readout + outcome assembly + rollback, from
+    # device completion to response fulfillment (shared by members)
+    writeback_s = max(t_done - (t_dev0 + device_s), 0.0)
+    flush_hold = max(float(flush_ms), 0.0) / 1e3
+    sink_on = telemetry.sink_active()
+    span_group = []
+    dev_span = _obs_trace.new_span_id() if sink_on else None
     for k, req in enumerate(live):
         rec = dict(results[k])
-        queue_s = (t_build0 - req.t_enqueue
-                   if req.t_enqueue is not None else 0.0)
+        wait_s = (t_build0 - req.t_enqueue
+                  if req.t_enqueue is not None else 0.0)
+        wait_s = max(wait_s, 0.0)
+        # the coalescing hold is policy (bounded by flush_ms); any
+        # wait beyond it is backlog — the queue/coalesce split is
+        # what makes "slow because saturated" and "slow because
+        # batching" distinguishable per response
+        coalesce_s = min(wait_s, flush_hold)
+        queue_s = wait_s - coalesce_s
         wall_s = t_done - req.t_submit
         rec["batch"] = {"run": batch_run, "occupancy": len(live),
                         "unique": len(uniq), "size": size,
                         "bucket": group_key[2]}
         rec["phase_s"] = {"queue": round(queue_s, 6),
+                          "coalesce": round(coalesce_s, 6),
                           "build": round(build_share, 6),
                           "device": round(dev_share, 6),
+                          "writeback": round(writeback_s, 6),
                           "total": round(wall_s, 6)}
-        # one ledger record per request, joined to the batch's run id
-        # (which owns the compile/phase attribution) — `pinttrace`
-        # shows request rows whose wall is device-dominated at
-        # healthy occupancy.  A full run_scope per request would cost
-        # two lock+emit round-trips at serving rates; the batch-level
-        # scope already carries the run semantics.
-        if telemetry.sink_active():
-            telemetry.emit({"type": "serve_request", "op": op,
-                            "run": batch_run,
-                            "dataset": req.dataset.dataset_id,
-                            "status": rec.get("status"),
-                            "queue_s": round(queue_s, 6),
-                            "device_s": round(dev_share, 6),
-                            "wall_s": round(wall_s, 6)})
-        telemetry.hist_record("serve.queue_s", max(queue_s, 0.0))
+        rec["trace"] = req.trace.to_doc()
+        # one request span per member, joined both to the batch's run
+        # id (ledger: compile/phase attribution) and — via the span
+        # link — to the shared device span; emitted as ONE group
+        # below so rotation can never split the batch's tree
+        if sink_on:
+            span_group.append(_obs_trace.request_span_record(
+                req.trace, ts=round(req.t_submit_wall, 6),
+                dur_s=round(wall_s, 6), device_span=dev_span,
+                phase_s=rec["phase_s"], op=op, run=batch_run,
+                dataset=req.dataset.dataset_id,
+                status=rec.get("status")))
+        telemetry.hist_record("serve.queue_s", max(wait_s, 0.0))
         telemetry.hist_record("serve.device_s", dev_share)
         telemetry.hist_record("serve.wall_s", wall_s)
+        if record_slo:
+            _slo.record(op, wall_s, ok=True)
+        results[k] = rec
+    if sink_on:
+        span_group.insert(0, _obs_trace.device_span_record(
+            dev_span, ts=round(t_dev0_wall, 6),
+            dur_s=round(device_s, 6),
+            links=[{"trace": r.trace.trace_id,
+                    "span": r.trace.span_id} for r in live],
+            op=op, run=batch_run, bucket=group_key[2],
+            occupancy=len(live), size=size,
+            programs=list(progs.labels)))
+        telemetry.counter_add("obs.trace_spans",
+                              float(len(span_group)))
+        telemetry.emit_group(span_group)
+    for k, req in enumerate(live):
         if req.future.set_running_or_notify_cancel():
-            req.future.set_result(rec)
+            req.future.set_result(results[k])
 
 
 def warm_serve(registry, dataset_id, max_batch, ops=("fit",),
@@ -687,12 +749,17 @@ def warm_serve(registry, dataset_id, max_batch, ops=("fit",),
                 op, {"dataset": dataset_id, "maxiter": maxiter,
                      "values": {jit_name: jit_base
                                 + (abs(jit_base) + 1.0)
-                                * 1e-13 * i}})
+                                * 1e-13 * i}},
+                trace=_obs_trace.mint())
                 for i in range(c)]
             for r in reqs:
                 r.t_enqueue = time.perf_counter()
             t0 = time.perf_counter()
-            dispatch_batch(reqs[0].group_key, reqs, max_batch)
+            # warm flushes are compile-heavy by design: keep their
+            # walls out of the SLO windows (a booting replica must
+            # not burn its own error budget)
+            dispatch_batch(reqs[0].group_key, reqs, max_batch,
+                           record_slo=False)
             for r in reqs:
                 r.future.result()  # surface warmup failures loudly
             out.append({"op": op, "size": c,

@@ -44,8 +44,8 @@ import threading
 import time
 
 __all__ = [
-    "span", "configure", "enabled", "emit", "flush", "sink_active",
-    "sink_info",
+    "span", "configure", "enabled", "emit", "emit_group", "flush",
+    "sink_active", "sink_info",
     "counter_add", "counter_get", "counters", "gauge_set", "gauges",
     "LogHistogram", "hist_record", "histograms",
     "add_span_hook", "add_flush_hook",
@@ -807,6 +807,60 @@ def emit(record: dict):
             _state.sink_owned = False
             return
         _state.sink_bytes += len(line) + 1
+        if (_state.sink_owned and _state.sink_max_bytes
+                and _state.sink_path
+                and _state.sink_bytes >= _state.sink_max_bytes):
+            _rotate_sink_locked()
+
+
+def emit_group(records):
+    """Write several related JSONL records as ONE atomic sink write
+    (no-op without a sink).
+
+    :func:`emit` checks the rotation cap after every record, so a
+    record *group* — a batched device span plus the N request spans
+    that link to it — could straddle a rotation boundary, leaving
+    ``pinttrace --chrome-trace`` a dangling track whose link target
+    lives in the rotated-out file.  This path serializes the whole
+    group first, writes it under one lock hold, and checks the cap
+    only at the group boundary: every record of the group lands in
+    the same sink file (the group may overshoot ``sink_max_bytes`` by
+    at most its own size — bounded by max_batch, not by load).
+
+    Run-id tagging matches :func:`emit` record-for-record."""
+    sink = _state.sink
+    if sink is None:
+        return
+    rid = current_run_id()
+    lines = []
+    for record in records:
+        if rid is not None and "run" not in record \
+                and record.get("type") not in _RUN_UNTAGGED_TYPES:
+            record = {**record, "run": rid}
+        try:
+            lines.append(json.dumps(_jsonable(record),
+                                    separators=(",", ":")))
+        except (TypeError, ValueError):
+            lines.append(json.dumps({"type": "emit_error",
+                                     "repr": repr(record)}))
+    if not lines:
+        return
+    blob = "\n".join(lines) + "\n"
+    with _lock:
+        if _state.sink is not sink:
+            return  # concurrent reconfigure: drop the group
+        try:
+            sink.write(blob)
+        except (OSError, ValueError):
+            if _state.sink_owned:
+                try:
+                    sink.close()
+                except OSError:
+                    pass
+            _state.sink = None
+            _state.sink_owned = False
+            return
+        _state.sink_bytes += len(blob)
         if (_state.sink_owned and _state.sink_max_bytes
                 and _state.sink_path
                 and _state.sink_bytes >= _state.sink_max_bytes):

@@ -257,6 +257,83 @@ class TestTracedFunctionHygiene:
         assert _rules_of(findings) == {"PTL103", "PTL104"}
 
 
+class TestTracePropagation:
+    """PTL105: serve-plane admission calls must carry the inbound
+    trace context, or the client's traceparent linkage silently
+    forks."""
+
+    def test_build_request_without_trace_flags(self, tmp_path):
+        root = _fixture_tree(tmp_path, {"serve/handlers.py": (
+            "def handle(state, body):\n"
+            "    return state.build_request('fit', body, 0)\n")})
+        findings, _ = static.run(root, select=["PTL105"])
+        assert [f.rule for f in findings] == ["PTL105"]
+        assert findings[0].file == "pint_tpu/serve/handlers.py"
+        assert "trace" in findings[0].message
+
+    def test_request_ctor_without_trace_flags(self, tmp_path):
+        root = _fixture_tree(tmp_path, {"serve/handlers.py": (
+            "from pint_tpu.serve.state import Request\n"
+            "def handle(body):\n"
+            "    return Request('fit', None, body, 2, None)\n")})
+        findings, _ = static.run(root, select=["PTL105"])
+        assert [f.rule for f in findings] == ["PTL105"]
+
+    def test_jobs_submit_without_trace_flags(self, tmp_path):
+        root = _fixture_tree(tmp_path, {"serve/handlers.py": (
+            "def handle(self, spec):\n"
+            "    return self.jobs.submit(spec)\n")})
+        findings, _ = static.run(root, select=["PTL105"])
+        assert [f.rule for f in findings] == ["PTL105"]
+
+    def test_trace_kwarg_is_clean(self, tmp_path):
+        root = _fixture_tree(tmp_path, {"serve/handlers.py": (
+            "def handle(state, body, ctx):\n"
+            "    r = state.build_request('fit', body, 0, trace=ctx)\n"
+            "    return r, state.jobs.submit(body, trace=ctx.trace_id)"
+            "\n")})
+        findings, _ = static.run(root, select=["PTL105"])
+        assert findings == []
+
+    def test_positional_trace_is_clean(self, tmp_path):
+        root = _fixture_tree(tmp_path, {"serve/handlers.py": (
+            "def handle(state, body, ctx):\n"
+            "    return state.build_request('fit', body, 0, ctx)\n")})
+        findings, _ = static.run(root, select=["PTL105"])
+        assert findings == []
+
+    def test_kwargs_passthrough_is_clean(self, tmp_path):
+        root = _fixture_tree(tmp_path, {"serve/handlers.py": (
+            "def handle(state, body, **kw):\n"
+            "    return state.build_request('fit', body, 0, **kw)\n")})
+        findings, _ = static.run(root, select=["PTL105"])
+        assert findings == []
+
+    def test_outside_serve_plane_is_clean(self, tmp_path):
+        # the same call in a non-serve module is not admission
+        root = _fixture_tree(tmp_path, {"analysis.py": (
+            "def handle(state, body):\n"
+            "    return state.build_request('fit', body, 0)\n")})
+        findings, _ = static.run(root, select=["PTL105"])
+        assert findings == []
+
+    def test_executor_submit_is_not_a_trace_carrier(self, tmp_path):
+        root = _fixture_tree(tmp_path, {"serve/handlers.py": (
+            "def handle(pool, fn):\n"
+            "    return pool.submit(fn)\n")})
+        findings, _ = static.run(root, select=["PTL105"])
+        assert findings == []
+
+    def test_allow_with_reason_suppresses(self, tmp_path):
+        root = _fixture_tree(tmp_path, {"serve/handlers.py": (
+            "def handle(state, body):\n"
+            "    # pintlint: allow=PTL105 -- warmup flush: no client,"
+            " no trace to carry\n"
+            "    return state.build_request('fit', body, 0)\n")})
+        findings, _ = static.run(root, select=["PTL105"])
+        assert findings == []
+
+
 class TestTelemetryDocCoverage:
     def test_undocumented_name_flags(self, tmp_path):
         root = _fixture_tree(tmp_path, {"bad.py": (

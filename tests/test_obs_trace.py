@@ -1,0 +1,373 @@
+"""Request-scoped tracing and fleet aggregation (pint_tpu/obs):
+traceparent mint/continue round-trip, response decoration, atomic
+span-group emission under sink rotation, chrome-trace fan-out
+reconstruction (1 device span -> N request spans via flow events),
+fleet merge semantics (summed counters, bucket-wise quantile merge,
+worst-of verdict, down-replica tolerance), and the two new
+lower-is-better regression series.  All host-only — no jax, no
+device work.
+"""
+
+import json
+import os
+
+import pytest
+
+from pint_tpu import telemetry
+from pint_tpu.obs import fleet
+from pint_tpu.obs import trace as obs_trace
+from pint_tpu.scripts.pinttrace import (
+    aggregate,
+    check_regression,
+    chrome_trace,
+)
+
+
+# ---------------------------------------------------------------------------
+# trace context
+# ---------------------------------------------------------------------------
+
+class TestTraceContext:
+    def test_mint_and_traceparent_roundtrip(self):
+        before = telemetry.counter_get("obs.traces_minted")
+        ctx = obs_trace.mint()
+        assert telemetry.counter_get("obs.traces_minted") == before + 1
+        assert len(ctx.trace_id) == 32 and len(ctx.span_id) == 16
+        tp = ctx.traceparent()
+        assert tp == f"00-{ctx.trace_id}-{ctx.span_id}-01"
+        assert obs_trace.parse_traceparent(tp) == (ctx.trace_id,
+                                                   ctx.span_id)
+        doc = ctx.to_doc()
+        assert doc["trace_id"] == ctx.trace_id
+        assert doc["traceparent"] == tp
+
+    @pytest.mark.parametrize("bad", [
+        None,
+        "",
+        "garbage",
+        "00-zz" + "a" * 30 + "-" + "b" * 16 + "-01",   # non-hex
+        "00-" + "a" * 31 + "-" + "b" * 16 + "-01",     # short trace id
+        "00-" + "0" * 32 + "-" + "b" * 16 + "-01",     # all-zero trace
+        "00-" + "a" * 32 + "-" + "0" * 16 + "-01",     # all-zero span
+        "00-" + "a" * 32 + "-" + "b" * 16,             # missing flags
+    ])
+    def test_malformed_traceparent_rejected(self, bad):
+        assert obs_trace.parse_traceparent(bad) is None
+        # malformed headers mint a fresh root rather than poisoning
+        # the sink with unparseable ids
+        ctx = obs_trace.from_headers({"traceparent": bad})
+        assert len(ctx.trace_id) == 32 and ctx.parent_id is None
+
+    def test_continuation_from_headers(self):
+        client = "ab" * 16
+        parent = "cd" * 8
+        before = telemetry.counter_get("obs.traces_continued")
+        ctx = obs_trace.from_headers(
+            {"traceparent": f"00-{client}-{parent}-01"})
+        assert ctx.trace_id == client
+        assert ctx.parent_id == parent
+        assert ctx.span_id != parent  # this hop gets a fresh span id
+        assert telemetry.counter_get(
+            "obs.traces_continued") == before + 1
+
+    def test_continuation_is_case_and_space_tolerant(self):
+        client = "ab" * 16
+        ctx = obs_trace.from_headers(
+            {"traceparent": f"  00-{client.upper()}-{'CD' * 8}-01 "})
+        assert ctx.trace_id == client
+
+    def test_server_timing_order_and_units(self):
+        phase_s = {"device": 0.004, "queue": 0.0015, "build": 0.0005}
+        hdr = obs_trace.server_timing(phase_s)
+        # PHASES order, not dict order; durations in ms
+        assert hdr == ("queue;dur=1.500, build;dur=0.500, "
+                       "device;dur=4.000")
+        assert obs_trace.server_timing({}) == ""
+        assert obs_trace.server_timing(None) == ""
+
+    def test_response_headers_decoration(self):
+        ctx = obs_trace.mint()
+        doc = {"trace": ctx.to_doc(), "phase_s": {"device": 0.001}}
+        extra = dict(obs_trace.response_headers(doc))
+        assert extra["traceparent"] == ctx.traceparent()
+        assert "device;dur=" in extra["Server-Timing"]
+        assert obs_trace.response_headers({}) == []
+        assert obs_trace.response_headers(None) == []
+
+
+# ---------------------------------------------------------------------------
+# span records + atomic group emission
+# ---------------------------------------------------------------------------
+
+def _fan_out_records(n_requests=2, replica=None, base_ts=100.0):
+    """One batch's span group: a device span linking N request
+    spans, each linking back (what dispatch_batch emits)."""
+    dev = obs_trace.new_span_id()
+    ctxs = [obs_trace.mint() for _ in range(n_requests)]
+    recs = [obs_trace.device_span_record(
+        dev, base_ts, 0.004,
+        links=[{"trace": c.trace_id, "span": c.span_id}
+               for c in ctxs],
+        op="fit", occupancy=n_requests, size=4)]
+    for c in ctxs:
+        recs.append(obs_trace.request_span_record(
+            c, base_ts - 0.002, 0.007, dev,
+            {"queue": 0.001, "coalesce": 0.001, "build": 0.0005,
+             "device": 0.004, "writeback": 0.0005},
+            op="fit", status="ok"))
+    if replica is not None:
+        for r in recs:
+            r["_replica"] = replica
+    return recs, dev, ctxs
+
+
+class TestSpanGroups:
+    def test_device_span_names_every_member(self):
+        recs, dev, ctxs = _fan_out_records(3)
+        dev_rec = recs[0]
+        assert dev_rec["type"] == "trace_span"
+        assert dev_rec["name"] == "serve.batch.device"
+        assert {lk["trace"] for lk in dev_rec["links"]} == \
+            {c.trace_id for c in ctxs}
+        for rec, c in zip(recs[1:], ctxs):
+            assert rec["name"] == "serve.request"
+            assert rec["trace"] == c.trace_id
+            assert rec["links"] == [{"span": dev}]
+            assert set(rec["phase_s"]) == set(obs_trace.PHASES)
+
+    def test_emit_group_is_atomic_across_rotation(self, tmp_path):
+        """A span group never straddles a rotation boundary: every
+        record of a group lands in the same sink file, so
+        --chrome-trace never sees a request span whose device-span
+        link target was rotated away."""
+        sink = tmp_path / "trace.jsonl"
+        prev = telemetry.sink_info()
+        # ~350 B/group against a 2 kB cap: rotation every few groups
+        telemetry.configure(sink=str(sink), max_mb=0.002)
+        try:
+            for gid in range(40):
+                recs, _, _ = _fan_out_records(2)
+                for r in recs:
+                    r["gid"] = gid
+                telemetry.emit_group(recs)
+        finally:
+            telemetry.configure(sink=prev["path"] or prev["sink"],
+                                enabled=prev["enabled"])
+        rotated = tmp_path / "trace.jsonl.1"
+        assert rotated.exists(), "cap small enough to force rotation"
+        groups_seen = {}
+        for path in (sink, rotated):
+            for ln in path.read_text().splitlines():
+                rec = json.loads(ln)
+                if rec.get("type") != "trace_span":
+                    continue
+                groups_seen.setdefault(rec["gid"], set()).add(
+                    str(path))
+        assert groups_seen, "span records landed in the sink"
+        split = {g: files for g, files in groups_seen.items()
+                 if len(files) > 1}
+        assert not split, f"groups split across rotation: {split}"
+
+    def test_emit_group_without_sink_is_noop(self):
+        prev = telemetry.sink_info()
+        telemetry.configure(sink=None, enabled=False)
+        try:
+            recs, _, _ = _fan_out_records(2)
+            telemetry.emit_group(recs)  # must not raise
+        finally:
+            telemetry.configure(sink=prev["path"] or prev["sink"],
+                                enabled=prev["enabled"])
+
+
+# ---------------------------------------------------------------------------
+# chrome-trace reconstruction
+# ---------------------------------------------------------------------------
+
+class TestChromeTraceFanOut:
+    def test_batch_reconstructs_as_device_plus_request_tracks(self):
+        recs, dev, ctxs = _fan_out_records(2)
+        doc = chrome_trace(recs)
+        events = doc["traceEvents"]
+        dev_x = [e for e in events if e["ph"] == "X"
+                 and e["name"] == "serve.batch.device"]
+        req_x = [e for e in events if e["ph"] == "X"
+                 and e["name"] == "serve.request"]
+        assert len(dev_x) == 1 and len(req_x) == 2
+        # device span on the shared batches track, requests on their
+        # own per-trace tracks in the request-scoped process lane
+        assert dev_x[0]["tid"] == 1
+        assert dev_x[0]["pid"] == 100
+        assert len({e["tid"] for e in req_x}) == 2
+        assert all(e["tid"] >= 16 for e in req_x)
+        # the fan-out: one flow start per member, finishes matching
+        starts = [e for e in events if e["ph"] == "s"]
+        finishes = [e for e in events if e["ph"] == "f"]
+        assert len(starts) == 2 and len(finishes) == 2
+        assert {e["id"] for e in starts} == {e["id"] for e in finishes}
+        assert all(e.get("bp") == "e" for e in finishes)
+        # phase decomposition renders as child slices on the track
+        phases = [e for e in events if e.get("cat") == "trace.phase"]
+        assert {e["name"] for e in phases} == set(obs_trace.PHASES)
+
+    def test_metadata_events_precede_timed_events(self):
+        recs, _, _ = _fan_out_records(2)
+        events = chrome_trace(recs)["traceEvents"]
+        kinds = [e["ph"] for e in events]
+        metas = [i for i, ph in enumerate(kinds) if ph == "M"]
+        timed = [i for i, ph in enumerate(kinds) if ph != "M"]
+        assert metas and max(metas) < min(timed)
+        names = {e["args"]["name"] for e in events if e["ph"] == "M"}
+        assert "serve requests" in names and "batches" in names
+
+    def test_replica_annotation_separates_lanes(self):
+        recs0, _, _ = _fan_out_records(2, replica=0)
+        recs1, _, _ = _fan_out_records(2, replica=1)
+        events = chrome_trace(recs0 + recs1)["traceEvents"]
+        pids = {e["pid"] for e in events if e["ph"] == "X"}
+        assert pids == {100, 101}
+
+    def test_aggregate_counts_trace_spans_as_other(self):
+        recs, _, _ = _fan_out_records(2)
+        spans, counters, gauges, metrics, other = aggregate(recs)
+        assert not spans and not metrics
+        assert other == len(recs)
+
+
+# ---------------------------------------------------------------------------
+# fleet aggregation
+# ---------------------------------------------------------------------------
+
+def _snap(target, counters=None, gauges=None, slo=None, error=None):
+    metrics = None
+    if error is None:
+        metrics = {"counters": counters or {}, "gauges": gauges or {},
+                   "samples": {}}
+    return {"target": target, "metrics": metrics, "slo": slo,
+            "error": error}
+
+
+def _slo_doc(verdict, n, errors=0, buckets=None, burn=0.0,
+             degraded=False):
+    return {"objectives": {"p99_ms": 50.0, "avail": 0.99},
+            "degraded": degraded, "verdict": verdict,
+            "windows": {"1m": {"n": n, "errors": errors, "slow": 0,
+                               "buckets": buckets or {},
+                               "burn_rate": burn}}}
+
+
+class TestFleetMerge:
+    def test_parse_prometheus(self):
+        text = ("# HELP pint_tpu_serve_requests_total reqs\n"
+                "pint_tpu_serve_requests_total 42\n"
+                "pint_tpu_serve_queue_depth 3.5\n"
+                'pint_tpu_hist{q="p99"} 0.012\n'
+                "not a sample line !!\n")
+        out = fleet.parse_prometheus(text)
+        assert out["counters"] == {
+            "pint_tpu_serve_requests_total": 42.0}
+        assert out["gauges"] == {"pint_tpu_serve_queue_depth": 3.5}
+        assert out["samples"]['pint_tpu_hist{q="p99"}'] == 0.012
+
+    def test_counters_sum_and_gauges_keep_spread(self):
+        doc = fleet.merge([
+            _snap("a:1", counters={"x_total": 5.0},
+                  gauges={"depth": 1.0}, slo=_slo_doc("ok", 10)),
+            _snap("b:2", counters={"x_total": 7.0},
+                  gauges={"depth": 9.0}, slo=_slo_doc("ok", 10)),
+        ])
+        assert doc["replicas"] == 2 and doc["replicas_up"] == 2
+        assert doc["counters"]["x_total"] == 12.0
+        g = doc["gauges"]["depth"]
+        assert (g["min"], g["max"], g["sum"], g["n"]) == \
+            (1.0, 9.0, 10.0, 2)
+
+    def test_slo_buckets_merge_bucket_wise_not_averaged(self):
+        # replica A all fast (bucket 0), replica B all slow (high
+        # bucket): the fleet p99 must come from the MERGED histogram
+        # (lands in B's slow bucket), not an average of per-replica
+        # p99s
+        a = _slo_doc("ok", 90, buckets={"0": 90})
+        b = _slo_doc("violated", 90, buckets={"60": 90}, burn=3.0)
+        doc = fleet.merge([_snap("a:1", slo=a), _snap("b:2", slo=b)])
+        w = doc["slo"]["windows"]["1m"]
+        assert w["n"] == 180
+        assert w["buckets"] == {"0": 90, "60": 90}
+        solo_a = fleet._merge_slo([a])["windows"]["1m"]["p99_ms"]
+        assert w["p99_ms"] > solo_a * 10
+        assert w["burn_rate"] == 3.0
+        # worst-of: one violating replica makes the fleet violated
+        assert doc["verdict"] == "violated"
+
+    def test_availability_and_degraded_or(self):
+        doc = fleet.merge([
+            _snap("a:1", slo=_slo_doc("ok", 100)),
+            _snap("b:2", slo=_slo_doc("ok", 100, errors=10,
+                                      degraded=True)),
+        ])
+        w = doc["slo"]["windows"]["1m"]
+        assert w["availability"] == pytest.approx(1.0 - 10 / 200)
+        assert doc["slo"]["degraded"] is True
+
+    def test_down_replica_tolerated_and_reported(self):
+        doc = fleet.merge([
+            _snap("a:1", counters={"x_total": 5.0},
+                  slo=_slo_doc("ok", 10)),
+            _snap("b:2", error="URLError: refused"),
+        ])
+        assert doc["replicas"] == 2 and doc["replicas_up"] == 1
+        assert doc["down"] == [{"target": "b:2",
+                                "error": "URLError: refused"}]
+        assert doc["counters"]["x_total"] == 5.0
+        assert doc["verdict"] == "ok"
+        lines = fleet.format_fleet(doc)
+        assert any("1/2 replicas up" in ln for ln in lines)
+        assert any("down b:2" in ln for ln in lines)
+
+    def test_all_down_is_no_data(self):
+        doc = fleet.merge([_snap("a:1", error="dead")])
+        assert doc["replicas_up"] == 0
+        assert doc["verdict"] == "no_data"
+
+
+# ---------------------------------------------------------------------------
+# regression series: slo_p99_ms + trace_overhead_pct (lower is better)
+# ---------------------------------------------------------------------------
+
+class TestObsRegressionSeries:
+    def _round(self, tmp_path, n, metrics):
+        p = tmp_path / f"BENCH_r{n:02d}.json"
+        p.write_text(json.dumps({"n": n, "metrics": metrics}))
+        return str(p)
+
+    def _paths(self, tmp_path, name, v1, v2):
+        return [
+            self._round(tmp_path, 1, [{"metric": name, "value": v1,
+                                       "backend": "cpu"}]),
+            self._round(tmp_path, 2, [{"metric": name, "value": v2,
+                                       "backend": "cpu"}]),
+        ]
+
+    def test_slo_p99_regression_flags(self, tmp_path):
+        lines, rc = check_regression(
+            self._paths(tmp_path, "slo_p99_ms", 10.0, 40.0))
+        assert rc == 1
+        assert any(ln.startswith("REGRESSION slo_p99_ms")
+                   for ln in lines)
+
+    def test_slo_p99_within_slack_ok(self, tmp_path):
+        # floor = best + max(best * tol, 2.0) = 10 + 5
+        lines, rc = check_regression(
+            self._paths(tmp_path, "slo_p99_ms", 10.0, 14.0))
+        assert rc == 0
+
+    def test_trace_overhead_absolute_slack(self, tmp_path):
+        # tiny overheads ride the absolute slack: 0.3 -> 2.0 is fine
+        # (noise around zero), 0.3 -> 8.0 is a regression
+        lines, rc = check_regression(
+            self._paths(tmp_path, "trace_overhead_pct", 0.3, 2.0))
+        assert rc == 0
+        lines, rc = check_regression(
+            self._paths(tmp_path, "trace_overhead_pct", 0.3, 8.0))
+        assert rc == 1
+        assert any(ln.startswith("REGRESSION trace_overhead_pct")
+                   for ln in lines)

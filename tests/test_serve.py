@@ -25,6 +25,7 @@ import pytest
 import pint_tpu  # noqa: F401  (x64 + cpu platform via conftest)
 from pint_tpu import faults, telemetry
 from pint_tpu.compile_cache import WARM_WLS_PAR
+from pint_tpu.obs import trace as obs_trace
 from pint_tpu.serve import state as sstate
 from pint_tpu.serve.batcher import CoalescingBatcher
 from pint_tpu.serve.state import (
@@ -80,7 +81,9 @@ def _fake_request(group="g", deadline=None):
 
     req.future = concurrent.futures.Future()
     req.t_submit = time.perf_counter()
+    req.t_submit_wall = time.time()
     req.t_enqueue = None
+    req.trace = obs_trace.mint()
     return req
 
 
@@ -490,3 +493,406 @@ class TestKillAndResume:
         # resumed from the checkpoint: 2 of 8 points survived the kill
         assert doc["resumed_from"] == 2
         assert doc["result"]["n_finite"] == 8
+
+
+# ---------------------------------------------------------------------------
+# observability: request tracing, SLO engine, queue stats, fleet
+# ---------------------------------------------------------------------------
+
+class FakeClock:
+    def __init__(self, t=1_000_000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class TestRequestTracing:
+    def test_batch_fans_out_one_device_span_per_member(
+            self, registry, tmp_path):
+        """THE tracing acceptance shape: one coalesced flush lands in
+        the sink as ONE shared device span linking every member, plus
+        a request span per member linking back — and chrome-trace
+        reconstructs the fan-out."""
+        from pint_tpu.scripts.pinttrace import chrome_trace
+
+        sink = tmp_path / "spans.jsonl"
+        prev = telemetry.sink_info()
+        telemetry.configure(sink=str(sink))
+        try:
+            out = _dispatch_fits(registry, ["srvA", "srvB"])
+        finally:
+            telemetry.configure(sink=prev["path"] or prev["sink"],
+                                enabled=prev["enabled"])
+        assert all(r["status"] == "ok" for r in out)
+        # every 2xx result carries its trace + phase decomposition
+        for r in out:
+            assert r["trace"]["trace_id"]
+            assert set(r["phase_s"]) >= set(obs_trace.PHASES)
+        recs = [json.loads(ln) for ln in
+                sink.read_text().splitlines()]
+        spans = [r for r in recs if r.get("type") == "trace_span"]
+        dev = [r for r in spans
+               if r["name"] == "serve.batch.device"]
+        reqs = [r for r in spans if r["name"] == "serve.request"]
+        assert len(dev) == 1 and len(reqs) == 2
+        assert {lk["trace"] for lk in dev[0]["links"]} == \
+            {r["trace"]["trace_id"] for r in out}
+        assert all(r["links"] == [{"span": dev[0]["span"]}]
+                   for r in reqs)
+        # the device span names the programs that actually ran
+        assert dev[0].get("programs"), "profiler join lost programs"
+        events = chrome_trace(spans)["traceEvents"]
+        assert sum(1 for e in events if e["ph"] == "s") == 2
+        assert sum(1 for e in events if e["ph"] == "f") == 2
+
+    def test_every_live_request_gets_a_span_even_deduped(
+            self, registry, tmp_path):
+        sink = tmp_path / "spans.jsonl"
+        prev = telemetry.sink_info()
+        telemetry.configure(sink=str(sink))
+        try:
+            out = _dispatch_fits(registry, ["srvA", "srvA", "srvA"])
+        finally:
+            telemetry.configure(sink=prev["path"] or prev["sink"],
+                                enabled=prev["enabled"])
+        assert out[0]["batch"]["unique"] == 1
+        reqs = [json.loads(ln) for ln in sink.read_text().splitlines()
+                if '"serve.request"' in ln]
+        # 3 deduped members share one stacked row but each keeps its
+        # own request span (record count == 2xx response count)
+        assert len(reqs) == 3
+        assert len({r["trace"] for r in reqs}) == 3
+
+
+class TestObservabilityHTTP:
+    @pytest.fixture(scope="class")
+    def server(self):
+        from pint_tpu.serve.client import request_json
+        from pint_tpu.serve.server import Server
+
+        srv = Server(flush_ms=10.0, max_batch=4, queue_max=32,
+                     deadline_ms=0)
+        srv.start(port=0)
+        s, _, _ = request_json(
+            "127.0.0.1", srv._port, "POST", "/v1/load",
+            {"dataset": "obs1", "par": WARM_WLS_PAR,
+             "toas": {"n": 50, "seed": 5}})
+        assert s == 200
+        yield srv
+        srv.stop()
+
+    def test_2xx_carries_traceparent_and_server_timing(self, server):
+        from pint_tpu.serve.client import request_json
+
+        s, fit, hdrs = request_json(
+            "127.0.0.1", server._port, "POST", "/v1/fit",
+            {"dataset": "obs1", "maxiter": 2}, timeout=300)
+        assert s == 200 and fit["status"] == "ok"
+        assert hdrs["traceparent"] == fit["trace"]["traceparent"]
+        assert obs_trace.parse_traceparent(hdrs["traceparent"])
+        timing = hdrs["server-timing"]
+        for phase in obs_trace.PHASES:
+            assert f"{phase};dur=" in timing
+        assert set(fit["phase_s"]) >= set(obs_trace.PHASES) | {"total"}
+
+    def test_client_traceparent_is_continued(self, server):
+        from pint_tpu.serve.client import request_json
+
+        client_trace = "ab" * 16
+        s, fit, hdrs = request_json(
+            "127.0.0.1", server._port, "POST", "/v1/fit",
+            {"dataset": "obs1", "maxiter": 2}, timeout=300,
+            headers={"traceparent": f"00-{client_trace}-{'cd' * 8}-01"})
+        assert s == 200
+        assert fit["trace"]["trace_id"] == client_trace
+        assert client_trace in hdrs["traceparent"]
+
+    def test_slo_endpoint_and_stats_blocks(self, server):
+        from pint_tpu.serve.client import request_json
+
+        s, doc, _ = request_json("127.0.0.1", server._port, "GET",
+                                 "/slo")
+        assert s == 200
+        assert doc["verdict"] in ("no_data", "ok", "violated")
+        assert set(doc["windows"]) == {"1m", "10m", "1h"}
+        assert "objectives" in doc and "degraded" in doc
+        s, stats, _ = request_json("127.0.0.1", server._port, "GET",
+                                   "/v1/stats")
+        q = stats["queue"]
+        assert set(q) >= {"depth", "oldest_age_s", "groups",
+                          "drain_rate_rps", "queue_max",
+                          "queue_max_effective"}
+        assert stats["slo"]["verdict"] in ("no_data", "ok",
+                                           "violated")
+        assert set(stats["slo"]["burn_rate"]) == {"1m", "10m", "1h"}
+
+    def test_fleet_snapshot_over_two_live_replicas(self, server):
+        from pint_tpu.obs import fleet
+        from pint_tpu.serve.server import Server
+
+        srv2 = Server(flush_ms=10.0, max_batch=4, queue_max=32,
+                      deadline_ms=0)
+        srv2.start(port=0)
+        try:
+            targets = [f"127.0.0.1:{server._port}",
+                       f"127.0.0.1:{srv2._port}"]
+            doc = fleet.fleet_snapshot(targets, timeout=10.0)
+            assert doc["replicas"] == 2 and doc["replicas_up"] == 2
+            assert doc["counters"], "live /metrics scrape was empty"
+            assert set(doc["slo"]["windows"]) >= {"1m"}
+            assert doc["verdict"] in ("no_data", "ok", "violated")
+            # the CLI front door over the same two replicas
+            from pint_tpu.scripts import pinttrace as pt
+
+            rc = pt.main(["--fleet", ",".join(targets)])
+            assert rc == 0
+            # one replica down: still a fleet view, down one named
+            bad = targets + ["127.0.0.1:9"]
+            down = fleet.fleet_snapshot(bad, timeout=2.0)
+            assert down["replicas_up"] == 2
+            assert down["down"][0]["target"] == "127.0.0.1:9"
+        finally:
+            srv2.stop()
+
+
+class TestQueueAndRetryAfter:
+    def test_retry_after_prefers_observed_drain_rate(self):
+        from pint_tpu.serve import admission
+
+        # no observation yet: ~two flush periods, floored
+        assert admission.retry_after_s(5.0) == pytest.approx(0.05)
+        assert admission.retry_after_s(100.0) == pytest.approx(0.2)
+        # observed: time to drain the CURRENT backlog, clamped
+        assert admission.retry_after_s(
+            5.0, n_pending=40, drain_rate=20.0) == pytest.approx(2.0)
+        assert admission.retry_after_s(
+            5.0, n_pending=10_000, drain_rate=1.0) == 30.0
+        assert admission.retry_after_s(
+            5.0, n_pending=1, drain_rate=1000.0) == 0.05
+
+    def test_shed_hint_derives_from_drain_history(self):
+        sheds = []
+
+        def dispatch(key, reqs):  # never called: huge flush hold
+            pass
+
+        b = CoalescingBatcher(flush_ms=10_000.0, max_batch=8,
+                              queue_max=4, dispatch=dispatch)
+        try:
+            # seed the observed flush history: 100 requests drained
+            # over the 10 s flush-period span -> 10 req/s
+            with b._cond:
+                b._drained.append((time.perf_counter(), 100))
+            for _ in range(4):
+                b.submit(_fake_request())
+            with pytest.raises(Shed) as exc_info:
+                b.submit(_fake_request())
+            sheds.append(exc_info.value)
+        finally:
+            b.stop()
+        # 4 pending / 10 req/s observed
+        assert sheds[0].retry_after_s == pytest.approx(0.4, rel=0.1)
+
+    def test_queue_info_depth_age_groups(self):
+        def dispatch(key, reqs):
+            pass
+
+        b = CoalescingBatcher(flush_ms=10_000.0, max_batch=8,
+                              queue_max=16, dispatch=dispatch)
+        try:
+            b.submit(_fake_request(group="ga"))
+            b.submit(_fake_request(group="ga"))
+            b.submit(_fake_request(group="gb"))
+            info = b.queue_info()
+            assert info["depth"] == 3
+            assert info["groups"] == {"ga": 2, "gb": 1}
+            assert info["oldest_age_s"] >= 0.0
+            assert info["queue_max"] == 16
+            assert info["queue_max_effective"] <= 16
+        finally:
+            b.stop()
+
+    def test_drain_rate_observed_after_flushes(self):
+        done = threading.Event()
+
+        def dispatch(key, reqs):
+            done.set()
+
+        b = CoalescingBatcher(flush_ms=1.0, max_batch=8,
+                              queue_max=16, dispatch=dispatch)
+        try:
+            b.submit(_fake_request())
+            assert done.wait(5)
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if b.queue_info()["drain_rate_rps"] > 0:
+                    break
+                time.sleep(0.01)
+            assert b.queue_info()["drain_rate_rps"] > 0
+        finally:
+            b.stop()
+
+
+class TestSloUnderSlowFlush:
+    def test_slow_flush_violates_then_recovers(self, registry):
+        """The acceptance story: the slow-flush fault drives /slo to
+        violated and trips the degrade hook (queue bound shrinks);
+        clearing the fault recovers both."""
+        from pint_tpu.obs import slo as obs_slo
+
+        clk = FakeClock()
+        tr = obs_slo.reset(p99_ms=300.0, time_fn=clk)
+        try:
+            faults.inject("slow_flush", ms=800, site="serve.flush")
+            try:
+                for _ in range(2):
+                    _dispatch_fits(registry, ["srvA"])
+            finally:
+                faults.clear()
+            clk.advance(1.5)
+            snap = tr.snapshot()
+            assert snap["verdict"] == "violated"
+            assert snap["windows"]["1m"]["p99_ms"] > 300.0
+            clk.advance(1.5)   # step past the 1 s verdict cache
+            assert tr.maybe_degrade() is True
+            assert tr.effective_queue_max(64) == 32
+            # recovery: the slow cohort ages out, fresh traffic is
+            # fast (well under the 300 ms objective, warm programs)
+            clk.advance(90)
+            for _ in range(3):
+                _dispatch_fits(registry, ["srvA"])
+            clk.advance(1.5)
+            assert tr.maybe_degrade() is False
+            assert tr.effective_queue_max(64) == 64
+            assert tr.snapshot()["windows"]["1m"]["verdict"] == "ok"
+        finally:
+            obs_slo.reset()
+
+
+class TestReadinessLatch:
+    def test_readyz_never_flaps_once_warm(self):
+        """Satellite: /readyz under concurrent warm/arm.  Once a
+        replica is warm, concurrent mark_warm(False) callers (a
+        startup(warm=False) racing a warmup thread) must never flip
+        readiness back to 503."""
+        from pint_tpu import metrics_http
+        from pint_tpu.serve.server import Server
+
+        srv = Server(flush_ms=5.0, max_batch=2, queue_max=8,
+                     deadline_ms=0)
+        try:
+            telemetry.gauge_set("serve.ready", 1.0)
+            srv.mark_warm(True)
+            assert metrics_http.readiness()[0] is True
+            flaps = []
+            stop = threading.Event()
+
+            def poll():
+                while not stop.is_set():
+                    ready, doc = metrics_http.readiness()
+                    if not ready:
+                        flaps.append(doc)
+
+            def hammer(first):
+                for _ in range(400):
+                    srv.mark_warm(first)
+                    srv.mark_warm(not first)
+
+            threads = [threading.Thread(target=poll)]
+            threads += [threading.Thread(target=hammer, args=(v,))
+                        for v in (False, True, False)]
+            for t in threads:
+                t.start()
+            for t in threads[1:]:
+                t.join()
+            stop.set()
+            threads[0].join()
+            assert not flaps, f"readiness flapped {len(flaps)}x"
+            assert srv._warm is True
+        finally:
+            srv.batcher.stop()
+
+    def test_sanitizer_armed_gauge_agrees_with_readiness(self):
+        """Satellite: an armed sanitizer declares the process warm —
+        the armed gauge may only be 1 when readiness agrees."""
+        from pint_tpu import metrics_http
+        from pint_tpu.lint import sanitizer
+        from pint_tpu.serve.server import Server
+
+        srv = Server(flush_ms=5.0, max_batch=2, queue_max=8,
+                     deadline_ms=0)
+        sanitizer.configure(mode="warn")
+        try:
+            telemetry.gauge_set("serve.ready", 1.0)
+            sanitizer.disarm()
+            # not warm: _arm_sanitizer must refuse to arm
+            srv._arm_sanitizer(False)
+            assert telemetry.gauges().get("sanitizer.armed",
+                                          0.0) == 0.0
+            # warm: arm fires, and readiness agrees with the gauge
+            srv.mark_warm(True)
+            srv._arm_sanitizer(True)
+            assert telemetry.gauges()["sanitizer.armed"] == 1.0
+            ready, doc = metrics_http.readiness()
+            assert ready is True
+            assert sanitizer.armed() is True
+        finally:
+            sanitizer.disarm()
+            sanitizer.configure(mode="off")
+            srv.batcher.stop()
+
+
+class TestJobTraceStamping:
+    def test_job_and_checkpoint_keep_admission_trace(
+            self, registry, tmp_path):
+        """A job chunk stamps the admission-time trace id into its
+        checkpoint header, so a resumed job continues the SAME trace
+        (the story of the work is one trace, not one per attempt)."""
+        from pint_tpu.serve import jobs as sjobs
+
+        trace_id = "ef" * 16
+        f0 = float(registry.get("srvA").model.values["F0"])
+        spec = {"kind": "grid", "dataset": "srvA", "job": "tr1",
+                "params": ["F0"], "n_steps": 1, "chunk": 2,
+                "axes": {"F0": {"start": f0 - 1e-10,
+                                "stop": f0 + 1e-10, "n": 4}}}
+        doc = {"kind": "grid", "job": "tr1", "spec": spec,
+               "trace": trace_id}
+        heads = []
+
+        def snoop(_doc):
+            # the checkpoint is unlinked once the job finishes, so
+            # read its header mid-run, after each chunk's save
+            with np.load(tmp_path / "tr1.ckpt.npz",
+                         allow_pickle=False) as z:
+                heads.append(json.loads(str(z["__meta__"][()])))
+
+        result = sjobs.run_job(registry, doc, str(tmp_path),
+                               grid_chunk=2, progress=snoop)
+        assert result["n_finite"] == 4
+        assert len(heads) == 2   # 4 points / chunk 2
+        for head in heads:
+            assert head["meta"]["trace"] == trace_id
+            assert head["meta"]["job"] == "tr1"
+        # the store-level contract: a resubmit of a finished job
+        # keeps the ORIGINAL trace, not the resubmit's
+        store = sjobs.JobStore(registry, job_dir=str(tmp_path),
+                               grid_chunk=2)
+        try:
+            first = store.submit(spec, trace=trace_id)
+            assert first["trace"] == trace_id
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                st = store.status("tr1")
+                if st["state"] in ("done", "failed"):
+                    break
+                time.sleep(0.2)
+            assert st["state"] == "done", st.get("error")
+            again = store.submit(spec, trace="99" * 16)
+            assert again["trace"] == trace_id
+        finally:
+            store.stop()

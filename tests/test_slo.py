@@ -1,0 +1,249 @@
+"""SLO engine (pint_tpu/obs/slo): objective parsing, rolling-window
+quantiles and availability, burn rates, the verdict lattice, and the
+degrade hook that shrinks admission's queue bound while the 1-minute
+error budget burns hot.  Everything runs on an injected fake clock —
+no sleeps, no wall-clock flakiness.
+"""
+
+import pytest
+
+from pint_tpu import telemetry
+from pint_tpu.obs import slo
+
+
+class FakeClock:
+    def __init__(self, t=1_000_000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture()
+def clk():
+    return FakeClock()
+
+
+# ---------------------------------------------------------------------------
+# objectives + estimator
+# ---------------------------------------------------------------------------
+
+class TestObjectives:
+    def test_env_parsing(self, monkeypatch):
+        monkeypatch.setenv(slo.P99_ENV, "25")
+        monkeypatch.setenv(slo.AVAIL_ENV, "0.99")
+        assert slo.objectives() == {"p99_ms": 25.0, "avail": 0.99}
+
+    def test_unset_zero_and_garbage_disable(self, monkeypatch):
+        for raw in ("", "0", "-3", "nope"):
+            monkeypatch.setenv(slo.P99_ENV, raw)
+            assert slo.objectives()["p99_ms"] is None
+        monkeypatch.delenv(slo.P99_ENV, raising=False)
+        assert slo.objectives()["p99_ms"] is None
+
+    def test_perfect_availability_has_no_budget(self, monkeypatch):
+        # avail >= 1.0 would make the burn denominator zero
+        monkeypatch.setenv(slo.AVAIL_ENV, "1.0")
+        assert slo.objectives()["avail"] is None
+
+
+class TestQuantileEstimator:
+    def test_empty_is_none(self):
+        assert slo.quantiles_from_buckets({}) == \
+            {50: None, 95: None, 99: None}
+
+    def test_single_bucket_and_tail(self):
+        idx = slo._bucket_idx(0.010)
+        qs = slo.quantiles_from_buckets({idx: 100})
+        # every quantile reads the one occupied bucket, within its
+        # geometric width
+        assert qs[50] == qs[99]
+        assert 0.005 < qs[99] < 0.020
+
+    def test_p99_lands_in_slow_tail(self):
+        fast, slow = slo._bucket_idx(0.005), slo._bucket_idx(0.500)
+        qs = slo.quantiles_from_buckets({fast: 95, slow: 5})
+        assert qs[50] < 0.02 and qs[99] > 0.1
+
+
+# ---------------------------------------------------------------------------
+# tracker: windows, verdicts, burn
+# ---------------------------------------------------------------------------
+
+class TestSloTracker:
+    def test_no_data_verdict(self, clk):
+        tr = slo.SloTracker(p99_ms=50.0, avail=0.99, time_fn=clk)
+        snap = tr.snapshot()
+        assert snap["verdict"] == "no_data"
+        assert snap["objectives"] == {"p99_ms": 50.0, "avail": 0.99}
+        assert set(snap["windows"]) == {"1m", "10m", "1h"}
+
+    def test_fast_healthy_traffic_is_ok(self, clk):
+        tr = slo.SloTracker(p99_ms=50.0, avail=0.99, time_fn=clk)
+        for _ in range(200):
+            tr.record("fit", 0.005)
+        snap = tr.snapshot()
+        w = snap["windows"]["1m"]
+        assert snap["verdict"] == "ok"
+        assert w["n"] == 200 and w["errors"] == 0
+        assert w["p99_ms"] < 50.0
+        assert w["availability"] == 1.0
+        assert w["burn_rate"] == 0.0
+        assert w["ops"]["fit"]["n"] == 200
+
+    def test_slow_tail_violates_latency_objective(self, clk):
+        tr = slo.SloTracker(p99_ms=50.0, avail=None, time_fn=clk)
+        for _ in range(95):
+            tr.record("fit", 0.005)
+        for _ in range(5):
+            tr.record("fit", 0.500)   # 10x the objective
+        snap = tr.snapshot()
+        w = snap["windows"]["1m"]
+        assert w["slow"] == 5
+        assert w["p99_ms"] > 50.0
+        assert snap["verdict"] == "violated"
+        # 5% slow against the 1% budget: burn 5x
+        assert w["burn_rate"] == pytest.approx(5.0)
+
+    def test_failures_burn_availability_not_quantiles(self, clk):
+        tr = slo.SloTracker(p99_ms=50.0, avail=0.99, time_fn=clk)
+        for _ in range(98):
+            tr.record("fit", 0.005)
+        for _ in range(2):
+            tr.record("fit", 0.0, ok=False)  # sheds: 0 ms, failed
+        w = tr.snapshot()["windows"]["1m"]
+        assert w["errors"] == 2
+        assert w["availability"] == pytest.approx(0.98)
+        # a shed's 0 ms must not improve p99: only the 98 ok
+        # latencies populate the histogram
+        assert sum(w["buckets"].values()) == 98
+        # 2% errors against the 1% budget: burn 2x
+        assert w["burn_rate"] == pytest.approx(2.0)
+        assert tr.snapshot()["verdict"] == "violated"
+
+    def test_windows_age_out_independently(self, clk):
+        tr = slo.SloTracker(p99_ms=50.0, avail=None, time_fn=clk)
+        for _ in range(10):
+            tr.record("fit", 0.500)
+        clk.advance(120)   # past 1m, inside 10m
+        snap = tr.snapshot()
+        assert snap["windows"]["1m"]["n"] == 0
+        assert snap["windows"]["1m"]["verdict"] == "no_data"
+        assert snap["windows"]["10m"]["n"] == 10
+        assert snap["windows"]["10m"]["verdict"] == "violated"
+        assert snap["verdict"] == "violated"   # worst window wins
+        clk.advance(3600)
+        assert tr.snapshot()["verdict"] == "no_data"
+
+    def test_buckets_pruned_past_horizon(self, clk):
+        tr = slo.SloTracker(p99_ms=50.0, avail=None, time_fn=clk)
+        for _ in range(5):
+            tr.record("fit", 0.005)
+            clk.advance(3700)
+        assert len(tr._buckets) <= slo.WINDOWS[-1][1] + 2
+
+    def test_gauges_exported(self, clk):
+        tr = slo.SloTracker(p99_ms=50.0, avail=0.99, time_fn=clk)
+        tr.record("fit", 0.005)
+        tr.snapshot()
+        g = telemetry.gauges()
+        assert g["slo.p99_ms"] > 0
+        assert g["slo.availability"] == 1.0
+        for label in ("1m", "10m", "1h"):
+            assert f"slo.burn_rate.{label}" in g
+        assert g["slo.degraded"] == 0.0
+        assert g["slo.queue_scale"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# degrade hook
+# ---------------------------------------------------------------------------
+
+class TestDegradeHook:
+    def _burn_hot(self, tr, clk):
+        for _ in range(20):
+            tr.record("fit", 0.005)
+        for _ in range(20):
+            tr.record("fit", 0.500)
+        clk.advance(1.5)   # invalidate the 1 s verdict cache
+
+    def test_degrade_shrinks_queue_and_recovers(self, clk):
+        tr = slo.SloTracker(p99_ms=50.0, avail=None, time_fn=clk)
+        assert tr.effective_queue_max(64) == 64
+        degrades = telemetry.counter_get("slo.degrades")
+        self._burn_hot(tr, clk)   # 50% slow: burn 50x >= 2.0
+        assert tr.maybe_degrade() is True
+        assert telemetry.counter_get("slo.degrades") == degrades + 1
+        assert tr.effective_queue_max(64) == 32
+        assert tr.effective_queue_max(1) == 1   # never below 1
+        # an unbounded queue degrades to a real bound: an unbounded
+        # queue is exactly the failure mode the hook exists to stop
+        assert tr.effective_queue_max(0) == 8
+        assert telemetry.gauges()["slo.degraded"] == 1.0
+        assert tr.snapshot()["degraded"] is True
+        # recovery: the slow cohort ages out of the 1 m window and
+        # fresh traffic is healthy -> burn < 1.0 releases the hook
+        recoveries = telemetry.counter_get("slo.recoveries")
+        clk.advance(90)
+        for _ in range(50):
+            tr.record("fit", 0.005)
+        clk.advance(1.5)
+        assert tr.maybe_degrade() is False
+        assert telemetry.counter_get(
+            "slo.recoveries") == recoveries + 1
+        assert tr.effective_queue_max(64) == 64
+        assert telemetry.gauges()["slo.degraded"] == 0.0
+
+    def test_hysteresis_holds_between_one_and_two(self, clk):
+        """Burn in [1, 2): not enough to trip, not enough to release
+        — whichever state the tracker is in, it keeps."""
+        tr = slo.SloTracker(p99_ms=None, avail=0.99, time_fn=clk)
+        # 1.5% errors against the 1% budget: burn 1.5
+        for _ in range(985):
+            tr.record("fit", 0.005)
+        for _ in range(15):
+            tr.record("fit", 0.0, ok=False)
+        clk.advance(1.5)
+        assert tr.maybe_degrade() is False   # below DEGRADE_BURN
+        tr._degraded = True                  # as if previously hot
+        clk.advance(1.5)
+        assert tr.maybe_degrade() is True    # burn >= 1.0 holds it
+
+    def test_verdict_cache_rate_limits(self, clk):
+        tr = slo.SloTracker(p99_ms=50.0, avail=None, time_fn=clk)
+        self._burn_hot(tr, clk)
+        assert tr.maybe_degrade() is True
+        # within the 1 s cache window the snapshot is not recomputed:
+        # even after the window would empty, the cached flag holds
+        tr._buckets.clear()
+        clk.advance(0.5)
+        assert tr.maybe_degrade() is True
+        clk.advance(1.0)   # cache stale -> recompute -> burn 0
+        assert tr.maybe_degrade() is False
+
+    def test_verdict_doc_shape(self, clk):
+        tr = slo.SloTracker(p99_ms=50.0, avail=0.99, time_fn=clk)
+        tr.record("fit", 0.005)
+        doc = tr.verdict_doc()
+        assert set(doc) == {"verdict", "degraded", "burn_rate",
+                            "objectives"}
+        assert set(doc["burn_rate"]) == {"1m", "10m", "1h"}
+
+
+# ---------------------------------------------------------------------------
+# module singleton
+# ---------------------------------------------------------------------------
+
+class TestSingleton:
+    def test_reset_swaps_and_module_record_routes(self, clk):
+        try:
+            tr = slo.reset(p99_ms=50.0, time_fn=clk)
+            assert slo.tracker() is tr
+            slo.record("fit", 0.005)
+            assert tr.snapshot()["windows"]["1m"]["n"] == 1
+            assert slo.effective_queue_max(16) == 16
+        finally:
+            slo.reset()   # back to env-declared objectives
