@@ -1698,6 +1698,86 @@ def bench_trace_overhead(jnp, backend):
     })
 
 
+def bench_corpus_parity(jnp, backend):
+    """Oracle-parity harness throughput over a corpus slice —
+    scenarios/sec through the full battery (generate, realize twice,
+    clean-closure residuals, fit-recovery).
+
+    Two passes over structurally identical slices drawn from
+    different base seeds: pass 1 (seed 0) compiles every shared trace
+    the slice's model structures need; pass 2 (seed 1) is the
+    measurement — same structures, fresh values/datasets, so the
+    number tracks the harness's steady-state cost, which is what a
+    nightly full-corpus run pays per scenario."""
+    from pint_tpu.corpus.parity import run_parity
+    from pint_tpu.corpus.spec import build_class
+
+    classes = ("spin", "binary", "dmx", "rednoise", "chromatic")
+    per_class = 2
+
+    def slice_of(seed):
+        out = []
+        for k in classes:
+            out.extend(build_class(k, base_seed=seed,
+                                   count=per_class))
+        return out
+
+    warm = run_parity(slice_of(0), mode="oracle")
+    assert all(v.status == "pass" for v in warm), \
+        [v.to_json() for v in warm if v.status != "pass"]
+    t0 = time.time()
+    verdicts = run_parity(slice_of(1), mode="oracle")
+    wall = time.time() - t0
+    bad = [v for v in verdicts if v.status != "pass"]
+    assert not bad, [v.to_json() for v in bad]
+    n = len(verdicts)
+    rate = n / wall
+    _emit_metric({
+        "metric": "corpus_parity_scenarios_per_sec",
+        "value": round(rate, 3),
+        "unit": f"scenarios/s oracle parity ({n} scenarios, "
+                f"{len(classes)} classes, backend={backend})",
+        "vs_baseline": None,
+        "backend": backend,
+        "compile_s": None,
+        "flops": None,
+    })
+
+
+def bench_corpus_replay(jnp, backend):
+    """Corpus soak replay throughput: the mixed scenario stream
+    through an in-process ``pintserve`` replica with the recompile
+    sanitizer ARMED — the record asserts zero violations, so the
+    metric doubles as the standing zero-compile soak acceptance
+    (ROADMAP item 2's load half).
+
+    Pass 1 warms (its rps is discarded); pass 2 over the same replica
+    state is the measurement."""
+    from pint_tpu.corpus.replay import default_mix, replay_mix
+
+    mix = default_mix(base_seed=0)
+    replay_mix(mix, n_requests=40, slo_p99_ms=500.0)
+    stats = replay_mix(mix, n_requests=120, slo_p99_ms=500.0)
+    assert stats["errors"] == 0, stats
+    assert stats["sanitizer_violations"] == 0, \
+        (f"corpus replay recompiled under the armed sanitizer: "
+         f"{stats['sanitizer_violations']} violations")
+    _emit_metric({
+        "metric": "corpus_replay_reqs_per_sec",
+        "value": round(stats["rps"], 1),
+        "unit": f"req/s corpus soak mix ({len(mix)} datasets, "
+                f"70/20/10 fit/lnlike/residuals, sanitizer armed, "
+                f"violations={stats['sanitizer_violations']}, "
+                f"slo={stats['slo'].get('verdict')}, "
+                f"backend={backend})",
+        "vs_baseline": None,
+        "backend": backend,
+        "compile_s": None,
+        "flops": None,
+        "violations": stats["sanitizer_violations"],
+    })
+
+
 #: run order: the roofline first (its measured matmul peak becomes the
 #: honest MFU denominator for everything after it), then
 #: proven-cheapest compile first, heaviest (GLS) last, so a mid-run
@@ -1720,6 +1800,11 @@ _METRICS = {
     "profile_overhead": bench_profile_overhead,
     "trace_overhead": bench_trace_overhead,
     "gls": bench_gls,
+    # the scenario-corpus pair (docs/corpus.md): parity-harness
+    # throughput and the serve-plane soak (the latter asserts zero
+    # sanitizer violations — the standing zero-compile soak gate)
+    "corpus_parity": bench_corpus_parity,
+    "corpus_replay": bench_corpus_replay,
 }
 
 

@@ -1,0 +1,27 @@
+"""Scenario corpus: the workload factory (ROADMAP item 1).
+
+``spec``   — deterministic scenario grammar (model families x noise
+             processes x cadence patterns x fault corruptions) and the
+             default >=100-scenario corpus.
+``parity`` — differential parity harness: every scenario through our
+             stack and (when mounted) the reference PINT, with
+             class-scaled tolerances and structured verdicts.
+``replay`` — the corpus as standing soak load for ``pintserve``.
+``cli``    — the ``pintcorpus`` generate/run/report/replay entry point.
+"""
+
+from pint_tpu.corpus.spec import (  # noqa: F401
+    CLASSES,
+    Scenario,
+    build_class,
+    default_corpus,
+    scenario_seed,
+)
+from pint_tpu.corpus.parity import (  # noqa: F401
+    CLASS_TOL,
+    Verdict,
+    parity_one,
+    reference_available,
+    run_parity,
+    summarize,
+)

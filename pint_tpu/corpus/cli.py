@@ -1,0 +1,176 @@
+"""``pintcorpus`` — generate / run / report / replay the scenario
+corpus.
+
+- ``pintcorpus generate [--out DIR] [--seed N] [--per-class K]
+  [--class NAME ...]`` — write every scenario's par/tim pair plus
+  ``manifest.json``.
+- ``pintcorpus run [--out DIR | --seed N] [--class NAME ...]
+  [--mode auto|oracle|reference] [--verdicts PATH]`` — the parity
+  harness over a corpus (an on-disk manifest, or generated in
+  memory), per-class verdict table on stdout, JSONL verdict records.
+- ``pintcorpus report VERDICTS.jsonl`` — re-render the table from a
+  saved verdict file.
+- ``pintcorpus replay [--requests N] [--seed N]`` — the serve-plane
+  soak mix (sanitizer armed, SLO engine fed).
+
+``--out`` defaults to ``$PINT_TPU_CORPUS_DIR`` when set.  Exit code:
+0 when nothing failed (skips are not failures), 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+__all__ = ["main"]
+
+ENV_DIR = "PINT_TPU_CORPUS_DIR"
+
+
+def _corpus_from_args(args):
+    from pint_tpu.corpus.spec import default_corpus, load_manifest
+
+    out = getattr(args, "out", None) or os.environ.get(ENV_DIR)
+    if out and os.path.exists(os.path.join(out, "manifest.json")):
+        scenarios = load_manifest(os.path.join(out, "manifest.json"))
+        if args.klass:
+            scenarios = [s for s in scenarios
+                         if s.klass in set(args.klass)]
+        return scenarios
+    return default_corpus(base_seed=args.seed,
+                          per_class=getattr(args, "per_class", 7),
+                          classes=args.klass or None)
+
+
+def _print_table(summary, file=sys.stdout):
+    print(f"{'class':<12s} {'scenarios':>9s} {'pass':>5s} "
+          f"{'fail':>5s} {'skip':>5s}", file=file)
+    for klass in sorted(summary):
+        row = summary[klass]
+        print(f"{klass:<12s} {row['scenarios']:>9d} "
+              f"{row['pass']:>5d} {row['fail']:>5d} "
+              f"{row['skip']:>5d}", file=file)
+
+
+def _cmd_generate(args) -> int:
+    from pint_tpu.corpus.spec import default_corpus, write_corpus
+
+    out = args.out or os.environ.get(ENV_DIR)
+    if not out:
+        print("generate needs --out (or $PINT_TPU_CORPUS_DIR)",
+              file=sys.stderr)
+        return 2
+    scenarios = default_corpus(base_seed=args.seed,
+                               per_class=args.per_class,
+                               classes=args.klass or None)
+    path = write_corpus(scenarios, out)
+    classes = sorted({s.klass for s in scenarios})
+    print(f"wrote {len(scenarios)} scenarios "
+          f"({len(classes)} classes) -> {path}")
+    return 0
+
+
+def _cmd_run(args) -> int:
+    from pint_tpu.corpus.parity import run_parity, summarize
+
+    scenarios = _corpus_from_args(args)
+    verdicts = run_parity(scenarios, mode=args.mode)
+    if args.verdicts:
+        with open(args.verdicts, "w") as f:
+            for v in verdicts:
+                f.write(json.dumps(v.to_json()) + "\n")
+    summary = summarize(verdicts)
+    _print_table(summary)
+    failed = [v for v in verdicts if v.status == "fail"]
+    for v in failed[:10]:
+        bad = {k: c for k, c in v.checks.items() if not c.get("ok")}
+        print(f"FAIL {v.scenario} [{v.klass}] "
+              f"{v.detail or json.dumps(bad)}", file=sys.stderr)
+    return 1 if failed else 0
+
+
+def _cmd_report(args) -> int:
+    from pint_tpu.corpus.parity import Verdict, summarize
+
+    verdicts = []
+    with open(args.verdicts) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            d = json.loads(line)
+            verdicts.append(Verdict(d["scenario"], d["class"],
+                                    d["mode"], d["status"],
+                                    checks=d.get("checks"),
+                                    detail=d.get("detail", "")))
+    _print_table(summarize(verdicts))
+    return 1 if any(v.status == "fail" for v in verdicts) else 0
+
+
+def _cmd_replay(args) -> int:
+    from pint_tpu.corpus.replay import (DEFAULT_MIX, default_mix,
+                                        replay_mix)
+
+    classes = tuple(args.klass) if args.klass else DEFAULT_MIX
+    mix = default_mix(base_seed=args.seed, classes=classes)
+    stats = replay_mix(mix, n_requests=args.requests,
+                       slo_p99_ms=args.slo_p99_ms)
+    print(json.dumps({k: v for k, v in stats.items()
+                      if k != "slo"}, indent=1))
+    verdict = (stats["slo"] or {}).get("verdict", "off")
+    print(f"slo verdict: {verdict}")
+    ok = (stats["errors"] == 0
+          and stats["sanitizer_violations"] == 0)
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="pintcorpus",
+        description="scenario corpus: generate / parity / replay")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    g = sub.add_parser("generate", help="write par/tim + manifest")
+    g.add_argument("--out", default=None)
+    g.add_argument("--seed", type=int, default=0)
+    g.add_argument("--per-class", type=int, default=7,
+                   dest="per_class")
+    g.add_argument("--class", action="append", dest="klass",
+                   default=None, help="restrict to a scenario class")
+    g.set_defaults(fn=_cmd_generate)
+
+    r = sub.add_parser("run", help="parity harness over a corpus")
+    r.add_argument("--out", default=None,
+                   help="corpus dir with manifest.json (else "
+                        "generate in memory)")
+    r.add_argument("--seed", type=int, default=0)
+    r.add_argument("--per-class", type=int, default=7,
+                   dest="per_class")
+    r.add_argument("--class", action="append", dest="klass",
+                   default=None)
+    r.add_argument("--mode", default=None,
+                   choices=("auto", "oracle", "reference"))
+    r.add_argument("--verdicts", default=None,
+                   help="write JSONL verdict records here")
+    r.set_defaults(fn=_cmd_run)
+
+    p = sub.add_parser("report", help="summarize a verdict file")
+    p.add_argument("verdicts")
+    p.set_defaults(fn=_cmd_report)
+
+    y = sub.add_parser("replay", help="serve-plane soak mix")
+    y.add_argument("--requests", type=int, default=60)
+    y.add_argument("--seed", type=int, default=0)
+    y.add_argument("--class", action="append", dest="klass",
+                   default=None)
+    y.add_argument("--slo-p99-ms", type=float, default=500.0,
+                   dest="slo_p99_ms")
+    y.set_defaults(fn=_cmd_replay)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,154 @@
+"""Corpus replay: the scenario mix as standing ``pintserve`` soak load.
+
+The load half of ROADMAP item 2: a deterministic slice of the corpus
+is registered on an in-process replica and a mixed fit/lnlike/
+residuals stream (the same 70/20/10 mix as ``bench.py``'s serve
+metric) is fired over real loopback HTTP with
+
+- the **recompile sanitizer** armed (:mod:`pint_tpu.lint.sanitizer`) —
+  after warmup, ANY compile during the stream is a violation; and
+- the **SLO engine** given objectives (:mod:`pint_tpu.obs.slo`) — the
+  stream's latencies feed the rolling windows and the final verdict
+  rides the stats.
+
+Returns a structured stats dict (requests, rps, errors, sanitizer
+violations, SLO verdict) — consumed by ``bench_corpus_replay``, the
+``pintcorpus replay`` CLI and the soak tests.  Telemetry:
+``corpus.replay.requests`` / ``corpus.replay.errors`` /
+``corpus.replay.violations``.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import List, Optional
+
+from pint_tpu import telemetry
+
+__all__ = ["replay_mix", "default_mix", "replay"]
+
+#: the default replay slice: cheap, structurally diverse classes —
+#: white-noise WLS, a binary, piecewise DM, and a correlated-noise GLS
+#: dataset, so the stream spans distinct program structures
+DEFAULT_MIX = ("spin", "binary", "dmx", "rednoise")
+
+
+def default_mix(base_seed=0, classes=DEFAULT_MIX) -> List:
+    """One scenario per mix class (deterministic in ``base_seed``)."""
+    from pint_tpu.corpus.spec import build_class
+
+    return [build_class(k, base_seed=base_seed, count=1)[0]
+            for k in classes]
+
+
+def _mixed_op(i):
+    """The bench-aligned deterministic 70/20/10 fit/lnlike/residuals
+    mix."""
+    m = i % 10
+    if m < 7:
+        return "fit"
+    if m < 9:
+        return "lnlike"
+    return "residuals"
+
+
+def replay_mix(scenarios=None, n_requests=60, flush_ms=2.0,
+               max_batch=8, slo_p99_ms=None, slo_avail=None,
+               maxiter=2) -> dict:
+    """Fire ``n_requests`` of the mixed stream at an in-process
+    replica loaded with ``scenarios`` (default :func:`default_mix`),
+    sanitizer armed after warmup.  Returns the stats dict; raises
+    only on setup failure — request errors are counted, not raised."""
+    import http.client
+    import tempfile
+
+    from pint_tpu.lint import sanitizer
+    from pint_tpu.obs import slo as _slo
+    from pint_tpu.serve.server import Server
+
+    scenarios = list(scenarios or default_mix())
+    if not scenarios:
+        raise ValueError("replay needs at least one scenario")
+
+    srv = Server(flush_ms=flush_ms, max_batch=max_batch,
+                 queue_max=4096, deadline_ms=0)
+    port = srv.start(port=0)
+    was_armed = sanitizer.armed()
+    try:
+        # each scenario rides in as its written par/tim pair — the
+        # replica ingests exactly what the corpus persists, so replay
+        # exercises the tim round-trip too
+        with tempfile.TemporaryDirectory(
+                prefix="pint_tpu_replay_") as td:
+            for s in scenarios:
+                _, tim_path = s.write(td)
+                srv.registry.load(s.name, par=s.par, tim=tim_path)
+        ids = [s.name for s in scenarios]
+        # warm every (op, dataset) program so the armed stream is
+        # honestly zero-compile
+        for ds in ids:
+            srv.warmup(ds, ops=("fit", "lnlike", "residuals"),
+                       maxiter=maxiter)
+        if slo_p99_ms is not None or slo_avail is not None:
+            _slo.reset(p99_ms=slo_p99_ms, avail=slo_avail)
+        v0 = len(sanitizer.violations())
+        sanitizer.arm(note="corpus.replay")
+
+        conn = http.client.HTTPConnection("127.0.0.1", port,
+                                          timeout=120)
+        ok = 0
+        errors = 0
+        t0 = time.time()
+        for i in range(int(n_requests)):
+            op = _mixed_op(i)
+            ds = ids[i % len(ids)]
+            body = {"dataset": ds}
+            if op == "fit":
+                body["maxiter"] = maxiter
+            try:
+                conn.request(
+                    "POST", f"/v1/{op}",
+                    body=json.dumps(body).encode(),
+                    headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                r = json.loads(resp.read())
+                if resp.status == 200 and r.get("status") == "ok":
+                    ok += 1
+                else:
+                    errors += 1
+            except (OSError, ValueError):
+                errors += 1
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", port, timeout=120)
+            telemetry.counter_add("corpus.replay.requests")
+        wall = time.time() - t0
+        conn.close()
+        violations = len(sanitizer.violations()) - v0
+        slo_doc = _slo.tracker().verdict_doc()
+    finally:
+        if not was_armed:
+            sanitizer.disarm()
+        srv.stop()
+    if errors:
+        telemetry.counter_add("corpus.replay.errors", errors)
+    if violations:
+        telemetry.counter_add("corpus.replay.violations", violations)
+    stats = {
+        "datasets": ids,
+        "requests": int(n_requests),
+        "ok": ok,
+        "errors": errors,
+        "wall_s": wall,
+        "rps": (int(n_requests) / wall) if wall > 0 else 0.0,
+        "sanitizer_violations": violations,
+        "slo": slo_doc,
+    }
+    telemetry.emit({"type": "corpus_replay", **{
+        k: v for k, v in stats.items() if k != "slo"}})
+    return stats
+
+
+def replay(scenarios=None, **kw) -> dict:
+    """Alias of :func:`replay_mix` (the name the CLI/docs use)."""
+    return replay_mix(scenarios=scenarios, **kw)

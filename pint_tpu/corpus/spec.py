@@ -1,0 +1,435 @@
+"""Deterministic scenario generator: the corpus grammar.
+
+A :class:`Scenario` is a fully-specified synthetic pulsar dataset —
+par text + cadence + noise/fault plan + a seed — that realizes to a
+reproducible ``(model, toas)`` pair through :mod:`pint_tpu.simulation`.
+Scenario classes compose four orthogonal axes:
+
+- **model family**: spin-only, astrometry, binary (ELL1/DD), JUMP/FD,
+  DMX, chromatic CMX windows, solar wind, glitch, WaveX;
+- **noise process**: white (EFAC/EQUAD), ECORR epochs, power-law red /
+  DM-GP / band / system noise (drawn via the disjoint
+  :func:`pint_tpu.simulation.substream` convention, so every process
+  has its own stream);
+- **cadence pattern**: uniform, fuzzed, clustered epochs, multi- or
+  dual-frequency;
+- **corruption**: an optional :mod:`pint_tpu.faults` spec the parity
+  harness injects while realizing (``faulted`` class).
+
+Every draw is keyed by ``scenario_seed(base_seed, klass, index)`` —
+regenerating a corpus with the same seed is bit-identical, and the
+streams of distinct scenarios/classes never alias (CRC-keyed
+SeedSequence, never builtin ``hash``).
+
+The default corpus (``default_corpus``) is 15 classes x 7 scenarios =
+105 scenarios — the >=100 / >=8-class acceptance floor of ROADMAP
+item 1 with headroom.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from typing import Callable, Dict, List
+
+import numpy as np
+
+from pint_tpu import telemetry
+
+__all__ = ["Scenario", "CLASSES", "build_class", "default_corpus",
+           "scenario_seed", "write_corpus", "load_manifest"]
+
+
+def scenario_seed(base_seed, klass, index) -> int:
+    """The scenario's stream key: deterministic in (base_seed, class,
+    index), stable across processes (CRC32, not builtin ``hash``)."""
+    return int(
+        (int(base_seed) * 2_000_003
+         + zlib.crc32(str(klass).encode("utf-8")) * 131
+         + int(index)) & 0x7FFFFFFF
+    )
+
+
+class Scenario:
+    """One reproducible synthetic dataset.
+
+    ``cadence`` keys: start_mjd, duration_days, ntoa, error_us,
+    freq_mhz (scalar | list cycled per TOA), obs, flags (uniform
+    per-TOA flag dict), flag_cycle ({key: [values...]} assigned
+    cyclically per TOA — multi-system selectors), fuzz_days,
+    multifreq, clustered.
+    ``fault``: a :mod:`pint_tpu.faults` spec string, or None.
+    ``correlated``: realize() draws the model's correlated components
+    from per-component disjoint substreams of ``seed``.
+    """
+
+    def __init__(self, name, klass, seed, par, cadence,
+                 correlated=False, fault=None):
+        self.name = str(name)
+        self.klass = str(klass)
+        self.seed = int(seed)
+        self.par = str(par)
+        self.cadence = dict(cadence)
+        self.correlated = bool(correlated)
+        self.fault = fault
+
+    # -- realization ----------------------------------------------------------
+    def realize(self, add_noise=True, add_correlated=None):
+        """Build ``(model, toas)``.  ``add_noise=False`` yields the
+        clean zero-residual realization (same cadence draws — the
+        fuzz stream is shared), the parity harness's truth arm."""
+        from pint_tpu import simulation as sim
+        from pint_tpu.models.builder import get_model
+
+        model = get_model(self.par)
+        c = self.cadence
+        rng = sim.substream(self.seed, "white")
+        n = int(c["ntoa"])
+        if c.get("clustered"):
+            n = max(n // 4, 1) * 4
+        freq = c.get("freq_mhz", 1400.0)
+        if isinstance(freq, (list, tuple)):
+            reps = int(np.ceil(n / len(freq)))
+            freq = np.tile(np.asarray(freq, np.float64), reps)[:n]
+        flags = c.get("flags")
+        cycle = c.get("flag_cycle")
+        if cycle:
+            flags = [dict(flags or {}) for _ in range(n)]
+            for key, vals in cycle.items():
+                for i, f in enumerate(flags):
+                    f[key] = str(vals[i % len(vals)])
+        if c.get("clustered"):
+            epochs = np.linspace(
+                c["start_mjd"], c["start_mjd"] + c["duration_days"],
+                n // 4)
+            mjds = np.repeat(epochs, 4) + np.tile(
+                np.arange(4) * 0.1 / 86400.0, n // 4)
+            toas = sim.make_fake_toas_fromMJDs(
+                mjds, model, freq_mhz=freq, obs=c.get("obs", "@"),
+                error_us=c.get("error_us", 1.0), add_noise=add_noise,
+                rng=rng, flags=flags)
+        else:
+            toas = sim.make_fake_toas_uniform(
+                c["start_mjd"], c["start_mjd"] + c["duration_days"],
+                n, model, freq_mhz=freq, obs=c.get("obs", "@"),
+                error_us=c.get("error_us", 1.0), add_noise=add_noise,
+                rng=rng, flags=flags,
+                fuzz_days=c.get("fuzz_days", 0.0),
+                multifreq=c.get("multifreq", False))
+        if add_correlated if add_correlated is not None \
+                else (self.correlated and add_noise):
+            sim.add_correlated_noise(
+                toas, model, per_component_seed=self.seed)
+        telemetry.counter_add("corpus.realized")
+        return model, toas
+
+    # -- persistence ----------------------------------------------------------
+    def write(self, outdir):
+        """Write ``<name>.par`` / ``<name>.tim`` under outdir; returns
+        (par_path, tim_path)."""
+        from pint_tpu.toa import write_tim
+
+        os.makedirs(outdir, exist_ok=True)
+        par_path = os.path.join(outdir, self.name + ".par")
+        tim_path = os.path.join(outdir, self.name + ".tim")
+        with open(par_path, "w") as f:
+            f.write(self.par)
+        _, toas = self.realize()
+        write_tim(toas, tim_path)
+        return par_path, tim_path
+
+    def to_manifest(self) -> dict:
+        return {
+            "name": self.name, "class": self.klass, "seed": self.seed,
+            "par": self.par, "cadence": self.cadence,
+            "correlated": self.correlated, "fault": self.fault,
+        }
+
+    @classmethod
+    def from_manifest(cls, d) -> "Scenario":
+        return cls(d["name"], d["class"], d["seed"], d["par"],
+                   d["cadence"], correlated=d.get("correlated", False),
+                   fault=d.get("fault"))
+
+
+# --------------------------------------------------------------------------
+# par-text building blocks
+# --------------------------------------------------------------------------
+
+def _base_par(rng, name, mid, free_spin=True, ecliptic=False,
+              elat=None):
+    """Shared par prologue: pulsar name, sky position, spin, DM."""
+    f0 = rng.uniform(50.0, 600.0)
+    f1 = -(10.0 ** rng.uniform(-16.5, -14.5))
+    dm = rng.uniform(5.0, 60.0)
+    fit = "1" if free_spin else "0"
+    if ecliptic:
+        elong = rng.uniform(0.0, 360.0)
+        elat = rng.uniform(-5.0, 5.0) if elat is None else elat
+        pos = f"ELONG {elong:.6f}\nELAT {elat:.6f}\n"
+    else:
+        ra_h = rng.uniform(0.0, 24.0)
+        dec = rng.uniform(-60.0, 60.0)
+        pos = (f"RAJ {int(ra_h):02d}:{int((ra_h % 1) * 60):02d}:"
+               f"{(ra_h * 3600) % 60:07.4f}\n"
+               f"DECJ {int(dec):+03d}:{int(abs(dec) % 1 * 60):02d}:00\n")
+    return (f"PSR {name}\n{pos}"
+            f"F0 {f0!r} {fit}\nF1 {f1!r} {fit}\n"
+            f"PEPOCH {mid:.1f}\nDM {dm:.4f}\n"
+            f"TZRMJD {mid:.1f}\nTZRSITE @\nTZRFRQ 1400\n"
+            f"UNITS TDB\nEPHEM builtin\n")
+
+
+def _cadence(start=54000.0, days=1000.0, ntoa=32, **kw):
+    c = {"start_mjd": float(start), "duration_days": float(days),
+         "ntoa": int(ntoa), "error_us": 1.0, "obs": "@",
+         "freq_mhz": 1400.0}
+    c.update(kw)
+    return c
+
+
+# --------------------------------------------------------------------------
+# scenario classes
+# --------------------------------------------------------------------------
+
+def _cls_spin(rng, seed, name):
+    par = _base_par(rng, name, 54500.0)
+    if rng.random() < 0.5:
+        par += f"F2 {rng.uniform(-1e-26, 1e-26)!r} 1\n"
+    return Scenario(name, "spin", seed, par,
+                    _cadence(ntoa=30, fuzz_days=rng.uniform(0, 3.0)))
+
+
+def _cls_astrometry(rng, seed, name):
+    par = _base_par(rng, name, 54600.0)
+    par += (f"PMRA {rng.uniform(-20, 20):.3f} 1\n"
+            f"PMDEC {rng.uniform(-20, 20):.3f} 1\n"
+            "POSEPOCH 54600\n")
+    return Scenario(name, "astrometry", seed, par,
+                    _cadence(days=1200.0, ntoa=36, obs="gbt"))
+
+
+def _cls_binary(rng, seed, name):
+    par = _base_par(rng, name, 54500.0)
+    if rng.random() < 0.5:
+        pb = rng.uniform(2.0, 40.0)
+        par += (f"BINARY ELL1\nPB {pb:.6f} 1\n"
+                f"A1 {rng.uniform(1.0, 20.0):.6f} 1\n"
+                f"TASC {54500.0 + rng.uniform(0, pb):.6f} 1\n"
+                f"EPS1 {rng.uniform(-1e-4, 1e-4)!r} 1\n"
+                f"EPS2 {rng.uniform(-1e-4, 1e-4)!r} 1\n")
+    else:
+        pb = rng.uniform(5.0, 60.0)
+        par += (f"BINARY DD\nPB {pb:.6f} 1\n"
+                f"A1 {rng.uniform(2.0, 25.0):.6f} 1\n"
+                f"T0 {54500.0 + rng.uniform(0, pb):.6f} 1\n"
+                f"ECC {rng.uniform(0.05, 0.5):.6f} 1\n"
+                f"OM {rng.uniform(0, 360):.4f} 1\n")
+    return Scenario(name, "binary", seed, par, _cadence(ntoa=40))
+
+
+def _cls_jumps(rng, seed, name):
+    par = _base_par(rng, name, 54500.0)
+    par += (f"JUMP -fe L-wide {rng.uniform(-1e-4, 1e-4)!r} 1\n"
+            f"FD1 {rng.uniform(-1e-5, 1e-5)!r} 1\n")
+    # the JUMP selects only half the TOAs (a full-coverage jump is
+    # degenerate with the absolute phase); three frequencies against a
+    # period-2 flag cycle keep FD1 and the JUMP mask non-degenerate
+    return Scenario(
+        name, "jumps", seed, par,
+        _cadence(ntoa=36, obs="gbt",
+                 freq_mhz=[430.0, 1400.0, 800.0],
+                 flag_cycle={"fe": ["S-wide", "L-wide"]}))
+
+
+def _cls_dmx(rng, seed, name):
+    par = _base_par(rng, name, 54500.0)
+    edges = np.linspace(53995.0, 55005.0, 4)
+    for i in range(3):
+        par += (f"DMX_{i + 1:04d} {rng.uniform(-5e-3, 5e-3)!r} 1\n"
+                f"DMXR1_{i + 1:04d} {edges[i]:.1f}\n"
+                f"DMXR2_{i + 1:04d} {edges[i + 1]:.1f}\n")
+    return Scenario(name, "dmx", seed, par,
+                    _cadence(ntoa=36, freq_mhz=[430.0, 1400.0]))
+
+
+def _cls_rednoise(rng, seed, name):
+    par = _base_par(rng, name, 54500.0)
+    par += (f"TNREDAMP {rng.uniform(-14.0, -13.0):.3f}\n"
+            f"TNREDGAM {rng.uniform(2.0, 5.0):.3f}\nTNREDC 10\n"
+            "EFAC -f all 1.0\n")
+    return Scenario(name, "rednoise", seed, par,
+                    _cadence(ntoa=36, flags={"f": "all"}),
+                    correlated=True)
+
+
+def _cls_dmgp(rng, seed, name):
+    par = _base_par(rng, name, 54500.0)
+    par += (f"TNDMAMP {rng.uniform(-13.8, -13.0):.3f}\n"
+            f"TNDMGAM {rng.uniform(2.0, 4.5):.3f}\nTNDMC 8\n")
+    return Scenario(name, "dmgp", seed, par,
+                    _cadence(ntoa=36, freq_mhz=[430.0, 1400.0]),
+                    correlated=True)
+
+
+def _cls_chromatic(rng, seed, name):
+    # piecewise chromatic windows — the ChromaticCMX port
+    par = _base_par(rng, name, 54500.0)
+    par += "TNCHROMIDX 4.0\n"
+    edges = np.linspace(53995.0, 55005.0, 3)
+    for i in range(2):
+        par += (f"CMX_{i + 1:04d} {rng.uniform(-0.02, 0.02)!r} 1\n"
+                f"CMXR1_{i + 1:04d} {edges[i]:.1f}\n"
+                f"CMXR2_{i + 1:04d} {edges[i + 1]:.1f}\n")
+    return Scenario(name, "chromatic", seed, par,
+                    _cadence(ntoa=36, freq_mhz=[430.0, 1400.0]))
+
+
+def _cls_solarwind(rng, seed, name):
+    # low ecliptic latitude: the sun-angle sweep NE_SW is fit from
+    par = _base_par(rng, name, 54500.0, ecliptic=True)
+    par += f"NE_SW {rng.uniform(4.0, 12.0):.3f} 1\n"
+    return Scenario(name, "solarwind", seed, par,
+                    _cadence(days=1100.0, ntoa=36, obs="gbt",
+                             freq_mhz=[430.0, 1400.0]))
+
+
+def _cls_glitch(rng, seed, name):
+    par = _base_par(rng, name, 54500.0)
+    par += (f"GLEP_1 {rng.uniform(54300.0, 54700.0):.2f}\n"
+            f"GLF0_1 {rng.uniform(1e-8, 1e-6)!r} 1\n"
+            f"GLF1_1 {rng.uniform(-1e-14, 0.0)!r} 1\n"
+            "GLPH_1 0.0\n")
+    return Scenario(name, "glitch", seed, par, _cadence(ntoa=36))
+
+
+def _cls_ecorr(rng, seed, name):
+    par = _base_par(rng, name, 54500.0)
+    par += (f"EFAC -be guppi {rng.uniform(0.9, 1.3):.3f}\n"
+            f"EQUAD -be guppi {rng.uniform(0.1, 0.6):.3f}\n"
+            f"ECORR -be guppi {rng.uniform(0.2, 0.8):.3f}\n")
+    return Scenario(name, "ecorr", seed, par,
+                    _cadence(ntoa=32, clustered=True,
+                             flags={"be": "guppi"}),
+                    correlated=True)
+
+
+def _cls_bandnoise(rng, seed, name):
+    # the PLBandNoise port: independent power law per frequency band
+    par = _base_par(rng, name, 54500.0)
+    par += (f"TNBANDAMP FREQ 300 900 {rng.uniform(-13.6, -13.0):.3f}\n"
+            f"TNBANDGAM FREQ 300 900 {rng.uniform(2.0, 4.0):.3f}\n"
+            f"TNBANDAMP FREQ 900 2000 "
+            f"{rng.uniform(-14.0, -13.4):.3f}\n"
+            f"TNBANDGAM FREQ 900 2000 {rng.uniform(1.5, 3.5):.3f}\n"
+            "TNBANDC 6\n")
+    return Scenario(name, "bandnoise", seed, par,
+                    _cadence(ntoa=36, freq_mhz=[430.0, 1400.0]),
+                    correlated=True)
+
+
+def _cls_sysnoise(rng, seed, name):
+    # the PLSystemNoise port: per-observing-system power law by flag
+    par = _base_par(rng, name, 54500.0)
+    par += (f"TNSYSAMP -sys ao_430 {rng.uniform(-13.6, -13.0):.3f}\n"
+            f"TNSYSGAM -sys ao_430 {rng.uniform(2.0, 4.0):.3f}\n"
+            f"TNSYSAMP -sys gbt_800 {rng.uniform(-14.0, -13.4):.3f}\n"
+            f"TNSYSGAM -sys gbt_800 {rng.uniform(1.5, 3.5):.3f}\n"
+            "TNSYSC 6\n")
+    return Scenario(
+        name, "sysnoise", seed, par,
+        _cadence(ntoa=36,
+                 flag_cycle={"sys": ["ao_430", "gbt_800"]}),
+        correlated=True)
+
+
+def _cls_wavex(rng, seed, name):
+    par = _base_par(rng, name, 54500.0)
+    par += ("WXEPOCH 54500\nWXFREQ_0001 0.002\n"
+            f"WXSIN_0001 {rng.uniform(-2e-6, 2e-6)!r} 1\n"
+            f"WXCOS_0001 {rng.uniform(-2e-6, 2e-6)!r} 1\n")
+    return Scenario(name, "wavex", seed, par, _cadence(ntoa=32))
+
+
+def _cls_faulted(rng, seed, name):
+    par = _base_par(rng, name, 54500.0)
+    kind = "nan_resid" if rng.random() < 0.5 else "inf_sigma"
+    idx = int(rng.integers(0, 30))
+    return Scenario(name, "faulted", seed, par, _cadence(ntoa=30),
+                    fault=f"{kind}:index={idx}")
+
+
+#: the class registry: name -> builder(rng, seed, name) -> Scenario.
+#: Adding a class = one entry here (+ a CLASS_TOL row in parity);
+#: docs/corpus.md walks through it.
+CLASSES: Dict[str, Callable] = {
+    "spin": _cls_spin,
+    "astrometry": _cls_astrometry,
+    "binary": _cls_binary,
+    "jumps": _cls_jumps,
+    "dmx": _cls_dmx,
+    "rednoise": _cls_rednoise,
+    "dmgp": _cls_dmgp,
+    "chromatic": _cls_chromatic,
+    "solarwind": _cls_solarwind,
+    "glitch": _cls_glitch,
+    "ecorr": _cls_ecorr,
+    "bandnoise": _cls_bandnoise,
+    "sysnoise": _cls_sysnoise,
+    "wavex": _cls_wavex,
+    "faulted": _cls_faulted,
+}
+
+
+def build_class(klass, base_seed=0, count=7) -> List[Scenario]:
+    """``count`` scenarios of one class, each from its own disjoint
+    stream."""
+    from pint_tpu.simulation import substream
+
+    builder = CLASSES[klass]
+    out = []
+    for i in range(int(count)):
+        seed = scenario_seed(base_seed, klass, i)
+        rng = substream(seed, "spec")
+        out.append(builder(rng, seed, f"{klass}-{i:03d}"))
+        telemetry.counter_add("corpus.generated")
+    return out
+
+
+def default_corpus(base_seed=0, per_class=7,
+                   classes=None) -> List[Scenario]:
+    """The standard corpus: every registered class x ``per_class``
+    (default 15 x 7 = 105 scenarios)."""
+    out = []
+    for klass in (classes or CLASSES):
+        out.extend(build_class(klass, base_seed=base_seed,
+                               count=per_class))
+    return out
+
+
+# --------------------------------------------------------------------------
+# on-disk corpus
+# --------------------------------------------------------------------------
+
+def write_corpus(scenarios, outdir) -> str:
+    """Write every scenario's par/tim pair plus ``manifest.json``;
+    returns the manifest path."""
+    os.makedirs(outdir, exist_ok=True)
+    entries = []
+    for s in scenarios:
+        par_path, tim_path = s.write(outdir)
+        e = s.to_manifest()
+        e["par_path"] = os.path.basename(par_path)
+        e["tim_path"] = os.path.basename(tim_path)
+        entries.append(e)
+    path = os.path.join(outdir, "manifest.json")
+    with open(path, "w") as f:
+        json.dump({"scenarios": entries}, f, indent=1)
+    return path
+
+
+def load_manifest(path) -> List[Scenario]:
+    with open(path) as f:
+        data = json.load(f)
+    return [Scenario.from_manifest(e) for e in data["scenarios"]]

@@ -473,6 +473,52 @@ def _gwb_section(n_psr=3, ntoa=24):
         return lines
 
 
+def _corpus_section():
+    """Scenario-corpus smoke (--corpus): one clean scenario, one
+    correlated-noise scenario, one faulted scenario — realized and
+    (for the clean one) pushed through the full oracle-parity
+    battery; per-class verdict lines.  Reference-PINT availability is
+    reported but not required.  Diagnostic: reports, never raises."""
+    lines = ["Scenario corpus (--corpus):"]
+    try:
+        import numpy as np
+
+        from pint_tpu.corpus.parity import (parity_one,
+                                            reference_available)
+        from pint_tpu.corpus.spec import CLASSES, build_class
+
+        lines.append(
+            f"  registry: {len(CLASSES)} scenario classes "
+            f"({', '.join(sorted(CLASSES))})")
+        ref = reference_available()
+        lines.append("  reference PINT: "
+                     + ("available (differential mode on)" if ref
+                        else "absent (oracle mode; mount at "
+                             "$PINT_TPU_CORPUS_REFERENCE to enable)"))
+        picks = [build_class(k, base_seed=0, count=1)[0]
+                 for k in ("spin", "rednoise", "faulted")]
+        for s in picks:
+            model, ds = s.realize()
+            ntoa = np.asarray(ds.mjd_float).size
+            lines.append(
+                f"  {s.klass:<9s} {s.name}: realized {ntoa} TOAs, "
+                f"{len(model.free_params)} free params "
+                + ("(correlated)" if s.correlated else "")
+                + (f"(fault {s.fault})" if s.fault else ""))
+        for s in picks:
+            v = parity_one(s, mode="oracle")
+            bad = {k: c for k, c in (v.checks or {}).items()
+                   if not c.get("ok")}
+            lines.append(
+                f"  parity[{v.mode}] {s.klass:<9s} {v.scenario}: "
+                + ("OK" if v.status == "pass"
+                   else f"PROBLEM {v.detail or bad}"))
+        return lines
+    except Exception as e:  # diagnostic must never take the report down
+        lines.append(f"  ERROR {type(e).__name__}: {e}")
+        return lines
+
+
 def _mesh_section():
     """Mesh-layer smoke (--mesh): device inventory, mesh construction,
     partition-rule resolution over a REAL stacked PTA-batch pytree
@@ -1362,6 +1408,11 @@ def main(argv=None):
                         "warm fit under the armed recompile "
                         "sanitizer, and a forced same-shape "
                         "recompile that must be caught + attributed")
+    p.add_argument("--corpus", action="store_true",
+                   help="run the scenario-corpus smoke: realize a "
+                        "clean, a correlated-noise, and a faulted "
+                        "scenario, oracle-parity verdicts on each, "
+                        "reference-PINT availability readout")
     p.add_argument("--aot-child", nargs=2, metavar=("MODE", "DIR"),
                    default=None, help=argparse.SUPPRESS)
     args = p.parse_args(argv)
@@ -1377,6 +1428,9 @@ def main(argv=None):
             print(line)
     if args.runs:
         for line in _runs_section():
+            print(line)
+    if args.corpus:
+        for line in _corpus_section():
             print(line)
     if args.serve:
         for line in _serve_section():

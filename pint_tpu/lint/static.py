@@ -270,6 +270,13 @@ HOST_ONLY = {
     # latencies AFTER dispatch — objectives shape verdicts and the
     # admission queue bound, never a traced program
     "PINT_TPU_SLO_P99_MS", "PINT_TPU_SLO_AVAIL",
+    # the scenario corpus (pint_tpu/corpus/): reference-PINT mount
+    # point, parity-mode selector, and the on-disk corpus directory
+    # all steer host-side generation/subprocess plumbing; scenarios
+    # reach traced programs only as ordinary datasets whose shapes
+    # flow through the aval/key machinery like any other TOA table
+    "PINT_TPU_CORPUS_REFERENCE", "PINT_TPU_CORPUS_MODE",
+    "PINT_TPU_CORPUS_DIR",
 }
 
 #: files where raw jax.jit is the point, not a registry bypass —

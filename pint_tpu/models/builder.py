@@ -37,9 +37,11 @@ from pint_tpu.models.dispersion import (  # noqa: F401
 from pint_tpu.models.jump import PhaseJump  # noqa: F401
 from pint_tpu.models.noise import (  # noqa: F401
     EcorrNoise,
+    PLBandNoise,
     PLChromNoise,
     PLDMNoise,
     PLRedNoise,
+    PLSystemNoise,
     ScaleDmError,
     ScaleToaError,
 )
@@ -48,7 +50,7 @@ from pint_tpu.models.spindown import Spindown  # noqa: F401
 from pint_tpu.models.wavex import CMWaveX, DMWaveX, WaveX  # noqa: F401
 from pint_tpu.models.wave import IFunc, Wave  # noqa: F401
 from pint_tpu.models.glitch import Glitch, PiecewiseSpindown  # noqa: F401
-from pint_tpu.models.chromatic import ChromaticCM  # noqa: F401
+from pint_tpu.models.chromatic import ChromaticCM, ChromaticCMX  # noqa: F401
 from pint_tpu.models.fd import FD, FDJump, FDJumpDM  # noqa: F401
 from pint_tpu.models.solar_wind import (  # noqa: F401
     SolarWindDispersion,
@@ -98,6 +100,7 @@ _FDJUMP_ALT_RE = re.compile(r"^FDJUMP(\d+)$")
 _MASK_KEYS = (
     "JUMP", "DMJUMP", "EFAC", "EQUAD", "TNEQ", "ECORR",
     "DMEFAC", "DMEQUAD", "FDJUMPDM",
+    "TNBANDAMP", "TNBANDGAM", "TNSYSAMP", "TNSYSGAM",
 )
 
 

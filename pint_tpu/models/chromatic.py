@@ -81,3 +81,88 @@ class ChromaticCM(DelayComponent):
     def delay(self, values, batch, ctx, delay_accum):
         cm = self.cm_at(values, ctx)
         return DM_CONST * cm * ctx["bfreq"] ** (-values["TNCHROMIDX"])
+
+
+class ChromaticCMX(DelayComponent):
+    """Piecewise chromatic-measure offsets over MJD ranges
+    (CMX_####/CMXR1/CMXR2) — the nu^-alpha analogue of DispersionDMX
+    (reference: chromatic_model.py ChromaticCMX), for scattering-delay
+    epochs a Taylor CM series cannot track.
+
+    delay = K * CMX(t) * bfreq^-TNCHROMIDX with CMX(t) the sum of the
+    window amplitudes covering t.  alpha defaults to 4 (thin-screen
+    scattering) and is shared with ChromaticCM when both are present.
+    Each CMX amplitude is exactly linear in the delay, so every window
+    gets an analytic hybrid design-matrix column."""
+
+    category = "chromatic_cmx"
+    trigger_params = ("CMX",)
+
+    def __init__(self, indices=()):
+        super().__init__()
+        self.indices = tuple(indices)
+        for i in self.indices:
+            self.add_param(Param(f"CMX_{i:04d}",
+                                 units="pc cm^-3 MHz^(alpha-2)",
+                                 description=f"CM offset in range {i}"))
+            self.add_param(Param(f"CMXR1_{i:04d}", kind="mjd",
+                                 fittable=False,
+                                 description=f"CMX range {i} start"))
+            self.add_param(Param(f"CMXR2_{i:04d}", kind="mjd",
+                                 fittable=False,
+                                 description=f"CMX range {i} end"))
+        self.add_param(Param("TNCHROMIDX", units="", fittable=False,
+                             description="Chromatic index alpha"))
+
+    @classmethod
+    def from_parfile(cls, pardict):
+        idx = sorted(
+            {
+                prefix_index(k)[1]
+                for k in pardict
+                if k.startswith("CMX_") and prefix_index(k)
+            }
+        )
+        return cls(indices=idx)
+
+    def defaults(self):
+        d = {f"CMX_{i:04d}": 0.0 for i in self.indices}
+        d["TNCHROMIDX"] = 4.0
+        return d
+
+    def prepare(self, toas, model):
+        from pint_tpu.models.astrometry import bary_freq_mhz
+
+        masks = []
+        for i in self.indices:
+            lo = model.values[f"CMXR1_{i:04d}"] / 86400.0 + 51544.5
+            hi = model.values[f"CMXR2_{i:04d}"] / 86400.0 + 51544.5
+            masks.append((toas.mjd_float >= lo) & (toas.mjd_float <= hi))
+        m = (
+            np.stack(masks, axis=0)
+            if masks
+            else np.zeros((0, len(toas)), dtype=bool)
+        )
+        return {
+            "masks": jnp.asarray(m),
+            "bfreq": jnp.asarray(bary_freq_mhz(toas, model)),
+        }
+
+    def cmx_at(self, values, ctx):
+        if not self.indices:
+            return jnp.zeros(ctx["bfreq"].shape)
+        cmx = jnp.stack([values[f"CMX_{i:04d}"] for i in self.indices])
+        return jnp.sum(ctx["masks"] * cmx[:, None], axis=0)
+
+    def delay(self, values, batch, ctx, delay_accum):
+        return DM_CONST * self.cmx_at(values, ctx) \
+            * ctx["bfreq"] ** (-values["TNCHROMIDX"])
+
+    # -- hybrid design matrix -------------------------------------------------
+    def linear_params(self):
+        return tuple(f"CMX_{i:04d}" for i in self.indices)
+
+    def d_delay_d_param(self, values, batch, ctx, delay_accum, name):
+        j = self.indices.index(int(name[4:]))
+        return DM_CONST * ctx["masks"][j].astype(jnp.float64) \
+            * ctx["bfreq"] ** (-values["TNCHROMIDX"])

@@ -39,6 +39,8 @@ __all__ = [
     "PLRedNoise",
     "PLDMNoise",
     "PLChromNoise",
+    "PLBandNoise",
+    "PLSystemNoise",
     "create_quantization_matrix",
     "powerlaw",
     "fourier_basis",
@@ -370,6 +372,141 @@ class _PLNoiseBase(NoiseComponent):
     def weights(self, values, ctx):
         amp, gam = self._amp_gam(values)
         return powerlaw(jnp.asarray(ctx["freqs"]), amp, gam) * ctx["df"]
+
+
+class _MaskedPLNoise(NoiseComponent):
+    """Selector-scoped power-law noise: one independent Fourier
+    power-law process per mask selector, with basis columns zeroed off
+    the selector's TOA subset (tempo2 band/system noise, the
+    correlated-noise families of arxiv 1107.5366 that plain TNRed
+    cannot express).
+
+    Every selector shares the pulsar's full-span frequency comb (the
+    same ``toa_fourier_basis`` convention as :class:`_PLNoiseBase`);
+    restricting a process to a band/system is purely a column mask, so
+    the stacked GLS basis stays static per dataset and only the
+    (amp, gamma) weights are dynamic — the shared-trace contract.
+
+    Amplitude and index come from *paired* mask families: an AMP line's
+    selector must have a matching GAM line with the identical selector
+    (e.g. ``TNBANDAMP FREQ 500 1000 -13.5`` with ``TNBANDGAM FREQ 500
+    1000 3.1``).  File order within each family assigns the numbered
+    parameter names, exactly like EFAC/EQUAD.
+    """
+
+    introduces_correlated_errors = True
+    is_time_correlated = True
+    #: (amp_key, gam_key, nmodes_key, default_nmodes)
+    mask_pl_params: Tuple[str, str, str, int] = ("", "", "", 15)
+
+    def __init__(self, amp_selects=(), gam_selects=()):
+        super().__init__()
+        ak, gk, ck, _ = self.mask_pl_params
+        self.amp_selects = tuple(amp_selects)
+        self.gam_selects = tuple(gam_selects)
+        unmatched = [s for s in self.amp_selects
+                     if s not in self.gam_selects]
+        if unmatched:
+            raise ValueError(
+                f"{ak} selector(s) {unmatched} have no {gk} line with "
+                "the same selector (amplitude and index pair by "
+                "selector, like tempo2 band/system noise)")
+        for i, sel in enumerate(self.amp_selects, start=1):
+            self.add_param(Param(f"{ak}{i}", select=sel,
+                                 description=f"log10 amp on {sel}"))
+        for i, sel in enumerate(self.gam_selects, start=1):
+            self.add_param(Param(f"{gk}{i}", select=sel,
+                                 description=f"spectral index on {sel}"))
+        self.add_param(Param(ck, fittable=False,
+                             description="modes per selector"))
+        # amp i's index parameter, paired by selector (file order of
+        # the two families may differ)
+        self._gam_of = tuple(
+            f"{gk}{self.gam_selects.index(sel) + 1}"
+            for sel in self.amp_selects
+        )
+
+    @classmethod
+    def from_parfile(cls, pardict):
+        masks = pardict.get("__MASKS__", {})
+        ak, gk = cls.mask_pl_params[0], cls.mask_pl_params[1]
+        return cls(
+            amp_selects=[s for s, _ in masks.get(ak, [])],
+            gam_selects=[s for s, _ in masks.get(gk, [])],
+        )
+
+    def defaults(self):
+        ak, gk, ck, _ = self.mask_pl_params
+        # a deeply-suppressed finite default (not NaN): an AMP line
+        # whose value is missing must stay inert, never poison the
+        # Woodbury weights with NaN
+        d = {f"{ak}{i}": -20.0
+             for i in range(1, len(self.amp_selects) + 1)}
+        d.update({f"{gk}{i}": 0.0
+                  for i in range(1, len(self.gam_selects) + 1)})
+        d[ck] = np.nan
+        return d
+
+    def _nmodes(self, model):
+        v = model.values.get(self.mask_pl_params[2], np.nan)
+        return int(v) if np.isfinite(v) and v > 0 else \
+            self.mask_pl_params[3]
+
+    def prepare(self, toas, model):
+        nf = self._nmodes(model)
+        F, freqs = toa_fourier_basis(toas, nf)
+        blocks = [
+            F * np.asarray(mask_from_select(sel, toas),
+                           dtype=np.float64)[:, None]
+            for sel in self.amp_selects
+        ]
+        basis = (np.concatenate(blocks, axis=1) if blocks
+                 else np.zeros((len(toas), 0)))
+        return {"basis": basis, "freqs": freqs, "df": freqs[0]}
+
+    def basis(self, ctx):
+        return ctx["basis"]
+
+    def weights(self, values, ctx):
+        ak = self.mask_pl_params[0]
+        if not self.amp_selects:
+            return jnp.zeros(0)
+        f = jnp.asarray(ctx["freqs"])
+        parts = []
+        for i in range(1, len(self.amp_selects) + 1):
+            amp = 10.0 ** values[f"{ak}{i}"]
+            gam = values[self._gam_of[i - 1]]
+            parts.append(powerlaw(f, amp, gam) * ctx["df"])
+        return jnp.concatenate(parts)
+
+
+class PLBandNoise(_MaskedPLNoise):
+    """Band noise: an independent achromatic power-law process per
+    radio-frequency band (tempo2 TNBandNoise; arxiv 1107.5366 sec 4.2
+    — unmodelled band-correlated signals absorbed per-band instead of
+    biasing the achromatic red noise).
+
+    Par grammar: ``TNBANDAMP FREQ <lo_MHz> <hi_MHz> <log10 amp>``
+    paired with ``TNBANDGAM FREQ <lo> <hi> <index>``; modes per band
+    via ``TNBANDC`` (default 15)."""
+
+    category = "pl_band_noise"
+    trigger_params = ("TNBANDAMP",)
+    mask_pl_params = ("TNBANDAMP", "TNBANDGAM", "TNBANDC", 15)
+
+
+class PLSystemNoise(_MaskedPLNoise):
+    """System noise: an independent power-law process per observing
+    system, selected by flag (tempo2 TNSysNoise / TNGroupNoise;
+    arxiv 1107.5366 sec 4.3 — per-backend instrumental noise).
+
+    Par grammar: ``TNSYSAMP -<flag> <value> <log10 amp>`` paired with
+    ``TNSYSGAM -<flag> <value> <index>`` (e.g. ``-sys ao_430``); modes
+    per system via ``TNSYSC`` (default 15)."""
+
+    category = "pl_system_noise"
+    trigger_params = ("TNSYSAMP",)
+    mask_pl_params = ("TNSYSAMP", "TNSYSGAM", "TNSYSC", 15)
 
 
 class PLRedNoise(_PLNoiseBase):

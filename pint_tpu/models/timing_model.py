@@ -65,6 +65,7 @@ DEFAULT_ORDER = [
     "fdjumpdm",
     "dmwavex",
     "chromatic",
+    "chromatic_cmx",
     "cmwavex",
     "pulsar_system",
     "frequency_dependent",

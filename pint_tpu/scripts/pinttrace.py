@@ -485,6 +485,10 @@ RATE_METRICS = frozenset({
     # the warm fitting service's mixed-stream throughput (pint_tpu/
     # serve): a coalescing/batching regression trips the sentinel
     "serve_reqs_per_sec",
+    # the scenario corpus (pint_tpu/corpus): oracle-parity harness
+    # throughput and the serve-plane soak replay — corpus throughput
+    # joins the perf trajectory like any other rate
+    "corpus_parity_scenarios_per_sec", "corpus_replay_reqs_per_sec",
 })
 
 #: absolute slack (same units as the metric — percentage points for

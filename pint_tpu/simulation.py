@@ -16,7 +16,7 @@ from pint_tpu.toa import TOA, TOAs
 
 __all__ = ["make_fake_toas_uniform", "make_fake_toas_fromMJDs",
            "make_fake_toas_fromtim", "make_fake_pta",
-           "pta_white_noise_seed", "pta_injection_seed",
+           "pta_white_noise_seed", "pta_injection_seed", "substream",
            "gwb_amp_linear", "add_correlated_noise", "add_gwb",
            "zero_residuals", "calculate_random_models"]
 
@@ -31,6 +31,29 @@ def _as_rng(rng, default_seed=0):
     if isinstance(rng, (int, np.integer)):
         return np.random.default_rng(int(rng))
     return rng
+
+
+def substream(seed, label) -> np.random.Generator:
+    """A named rng stream derived from ``(seed, label)`` — the
+    generalization of the PR-3 integer conventions
+    (:func:`pta_white_noise_seed` / :func:`pta_injection_seed`) to
+    arbitrarily many noise processes.
+
+    Streams with different labels are disjoint by construction
+    (``np.random.SeedSequence`` spawn keyed on the label's CRC32 —
+    stable across processes and python versions, unlike builtin
+    ``hash``), so a scenario's white-noise draw never shifts when a
+    correlated component is added, and per-component correlated draws
+    never alias each other.  The corpus generator keys every draw
+    through here (labels ``"white"``, ``"dm"``, ``"fuzz"``,
+    ``"corr.<Component>"``)."""
+    import zlib
+
+    label = str(label)
+    ss = np.random.SeedSequence(
+        entropy=[int(seed) & 0xFFFFFFFFFFFFFFFF,
+                 zlib.crc32(label.encode("utf-8"))])
+    return np.random.default_rng(ss)
 
 
 def zero_residuals(toas: TOAs, model, iterations=2):
@@ -113,15 +136,25 @@ def make_fake_toas_fromMJDs(
     mjds = np.asarray(mjds, dtype=np.float64)
     ntoas = len(mjds)
     freqs = np.broadcast_to(np.asarray(freq_mhz, dtype=np.float64), (ntoas,))
-    flags = dict(flags or {})
+    # flags: one dict applied to every TOA, or a per-TOA list of dicts
+    # (the corpus generator's flag_cycle — mask selectors like JUMP
+    # must see the final flags BEFORE zero_residuals inverts phase)
+    if isinstance(flags, (list, tuple)):
+        if len(flags) != ntoas:
+            raise ValueError(
+                f"per-TOA flags list has {len(flags)} entries for "
+                f"{ntoas} TOAs")
+        flag_list = [dict(f or {}) for f in flags]
+    else:
+        flag_list = [dict(flags or {}) for _ in range(ntoas)]
     toa_list = []
-    for mjd, f in zip(mjds, freqs):
+    for mjd, f, fl in zip(mjds, freqs, flag_list):
         day = int(np.floor(mjd))
         frac = mjd - day
         num = int(round(frac * 10**12))
         toa_list.append(
             TOA(day, num, 10**12, float(error_us), float(f), obs,
-                dict(flags), "fake")
+                fl, "fake")
         )
     from pint_tpu.models.builder import planets_requested
 
@@ -158,7 +191,8 @@ def _apply_noise_products(toas, model, add_noise, wideband, dm_error,
     return toas
 
 
-def add_correlated_noise(toas: TOAs, model, rng=None):
+def add_correlated_noise(toas: TOAs, model, rng=None,
+                         per_component_seed=None):
     """Add one realization of the model's correlated-noise components
     (ECORR / red / DM noise) to the TOA ticks (reference:
     simulation.py add_correlated_noise): draw c = U @ (sqrt(phi) * z)
@@ -169,7 +203,16 @@ def add_correlated_noise(toas: TOAs, model, rng=None):
     ``rng`` may be a Generator, an int seed (0 included), or None
     (seed 0).  Returns ``(toas, noise_sec)`` — the exact drawn
     realization [s] per TOA, so injection tests can assert against the
-    draw instead of reverse-engineering it from the ticks."""
+    draw instead of reverse-engineering it from the ticks.
+
+    ``per_component_seed``: when given, each component's z-block is
+    drawn from the disjoint :func:`substream` ``corr.<Component>``
+    instead of one stream over the concatenated basis, making every
+    component's realization invariant to which OTHER components the
+    model carries (the seed-determinism gap the corpus generator
+    exposed: under a single stream, adding band noise to a par file
+    silently shifts the red-noise draw).  ``rng`` is ignored in this
+    mode."""
     if not model.has_correlated_errors:
         raise ValueError(
             "add_correlated_noise: the model has no correlated-noise "
@@ -179,8 +222,15 @@ def add_correlated_noise(toas: TOAs, model, rng=None):
     values = r._values()
     U = np.asarray(r.prepared.noise_basis)
     phi = np.asarray(r.prepared.noise_weights_fn(values))
-    rng = _as_rng(rng)
-    z = rng.standard_normal(U.shape[1])
+    if per_component_seed is not None:
+        z = np.empty(U.shape[1])
+        for name, (start, nb) in \
+                r.prepared.noise_dimensions().items():
+            z[start:start + nb] = substream(
+                per_component_seed, f"corr.{name}").standard_normal(nb)
+    else:
+        rng = _as_rng(rng)
+        z = rng.standard_normal(U.shape[1])
     noise_sec = U @ (np.sqrt(np.maximum(phi, 0.0)) * z)
     toas.ticks = toas.ticks + np.round(
         noise_sec * 2**32).astype(np.int64)

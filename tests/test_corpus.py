@@ -1,0 +1,494 @@
+"""Scenario corpus + differential parity harness (ISSUE 18).
+
+Covers:
+
+1. the spec grammar — bit-determinism from seeds, disjoint
+   substreams, >= 100 scenarios over >= 8 classes, par/tim + manifest
+   round trip;
+2. the parity harness — oracle verdicts across a sampled class set,
+   fault *detection* on the faulted class, reference-mode graceful
+   skip when no reference PINT is mounted, CLI round trip;
+3. the two newly ported components the corpus drove out
+   (PLBandNoise / PLSystemNoise band/system-masked power laws,
+   ChromaticCMX windowed chromatic events): basis/weights vs brute
+   force, hybrid==jacfwd at the design pin, zero-recompile on a
+   second same-structure fitter;
+4. the PTABatch satellite — one corpus class as a single stacked
+   program, per-member chi^2 == per-pulsar path;
+5. the serve-plane soak replay — mixed stream, sanitizer armed, zero
+   violations.
+
+All CPU, tier-1-fast (small counts; the full 105-scenario sweep is
+``pintcorpus run``, not a unit test).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pint_tpu import telemetry
+from pint_tpu.corpus import (CLASSES, CLASS_TOL, Scenario, build_class,
+                             default_corpus, parity_one,
+                             reference_available, run_parity,
+                             scenario_seed, summarize)
+from pint_tpu.corpus.spec import load_manifest, write_corpus
+from pint_tpu.fitter import GLSFitter, WLSFitter
+from pint_tpu.models.builder import get_model
+from pint_tpu.residuals import Residuals
+from pint_tpu.simulation import (add_correlated_noise,
+                                 make_fake_toas_uniform, substream)
+
+pytestmark = pytest.mark.filterwarnings(
+    "ignore::RuntimeWarning")
+
+
+# ----------------------------------------------------------------- spec
+
+class TestSpecGrammar:
+    def test_corpus_size_and_class_floor(self):
+        """The acceptance floor: >= 100 scenarios over >= 8 classes."""
+        corpus = default_corpus(base_seed=0)
+        assert len(corpus) >= 100
+        classes = {s.klass for s in corpus}
+        assert len(classes) >= 8
+        assert classes == set(CLASSES)
+        # names are unique — the manifest key
+        assert len({s.name for s in corpus}) == len(corpus)
+
+    def test_scenario_seed_spreads(self):
+        seeds = {scenario_seed(0, k, i)
+                 for k in CLASSES for i in range(7)}
+        assert len(seeds) == 7 * len(CLASSES), "seed collision"
+
+    def test_substream_disjoint_and_stable(self):
+        a = substream(42, "white").standard_normal(8)
+        b = substream(42, "white").standard_normal(8)
+        c = substream(42, "corr.PLRedNoise").standard_normal(8)
+        np.testing.assert_array_equal(a, b)
+        assert not np.allclose(a, c)
+
+    @pytest.mark.parametrize("klass", ["spin", "rednoise", "jumps"])
+    def test_realize_bit_deterministic(self, klass):
+        s = build_class(klass, base_seed=3, count=1)[0]
+        m1, t1 = s.realize()
+        m2, t2 = s.realize()
+        # ticks are the int64 fixed-point epochs: bit-identical or bust
+        np.testing.assert_array_equal(np.asarray(t1.ticks),
+                                      np.asarray(t2.ticks))
+        np.testing.assert_array_equal(np.asarray(t1.error_us),
+                                      np.asarray(t2.error_us))
+        for p in m1.free_params:
+            assert m1.values[p] == m2.values[p], p
+
+    def test_per_component_seed_invariant_to_other_components(self):
+        """PR-3 convention extended: one component's correlated draw
+        must not shift when ANOTHER correlated component joins the
+        model."""
+        base = ("PSR TSUB\nRAJ 5:00:00\nDECJ 10:00:00\nF0 100 1\n"
+                "F1 -1e-14 1\nPEPOCH 55000\nDM 10\nTZRMJD 55000\n"
+                "TZRSITE @\nTZRFRQ 1400\nUNITS TDB\nEPHEM builtin\n")
+        red = "TNRedAmp -13.2\nTNRedGam 3.0\nTNRedC 5\n"
+        dm = "TNDMAmp -13.5\nTNDMGam 3.0\nTNDMC 5\n"
+
+        def draw(par):
+            model = get_model(par)
+            toas = make_fake_toas_uniform(
+                54000.0, 55000.0, 40, model, freq_mhz=1400.0, obs="@",
+                error_us=1.0, add_noise=False,
+                rng=np.random.default_rng(0))
+            _, noise_sec = add_correlated_noise(
+                toas, model, per_component_seed=7)
+            return np.asarray(noise_sec)
+
+        alone = draw(base + red)
+        joined = draw(base + red + dm)
+        both_alone = draw(base + dm)
+        # the red draw is unchanged by DM joining; total = sum of parts
+        np.testing.assert_allclose(alone + both_alone, joined,
+                                   rtol=0, atol=1e-18)
+
+    def test_manifest_round_trip(self, tmp_path):
+        scenarios = build_class("spin", base_seed=1, count=2)
+        path = write_corpus(scenarios, str(tmp_path))
+        assert os.path.exists(path)
+        back = load_manifest(path)
+        assert len(back) == 2
+        for s0, s1 in zip(scenarios, back):
+            assert s0.name == s1.name and s0.seed == s1.seed
+            assert s0.par == s1.par
+            m0, t0 = s0.realize()
+            m1, t1 = s1.realize()
+            np.testing.assert_array_equal(np.asarray(t0.ticks),
+                                          np.asarray(t1.ticks))
+        # par/tim pairs landed on disk
+        for s in scenarios:
+            assert os.path.exists(tmp_path / f"{s.name}.par")
+            assert os.path.exists(tmp_path / f"{s.name}.tim")
+
+    def test_written_tim_reloads_and_agrees(self, tmp_path):
+        """The serialized pair rebuilds the same residual problem —
+        what reference PINT will actually read."""
+        from pint_tpu.toa import get_TOAs
+
+        s = build_class("spin", base_seed=5, count=1)[0]
+        par_path, tim_path = s.write(str(tmp_path))
+        model, toas = s.realize()
+        model2 = get_model(par_path)
+        toas2 = get_TOAs(tim_path)
+        r1 = np.asarray(Residuals(toas, model).time_resids)
+        r2 = np.asarray(Residuals(toas2, model2).time_resids)
+        # tim files carry ~1e-4 us rounding of the MJD string
+        np.testing.assert_allclose(r1, r2, atol=2e-9)
+
+
+# --------------------------------------------------------------- parity
+
+#: cheap class sample for tier-1 (the full 15-class sweep is the
+#: pintcorpus CLI / nightly, not a unit test)
+PARITY_SAMPLE = ["spin", "binary", "dmx", "rednoise", "chromatic",
+                 "bandnoise", "sysnoise", "faulted"]
+
+
+class TestParityOracle:
+    @pytest.mark.parametrize("klass", PARITY_SAMPLE)
+    def test_class_passes_oracle(self, klass):
+        s = build_class(klass, base_seed=0, count=1)[0]
+        v = parity_one(s, mode="oracle")
+        bad = {k: c for k, c in (v.checks or {}).items()
+               if not c.get("ok")}
+        assert v.status == "pass", (v.detail, bad)
+        assert v.mode == "oracle"
+        assert v.klass == klass
+
+    def test_faulted_detection_is_the_check(self):
+        s = build_class("faulted", base_seed=0, count=1)[0]
+        assert s.fault
+        v = parity_one(s, mode="oracle")
+        assert v.status == "pass"
+        assert v.checks["fault_detected"]["ok"]
+
+    def test_verdict_json_and_summary(self):
+        vs = run_parity(build_class("spin", base_seed=0, count=2),
+                        mode="oracle")
+        docs = [v.to_json() for v in vs]
+        for d in docs:
+            json.dumps(d)  # serializable
+            assert d["status"] == "pass"
+        summary = summarize(vs)
+        assert summary["spin"]["pass"] == 2
+        assert summary["spin"]["fail"] == 0
+
+    def test_class_tol_covers_loose_classes(self):
+        """Every loosened tolerance names a registered class, and the
+        correlated classes carry the widened chi^2 band the GP-draw
+        rationale requires (docs/corpus.md)."""
+        assert set(CLASS_TOL) <= set(CLASSES)
+        for k in ("rednoise", "dmgp", "ecorr", "bandnoise",
+                  "sysnoise"):
+            lo, hi = CLASS_TOL[k]["chi2_dof"]
+            assert lo <= 0.1 and hi >= 4.0
+
+    def test_reference_mode_graceful_skip(self, monkeypatch):
+        """Explicitly requested reference mode with nothing mounted
+        must yield a SKIP verdict, not a fabricated pass."""
+        monkeypatch.setenv("PINT_TPU_CORPUS_REFERENCE",
+                           "/nonexistent/reference")
+        from pint_tpu.corpus import parity as _parity
+        old = _parity._REF_OK
+        _parity._REF_OK = None  # drop the once-per-process probe cache
+        try:
+            assert not reference_available()
+            s = build_class("spin", base_seed=0, count=1)[0]
+            v = parity_one(s, mode="reference")
+            assert v.status == "skip"
+        finally:
+            _parity._REF_OK = old
+
+    def test_parity_never_raises(self):
+        """A broken scenario becomes a fail verdict, not an
+        exception."""
+        s = Scenario(name="broken-000", klass="spin", seed=1,
+                     par="PSR BROKEN\nTHIS IS NOT A PARFILE\n",
+                     cadence={"start": 54000.0, "days": 100.0,
+                              "ntoa": 4})
+        v = parity_one(s, mode="oracle")
+        assert v.status == "fail"
+        assert v.detail
+
+
+class TestCLI:
+    def _run(self, *args):
+        return subprocess.run(
+            [sys.executable, "-m", "pint_tpu.corpus.cli", *args],
+            capture_output=True, text=True,
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))),
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+
+    def test_generate_run_report_round_trip(self, tmp_path):
+        out = str(tmp_path / "corpus")
+        r = self._run("generate", "--out", out, "--seed", "2",
+                      "--per-class", "1", "--class", "spin",
+                      "--class", "dmx")
+        assert r.returncode == 0, r.stderr
+        assert os.path.exists(os.path.join(out, "manifest.json"))
+        vpath = str(tmp_path / "v.jsonl")
+        r = self._run("run", "--out", out, "--mode", "oracle",
+                      "--verdicts", vpath)
+        assert r.returncode == 0, r.stderr + r.stdout
+        assert "spin" in r.stdout and "dmx" in r.stdout
+        lines = [json.loads(x) for x in open(vpath)
+                 if x.strip()]
+        assert len(lines) == 2
+        assert all(d["status"] == "pass" for d in lines)
+        r = self._run("report", vpath)
+        assert r.returncode == 0
+        assert "pass" in r.stdout
+
+
+# ----------------------------------------------- new ported components
+
+BASE = """PSR TSTCORP
+RAJ 18:57:36.39
+DECJ 09:43:17.2
+F0 186.494 1
+F1 -6.2e-16 1
+PEPOCH 54000
+DM 13.3 1
+TZRMJD 54000
+TZRSITE @
+TZRFRQ 1400
+UNITS TDB
+EPHEM builtin
+"""
+
+BAND = ("TNBANDAMP FREQ 1000 2000 -13.0 1\n"
+        "TNBANDGAM FREQ 1000 2000 3.0 1\n"
+        "TNBANDC 5\n")
+
+SYS = ("TNSYSAMP -f L-wide -13.0 1\n"
+       "TNSYSGAM -f L-wide 3.0 1\n"
+       "TNSYSC 5\n")
+
+CMX = ("TNCHROMIDX 4.0\n"
+       "CMX_0001 0.01 1\nCMXR1_0001 53900\nCMXR2_0001 54100\n"
+       "CMX_0002 -0.02 1\nCMXR1_0002 54300\nCMXR2_0002 54500\n")
+
+
+def _toas(model, n=60, seed=0, two_freqs=False):
+    freqs = 1400.0
+    if two_freqs:
+        freqs = np.where(np.arange(n) % 2 == 0, 1400.0, 430.0)
+    return make_fake_toas_uniform(
+        53800.0, 54600.0, n, model, freq_mhz=freqs, obs="gbt",
+        error_us=1.0, add_noise=True, rng=np.random.default_rng(seed),
+        flags={"f": "L-wide"})
+
+
+class TestMaskedPLNoise:
+    """PLBandNoise / PLSystemNoise: selector-masked power-law GPs."""
+
+    @pytest.mark.parametrize("extra,comp", [(BAND, "PLBandNoise"),
+                                            (SYS, "PLSystemNoise")])
+    def test_basis_and_weights(self, extra, comp):
+        model = get_model(BASE + extra)
+        assert comp in [c.__class__.__name__ for c in model.components]
+        toas = _toas(model)
+        prep = model.prepare(toas)
+        dims = prep.noise_dimensions()
+        assert comp in dims
+        start, nb = dims[comp]
+        assert nb == 10  # 5 modes x (sin, cos)
+        F = np.asarray(prep.noise_basis)[:, start:start + nb]
+        w = np.asarray(prep.noise_weights_fn(
+            prep._values_pytree()))[start:start + nb]
+        assert np.all(np.isfinite(F)) and np.all(np.isfinite(w))
+        assert np.all(w > 0)
+        # the selector masks columns: every TOA here matches, so the
+        # block is the dense Fourier basis on the absolute TDB second
+        # axis (toa_fourier_basis convention) — brute-force the first
+        # sin/cos pair at the fundamental f = 1/T
+        t = np.asarray(toas.ticks, dtype=np.float64) / 2**32
+        T = t.max() - t.min()
+        np.testing.assert_allclose(
+            F[:, 0], np.sin(2 * np.pi * t / T), atol=1e-8)
+        np.testing.assert_allclose(
+            F[:, 1], np.cos(2 * np.pi * t / T), atol=1e-8)
+
+    def test_selector_masks_nonmatching_toas(self):
+        par = BASE + ("TNSYSAMP -f S-wide -13.0 1\n"
+                      "TNSYSGAM -f S-wide 3.0 1\nTNSYSC 4\n")
+        model = get_model(par)
+        toas = _toas(model)  # every TOA flagged L-wide
+        prep = model.prepare(toas)
+        start, nb = prep.noise_dimensions()["PLSystemNoise"]
+        F = np.asarray(prep.noise_basis)[:, start:start + nb]
+        assert np.all(F == 0.0), "non-matching TOAs must be masked out"
+
+    def test_mismatched_selectors_raise(self):
+        with pytest.raises(ValueError, match="selector"):
+            get_model(BASE + "TNBANDAMP -mjd 53800_54600 -13.0 1\n"
+                             "TNBANDC 5\n")
+
+    @pytest.mark.parametrize("extra", [BAND, SYS])
+    def test_gls_fit_and_zero_recompile(self, extra):
+        if telemetry.compile_stats()["source"] != "jax.monitoring":
+            pytest.skip("compile events unavailable")
+        model = get_model(BASE + extra)
+        toas = _toas(model)
+        f1 = GLSFitter(toas, model)
+        f1.fit_toas(maxiter=2)
+        float(f1.resids.chi2)
+        telemetry.compile_stats()
+        n0 = telemetry.counter_get("jit.compile_events")
+        model2 = get_model(BASE + extra)
+        f2 = GLSFitter(toas, model2)
+        f2.fit_toas(maxiter=2)
+        float(f2.resids.chi2)
+        telemetry.compile_stats()
+        assert telemetry.counter_get("jit.compile_events") == n0
+
+
+class TestChromaticCMX:
+    def test_delay_windows_and_scaling(self):
+        model = get_model(BASE + CMX)
+        toas = _toas(model, two_freqs=True)
+        prep = model.prepare(toas)
+        comp = model.component("ChromaticCMX")
+        values = prep._values_pytree()
+        d = np.asarray(comp.delay(values, prep.batch,
+                                  prep.ctx["ChromaticCMX"],
+                                  jnp.zeros(len(toas))))
+        mjd = np.asarray(toas.mjd_float)
+        outside = (mjd < 53900.0) & (mjd > 54500.0)
+        assert np.all(d[outside] == 0.0)
+        ins = (mjd > 53900.0) & (mjd < 54100.0)
+        assert np.any(d[ins] != 0.0)
+        # chromatic: nu^-4 — the 430 MHz TOAs see (1400/430)^4 more
+        lo = ins & (np.asarray(toas.freq_mhz) < 500.0)
+        hi = ins & (np.asarray(toas.freq_mhz) > 1000.0)
+        if lo.any() and hi.any():
+            ratio = np.abs(d[lo]).max() / np.abs(d[hi]).max()
+            # bfreq is barycentric — Doppler-shifted ~1e-4 from the
+            # topocentric 1400/430, hence the loose tolerance
+            np.testing.assert_allclose(ratio, (1400.0 / 430.0) ** 4,
+                                       rtol=1e-3)
+
+    def test_hybrid_matches_jacfwd(self):
+        """The design pin: CMX analytic columns == dense jacfwd at
+        1e-12 relative (tests/test_design.py contract)."""
+        model = get_model(BASE + CMX)
+        toas = _toas(model, two_freqs=True)
+        f = WLSFitter(toas, model)
+        lin, _ = f._partition
+        assert "CMX_0001" in lin and "CMX_0002" in lin
+        vec = jnp.asarray([f.model.values[p] for p in f._traced_free])
+        base = f.prepared._values_pytree()
+        data = f._fit_data
+        _, J = f._rj(vec, base, data)
+        free = f._traced_free
+
+        def resid_fn(v):
+            values = dict(base)
+            for i, name in enumerate(free):
+                values[name] = v[i]
+            return f.resids.time_resids_at(values, data)
+
+        J_dense = np.asarray(jax.jacfwd(resid_fn)(vec))
+        J = np.asarray(J)
+        scale = np.abs(J_dense).max(axis=0)
+        rel = (np.abs(J - J_dense) / np.maximum(scale, 1e-300)).max()
+        assert rel <= 1e-12
+
+    def test_fit_recovers_and_zero_recompile(self):
+        if telemetry.compile_stats()["source"] != "jax.monitoring":
+            pytest.skip("compile events unavailable")
+        model = get_model(BASE + CMX)
+        toas = _toas(model, two_freqs=True, seed=4)
+        truth = {p: model.values[p]
+                 for p in ("CMX_0001", "CMX_0002")}
+        model.values["CMX_0001"] += 5e-3
+        model.values["CMX_0002"] -= 5e-3
+        f1 = WLSFitter(toas, model)
+        f1.fit_toas(maxiter=4)
+        for p, t in truth.items():
+            unc = model.params[p].uncertainty
+            assert unc and abs(model.values[p] - t) < 5 * unc, p
+        telemetry.compile_stats()
+        n0 = telemetry.counter_get("jit.compile_events")
+        model2 = get_model(BASE + CMX)
+        f2 = WLSFitter(toas, model2)
+        f2.fit_toas(maxiter=4)
+        telemetry.compile_stats()
+        assert telemetry.counter_get("jit.compile_events") == n0
+
+
+# ------------------------------------------------------ PTA satellite
+
+class TestCorpusPTABatch:
+    def test_corpus_class_as_stacked_program(self):
+        """One full corpus class through PTABatch as a single stacked
+        program: per-member chi^2 == the per-pulsar path."""
+        from pint_tpu.parallel import PTABatch
+
+        scenarios = build_class("spin", base_seed=0, count=4)
+        pairs = [s.realize() for s in scenarios]
+        batch = PTABatch(pairs)
+        chi2_b = np.asarray(batch.chisq())
+        assert chi2_b.shape == (len(pairs),)
+        for k, (m, toas) in enumerate(pairs):
+            single = float(Residuals(toas, m).chi2)
+            np.testing.assert_allclose(chi2_b[k], single, rtol=1e-8,
+                                       err_msg=scenarios[k].name)
+
+    def test_corpus_class_batched_fit_matches_individual(self):
+        from pint_tpu.parallel import PTABatch
+
+        scenarios = build_class("spin", base_seed=1, count=3)
+        pairs = [s.realize() for s in scenarios]
+        batch = PTABatch(pairs)
+        vec, chi2, _ = batch.fit_wls(maxiter=3)
+        for k, (m, toas) in enumerate(pairs):
+            m2, t2 = scenarios[k].realize()
+            f = WLSFitter(t2, m2)
+            f.fit_toas(maxiter=3)
+            np.testing.assert_allclose(
+                float(chi2[k]), float(f.resids.chi2), rtol=1e-6,
+                err_msg=scenarios[k].name)
+
+
+# ------------------------------------------------------------- replay
+
+class TestReplay:
+    def test_soak_mix_zero_violations(self):
+        from pint_tpu.corpus.replay import replay_mix
+
+        mix = [build_class(k, base_seed=0, count=1)[0]
+               for k in ("spin", "dmx")]
+        stats = replay_mix(mix, n_requests=12, slo_p99_ms=2000.0)
+        assert stats["requests"] == 12
+        assert stats["errors"] == 0
+        assert stats["sanitizer_violations"] == 0
+        assert stats["slo"].get("verdict") in ("ok", "breach")
+        assert stats["rps"] > 0
+
+
+# ----------------------------------------------------------- datacheck
+
+class TestDatacheckCorpus:
+    @pytest.mark.slow
+    def test_corpus_section_smoke(self):
+        from pint_tpu.datacheck import _corpus_section
+
+        lines = _corpus_section()
+        text = "\n".join(lines)
+        assert "Scenario corpus" in text
+        assert "PROBLEM" not in text and "ERROR" not in text
+        assert text.count("OK") >= 3
