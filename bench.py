@@ -1126,12 +1126,15 @@ def _serve_stream_worker(port, indices, barrier, q):
     the mixed stream over one keep-alive connection and reports
     per-request outcomes.  Lives OUTSIDE the server process so client
     JSON/HTTP work never shares the replica's GIL (a real deployment's
-    clients are remote)."""
-    import http.client
-    import json as _json
+    clients are remote).  The request loop is the shared fleet client
+    (bounded retry honoring Retry-After — every in-repo load path
+    speaks through it); import cost lands before the barrier, outside
+    the measured window."""
     import time as _t
 
-    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+    from pint_tpu.fleet.client import RetryClient
+
+    client = RetryClient("127.0.0.1", port, timeout=120)
     out = []
     barrier.wait()
     t0 = _t.time()
@@ -1141,22 +1144,18 @@ def _serve_stream_worker(port, indices, barrier, q):
         body = {"dataset": ds}
         if op == "fit":
             body["maxiter"] = 2
-        payload = _json.dumps(body).encode()
-        conn.request("POST", f"/v1/{op}", body=payload,
-                     headers={"Content-Type": "application/json"})
-        resp = conn.getresponse()
-        r = _json.loads(resp.read())
+        status, r, _ = client.post(f"/v1/{op}", body)
         ph = r.get("phase_s") or {}
         # the client keeps only the response's total wall (for the
         # client-vs-span-record agreement assert) — the phase
         # decomposition itself is read from the trace_span records
         # the replica emits, the same source /slo and pinttrace use
-        out.append((op, ds, resp.status, r.get("status"),
+        out.append((op, ds, status, r.get("status"),
                     repr(r["chi2"]) if op == "fit" and "chi2" in r
                     else None,
                     float(ph.get("total", 0.0))))
     t1 = _t.time()
-    conn.close()
+    client.close()
     q.put({"t0": t0, "t1": t1, "results": out})
 
 
@@ -1778,6 +1777,77 @@ def bench_corpus_replay(jnp, backend):
     })
 
 
+def bench_fleet(jnp, backend):
+    """Fleet scale-out + zero-downtime deploy: the chaos-harness soak
+    (real ``pintserve`` subprocesses behind the rendezvous router)
+    run twice — 1 replica then ``$PINT_TPU_FLEET_REPLICAS`` (default
+    4) — with a rolling deploy fired mid-stream on the fleet arm.
+
+    Two sentinel series: ``fleet_reqs_per_sec`` (the fleet arm's
+    routed throughput; ``vs_baseline`` is the fleet/single ratio —
+    ≥ 2.5x at 4 replicas is the acceptance on real multi-core
+    hardware; a 1-CPU host reports its honest ~1x) and
+    ``rolling_deploy_downtime_s`` (seconds with ZERO ready replicas
+    during the deploy; lower is better, 0 is the zero-downtime
+    claim).  The record asserts the chaos contract: zero 5xx to the
+    client and zero fleet-wide sanitizer violations through the
+    deploy."""
+    from pint_tpu.fleet.chaos import chaos_soak
+    from pint_tpu.fleet.supervisor import REPLICAS_ENV
+
+    n = int(float(os.environ.get(REPLICAS_ENV, "") or 4))
+    n_req = 160
+    one = chaos_soak(n_replicas=1, n_requests=n_req, kill=False,
+                     deploy=False, job=False)
+    assert one["client_5xx"] == 0, one["statuses"]
+    fleet = chaos_soak(n_replicas=n, n_requests=n_req, kill=False,
+                       deploy=True, job=False, slo_p99_ms=2000.0)
+    assert fleet["client_5xx"] == 0, fleet["statuses"]
+    assert fleet["sanitizer_violations"] == 0, \
+        (f"fleet recompiled under the armed sanitizer: "
+         f"{fleet['sanitizer_violations']} violations")
+    scale = fleet["rps"] / one["rps"] if one["rps"] else 0.0
+    deploy = fleet.get("deploy") or {}
+    _emit_metric({
+        "metric": "fleet_reqs_per_sec",
+        "value": round(fleet["rps"], 2),
+        "unit": (f"req/s routed mixed stream ({n} replicas behind "
+                 f"the rendezvous router, rolling deploy mid-"
+                 f"stream, {n_req} reqs; 1-replica arm "
+                 f"{one['rps']:.1f} req/s -> {scale:.2f}x; "
+                 f"client 5xx {fleet['client_5xx']}, sanitizer "
+                 f"violations {fleet['sanitizer_violations']}, "
+                 f"slo={fleet['slo'].get('verdict')}; "
+                 f"backend={backend})"),
+        "vs_baseline": round(scale, 2),
+        "backend": backend,
+        "compile_s": None,
+        "flops": None,
+        "fleet": {
+            "replicas": n,
+            "rps_single": round(one["rps"], 2),
+            "rps_fleet": round(fleet["rps"], 2),
+            "scaleup": round(scale, 3),
+            "client_5xx": fleet["client_5xx"],
+            "sanitizer_violations": fleet["sanitizer_violations"],
+            "slo_verdict": fleet["slo"].get("verdict"),
+        },
+    })
+    _emit_metric({
+        "metric": "rolling_deploy_downtime_s",
+        "value": round(float(deploy.get("downtime_s", 0.0)), 3),
+        "unit": (f"s with zero ready replicas during a rolling "
+                 f"deploy of {n} replicas under load (drain -> "
+                 f"swap AOT artifact -> re-warm, serial; deploy "
+                 f"wall {deploy.get('wall_s', 0.0):.1f}s; "
+                 f"backend={backend})"),
+        "vs_baseline": None,
+        "backend": backend,
+        "compile_s": None,
+        "flops": None,
+    })
+
+
 #: run order: the roofline first (its measured matmul peak becomes the
 #: honest MFU denominator for everything after it), then
 #: proven-cheapest compile first, heaviest (GLS) last, so a mid-run
@@ -1805,6 +1875,9 @@ _METRICS = {
     # sanitizer violations — the standing zero-compile soak gate)
     "corpus_parity": bench_corpus_parity,
     "corpus_replay": bench_corpus_replay,
+    # fleet orchestration (docs/fleet.md): routed scale-out + the
+    # zero-downtime rolling-deploy claim, chaos contract asserted
+    "fleet": bench_fleet,
 }
 
 

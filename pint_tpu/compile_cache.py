@@ -1407,7 +1407,27 @@ def import_executables(path, progress=None):
                     # (see _prime_custom_calls: unprimed == segfault)
                     _prime_custom_calls()
                     primed = True
-                compiled = jax.jit(_jexp.deserialize(raw).call)
+                exported = _jexp.deserialize(raw)
+                compiled = jax.jit(exported.call)
+                # compile NOW, at import time, from the exported
+                # avals: a lazy jit would take its backend compile on
+                # the first dispatch — which on a replica is after
+                # the recompile sanitizer armed, turning every AOT
+                # "hit" into a counted violation (and a cold-start
+                # latency cliff on the first real request)
+                try:
+                    specs = [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                             for a in exported.in_avals]
+                    cargs, ckw = jax.tree_util.tree_unflatten(
+                        exported.in_tree, specs)
+                    compiled = compiled.lower(*cargs,
+                                              **ckw).compile()
+                except Exception:
+                    # keep the lazy jit: first dispatch compiles as
+                    # before — slower and sanitizer-visible, never
+                    # wrong
+                    telemetry.counter_add(
+                        "jit.aot_import_lazy_fallbacks")
         except Exception as exc:
             telemetry.counter_add("jit.aot_import_rejects")
             rejected.append((label, f"{type(exc).__name__}: {exc}"))

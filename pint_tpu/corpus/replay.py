@@ -20,7 +20,6 @@ violations, SLO verdict) — consumed by ``bench_corpus_replay``, the
 
 from __future__ import annotations
 
-import json
 import time
 from typing import List, Optional
 
@@ -60,9 +59,9 @@ def replay_mix(scenarios=None, n_requests=60, flush_ms=2.0,
     replica loaded with ``scenarios`` (default :func:`default_mix`),
     sanitizer armed after warmup.  Returns the stats dict; raises
     only on setup failure — request errors are counted, not raised."""
-    import http.client
     import tempfile
 
+    from pint_tpu.fleet.client import RetryClient
     from pint_tpu.lint import sanitizer
     from pint_tpu.obs import slo as _slo
     from pint_tpu.serve.server import Server
@@ -95,8 +94,9 @@ def replay_mix(scenarios=None, n_requests=60, flush_ms=2.0,
         v0 = len(sanitizer.violations())
         sanitizer.arm(note="corpus.replay")
 
-        conn = http.client.HTTPConnection("127.0.0.1", port,
-                                          timeout=120)
+        # the shared fleet client: bounded retry/backoff honoring
+        # Retry-After — the one request loop every soak path uses
+        client = RetryClient("127.0.0.1", port, timeout=120)
         ok = 0
         errors = 0
         t0 = time.time()
@@ -107,23 +107,16 @@ def replay_mix(scenarios=None, n_requests=60, flush_ms=2.0,
             if op == "fit":
                 body["maxiter"] = maxiter
             try:
-                conn.request(
-                    "POST", f"/v1/{op}",
-                    body=json.dumps(body).encode(),
-                    headers={"Content-Type": "application/json"})
-                resp = conn.getresponse()
-                r = json.loads(resp.read())
-                if resp.status == 200 and r.get("status") == "ok":
+                status, r, _ = client.post(f"/v1/{op}", body)
+                if status == 200 and r.get("status") == "ok":
                     ok += 1
                 else:
                     errors += 1
-            except (OSError, ValueError):
+            except OSError:
                 errors += 1
-                conn = http.client.HTTPConnection(
-                    "127.0.0.1", port, timeout=120)
             telemetry.counter_add("corpus.replay.requests")
         wall = time.time() - t0
-        conn.close()
+        client.close()
         violations = len(sanitizer.violations()) - v0
         slo_doc = _slo.tracker().verdict_doc()
     finally:

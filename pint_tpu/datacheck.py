@@ -1205,6 +1205,116 @@ def _serve_section():
     return lines
 
 
+def _fleet_section():
+    """Fleet-orchestration smoke (--fleet): two in-process replicas
+    behind a real router socket — broadcast load fans out and
+    journals, requests land on the rendezvous owner, killing the
+    owner re-routes with zero 5xx, and an all-drained fleet yields
+    the router's structured 503 (never a 500).  The full subprocess
+    chaos story (kill mid-batch, rolling deploy, job failover) lives
+    in ``pint_tpu.fleet.chaos`` / ``tests/test_fleet.py``.
+    Diagnostic: reports, never raises."""
+    from pint_tpu import telemetry
+
+    lines = ["Fleet orchestration (--fleet):"]
+    srv_a = srv_b = router = None
+    try:
+        from pint_tpu.compile_cache import WARM_WLS_PAR
+        from pint_tpu.fleet.client import RetryClient
+        from pint_tpu.fleet.router import Router, rendezvous_order
+        from pint_tpu.serve.client import request_json
+        from pint_tpu.serve.server import Server
+
+        srv_a = Server(flush_ms=50.0, max_batch=4, queue_max=32)
+        srv_b = Server(flush_ms=50.0, max_batch=4, queue_max=32)
+        pa, pb = srv_a.start(port=0), srv_b.start(port=0)
+        targets = [f"127.0.0.1:{pa}", f"127.0.0.1:{pb}"]
+        router = Router(targets=targets, probe_s=30.0)
+        rp = router.start(port=0)
+
+        # broadcast load: one POST through the router reaches BOTH
+        # replicas and lands in the rejoin journal
+        for i, name in enumerate(("flt0", "flt1")):
+            s, doc, _ = request_json(
+                "127.0.0.1", rp, "POST", "/v1/load",
+                {"dataset": name, "par": WARM_WLS_PAR,
+                 "toas": {"n": 50, "seed": i}})
+            assert s == 200, doc
+        fanout = (len(srv_a.registry.ids()) == 2
+                  and len(srv_b.registry.ids()) == 2
+                  and doc.get("journaled") is True)
+        lines.append(
+            f"  broadcast load: 2 datasets -> a={srv_a.registry.ids()}"
+            f" b={srv_b.registry.ids()}, journaled -> "
+            + ("OK" if fanout else "PROBLEM"))
+
+        # warm once (shared in-process jit registry warms both) and
+        # place through the router: the rendezvous owner serves it
+        srv_a.warmup("flt0", ops=("fit",), sizes=(1,), maxiter=2)
+        n_ready = router.probe_now()
+        owner = rendezvous_order("flt0", targets)[0]
+        before = dict(telemetry.counters())
+        s, fit, _ = request_json(
+            "127.0.0.1", rp, "POST", "/v1/fit",
+            {"dataset": "flt0", "maxiter": 2}, timeout=300)
+        lines.append(
+            f"  placement: {n_ready}/2 ready, fit via router "
+            f"chi2={fit.get('chi2'):.2f} (owner {owner}) -> "
+            + ("OK" if s == 200 and n_ready == 2 else "PROBLEM"))
+
+        # kill the owner: the router must re-route to the sibling
+        # with ZERO client-visible 5xx
+        victim = srv_a if owner.endswith(str(pa)) else srv_b
+        victim.stop()
+        router.probe_now()
+        client = RetryClient("127.0.0.1", rp, timeout=300)
+        s, fit, _ = client.post("/v1/fit",
+                                {"dataset": "flt0", "maxiter": 2})
+        client.close()
+        ctr = telemetry.counters()
+        rerouted = (ctr.get("router.reroutes", 0)
+                    + ctr.get("router.proxy_errors", 0)
+                    - before.get("router.reroutes", 0)
+                    - before.get("router.proxy_errors", 0))
+        lines.append(
+            f"  owner death: re-route moved {rerouted:g} "
+            f"counter(s), fit {s} chi2={fit.get('chi2'):.2f} -> "
+            + ("OK" if s == 200 and rerouted >= 1 else "PROBLEM"))
+
+        # drain the survivor: the fleet is empty and the router's
+        # answer is the structured 503 contract, never a 500
+        survivor = srv_b if victim is srv_a else srv_a
+        sp = pb if victim is srv_a else pa
+        s, doc, _ = request_json("127.0.0.1", sp, "POST", "/drain",
+                                 {"timeout_s": 10})
+        drained = s == 200 and doc.get("draining") is True
+        router.probe_now()
+        s, doc, h = request_json("127.0.0.1", rp, "POST", "/v1/fit",
+                                 {"dataset": "flt0", "maxiter": 2})
+        lines.append(
+            f"  all drained: /drain {'OK' if drained else 'PROBLEM'},"
+            f" router -> {s} {doc.get('error')} "
+            f"Retry-After {h.get('retry-after')!r} -> "
+            + ("OK" if s == 503 and doc.get("error") == "ServeError"
+               else "PROBLEM"))
+    except Exception as e:  # diagnostic must never take the report down
+        lines.append(f"  ERROR {type(e).__name__}: {e}")
+    finally:
+        if router is not None:
+            try:
+                router.stop()
+            except Exception:
+                pass
+        for s_ in (srv_a, srv_b):
+            if s_ is not None:
+                try:
+                    s_.stop()
+                except Exception:
+                    pass
+        telemetry.gauge_set("serve.draining", 0.0)
+    return lines
+
+
 def _aot_child(mode, path):
     """Child entry for the --aot smoke (one fresh interpreter per
     probe run): prints the probe record as a JSON line."""
@@ -1408,6 +1518,12 @@ def main(argv=None):
                         "warm fit under the armed recompile "
                         "sanitizer, and a forced same-shape "
                         "recompile that must be caught + attributed")
+    p.add_argument("--fleet", action="store_true",
+                   help="run the fleet smoke: two in-process replicas "
+                        "behind the rendezvous router, broadcast load "
+                        "+ journal, a routed fit, owner kill with "
+                        "re-route to the sibling, and the drained "
+                        "all-down structured 503")
     p.add_argument("--corpus", action="store_true",
                    help="run the scenario-corpus smoke: realize a "
                         "clean, a correlated-noise, and a faulted "
@@ -1434,6 +1550,9 @@ def main(argv=None):
             print(line)
     if args.serve:
         for line in _serve_section():
+            print(line)
+    if args.fleet:
+        for line in _fleet_section():
             print(line)
     if args.profile:
         for line in _profile_section():

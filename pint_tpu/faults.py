@@ -30,14 +30,21 @@ Fault classes (spec grammar: comma-separated ``name[:key=val...]``):
   code 137), simulating a mid-chain kill for checkpoint/resume tests.
   Known sites: ``sampler.chunk`` (mid-MCMC-chain) and ``serve.flush``
   (the warm fitting service — mid-batch dispatch and the grid-job
-  chunk loop, so a killed replica's resume story is testable).
+  chunk loop, so a killed replica's resume story is testable).  The
+  fleet chaos harness (:mod:`pint_tpu.fleet.chaos`) aims this same
+  spec at ONE replica subprocess via its spawn env, so a
+  whole-process death mid-batch exercises router re-route and
+  supervisor restart.
 - ``slow_flush[:ms=N][:site=S]`` — deterministic latency injection:
   every call to :func:`maybe_delay` at site ``S`` (default: any site)
   sleeps ``ms`` milliseconds (default 50).  The serve plane's batched
   dispatch calls it at ``serve.flush``, so an injected slow flush
   drives per-request latency past a declared SLO objective — the
   harness the ``/slo`` verdict-flip and admission-degrade tests run
-  on.
+  on.  The fleet router calls it at ``router.forward`` before every
+  proxied backend request, so injected proxy latency tests the
+  router-side SLO windows and spread policy without touching a
+  replica.
 
 Faults activate via the environment variable (read per call, so a
 subprocess harness controls them) or programmatically
@@ -56,12 +63,13 @@ from pint_tpu import telemetry
 
 __all__ = ["parse", "config", "active", "any_active", "inject", "clear",
            "corrupt_batch", "corrupt_orf", "corrupt_clock_rows",
-           "maybe_kill", "maybe_delay"]
+           "maybe_kill", "maybe_delay", "suspend"]
 
 ENV = "PINT_TPU_FAULTS"
 
 _programmatic: dict = {}
 _site_counts: dict = {}
+_suspended = 0
 
 
 def _coerce(v: str):
@@ -189,10 +197,34 @@ def corrupt_clock_rows(mjds, offsets):
         _tick("clock_corrupt")
 
 
+class _Suspend:
+    def __enter__(self):
+        global _suspended
+        _suspended += 1
+        return self
+
+    def __exit__(self, *exc):
+        global _suspended
+        _suspended -= 1
+        return False
+
+
+def suspend():
+    """Context manager pausing site-fault injection process-wide:
+    :func:`maybe_kill` / :func:`maybe_delay` are no-ops inside it and
+    do NOT advance their ``after=N`` site counters.  The serve plane
+    wraps its boot-time warm rehearsal in this — ``kill:after=K``
+    means the Kth *served* flush, so a replica spawned with a fault
+    armed must not burn the budget (or die) warming itself up."""
+    return _Suspend()
+
+
 def maybe_kill(site):
     """``kill``: hard-exit on the Nth call at the named site (default
     site = any, after=1, code=137).  ``os._exit`` — no atexit, no
     cleanup — the honest simulation of a SIGKILL mid-job."""
+    if _suspended:
+        return
     p = active("kill")
     if p is None:
         return
@@ -210,6 +242,8 @@ def maybe_delay(site):
     """``slow_flush``: sleep ``ms`` milliseconds at the named site
     (host-side only — the delay happens before any device work, so it
     is pure added latency, never a traced-program change)."""
+    if _suspended:
+        return
     p = active("slow_flush")
     if p is None:
         return

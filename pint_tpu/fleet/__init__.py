@@ -1,0 +1,53 @@
+"""Fleet orchestration: N serve replicas as one fault-tolerant
+service (ROADMAP item 2).
+
+The layer above :mod:`pint_tpu.serve`: one replica is a hardened
+process; production is N of them behind a router, supervised, rolled,
+and chaos-tested as a unit.
+
+- :mod:`pint_tpu.fleet.client` — the shared HTTP helper every in-repo
+  load path uses: bounded retry/backoff that honors 429/503
+  ``Retry-After`` hints, with a per-request attempt + wall-clock
+  budget.
+- :mod:`pint_tpu.fleet.router` — the front-proxy: dataset→replica
+  rendezvous hashing (stacked-batch LRU locality), same-bucket load
+  spreading, ``/readyz``-gated placement, backpressure-aware
+  re-routing, and a router-side SLO tracker over client-visible
+  outcomes.
+- :mod:`pint_tpu.fleet.supervisor` — spawns/monitors N ``pintserve``
+  subprocesses: exponential-backoff restarts, crash-loop quarantine,
+  zero-downtime rolling deploys of a new AOT artifact (drain → swap →
+  re-warm), queue-depth/shed-rate autoscaling.
+- :mod:`pint_tpu.fleet.chaos` — the standing soak: the corpus mix
+  streamed through the router while replicas are killed and deployed,
+  asserting bounded error budgets, job resume on siblings, and a
+  violation-free sanitizer fleet-wide.
+
+``pintfleet`` (:mod:`pint_tpu.fleet.cli`) boots a supervised fleet +
+router as one command.  See docs/fleet.md.
+"""
+
+from __future__ import annotations
+
+__all__ = ["RetryClient", "request_with_retry", "Router",
+           "FleetSupervisor", "chaos_soak"]
+
+
+def __getattr__(name):  # lazy: keep `import pint_tpu.fleet` cheap
+    if name in ("RetryClient", "request_with_retry"):
+        from pint_tpu.fleet import client as _m
+
+        return getattr(_m, name)
+    if name == "Router":
+        from pint_tpu.fleet.router import Router
+
+        return Router
+    if name == "FleetSupervisor":
+        from pint_tpu.fleet.supervisor import FleetSupervisor
+
+        return FleetSupervisor
+    if name == "chaos_soak":
+        from pint_tpu.fleet.chaos import chaos_soak
+
+        return chaos_soak
+    raise AttributeError(name)

@@ -1,0 +1,297 @@
+"""Fleet chaos harness: the corpus mix through the router while
+replicas die and deploy.
+
+The fleet-level twin of :mod:`pint_tpu.corpus.replay`: the same
+deterministic scenario mix and 70/20/10 op stream, but fired through a
+:class:`~pint_tpu.fleet.router.Router` fronting N REAL ``pintserve``
+subprocesses under a :class:`~pint_tpu.fleet.supervisor
+.FleetSupervisor` — and then the harness breaks things on purpose:
+
+- **mid-batch replica death** — the victim (the rendezvous owner of
+  the first dataset, so it is guaranteed traffic) is respawned with
+  ``PINT_TPU_FAULTS=kill:site=serve.flush:after=K``: its Kth batch
+  flush or grid chunk hard-exits the process mid-work, exactly the
+  fault :mod:`pint_tpu.faults` injects everywhere else.  The router
+  must re-route (clients see retries, never 5xx) and the supervisor
+  must restart the replica (the fault env is cleared on first death
+  so the respawn is clean).
+- **checkpointed-job failover** — a grid job is submitted through the
+  router onto the victim before the kill; after the death the poll
+  path resubmits it to a sibling, which resumes from the shared
+  job-dir checkpoint losing at most one chunk.
+- **rolling deploy under load** (opt-in) — the supervisor walks the
+  fleet mid-stream; the measured zero-ready downtime rides the stats.
+- **sanitizer fleet-wide** — every replica runs with
+  ``$PINT_TPU_RECOMPILE_SANITIZER`` armed over an AOT artifact
+  exported by an in-process rehearsal (same datasets, same op set,
+  same grid geometry), so any post-warm compile anywhere in the fleet
+  is a counted violation in the final scrape.
+
+Returns one structured stats dict (stream outcomes, router counters,
+SLO verdict, job document, deploy record, fleet-summed sanitizer
+violations) — consumed by ``bench_fleet``, ``datacheck --fleet`` and
+the chaos tests.  Telemetry: ``fleet.chaos.requests`` /
+``fleet.chaos.errors`` counters.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+import threading
+import time
+
+from pint_tpu import telemetry
+
+__all__ = ["chaos_soak", "KILL_SPEC"]
+
+#: the injected fault: Kth ``serve.flush`` call (batch flush OR grid
+#: chunk) hard-exits the replica mid-work
+KILL_SPEC = "kill:site=serve.flush:after={after}"
+
+
+def _mixed_op(i):
+    from pint_tpu.corpus.replay import _mixed_op as m
+
+    return m(i)
+
+
+def _rehearse(scenarios, files, aot_dir, maxiter, grid_spec,
+              job_chunk):
+    """In-process AOT rehearsal: warm every (op, dataset) program —
+    and the grid-chunk program when a job rides the soak — then
+    export the executables as the fleet's deploy artifact."""
+    from pint_tpu import compile_cache as _cc
+    from pint_tpu.serve import jobs as _jobs
+    from pint_tpu.serve.server import Server
+
+    srv = Server(queue_max=4096, deadline_ms=0)
+    try:
+        for s in scenarios:
+            par_path, tim_path = files[s.name]
+            srv.registry.load(s.name, par=par_path, tim=tim_path)
+        f0 = float(srv.registry.get(scenarios[0].name)
+                   .model.values["F0"])
+        for s in scenarios:
+            srv.warmup(s.name, ops=("fit", "lnlike", "residuals"),
+                       maxiter=maxiter)
+        if grid_spec is not None:
+            grid_spec = dict(grid_spec)
+            a = grid_spec["axes"]["F0"]
+            a.setdefault("start", f0 - 1e-10)
+            a.setdefault("stop", f0 + 1e-10)
+            with tempfile.TemporaryDirectory(
+                    prefix="pintchaos_rehearse_") as jd:
+                doc = {"job": "rehearsal", "kind": "grid",
+                       "spec": grid_spec}
+                _jobs.run_job(srv.registry, doc, jd,
+                              grid_chunk=job_chunk)
+        out = _cc.export_executables(aot_dir)
+    finally:
+        srv.stop()
+    return {"exported": len(out.get("exported", ())), "f0": f0,
+            "grid_spec": grid_spec}
+
+
+def chaos_soak(n_replicas=2, n_requests=120,
+               classes=("spin", "binary"), kill=True, kill_after=4,
+               deploy=False, job=True, grid_points=16, job_chunk=4,
+               maxiter=2, slo_p99_ms=None, slo_avail=None,
+               base_seed=0, ready_timeout=600.0, request_timeout=120.0,
+               keep_dirs=False, workdir=None) -> dict:
+    """Run one chaos soak; returns the stats dict (never raises for
+    in-stream failures — they are counted).  ``kill``/``deploy``/
+    ``job`` toggle the three fault stories independently so the lean
+    tier-1 test and the full acceptance soak share this one body."""
+    from pint_tpu.corpus.replay import default_mix
+    from pint_tpu.fleet.client import RetryClient
+    from pint_tpu.fleet.router import Router, rendezvous_order
+    from pint_tpu.fleet.supervisor import FleetSupervisor
+    from pint_tpu.obs import fleet as _obs_fleet
+
+    own_workdir = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="pintchaos_")
+    data_dir = os.path.join(workdir, "data")
+    aot_dir = os.path.join(workdir, "aot")
+    job_dir = os.path.join(workdir, "jobs")
+    log_dir = os.path.join(workdir, "logs")
+    for d in (data_dir, aot_dir, job_dir, log_dir):
+        os.makedirs(d, exist_ok=True)
+
+    scenarios = default_mix(base_seed=base_seed, classes=classes)
+    files = {}
+    for s in scenarios:
+        files[s.name] = s.write(data_dir)
+    ids = [s.name for s in scenarios]
+
+    grid_spec = None
+    if job:
+        grid_spec = {"kind": "grid", "dataset": ids[0],
+                     "job": "chaosjob", "params": ["F0"],
+                     "n_steps": 1, "chunk": int(job_chunk),
+                     "axes": {"F0": {"n": int(grid_points)}}}
+    rehearsal = _rehearse(scenarios, files, aot_dir, maxiter,
+                          grid_spec, job_chunk)
+    grid_spec = rehearsal.pop("grid_spec", None)
+
+    env = dict(os.environ)
+    env.setdefault("PINT_TPU_RECOMPILE_SANITIZER", "warn")
+    env.setdefault("PINT_TPU_CACHE_DIR",
+                   os.path.join(workdir, "cache"))
+    env.pop("PINT_TPU_FAULTS", None)  # only the victim gets faults
+
+    router = Router(slo_p99_ms=slo_p99_ms, slo_avail=slo_avail)
+    sup = FleetSupervisor(
+        n_replicas=n_replicas,
+        datasets=[(n, files[n][0], files[n][1]) for n in ids],
+        aot_dir=aot_dir, job_dir=job_dir, base_env=env,
+        router=router, log_dir=log_dir)
+    stats = {"replicas": int(n_replicas),
+             "requests": int(n_requests), "datasets": ids,
+             "rehearsal": rehearsal}
+    client = None
+    try:
+        sup.start()
+        router.start(port=0)
+        if not sup.wait_ready(timeout=ready_timeout):
+            raise RuntimeError(
+                f"fleet not ready within {ready_timeout}s "
+                f"(logs under {log_dir})")
+
+        victim_slot = None
+        if kill and n_replicas >= 2:
+            # the victim must be guaranteed traffic: the rendezvous
+            # owner of the first dataset.  Its fault env only exists
+            # at spawn time, so bounce it (expected exit, direct
+            # respawn — not a counted crash) with the kill armed.
+            victim = rendezvous_order(ids[0], sup.targets())[0]
+            for s in sup._slots:
+                if s.target == victim:
+                    victim_slot = s
+                    break
+            victim_slot.extra_env["PINT_TPU_FAULTS"] = \
+                KILL_SPEC.format(after=int(kill_after))
+            victim_slot.expecting_exit = True
+            victim_slot.proc.terminate()
+            victim_slot.proc.wait(timeout=30)
+            sup._spawn(victim_slot)
+            if not sup.wait_ready(timeout=ready_timeout):
+                raise RuntimeError("victim respawn never ready")
+
+            def _clear_fault():
+                # first death disarms the fault, so the supervisor's
+                # restart comes back clean instead of crash-looping
+                while victim_slot.proc is not None \
+                        and victim_slot.proc.poll() is None:
+                    time.sleep(0.02)
+                victim_slot.extra_env.pop("PINT_TPU_FAULTS", None)
+
+            threading.Thread(target=_clear_fault,
+                             daemon=True).start()
+        router.probe_now()
+
+        job_doc = None
+        if grid_spec is not None:
+            client = RetryClient("127.0.0.1", router._port,
+                                 timeout=request_timeout,
+                                 max_attempts=6, budget_s=60.0)
+            status, job_doc, _ = client.post("/v1/jobs", grid_spec)
+            stats["job_submit_status"] = status
+
+        ok = 0
+        errors = 0
+        five_xx = 0
+        statuses: dict = {}
+        deploy_doc: dict = {}
+
+        def _deploy():
+            deploy_doc.update(sup.rolling_deploy())
+
+        deploy_thread = None
+        client = client or RetryClient(
+            "127.0.0.1", router._port, timeout=request_timeout,
+            max_attempts=6, budget_s=60.0)
+        t0 = time.time()
+        for i in range(int(n_requests)):
+            if deploy and deploy_thread is None \
+                    and i >= int(n_requests) * 0.25:
+                deploy_thread = threading.Thread(target=_deploy,
+                                                 daemon=True)
+                deploy_thread.start()
+            op = _mixed_op(i)
+            body = {"dataset": ids[i % len(ids)]}
+            if op == "fit":
+                body["maxiter"] = maxiter
+            try:
+                status, r, _ = client.post(f"/v1/{op}", body)
+            except OSError:
+                errors += 1
+                statuses["conn_error"] = \
+                    statuses.get("conn_error", 0) + 1
+                client.close()
+                continue
+            statuses[status] = statuses.get(status, 0) + 1
+            if status == 200 and r.get("status") == "ok":
+                ok += 1
+            else:
+                errors += 1
+                if status >= 500:
+                    five_xx += 1
+            telemetry.counter_add("fleet.chaos.requests")
+        wall = time.time() - t0
+        if deploy_thread is not None:
+            deploy_thread.join(timeout=600)
+
+        if grid_spec is not None:
+            # poll THROUGH the router: if the owner died this is the
+            # failover path (resubmit to a sibling, checkpoint resume)
+            deadline = time.time() + 300
+            while time.time() < deadline:
+                status, job_doc, _ = client.get(
+                    f"/v1/jobs/{grid_spec['job']}")
+                if status == 200 and job_doc.get("state") in (
+                        "done", "failed"):
+                    break
+                time.sleep(0.25)
+            stats["job"] = job_doc
+
+        # settle, then scrape every replica for the fleet-wide
+        # sanitizer verdict and merged counters
+        sup.wait_ready(timeout=60)
+        fleet_doc = _obs_fleet.fleet_snapshot(sup.targets(),
+                                              timeout=5.0)
+        ctr = telemetry.counters()
+        if errors:
+            telemetry.counter_add("fleet.chaos.errors", errors)
+        stats.update({
+            "ok": ok, "errors": errors, "client_5xx": five_xx,
+            "statuses": {str(k): v for k, v in statuses.items()},
+            "wall_s": round(wall, 3),
+            "rps": round(int(n_requests) / wall, 3) if wall else 0.0,
+            "kill": {"armed": bool(victim_slot is not None),
+                     "victim": (victim_slot.target
+                                if victim_slot else None),
+                     "crashes": (victim_slot.crashes
+                                 if victim_slot else 0)},
+            "deploy": deploy_doc or None,
+            "sanitizer_violations": (fleet_doc.get("counters") or {})
+            .get("pint_tpu_sanitizer_violations_total", 0.0),
+            "fleet": {"replicas_up": fleet_doc.get("replicas_up"),
+                      "replicas_total": fleet_doc.get("replicas")},
+            "router_counters": {k: v for k, v in ctr.items()
+                                if k.startswith("router.")},
+            "slo": router.slo.verdict_doc(),
+        })
+        telemetry.emit({"type": "fleet_chaos", **{
+            k: stats[k] for k in ("replicas", "requests", "ok",
+                                  "errors", "client_5xx", "wall_s",
+                                  "rps", "sanitizer_violations")}})
+        return stats
+    finally:
+        if client is not None:
+            client.close()
+        router.stop()
+        sup.stop()
+        if own_workdir and not keep_dirs:
+            shutil.rmtree(workdir, ignore_errors=True)

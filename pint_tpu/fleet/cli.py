@@ -1,0 +1,133 @@
+"""``pintfleet``: boot a supervised replica fleet behind one router.
+
+::
+
+    # 4 supervised replicas + router on :8080, one shared AOT artifact
+    pintfleet --replicas 4 --port 8080 --import /fast/aot \\
+        --dataset J1909=J1909.par,J1909.tim
+
+    # rolling-deploy a new artifact into a running fleet: re-run the
+    # supervisor with the new --import dir (or drive
+    # FleetSupervisor.rolling_deploy from code / the chaos harness)
+
+The router listens on ``--port`` (or ``$PINT_TPU_ROUTER_PORT``; 0
+picks an ephemeral port, printed at boot).  Replica count defaults
+from ``$PINT_TPU_FLEET_REPLICAS``.  ``--autoscale SECONDS`` enables
+the queue-depth/shed-rate autoscaler between ``--min-replicas`` and
+``--max-replicas``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def build_parser() -> argparse.ArgumentParser:
+    from pint_tpu.fleet.supervisor import (
+        AUTOSCALE_S_ENV, MAX_REPLICAS_ENV, MIN_REPLICAS_ENV,
+        REPLICAS_ENV,
+    )
+
+    p = argparse.ArgumentParser(
+        prog="pintfleet",
+        description="supervised pintserve fleet behind a "
+                    "rendezvous-hashing router")
+    p.add_argument("--replicas", type=int, default=None,
+                   help=f"replica count (default ${REPLICAS_ENV} "
+                        "or 2)")
+    p.add_argument("--host", default="127.0.0.1",
+                   help="router bind host")
+    p.add_argument("--port", type=int, default=None,
+                   help="router port (default $PINT_TPU_ROUTER_PORT "
+                        "or 0 = ephemeral)")
+    p.add_argument("--import", dest="import_dir", metavar="DIR",
+                   default=None,
+                   help="AOT manifest every replica imports at boot")
+    p.add_argument("--warm", action="store_true",
+                   help="explicit warmup at each replica boot")
+    p.add_argument("--job-dir", default=None,
+                   help="SHARED job directory (sibling replicas "
+                        "resume each other's checkpointed jobs)")
+    p.add_argument("--dataset", action="append", default=[],
+                   metavar="ID=PAR[,TIM]",
+                   help="dataset registered on every replica at "
+                        "boot (repeatable)")
+    p.add_argument("--autoscale", type=float, default=None,
+                   metavar="SECONDS",
+                   help="autoscaler tick period (default "
+                        f"${AUTOSCALE_S_ENV}; unset/0 = off)")
+    p.add_argument("--min-replicas", type=int, default=None,
+                   help=f"autoscale floor (default "
+                        f"${MIN_REPLICAS_ENV} or 1)")
+    p.add_argument("--max-replicas", type=int, default=None,
+                   help=f"autoscale ceiling (default "
+                        f"${MAX_REPLICAS_ENV} or 8)")
+    return p
+
+
+def _parse_datasets(specs) -> list:
+    out = []
+    for spec in specs:
+        name, _, paths = spec.partition("=")
+        if not name or not paths:
+            raise SystemExit(
+                f"--dataset {spec!r}: expected ID=PAR[,TIM]")
+        par, _, tim = paths.partition(",")
+        out.append((name, par, tim or None))
+    return out
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    from pint_tpu.fleet.router import Router
+    from pint_tpu.fleet.supervisor import (
+        AUTOSCALE_S_ENV, FleetSupervisor,
+    )
+
+    autoscale_s = args.autoscale
+    if autoscale_s is None:
+        raw = os.environ.get(AUTOSCALE_S_ENV, "").strip()
+        autoscale_s = float(raw) if raw else 0.0
+
+    router = Router()
+    sup = FleetSupervisor(
+        n_replicas=args.replicas,
+        datasets=_parse_datasets(args.dataset),
+        aot_dir=args.import_dir, job_dir=args.job_dir,
+        warm=args.warm, min_replicas=args.min_replicas,
+        max_replicas=args.max_replicas, router=router)
+    try:
+        targets = sup.start()
+        port = router.start(host=args.host, port=args.port)
+        print(f"pintfleet: router on {args.host}:{port}  "
+              f"replicas: {', '.join(targets)}", flush=True)
+        print(f"pintfleet: logs under {sup.log_dir}  "
+              f"jobs under {sup.job_dir}", flush=True)
+        if sup.wait_ready(timeout=600.0, min_ready=1):
+            print("pintfleet: fleet ready", flush=True)
+        else:
+            print("pintfleet: WARNING no replica became ready "
+                  "within 600s", file=sys.stderr, flush=True)
+        while True:
+            time.sleep(autoscale_s if autoscale_s > 0 else 3600)
+            if autoscale_s > 0:
+                d = sup.autoscale_tick()
+                if d["target"] != d["current"]:
+                    print(f"pintfleet: autoscale "
+                          f"{d['current']} -> {d['target']} "
+                          f"(queue={d['queue_depth']:.0f} "
+                          f"sheds={d['sheds_delta']:.0f})",
+                          flush=True)
+    except KeyboardInterrupt:
+        print("pintfleet: shutting down", flush=True)
+    finally:
+        router.stop()
+        sup.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,159 @@
+"""Shared HTTP client with Retry-After-honoring retry/backoff.
+
+Every in-repo load path (the corpus replay stream, the bench serve
+load generators, the chaos harness, the supervisor's drain/readyz
+calls) speaks to the serve plane through this ONE helper instead of
+hand-rolling its own request loop: a bounded retry policy with
+
+- **Retry-After honored**: a 429/503 carries the replica's own hint
+  (header seconds, or ``retry_after_ms`` in the body) — sleeping
+  exactly that long is the cooperative half of admission control;
+- **exponential backoff** for transport failures and hint-less
+  refusals (base doubles per attempt, deterministic — no jitter, so
+  test traffic replays exactly);
+- **per-request budgets**: at most ``$PINT_TPU_FLEET_RETRIES``
+  attempts AND ``$PINT_TPU_FLEET_RETRY_BUDGET_S`` wall seconds —
+  a retry storm is bounded on both axes by construction.
+
+Retried outcomes: connection errors (the replica died — the fleet
+router re-placed its work) and 429/503 (shed / draining / transient).
+A 504 deadline miss is returned to the caller — deadline semantics
+belong to the client, not the transport.  Fit/residual/lnlike
+requests are pure functions of registered data, so a replay after an
+ambiguous transport failure is safe by construction.
+
+Telemetry: ``fleet.client.retries`` / ``fleet.client.giveups``.
+"""
+
+from __future__ import annotations
+
+import http.client
+import os
+import time
+
+from pint_tpu import telemetry
+from pint_tpu.serve.client import ServeClient
+
+__all__ = ["RetryClient", "request_with_retry", "retry_after_from",
+           "RETRIES_ENV", "RETRY_BUDGET_ENV"]
+
+#: host-only knobs (lint/static.py HOST_ONLY): retry policy shapes
+#: traffic, never a traced program
+RETRIES_ENV = "PINT_TPU_FLEET_RETRIES"
+RETRY_BUDGET_ENV = "PINT_TPU_FLEET_RETRY_BUDGET_S"
+
+#: statuses worth retrying: shed (429) and unavailable/draining (503)
+RETRY_STATUSES = (429, 503)
+
+
+def _env_num(name, default):
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+def retry_after_from(headers, body) -> float | None:
+    """The replica's own backoff hint, in seconds: the
+    ``Retry-After`` header (integral seconds per the spec) or the
+    finer-grained ``retry_after_ms`` the structured error body
+    carries."""
+    ms = None
+    if isinstance(body, dict):
+        ms = body.get("retry_after_ms")
+    if ms is not None:
+        try:
+            return float(ms) / 1e3
+        except (TypeError, ValueError):
+            pass
+    raw = (headers or {}).get("retry-after")
+    if raw is not None:
+        try:
+            return float(raw)
+        except (TypeError, ValueError):
+            pass
+    return None
+
+
+class RetryClient:
+    """One keep-alive connection with the bounded retry policy on
+    top.  ``max_attempts``/``budget_s`` default from the env knobs
+    (4 attempts, 15 s)."""
+
+    def __init__(self, host="127.0.0.1", port=8470, timeout=60.0,
+                 max_attempts=None, budget_s=None, backoff_s=0.05,
+                 retry_statuses=RETRY_STATUSES):
+        self._client = ServeClient(host, port, timeout=timeout)
+        self.max_attempts = int(max_attempts
+                                if max_attempts is not None
+                                else _env_num(RETRIES_ENV, 4))
+        self.budget_s = float(budget_s if budget_s is not None
+                              else _env_num(RETRY_BUDGET_ENV, 15.0))
+        self.backoff_s = float(backoff_s)
+        self.retry_statuses = tuple(retry_statuses)
+
+    @property
+    def host(self):
+        return self._client.host
+
+    @property
+    def port(self):
+        return self._client.port
+
+    def request(self, method, path, body=None, headers=None):
+        """Returns the final ``(status, parsed_json, headers_dict)``.
+        Raises the last transport error only when EVERY attempt
+        failed before receiving any HTTP response."""
+        t0 = time.monotonic()
+        backoff = self.backoff_s
+        last = None
+        last_exc = None
+        for attempt in range(max(self.max_attempts, 1)):
+            if attempt:
+                telemetry.counter_add("fleet.client.retries")
+            wait = None
+            try:
+                status, obj, h = self._client.request(
+                    method, path, body, headers=headers)
+            except (http.client.HTTPException, ConnectionError,
+                    BrokenPipeError, OSError) as e:
+                last_exc = e
+            else:
+                last, last_exc = (status, obj, h), None
+                if status not in self.retry_statuses:
+                    return last
+                wait = retry_after_from(h, obj)
+            remaining = self.budget_s - (time.monotonic() - t0)
+            if attempt >= self.max_attempts - 1 or remaining <= 0:
+                break
+            time.sleep(max(0.0, min(wait if wait is not None
+                                    else backoff, remaining)))
+            backoff *= 2.0
+        telemetry.counter_add("fleet.client.giveups")
+        if last is None:
+            raise last_exc
+        return last
+
+    # convenience verbs (the ServeClient surface)
+    def get(self, path):
+        return self.request("GET", path)
+
+    def post(self, path, body, headers=None):
+        return self.request("POST", path, body, headers=headers)
+
+    def close(self):
+        self._client.close()
+
+
+def request_with_retry(host, port, method, path, body=None,
+                       timeout=60.0, headers=None, **kw):
+    """One-shot request through the retry policy (fresh connection,
+    closed after)."""
+    c = RetryClient(host, port, timeout=timeout, **kw)
+    try:
+        return c.request(method, path, body, headers=headers)
+    finally:
+        c.close()

@@ -1,0 +1,777 @@
+"""Fleet router/front-proxy: N replicas behind one door.
+
+Stdlib asyncio HTTP/1.1, the same skeleton as
+:class:`pint_tpu.serve.server.Server` — the event loop never blocks
+on a backend: every proxied call runs on the default executor, so a
+slow replica stalls nothing but its own client.
+
+Placement policy (the throughput story):
+
+- **dataset → replica rendezvous hashing.**  The stacked-batch LRU
+  and the dataset registry are per-process, so locality IS
+  throughput: all requests for one dataset should land on one
+  replica (its warm cache) and keep landing there across fleet
+  membership changes.  Rendezvous (highest-random-weight) hashing
+  gives exactly that: each (dataset, replica) pair gets a stable
+  score, the live replica with the highest score owns the dataset,
+  and a replica death only re-homes the datasets it owned.
+- **same-bucket spread.**  When the owner is saturated (its
+  router-side in-flight count reaches
+  ``$PINT_TPU_ROUTER_SPREAD_PENDING``), the request spills to the
+  next candidate in rendezvous order — bounded locality loss in
+  exchange for not queueing behind a hot spot.
+- **readiness-gated.**  A background prober polls every target's
+  ``/readyz``; only ready replicas are candidates.  A replica that
+  transitions down→up (a supervisor restart) gets the **dataset
+  journal replayed** (every ``/v1/load`` body this router has seen)
+  before it rejoins rotation — a freshly restarted process knows
+  nothing, and routing to it before replay would 400.
+- **backpressure honored, failures re-routed.**  A 429 shed moves to
+  the next candidate; if every candidate sheds, the router returns
+  the 429 with the LARGEST Retry-After (the honest fleet-wide hint).
+  A 503 or connection error pulls the replica from rotation (the
+  probe restores it) and re-routes.  Only when every candidate is
+  down does the client see a structured 503 — and **never a 500**.
+- **per-request retry budgets.**  At most ``$PINT_TPU_ROUTER_RETRY``
+  proxy attempts per request — a bounded error budget, not a retry
+  storm.
+
+Jobs: ``POST /v1/jobs`` routes by dataset and journals the spec
+(stamped with its id); when a poll finds the owner has LOST the job —
+dead, answering 404 (a deploy-respawned process with a fresh
+in-memory store), or reporting ``"interrupted"`` after a drain
+checkpointed it — the router resubmits the journaled spec (shared
+job dir ⇒ the new run resumes from the checkpoint losing ≤ 1 chunk)
+— ``GET /v1/jobs/<id>`` fails over transparently.
+
+The router keeps its OWN :class:`~pint_tpu.obs.slo.SloTracker` (not
+the process singleton): its windows measure CLIENT-visible outcomes
+(after re-routing), which is the fleet's real SLO; ``/slo`` serves
+it and ``/fleet`` serves the merged per-replica view
+(:func:`pint_tpu.obs.fleet.fleet_snapshot`).
+
+Telemetry: ``router.requests`` / ``router.reroutes`` /
+``router.retries`` / ``router.sheds`` / ``router.all_down`` /
+``router.proxy_errors`` / ``router.replays`` /
+``router.job_failovers`` counters; ``router.replicas_ready`` /
+``router.replicas_total`` / ``router.inflight`` gauges.  All
+``PINT_TPU_ROUTER_*`` knobs are host-only: they shape placement and
+retry policy, never a traced program (the router process runs no
+device code at all).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import os
+import threading
+import time
+
+from pint_tpu import faults as _faults
+from pint_tpu import telemetry
+from pint_tpu.fleet.client import retry_after_from
+from pint_tpu.obs import slo as _slo
+from pint_tpu.serve.client import request_json
+
+__all__ = ["Router", "rendezvous_order",
+           "ROUTER_PORT_ENV", "ROUTER_HOST_ENV", "RETRY_ENV",
+           "PROBE_S_ENV", "SPREAD_ENV"]
+
+# host-only knobs (lint/static.py HOST_ONLY)
+ROUTER_PORT_ENV = "PINT_TPU_ROUTER_PORT"
+ROUTER_HOST_ENV = "PINT_TPU_ROUTER_HOST"
+RETRY_ENV = "PINT_TPU_ROUTER_RETRY"
+PROBE_S_ENV = "PINT_TPU_ROUTER_PROBE_S"
+SPREAD_ENV = "PINT_TPU_ROUTER_SPREAD_PENDING"
+
+_REASONS = {200: "OK", 400: "Bad Request", 404: "Not Found",
+            405: "Method Not Allowed", 429: "Too Many Requests",
+            503: "Service Unavailable", 504: "Gateway Timeout"}
+
+_MAX_BODY = 8 << 20
+
+#: ops proxied through the coalescing data plane
+_OPS = ("fit", "residuals", "lnlike")
+
+
+def _env_num(name, default):
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+def rendezvous_order(dataset, targets) -> list:
+    """Highest-random-weight order of ``targets`` for ``dataset``:
+    stable per pair, so membership changes only re-home the dead
+    replica's datasets — the property that preserves every OTHER
+    replica's warm stacked-batch LRU through a kill or deploy."""
+    def score(t):
+        h = hashlib.sha256(
+            f"{dataset}|{t}".encode("utf-8", "replace")).digest()
+        return h
+    return sorted(targets, key=score, reverse=True)
+
+
+class _Replica:
+    """Router-side view of one backend."""
+
+    __slots__ = ("target", "ready", "inflight", "replayed",
+                 "last_error", "last_probe_ts")
+
+    def __init__(self, target):
+        self.target = str(target)
+        self.ready = False
+        self.inflight = 0
+        self.replayed = False      # dataset journal delivered?
+        self.last_error = None
+        self.last_probe_ts = 0.0
+
+    @property
+    def host(self):
+        return self.target.rsplit(":", 1)[0]
+
+    @property
+    def port(self):
+        return int(self.target.rsplit(":", 1)[1])
+
+    def doc(self):
+        return {"target": self.target, "ready": self.ready,
+                "inflight": self.inflight,
+                "replayed": self.replayed,
+                "error": self.last_error}
+
+
+class Router:
+    """The front-proxy: readiness-probed replica table + rendezvous
+    placement + bounded re-routing + router-side SLO."""
+
+    def __init__(self, targets=(), probe_s=None, retry=None,
+                 spread_pending=None, slo_p99_ms=None, slo_avail=None,
+                 proxy_timeout=120.0):
+        self.probe_s = float(probe_s if probe_s is not None
+                             else _env_num(PROBE_S_ENV, 0.5))
+        self.retry = int(retry if retry is not None
+                         else _env_num(RETRY_ENV, 4))
+        self.spread_pending = int(
+            spread_pending if spread_pending is not None
+            else _env_num(SPREAD_ENV, 8))
+        self.proxy_timeout = float(proxy_timeout)
+        # the router's OWN tracker: client-visible outcomes after
+        # re-routing — deliberately not the process singleton
+        self.slo = _slo.SloTracker(p99_ms=slo_p99_ms, avail=slo_avail)
+        self._lock = threading.Lock()
+        self._replicas: dict = {}      # target -> _Replica
+        self._datasets: dict = {}      # dataset id -> /v1/load body
+        self._ds_order: list = []      # registration order
+        self._jobs: dict = {}          # job id -> journaled spec
+        self._job_owner: dict = {}     # job id -> target
+        for t in targets:
+            self._replicas[str(t)] = _Replica(t)
+        self._loop = None
+        self._aserver = None
+        self._thread = None
+        self._port = None
+        self._started = threading.Event()
+        self._stop_probe = threading.Event()
+        self._probe_thread = None
+
+    # -- membership ---------------------------------------------------------
+    def set_targets(self, targets):
+        """Declare the replica set (the supervisor calls this on
+        membership changes).  Existing state for kept targets
+        survives; removed targets leave rotation immediately."""
+        targets = [str(t) for t in targets]
+        with self._lock:
+            for t in targets:
+                if t not in self._replicas:
+                    self._replicas[t] = _Replica(t)
+            for t in list(self._replicas):
+                if t not in targets:
+                    del self._replicas[t]
+        self._export_gauges()
+
+    def targets(self) -> list:
+        with self._lock:
+            return list(self._replicas)
+
+    def replica_docs(self) -> list:
+        with self._lock:
+            return [r.doc() for r in self._replicas.values()]
+
+    def _export_gauges(self):
+        with self._lock:
+            n_ready = sum(r.ready for r in self._replicas.values())
+            n_total = len(self._replicas)
+            inflight = sum(r.inflight
+                           for r in self._replicas.values())
+        telemetry.gauge_set("router.replicas_ready", float(n_ready))
+        telemetry.gauge_set("router.replicas_total", float(n_total))
+        telemetry.gauge_set("router.inflight", float(inflight))
+
+    # -- readiness probing + journal replay ---------------------------------
+    def probe_now(self):
+        """One synchronous probe sweep (the background prober's body;
+        callable directly so tests and the supervisor can force a
+        refresh instead of waiting a period)."""
+        for target in self.targets():
+            with self._lock:
+                rep = self._replicas.get(target)
+            if rep is None:
+                continue
+            try:
+                status, doc, _ = request_json(
+                    rep.host, rep.port, "GET", "/readyz", timeout=2.0)
+            except OSError as e:
+                # connection-level death: the PROCESS is likely gone,
+                # so a future comeback needs the journal replayed
+                with self._lock:
+                    rep.ready = False
+                    rep.replayed = False
+                    rep.last_error = f"{type(e).__name__}: {e}"
+                continue
+            rep.last_probe_ts = time.monotonic()
+            if status == 200:
+                if not rep.replayed:
+                    self._replay_datasets(rep)
+                with self._lock:
+                    rep.ready = rep.replayed
+                    rep.last_error = None
+            else:
+                # an HTTP 503 (cold or DRAINING) is the same live
+                # process refusing traffic: keep its replayed state —
+                # its registry still holds the datasets
+                with self._lock:
+                    rep.ready = False
+                    rep.last_error = (doc or {}).get("detail") \
+                        or "not ready"
+        self._export_gauges()
+
+    def _replay_datasets(self, rep):
+        """Deliver the dataset journal to a (re)joining replica —
+        register-before-route, so a supervisor-restarted process
+        never sees a request for a dataset it does not know."""
+        with self._lock:
+            order = list(self._ds_order)
+            bodies = {d: self._datasets[d] for d in order}
+        ok = True
+        for ds in order:
+            try:
+                status, _, _ = request_json(
+                    rep.host, rep.port, "POST", "/v1/load",
+                    bodies[ds], timeout=self.proxy_timeout)
+                if status != 200:
+                    ok = False
+                    break
+                telemetry.counter_add("router.replays")
+            except OSError:
+                ok = False
+                break
+        with self._lock:
+            rep.replayed = ok
+
+    def _probe_loop(self):
+        while not self._stop_probe.wait(self.probe_s):
+            try:
+                self.probe_now()
+            except Exception:  # noqa: BLE001 — the prober must
+                pass           # survive anything a backend does
+
+    # -- placement ----------------------------------------------------------
+    def _candidates(self, dataset) -> list:
+        """Ready replicas in rendezvous order for ``dataset``, with
+        the spread rule applied: a saturated owner (inflight at the
+        spread bound) yields to the next candidate with headroom."""
+        with self._lock:
+            ready = [t for t, r in self._replicas.items() if r.ready]
+            inflight = {t: self._replicas[t].inflight for t in ready}
+        order = rendezvous_order(dataset or "", ready)
+        if len(order) >= 2 and self.spread_pending > 0 \
+                and inflight.get(order[0], 0) >= self.spread_pending:
+            spilled = min(order[1:], key=lambda t: inflight.get(t, 0))
+            order = [spilled] + [t for t in order if t != spilled]
+        return order
+
+    def _mark_down(self, target, err):
+        with self._lock:
+            rep = self._replicas.get(target)
+            if rep is not None:
+                rep.ready = False
+                rep.replayed = False
+                rep.last_error = str(err)
+        self._export_gauges()
+
+    # -- proxying -----------------------------------------------------------
+    def _proxy_sync(self, target, method, path, body, headers=None):
+        """One forwarded request (executor thread).  Raises OSError
+        on transport failure — the caller re-routes."""
+        _faults.maybe_delay("router.forward")
+        with self._lock:
+            rep = self._replicas.get(target)
+            if rep is not None:
+                rep.inflight += 1
+        try:
+            host, _, port = target.rpartition(":")
+            return request_json(host, int(port), method, path, body,
+                                timeout=self.proxy_timeout,
+                                headers=headers)
+        finally:
+            with self._lock:
+                rep = self._replicas.get(target)
+                if rep is not None:
+                    rep.inflight = max(rep.inflight - 1, 0)
+
+    async def _proxy(self, target, method, path, body, headers=None):
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            None, lambda: self._proxy_sync(target, method, path,
+                                           body, headers))
+
+    def _fwd_headers(self, headers):
+        """Headers worth forwarding: the trace context continues
+        THROUGH the router, so one traceparent names the whole story
+        client → router → replica → flush."""
+        out = {}
+        tp = (headers or {}).get("traceparent")
+        if tp:
+            out["traceparent"] = tp
+        return out or None
+
+    async def _route_op(self, op, params, headers):
+        """The re-routing loop for one data-plane request: rendezvous
+        candidates, bounded attempts, Retry-After honored between
+        passes.  Every terminal outcome is recorded into the
+        router-side SLO tracker."""
+        t0 = time.perf_counter()
+        telemetry.counter_add("router.requests")
+        telemetry.counter_add(f"router.requests.{op}")
+        dataset = params.get("dataset")
+        fwd = self._fwd_headers(headers)
+        attempts = 0
+        sheds = []          # (retry_after_s, status, obj, hdrs)
+        last_err = None
+        for sweep in range(2):
+            if sweep:
+                # every candidate shed in sweep 0: honor the smallest
+                # Retry-After (the soonest any replica asked to be
+                # retried), bounded by the per-request budget
+                if not sheds or attempts >= self.retry:
+                    break
+                hint = min(ra for ra, *_ in sheds)
+                await asyncio.sleep(min(max(hint, 0.0), 5.0))
+                sheds = []
+            cands = self._candidates(dataset)
+            if not cands:
+                break
+            for target in cands:
+                if attempts >= self.retry:
+                    break
+                attempts += 1
+                if attempts > 1:
+                    telemetry.counter_add("router.retries")
+                try:
+                    status, obj, h = await self._proxy(
+                        target, "POST", f"/v1/{op}", params, fwd)
+                except OSError as e:
+                    telemetry.counter_add("router.proxy_errors")
+                    telemetry.counter_add("router.reroutes")
+                    self._mark_down(target, e)
+                    last_err = f"{target}: {type(e).__name__}: {e}"
+                    continue
+                if status == 429:
+                    ra = retry_after_from(h, obj)
+                    sheds.append((ra if ra is not None else 0.2,
+                                  status, obj, h))
+                    telemetry.counter_add("router.reroutes")
+                    continue
+                if status == 503:
+                    # draining or failing: pull it (the probe
+                    # restores a live one) and re-route
+                    telemetry.counter_add("router.reroutes")
+                    self._mark_down(target,
+                                    (obj or {}).get("detail", 503))
+                    last_err = f"{target}: 503"
+                    continue
+                # 200, 400, 404, 504...: the client's answer
+                self.slo.record(op, time.perf_counter() - t0,
+                                ok=(status == 200))
+                return status, obj, h
+        self.slo.record(op, time.perf_counter() - t0, ok=False)
+        if sheds:
+            # every candidate shed: the fleet is saturated — tell the
+            # client the LARGEST hint (the honest time until capacity)
+            telemetry.counter_add("router.sheds")
+            ra, status, obj, h = max(sheds, key=lambda s: s[0])
+            return status, obj, h
+        telemetry.counter_add("router.all_down")
+        detail = ("no ready replicas"
+                  if last_err is None else
+                  f"all candidate replicas failed (last: {last_err})")
+        return (503,
+                {"error": "ServeError", "detail": detail,
+                 "retry_after_ms": 1000},
+                {"retry-after": "1"})
+
+    # -- job routing + failover ---------------------------------------------
+    async def _route_job_submit(self, params, headers):
+        dataset = params.get("dataset")
+        fwd = self._fwd_headers(headers)
+        cands = self._candidates(dataset)
+        last = None
+        for target in cands[:max(self.retry, 1)]:
+            try:
+                status, obj, h = await self._proxy(
+                    target, "POST", "/v1/jobs", params, fwd)
+            except OSError as e:
+                telemetry.counter_add("router.proxy_errors")
+                self._mark_down(target, e)
+                continue
+            if status == 200 and isinstance(obj, dict) \
+                    and obj.get("job"):
+                job_id = str(obj["job"])
+                with self._lock:
+                    # journal the spec WITH its id: the failover
+                    # resubmit must resume, not mint a fresh job
+                    self._jobs[job_id] = {**params, "job": job_id}
+                    self._job_owner[job_id] = target
+                return status, obj, h
+            last = (status, obj, h)
+            if status != 503:
+                return last
+        if last is not None:
+            return last
+        return (503, {"error": "ServeError",
+                      "detail": "no ready replicas",
+                      "retry_after_ms": 1000},
+                {"retry-after": "1"})
+
+    async def _route_job_status(self, job_id):
+        with self._lock:
+            owner = self._job_owner.get(job_id)
+            spec = self._jobs.get(job_id)
+        got = None
+        if owner is not None:
+            try:
+                status, obj, h = await self._proxy(
+                    owner, "GET", f"/v1/jobs/{job_id}", None)
+                # an owner that ANSWERS can still have lost the job.
+                # The document of record lives in the SHARED job dir
+                # and outlives its writer, so a respawned owner
+                # happily serves its dead predecessor's last
+                # "running" write: trust a queued/running doc only
+                # when the owner says the job is live IN ITS process
+                # (``live`` explicitly False — absent means an older
+                # replica, keep the old trust-the-answer behavior).
+                # A 404 (no shared dir) or a drain-checkpointed
+                # "interrupted" doc is equally lost — resubmit, the
+                # checkpoint resume loses at most one chunk.
+                lost = (status == 404
+                        or (status == 200 and isinstance(obj, dict)
+                            and (obj.get("state") == "interrupted"
+                                 or (obj.get("state") in
+                                     ("queued", "running")
+                                     and obj.get("live") is False))))
+                if status != 503 and not lost:
+                    return status, obj, h
+                got = (status, obj, h)
+            except OSError as e:
+                telemetry.counter_add("router.proxy_errors")
+                self._mark_down(owner, e)
+        if spec is None:
+            return got if got is not None else (
+                404, {"error": "NotFound"}, {})
+        # the owner is gone: resubmit the journaled spec to a sibling
+        # — same id + shared job dir ⇒ checkpoint resume (≤ 1 chunk
+        # lost), the document of record survives the replica
+        telemetry.counter_add("router.job_failovers")
+        resub = await self._route_job_submit(spec, None)
+        if resub[0] == 200:
+            with self._lock:
+                owner = self._job_owner.get(job_id)
+            if owner is not None:
+                try:
+                    return await self._proxy(
+                        owner, "GET", f"/v1/jobs/{job_id}", None)
+                except OSError:
+                    pass
+        return resub
+
+    # -- lifecycle (the Server skeleton) ------------------------------------
+    def start(self, host="127.0.0.1", port=None) -> int:
+        if self._thread is not None:
+            return self._port
+        if port is None:
+            port = int(_env_num(ROUTER_PORT_ENV, 0))
+        self._thread = threading.Thread(
+            target=self._run_loop, args=(host, int(port)),
+            name="pintfleet-router", daemon=True)
+        self._thread.start()
+        if not self._started.wait(timeout=30):
+            raise RuntimeError("router listener failed to start")
+        self._probe_thread = threading.Thread(
+            target=self._probe_loop, name="pintfleet-probe",
+            daemon=True)
+        self._probe_thread.start()
+        return self._port
+
+    def _run_loop(self, host, port):
+        loop = asyncio.new_event_loop()
+        self._loop = loop
+        asyncio.set_event_loop(loop)
+
+        async def _boot():
+            self._aserver = await asyncio.start_server(
+                self._handle, host, port)
+            self._port = self._aserver.sockets[0].getsockname()[1]
+            telemetry.gauge_set("router.port", self._port)
+            self._started.set()
+
+        try:
+            loop.run_until_complete(_boot())
+            loop.run_forever()
+        finally:
+            try:
+                if self._aserver is not None:
+                    self._aserver.close()
+                    loop.run_until_complete(
+                        self._aserver.wait_closed())
+                pending = [t for t in asyncio.all_tasks(loop)
+                           if not t.done()]
+                for t in pending:
+                    t.cancel()
+                if pending:
+                    loop.run_until_complete(asyncio.gather(
+                        *pending, return_exceptions=True))
+            finally:
+                loop.close()
+
+    def stop(self):
+        self._stop_probe.set()
+        if self._probe_thread is not None:
+            self._probe_thread.join(timeout=5)
+            self._probe_thread = None
+        loop, self._loop = self._loop, None
+        if loop is not None and not loop.is_closed():
+            try:
+                loop.call_soon_threadsafe(loop.stop)
+            except RuntimeError:
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    # -- HTTP plumbing (same wire discipline as the replica) -----------------
+    async def _handle(self, reader, writer):
+        try:
+            while True:
+                line = await reader.readline()
+                if not line or line in (b"\r\n", b"\n"):
+                    return
+                try:
+                    method, path, _ = line.decode(
+                        "latin1").split(None, 2)
+                except ValueError:
+                    return
+                headers = {}
+                while True:
+                    h = await reader.readline()
+                    if h in (b"\r\n", b"\n", b""):
+                        break
+                    k, _, v = h.decode("latin1").partition(":")
+                    headers[k.strip().lower()] = v.strip()
+                n = int(headers.get("content-length", 0) or 0)
+                if n > _MAX_BODY:
+                    return
+                body = await reader.readexactly(n) if n else b""
+                status, payload, ctype, extra = await self._route(
+                    method.upper(), path.split("?", 1)[0], body,
+                    headers)
+                keep = headers.get("connection",
+                                   "keep-alive").lower() != "close"
+                head = [f"HTTP/1.1 {status} "
+                        f"{_REASONS.get(status, 'OK')}",
+                        f"Content-Type: {ctype}",
+                        f"Content-Length: {len(payload)}"]
+                head += [f"{k}: {v}" for k, v in extra]
+                head.append("Connection: "
+                            + ("keep-alive" if keep else "close"))
+                writer.write(("\r\n".join(head) + "\r\n\r\n")
+                             .encode() + payload)
+                await writer.drain()
+                if not keep:
+                    return
+        except (asyncio.IncompleteReadError, ConnectionError,
+                asyncio.CancelledError):
+            pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+    @staticmethod
+    def _json(status, obj, extra=()):
+        return (status, json.dumps(obj).encode(), "application/json",
+                list(extra))
+
+    def _passthrough(self, status, obj, hdrs):
+        """Re-emit a backend response, carrying the headers that
+        matter across a hop (Retry-After, traceparent)."""
+        extra = []
+        for k in ("retry-after", "traceparent", "server-timing"):
+            v = (hdrs or {}).get(k)
+            if v is not None:
+                extra.append((k.title(), v))
+        return self._json(status, obj, extra)
+
+    async def _route(self, method, path, body, headers=None):
+        try:
+            return await self._route_inner(method, path, body,
+                                           headers or {})
+        except (ValueError, KeyError, TypeError) as e:
+            return self._json(400, {"error": "BadRequest",
+                                    "detail": str(e)})
+        except Exception as e:  # noqa: BLE001 — the no-500 contract
+            # holds at the router too: an unexpected failure is a
+            # structured, retryable 503
+            telemetry.counter_add("router.proxy_errors")
+            return self._json(
+                503, {"error": "ServeError",
+                      "detail": f"{type(e).__name__}: {e}",
+                      "retry_after_ms": 1000},
+                [("Retry-After", "1")])
+
+    async def _route_inner(self, method, path, body, headers):
+        path = path.rstrip("/") or "/"
+        if method == "GET":
+            if path == "/healthz":
+                return self._json(200, self._health_doc())
+            if path == "/readyz":
+                with self._lock:
+                    n_ready = sum(r.ready
+                                  for r in self._replicas.values())
+                doc = {"ready": n_ready > 0,
+                       "replicas_ready": n_ready,
+                       "replicas_total": len(self.targets())}
+                if n_ready:
+                    return self._json(200, doc)
+                return self._json(503, doc, [("Retry-After", "1")])
+            if path == "/slo":
+                return self._json(200, self.slo.snapshot())
+            if path == "/metrics":
+                from pint_tpu import metrics_http
+
+                self.slo.snapshot()  # refresh slo.* gauges
+                self._export_gauges()
+                return (200, metrics_http.render_prometheus()
+                        .encode(),
+                        "text/plain; version=0.0.4; charset=utf-8",
+                        [])
+            if path == "/fleet":
+                from pint_tpu.obs import fleet as _fleet
+
+                loop = asyncio.get_running_loop()
+                doc = await loop.run_in_executor(
+                    None, lambda: _fleet.fleet_snapshot(
+                        self.targets()))
+                doc.pop("scrapes", None)  # drill-down is pinttrace's
+                return self._json(200, doc)
+            if path == "/v1/stats":
+                return self._json(200, self._stats_doc())
+            if path == "/":
+                return self._json(200, {"routes": [
+                    "POST /v1/load", "POST /v1/fit",
+                    "POST /v1/residuals", "POST /v1/lnlike",
+                    "POST /v1/jobs", "GET /v1/jobs/<id>",
+                    "GET /healthz", "GET /readyz", "GET /metrics",
+                    "GET /slo", "GET /fleet", "GET /v1/stats",
+                ]})
+            if path.startswith("/v1/jobs/"):
+                return self._passthrough(*await
+                                         self._route_job_status(
+                                             path.rsplit("/", 1)[1]))
+            return self._json(404, {"error": "NotFound"})
+        if method != "POST":
+            return self._json(405, {"error": "MethodNotAllowed"})
+        params = json.loads(body.decode() or "{}")
+        if path == "/v1/load":
+            return await self._broadcast_load(params)
+        if path == "/v1/jobs":
+            return self._passthrough(*await self._route_job_submit(
+                params, headers))
+        if path in tuple(f"/v1/{op}" for op in _OPS):
+            op = path.rsplit("/", 1)[1]
+            return self._passthrough(*await self._route_op(
+                op, params, headers))
+        return self._json(404, {"error": "NotFound"})
+
+    async def _broadcast_load(self, params):
+        """Register a dataset on EVERY ready replica and journal the
+        body — late joiners (restarts, scale-ups) get it replayed
+        before they rejoin rotation."""
+        ds = params.get("dataset")
+        if not ds:
+            return self._json(400, {"error": "BadRequest",
+                                    "detail": "missing 'dataset'"})
+        with self._lock:
+            if ds not in self._datasets:
+                self._ds_order.append(ds)
+            self._datasets[ds] = dict(params)
+        with self._lock:
+            ready = [t for t, r in self._replicas.items() if r.ready]
+        telemetry.counter_add("router.broadcast_loads")
+        results = []
+        info = None
+        for target in ready:
+            try:
+                status, obj, _ = await self._proxy(
+                    target, "POST", "/v1/load", params)
+            except OSError as e:
+                self._mark_down(target, e)
+                results.append({"target": target, "ok": False,
+                                "error": f"{type(e).__name__}"})
+                continue
+            ok = status == 200
+            if ok and info is None:
+                info = obj
+            results.append({"target": target, "ok": ok})
+        n_ok = sum(r["ok"] for r in results)
+        if ready and n_ok == 0:
+            return self._json(503, {"error": "ServeError",
+                                    "detail": "load failed on every "
+                                              "ready replica",
+                                    "replicas": results},
+                              [("Retry-After", "1")])
+        doc = dict(info or {})
+        doc["replicas"] = results
+        doc["journaled"] = True
+        return self._json(200, doc)
+
+    # -- documents ----------------------------------------------------------
+    def _health_doc(self):
+        return {
+            "role": "router",
+            "replicas": self.replica_docs(),
+            "datasets": list(self._ds_order),
+            "jobs_journaled": len(self._jobs),
+            "slo": self.slo.verdict_doc(),
+        }
+
+    def _stats_doc(self):
+        ctr = telemetry.counters()
+        return {
+            "replicas": self.replica_docs(),
+            "datasets": list(self._ds_order),
+            "retry": self.retry,
+            "spread_pending": self.spread_pending,
+            "probe_s": self.probe_s,
+            "slo": self.slo.verdict_doc(),
+            "counters": {k: v for k, v in ctr.items()
+                         if k.startswith("router.")},
+        }

@@ -1,0 +1,515 @@
+"""Replica supervisor: spawn, watch, restart, roll, and scale N
+``pintserve`` processes.
+
+The process-management half of the fleet layer (the router is the
+traffic half; :class:`FleetSupervisor` keeps the router's target list
+current):
+
+- **spawn/monitor** — each replica slot is one ``pintserve``
+  subprocess on its own port, all sharing one job dir (so a sibling
+  can resume any replica's checkpointed jobs) and one compile/AOT
+  artifact dir (so restarts re-warm from serialized executables, not
+  fresh XLA compiles).
+- **restarts with exponential backoff** — a crashed replica is
+  respawned after ``backoff · 2^crashes`` seconds, and a slot that
+  crashes ``$PINT_TPU_FLEET_CRASH_LOOP_K`` times inside the crash
+  window is **quarantined**: pulled from the router and left down for
+  a human — a crash-looping replica forever cycling through rotation
+  is worse than one honestly absent.
+- **rolling deploys** — ``rolling_deploy(new_aot_dir)`` walks the
+  slots one at a time: ``POST /drain`` (the replica flips
+  ``/readyz``, finishes in-flight flushes, checkpoints its running
+  job, exits 0), swap in the new artifact, respawn, wait ready, move
+  on.  With N ≥ 2 replicas the fleet never has zero ready members —
+  measured and returned as ``downtime_s`` (the bench
+  ``rolling_deploy_downtime_s`` series asserts it stays ~0).
+- **autoscaling** — :func:`autoscale_decision` is a pure function of
+  the fleet's queue-depth/shed gauges (scraped from ``/metrics`` via
+  :mod:`pint_tpu.obs.fleet`); the tick applies it within
+  ``[min_replicas, max_replicas]``.
+
+Every ``PINT_TPU_FLEET_*`` knob is host-only: process counts,
+backoffs, and windows shape the harness around the replicas, never a
+traced program.  Telemetry: ``fleet.restarts`` / ``fleet.crash_loops``
+/ ``fleet.deploys`` / ``fleet.drains`` / ``fleet.scale_ups`` /
+``fleet.scale_downs`` counters; ``fleet.replicas`` /
+``fleet.target_replicas`` gauges.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+from pint_tpu import telemetry
+from pint_tpu.serve.client import request_json
+
+__all__ = ["FleetSupervisor", "autoscale_decision", "free_port",
+           "REPLICAS_ENV", "BACKOFF_ENV", "CRASH_LOOP_K_ENV",
+           "MIN_REPLICAS_ENV", "MAX_REPLICAS_ENV", "AUTOSCALE_S_ENV"]
+
+# host-only knobs (lint/static.py HOST_ONLY)
+REPLICAS_ENV = "PINT_TPU_FLEET_REPLICAS"
+BACKOFF_ENV = "PINT_TPU_FLEET_BACKOFF_S"
+CRASH_LOOP_K_ENV = "PINT_TPU_FLEET_CRASH_LOOP_K"
+MIN_REPLICAS_ENV = "PINT_TPU_FLEET_MIN_REPLICAS"
+MAX_REPLICAS_ENV = "PINT_TPU_FLEET_MAX_REPLICAS"
+AUTOSCALE_S_ENV = "PINT_TPU_FLEET_AUTOSCALE_S"
+
+
+def _env_num(name, default):
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+def free_port(host="127.0.0.1") -> int:
+    """An OS-assigned free port (bind-then-close; the tiny reuse race
+    is acceptable for a supervisor that owns its own port space)."""
+    with socket.socket() as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+def autoscale_decision(current, queue_depth, sheds_delta,
+                       min_replicas, max_replicas,
+                       queue_high=32.0, queue_low=2.0) -> int:
+    """Pure scaling policy: the fleet-summed ``serve.queue_depth``
+    gauge and the shed-counter delta since the last tick decide the
+    target replica count.  Sheds mean admission is refusing work NOW
+    (scale up even if the queue gauge looks calm — shed work never
+    queued); a deep fleet queue means the same; a near-empty queue
+    with zero sheds releases one replica per tick (gentle scale-down,
+    never a cliff)."""
+    current = int(current)
+    lo = max(int(min_replicas), 1)
+    hi = max(int(max_replicas), lo)
+    if current < lo:
+        return lo
+    if (sheds_delta > 0 or queue_depth > queue_high) and current < hi:
+        return current + 1
+    if sheds_delta == 0 and queue_depth <= queue_low \
+            and current > lo:
+        return current - 1
+    return min(current, hi)
+
+
+class _Slot:
+    """One replica slot: a port that outlives its processes."""
+
+    __slots__ = ("index", "port", "proc", "aot_dir", "extra_env",
+                 "crashes", "crash_times", "quarantined",
+                 "next_spawn_ts", "expecting_exit", "log_path")
+
+    def __init__(self, index, port, aot_dir=None, extra_env=None,
+                 log_path=None):
+        self.index = index
+        self.port = port
+        self.proc = None
+        self.aot_dir = aot_dir
+        self.extra_env = dict(extra_env or {})
+        self.crashes = 0
+        self.crash_times: list = []
+        self.quarantined = False
+        self.next_spawn_ts = 0.0
+        self.expecting_exit = False
+        self.log_path = log_path
+
+    @property
+    def target(self):
+        return f"127.0.0.1:{self.port}"
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def doc(self):
+        return {"index": self.index, "target": self.target,
+                "alive": self.alive(),
+                "pid": (None if self.proc is None
+                        else self.proc.pid),
+                "crashes": self.crashes,
+                "quarantined": self.quarantined}
+
+
+class FleetSupervisor:
+    """Own N replica subprocesses; keep a :class:`Router` fed with
+    the live target list.
+
+    ``replica_cmd`` is injectable for tests: a callable
+    ``(slot) -> argv`` returning the subprocess command (the default
+    builds the real ``pintserve`` invocation).  ``datasets`` is a
+    list of ``(id, par_path, tim_path_or_None)`` registered at every
+    replica boot via ``--dataset``."""
+
+    def __init__(self, n_replicas=None, datasets=(), aot_dir=None,
+                 job_dir=None, base_env=None, replica_cmd=None,
+                 backoff_s=None, crash_loop_k=None,
+                 crash_window_s=30.0, min_replicas=None,
+                 max_replicas=None, router=None, warm=False,
+                 serve_args=(), log_dir=None, tick_s=0.2,
+                 slot_env=None):
+        self.n_replicas = int(n_replicas if n_replicas is not None
+                              else _env_num(REPLICAS_ENV, 2))
+        self.datasets = list(datasets)
+        self.aot_dir = aot_dir
+        self.job_dir = (job_dir
+                        or tempfile.mkdtemp(prefix="pintfleet_jobs_"))
+        self.base_env = dict(base_env if base_env is not None
+                             else os.environ)
+        self.replica_cmd = replica_cmd or self._default_cmd
+        self.backoff_s = float(backoff_s if backoff_s is not None
+                               else _env_num(BACKOFF_ENV, 0.5))
+        self.crash_loop_k = int(
+            crash_loop_k if crash_loop_k is not None
+            else _env_num(CRASH_LOOP_K_ENV, 3))
+        self.crash_window_s = float(crash_window_s)
+        self.min_replicas = int(
+            min_replicas if min_replicas is not None
+            else _env_num(MIN_REPLICAS_ENV, 1))
+        self.max_replicas = int(
+            max_replicas if max_replicas is not None
+            else _env_num(MAX_REPLICAS_ENV, 8))
+        self.router = router
+        self.warm = bool(warm)
+        self.serve_args = list(serve_args)
+        self.log_dir = log_dir or tempfile.mkdtemp(
+            prefix="pintfleet_logs_")
+        self.tick_s = float(tick_s)
+        #: per-slot-index extra env (chaos uses this to aim a
+        #: PINT_TPU_FAULTS kill at ONE replica)
+        self.slot_env = {int(k): dict(v)
+                         for k, v in (slot_env or {}).items()}
+        self._slots: list = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._monitor = None
+        self._sheds_seen = 0.0
+
+    # -- process plumbing ---------------------------------------------------
+    def _default_cmd(self, slot) -> list:
+        argv = [sys.executable, "-m", "pint_tpu.serve.cli",
+                "--host", "127.0.0.1", "--port", str(slot.port),
+                "--job-dir", self.job_dir]
+        if slot.aot_dir:
+            # --warm alongside --import: over the AOT store the warm
+            # sweep is a cheap pre-arm dress rehearsal of every
+            # registered dataset — it absorbs the serving path's
+            # first-use eager compiles BEFORE the recompile sanitizer
+            # arms, so a steady-state replica really is violation-free
+            argv += ["--import", slot.aot_dir, "--warm"]
+        elif self.warm:
+            argv += ["--warm"]
+        for name, par, tim in self.datasets:
+            spec = f"{name}={par}" + (f",{tim}" if tim else "")
+            argv += ["--dataset", spec]
+        argv += self.serve_args
+        return argv
+
+    def _spawn(self, slot):
+        env = {**self.base_env, **slot.extra_env,
+               "PINT_TPU_SERVE_JOB_DIR": self.job_dir}
+        log = open(os.path.join(
+            self.log_dir, f"replica{slot.index}.log"), "ab")
+        try:
+            slot.proc = subprocess.Popen(
+                self.replica_cmd(slot), env=env,
+                stdout=log, stderr=log,
+                stdin=subprocess.DEVNULL)
+        finally:
+            log.close()  # the child holds its own descriptor
+        slot.expecting_exit = False
+
+    def _notify_router(self):
+        if self.router is not None:
+            self.router.set_targets(self.targets())
+        telemetry.gauge_set("fleet.replicas",
+                            float(len(self._slots)))
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> list:
+        """Spawn every slot; returns the target list.  Readiness is
+        the router's business (probe + journal replay) — callers that
+        need a warm fleet use :meth:`wait_ready`."""
+        with self._lock:
+            for i in range(self.n_replicas):
+                slot = _Slot(i, free_port(), aot_dir=self.aot_dir,
+                             extra_env=self.slot_env.get(i))
+                self._slots.append(slot)
+                self._spawn(slot)
+        telemetry.gauge_set("fleet.target_replicas",
+                            float(self.n_replicas))
+        self._notify_router()
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="pintfleet-monitor",
+            daemon=True)
+        self._monitor.start()
+        return self.targets()
+
+    def stop(self):
+        self._stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=5)
+            self._monitor = None
+        with self._lock:
+            slots = list(self._slots)
+        for slot in slots:
+            if slot.proc is not None and slot.proc.poll() is None:
+                slot.proc.terminate()
+        deadline = time.monotonic() + 5.0
+        for slot in slots:
+            if slot.proc is None:
+                continue
+            left = max(deadline - time.monotonic(), 0.1)
+            try:
+                slot.proc.wait(timeout=left)
+            except subprocess.TimeoutExpired:
+                slot.proc.kill()
+                slot.proc.wait(timeout=5.0)
+
+    def targets(self) -> list:
+        """Routable targets: every non-quarantined slot (a briefly
+        dead slot stays listed — the router's probe marks it down and
+        restores it after the supervisor restart)."""
+        with self._lock:
+            return [s.target for s in self._slots
+                    if not s.quarantined]
+
+    def slot_docs(self) -> list:
+        with self._lock:
+            return [s.doc() for s in self._slots]
+
+    def wait_ready(self, timeout=300.0, min_ready=None) -> bool:
+        """Block until ``min_ready`` (default: all) replicas answer
+        ``/readyz`` 200."""
+        want = (len(self.targets()) if min_ready is None
+                else int(min_ready))
+        deadline = time.monotonic() + float(timeout)
+        while time.monotonic() < deadline:
+            n = 0
+            for t in self.targets():
+                host, _, port = t.rpartition(":")
+                try:
+                    status, _, _ = request_json(
+                        host, int(port), "GET", "/readyz",
+                        timeout=2.0)
+                    n += status == 200
+                except OSError:
+                    pass
+            if n >= want:
+                if self.router is not None:
+                    self.router.probe_now()
+                return True
+            time.sleep(0.25)
+        return False
+
+    # -- crash supervision --------------------------------------------------
+    def _monitor_loop(self):
+        while not self._stop.wait(self.tick_s):
+            try:
+                self.poll()
+            except Exception:  # noqa: BLE001 — the monitor survives
+                pass           # anything a child does
+
+    def poll(self):
+        """One supervision tick: reap crashes, schedule/execute
+        backoff restarts, quarantine crash-loopers."""
+        now = time.monotonic()
+        changed = False
+        with self._lock:
+            slots = list(self._slots)
+        for slot in slots:
+            if slot.quarantined or slot.proc is None:
+                continue
+            rc = slot.proc.poll()
+            if rc is None:
+                continue
+            if slot.expecting_exit:
+                # drain-initiated exit (rolling deploy / scale-down):
+                # the deployer owns the respawn
+                continue
+            # a crash (or an unsupervised clean exit: a replica that
+            # stops serving is down either way)
+            slot.proc = None
+            slot.crashes += 1
+            slot.crash_times = [t for t in slot.crash_times
+                                if now - t <= self.crash_window_s]
+            slot.crash_times.append(now)
+            if len(slot.crash_times) >= self.crash_loop_k:
+                slot.quarantined = True
+                telemetry.counter_add("fleet.crash_loops")
+                changed = True
+                continue
+            slot.next_spawn_ts = now + self.backoff_s * (
+                2.0 ** (len(slot.crash_times) - 1))
+        # execute due restarts
+        for slot in slots:
+            if (slot.proc is None and not slot.quarantined
+                    and now >= slot.next_spawn_ts):
+                self._spawn(slot)
+                telemetry.counter_add("fleet.restarts")
+        if changed:
+            self._notify_router()
+
+    # -- rolling deploy -----------------------------------------------------
+    def drain_slot(self, slot, timeout=120.0) -> bool:
+        """Drain one replica and wait for its process to exit 0.  A
+        connection drop on the drain response counts as success when
+        the process exits — the exit IS the acknowledgement."""
+        from pint_tpu.fleet.client import request_with_retry
+
+        slot.expecting_exit = True
+        telemetry.counter_add("fleet.drains")
+        try:
+            request_with_retry(
+                "127.0.0.1", slot.port, "POST", "/drain",
+                {"timeout_s": timeout}, timeout=timeout,
+                max_attempts=1)
+        except OSError:
+            pass  # judged by the exit below
+        if slot.proc is None:
+            return True
+        try:
+            slot.proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            slot.proc.terminate()
+            try:
+                slot.proc.wait(timeout=10.0)
+            except subprocess.TimeoutExpired:
+                slot.proc.kill()
+                slot.proc.wait(timeout=5.0)
+            return False
+        return True
+
+    def _ready_count(self) -> int:
+        n = 0
+        for t in self.targets():
+            host, _, port = t.rpartition(":")
+            try:
+                status, _, _ = request_json(host, int(port), "GET",
+                                            "/readyz", timeout=1.0)
+                n += status == 200
+            except OSError:
+                pass
+        return n
+
+    def rolling_deploy(self, aot_dir=None, drain_timeout=120.0,
+                       ready_timeout=300.0) -> dict:
+        """Zero-downtime artifact swap: slot by slot — drain (readyz
+        flips, in-flight work finishes, job checkpoints, process
+        exits 0), respawn on the new AOT dir, wait ready, next.
+        Returns the deploy record including measured ``downtime_s``:
+        seconds during the deploy with ZERO ready replicas (0.0 is
+        the zero-downtime claim, sampled at 50 ms)."""
+        t0 = time.monotonic()
+        if aot_dir is not None:
+            self.aot_dir = aot_dir
+        downtime = [0.0]
+        stop_sampler = threading.Event()
+
+        def _sample():
+            last = time.monotonic()
+            while not stop_sampler.wait(0.05):
+                now = time.monotonic()
+                if self._ready_count() == 0:
+                    downtime[0] += now - last
+                last = now
+
+        sampler = threading.Thread(target=_sample, daemon=True)
+        sampler.start()
+        swapped = []
+        try:
+            with self._lock:
+                slots = [s for s in self._slots if not s.quarantined]
+            for slot in slots:
+                drained = self.drain_slot(slot,
+                                          timeout=drain_timeout)
+                slot.proc = None
+                slot.aot_dir = self.aot_dir
+                self._spawn(slot)
+                telemetry.counter_add("fleet.restarts")
+                deadline = time.monotonic() + ready_timeout
+                ready = False
+                while time.monotonic() < deadline:
+                    try:
+                        status, _, _ = request_json(
+                            "127.0.0.1", slot.port, "GET",
+                            "/readyz", timeout=2.0)
+                        if status == 200:
+                            ready = True
+                            break
+                    except OSError:
+                        pass
+                    time.sleep(0.2)
+                if self.router is not None:
+                    self.router.probe_now()
+                swapped.append({"target": slot.target,
+                                "drained": drained,
+                                "ready": ready})
+        finally:
+            stop_sampler.set()
+            sampler.join(timeout=2.0)
+        telemetry.counter_add("fleet.deploys")
+        return {"replicas": swapped,
+                "aot_dir": self.aot_dir,
+                "downtime_s": round(downtime[0], 3),
+                "wall_s": round(time.monotonic() - t0, 3)}
+
+    # -- autoscaling --------------------------------------------------------
+    def scale_to(self, n) -> list:
+        """Grow/shrink to ``n`` slots (grow spawns; shrink drains the
+        highest-index slots and removes them)."""
+        n = max(1, int(n))
+        with self._lock:
+            current = len(self._slots)
+        if n > current:
+            with self._lock:
+                for i in range(current, n):
+                    slot = _Slot(i, free_port(),
+                                 aot_dir=self.aot_dir,
+                                 extra_env=self.slot_env.get(i))
+                    self._slots.append(slot)
+                    self._spawn(slot)
+            telemetry.counter_add("fleet.scale_ups", n - current)
+        elif n < current:
+            with self._lock:
+                victims = self._slots[n:]
+                self._slots = self._slots[:n]
+            for slot in victims:
+                if slot.alive():
+                    self.drain_slot(slot, timeout=60.0)
+            telemetry.counter_add("fleet.scale_downs", current - n)
+        telemetry.gauge_set("fleet.target_replicas", float(n))
+        self._notify_router()
+        return self.targets()
+
+    def autoscale_tick(self) -> dict:
+        """Scrape the fleet, apply :func:`autoscale_decision`, and
+        act on it.  Returns the decision record."""
+        from pint_tpu.obs import fleet as _fleet
+
+        doc = _fleet.fleet_snapshot(self.targets(), timeout=2.0)
+        g = doc.get("gauges") or {}
+        depth = (g.get("pint_tpu_serve_queue_depth") or {}).get(
+            "sum", 0.0)
+        sheds = (doc.get("counters") or {}).get(
+            "pint_tpu_serve_sheds_total", 0.0)
+        delta = max(sheds - self._sheds_seen, 0.0)
+        self._sheds_seen = sheds
+        with self._lock:
+            current = len(self._slots)
+        target = autoscale_decision(
+            current, depth, delta,
+            self.min_replicas, self.max_replicas)
+        if target != current:
+            self.scale_to(target)
+        return {"current": current, "target": target,
+                "queue_depth": depth, "sheds_delta": delta}

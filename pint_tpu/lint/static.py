@@ -277,6 +277,23 @@ HOST_ONLY = {
     # flow through the aval/key machinery like any other TOA table
     "PINT_TPU_CORPUS_REFERENCE", "PINT_TPU_CORPUS_MODE",
     "PINT_TPU_CORPUS_DIR",
+    # fleet orchestration (pint_tpu/fleet/): router placement/retry
+    # policy and supervisor process management are PURE harness — the
+    # router process runs no device code at all, and the supervisor
+    # only spawns/drains/restarts pintserve subprocesses.  Replica
+    # counts, backoffs, probe cadence, and retry budgets shape which
+    # PROCESS serves a request, never a traced program inside one.
+    "PINT_TPU_ROUTER_PORT", "PINT_TPU_ROUTER_HOST",
+    "PINT_TPU_ROUTER_RETRY", "PINT_TPU_ROUTER_PROBE_S",
+    "PINT_TPU_ROUTER_SPREAD_PENDING",
+    "PINT_TPU_FLEET_REPLICAS", "PINT_TPU_FLEET_MIN_REPLICAS",
+    "PINT_TPU_FLEET_MAX_REPLICAS", "PINT_TPU_FLEET_BACKOFF_S",
+    "PINT_TPU_FLEET_CRASH_LOOP_K", "PINT_TPU_FLEET_AUTOSCALE_S",
+    "PINT_TPU_FLEET_RETRIES", "PINT_TPU_FLEET_RETRY_BUDGET_S",
+    # the tokens the regex extracts from the docstring wildcard
+    # spellings ``PINT_TPU_ROUTER_*`` / ``PINT_TPU_FLEET_*`` (prose
+    # about the families); every real member is enumerated above
+    "PINT_TPU_ROUTER_", "PINT_TPU_FLEET_",
 }
 
 #: files where raw jax.jit is the point, not a registry bypass —

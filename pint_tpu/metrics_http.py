@@ -132,15 +132,19 @@ def readiness():
                       "detail": "no serving layer in this process"}
     started = bool(g.get("serve.ready"))
     warm = bool(g.get("serve.aot_warm"))
-    ready = started and warm
+    draining = bool(g.get("serve.draining", 0.0))
+    ready = started and warm and not draining
     # the SLO degrade hook is informational here, NOT a readiness
     # input: a degraded replica still serves (with a tighter queue
     # bound) — pulling it from rotation would turn a partial
     # brown-out into a full outage.  Warmth is a latch on the server
     # side (Server.mark_warm), so ready can never flap 200 -> 503
-    # once warm while the process serves.
+    # once warm while the process serves.  DRAINING is the one
+    # deliberate un-ready transition: /drain flips it so a router
+    # stops placing new work while in-flight requests and job
+    # chunks finish — the rolling-deploy handshake.
     return ready, {"ready": ready, "started": started,
-                   "aot_warm": warm,
+                   "aot_warm": warm, "draining": draining,
                    "queue_depth": g.get("serve.queue_depth", 0),
                    "slo_degraded": bool(g.get("slo.degraded", 0.0))}
 

@@ -462,7 +462,11 @@ def convergence_table(records, run_id=None):
 #: guard_overhead because it jitters about 0 on a quiet host.
 _LOWER_IS_BETTER = {"guard_overhead", "profile_overhead",
                     "cold_start_s", "cold_replica_warm_s",
-                    "slo_p99_ms", "trace_overhead_pct"}
+                    "slo_p99_ms", "trace_overhead_pct",
+                    # seconds with zero ready replicas during a
+                    # rolling deploy (pint_tpu/fleet): 0 is the
+                    # zero-downtime claim
+                    "rolling_deploy_downtime_s"}
 
 #: the suite's known rate-metric series (higher is better — the
 #: sentinel's default direction).  Purely a registration list: the
@@ -489,6 +493,9 @@ RATE_METRICS = frozenset({
     # throughput and the serve-plane soak replay — corpus throughput
     # joins the perf trajectory like any other rate
     "corpus_parity_scenarios_per_sec", "corpus_replay_reqs_per_sec",
+    # the routed fleet's mixed-stream throughput (pint_tpu/fleet):
+    # a placement/re-route regression trips the sentinel
+    "fleet_reqs_per_sec",
 })
 
 #: absolute slack (same units as the metric — percentage points for

@@ -61,6 +61,8 @@ class CoalescingBatcher:
         self._drained: list = []   # (t_done, n_reqs) recent flushes
         self._cond = threading.Condition()
         self._stopped = False
+        self._draining = False
+        self._in_flush = 0         # flushes currently dispatching
         self._thread = threading.Thread(
             target=self._worker, name="pintserve-batcher", daemon=True)
         self._thread.start()
@@ -81,6 +83,13 @@ class CoalescingBatcher:
         with self._cond:
             if self._stopped:
                 raise ServeError("server is shutting down")
+            if self._draining:
+                # a draining replica refuses NEW work with a
+                # structured, immediately-retryable 503: the router's
+                # readyz probe already (or imminently) pulled it from
+                # rotation, so the client's retry lands on a sibling
+                raise ServeError("server is draining",
+                                 retry_after_s=1.0)
             try:
                 admission.admit(self._n_pending, eff_queue_max,
                                 self.flush_ms,
@@ -137,6 +146,25 @@ class CoalescingBatcher:
                 "queue_max_effective":
                     _slo.effective_queue_max(self.queue_max),
             }
+
+    def drain(self, timeout=30.0) -> bool:
+        """Graceful quiesce: stop ADMITTING (new submits get a
+        structured 503 whose retry lands on a sibling via the
+        router), then wait until every already-admitted request has
+        been flushed — served or failed, but never dropped.  Unlike
+        :meth:`stop`, in-flight work completes; unlike a timeout'd
+        stop, nothing is failed wholesale.  Returns True when the
+        queue fully quiesced within ``timeout``."""
+        deadline = time.perf_counter() + float(timeout)
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+            while self._n_pending > 0 or self._in_flush > 0:
+                left = deadline - time.perf_counter()
+                if left <= 0:
+                    return False
+                self._cond.wait(min(left, 0.1))
+        return True
 
     def stop(self, timeout=10.0):
         """Stop the worker; pending requests fail with a structured
@@ -203,6 +231,7 @@ class CoalescingBatcher:
                 else:
                     del self._pending[key]
                 self._n_pending -= len(reqs)
+                self._in_flush += 1
                 telemetry.gauge_set("serve.queue_depth",
                                     self._n_pending)
             try:
@@ -220,7 +249,10 @@ class CoalescingBatcher:
             finally:
                 # flush completed (served or failed): the requests
                 # left the queue either way — that is the drain rate
-                # Retry-After hints are derived from
+                # Retry-After hints are derived from (and the
+                # in-flush count drain() waits on)
                 with self._cond:
                     self._drained.append(
                         (time.perf_counter(), len(reqs)))
+                    self._in_flush -= 1
+                    self._cond.notify_all()

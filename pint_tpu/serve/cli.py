@@ -145,10 +145,13 @@ def main(argv=None):
           f"{'warm' if ready else 'COLD — /readyz will gate'})",
           file=sys.stderr, flush=True)
     try:
-        import time
-
-        while True:
-            time.sleep(3600)
+        # the graceful-shutdown handshake: a POST /drain quiesces the
+        # replica (in-flight flushes served, running job checkpointed)
+        # and sets this event — the process then exits 0, which is
+        # what the fleet supervisor's rolling deploy waits for
+        while not srv.drained.wait(timeout=3600):
+            pass
+        print("pintserve: drained; exiting", file=sys.stderr)
     except KeyboardInterrupt:
         print("pintserve: shutting down", file=sys.stderr)
     finally:

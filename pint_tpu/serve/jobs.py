@@ -58,7 +58,14 @@ import numpy as np
 
 from pint_tpu import telemetry
 
-__all__ = ["JobStore", "run_job", "main"]
+__all__ = ["JobStore", "JobInterrupted", "run_job", "main"]
+
+
+class JobInterrupted(Exception):
+    """A drain stopped the job at a chunk boundary — its checkpoint
+    is on disk, its document state becomes ``interrupted``, and
+    resubmitting the same id (on this replica after restart, or on a
+    sibling sharing the job dir) resumes losing zero chunks."""
 
 #: result payloads are capped like residual payloads — a 10^5-point
 #: grid reports its minimum and shape, not every chi^2
@@ -131,22 +138,27 @@ def _check_grid_params(ds, params):
                 f"{ds.dataset_id!r}")
 
 
-def run_job(registry, doc, job_dir, grid_chunk=16, progress=None):
+def run_job(registry, doc, job_dir, grid_chunk=16, progress=None,
+            should_stop=None):
     """Run one job document to completion (resuming from its
     checkpoint when one exists); returns the result dict.  Raises on
     failure — the worker (or the CLI child) records the failure
-    state."""
+    state.  ``should_stop`` (a callable) is polled at chunk
+    boundaries: returning True raises :class:`JobInterrupted` AFTER
+    the chunk's checkpoint landed — the drain path."""
     kind = doc["kind"]
     spec = doc["spec"]
     if kind == "grid":
-        return _run_grid(registry, doc, job_dir, grid_chunk, progress)
+        return _run_grid(registry, doc, job_dir, grid_chunk, progress,
+                         should_stop)
     if kind == "mcmc":
         return _run_mcmc(registry, doc, job_dir, progress)
     raise ValueError(f"unknown job kind {kind!r} "
                      "(supported: grid, mcmc)")
 
 
-def _run_grid(registry, doc, job_dir, grid_chunk, progress):
+def _run_grid(registry, doc, job_dir, grid_chunk, progress,
+              should_stop=None):
     from pint_tpu import compile_cache as _cc
     from pint_tpu import faults as _faults
     from pint_tpu import guard as _guard
@@ -197,6 +209,11 @@ def _run_grid(registry, doc, job_dir, grid_chunk, progress):
         doc["progress"] = {"done": done, "total": n}
         if progress is not None:
             progress(doc)
+        # drain check AFTER the checkpoint write: an interrupted job
+        # is always resumable from exactly where it stopped
+        if done < n and should_stop is not None and should_stop():
+            raise JobInterrupted(
+                f"drained at {done}/{n} points (checkpointed)")
     finite = np.isfinite(chi2)
     result = {
         "n_points": int(n),
@@ -294,6 +311,9 @@ class JobStore:
         self._q: queue.Queue = queue.Queue()
         self._lock = threading.Lock()
         self._stopped = False
+        self._draining = False
+        self._active = None  # job id the worker is running right now
+        self._pending: set = set()  # ids enqueued, not yet picked up
         self._thread = threading.Thread(
             target=self._worker, name="pintserve-jobs", daemon=True)
         self._thread.start()
@@ -319,6 +339,10 @@ class JobStore:
         story of the work is one trace)."""
         if not isinstance(spec, dict):
             raise ValueError("job spec must be a JSON object")
+        if self._draining:
+            from pint_tpu.serve.state import ServeError
+
+            raise ServeError("server is draining", retry_after_s=1.0)
         kind = spec.get("kind")
         if kind not in ("grid", "mcmc"):
             raise ValueError(
@@ -341,6 +365,7 @@ class JobStore:
                "trace": (existing or {}).get("trace") or trace}
         with self._lock:
             self._write(doc)
+            self._pending.add(job_id)
         self._q.put(job_id)
         telemetry.counter_add("serve.jobs_submitted")
         return doc
@@ -353,19 +378,56 @@ class JobStore:
         except (OSError, ValueError):
             return None
 
+    def is_live(self, job_id) -> bool:
+        """True when THIS process will make progress on the job — it
+        is on the worker right now or waiting in this store's queue.
+        The document of record lives in the (shared) job dir and
+        survives any replica, so a doc saying "running" proves
+        nothing about who is running it: a respawned replica serves
+        the dead process's last write.  This is the disambiguator
+        the router's failover needs."""
+        job_id = str(job_id)
+        with self._lock:
+            return job_id == self._active or job_id in self._pending
+
     def stop(self, timeout=10.0):
         self._stopped = True
         self._q.put(None)
         self._thread.join(timeout=timeout)
+
+    def drain(self, timeout=60.0) -> bool:
+        """Graceful quiesce: refuse new submits, leave queued jobs
+        queued (their documents of record survive on disk — the
+        router's failover or the post-deploy replica resubmits them),
+        and wait for the RUNNING job to stop at its next chunk
+        boundary (:class:`JobInterrupted` after its checkpoint
+        landed).  Returns True when the worker went idle within
+        ``timeout``."""
+        self._draining = True
+        deadline = time.time() + float(timeout)
+        while time.time() < deadline:
+            if self._active is None:
+                return True
+            time.sleep(0.05)
+        return self._active is None
 
     def _worker(self):
         while True:
             job_id = self._q.get()
             if job_id is None or self._stopped:
                 return
+            # claim BEFORE leaving the pending set so is_live never
+            # sees the job in neither place mid-handoff
+            self._active = job_id
+            with self._lock:
+                self._pending.discard(job_id)
+            if self._draining:
+                self._active = None
+                continue  # stays 'queued' on disk: resubmit resumes
             doc = self.status(job_id)
-            if doc is None:
-                continue
+            if doc is None or doc.get("state") == "done":
+                self._active = None
+                continue  # a raced resubmit of a finished job
             doc["state"] = "running"
             doc["started_ts"] = round(time.time(), 3)
             with self._lock:
@@ -380,16 +442,24 @@ class JobStore:
                 attrs["trace"] = doc["trace"]
             try:
                 with telemetry.run_scope("serve.job", **attrs):
-                    result = run_job(self.registry, doc, self.job_dir,
-                                     grid_chunk=self.grid_chunk,
-                                     progress=_progress)
+                    result = run_job(
+                        self.registry, doc, self.job_dir,
+                        grid_chunk=self.grid_chunk,
+                        progress=_progress,
+                        should_stop=lambda: self._draining)
                 doc["state"] = "done"
                 doc["result"] = result
                 telemetry.counter_add("serve.jobs_done")
+            except JobInterrupted as e:  # drained at a chunk
+                doc["state"] = "interrupted"  # boundary: resumable
+                doc["detail"] = str(e)
+                telemetry.counter_add("serve.jobs_interrupted")
             except Exception as e:  # job failure is a document state,
                 doc["state"] = "failed"  # never a worker death
                 doc["error"] = f"{type(e).__name__}: {e}"
                 telemetry.counter_add("serve.jobs_failed")
+            finally:
+                self._active = None
             doc["finished_ts"] = round(time.time(), 3)
             with self._lock:
                 self._write(doc)

@@ -18,6 +18,11 @@ Routes (all JSON):
 - ``POST /v1/lnlike``      — coalesced batched white-noise lnlike.
 - ``POST /v1/jobs``        — submit a grid/mcmc job; ``GET
   /v1/jobs/<id>`` polls it.
+- ``POST /drain``          — graceful quiesce: ``/readyz`` flips to
+  503 (the router pulls the replica), new work gets structured 503s,
+  in-flight flushes finish and the running job checkpoints at its
+  chunk boundary; the CLI process then exits 0.  The rolling-deploy
+  handshake.
 - ``GET /healthz``         — the metrics_http health document plus
   serving state.
 - ``GET /readyz``          — 200 only after the AOT import (or an
@@ -111,6 +116,11 @@ class Server:
         self.aot_report = None
         self._warm = False
         self._warm_lock = threading.Lock()
+        self._drain_lock = threading.Lock()
+        self._draining = False
+        #: set once a POST /drain fully quiesced the replica — the
+        #: CLI waits on it to exit 0 (the rolling-deploy handshake)
+        self.drained = threading.Event()
         self._loop = None
         self._aserver = None
         self._thread = None
@@ -137,21 +147,82 @@ class Server:
             if self.aot_report.get("loaded", 0) > 0:
                 warmed = True
         if warm:
-            ds_id = warm_dataset
-            if ds_id is None:
-                from pint_tpu.compile_cache import WARM_WLS_PAR
+            from pint_tpu import faults as _faults
 
-                ds_id = "_warm"
-                if ds_id not in self.registry.ids():
-                    self.registry.load(ds_id, par=WARM_WLS_PAR,
+            ids = ([warm_dataset] if warm_dataset is not None
+                   else self.registry.ids())
+            # the rehearsal is self-inflicted work: site faults
+            # (kill/slow_flush) must neither fire here nor burn
+            # their after=N budget, or a fault-armed replica dies
+            # warming itself up instead of mid-served-batch
+            with _faults.suspend():
+                if ids:
+                    # warm what this replica will actually serve:
+                    # every registered dataset, all three ops, and
+                    # the grid-job path.  Over an AOT import this is
+                    # the cheap pre-arm dress rehearsal that also
+                    # absorbs the serving path's first-use eager
+                    # compiles — without it an --import replica's
+                    # first real requests compile AFTER the
+                    # sanitizer armed
+                    for ds_id in ids:
+                        warm_serve(self.registry, ds_id,
+                                   self.cfg["max_batch"],
+                                   ops=("fit", "residuals",
+                                        "lnlike"),
+                                   maxiter=3)
+                        self._warm_grid_path(ds_id, progress)
+                else:
+                    # no datasets yet: the synthetic single-program
+                    # warmup keeps a bare `pintserve --warm`
+                    # meaningful (and cheap) without pretending to
+                    # cover real data
+                    from pint_tpu.compile_cache import WARM_WLS_PAR
+
+                    self.registry.load("_warm", par=WARM_WLS_PAR,
                                        toas={"n": 64, "seed": 0})
-            warm_serve(self.registry, ds_id, self.cfg["max_batch"],
-                       ops=("fit",), maxiter=3)
+                    warm_serve(self.registry, "_warm",
+                               self.cfg["max_batch"], ops=("fit",),
+                               maxiter=3)
             warmed = True
         self.mark_warm(warmed)
         telemetry.gauge_set("serve.ready", 1.0)
         self._arm_sanitizer(warmed)
         return self.aot_report
+
+    def _warm_grid_path(self, ds_id, progress=None):
+        """One-point grid job against a throwaway checkpoint dir: the
+        grid path's model snapshot + chunk glue do host-side eager
+        jax ops that compile once per process — without this
+        rehearsal a replica's FIRST real grid job takes those
+        compiles after the sanitizer armed (and pays them inside the
+        job).  Best-effort: a dataset with no free parameters simply
+        skips."""
+        import tempfile
+
+        from pint_tpu.serve import jobs as _jobs
+
+        ds = self.registry.get(ds_id)
+        free = list(getattr(ds.model, "free_params", ()) or ())
+        if not free:
+            return
+        p0 = free[0]
+        v0 = float(ds.model.values[p0])
+        spec = {"kind": "grid", "dataset": ds_id, "params": [p0],
+                "n_steps": 1, "chunk": 1,
+                "axes": {p0: {"start": v0, "stop": v0, "n": 1}}}
+        try:
+            with tempfile.TemporaryDirectory(
+                    prefix="pintserve_warmgrid_") as jd:
+                _jobs.run_job(
+                    self.registry,
+                    {"job": f"_warmgrid_{ds_id}", "kind": "grid",
+                     "spec": spec}, jd, grid_chunk=1)
+            if progress is not None:
+                progress(f"warm grid path ({ds_id})")
+        except Exception as e:
+            if progress is not None:
+                progress(f"warm grid path skipped ({ds_id}): {e}")
 
     @staticmethod
     def _arm_sanitizer(warmed):
@@ -254,6 +325,33 @@ class Server:
             pass
         finally:
             self.stop()
+
+    def drain(self, timeout=60.0) -> dict:
+        """Graceful quiesce (the ``POST /drain`` body, and the
+        rolling-deploy primitive): flip ``serve.draining`` so
+        ``/readyz`` answers 503 and the router pulls this replica
+        from rotation; refuse NEW requests/jobs with structured 503s
+        (their retries land on siblings); wait for every in-flight
+        flush to complete and the running job to checkpoint-stop at
+        its next chunk boundary.  Idempotent.  The listener stays up
+        throughout — health/metrics scrapes and job polls still
+        answer — and the process itself exits via the CLI loop
+        watching :attr:`drained`."""
+        t0 = time.perf_counter()
+        with self._drain_lock:
+            if not self._draining:
+                self._draining = True
+                telemetry.gauge_set("serve.draining", 1.0)
+                telemetry.counter_add("serve.drains")
+        queue_ok = self.batcher.drain(timeout=timeout)
+        jobs_ok = self.jobs.drain(timeout=timeout)
+        doc = {
+            "draining": True,
+            "queue_quiesced": bool(queue_ok),
+            "jobs_quiesced": bool(jobs_ok),
+            "wall_s": round(time.perf_counter() - t0, 3),
+        }
+        return doc
 
     def stop(self):
         """Stop listener, batcher, and job worker (idempotent — a
@@ -381,20 +479,38 @@ class Server:
                     "POST /v1/load", "POST /v1/fit",
                     "POST /v1/residuals", "POST /v1/lnlike",
                     "POST /v1/jobs", "GET /v1/jobs/<id>",
+                    "POST /drain",
                     "GET /healthz", "GET /readyz", "GET /metrics",
                     "GET /slo", "GET /v1/stats",
                 ]})
             if path == "/v1/stats":
                 return self._json(200, self._stats_doc())
             if path.startswith("/v1/jobs/"):
-                doc = self.jobs.status(path.rsplit("/", 1)[1])
+                jid = path.rsplit("/", 1)[1]
+                doc = self.jobs.status(jid)
                 if doc is None:
                     return self._json(404, {"error": "NotFound"})
-                return self._json(200, doc)
+                # "live": will THIS replica progress the job?  The
+                # doc comes from the shared job dir and outlives its
+                # writer, so a dead owner's "running" needs this bit
+                # for the router to tell lost from in-flight
+                return self._json(200,
+                                  {**doc,
+                                   "live": self.jobs.is_live(jid)})
             return self._json(404, {"error": "NotFound"})
         if method != "POST":
             return self._json(405, {"error": "MethodNotAllowed"})
         params = json.loads(body.decode() or "{}")
+        if path == "/drain":
+            loop = asyncio.get_running_loop()
+            doc = await loop.run_in_executor(
+                None, lambda: self.drain(
+                    timeout=float(params.get("timeout_s", 60.0))))
+            # signal the CLI's exit-0 loop only after this handler
+            # has had time to write the response (the callback runs
+            # on this same loop, after the handler resumed + wrote)
+            loop.call_later(0.25, self.drained.set)
+            return self._json(200, doc)
         if path == "/v1/load":
             loop = asyncio.get_running_loop()
             info = await loop.run_in_executor(

@@ -86,6 +86,30 @@ class TestSpecGrammar:
         faults.clear()
         assert faults.active("nan_resid") == {"index": 7}
 
+    def test_suspend_freezes_site_faults_and_budget(self):
+        # inside suspend() a kill site neither fires nor advances its
+        # after=N counter — the serve plane's warm rehearsal depends
+        # on this (a fault-armed replica must die mid-SERVED-batch,
+        # not warming itself up)
+        faults.inject("kill", after=3, site="serve.flush")
+        try:
+            with faults.suspend():
+                for _ in range(10):
+                    faults.maybe_kill("serve.flush")  # would exit
+                faults.maybe_delay("serve.flush")
+            assert faults._site_counts.get("serve.flush", 0) == 0
+            # outside, the counter advances from zero (stay < after)
+            faults.maybe_kill("serve.flush")
+            assert faults._site_counts["serve.flush"] == 1
+            # suspension is re-entrant and restores cleanly
+            with faults.suspend():
+                with faults.suspend():
+                    faults.maybe_kill("serve.flush")
+            assert faults._site_counts["serve.flush"] == 1
+            assert faults._suspended == 0
+        finally:
+            faults.clear()
+
 
 class TestInputFaults:
     def test_nan_resid_structured_error(self):

@@ -1,0 +1,779 @@
+"""Fleet orchestration (pint_tpu/fleet): router placement, retry
+client, drain contract, supervisor crash handling, and the subprocess
+chaos stories.
+
+The tier-1 half runs against FAKE replicas (stdlib HTTP servers with
+scripted behavior) and monkeypatched job bodies, so the placement /
+re-route / drain / crash-loop CONTRACTS are pinned without paying a
+single XLA compile.  The real-subprocess chaos soaks (kill mid-batch
+→ re-route with zero client 5xx, checkpointed-job failover to a
+sibling, rolling deploy under load) run the full
+:func:`pint_tpu.fleet.chaos.chaos_soak` and are ``slow``-marked —
+``bench fleet_reqs_per_sec`` measures the same harness's throughput
+claims.
+"""
+
+import http.server
+import json
+import os
+import socket
+import sys
+import threading
+import time
+
+import pytest
+
+import pint_tpu  # noqa: F401  (x64 + cpu platform via conftest)
+from pint_tpu import telemetry
+from pint_tpu.fleet.client import (
+    RetryClient,
+    request_with_retry,
+    retry_after_from,
+)
+from pint_tpu.fleet.router import Router, rendezvous_order
+from pint_tpu.fleet.supervisor import (
+    FleetSupervisor,
+    autoscale_decision,
+    free_port,
+)
+from pint_tpu.serve.client import request_json
+
+
+# ---------------------------------------------------------------------------
+# fake replica: a scripted stdlib HTTP server
+
+
+class FakeReplica:
+    """A scripted backend: enough of the replica surface (/readyz,
+    /v1/load, /v1/{op}, /v1/jobs, /drain) for router contract tests,
+    with per-instance switches for shed/fail behavior and a full
+    request log."""
+
+    def __init__(self, name, port=None):
+        self.name = name
+        self.ready = True
+        self.shed = False            # 429 every data-plane request
+        self.fail_loads = False
+        self.retry_after_s = 1
+        self.requests = []           # (method, path, body_dict)
+        self.datasets = []
+        self.jobs = {}
+        self.port = port or free_port()
+        fake = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, status, obj, extra=()):
+                payload = json.dumps(obj).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length",
+                                 str(len(payload)))
+                for k, v in extra:
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                fake.requests.append(("GET", self.path, None))
+                if self.path == "/readyz":
+                    if fake.ready:
+                        self._send(200, {"ready": True})
+                    else:
+                        self._send(503, {"ready": False},
+                                   [("Retry-After", "1")])
+                    return
+                if self.path.startswith("/v1/jobs/"):
+                    jid = self.path.rsplit("/", 1)[1]
+                    doc = fake.jobs.get(jid)
+                    if doc is None:
+                        self._send(404, {"error": "NotFound"})
+                    else:
+                        self._send(200, doc)
+                    return
+                self._send(404, {"error": "NotFound"})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0) or 0)
+                body = json.loads(self.rfile.read(n) or b"{}")
+                fake.requests.append(("POST", self.path, body))
+                if self.path == "/v1/load":
+                    if fake.fail_loads:
+                        self._send(503, {"error": "ServeError"},
+                                   [("Retry-After", "1")])
+                        return
+                    fake.datasets.append(body.get("dataset"))
+                    self._send(200, {"dataset": body.get("dataset"),
+                                     "status": "ok"})
+                    return
+                if self.path == "/v1/jobs":
+                    jid = str(body.get("job") or "j1")
+                    doc = {"job": jid, "state": "done",
+                           "owner": fake.name,
+                           "spec": body}
+                    fake.jobs[jid] = doc
+                    self._send(200, doc)
+                    return
+                if self.path == "/drain":
+                    fake.ready = False
+                    self._send(200, {"draining": True})
+                    return
+                if fake.shed:
+                    self._send(
+                        429,
+                        {"error": "Shed", "retry_after_ms":
+                         int(fake.retry_after_s * 1e3)},
+                        [("Retry-After",
+                          str(fake.retry_after_s))])
+                    return
+                self._send(200, {"status": "ok",
+                                 "replica": fake.name})
+
+        self._httpd = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", self.port), Handler)
+        self._httpd.allow_reuse_address = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    @property
+    def target(self):
+        return f"127.0.0.1:{self.port}"
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+@pytest.fixture
+def fakes():
+    made = []
+
+    def make(name, **kw):
+        f = FakeReplica(name, **kw)
+        made.append(f)
+        return f
+
+    yield make
+    for f in made:
+        try:
+            f.stop()
+        except Exception:
+            pass
+
+
+@pytest.fixture
+def router_of():
+    routers = []
+
+    def make(targets, **kw):
+        kw.setdefault("probe_s", 30.0)  # tests drive probe_now()
+        r = Router(targets=targets, **kw)
+        r.start(port=0)
+        routers.append(r)
+        return r
+
+    yield make
+    for r in routers:
+        r.stop()
+
+
+# ---------------------------------------------------------------------------
+# rendezvous + retry client
+
+
+class TestRendezvous:
+    def test_stable_and_minimal_rehoming(self):
+        targets = [f"127.0.0.1:{8000 + i}" for i in range(5)]
+        order = rendezvous_order("psrA", targets)
+        assert sorted(order) == sorted(targets)
+        assert order == rendezvous_order("psrA", list(targets))
+        # removing one target must not reorder the survivors — that
+        # is the property that keeps every OTHER replica's warm LRU
+        dead = order[2]
+        survivors = rendezvous_order(
+            "psrA", [t for t in targets if t != dead])
+        assert survivors == [t for t in order if t != dead]
+
+    def test_different_datasets_spread(self):
+        targets = [f"127.0.0.1:{8000 + i}" for i in range(4)]
+        owners = {rendezvous_order(f"psr{i}", targets)[0]
+                  for i in range(32)}
+        assert len(owners) > 1  # hashing, not a constant
+
+
+class TestRetryClient:
+    def test_retry_after_from_prefers_body_ms(self):
+        assert retry_after_from({"retry-after": "3"},
+                                {"retry_after_ms": 250}) == 0.25
+        assert retry_after_from({"retry-after": "3"}, {}) == 3.0
+        assert retry_after_from({}, None) is None
+
+    def test_retries_shed_until_ok(self, fakes):
+        f = fakes("a")
+        f.shed = True
+        f.retry_after_s = 0.01
+        flip = threading.Timer(0.15, lambda: setattr(
+            f, "shed", False))
+        flip.start()
+        try:
+            c = RetryClient("127.0.0.1", f.port, max_attempts=20,
+                            budget_s=10.0, backoff_s=0.01)
+            status, obj, _ = c.post("/v1/fit", {"dataset": "d"})
+            c.close()
+        finally:
+            flip.cancel()
+        assert status == 200 and obj["status"] == "ok"
+        n_shed = sum(1 for m, p, _ in f.requests
+                     if p == "/v1/fit") - 1
+        assert n_shed >= 1  # it actually retried through sheds
+
+    def test_gives_up_bounded(self, fakes):
+        f = fakes("a")
+        f.shed = True
+        f.retry_after_s = 0.01
+        c = RetryClient("127.0.0.1", f.port, max_attempts=3,
+                        budget_s=5.0, backoff_s=0.01)
+        status, _, _ = c.post("/v1/fit", {"dataset": "d"})
+        c.close()
+        assert status == 429
+        assert sum(1 for _, p, _ in f.requests
+                   if p == "/v1/fit") == 3
+
+    def test_transport_error_raises_after_budget(self):
+        port = free_port()  # nothing listens here
+        with pytest.raises(OSError):
+            request_with_retry("127.0.0.1", port, "POST", "/v1/fit",
+                               {"dataset": "d"}, max_attempts=2,
+                               backoff_s=0.01)
+
+
+# ---------------------------------------------------------------------------
+# router contracts (fake backends, no jax)
+
+
+class TestRouter:
+    def test_routes_to_rendezvous_owner_and_gates_on_ready(
+            self, fakes, router_of):
+        a, b = fakes("a"), fakes("b")
+        r = router_of([a.target, b.target])
+        r.probe_now()
+        owner_t = rendezvous_order("psrX", [a.target, b.target])[0]
+        owner = a if owner_t == a.target else b
+        other = b if owner is a else a
+        s, obj, _ = request_json("127.0.0.1", r._port, "POST",
+                                 "/v1/fit", {"dataset": "psrX"})
+        assert s == 200 and obj["replica"] == owner.name
+        # owner goes unready -> traffic moves to the sibling
+        owner.ready = False
+        r.probe_now()
+        s, obj, _ = request_json("127.0.0.1", r._port, "POST",
+                                 "/v1/fit", {"dataset": "psrX"})
+        assert s == 200 and obj["replica"] == other.name
+
+    def test_shed_reroutes_to_sibling(self, fakes, router_of):
+        a, b = fakes("a"), fakes("b")
+        r = router_of([a.target, b.target])
+        r.probe_now()
+        owner_t = rendezvous_order("psrX", [a.target, b.target])[0]
+        owner = a if owner_t == a.target else b
+        other = b if owner is a else a
+        owner.shed = True
+        s, obj, _ = request_json("127.0.0.1", r._port, "POST",
+                                 "/v1/fit", {"dataset": "psrX"})
+        assert s == 200 and obj["replica"] == other.name
+
+    def test_all_shed_returns_largest_retry_after(self, fakes,
+                                                  router_of):
+        a, b = fakes("a"), fakes("b")
+        a.shed = b.shed = True
+        a.retry_after_s = 2
+        b.retry_after_s = 5
+        r = router_of([a.target, b.target], retry=2)
+        r.probe_now()
+        s, obj, h = request_json("127.0.0.1", r._port, "POST",
+                                 "/v1/fit", {"dataset": "psrX"})
+        assert s == 429
+        assert obj["retry_after_ms"] == 5000
+        assert h.get("retry-after") == "5"
+
+    def test_all_down_is_structured_503_never_500(self, fakes,
+                                                  router_of):
+        a = fakes("a")
+        a.ready = False
+        r = router_of([a.target])
+        r.probe_now()
+        s, obj, h = request_json("127.0.0.1", r._port, "POST",
+                                 "/v1/fit", {"dataset": "psrX"})
+        assert s == 503
+        assert obj["error"] == "ServeError"
+        assert obj["retry_after_ms"] == 1000
+        s, obj, _ = request_json("127.0.0.1", r._port, "GET",
+                                 "/readyz")
+        assert s == 503
+
+    def test_broadcast_load_and_journal_replay(self, fakes,
+                                               router_of):
+        a, b = fakes("a"), fakes("b")
+        r = router_of([a.target, b.target])
+        r.probe_now()
+        s, obj, _ = request_json(
+            "127.0.0.1", r._port, "POST", "/v1/load",
+            {"dataset": "psrX", "par": "fake.par"})
+        assert s == 200 and obj["journaled"] is True
+        assert a.datasets == ["psrX"] and b.datasets == ["psrX"]
+        # replica death (connection refused) -> journal replay on the
+        # replacement process before it rejoins rotation
+        port = a.port
+        a.stop()
+        r.probe_now()
+        docs = {d["target"]: d for d in r.replica_docs()}
+        assert docs[a.target]["ready"] is False
+        a2 = fakes("a2", port=port)
+        r.probe_now()
+        assert a2.datasets == ["psrX"]  # replayed before ready
+        docs = {d["target"]: d for d in r.replica_docs()}
+        assert docs[a2.target]["ready"] is True
+
+    def test_job_failover_resubmits_to_sibling(self, fakes,
+                                               router_of):
+        a, b = fakes("a"), fakes("b")
+        r = router_of([a.target, b.target])
+        r.probe_now()
+        spec = {"dataset": "psrX", "kind": "grid", "job": "jf1",
+                "params": ["F0"], "values": [[1.0]]}
+        s, obj, _ = request_json("127.0.0.1", r._port, "POST",
+                                 "/v1/jobs", spec)
+        assert s == 200
+        owner = a if obj["owner"] == "a" else b
+        sibling = b if owner is a else a
+        owner.stop()
+        s, obj, _ = request_json("127.0.0.1", r._port, "GET",
+                                 "/v1/jobs/jf1")
+        assert s == 200
+        assert obj["owner"] == sibling.name
+        resub = [body for m, p, body in sibling.requests
+                 if p == "/v1/jobs"]
+        assert resub and resub[-1]["job"] == "jf1"
+
+    def test_job_failover_when_owner_forgot_the_job(self, fakes,
+                                                    router_of):
+        # a deploy-respawned owner is ALIVE but has a fresh in-memory
+        # job store: it answers 404.  The router must treat that as
+        # "the owner lost the job" and resubmit the journaled spec to
+        # a sibling — returning the 404 verbatim leaves the client
+        # polling a stale doc forever (the acceptance-soak stall)
+        a, b = fakes("a"), fakes("b")
+        r = router_of([a.target, b.target])
+        r.probe_now()
+        spec = {"dataset": "psrX", "kind": "grid", "job": "jf2",
+                "params": ["F0"], "values": [[1.0]]}
+        s, obj, _ = request_json("127.0.0.1", r._port, "POST",
+                                 "/v1/jobs", spec)
+        assert s == 200
+        owner = a if obj["owner"] == "a" else b
+        sibling = b if owner is a else a
+        owner.jobs.clear()  # same process alive, job store fresh
+        s, obj, _ = request_json("127.0.0.1", r._port, "GET",
+                                 "/v1/jobs/jf2")
+        # the journaled spec was resubmitted (rendezvous decides to
+        # whom — the respawned owner itself is a fine home: it
+        # resumes from the shared checkpoint) and the doc of record
+        # is live again, not a stale 404
+        assert s == 200
+        assert obj["job"] == "jf2" and obj.get("state")
+        resubs = [body for f in (owner, sibling)
+                  for m, p, body in f.requests
+                  if p == "/v1/jobs" and body.get("job") == "jf2"]
+        assert len(resubs) >= 2  # original submit + failover resubmit
+
+    def test_job_failover_on_stale_running_doc(self, fakes,
+                                               router_of):
+        # the shared-job-dir stall: the doc of record outlives its
+        # writer, so a kill-respawned owner serves its dead
+        # predecessor's last "running" write forever.  The owner
+        # saying live=False is the disambiguator — the router must
+        # resubmit, not trust the zombie doc
+        a, b = fakes("a"), fakes("b")
+        r = router_of([a.target, b.target])
+        r.probe_now()
+        spec = {"dataset": "psrX", "kind": "grid", "job": "jl1",
+                "params": ["F0"], "values": [[1.0]]}
+        s, obj, _ = request_json("127.0.0.1", r._port, "POST",
+                                 "/v1/jobs", spec)
+        assert s == 200
+        owner = a if obj["owner"] == "a" else b
+        sibling = b if owner is a else a
+        owner.jobs["jl1"] = {"job": "jl1", "state": "running",
+                             "progress": {"done": 2, "total": 4},
+                             "owner": owner.name, "live": False}
+        s, obj, _ = request_json("127.0.0.1", r._port, "GET",
+                                 "/v1/jobs/jl1")
+        assert s == 200
+        resubs = [body for f in (owner, sibling)
+                  for m, p, body in f.requests
+                  if p == "/v1/jobs" and body.get("job") == "jl1"]
+        assert len(resubs) >= 2
+        # but a doc the owner IS progressing (live True, or a replica
+        # too old to say) is returned as-is — no spurious resubmit
+        # (the resubmit may have rehomed the job: stamp both fakes)
+        for f in (owner, sibling):
+            f.jobs["jl1"] = {"job": "jl1", "state": "running",
+                             "owner": f.name, "live": True}
+        n0 = len([1 for f in (owner, sibling)
+                  for m, p, body in f.requests if p == "/v1/jobs"])
+        s, obj, _ = request_json("127.0.0.1", r._port, "GET",
+                                 "/v1/jobs/jl1")
+        assert s == 200 and obj["state"] == "running"
+        n1 = len([1 for f in (owner, sibling)
+                  for m, p, body in f.requests if p == "/v1/jobs"])
+        assert n1 == n0
+
+    def test_fleet_and_health_docs(self, fakes, router_of):
+        a = fakes("a")
+        r = router_of([a.target])
+        r.probe_now()
+        s, obj, _ = request_json("127.0.0.1", r._port, "GET",
+                                 "/healthz")
+        assert s == 200 and obj["role"] == "router"
+        s, obj, _ = request_json("127.0.0.1", r._port, "GET", "/slo")
+        assert s == 200 and "windows" in obj
+
+
+# ---------------------------------------------------------------------------
+# supervisor (stub replica commands, no jax in children)
+
+
+def _stub_cmd(body):
+    return [sys.executable, "-c", body]
+
+
+class TestSupervisor:
+    def test_restarts_crashed_replica(self):
+        sup = FleetSupervisor(
+            n_replicas=1,
+            replica_cmd=lambda s: _stub_cmd(
+                "import time; time.sleep(60)"),
+            backoff_s=0.01, tick_s=0.02)
+        try:
+            sup.start()
+            slot = sup._slots[0]
+            pid = slot.proc.pid
+            slot.proc.kill()
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                if slot.proc is not None \
+                        and slot.proc.poll() is None \
+                        and slot.proc.pid != pid:
+                    break
+                time.sleep(0.05)
+            assert slot.proc is not None and slot.proc.pid != pid
+            assert slot.crashes == 1
+            assert not slot.quarantined
+        finally:
+            sup.stop()
+
+    def test_crash_loop_quarantines_after_k(self):
+        c0 = telemetry.counter_get("fleet.crash_loops")
+        sup = FleetSupervisor(
+            n_replicas=2,
+            replica_cmd=lambda s: _stub_cmd(
+                "raise SystemExit(1)" if s.index == 0
+                else "import time; time.sleep(60)"),
+            backoff_s=0.01, crash_loop_k=3, crash_window_s=30.0,
+            tick_s=0.02)
+        try:
+            sup.start()
+            bad, good = sup._slots
+            deadline = time.time() + 15
+            while time.time() < deadline and not bad.quarantined:
+                time.sleep(0.05)
+            assert bad.quarantined, bad.doc()
+            assert bad.crashes >= 3
+            # quarantined slot leaves the routable target list; the
+            # healthy sibling stays
+            assert sup.targets() == [good.target]
+            assert telemetry.counter_get("fleet.crash_loops") > c0
+        finally:
+            sup.stop()
+
+    def test_expected_exit_is_not_a_crash(self):
+        sup = FleetSupervisor(
+            n_replicas=1,
+            replica_cmd=lambda s: _stub_cmd(
+                "import time; time.sleep(60)"),
+            backoff_s=0.01, tick_s=0.02)
+        try:
+            sup.start()
+            slot = sup._slots[0]
+            slot.expecting_exit = True
+            slot.proc.terminate()
+            slot.proc.wait(timeout=10)
+            time.sleep(0.3)  # give the monitor ticks a chance
+            assert slot.crashes == 0
+            assert not slot.quarantined
+        finally:
+            sup.stop()
+
+    def test_autoscale_decision_policy(self):
+        # sheds force a scale-up even with a calm queue gauge
+        assert autoscale_decision(2, 0.0, 5, 1, 8) == 3
+        # deep fleet queue scales up, bounded by the ceiling
+        assert autoscale_decision(2, 100.0, 0, 1, 8) == 3
+        assert autoscale_decision(8, 100.0, 9, 1, 8) == 8
+        # idle fleet releases one replica per tick, floored
+        assert autoscale_decision(3, 0.0, 0, 2, 8) == 2
+        assert autoscale_decision(2, 0.0, 0, 2, 8) == 2
+        # mid-load holds steady
+        assert autoscale_decision(2, 10.0, 0, 1, 8) == 2
+
+
+# ---------------------------------------------------------------------------
+# drain contract (real Server, no compiles; fake job bodies)
+
+
+class TestDrain:
+    def test_drain_flips_readyz_refuses_work_and_signals_exit(self):
+        from pint_tpu import metrics_http
+        from pint_tpu.serve.server import Server
+
+        srv = Server(flush_ms=5, max_batch=4, queue_max=16,
+                     deadline_ms=0)
+        port = srv.start(port=0)
+        try:
+            # warm latch WITHOUT compiling: the readiness gates are
+            # gauges, and this test is about the drain transition
+            srv.mark_warm(True)
+            telemetry.gauge_set("serve.ready", 1.0)
+            s, _, _ = request_json("127.0.0.1", port, "GET",
+                                   "/readyz")
+            assert s == 200
+            s, doc, _ = request_json("127.0.0.1", port, "POST",
+                                     "/drain", {"timeout_s": 5})
+            assert s == 200
+            assert doc["draining"] is True
+            assert doc["queue_quiesced"] is True
+            assert doc["jobs_quiesced"] is True
+            # readiness flipped: the ONE deliberate un-ready move
+            assert telemetry.gauges().get("serve.draining") == 1.0
+            ready, rdoc = metrics_http.readiness()
+            assert ready is False and rdoc["draining"] is True
+            s, _, h = request_json("127.0.0.1", port, "GET",
+                                   "/readyz")
+            assert s == 503
+            # new work refused with a structured, retryable error
+            # (a stub registry entry so admission reaches the DRAINED
+            # batcher instead of 400ing on the unknown dataset)
+            class _M:
+                values = {}
+
+            class _D:
+                dataset_id = "d"
+                model = _M()
+                noise_owned = frozenset()
+                kind = "single"
+                bucket = 64
+                structure = "iso"
+
+            srv.registry._datasets["d"] = _D()
+            s, obj, _ = request_json("127.0.0.1", port, "POST",
+                                     "/v1/fit", {"dataset": "d"})
+            assert s == 503 and obj["error"] == "ServeError"
+            # the CLI's exit-0 handshake fires after the response
+            assert srv.drained.wait(timeout=5)
+        finally:
+            telemetry.gauge_set("serve.draining", 0.0)
+            srv.stop()
+
+    def test_drain_during_active_job_checkpoints_then_interrupts(
+            self, tmp_path, monkeypatch):
+        """Satellite contract: a drain while a grid job is mid-run
+        stops the job at a CHUNK BOUNDARY (checkpoint already on
+        disk), marks it interrupted (resumable), and quiesces — the
+        job body here is a stand-in honoring the same
+        progress/should_stop protocol as `_run_grid`, so the
+        JobStore plumbing is pinned without an XLA compile; the
+        slow-marked chaos soak runs the real grid."""
+        from pint_tpu.serve import jobs as sjobs
+
+        ckpt = tmp_path / "dr1.ckpt"
+        started = threading.Event()
+
+        def fake_run_job(registry, doc, job_dir, grid_chunk=16,
+                         progress=None, should_stop=None):
+            for i in range(200):
+                time.sleep(0.01)
+                ckpt.write_text(str(i + 1))  # the chunk checkpoint
+                doc["progress"] = {"done": i + 1, "total": 200}
+                if progress is not None:
+                    progress(doc)
+                started.set()
+                if should_stop is not None and should_stop():
+                    raise sjobs.JobInterrupted(
+                        f"drained at {i + 1}/200 (checkpointed)")
+            return {"state": "done"}
+
+        monkeypatch.setattr(sjobs, "run_job", fake_run_job)
+
+        class _FakeModel:
+            free_params = ("F0",)
+
+        class _FakeDs:
+            model = _FakeModel()
+            dataset_id = "d"
+
+        class _FakeRegistry:
+            def get(self, name):
+                return _FakeDs()
+
+        store = sjobs.JobStore(_FakeRegistry(),
+                               job_dir=str(tmp_path))
+        try:
+            doc = store.submit({"kind": "grid", "dataset": "d",
+                                "job": "dr1", "params": ["F0"],
+                                "values": [[1.0]]})
+            assert started.wait(timeout=10)
+            c0 = telemetry.counter_get("serve.jobs_interrupted")
+            assert store.drain(timeout=10) is True
+            doc = store.status("dr1")
+            assert doc["state"] == "interrupted"
+            assert "checkpointed" in doc["detail"]
+            assert ckpt.exists()
+            done = doc["progress"]["done"]
+            assert int(ckpt.read_text()) == done  # boundary, not mid
+            assert telemetry.counter_get(
+                "serve.jobs_interrupted") == c0 + 1
+            # draining store refuses new submits
+            from pint_tpu.serve.state import ServeError
+
+            with pytest.raises(ServeError):
+                store.submit({"kind": "grid", "dataset": "d",
+                              "params": ["F0"], "values": [[1.0]]})
+        finally:
+            store.stop()
+
+    def test_stale_running_doc_is_not_live_in_a_fresh_store(
+            self, tmp_path, monkeypatch):
+        """The job document of record lives in the SHARED job dir and
+        survives the process: after a hard kill, the respawned
+        replica's store still serves its dead predecessor's last
+        "running" write.  `is_live` is the disambiguator the router's
+        failover keys on — a fresh store must report live=False for a
+        doc it will never progress, and live=True for one it owns."""
+        import json as _json
+
+        from pint_tpu.serve import jobs as sjobs
+
+        # the dead predecessor's last write, straight onto disk
+        (tmp_path / "ghost.json").write_text(_json.dumps(
+            {"job": "ghost", "kind": "grid", "state": "running",
+             "progress": {"done": 2, "total": 8}}))
+
+        hold = threading.Event()
+        started = threading.Event()
+
+        def fake_run_job(registry, doc, job_dir, grid_chunk=16,
+                         progress=None, should_stop=None):
+            started.set()
+            hold.wait(timeout=30)
+            return {"state": "done"}
+
+        monkeypatch.setattr(sjobs, "run_job", fake_run_job)
+
+        class _FakeModel:
+            free_params = ("F0",)
+
+        class _FakeDs:
+            model = _FakeModel()
+            dataset_id = "d"
+
+        class _FakeRegistry:
+            def get(self, name):
+                return _FakeDs()
+
+        store = sjobs.JobStore(_FakeRegistry(),
+                               job_dir=str(tmp_path))
+        try:
+            doc = store.status("ghost")
+            assert doc is not None and doc["state"] == "running"
+            assert store.is_live("ghost") is False
+            # a job THIS store owns is live while active on the worker
+            store.submit({"kind": "grid", "dataset": "d",
+                          "job": "own1", "params": ["F0"],
+                          "values": [[1.0]]})
+            assert started.wait(timeout=10)
+            assert store.is_live("own1") is True
+        finally:
+            hold.set()
+            store.stop()
+
+
+# ---------------------------------------------------------------------------
+# the real thing: subprocess chaos soaks (slow — bench fleet measures
+# the same harness's throughput)
+
+
+def _soak_env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+class TestChaosSoak:
+    def test_kill_midbatch_reroutes_and_job_fails_over(self):
+        """2 real replicas; the rendezvous owner of the first
+        dataset is killed mid-batch by the injected serve.flush
+        fault while a checkpointed grid job runs on it.  Zero 5xx
+        reaches the client, the supervisor restarts the victim, the
+        job finishes on a sibling via the router's failover resubmit,
+        and the armed sanitizer reports zero violations fleet-wide."""
+        from pint_tpu.fleet.chaos import chaos_soak
+
+        stats = chaos_soak(n_replicas=2, n_requests=80,
+                           classes=("spin",), kill=True,
+                           kill_after=4, deploy=False, job=True,
+                           grid_points=16, job_chunk=4)
+        assert stats["client_5xx"] == 0, stats["statuses"]
+        assert stats["kill"]["crashes"] >= 1, stats["kill"]
+        assert stats["router_counters"].get(
+            "router.proxy_errors", 0) >= 1
+        job = stats.get("job") or {}
+        assert job.get("state") == "done", job
+        assert stats["sanitizer_violations"] == 0, stats
+        assert stats["errors"] == 0, stats["statuses"]
+
+    def test_acceptance_soak_rolling_deploy_under_load(self):
+        """4 replicas, rolling deploy mid-stream AND a replica kill:
+        the ISSUE's acceptance soak.  Zero 5xx, SLO verdict not
+        violated, zero sanitizer violations, near-zero deploy
+        downtime.  The ≥2.5x scale-out throughput claim needs real
+        parallel hardware — bench fleet_reqs_per_sec measures it;
+        here it is asserted only when this host has the cores."""
+        from pint_tpu.fleet.chaos import chaos_soak
+
+        fleet = chaos_soak(n_replicas=4, n_requests=160,
+                           classes=("spin", "binary"), kill=True,
+                           kill_after=6, deploy=True, job=True,
+                           slo_p99_ms=5000.0, slo_avail=0.99)
+        assert fleet["client_5xx"] == 0, fleet["statuses"]
+        assert fleet["sanitizer_violations"] == 0, fleet
+        assert fleet["slo"]["verdict"] != "violated", fleet["slo"]
+        deploy = fleet.get("deploy") or {}
+        assert deploy.get("replicas"), deploy
+        assert all(r["ready"] for r in deploy["replicas"]), deploy
+        # zero-downtime: with >= 2 live replicas a serial drain must
+        # never leave the fleet empty
+        assert deploy.get("downtime_s", 0.0) <= 1.0, deploy
+        job = fleet.get("job") or {}
+        assert job.get("state") == "done", job
+        if (os.cpu_count() or 1) >= 4:
+            single = chaos_soak(n_replicas=1, n_requests=160,
+                                classes=("spin", "binary"),
+                                kill=False, deploy=False, job=False)
+            assert fleet["rps"] >= 2.5 * single["rps"], \
+                (fleet["rps"], single["rps"])
