@@ -1697,6 +1697,119 @@ def bench_trace_overhead(jnp, backend):
     })
 
 
+def bench_stream(jnp, backend):
+    """The streaming append path's headline A/B (docs/streaming.md):
+    a simulated multi-night campaign — N=5000 base GLS fit, then 10
+    nights x ~25 TOAs absorbed through the rank-k Woodbury
+    ``append_refit`` — against a from-scratch prepare+fit over the
+    same final data.  Night 0 is the warm append (the stream
+    capture/delta/refit programs compile there, recorded in the
+    cold/warm split); the steady-state latency is the median of the
+    remaining nights, every one of which must stay on the incremental
+    path (same bucket, zero new programs).  The cold arm is the
+    serve-plane reload a non-streaming deployment pays per night:
+    re-read the tim backlog (parse + posvels), re-prepare, refit —
+    through the ALREADY-COMPILED bucket programs, so no compile
+    lands in either timed number.
+    Emits two series: ``append_refit_speedup`` (cold/append, the
+    >=10x ROADMAP acceptance rides ``vs_baseline``) and
+    ``append_latency_ms`` (lower is better)."""
+    from pint_tpu.fitter import GLSFitter
+    from pint_tpu.models.builder import get_model
+    from pint_tpu.simulation import make_fake_toas_uniform
+    from pint_tpu.toa import TOAs
+
+    n_base, n_nights, dn = 5000, 10, 25
+    model = get_model(B1855_LIKE_PAR)
+    toas = _sim_two_band(model, n_base)
+    base_values = dict(model.values)
+    end = float(np.max(np.asarray(toas.mjd_float)))
+    nights = []
+    for i in range(n_nights):
+        s0 = end + 1.0 + 3.0 * i
+        nights.append(make_fake_toas_uniform(
+            s0, s0 + 0.2, dn, model, freq_mhz=1400.0, obs="gbt",
+            error_us=1.0, add_noise=True,
+            rng=np.random.default_rng(1000 + i),
+            flags={"f": "L-wide"}))
+
+    f = GLSFitter(toas, model, bucket=True)
+    compile_s = _timed_compile(lambda: f.fit_toas(maxiter=3))
+    f.stream_prepare()
+    warm_s, _ = _timed_compile2(
+        lambda: f.append_refit(nights[0], maxiter=3))
+    lat = []
+    for d in nights[1:]:
+        t0 = time.perf_counter()
+        rep = f.append_refit(d, maxiter=3)
+        lat.append(time.perf_counter() - t0)
+        assert rep["mode"] == "incremental", rep["mode"]
+    append_s = float(np.median(lat))
+    stream_values = {k: float(model.values[k])
+                     for k in model.free_params}
+
+    # cold arm: from-scratch reload+prepare+fit over the SAME final
+    # data — what a non-streaming deployment (registry reload) pays
+    # per night: re-read the tim backlog (parse + posvels), rebuild
+    # the fitter, refit.  5250 TOAs land in the 5000-TOA bucket, so
+    # every program still resolves through the registry — no compile
+    # in the timed number.
+    import tempfile
+
+    from pint_tpu.toa import get_TOAs, write_tim
+
+    merged = TOAs.merge([toas] + nights)
+    model.values.update(base_values)
+    with tempfile.TemporaryDirectory(prefix="pint_tpu_bench_") as td:
+        tim = os.path.join(td, "backlog.tim")
+        write_tim(merged, tim)
+        t0 = time.perf_counter()
+        t_cold = get_TOAs(tim)
+        f_cold = GLSFitter(t_cold, model, bucket=True)
+        f_cold.fit_toas(maxiter=3)
+        cold_s = time.perf_counter() - t0
+    rel = max(abs(stream_values[k] - float(model.values[k]))
+              / max(abs(float(model.values[k])), 1e-300)
+              for k in stream_values)
+    assert rel < 1e-4, \
+        f"streamed fit diverged from from-scratch (rel {rel:.2e})"
+    speedup = cold_s / max(append_s, 1e-9)
+    stream_doc = {
+        "n_base": n_base, "n_nights": n_nights, "dn": dn,
+        "append_s": round(append_s, 4),
+        "cold_s": round(cold_s, 4),
+        "speedup": round(speedup, 2),
+        "consistency_rel": float(rel),
+    }
+    _emit_metric({
+        "metric": "append_refit_speedup",
+        "value": round(speedup, 1),
+        "unit": (f"x cheaper than cold prepare+fit (GLS {n_base} "
+                 f"base TOAs, {n_nights} nights x {dn} TOAs, "
+                 f"append {append_s * 1e3:.1f} ms vs cold "
+                 f"{cold_s:.2f} s, from-scratch agreement rel "
+                 f"{rel:.1e}, backend={backend}, "
+                 f"compile={compile_s:.1f}s/warm {warm_s:.1f}s)"),
+        "vs_baseline": round(speedup / 10.0, 2),
+        "backend": backend,
+        "compile_s": _cold_warm(compile_s, warm_s),
+        "flops": None,
+        "stream": stream_doc,
+    })
+    _emit_metric({
+        "metric": "append_latency_ms",
+        "value": round(append_s * 1e3, 2),
+        "unit": (f"ms median steady-state append+refit ({dn} TOAs "
+                 f"into {n_base}+ base, incremental rank-k path, "
+                 f"backend={backend})"),
+        "vs_baseline": None,
+        "backend": backend,
+        "compile_s": None,
+        "flops": None,
+        "stream": stream_doc,
+    })
+
+
 def bench_corpus_parity(jnp, backend):
     """Oracle-parity harness throughput over a corpus slice —
     scenarios/sec through the full battery (generate, realize twice,
@@ -1869,6 +1982,9 @@ _METRICS = {
     "guard_overhead": bench_guard,
     "profile_overhead": bench_profile_overhead,
     "trace_overhead": bench_trace_overhead,
+    # the streaming append A/B (docs/streaming.md): emits both
+    # append_refit_speedup and append_latency_ms
+    "stream": bench_stream,
     "gls": bench_gls,
     # the scenario-corpus pair (docs/corpus.md): parity-harness
     # throughput and the serve-plane soak (the latter asserts zero

@@ -74,7 +74,8 @@ from pint_tpu import profiling, telemetry
 __all__ = [
     "enable_persistent_cache", "cache_dir", "cache_entries",
     "shared_jit", "registry_stats", "clear_registry",
-    "bucket_size", "pad_toas", "apply_toa_row_plan", "PAD_ERROR_US",
+    "bucket_size", "pad_toas", "append_toas", "apply_toa_row_plan",
+    "PAD_ERROR_US",
     "split_ctx", "merge_ctx", "fingerprint",
     "model_structure_key", "donation_argnums", "warmup",
     "scan_iters_default", "iterate_fixed", "iter_trace_default",
@@ -821,8 +822,10 @@ def pad_toas(toas, n_target=None):
     count) or the input unchanged when already at a bucket boundary.
 
     The sentinels are copies of the LAST real TOA (so they join its
-    noise-mask groups and its ECORR epoch — never adding basis
-    columns) with uncertainty ``PAD_ERROR_US`` (and ``-pp_dme`` set to
+    noise-mask groups — never adding basis columns; ECORR epoch
+    formation skips ``pad``-flagged rows entirely, keeping the epoch
+    layout independent of pad placement across streaming appends)
+    with uncertainty ``PAD_ERROR_US`` (and ``-pp_dme`` set to
     the same sentinel when the dataset carries wideband DM data), so
     every weighted reduction downstream — chi^2, weighted mean,
     normal equations, Woodbury — drops them to below f64 resolution.
@@ -864,14 +867,85 @@ def pad_toas(toas, n_target=None):
     return padded
 
 
+def append_toas(toas, delta):
+    """Append new TOAs to a (padded) TOAs object, reusing the bucket's
+    pad-sentinel rows when they fit: returns ``(merged, in_bucket)``.
+
+    The bucket-interior case (``n_real + len(delta) <= bucket``) is the
+    streaming fast path: the merged object is re-padded to the SAME
+    bucket, so the append amounts to flipping ``len(delta)`` sentinel
+    rows at ``[n_real, n_real + len(delta))`` to real data — identical
+    shapes, identical structure key, every shared trace keyed on this
+    bucket serves the appended dataset with zero new executables.  The
+    layout is bit-identical to a from-scratch ``pad_toas`` over the
+    concatenated data (the remaining sentinels become clones of the
+    NEW last row — the pad_toas convention), so append-vs-reload
+    consistency holds by construction at this layer.
+
+    ``in_bucket=False`` signals the caller to take the full re-prepare
+    fallback: the delta overflows the bucket (the merged object comes
+    back padded to the NEXT bucket), or the base carries a non-suffix
+    ``pad_valid`` row plan (shard-aligned layouts interleave sentinels
+    — a suffix flip cannot express the append).  The one interleaved
+    layout the fast path DOES keep is the streaming quarantine hole:
+    a base stamped with ``n_filled`` (rows ``[0, n_filled)`` occupied
+    — valid data or quarantined sentinels — pads strictly beyond) may
+    carry interior False ``pad_valid`` entries; the merged object
+    re-carries them with the appended rows marked valid.  Host-side
+    array surgery only — the expensive per-TOA ingestion (clock
+    chains, ephemeris posvels) happened when ``delta`` was built, and
+    the base rows' prepared arrays are concatenated as-is, never
+    recomputed."""
+    from pint_tpu.toa import TOAs
+
+    if len(delta) == 0:
+        raise ValueError("append_toas: empty delta")
+    if getattr(delta, "n_real", None) is not None:
+        raise ValueError("append_toas: delta must be unpadded TOAs")
+    n_real = getattr(toas, "n_real", None)
+    old_valid = getattr(toas, "pad_valid", None)
+    n_filled = getattr(toas, "n_filled", None)
+    if old_valid is None:
+        suffix_ok = True
+        if n_filled is None:
+            n_filled = n_real
+        hole_valid = None
+    else:
+        # explicit mask: only the streaming quarantine layout (all
+        # pads a suffix past n_filled) keeps the fast path
+        ov = np.asarray(old_valid, dtype=bool)
+        suffix_ok = n_filled is not None and not ov[n_filled:].any()
+        hole_valid = ov[:n_filled] if suffix_ok else None
+    if n_filled is None:
+        n_filled = len(toas)
+        real = toas
+        bucket = None
+    else:
+        real = toas[np.arange(n_filled)]
+        bucket = len(toas) if n_real is not None else None
+    merged = TOAs.merge([real, delta])
+    total = n_filled + len(delta)
+    in_bucket = (suffix_ok and bucket is not None and total <= bucket)
+    out = pad_toas(merged, n_target=bucket if in_bucket else None)
+    out.n_filled = total
+    if hole_valid is not None:
+        out.pad_valid = np.concatenate(
+            [hole_valid, np.ones(len(delta), dtype=bool),
+             np.zeros(len(out) - total, dtype=bool)])
+    telemetry.counter_add("compile_cache.toas_appended")
+    telemetry.counter_add("compile_cache.append_rows", float(len(delta)))
+    return out, in_bucket
+
+
 def apply_toa_row_plan(toas, plan):
     """Re-lay a TOAs object per an epoch-alignment row plan
     (:func:`pint_tpu.parallel.mesh.toa_shard_plan`): entries >= 0 are
     source rows, ``-1`` inserts a zero-weight sentinel row — a clone
     of the nearest PRECEDING source row, so it joins that row's
-    noise-mask groups and ECORR epoch (the :func:`pad_toas`
-    convention) and the preceding epoch block extends exactly to the
-    shard boundary, never past it.
+    noise-mask groups (the :func:`pad_toas` convention; ECORR epoch
+    formation skips pad rows, so the inserted rows only push the NEXT
+    epoch block past the shard boundary — a shrunken span never
+    straddles a boundary the full span did not).
 
     Because pad rows are no longer a suffix, the returned object
     carries an explicit boolean ``pad_valid`` mask (honored by

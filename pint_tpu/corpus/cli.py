@@ -11,7 +11,10 @@ corpus.
 - ``pintcorpus report VERDICTS.jsonl`` — re-render the table from a
   saved verdict file.
 - ``pintcorpus replay [--requests N] [--seed N]`` — the serve-plane
-  soak mix (sanitizer armed, SLO engine fed).
+  soak mix (sanitizer armed, SLO engine fed).  With ``--stream``: a
+  ``multi_night_campaign`` scenario's appends streamed through
+  ``POST /v1/datasets/<id>/append`` instead (sanitizer armed after
+  the warm night; zero violations is the pass bar).
 
 ``--out`` defaults to ``$PINT_TPU_CORPUS_DIR`` when set.  Exit code:
 0 when nothing failed (skips are not failures), 1 otherwise.
@@ -110,7 +113,22 @@ def _cmd_report(args) -> int:
 
 def _cmd_replay(args) -> int:
     from pint_tpu.corpus.replay import (DEFAULT_MIX, default_mix,
-                                        replay_mix)
+                                        replay_appends, replay_mix)
+
+    if args.stream:
+        from pint_tpu.corpus.spec import build_class
+
+        scenario = build_class("multi_night_campaign",
+                               base_seed=args.seed, count=1)[0]
+        stats = replay_appends(scenario,
+                               slo_p99_ms=args.slo_p99_ms)
+        print(json.dumps({k: v for k, v in stats.items()
+                          if k != "slo"}, indent=1))
+        verdict = (stats["slo"] or {}).get("verdict", "off")
+        print(f"slo verdict: {verdict}")
+        ok = (stats["errors"] == 0
+              and stats["sanitizer_violations"] == 0)
+        return 0 if ok else 1
 
     classes = tuple(args.klass) if args.klass else DEFAULT_MIX
     mix = default_mix(base_seed=args.seed, classes=classes)
@@ -166,6 +184,10 @@ def main(argv=None) -> int:
                    default=None)
     y.add_argument("--slo-p99-ms", type=float, default=500.0,
                    dest="slo_p99_ms")
+    y.add_argument("--stream", action="store_true",
+                   help="stream a multi_night_campaign scenario's "
+                        "appends through POST /v1/datasets/<id>/"
+                        "append (sanitizer armed after night 0)")
     y.set_defaults(fn=_cmd_replay)
 
     args = ap.parse_args(argv)

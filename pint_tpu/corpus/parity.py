@@ -71,6 +71,10 @@ CLASS_TOL: Dict[str, dict] = {
     "ecorr": {"nsigma": 6.0, "chi2_dof": (0.1, 4.0)},
     "bandnoise": {"nsigma": 6.0, "chi2_dof": (0.1, 4.0)},
     "sysnoise": {"nsigma": 6.0, "chi2_dof": (0.1, 4.0)},
+    # spin+EFAC base; the append plan (and its optional glitch_toas
+    # fault) only matters to the streaming replay, which injects the
+    # fault itself — parity sees an ordinary clean base realization
+    "multi_night_campaign": {},
     # the fault must be DETECTED; no numeric tolerances apply
     "faulted": {},
 }

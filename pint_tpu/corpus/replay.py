@@ -15,7 +15,17 @@ Returns a structured stats dict (requests, rps, errors, sanitizer
 violations, SLO verdict) — consumed by ``bench_corpus_replay``, the
 ``pintcorpus replay`` CLI and the soak tests.  Telemetry:
 ``corpus.replay.requests`` / ``corpus.replay.errors`` /
-``corpus.replay.violations``.
+``corpus.replay.violations`` / ``corpus.replay.appends``.
+
+:func:`replay_appends` is the STREAMING replay mode (``pintcorpus
+replay --stream``): a ``multi_night_campaign`` scenario's base
+backlog is registered on an in-process replica, then each night's
+arrivals stream through ``POST /v1/datasets/<id>/append`` — the
+first (compile-bearing) night warms the append surface, the
+sanitizer arms, and every steady-state night must append with ZERO
+recompile violations.  The scenario's optional ``glitch_toas``
+fault spec is injected while nights are realized, so the replay
+also exercises the triage-quarantine path end to end.
 """
 
 from __future__ import annotations
@@ -25,7 +35,7 @@ from typing import List, Optional
 
 from pint_tpu import telemetry
 
-__all__ = ["replay_mix", "default_mix", "replay"]
+__all__ = ["replay_mix", "default_mix", "replay", "replay_appends"]
 
 #: the default replay slice: cheap, structurally diverse classes —
 #: white-noise WLS, a binary, piecewise DM, and a correlated-noise GLS
@@ -145,3 +155,126 @@ def replay_mix(scenarios=None, n_requests=60, flush_ms=2.0,
 def replay(scenarios=None, **kw) -> dict:
     """Alias of :func:`replay_mix` (the name the CLI/docs use)."""
     return replay_mix(scenarios=scenarios, **kw)
+
+
+def replay_appends(scenario=None, flush_ms=2.0, max_batch=4,
+                   maxiter=3, slo_p99_ms=None) -> dict:
+    """Stream one campaign's nightly appends through
+    ``POST /v1/datasets/<id>/append`` on an in-process replica.
+
+    Night 0 is the warm append (the capture/delta/refit programs
+    compile there, exactly once per structure); the recompile
+    sanitizer arms after it, so ANY compile on the remaining nights
+    is a violation.  The scenario's ``glitch_toas`` fault (when
+    drawn) is injected only while the nights are realized — the
+    corrupted nights reach the replica as ordinary data and the
+    triage must quarantine them.  Returns the stats dict; request
+    errors are counted, not raised."""
+    import os
+    import tempfile
+
+    from pint_tpu import faults
+    from pint_tpu.corpus.spec import build_class
+    from pint_tpu.fleet.client import RetryClient
+    from pint_tpu.lint import sanitizer
+    from pint_tpu.obs import slo as _slo
+    from pint_tpu.serve.server import Server
+    from pint_tpu.toa import write_tim
+
+    if scenario is None:
+        scenario = build_class("multi_night_campaign", base_seed=0,
+                               count=1)[0]
+    # realize the nights FIRST (fault injected only around this —
+    # the serve plane must see the glitch as data, not as an armed
+    # fault, or the batcher would bypass its stacked cache)
+    try:
+        if scenario.fault:
+            for fname, params in faults.parse(scenario.fault).items():
+                faults.inject(fname, **params)
+        nights = scenario.realize_nights()
+    finally:
+        faults.clear()
+    if not nights:
+        raise ValueError(
+            f"scenario {scenario.name!r} has no append plan "
+            "(streaming replay needs a campaign class)")
+
+    srv = Server(flush_ms=flush_ms, max_batch=max_batch,
+                 queue_max=1024, deadline_ms=0)
+    port = srv.start(port=0)
+    was_armed = sanitizer.armed()
+    appends_ok = 0
+    errors = 0
+    modes = []
+    verdicts = []
+    quarantined = 0
+    version = None
+    freshness_s = None
+    try:
+        with tempfile.TemporaryDirectory(
+                prefix="pint_tpu_stream_") as td:
+            _, tim_path = scenario.write(td)
+            srv.registry.load(scenario.name, par=scenario.par,
+                              tim=tim_path)
+            client = RetryClient("127.0.0.1", port, timeout=300)
+            v0 = len(sanitizer.violations())
+            t0 = time.time()
+            for night, delta in enumerate(nights):
+                path = os.path.join(td, f"night{night:02d}.tim")
+                write_tim(delta, path)
+                try:
+                    status, r, _ = client.post(
+                        f"/v1/datasets/{scenario.name}/append",
+                        {"tim": path, "maxiter": maxiter})
+                except OSError:
+                    errors += 1
+                    continue
+                if status != 200:
+                    errors += 1
+                    continue
+                appends_ok += 1
+                modes.append(r.get("mode"))
+                verdicts.append(r.get("verdict"))
+                quarantined += len(r.get("quarantined") or ())
+                version = r.get("version")
+                freshness_s = r.get("freshness_s")
+                telemetry.counter_add("corpus.replay.appends")
+                if night == 0:
+                    # the cold night is done: everything after this
+                    # is the steady-state append path — arm the
+                    # sanitizer and start the SLO windows here, so
+                    # neither gate charges the one-time compiles
+                    sanitizer.arm(note="corpus.replay.appends")
+                    v0 = len(sanitizer.violations())
+                    if slo_p99_ms is not None:
+                        _slo.reset(p99_ms=slo_p99_ms)
+            wall = time.time() - t0
+            client.close()
+        violations = len(sanitizer.violations()) - v0
+        slo_doc = _slo.tracker().verdict_doc()
+    finally:
+        if not was_armed:
+            sanitizer.disarm()
+        srv.stop()
+    if errors:
+        telemetry.counter_add("corpus.replay.errors", errors)
+    if violations:
+        telemetry.counter_add("corpus.replay.violations", violations)
+    stats = {
+        "dataset": scenario.name,
+        "fault": scenario.fault,
+        "nights": len(nights),
+        "appends_ok": appends_ok,
+        "errors": errors,
+        "wall_s": wall,
+        "modes": modes,
+        "verdicts": verdicts,
+        "quarantined": quarantined,
+        "final_version": version,
+        "freshness_s": freshness_s,
+        "sanitizer_violations": violations,
+        "slo": slo_doc,
+    }
+    telemetry.emit({"type": "corpus_replay_appends", **{
+        k: v for k, v in stats.items() if k != "slo"}})
+    return stats

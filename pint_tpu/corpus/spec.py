@@ -21,9 +21,12 @@ regenerating a corpus with the same seed is bit-identical, and the
 streams of distinct scenarios/classes never alias (CRC-keyed
 SeedSequence, never builtin ``hash``).
 
-The default corpus (``default_corpus``) is 15 classes x 7 scenarios =
-105 scenarios — the >=100 / >=8-class acceptance floor of ROADMAP
-item 1 with headroom.
+The default corpus (``default_corpus``) is 16 classes x 7 scenarios =
+112 scenarios — the >=100 / >=8-class acceptance floor of ROADMAP
+item 1 with headroom.  The ``multi_night_campaign`` class additionally
+carries an append plan (``nights`` x ``night_ntoa`` cadence keys) the
+streaming replay (:func:`pint_tpu.corpus.replay.replay_appends`)
+realizes night by night through ``POST /v1/datasets/<id>/append``.
 """
 
 from __future__ import annotations
@@ -58,7 +61,9 @@ class Scenario:
     freq_mhz (scalar | list cycled per TOA), obs, flags (uniform
     per-TOA flag dict), flag_cycle ({key: [values...]} assigned
     cyclically per TOA — multi-system selectors), fuzz_days,
-    multifreq, clustered.
+    multifreq, clustered; campaign classes add nights, night_ntoa,
+    night_gap_days (consumed by :meth:`realize_nights`, ignored by
+    :meth:`realize`).
     ``fault``: a :mod:`pint_tpu.faults` spec string, or None.
     ``correlated``: realize() draws the model's correlated components
     from per-component disjoint substreams of ``seed``.
@@ -123,6 +128,41 @@ class Scenario:
                 toas, model, per_component_seed=self.seed)
         telemetry.counter_add("corpus.realized")
         return model, toas
+
+    def realize_nights(self, model=None):
+        """The campaign append plan: one TOAs object per night (the
+        ``nights`` / ``night_ntoa`` / ``night_gap_days`` cadence keys;
+        empty list for non-campaign classes), each from its own
+        disjoint substream, starting after the base span.  Every night
+        routes through :func:`pint_tpu.faults.corrupt_append_toas` —
+        a harness that injected this scenario's ``glitch_toas`` fault
+        spec gets the glitch-shaped nights the triage must
+        quarantine; with no fault active the hook is a no-op."""
+        from pint_tpu import faults
+        from pint_tpu import simulation as sim
+        from pint_tpu.models.builder import get_model
+
+        c = self.cadence
+        nights = int(c.get("nights", 0))
+        if not nights:
+            return []
+        if model is None:
+            model = get_model(self.par)
+        gap = float(c.get("night_gap_days", 1.0))
+        k = int(c.get("night_ntoa", 4))
+        base_end = float(c["start_mjd"]) + float(c["duration_days"])
+        out = []
+        for night in range(nights):
+            rng = sim.substream(self.seed, f"night{night}")
+            s0 = base_end + gap * (night + 1)
+            t = sim.make_fake_toas_uniform(
+                s0, s0 + 0.2, k, model,
+                freq_mhz=c.get("freq_mhz", 1400.0),
+                obs=c.get("obs", "@"),
+                error_us=c.get("error_us", 1.0),
+                add_noise=True, rng=rng, flags=c.get("flags"))
+            out.append(faults.corrupt_append_toas(t, night=night))
+        return out
 
     # -- persistence ----------------------------------------------------------
     def write(self, outdir):
@@ -352,6 +392,30 @@ def _cls_wavex(rng, seed, name):
     return Scenario(name, "wavex", seed, par, _cadence(ntoa=32))
 
 
+def _cls_campaign(rng, seed, name):
+    # the streaming demo class (docs/streaming.md): a base backlog
+    # plus a nightly append plan sized to stay INSIDE the base TOA
+    # bucket (30 base -> bucket 64; <= 7 nights x 4 TOAs = 28 added),
+    # so the steady-state append path is exercised, not the boundary
+    # fallback.  ~Half the draws arm a ``glitch_toas`` fault spec the
+    # append replay injects while realizing nights — the triage must
+    # quarantine those nights, never absorb them.
+    par = _base_par(rng, name, 54500.0)
+    par += f"EFAC -f camp {rng.uniform(0.95, 1.15):.3f}\n"
+    fault = None
+    if rng.random() < 0.5:
+        fault = (f"glitch_toas:night={int(rng.integers(2, 4))}"
+                 f":offset_us={rng.uniform(60.0, 120.0):.1f}"
+                 f":ramp_us_per_day={rng.uniform(20.0, 60.0):.1f}")
+    return Scenario(
+        name, "multi_night_campaign", seed, par,
+        _cadence(ntoa=30, days=800.0, obs="gbt",
+                 flags={"f": "camp"},
+                 nights=int(rng.integers(4, 8)), night_ntoa=4,
+                 night_gap_days=float(rng.uniform(1.0, 3.0))),
+        fault=fault)
+
+
 def _cls_faulted(rng, seed, name):
     par = _base_par(rng, name, 54500.0)
     kind = "nan_resid" if rng.random() < 0.5 else "inf_sigma"
@@ -378,6 +442,7 @@ CLASSES: Dict[str, Callable] = {
     "bandnoise": _cls_bandnoise,
     "sysnoise": _cls_sysnoise,
     "wavex": _cls_wavex,
+    "multi_night_campaign": _cls_campaign,
     "faulted": _cls_faulted,
 }
 

@@ -1205,6 +1205,91 @@ def _serve_section():
     return lines
 
 
+def _stream_section():
+    """Streaming-append smoke (--stream): boot a replica, load a
+    dataset, push one clean night through ``POST
+    /v1/datasets/<id>/append`` (incremental mode, version bump,
+    freshness gauge), push a second night under an absurdly tight
+    triage threshold to see the quarantine path, and read the
+    ``stream.*`` counters back.  Diagnostic: reports, never raises."""
+    from pint_tpu import telemetry
+
+    lines = ["Streaming appends (--stream):"]
+    srv = None
+    try:
+        from pint_tpu.compile_cache import WARM_WLS_PAR
+        from pint_tpu.serve.client import request_json
+        from pint_tpu.serve.server import Server
+
+        srv = Server(flush_ms=100.0, max_batch=4, queue_max=32,
+                     deadline_ms=0)
+        port = srv.start(port=0)
+        s, info, _ = request_json(
+            "127.0.0.1", port, "POST", "/v1/load",
+            {"dataset": "streamsmk", "par": WARM_WLS_PAR,
+             "toas": {"n": 70, "seed": 0}})
+        assert s == 200, info
+        v0 = info["version"] if "version" in info else 1
+        lines.append(f"  dataset: n={info['n_toas']} bucket "
+                     f"{info['bucket']} ({info['kind']})")
+
+        # clean night: incremental append + atomic version publish
+        s, doc, _ = request_json(
+            "127.0.0.1", port, "POST",
+            "/v1/datasets/streamsmk/append",
+            {"toas": {"n": 5, "seed": 7}}, timeout=600)
+        ok = (s == 200 and doc.get("mode") == "incremental"
+              and doc.get("verdict") == "clean"
+              and doc.get("version", 0) > v0)
+        lines.append(
+            f"  append: +{doc.get('n_appended')} TOAs -> mode "
+            f"{doc.get('mode')!r}, verdict {doc.get('verdict')!r}, "
+            f"version {v0} -> {doc.get('version')}, "
+            f"{doc.get('latency_ms')} ms -> "
+            + ("OK" if ok else "PROBLEM"))
+
+        # triage: a 0.05-sigma threshold flags ordinary noise rows —
+        # the quarantine machinery, not the science, is under test
+        import warnings as _warnings
+
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("ignore")
+            s, doc2, _ = request_json(
+                "127.0.0.1", port, "POST",
+                "/v1/datasets/streamsmk/append",
+                {"toas": {"n": 5, "seed": 8},
+                 "triage_sigma": 0.05}, timeout=600)
+        tri_ok = (s == 200 and doc2.get("verdict") != "clean"
+                  and len(doc2.get("quarantined") or ()) >= 1)
+        lines.append(
+            f"  triage: 0.05-sigma threshold -> verdict "
+            f"{doc2.get('verdict')!r}, "
+            f"{len(doc2.get('quarantined') or ())} quarantined -> "
+            + ("OK" if tri_ok else "PROBLEM"))
+
+        # freshness SLO gauge + counters
+        fresh = telemetry.gauges().get("stream.freshness_s")
+        counts = {k: _tel_counter(k) for k in
+                  ("stream.appends", "stream.refits",
+                   "stream.publishes", "stream.quarantined")}
+        g_ok = fresh is None or 0.0 <= float(fresh) < 600.0
+        lines.append(
+            f"  freshness: stream.freshness_s={fresh}, "
+            + ", ".join(f"{k.split('.')[1]}={v:g}"
+                        for k, v in counts.items())
+            + " -> " + ("OK" if g_ok and counts["stream.publishes"]
+                        >= 2 else "PROBLEM"))
+    except Exception as e:  # diagnostic must never take the report down
+        lines.append(f"  ERROR {type(e).__name__}: {e}")
+    finally:
+        if srv is not None:
+            try:
+                srv.stop()
+            except Exception:
+                pass
+    return lines
+
+
 def _fleet_section():
     """Fleet-orchestration smoke (--fleet): two in-process replicas
     behind a real router socket — broadcast load fans out and
@@ -1529,6 +1614,13 @@ def main(argv=None):
                         "clean, a correlated-noise, and a faulted "
                         "scenario, oracle-parity verdicts on each, "
                         "reference-PINT availability readout")
+    p.add_argument("--stream", action="store_true",
+                   help="run the streaming-append smoke: one clean "
+                        "night through POST /v1/datasets/<id>/append "
+                        "(incremental mode + version bump), one night "
+                        "under a tight triage threshold (quarantine "
+                        "path), freshness gauge + stream.* counter "
+                        "readout")
     p.add_argument("--aot-child", nargs=2, metavar=("MODE", "DIR"),
                    default=None, help=argparse.SUPPRESS)
     args = p.parse_args(argv)
@@ -1550,6 +1642,9 @@ def main(argv=None):
             print(line)
     if args.serve:
         for line in _serve_section():
+            print(line)
+    if args.stream:
+        for line in _stream_section():
             print(line)
     if args.fleet:
         for line in _fleet_section():

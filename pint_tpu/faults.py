@@ -35,6 +35,16 @@ Fault classes (spec grammar: comma-separated ``name[:key=val...]``):
   spec at ONE replica subprocess via its spawn env, so a
   whole-process death mid-batch exercises router re-route and
   supervisor restart.
+- ``glitch_toas[:night=K][:offset_us=U][:ramp_us_per_day=R]`` — a
+  glitch-shaped corruption of a streaming append: every campaign
+  night >= ``night`` (default 1) arrives late by a one-sided phase
+  ramp (``offset_us`` + ``ramp_us_per_day`` x days-into-night
+  microseconds — the post-glitch linear drift signature of
+  arXiv 2010.10322).  Applied where the corpus campaign generator
+  realizes a night's TOAs (:meth:`pint_tpu.corpus.spec.Scenario.
+  realize_nights`); the streaming triage
+  (``Fitter._stream_triage``) must QUARANTINE the night, never
+  absorb it into the warm fit.
 - ``slow_flush[:ms=N][:site=S]`` — deterministic latency injection:
   every call to :func:`maybe_delay` at site ``S`` (default: any site)
   sleeps ``ms`` milliseconds (default 50).  The serve plane's batched
@@ -62,8 +72,9 @@ import numpy as np
 from pint_tpu import telemetry
 
 __all__ = ["parse", "config", "active", "any_active", "inject", "clear",
-           "corrupt_batch", "corrupt_orf", "corrupt_clock_rows",
-           "maybe_kill", "maybe_delay", "suspend"]
+           "corrupt_batch", "corrupt_orf", "corrupt_append_toas",
+           "corrupt_clock_rows", "maybe_kill", "maybe_delay",
+           "suspend"]
 
 ENV = "PINT_TPU_FAULTS"
 
@@ -185,6 +196,27 @@ def corrupt_orf(orf):
         _tick("rank_deficient_phi")
         return jnp.ones_like(orf)
     return orf
+
+
+def corrupt_append_toas(toas, night=0):
+    """``glitch_toas``: make one campaign night's appended TOAs arrive
+    late by a one-sided phase ramp (host-side tick shift, exactly how
+    the simulator injects white noise) — the glitch/acceleration
+    residual signature the streaming triage quarantines.  Nights
+    before ``night`` pass through untouched; returns ``toas``."""
+    p = active("glitch_toas")
+    if p is None or int(night) < int(p.get("night", 1)):
+        return toas
+    offset_us = float(p.get("offset_us", 100.0))
+    ramp = float(p.get("ramp_us_per_day", 50.0))
+    mjds = np.asarray(toas.mjd_float, dtype=np.float64)
+    days = mjds - float(mjds.min()) if mjds.size else mjds
+    shift_s = (offset_us + ramp * days) * 1e-6
+    toas.ticks = toas.ticks + np.round(
+        shift_s * 2**32).astype(np.int64)
+    toas._compute_posvels()
+    _tick("glitch_toas")
+    return toas
 
 
 def corrupt_clock_rows(mjds, offsets):

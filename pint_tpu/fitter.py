@@ -15,7 +15,10 @@ when the model has correlated noise, WLS otherwise.
 
 from __future__ import annotations
 
+import copy
+import os
 import warnings
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -25,8 +28,9 @@ from pint_tpu import compile_cache as _cc
 from pint_tpu import flops as _flops
 from pint_tpu import guard as _guard
 from pint_tpu import telemetry
-from pint_tpu.linalg import StructuredU, basis_ncols, gls_normal_solve, \
-    su_pad_rows
+from pint_tpu.linalg import NormalBlocks, StructuredU, _phi_terms, \
+    _ut_dot, _weighted_gram, basis_ncols, gls_normal_solve, \
+    normal_solve_from_blocks, su_dense_rows, su_pad_rows
 from pint_tpu.models.timing_model import frozen_delay_default, \
     hybrid_design_default
 from pint_tpu.residuals import Residuals, WidebandTOAResiduals
@@ -170,6 +174,148 @@ def wls_gn_solve(resid_fn, vec, err, threshold=1e-14, rcond=None,
         )
         out = out + (diag,)
     return out
+
+
+# -- streaming appends (module helpers) -------------------------------------
+#
+# The serve plane's incremental ingestion path (arXiv 1210.0584): an
+# appended observing epoch touches the normal-equation system only
+# through row sums, so DeltaN new TOAs are a rank-DeltaN update.  The
+# fitter keeps RAW (uncentered) weighted moments of the current
+# linearization as stream state and derives the mean-centered
+# NormalBlocks at solve time — the global weighted-mean coupling of
+# ``subtract_mean`` (appending rows moves the mean, which moves EVERY
+# row's residual) collapses to a rank-one correction instead of an
+# O(N) re-read.  See docs/streaming.md for the algebra.
+
+
+def stream_block_default():
+    """Padded block size for append deltas
+    (``$PINT_TPU_STREAM_BLOCK``): every nightly delta pads to this many
+    rows, so the per-append delta/refit programs compile ONCE and serve
+    any DeltaN up to the block — zero recompiles on the steady-state
+    append path."""
+    raw = os.environ.get("PINT_TPU_STREAM_BLOCK", "")
+    try:
+        n = int(raw)
+    except ValueError:
+        n = 0
+    return n if n > 0 else 32
+
+
+def stream_triage_sigma_default():
+    """Anomaly-triage threshold in whitened sigma units
+    (``$PINT_TPU_STREAM_TRIAGE_SIGMA``)."""
+    raw = os.environ.get("PINT_TPU_STREAM_TRIAGE_SIGMA", "")
+    try:
+        v = float(raw)
+    except ValueError:
+        v = 0.0
+    return v if v > 0 else 7.0
+
+
+def stream_recapture_default():
+    """Incremental refits between full moment re-captures
+    (``$PINT_TPU_STREAM_RECAPTURE``).  The refit linearizes at the
+    capture point and first-order-shifts the moments after each step;
+    periodic recapture re-anchors the Jacobian at the current optimum
+    so the quadratic residue of the timing model cannot accumulate."""
+    raw = os.environ.get("PINT_TPU_STREAM_RECAPTURE", "")
+    try:
+        n = int(raw)
+    except ValueError:
+        n = 0
+    return n if n > 0 else 8
+
+
+class _StreamMoments(NamedTuple):
+    """Raw (uncentered) weighted moments of the current linearization.
+
+    With q the residual and Jq its design evaluated WITHOUT mean
+    subtraction, w the 1/sigma^2 weights, U the (raw) extended noise
+    basis and phi its prior: every mean-centered normal-equation block
+    is an exact function of these sums (:func:`_derive_blocks`), and
+    DeltaN appended rows update each by a row sum."""
+
+    a_qq: jnp.ndarray   # (P, P)  Jq^T W Jq
+    a_qu: jnp.ndarray   # (P, K)  Jq^T W U
+    g_uu: jnp.ndarray   # (K, K)  U^T W U + Phi^-1
+    b_q: jnp.ndarray    # (P,)    Jq^T W q
+    b_u: jnp.ndarray    # (K,)    U^T W q
+    rr: jnp.ndarray     # ()      q^T W q
+    s_j: jnp.ndarray    # (P,)    Jq^T W 1
+    s_u: jnp.ndarray    # (K,)    U^T W 1
+    s_q: jnp.ndarray    # ()      1^T W q
+    s_w: jnp.ndarray    # ()      1^T W 1
+
+
+def _derive_blocks(m: _StreamMoments, center) -> NormalBlocks:
+    """Mean-centered :class:`~pint_tpu.linalg.NormalBlocks` from raw
+    moments.  Subtracting the weighted mean mu_x = S_x / S_w from two
+    row vectors turns their weighted product sum into
+    S_xy - S_x S_y / S_w; only the residual/design side centers — U
+    stays raw in the GLS system (the mean-offset column carries the
+    mean there)."""
+    if not center:
+        return NormalBlocks(a_jj=m.a_qq, a_ju=m.a_qu, gram=m.g_uu,
+                            y_j=m.b_q, y_u=m.b_u, rr=m.rr)
+    c = 1.0 / m.s_w
+    return NormalBlocks(
+        a_jj=m.a_qq - c * jnp.outer(m.s_j, m.s_j),
+        a_ju=m.a_qu - c * jnp.outer(m.s_j, m.s_u),
+        gram=m.g_uu,
+        y_j=m.b_q - c * m.s_j * m.s_q,
+        y_u=m.b_u - c * m.s_u * m.s_q,
+        rr=m.rr - c * m.s_q ** 2)
+
+
+def _u_rows(U, rows):
+    """Dense rows of an extended basis (handles StructuredU) — the
+    append path's (DeltaN, K) slice."""
+    if isinstance(U, StructuredU):
+        return np.asarray(su_dense_rows(U, np.asarray(rows)))
+    return np.asarray(U)[np.asarray(rows)]
+
+
+def _u_rows_slice(U, row0, dn):
+    """Contiguous ``[row0, row0+dn)`` dense rows of an extended basis.
+    For a dense on-device basis this is a ``dynamic_slice`` — O(DeltaN
+    K) device->host, instead of ``np.asarray(U)`` pulling the whole
+    (N, K) matrix across per append."""
+    if isinstance(U, StructuredU):
+        return np.asarray(su_dense_rows(U, np.arange(row0, row0 + dn)))
+    if isinstance(U, jnp.ndarray):
+        return np.asarray(jax.lax.dynamic_slice(
+            U, (row0, 0), (dn, U.shape[1])))
+    return np.asarray(U)[row0:row0 + dn]
+
+
+def _quarantine_rows(delta, rows):
+    """Copy of an append delta with the given rows turned into
+    zero-weight sentinels: quarantined TOAs keep their dataset slot
+    (layout, flags, the ``-quarantine 1`` audit mark) but carry
+    ``PAD_ERROR_US`` uncertainty, so no weighted reduction sees them —
+    the triage's hold-out, not a deletion."""
+    out = delta[np.arange(len(delta))]
+    out.error_us = np.asarray(out.error_us, dtype=np.float64).copy()
+    out.error_us[rows] = _cc.PAD_ERROR_US
+    for i in rows:
+        out.flags[int(i)]["quarantine"] = "1"
+    return out
+
+
+class _MiniAppend(NamedTuple):
+    """One append delta prepared as a tiny padded dataset — the O(DeltaN)
+    evaluation surface for the delta rows' residuals, design rows,
+    sigma and frozen-delay leaf entries."""
+
+    toas: object
+    prep: object
+    res: object
+    data: dict
+    n: int        # real delta rows
+    block: int    # padded block length
+    frozen: object = None  # frozen-delay leaves, computed once
 
 
 class Fitter:
@@ -564,6 +710,10 @@ class Fitter:
         fitter on a same-shaped problem reuses this one's trace and
         executable — zero new XLA compiles."""
         telemetry.counter_add("fitter.retraces")
+        # a retrace re-keys the step (free set / partition / structure
+        # changed) — any captured stream moments describe the OLD
+        # program's linearization; drop them (append_refit re-captures)
+        self._stream = None
         self._traced_free = tuple(self.model.free_timing_params)
         # the guard's escalation scalar rides the data pytree as a
         # DYNAMIC leaf (precedent: n_real), so ladder rungs reuse the
@@ -938,6 +1088,533 @@ class Fitter:
     def parameter_correlation_matrix(self):
         d = np.sqrt(np.diag(self.covariance))
         return self.covariance / np.outer(d, d)
+
+    # -- streaming appends ------------------------------------------------
+    #: incremental-refit state: {"moments": _StreamMoments,
+    #: "since_capture": int, "frozen_fp": ..., "noise_fp": ...} — or
+    #: None when no capture is live (cold, or invalidated by a
+    #: re-prepare / free-set change / parameter edit)
+    _stream = None
+
+    def _stream_check(self):
+        if isinstance(self.resids, WidebandTOAResiduals):
+            raise NotImplementedError(
+                "streaming append: narrowband residuals only")
+        if self.resids.subtract_mean and \
+                not self.resids.use_weighted_mean:
+            raise NotImplementedError(
+                "streaming refit supports the weighted-mean convention "
+                "only: an unweighted mean couples rows through sums the "
+                "stream moments do not carry")
+
+    def _stream_raw_view(self):
+        """A shallow no-mean view of the residuals: the stream state
+        tracks RAW moments, so the capture and delta programs evaluate
+        residuals/design without the in-trace mean subtraction — the
+        centering happens on the moments at solve time
+        (:func:`_derive_blocks`)."""
+        raw = copy.copy(self.resids)
+        raw.subtract_mean = False
+        raw._jit_cache = {}
+        raw._data_cached = None
+        raw._structure_key_cached = None
+        return raw
+
+    def _stream_mini_build(self, delta):
+        """Prepare an append delta as a tiny padded dataset.  The block
+        pads to ``$PINT_TPU_STREAM_BLOCK`` so every nightly delta shares
+        one program shape; the TZR anchor is frozen to the BASE prepare
+        (a mini-local TZR would re-derive the reference phase from the
+        delta night), and correlated-noise ctx entries are replaced by a
+        canonical empty basis — the mini programs never read one (the
+        merged prepare owns the real epoch bookkeeping), and a
+        data-dependent epoch count here would re-key the shared mini
+        trace on every append."""
+        block = stream_block_default()
+        n_t = block if len(delta) <= block \
+            else _cc.bucket_size(len(delta))
+        mtoas = _cc.pad_toas(delta, n_target=n_t)
+        # tzr=False: the mini never derives its own absolute-phase
+        # anchor — the BASE prepare's is grafted in below, so the TZR
+        # component sweep would be pure throwaway work
+        mprep = self.model.prepare(mtoas, tzr=False)
+        mprep.tzr_batch = self.prepared.tzr_batch
+        mprep.tzr_ctx = self.prepared.tzr_ctx
+        for c in self.model.noise_components:
+            if getattr(c, "introduces_correlated_errors", False):
+                mprep.ctx[type(c).__name__] = {
+                    "basis": np.zeros((n_t, 0)), "counts": ()}
+        mprep._noise_basis_comps = []
+        mprep.noise_basis = jnp.asarray(np.zeros((n_t, 0)))
+        mres = Residuals(mtoas, mprep, subtract_mean=False,
+                         track_mode=self.resids.track_mode,
+                         use_weighted_mean=self.resids.use_weighted_mean)
+        leaves = {}
+        mfrozen = None
+        if getattr(self, "_frozen_names", ()):
+            frozen, tzr_frozen = mprep.frozen_delay_leaves(
+                self._frozen_names)
+            if frozen is not None:
+                mfrozen = frozen
+                leaves["frozen"] = frozen
+                if tzr_frozen is not None:
+                    leaves["tzr_frozen"] = tzr_frozen
+        mdata = self._inject_frozen(
+            {**mres._data(), "guard_eps": np.float64(0.0)}, leaves)
+        return _MiniAppend(toas=mtoas, prep=mprep, res=mres, data=mdata,
+                           n=len(delta), block=n_t, frozen=mfrozen)
+
+    def _stream_capture_jit(self):
+        """The one O(N) pass of the streaming path: raw weighted
+        moments of the current linearization, shared-jitted so a second
+        same-shaped capture performs zero new compiles."""
+        raw = self._stream_raw
+        use_basis = self._noise_gram_leaves
+
+        def capture_fn(vec, base_values, data):
+            def resid_of(sub):
+                values = dict(base_values)
+                values.update(sub)
+                return raw.time_resids_at(values, data)
+
+            def linear_of(sub):
+                values = dict(base_values)
+                values.update(sub)
+                return raw.linear_design_at(values, data,
+                                            self._partition[0])
+
+            q, jq = resid_and_design(self._traced_free, vec,
+                                     self._partition, resid_of,
+                                     linear_of)
+            # the frozen-noise sigma leaf, NOT a mask: pad sentinels
+            # carry their ~1e-32 weights exactly as in the batch step,
+            # so streamed and batch solves see identical inputs
+            sigma = data["noise_sigma"]
+            w = 1.0 / sigma ** 2
+            jw = jq * w[:, None]
+            if use_basis:
+                u = data["U_ext"]
+                # the precomputed gram leaf IS U^T W U + Phi^-1 — reuse
+                # it so capture and batch step agree bit-for-bit
+                g_uu = data["noise_gram"]
+                a_qu = _ut_dot(u, jw).T
+                b_u = _ut_dot(u, w * q)
+                s_u = _ut_dot(u, w)
+            else:
+                p = len(self._traced_free)
+                a_qu = jnp.zeros((p, 0))
+                g_uu = jnp.zeros((0, 0))
+                b_u = jnp.zeros((0,))
+                s_u = jnp.zeros((0,))
+            return _StreamMoments(
+                a_qq=jw.T @ jq, a_qu=a_qu, g_uu=g_uu,
+                b_q=jw.T @ q, b_u=b_u, rr=jnp.sum(w * q * q),
+                s_j=jnp.sum(jw, axis=0), s_u=s_u,
+                s_q=jnp.sum(w * q), s_w=jnp.sum(w))
+
+        # capture_fn is constructed fresh per call: fn_token makes the
+        # registry identity the key alone (every closed-over static —
+        # partition, free set, raw structure — is in the key)
+        key = ("stream.capture", type(self).__name__, self._traced_free,
+               self._partition, self._frozen_names, use_basis,
+               raw._structure_key())
+        return _cc.shared_jit(capture_fn, key=key,
+                              fn_token="stream.capture",
+                              label="stream.capture")
+
+    def _stream_delta_jit(self, mres):
+        """(q, Jq, sigma) of the delta block at the current parameters
+        — evaluated on the mini dataset, raw (no mean subtraction).
+        Keyed on the mini STRUCTURE: every same-shaped nightly delta
+        reuses one trace."""
+        def delta_fn(vec, base_values, data):
+            def resid_of(sub):
+                values = dict(base_values)
+                values.update(sub)
+                return mres.time_resids_at(values, data)
+
+            def linear_of(sub):
+                values = dict(base_values)
+                values.update(sub)
+                return mres.linear_design_at(values, data,
+                                             self._partition[0])
+
+            q, jq = resid_and_design(self._traced_free, vec,
+                                     self._partition, resid_of,
+                                     linear_of)
+            sigma = mres.sigma_at(self._merged(base_values, vec), data)
+            return q, jq, sigma
+
+        key = ("stream.delta", type(self).__name__, self._traced_free,
+               self._partition, self._frozen_names,
+               mres._structure_key())
+        return _cc.shared_jit(delta_fn, key=key,
+                              fn_token="stream.delta",
+                              label="stream.delta")
+
+    def _stream_refit_jit(self):
+        """Rank-DeltaN moment update + solve + first-order re-anchor,
+        one O((P+K)^2 DeltaN + (P+K)^3) program with NO term
+        proportional to N.  ``valid_d`` masks the block padding (and
+        quarantined rows) to exactly zero weight, so one static block
+        shape serves every DeltaN."""
+        center = bool(self.resids.subtract_mean)
+
+        def refit_fn(m, q_d, j_d, sigma_d, u_d, valid_d, guard_eps):
+            w = jnp.where(valid_d, 1.0 / sigma_d ** 2, 0.0)
+            jw = j_d * w[:, None]
+            m = m._replace(
+                a_qq=m.a_qq + jw.T @ j_d,
+                a_qu=m.a_qu + jw.T @ u_d,
+                g_uu=m.g_uu + u_d.T @ (u_d * w[:, None]),
+                b_q=m.b_q + jw.T @ q_d,
+                b_u=m.b_u + u_d.T @ (w * q_d),
+                rr=m.rr + jnp.sum(w * q_d * q_d),
+                s_j=m.s_j + jnp.sum(jw, axis=0),
+                s_u=m.s_u + u_d.T @ w,
+                s_q=m.s_q + jnp.sum(w * q_d),
+                s_w=m.s_w + jnp.sum(w))
+            dpar, cov, ncoef, chi2 = normal_solve_from_blocks(
+                _derive_blocks(m, center), guard_eps=guard_eps)
+            # first-order shift to the post-step linearization point:
+            # q(theta + dpar) = q + Jq dpar in the linear model
+            # (normal_blocks_shift, on the raw moments)
+            m2 = m._replace(
+                b_q=m.b_q + m.a_qq @ dpar,
+                b_u=m.b_u + m.a_qu.T @ dpar,
+                rr=(m.rr + 2.0 * jnp.dot(dpar, m.b_q)
+                    + dpar @ m.a_qq @ dpar),
+                s_q=m.s_q + jnp.dot(m.s_j, dpar))
+            return m2, dpar, cov, chi2
+
+        key = ("stream.refit", type(self).__name__, self._traced_free,
+               center)
+        return _cc.shared_jit(refit_fn, key=key,
+                              fn_token="stream.refit",
+                              label="stream.refit")
+
+    def stream_prepare(self):
+        """Capture the streaming-refit state at the current parameters
+        (normally: right after a converged ``fit_toas``).  Requires the
+        frozen-noise fast path — with free noise parameters an append
+        changes sigma/phi on every row and nothing is incremental."""
+        self._stream_check()
+        if not getattr(self, "_noise_frozen", False):
+            raise NotImplementedError(
+                "streaming refit requires the frozen-noise fast path "
+                "(no free noise parameters)")
+        with span("fitter.stream_prepare", n_toa=len(self.toas)):
+            self._stream_raw = self._stream_raw_view()
+            cap = self._stream_capture_jit()
+            vec = jnp.array(
+                [self.model.values[k] for k in self._traced_free],
+                dtype=jnp.float64)
+            base = self.prepared._values_pytree()
+            m = cap(vec, base, self._fit_data)
+            self._stream = {
+                "moments": m,
+                "since_capture": 0,
+                "frozen_fp": dict(self._frozen_fp),
+                "noise_fp": dict(self._noise_fp),
+            }
+            telemetry.counter_add("stream.captures")
+        return self._stream["moments"]
+
+    def _stream_triage(self, q, sigma, t_s, threshold):
+        """Anomaly triage of an arriving delta, whitened against the
+        PRE-append fit (the residual signatures of arXiv 2010.10322):
+        scattered outliers quarantine row-by-row; a coherent one-sided
+        excursion across most of the night is a glitch- or
+        acceleration-shaped event the timing solution must NOT absorb —
+        the whole delta is quarantined into the guard record for
+        intervention, and the warm fit keeps serving."""
+        m = self._stream["moments"]
+        s_w = float(m.s_w)
+        mu = float(m.s_q) / s_w if s_w > 0 else 0.0
+        z = (np.asarray(q) - mu) / np.asarray(sigma)
+        out = np.abs(z) > threshold
+        outliers = np.flatnonzero(out)
+        verdict, quarantine = "clean", outliers
+        if outliers.size:
+            one_sided = bool(np.all(z[out] > 0) or np.all(z[out] < 0))
+            if len(z) >= 3 and out.mean() >= 0.5 and one_sided:
+                tc = t_s - t_s.mean()
+                zc = z - z.mean()
+                denom = np.sqrt((tc ** 2).sum() * (zc ** 2).sum())
+                slope = abs(float((tc * zc).sum() / denom)) \
+                    if denom > 0 else 0.0
+                verdict = "acceleration" if slope > 0.8 else "glitch"
+                quarantine = np.arange(len(z))
+            else:
+                verdict = "outlier"
+            telemetry.counter_add("stream.triage_outliers",
+                                  float(outliers.size))
+            telemetry.counter_add("stream.quarantined",
+                                  float(quarantine.size))
+            warnings.warn(
+                f"stream triage: {verdict} signature in appended TOAs "
+                f"({outliers.size}/{len(z)} rows beyond "
+                f"{threshold:.1f} sigma); {quarantine.size} rows "
+                "quarantined")
+        telemetry.counter_add(f"stream.triage_{verdict}")
+        return {"verdict": verdict, "z": z, "outliers": outliers,
+                "quarantine": np.asarray(quarantine, dtype=np.int64),
+                "threshold": float(threshold)}
+
+    def append(self, delta, quarantine=(), _mini=None):
+        """Structural append: merge ``delta`` into the (padded) dataset
+        and refresh residuals + fit-data leaves — incrementally when
+        the delta fits the current bucket (flip pad sentinels to real
+        rows: same shapes, same structure key, zero new executables;
+        frozen-delay / sigma leaves patched from an O(DeltaN)
+        mini-dataset evaluation and the noise gram by a rank-DeltaN row
+        swap), otherwise a full re-prepare at the next bucket.  Returns
+        True on the incremental path, False on the fallback.
+        ``quarantine`` lists delta row indices held out of every solve
+        (zero-weight sentinels flagged ``-quarantine 1``)."""
+        if isinstance(self.resids, WidebandTOAResiduals):
+            raise NotImplementedError(
+                "streaming append: narrowband residuals only")
+        if self._toa_mesh is not None:
+            raise NotImplementedError(
+                "streaming append: unsharded fitters only (the "
+                "TOA-shard row plan interleaves sentinel rows)")
+        dn = len(delta)
+        with span("fitter.append", n_delta=dn) as sp:
+            row0 = getattr(self.toas, "n_filled", None) \
+                or getattr(self.toas, "n_real", None) or len(self.toas)
+            quarantine = np.unique(np.asarray(
+                quarantine, dtype=np.int64).ravel()) \
+                if np.size(quarantine) else np.zeros(0, dtype=np.int64)
+            if quarantine.size and (quarantine[0] < 0
+                                    or quarantine[-1] >= dn):
+                raise ValueError("quarantine indices outside the delta")
+            if quarantine.size:
+                delta = _quarantine_rows(delta, quarantine)
+            merged, in_bucket = _cc.append_toas(self.toas, delta)
+            if quarantine.size:
+                pv = getattr(merged, "pad_valid", None)
+                if pv is None:
+                    nf = getattr(merged, "n_filled", len(merged))
+                    pv = np.arange(len(merged)) < nf
+                pv = np.asarray(pv, dtype=bool).copy()
+                pv[row0 + quarantine] = False
+                merged.pad_valid = pv
+            traced = getattr(self, "_traced_free", None)
+            old_key = self.resids._structure_key() \
+                if traced is not None else None
+            kwargs = dict(
+                subtract_mean=self.resids.subtract_mean,
+                track_mode=self.resids.track_mode,
+                use_weighted_mean=self.resids.use_weighted_mean)
+            prepared = None
+            resids = None
+            if in_bucket and old_key is not None:
+                prepared = self.prepared.prepare_appended(
+                    merged, n0=row0,
+                    mini_ctx=(_mini.prep.ctx
+                              if _mini is not None else None))
+            if prepared is not None:
+                resids = Residuals(merged, prepared, **kwargs)
+                if resids._structure_key() != old_key:
+                    # a static ctx class drifted under the new span
+                    # (e.g. the Kepler unroll depth) — the streamed
+                    # prepare cannot serve the existing executables
+                    prepared = None
+            if prepared is None:
+                telemetry.counter_add("stream.reprepares")
+                sp.set(mode="reprepare")
+                self.toas = merged
+                self.resids = Residuals(merged, self.model, **kwargs)
+                self.prepared = self.resids.prepared
+                self._stream = None
+                if traced is not None:
+                    self._retrace()
+                return False
+            telemetry.counter_add("stream.appends")
+            telemetry.counter_add("stream.append_rows", float(dn))
+            sp.set(mode="incremental")
+            old_data = self._fit_data
+            old_u = self.resids._U_ext
+            self.toas = merged
+            self.resids = resids
+            self.prepared = prepared
+            if tuple(self.model.free_timing_params) != traced:
+                # the free set changed since the last trace — the leaf
+                # patch would refresh data for a stale program
+                self._stream = None
+                self._retrace()
+                return True
+            mini = _mini if _mini is not None \
+                else self._stream_mini_build(delta)
+            self._append_fit_data(old_data, old_u, mini, row0, dn,
+                                  quarantine)
+            if self._stream is not None:
+                # rebind the raw stream view onto the replaced
+                # residuals (structure unchanged — the capture/delta
+                # programs persist)
+                self._stream_raw = self._stream_raw_view()
+            return True
+
+    def _append_fit_data(self, old, old_u, mini, row0, dn, quarantine):
+        """O(DeltaN) refresh of the fit-data pytree after an in-bucket
+        append: the delta rows' frozen-delay and sigma leaf entries
+        come from the mini dataset, and the noise gram takes a
+        rank-DeltaN row swap (sentinel rows out, real rows in —
+        linalg.noise_gram_append) instead of the O(N K^2) rebuild.
+        Rows past the delta keep their old pad-clone leaf values: they
+        differ from a from-scratch prepare's clones of the NEW last
+        row, but at 1/PAD_ERROR_US^2 ~ 1e-44 weight every assembled
+        quantity agrees far below the documented 1e-10 budget."""
+        from pint_tpu.linalg import noise_gram_append
+
+        data = {**self.resids._data(),
+                "guard_eps": old.get("guard_eps", np.float64(0.0))}
+        leaves = {}
+        if "frozen" in old:
+            mfrozen = mini.frozen
+            if mfrozen is None:
+                mfrozen, _ = mini.prep.frozen_delay_leaves(
+                    self._frozen_names)
+            # device-side row patch: only the DeltaN new entries cross
+            # the host boundary; the old rows stay resident
+            frozen = {}
+            for name, arr in old["frozen"].items():
+                frozen[name] = jax.lax.dynamic_update_slice(
+                    jnp.asarray(arr),
+                    jnp.asarray(np.asarray(mfrozen[name])[:dn]),
+                    (row0,))
+            leaves["frozen"] = frozen
+            if "tzr_frozen" in old:
+                leaves["tzr_frozen"] = old["tzr_frozen"]
+        if getattr(self, "_noise_frozen", False):
+            base = self.prepared._values_pytree()
+            sig_rows = np.asarray(
+                mini.res.sigma_fn(base))[:dn].copy()
+            if quarantine.size:
+                # quarantined rows carry the sentinel uncertainty; the
+                # exact EFAC/EQUAD fold of a 1e22 us error is
+                # indistinguishable at w ~ 1e-44 — stamp the sentinel
+                sig_rows[quarantine] = _cc.PAD_ERROR_US * 1e-6
+            old_sigma = jnp.asarray(old["noise_sigma"])
+            old_sig_rows = np.asarray(jax.lax.dynamic_slice(
+                old_sigma, (row0,), (dn,)))
+            leaves["noise_sigma"] = jax.lax.dynamic_update_slice(
+                old_sigma, jnp.asarray(sig_rows), (row0,))
+            if self._noise_gram_leaves:
+                leaves["noise_phi"] = old["noise_phi"]
+                leaves["noise_gram"] = noise_gram_append(
+                    old["noise_gram"], row0,
+                    jnp.asarray(sig_rows),
+                    jnp.asarray(_u_rows_slice(
+                        self.resids._U_ext, row0, dn)),
+                    jnp.asarray(old_sig_rows),
+                    jnp.asarray(_u_rows_slice(old_u, row0, dn)))
+        self._fit_data = self._inject_frozen(data, leaves)
+        self._shard_fit_data()
+        telemetry.counter_add("stream.leaf_patches")
+
+    def append_refit(self, delta, triage_sigma=None, maxiter=3):
+        """The serve plane's streaming ingest: triage the arriving
+        delta against the pre-append fit, append it (incremental leaf
+        patch when it fits the bucket), and refit by a rank-DeltaN
+        update to the captured moments — O((P+K)^2 DeltaN + (P+K)^3)
+        per append, no O(N) pass.  Falls back to the full ladder fit at
+        bucket boundaries and on a non-finite incremental solve.
+        Returns a report dict: mode ("incremental" | "reprepare" |
+        "refit_full" | "fallback"), triage, chi2 (evaluated at the
+        pre-step vector on the incremental path, the gls convention),
+        dpar, in_bucket."""
+        self._stream_check()
+        dn = len(delta)
+        with span("fitter.append_refit", n_delta=dn) as sp:
+            if self._stream is None:
+                # cold start (or post-fallback): one O(N) capture at
+                # the current fit before the first streamed append
+                self.stream_prepare()
+            elif not (self._fp_same(
+                        self.prepared.frozen_param_values(
+                            self._frozen_names),
+                        self._stream["frozen_fp"])
+                      and self._fp_same(self._noise_param_values(),
+                                        self._stream["noise_fp"])):
+                # a frozen/noise parameter was edited since capture —
+                # the moments are stale; re-fold leaves and re-anchor
+                self._refresh_frozen()
+                self.stream_prepare()
+            row0 = getattr(self.toas, "n_filled", None) \
+                or getattr(self.toas, "n_real", None) or len(self.toas)
+            mini = self._stream_mini_build(delta)
+            djit = self._stream_delta_jit(mini.res)
+            vec = jnp.array(
+                [self.model.values[k] for k in self._traced_free],
+                dtype=jnp.float64)
+            base = self.prepared._values_pytree()
+            q_b, j_b, sigma_b = djit(vec, base, mini.data)
+            q_b = np.asarray(q_b)
+            j_b = np.asarray(j_b)
+            sigma_b = np.asarray(sigma_b)
+            thresh = triage_sigma if triage_sigma is not None \
+                else stream_triage_sigma_default()
+            t_s = np.asarray(mini.toas.ticks[:dn],
+                             dtype=np.float64) / 2.0 ** 32
+            tri = self._stream_triage(q_b[:dn], sigma_b[:dn], t_s,
+                                      thresh)
+            in_bucket = self.append(delta,
+                                    quarantine=tri["quarantine"],
+                                    _mini=mini)
+            if not in_bucket or self._stream is None:
+                # bucket boundary (full re-prepare happened) or the
+                # stream was invalidated: full laddered refit, fresh
+                # capture
+                mode = "reprepare" if not in_bucket else "refit_full"
+                sp.set(mode=mode)
+                chi2 = self.fit_toas(maxiter=maxiter)
+                self.stream_prepare()
+                return {"mode": mode, "triage": tri, "chi2": chi2,
+                        "dpar": None, "in_bucket": in_bucket}
+            k_cols = basis_ncols(self.resids._U_ext) \
+                if self._noise_gram_leaves else 0
+            u_b = np.zeros((mini.block, k_cols))
+            if k_cols:
+                u_b[:dn] = _u_rows_slice(self.resids._U_ext, row0, dn)
+            valid_b = np.zeros(mini.block, dtype=bool)
+            valid_b[:dn] = True
+            valid_b[tri["quarantine"]] = False
+            rjit = self._stream_refit_jit()
+            m2, dpar, cov, chi2 = rjit(
+                self._stream["moments"], jnp.asarray(q_b),
+                jnp.asarray(j_b), jnp.asarray(sigma_b),
+                jnp.asarray(u_b), jnp.asarray(valid_b),
+                np.float64(0.0))
+            dpar_np = np.asarray(dpar)
+            cov_np = np.asarray(cov)
+            chi2_f = float(chi2)
+            if not (np.isfinite(chi2_f) and np.isfinite(dpar_np).all()
+                    and np.isfinite(cov_np).all()):
+                telemetry.counter_add("stream.solve_fallbacks")
+                sp.set(mode="fallback")
+                self._stream = None
+                chi2 = self.fit_toas(maxiter=maxiter)
+                self.stream_prepare()
+                return {"mode": "fallback", "triage": tri,
+                        "chi2": chi2, "dpar": None, "in_bucket": True}
+            errs = np.sqrt(np.clip(np.diag(cov_np), 0.0, None))
+            params = self.model.params
+            for i, name in enumerate(self._traced_free):
+                self.model.values[name] = float(
+                    self.model.values[name] + dpar_np[i])
+                params[name].uncertainty = float(errs[i])
+            self.covariance = cov_np
+            self._stream["moments"] = m2
+            self._stream["since_capture"] += 1
+            telemetry.counter_add("stream.refits")
+            sp.set(mode="incremental", chi2=chi2_f)
+            if self._stream["since_capture"] >= \
+                    stream_recapture_default():
+                self.stream_prepare()
+            return {"mode": "incremental", "triage": tri,
+                    "chi2": chi2_f, "dpar": dpar_np, "in_bucket": True}
 
 
 class WLSFitter(Fitter):

@@ -36,6 +36,17 @@ Placement policy (the throughput story):
   proxy attempts per request — a bounded error budget, not a retry
   storm.
 
+Streaming appends: ``POST /v1/datasets/<id>/append`` forwards to the
+dataset's rendezvous OWNER only (never the spread rule — the
+incremental stream session and the versioned dataset live in one
+process, and spilling an append to a sibling would fork the dataset's
+history).  Successful append bodies are journaled per dataset in
+arrival order; a (re)joining replica gets them replayed right after
+the dataset journal, so a supervisor-restarted owner — or the NEXT
+candidate after an owner death — reconstructs the appended dataset
+before it takes traffic.  A re-load of the dataset id clears its
+append journal (the appends described TOAs of the replaced data).
+
 Jobs: ``POST /v1/jobs`` routes by dataset and journals the spec
 (stamped with its id); when a poll finds the owner has LOST the job —
 dead, answering 404 (a deploy-respawned process with a fresh
@@ -53,7 +64,9 @@ it and ``/fleet`` serves the merged per-replica view
 Telemetry: ``router.requests`` / ``router.reroutes`` /
 ``router.retries`` / ``router.sheds`` / ``router.all_down`` /
 ``router.proxy_errors`` / ``router.replays`` /
-``router.job_failovers`` counters; ``router.replicas_ready`` /
+``router.job_failovers`` / ``router.appends`` /
+``router.append_journal`` / ``router.append_replays`` counters;
+``router.replicas_ready`` /
 ``router.replicas_total`` / ``router.inflight`` gauges.  All
 ``PINT_TPU_ROUTER_*`` knobs are host-only: they shape placement and
 retry policy, never a traced program (the router process runs no
@@ -169,6 +182,7 @@ class Router:
         self._replicas: dict = {}      # target -> _Replica
         self._datasets: dict = {}      # dataset id -> /v1/load body
         self._ds_order: list = []      # registration order
+        self._appends: dict = {}       # dataset id -> [append bodies]
         self._jobs: dict = {}          # job id -> journaled spec
         self._job_owner: dict = {}     # job id -> target
         for t in targets:
@@ -255,10 +269,16 @@ class Router:
     def _replay_datasets(self, rep):
         """Deliver the dataset journal to a (re)joining replica —
         register-before-route, so a supervisor-restarted process
-        never sees a request for a dataset it does not know."""
+        never sees a request for a dataset it does not know.  Each
+        dataset's journaled APPENDS replay right after its load, in
+        arrival order: the rejoining process reconstructs the same
+        appended, versioned dataset its predecessor (or the old
+        owner) served."""
         with self._lock:
             order = list(self._ds_order)
             bodies = {d: self._datasets[d] for d in order}
+            appends = {d: list(self._appends.get(d, ()))
+                       for d in order}
         ok = True
         for ds in order:
             try:
@@ -269,6 +289,17 @@ class Router:
                     ok = False
                     break
                 telemetry.counter_add("router.replays")
+                for body in appends[ds]:
+                    status, _, _ = request_json(
+                        rep.host, rep.port, "POST",
+                        f"/v1/datasets/{ds}/append", body,
+                        timeout=self.proxy_timeout)
+                    if status != 200:
+                        ok = False
+                        break
+                    telemetry.counter_add("router.append_replays")
+                if not ok:
+                    break
             except OSError:
                 ok = False
                 break
@@ -415,6 +446,57 @@ class Router:
         return (503,
                 {"error": "ServeError", "detail": detail,
                  "retry_after_ms": 1000},
+                {"retry-after": "1"})
+
+    # -- streaming appends: owner-only forwarding + journal ------------------
+    def _owner_order(self, dataset) -> list:
+        """Ready replicas in STRICT rendezvous order — no spread
+        spill.  Appends must land on the dataset's owner: the stream
+        session and its versioned history live in one process, and a
+        spilled append would fork them.  Position 0 is the owner;
+        later entries only matter after the owner leaves rotation
+        (they are, in order, its successors)."""
+        with self._lock:
+            ready = [t for t, r in self._replicas.items() if r.ready]
+        return rendezvous_order(dataset or "", ready)
+
+    async def _route_append(self, ds_id, params, headers):
+        """Forward one append to the dataset's rendezvous owner; on
+        owner death (transport error / 503) the next candidate IS the
+        new owner once the probe pulls the dead one, so the bounded
+        retry walks the succession order.  A 200 journals the body
+        for restart replay."""
+        telemetry.counter_add("router.appends")
+        fwd = self._fwd_headers(headers)
+        cands = self._owner_order(ds_id)
+        last_err = None
+        for target in cands[:max(self.retry, 1)]:
+            try:
+                status, obj, h = await self._proxy(
+                    target, "POST", f"/v1/datasets/{ds_id}/append",
+                    params, fwd)
+            except OSError as e:
+                telemetry.counter_add("router.proxy_errors")
+                telemetry.counter_add("router.reroutes")
+                self._mark_down(target, e)
+                last_err = f"{target}: {type(e).__name__}: {e}"
+                continue
+            if status == 503:
+                telemetry.counter_add("router.reroutes")
+                self._mark_down(target,
+                                (obj or {}).get("detail", 503))
+                last_err = f"{target}: 503"
+                continue
+            if status == 200:
+                with self._lock:
+                    self._appends.setdefault(ds_id, []).append(
+                        dict(params))
+                telemetry.counter_add("router.append_journal")
+            return status, obj, h
+        detail = ("no ready replicas" if last_err is None else
+                  f"all candidate replicas failed (last: {last_err})")
+        return (503, {"error": "ServeError", "detail": detail,
+                      "retry_after_ms": 1000},
                 {"retry-after": "1"})
 
     # -- job routing + failover ---------------------------------------------
@@ -688,6 +770,7 @@ class Router:
                 return self._json(200, {"routes": [
                     "POST /v1/load", "POST /v1/fit",
                     "POST /v1/residuals", "POST /v1/lnlike",
+                    "POST /v1/datasets/<id>/append",
                     "POST /v1/jobs", "GET /v1/jobs/<id>",
                     "GET /healthz", "GET /readyz", "GET /metrics",
                     "GET /slo", "GET /fleet", "GET /v1/stats",
@@ -705,6 +788,13 @@ class Router:
         if path == "/v1/jobs":
             return self._passthrough(*await self._route_job_submit(
                 params, headers))
+        if path.startswith("/v1/datasets/") and \
+                path.endswith("/append"):
+            ds_id = path[len("/v1/datasets/"):-len("/append")]
+            if not ds_id or "/" in ds_id:
+                return self._json(404, {"error": "NotFound"})
+            return self._passthrough(*await self._route_append(
+                ds_id, params, headers))
         if path in tuple(f"/v1/{op}" for op in _OPS):
             op = path.rsplit("/", 1)[1]
             return self._passthrough(*await self._route_op(
@@ -723,6 +813,8 @@ class Router:
             if ds not in self._datasets:
                 self._ds_order.append(ds)
             self._datasets[ds] = dict(params)
+            # the journaled appends described the REPLACED data
+            self._appends.pop(ds, None)
         with self._lock:
             ready = [t for t, r in self._replicas.items() if r.ready]
         telemetry.counter_add("router.broadcast_loads")
@@ -759,6 +851,8 @@ class Router:
             "role": "router",
             "replicas": self.replica_docs(),
             "datasets": list(self._ds_order),
+            "appends_journaled": {d: len(v) for d, v
+                                  in self._appends.items()},
             "jobs_journaled": len(self._jobs),
             "slo": self.slo.verdict_doc(),
         }

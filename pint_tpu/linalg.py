@@ -51,10 +51,15 @@ __all__ = ["woodbury_chi2_logdet", "gls_normal_solve",
            "WoodburyPre", "woodbury_precompute",
            "woodbury_chi2_logdet_pre", "woodbury_solve",
            "StructuredU", "structured_from_dense_blocks", "su_to_dense",
+           "su_dense_rows",
            "su_pad_rows", "basis_ncols", "noise_gram_precompute",
            "KronPhi", "KronGram", "kron_gw_blocks", "kron_phi_dense",
            "kron_gram_precompute", "kron_chi2_logdet_pre",
-           "kron_chi2_logdet"]
+           "kron_chi2_logdet",
+           "NormalBlocks", "normal_blocks", "normal_blocks_delta",
+           "normal_blocks_shift", "normal_solve_from_blocks",
+           "woodbury_pre_append", "noise_gram_append",
+           "kron_gram_append"]
 
 #: floor on basis weights: a zero weight (e.g. ECORR 0) means infinite
 #: prior precision on that column — the coefficient is pinned to zero and
@@ -116,6 +121,17 @@ def su_to_dense(su: StructuredU):
     ecorr = (su.seg[:, None] == jnp.arange(k_e)[None, :]).astype(
         jnp.float64)
     return jnp.concatenate([su.pre, ecorr, su.post], axis=1)
+
+
+def su_dense_rows(su: StructuredU, rows):
+    """Materialize a row subset of the dense (len(rows), K) basis —
+    the streaming append path's delta-row slice (ΔN rows of a basis it
+    never needs in full)."""
+    rows = jnp.asarray(rows)
+    k_e = su.eslot.shape[0]
+    ecorr = (su.seg[rows][:, None] == jnp.arange(k_e)[None, :]).astype(
+        jnp.float64)
+    return jnp.concatenate([su.pre[rows], ecorr, su.post[rows]], axis=1)
 
 
 def su_pad_rows(su: StructuredU, n_rows: int):
@@ -735,3 +751,284 @@ def kron_chi2_logdet(r, sigma, U, F, kp: KronPhi, valid=None,
     return kron_chi2_logdet_pre(
         kron_gram_precompute(r, sigma, U, F, valid=valid), kp,
         jitter=jitter)
+
+
+# --------------------------------------------------------------------------
+# streaming appends: rank-k updates to the precomputes (arXiv 1210.0584)
+# --------------------------------------------------------------------------
+#
+# An appended observing epoch touches the N-row system only through
+# row sums: every block of the GLS normal matrix and every capacity
+# matrix is a sum over TOA rows, so DeltaN new rows are a rank-k
+# correction assembled in O(DeltaN (P+K)^2) — never a re-factorization
+# of the N-row gram.  Pad-sentinel rows flipped real by
+# ``compile_cache.append_toas`` carried weight ~1e-32 before the flip;
+# the updates below either downdate them exactly (woodbury_pre_append,
+# noise_gram_append — so the result matches a from-scratch precompute
+# to roundoff) or document the ~1e-32-relative residue as below the
+# streaming path's 1e-10 consistency budget (NormalBlocks, whose
+# capture runs on the already-flipped data anyway).
+
+class NormalBlocks(NamedTuple):
+    """The GLS normal-equation system reduced to its N-free summary —
+    everything :func:`gls_normal_solve`'s constant-gram path needs,
+    with the O(N) row contractions already folded in.  Captured once
+    after a converged fit (``normal_blocks``), then kept current
+    across streaming appends by pure rank-k row updates
+    (:func:`normal_blocks_delta`) and linearization re-anchoring
+    (:func:`normal_blocks_shift`): the incremental refit
+    (:func:`normal_solve_from_blocks`) costs O((P+K)^3) with NO term
+    proportional to N — the O(P^2 DeltaN) append economics of arXiv
+    1210.0584.  All blocks are defined at a fixed linearization point
+    (the parameter vector r and J were evaluated at); the shift keeps
+    them first-order exact after a step, and the caller bounds drift
+    by periodic recapture."""
+
+    a_jj: jnp.ndarray   # (P, P) J^T W J
+    a_ju: jnp.ndarray   # (P, K) J^T W U
+    gram: jnp.ndarray   # (K, K) U^T W U + Phi^-1 (Woodbury capacity)
+    y_j: jnp.ndarray    # (P,)  J^T W r
+    y_u: jnp.ndarray    # (K,)  U^T W r
+    rr: jnp.ndarray     # ()    r^T W r
+
+
+def normal_blocks(r, J, sigma, U, phi, valid=None):
+    """Capture the :class:`NormalBlocks` summary from full-size arrays
+    — the one O(N) pass of the streaming path, run at stream-prepare
+    time (and periodic recapture) under a shared trace.
+
+    ``valid`` masks bucketing pad rows to EXACTLY zero weight, so a
+    capture over a padded bucket equals one over the real rows alone
+    bit-for-bit — without it pad rows contribute their ~1e-32 sentinel
+    weights like everywhere else.  ``U`` may be dense or a
+    :class:`StructuredU`; ``phi`` must be a (K,) weight vector (the
+    frozen-noise gram contract of :func:`gls_normal_solve` — the
+    dense-prior GWB sector streams through :func:`kron_gram_append`
+    instead)."""
+    w = 1.0 / sigma**2
+    if valid is not None:
+        w = jnp.where(valid, w, 0.0)
+    nb = basis_ncols(U)
+    Jw = J * w[:, None]
+    a_jj = J.T @ Jw
+    if nb:
+        a_ju = _ut_dot(U, Jw).T
+        phi_inv, _ = _phi_terms(phi)
+        gram = _weighted_gram(U, w) + phi_inv
+        y_u = _ut_dot(U, w * r)
+    else:
+        p = J.shape[1]
+        a_ju = jnp.zeros((p, 0))
+        gram = jnp.zeros((0, 0))
+        y_u = jnp.zeros((0,))
+    return NormalBlocks(a_jj=a_jj, a_ju=a_ju, gram=gram,
+                        y_j=Jw.T @ r, y_u=y_u,
+                        rr=jnp.sum(r * w * r))
+
+
+def normal_blocks_delta(nb_pre: NormalBlocks, r_d, J_d, sigma_d, U_d,
+                        valid_d=None):
+    """Fold DeltaN appended rows into a :class:`NormalBlocks` — the
+    rank-k update.  Every block is a row sum, so the delta rows simply
+    ADD; rows masked off by ``valid_d`` (the fixed-size stream-block
+    padding) carry exactly zero weight and vanish from every product,
+    which is what lets the delta program run at ONE static shape
+    (``$PINT_TPU_STREAM_BLOCK``) regardless of the actual nightly
+    DeltaN — zero recompiles.  ``U_d`` is the dense (DeltaN, K) basis
+    rows of the appended TOAs evaluated against the FROZEN basis
+    anchoring (span-frozen Fourier comb, existing ECORR epochs — see
+    docs/streaming.md); structure growth (a new epoch column) must
+    fall back to full re-prepare upstream, it cannot be expressed
+    here."""
+    w = 1.0 / sigma_d**2
+    if valid_d is not None:
+        w = jnp.where(valid_d, w, 0.0)
+    Jw = J_d * w[:, None]
+    k = nb_pre.gram.shape[0]
+    if k:
+        return NormalBlocks(
+            a_jj=nb_pre.a_jj + J_d.T @ Jw,
+            a_ju=nb_pre.a_ju + Jw.T @ U_d,
+            gram=nb_pre.gram + U_d.T @ (U_d * w[:, None]),
+            y_j=nb_pre.y_j + Jw.T @ r_d,
+            y_u=nb_pre.y_u + U_d.T @ (w * r_d),
+            rr=nb_pre.rr + jnp.sum(r_d * w * r_d),
+        )
+    return nb_pre._replace(a_jj=nb_pre.a_jj + J_d.T @ Jw,
+                           y_j=nb_pre.y_j + Jw.T @ r_d,
+                           rr=nb_pre.rr + jnp.sum(r_d * w * r_d))
+
+
+def normal_blocks_shift(nb_pre: NormalBlocks, dpar):
+    """Re-anchor the linearization after the parameter vector moved by
+    ``dpar`` (the step ADDED to the vector, i.e. the first element of
+    :func:`normal_solve_from_blocks`'s return).  To first order
+    r -> r + J dpar, so only the r-dependent blocks move — and they
+    move through the gram blocks already in hand:
+
+        y_j += A_jj dpar,   y_u += A_ju^T dpar,
+        rr  += 2 dpar^T y_j_old + dpar^T A_jj dpar.
+
+    Exact for a truly linear model; for the real (mildly nonlinear)
+    timing model the quadratic residue is what periodic recapture
+    (``$PINT_TPU_STREAM_RECAPTURE``) bounds."""
+    rr = (nb_pre.rr + 2.0 * jnp.dot(dpar, nb_pre.y_j)
+          + dpar @ nb_pre.a_jj @ dpar)
+    return nb_pre._replace(y_j=nb_pre.y_j + nb_pre.a_jj @ dpar,
+                           y_u=nb_pre.y_u + nb_pre.a_ju.T @ dpar,
+                           rr=rr)
+
+
+def normal_solve_from_blocks(nb_pre: NormalBlocks, guard_eps=None,
+                             with_health=False):
+    """:func:`gls_normal_solve` evaluated from a :class:`NormalBlocks`
+    summary — the SAME normalization, eigh pseudo-inverse cutoff, and
+    gram-Cholesky chi^2 as the constant-gram path there (so streamed
+    and batch fits agree to roundoff), with every N-sized contraction
+    already folded into the blocks.  Returns ``(dpar, cov,
+    noise_coeffs, chi2)`` (+ SolveDiag when ``with_health``) under
+    gls_normal_solve's sign convention: ``dpar`` is the step to ADD."""
+    n_par = nb_pre.a_jj.shape[0]
+    k = nb_pre.gram.shape[0]
+    if k:
+        mtcm = jnp.block([[nb_pre.a_jj, nb_pre.a_ju],
+                          [nb_pre.a_ju.T, nb_pre.gram]])
+        rhs = jnp.concatenate([nb_pre.y_j, nb_pre.y_u])
+    else:
+        mtcm = nb_pre.a_jj
+        rhs = nb_pre.y_j
+    norm = jnp.sqrt(jnp.diag(mtcm))
+    norm = jnp.where(norm == 0, 1.0, norm)
+    mtcm_n = mtcm / jnp.outer(norm, norm)
+    w, Q = jnp.linalg.eigh(mtcm_n)
+    wmax = jnp.max(w)
+    cut = 1e-16 if guard_eps is None else jnp.maximum(1e-16, guard_eps)
+    w_inv = jnp.where(w > cut * wmax, 1.0 / w, 0.0)
+    xhat = (Q @ (w_inv * (Q.T @ (rhs / norm)))) / norm
+    cov_full = (Q * w_inv[None, :]) @ Q.T / jnp.outer(norm, norm)
+    if k:
+        # chi^2 from the capacity Cholesky, exactly the gram fast path
+        # of gls_normal_solve: rr - y_u^T cap^-1 y_u with the guard
+        # ladder's per-diagonal relative ridge
+        cap = nb_pre.gram
+        if guard_eps is not None:
+            cap = cap + guard_eps * jnp.diag(jnp.abs(jnp.diag(cap)))
+        cf = jax.scipy.linalg.cho_factor(cap, lower=True)
+        x = jax.scipy.linalg.cho_solve(cf, nb_pre.y_u)
+        chi2 = nb_pre.rr - jnp.sum(nb_pre.y_u * x)
+    else:
+        chi2 = nb_pre.rr
+    out = (
+        -xhat[:n_par],
+        cov_full[:n_par, :n_par],
+        xhat[n_par:],
+        chi2,
+    )
+    if with_health:
+        kept_min = jnp.min(jnp.where(w_inv > 0.0, w, wmax))
+        diag = SolveDiag(
+            n_truncated=jnp.sum(w_inv == 0.0).astype(jnp.int32),
+            cond_log10=jnp.log10(wmax / jnp.maximum(kept_min, 1e-300)),
+        )
+        out = out + (diag,)
+    return out
+
+
+def woodbury_pre_append(pre: WoodburyPre, row0, sigma_rows, u_rows,
+                        logdet_phi=None):
+    """Extend a :class:`WoodburyPre` with appended rows WITHOUT
+    re-factorizing the N-row system: the bucket-interior append flips
+    ``pad_toas``'s sentinel rows at ``[row0, row0 + DeltaN)`` to real
+    data, so the capacity matrix moves by the rank-k difference of the
+    outgoing sentinel rows and the incoming real rows,
+
+        Sigma' = L L^T - U_old^T W_old U_old + U_new^T W_new U_new,
+
+    re-Choleskied at O(K^3) — N enters only through the (DeltaN, K)
+    row products.  The sentinel downdate is carried EXACTLY (the old
+    rows still sit in ``pre``), so the result matches a from-scratch
+    :func:`woodbury_precompute` over the flipped data to roundoff.
+    The logdet moves by the white-row swap plus the capacity
+    determinant ratio; ``logdet_phi`` is NOT needed because it cancels
+    in the difference.  ``row0`` may be traced (dynamic-slice
+    addressing), DeltaN is static from ``sigma_rows.shape`` — one
+    shared executable serves every append in the bucket."""
+    dn = sigma_rows.shape[0]
+    u_rows = jnp.asarray(u_rows)
+    nvec_new = jnp.asarray(sigma_rows) ** 2
+    nvec_old = jax.lax.dynamic_slice_in_dim(pre.nvec, row0, dn)
+    u_old = jax.lax.dynamic_slice_in_dim(pre.U, row0, dn, axis=0)
+    cap_old = pre.chol_lower @ pre.chol_lower.T
+    cap = (cap_old
+           - u_old.T @ (u_old / nvec_old[:, None])
+           + u_rows.T @ (u_rows / nvec_new[:, None]))
+    cf = jax.scipy.linalg.cho_factor(cap, lower=True)
+    logdet = (pre.logdet
+              + jnp.sum(jnp.log(nvec_new)) - jnp.sum(jnp.log(nvec_old))
+              + 2.0 * jnp.sum(jnp.log(jnp.diag(cf[0])))
+              - 2.0 * jnp.sum(jnp.log(jnp.diag(pre.chol_lower))))
+    return WoodburyPre(
+        nvec=jax.lax.dynamic_update_slice_in_dim(
+            pre.nvec, nvec_new, row0, 0),
+        U=jax.lax.dynamic_update_slice_in_dim(pre.U, u_rows, row0, 0),
+        chol_lower=cf[0],
+        logdet=logdet,
+    )
+
+
+def noise_gram_append(gram, row0, sigma_rows, u_rows, sigma_old_rows,
+                      u_old_rows):
+    """Extend a :func:`noise_gram_precompute` result with appended
+    rows: the (K, K) gram moves by the same sentinel-out/real-in
+    rank-k difference as :func:`woodbury_pre_append` (the gram IS the
+    capacity matrix), and since the gram is carried unfactored the
+    update is pure row arithmetic — O(DeltaN K^2), no Cholesky here
+    (``gls_normal_solve`` factors it in-trace).  The caller passes the
+    outgoing sentinel rows explicitly (``sigma_old_rows`` /
+    ``u_old_rows``) because the gram, unlike a WoodburyPre, does not
+    retain its rows; ``row0`` is accepted for signature symmetry and
+    unused."""
+    del row0
+    u_rows = jnp.asarray(u_rows)
+    u_old_rows = jnp.asarray(u_old_rows)
+    w_new = 1.0 / jnp.asarray(sigma_rows) ** 2
+    w_old = 1.0 / jnp.asarray(sigma_old_rows) ** 2
+    return (gram
+            - u_old_rows.T @ (u_old_rows * w_old[:, None])
+            + u_rows.T @ (u_rows * w_new[:, None]))
+
+
+def kron_gram_append(pre: KronGram, pulsar, row0, r_rows, sigma_rows,
+                     u_rows, f_rows):
+    """Extend a :func:`kron_gram_precompute` result with rows appended
+    to ONE pulsar of the stacked array.  Kron pad rows carry exactly
+    zero r/U/F by contract (module docstring there), so the outgoing
+    pad rows contributed NOTHING to the gram products and the update
+    is purely additive — only the white logdet swaps the pad rows'
+    masked-out zeros for the new rows' log sigma^2.  O(DeltaN (nb +
+    m2)^2) on pulsar ``pulsar``'s (nb, nb)/(nb, m2)/(m2, m2) blocks;
+    every other pulsar's blocks are untouched.  ``pulsar`` and
+    ``row0`` may be traced."""
+    w = 1.0 / jnp.asarray(sigma_rows) ** 2
+    u_rows = jnp.asarray(u_rows)
+    f_rows = jnp.asarray(f_rows)
+    r_rows = jnp.asarray(r_rows)
+    uw = u_rows * w[:, None]
+    fw = f_rows * w[:, None]
+
+    def bump(stack, delta):
+        old = jax.lax.dynamic_index_in_dim(stack, pulsar, 0,
+                                           keepdims=False)
+        return jax.lax.dynamic_update_index_in_dim(
+            stack, old + delta, pulsar, 0)
+
+    return KronGram(
+        g_uu=bump(pre.g_uu, uw.T @ u_rows),
+        g_uf=bump(pre.g_uf, uw.T @ f_rows),
+        g_ff=bump(pre.g_ff, fw.T @ f_rows),
+        b_u=bump(pre.b_u, uw.T @ r_rows),
+        b_f=bump(pre.b_f, fw.T @ r_rows),
+        rr=bump(pre.rr, jnp.sum(r_rows * w * r_rows)),
+        ld_white=bump(pre.ld_white,
+                      jnp.sum(jnp.log(jnp.asarray(sigma_rows) ** 2))),
+    )

@@ -294,6 +294,15 @@ HOST_ONLY = {
     # spellings ``PINT_TPU_ROUTER_*`` / ``PINT_TPU_FLEET_*`` (prose
     # about the families); every real member is enumerated above
     "PINT_TPU_ROUTER_", "PINT_TPU_FLEET_",
+    # streaming appends (Fitter.append_refit / linalg block solver):
+    # the mini-batch block size pads the DELTA host-side — like
+    # PINT_TPU_BUCKET_TOAS the padded shape reaches the key through
+    # the avals, not through a gate; recapture cadence and the triage
+    # threshold steer host-side control flow between already-keyed
+    # programs (tests/test_stream.py pins the zero-new-compile
+    # contract on a steady-state same-bucket append)
+    "PINT_TPU_STREAM_BLOCK", "PINT_TPU_STREAM_RECAPTURE",
+    "PINT_TPU_STREAM_TRIAGE_SIGMA", "PINT_TPU_STREAM_",
 }
 
 #: files where raw jax.jit is the point, not a registry bypass —

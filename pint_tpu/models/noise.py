@@ -44,6 +44,7 @@ __all__ = [
     "create_quantization_matrix",
     "powerlaw",
     "fourier_basis",
+    "fourier_basis_from_freqs",
     "toa_fourier_basis",
 ]
 
@@ -103,6 +104,23 @@ def fourier_basis(t_s, nmodes: int, tspan_s=None) -> Tuple[np.ndarray, np.ndarra
     F[:, ::2] = np.sin(2 * np.pi * t_s[:, None] * freqs[::2])
     F[:, 1::2] = np.cos(2 * np.pi * t_s[:, None] * freqs[1::2])
     return F, freqs
+
+
+def fourier_basis_from_freqs(t_s, freqs) -> np.ndarray:
+    """Fourier design matrix on a FROZEN frequency comb — the streaming
+    append path's basis build.  ``fourier_basis`` derives the comb from
+    the dataset span, so re-preparing after an append would move every
+    frequency and silently re-weight the old rows' red-noise columns;
+    an appended epoch instead keeps the prepare-time comb (the same
+    contract as the cross-pulsar GWB comb, which fixes ``tspan_s``
+    array-wide).  Built with the identical sin/cos expressions as
+    ``fourier_basis`` so old rows reproduce bit-exactly."""
+    t_s = np.asarray(t_s, dtype=np.float64)
+    freqs = np.asarray(freqs, dtype=np.float64)
+    F = np.zeros((len(t_s), len(freqs)))
+    F[:, ::2] = np.sin(2 * np.pi * t_s[:, None] * freqs[::2])
+    F[:, 1::2] = np.cos(2 * np.pi * t_s[:, None] * freqs[1::2])
+    return F
 
 
 def toa_fourier_basis(toas, nmodes: int, tspan_s=None):
@@ -311,10 +329,27 @@ class EcorrNoise(NoiseComponent):
     def prepare(self, toas, model):
         t = toas.ticks.astype(np.float64) / 2**32  # TDB seconds
         n = len(toas)
+        # pad sentinels (bucketing/shard alignment) and quarantined
+        # rows carry ~zero weight but clone a REAL row's time, so
+        # letting them seed or nmin-count epochs ties the epoch layout
+        # to the pad placement: suffix pads clone the LAST real TOA,
+        # which moves on every streaming append and would shuffle the
+        # old rows' basis columns.  Epochs are determined by live rows
+        # only; excluded rows get all-zero basis rows (their 1e-44
+        # weight made the column entry numerically irrelevant anyway,
+        # and a shrunken epoch span can never straddle a shard
+        # boundary the full span did not).
+        flags = getattr(toas, "flags", None)
+        if flags is not None:
+            live = np.array(
+                [f.get("pad") != "1" and f.get("quarantine") != "1"
+                 for f in flags], dtype=bool)
+        else:
+            live = np.ones(n, dtype=bool)
         umats = []
         counts = []
         for sel in self.selects:
-            mask = np.asarray(mask_from_select(sel, toas))
+            mask = np.asarray(mask_from_select(sel, toas)) & live
             u_local = create_quantization_matrix(t[mask])
             u_full = np.zeros((n, u_local.shape[1]))
             u_full[mask, :] = u_local
@@ -324,6 +359,48 @@ class EcorrNoise(NoiseComponent):
             np.concatenate(umats, axis=1) if umats else np.zeros((n, 0))
         )
         return {"basis": basis, "counts": tuple(counts)}
+
+    def prepare_streamed(self, toas, model, old_ctx, n0):
+        """Streaming-append re-prepare: keep the quantization basis
+        when the appended rows provably cannot disturb it, veto to the
+        full re-prepare otherwise.  ``create_quantization_matrix``
+        keys buckets on their FIRST time with a running 1-s window, so
+        rows arriving strictly LATER than every old row by more than
+        the window can neither re-bucket old rows nor resurrect a
+        dropped singleton; they only matter if they form a >=nmin
+        epoch among themselves, which would add a column.  Vetoes
+        (return None -> full re-prepare, always sound): a new row
+        within the window of the last old epoch, out-of-order
+        arrivals, or a new >=nmin epoch.  On the fast path the old
+        basis is returned as-is — appended singleton rows carry
+        all-zero basis rows exactly as a from-scratch prepare would
+        give them, and pad rows were already excluded (all-zero)."""
+        t = toas.ticks.astype(np.float64) / 2**32
+        n = len(toas)
+        n1 = getattr(toas, "n_filled", None) \
+            or getattr(toas, "n_real", None) or n
+        flags = getattr(toas, "flags", None)
+        if flags is not None:
+            live = np.array(
+                [f.get("pad") != "1" and f.get("quarantine") != "1"
+                 for f in flags], dtype=bool)
+        else:
+            live = np.ones(n, dtype=bool)
+        for sel in self.selects:
+            mask = np.asarray(mask_from_select(sel, toas)) & live
+            t_old = t[:n0][mask[:n0]]
+            t_new = t[n0:n1][mask[n0:n1]]
+            if t_new.size == 0:
+                continue
+            if np.any(np.diff(t_new) < 0.0):
+                return None
+            if t_old.size and \
+                    float(t_new.min()) < float(t_old.max()) + 1.0:
+                return None
+            if create_quantization_matrix(t_new).shape[1] > 0:
+                return None
+        return {"basis": old_ctx["basis"],
+                "counts": old_ctx["counts"]}
 
     def basis(self, ctx):
         return ctx["basis"]
@@ -360,6 +437,29 @@ class _PLNoiseBase(NoiseComponent):
         F, freqs = toa_fourier_basis(toas, nf)
         F = F * self._freq_scaling(model, toas.freq_mhz)[:, None]
         return {"basis": F, "freqs": freqs, "df": freqs[0]}
+
+    def prepare_streamed(self, toas, model, old_ctx, n0):
+        """Streaming-append re-prepare: extend the basis on the FROZEN
+        prepare-time comb (``old_ctx['freqs']``) instead of the new
+        span.  Old rows are bit-exact by construction (same comb, same
+        ticks), so only the appended rows [n0, n_filled) are computed —
+        O(DeltaN K), not O(N K); pad rows past the delta keep the old
+        prepare's clone values (weight ~1e-44, the documented
+        pad-staleness class).  The spectral resolution of the original
+        span is kept until the next full re-prepare (bucket boundary).
+        None when the mode count changed under us."""
+        freqs = np.asarray(old_ctx["freqs"])
+        if freqs.shape[0] != 2 * self._nmodes(model):
+            return None
+        n1 = getattr(toas, "n_filled", None) \
+            or getattr(toas, "n_real", None) or len(toas)
+        t = toas.ticks[n0:n1].astype(np.float64) / 2**32
+        rows = fourier_basis_from_freqs(t, freqs)
+        rows = rows * self._freq_scaling(
+            model, toas.freq_mhz[n0:n1])[:, None]
+        F = np.array(old_ctx["basis"], copy=True)
+        F[n0:n1] = rows
+        return {"basis": F, "freqs": freqs, "df": old_ctx["df"]}
 
     def basis(self, ctx):
         return ctx["basis"]
@@ -463,6 +563,30 @@ class _MaskedPLNoise(NoiseComponent):
         basis = (np.concatenate(blocks, axis=1) if blocks
                  else np.zeros((len(toas), 0)))
         return {"basis": basis, "freqs": freqs, "df": freqs[0]}
+
+    def prepare_streamed(self, toas, model, old_ctx, n0):
+        """Streaming-append re-prepare on the frozen comb (see
+        :meth:`_PLNoiseBase.prepare_streamed`); the per-selector masks
+        are row-local flag/frequency predicates, so old rows are
+        bit-exact and only the appended rows [n0, n_filled) are
+        computed and patched in — O(DeltaN K)."""
+        freqs = np.asarray(old_ctx["freqs"])
+        if freqs.shape[0] != 2 * self._nmodes(model):
+            return None
+        n1 = getattr(toas, "n_filled", None) \
+            or getattr(toas, "n_real", None) or len(toas)
+        t = toas.ticks[n0:n1].astype(np.float64) / 2**32
+        F = fourier_basis_from_freqs(t, freqs)
+        blocks = [
+            F * np.asarray(mask_from_select(sel, toas),
+                           dtype=np.float64)[n0:n1, None]
+            for sel in self.amp_selects
+        ]
+        rows = (np.concatenate(blocks, axis=1) if blocks
+                else np.zeros((n1 - n0, 0)))
+        basis = np.array(old_ctx["basis"], copy=True)
+        basis[n0:n1] = rows
+        return {"basis": basis, "freqs": freqs, "df": old_ctx["df"]}
 
     def basis(self, ctx):
         return ctx["basis"]

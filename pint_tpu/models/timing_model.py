@@ -218,8 +218,11 @@ class TimingModel:
         return dict(getattr(self, "_func_params", {}))
 
     # -- preparation ---------------------------------------------------------
-    def prepare(self, toas) -> "PreparedModel":
-        return PreparedModel(self, toas)
+    def prepare(self, toas, tzr=True) -> "PreparedModel":
+        """Bind this model to ``toas``.  ``tzr=False`` skips the TZR
+        reference prepare — for throwaway preps whose caller grafts in
+        an existing TZR anchor (the streaming-append mini datasets)."""
+        return PreparedModel(self, toas, tzr=tzr)
 
     # -- output --------------------------------------------------------------
     def get_derived_params(self, rms_us=None, ntoas=None,
@@ -477,6 +480,74 @@ def gated_dm_sum(model, values, batch, ctx_map):
     return dm
 
 
+def _ctx_patch_rows(old_ctx, mini_ctx, n0, n1, n_rows):
+    """Row-local ctx refresh for the streaming append: per-row array
+    leaves (leading axis ``n_rows``) take rows ``[n0, n1)`` from the
+    mini prepare's leading rows; everything else (scalars, static
+    depths, per-select index arrays) must be EQUAL between the old and
+    mini prepares — a mismatch means the ctx is not row-local after
+    all, and the caller falls back to the component's plain prepare.
+    On-device leaves are patched with ``dynamic_update_slice`` so no
+    O(N) array crosses the host boundary; pad rows past the delta keep
+    the old prepare's clone values (weight ~1e-44).  Returns the new
+    ctx dict, or None on any structural disagreement."""
+    dn = n1 - n0
+    if dn <= 0 or set(k for k in old_ctx if k != "__gate__") != \
+            set(k for k in mini_ctx if k != "__gate__"):
+        return None
+    out = {}
+    for k, v_old in old_ctx.items():
+        if k == "__gate__":
+            continue
+        v_mini = mini_ctx[k]
+        is_arr = isinstance(v_old, (np.ndarray, jax.Array))
+        if is_arr and v_old.ndim >= 1 and v_old.shape[0] == n_rows:
+            rows = np.asarray(v_mini)
+            if rows.ndim != v_old.ndim or rows.shape[0] < dn or \
+                    rows.shape[1:] != v_old.shape[1:]:
+                return None
+            rows = rows[:dn]
+            if isinstance(v_old, jax.Array):
+                out[k] = jax.lax.dynamic_update_slice(
+                    v_old, jnp.asarray(rows, dtype=v_old.dtype),
+                    (n0,) + (0,) * (v_old.ndim - 1))
+            else:
+                a = np.array(v_old, copy=True)
+                a[n0:n1] = rows
+                out[k] = a
+            continue
+        if is_arr and v_old.ndim == 2 and v_old.shape[1] == n_rows \
+                and v_old.shape[0] != n_rows:
+            # row-stacked per-select layout (k, N) — the white-noise
+            # mask stacks; rows live on axis 1
+            rows = np.asarray(v_mini)
+            if rows.ndim != 2 or rows.shape[0] != v_old.shape[0] or \
+                    rows.shape[1] < dn:
+                return None
+            rows = rows[:, :dn]
+            if isinstance(v_old, jax.Array):
+                out[k] = jax.lax.dynamic_update_slice(
+                    v_old, jnp.asarray(rows, dtype=v_old.dtype),
+                    (0, n0))
+            else:
+                a = np.array(v_old, copy=True)
+                a[:, n0:n1] = rows
+                out[k] = a
+            continue
+        try:
+            if is_arr or isinstance(v_mini, (np.ndarray, jax.Array)):
+                same = np.array_equal(np.asarray(v_old),
+                                      np.asarray(v_mini))
+            else:
+                same = bool(v_old == v_mini)
+        except Exception:
+            return None
+        if not same:
+            return None
+        out[k] = v_old
+    return out
+
+
 class PreparedModel:
     """Model bound to a dataset: static ctx captured, pure fns jitted.
 
@@ -485,7 +556,7 @@ class PreparedModel:
     resolved once, into jit-closure constants.
     """
 
-    def __init__(self, model: TimingModel, toas):
+    def __init__(self, model: TimingModel, toas, tzr=True):
         self.model = model
         self.toas = toas
         self.batch = toas.to_batch()
@@ -508,19 +579,20 @@ class PreparedModel:
         # static arrays (caught by simulate->fit self-consistency).
         self.tzr_batch = None
         self.tzr_ctx = None
-        for c in model.components:
-            if hasattr(c, "make_tzr_toas"):
-                tzr_toas = c.make_tzr_toas(model, toas)
-                if tzr_toas is not None:
-                    self.tzr_batch = tzr_toas.to_batch()
-                    self.tzr_ctx = {
-                        type(cc).__name__: cc.prepare(tzr_toas, model)
-                        for cc in model.components
-                    }
-                    if inert is not None:
-                        for name, c_ctx in self.tzr_ctx.items():
-                            c_ctx["__gate__"] = jnp.float64(
-                                0.0 if name in inert else 1.0)
+        if tzr:
+            for c in model.components:
+                if hasattr(c, "make_tzr_toas"):
+                    tzr_toas = c.make_tzr_toas(model, toas)
+                    if tzr_toas is not None:
+                        self.tzr_batch = tzr_toas.to_batch()
+                        self.tzr_ctx = {
+                            type(cc).__name__: cc.prepare(tzr_toas, model)
+                            for cc in model.components
+                        }
+                        if inert is not None:
+                            for name, c_ctx in self.tzr_ctx.items():
+                                c_ctx["__gate__"] = jnp.float64(
+                                    0.0 if name in inert else 1.0)
         # correlated-noise bases are static per dataset; stack them once
         # (reference: noise_model_designmatrix, timing_model.py:1690)
         self._noise_basis_comps = []
@@ -539,6 +611,103 @@ class PreparedModel:
         # never touches it — it routes through Residuals' shared
         # programs
         self._phase_jit = jax.jit(self._phase_raw)
+
+    def prepare_appended(self, toas, n0=None, mini_ctx=None):
+        """Streaming re-prepare: bind this prepared model to ``toas``
+        (the same dataset with rows appended in place of pad
+        sentinels) while keeping every prepare-time frozen quantity
+        frozen — the bucket-interior append path.
+
+        Components offering ``prepare_streamed(toas, model, old_ctx,
+        n0)`` extend their ctx on their own frozen anchors (the
+        Fourier comb, the ECORR epoch layout); a hook returning None
+        vetoes the streamed prepare (the caller falls back to a full
+        re-prepare).  Components without the hook re-run their plain
+        ``prepare`` — sound only for row-local ctx (masks, dt ticks),
+        so an unknown *correlated* component vetoes conservatively.
+        When the caller already prepared the delta as a mini dataset
+        (``mini_ctx``: the mini PreparedModel's per-component ctx),
+        those row-local entries are row-patched onto the old ctx
+        instead — O(DeltaN) host work and, for on-device leaves, a
+        device-side update with no O(N) re-upload; any structural
+        disagreement (keys, shapes, non-row scalars) falls back to the
+        plain per-component prepare, never to a wrong answer.  The TZR
+        reference batch/ctx are carried over verbatim: the
+        absolute-phase anchor of a streamed dataset never moves.
+
+        Returns the new PreparedModel, or None on any veto."""
+        if n0 is None:
+            n0 = getattr(self.toas, "n_filled", None) \
+                or getattr(self.toas, "n_real", None) or len(self.toas)
+        n1 = getattr(toas, "n_filled", None) \
+            or getattr(toas, "n_real", None) or len(toas)
+        n_rows = len(toas)
+        model = self.model
+        ctx = {}
+        for c in model.components:
+            name = type(c).__name__
+            old_ctx = self.ctx[name]
+            hook = getattr(c, "prepare_streamed", None)
+            if hook is not None:
+                got = hook(toas, model, old_ctx, n0)
+                if got is None:
+                    return None
+            elif getattr(c, "introduces_correlated_errors", False):
+                # a correlated component without a streaming story
+                # (e.g. a cross-pulsar common process) would need its
+                # frozen gram re-derived — full re-prepare instead
+                return None
+            else:
+                got = None
+                if mini_ctx is not None and name in mini_ctx:
+                    got = _ctx_patch_rows(old_ctx, mini_ctx[name],
+                                          n0, n1, n_rows)
+                if got is None:
+                    got = c.prepare(toas, model)
+            if "__gate__" in old_ctx:
+                got["__gate__"] = old_ctx["__gate__"]
+            ctx[name] = got
+        new = object.__new__(PreparedModel)
+        new.model = model
+        new.toas = toas
+        new.batch = toas.to_batch()
+        new.ctx = ctx
+        new.tzr_batch = self.tzr_batch
+        new.tzr_ctx = self.tzr_ctx
+        new._noise_basis_comps = []
+        parts = []
+        rows_parts = []
+        for c in model.noise_components:
+            b = c.basis(ctx[type(c).__name__])
+            if b is not None and b.shape[1] > 0:
+                new._noise_basis_comps.append(c)
+                parts.append(b)
+                rows_parts.append(np.asarray(b)[n0:n1])
+        n = new.batch.ticks.shape[0]
+        widths = sum(int(b.shape[1]) for b in parts)
+        if (new._noise_basis_comps == self._noise_basis_comps
+                and widths == int(self.noise_basis.shape[1])
+                and n == int(self.noise_basis.shape[0])
+                and n1 > n0):
+            # rank-DeltaN stacked-basis refresh: the hooks certified
+            # every old row bit-exact, so only the appended rows need
+            # transferring — a device-side row patch instead of the
+            # O(N K) host concat + full re-upload.  Pad rows past the
+            # delta keep the OLD prepare's clones (weight ~1e-44; the
+            # same pad-staleness class _append_fit_data documents).
+            if widths:
+                rows = np.concatenate(rows_parts, axis=1)
+                new.noise_basis = jax.lax.dynamic_update_slice(
+                    self.noise_basis, jnp.asarray(rows), (n0, 0))
+            else:
+                new.noise_basis = self.noise_basis
+        else:
+            new.noise_basis = jnp.asarray(
+                np.concatenate([np.asarray(b) for b in parts], axis=1)
+                if parts else np.zeros((n, 0)))
+        # pintlint: allow=PTL101 -- same legacy accessor as __init__
+        new._phase_jit = jax.jit(new._phase_raw)
+        return new
 
     # -- noise interface ------------------------------------------------------
     def scaled_sigma_fn(self, values, batch=None, ctx=None):

@@ -466,7 +466,12 @@ _LOWER_IS_BETTER = {"guard_overhead", "profile_overhead",
                     # seconds with zero ready replicas during a
                     # rolling deploy (pint_tpu/fleet): 0 is the
                     # zero-downtime claim
-                    "rolling_deploy_downtime_s"}
+                    "rolling_deploy_downtime_s",
+                    # median steady-state streaming append+refit
+                    # latency (docs/streaming.md): a regression here
+                    # means the rank-k path got slower or fell off
+                    # the incremental path entirely
+                    "append_latency_ms"}
 
 #: the suite's known rate-metric series (higher is better — the
 #: sentinel's default direction).  Purely a registration list: the
@@ -496,6 +501,9 @@ RATE_METRICS = frozenset({
     # the routed fleet's mixed-stream throughput (pint_tpu/fleet):
     # a placement/re-route regression trips the sentinel
     "fleet_reqs_per_sec",
+    # streaming append+refit vs cold prepare+fit (docs/streaming.md):
+    # the >=10x ROADMAP acceptance as a standing series
+    "append_refit_speedup",
 })
 
 #: absolute slack (same units as the metric — percentage points for

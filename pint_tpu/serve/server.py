@@ -12,6 +12,13 @@ Routes (all JSON):
 
 - ``POST /v1/load``        — register a dataset (par text + tim path
   or synthetic TOA spec); control plane, allowed before readiness.
+- ``POST /v1/datasets/<id>/append`` — streaming ingest: a night's new
+  TOAs (tim path or synthetic spec) ride the rank-k Woodbury append
+  path — anomaly triage, incremental refit, and an atomic version
+  publish (:meth:`~pint_tpu.serve.state.DatasetRegistry.append`).
+  In-flight requests keep the version they were admitted against;
+  the response carries the new version, the triage verdict, and the
+  freshness (``stream.freshness_s`` also lands on the SLO gauge).
 - ``POST /v1/fit``         — coalesced batched fit (``dataset``,
   ``maxiter``, ``values`` start overrides, ``deadline_ms``).
 - ``POST /v1/residuals``   — coalesced batched residuals.
@@ -82,6 +89,7 @@ from pint_tpu.serve.state import (
     ServeError,
     serve_config,
     size_classes,
+    warm_append,
     warm_serve,
 )
 
@@ -172,6 +180,16 @@ class Server:
                                         "lnlike"),
                                    maxiter=3)
                         self._warm_grid_path(ds_id, progress)
+                        # streaming-append rehearsal: a throwaway
+                        # session absorbs one synthetic night so the
+                        # capture/delta/refit programs exist before
+                        # the sanitizer arms (state.warm_append)
+                        rec = warm_append(self.registry, ds_id)
+                        if progress is not None:
+                            progress(
+                                f"warm append ({ds_id}): "
+                                + ("ok" if rec.get("warmed")
+                                   else rec.get("detail", "skipped")))
                 else:
                     # no datasets yet: the synthetic single-program
                     # warmup keeps a bare `pintserve --warm`
@@ -478,6 +496,7 @@ class Server:
                 return self._json(200, {"routes": [
                     "POST /v1/load", "POST /v1/fit",
                     "POST /v1/residuals", "POST /v1/lnlike",
+                    "POST /v1/datasets/<id>/append",
                     "POST /v1/jobs", "GET /v1/jobs/<id>",
                     "POST /drain",
                     "GET /healthz", "GET /readyz", "GET /metrics",
@@ -519,6 +538,25 @@ class Server:
                     toas=params.get("toas"), tim=params.get("tim"),
                     flags=params.get("flags")))
             return self._json(200, info)
+        if path.startswith("/v1/datasets/") and \
+                path.endswith("/append"):
+            if self._draining:
+                raise ServeError("replica is draining",
+                                 retry_after_s=1.0)
+            ds_id = path[len("/v1/datasets/"):-len("/append")]
+            if not ds_id or "/" in ds_id:
+                return self._json(404, {"error": "NotFound"})
+            ts = params.get("triage_sigma")
+            loop = asyncio.get_running_loop()
+            doc = await loop.run_in_executor(
+                None, lambda: self.registry.append(
+                    ds_id, toas=params.get("toas"),
+                    tim=params.get("tim"),
+                    flags=params.get("flags"),
+                    maxiter=int(params.get("maxiter", 3)),
+                    triage_sigma=(float(ts) if ts is not None
+                                  else None)))
+            return self._json(200, doc)
         if path == "/v1/jobs":
             ctx = _obs_trace.from_headers(headers)
             doc = self.jobs.submit(params, trace=ctx.trace_id)
@@ -562,9 +600,10 @@ class Server:
         ctr = telemetry.counters()
         g = telemetry.gauges()
         serve_ctr = {k: v for k, v in ctr.items()
-                     if k.startswith("serve.")}
+                     if k.startswith(("serve.", "stream."))}
         serve_g = {k: v for k, v in g.items()
-                   if k.startswith(("serve.", "hist.serve."))}
+                   if k.startswith(("serve.", "hist.serve.",
+                                    "stream."))}
         return {
             "config": dict(self.cfg),
             "queue_depth": self.batcher.depth(),

@@ -57,9 +57,10 @@ from pint_tpu.obs import trace as _obs_trace
 
 __all__ = [
     "ServeError", "Shed", "DeadlineMiss",
-    "Dataset", "DatasetRegistry", "Request",
+    "Dataset", "DatasetRegistry", "Request", "StreamSession",
     "serve_config", "size_classes", "size_class_for",
-    "dispatch_batch", "warm_serve", "clear_batch_cache",
+    "dispatch_batch", "warm_serve", "warm_append",
+    "clear_batch_cache",
     "FLUSH_MS_ENV", "MAX_BATCH_ENV", "QUEUE_MAX_ENV", "DEADLINE_MS_ENV",
     "GRID_CHUNK_ENV", "PORT_ENV", "HOST_ENV", "JOB_DIR_ENV",
     "AOT_DIR_ENV",
@@ -186,13 +187,15 @@ class Dataset:
 
     __slots__ = ("dataset_id", "model", "toas", "prepared", "resid",
                  "bucket", "n_real", "kind", "structure", "token",
-                 "noise_owned", "_values_snapshot", "_rung_snapshot")
+                 "noise_owned", "version", "_values_snapshot",
+                 "_rung_snapshot")
 
     def __init__(self, dataset_id, model, toas):
         from pint_tpu import compile_cache as _cc
         from pint_tpu.residuals import Residuals
 
         self.dataset_id = str(dataset_id)
+        self.version = 1
         self.n_real = len(toas)
         toas = _cc.pad_toas(toas)
         self.model = model
@@ -225,9 +228,52 @@ class Dataset:
         else:
             self.model.meta["GUARD_RUNG"] = self._rung_snapshot
 
+    @classmethod
+    def published(cls, prev, fitter):
+        """The streaming-append publish: a NEW version wrapping the
+        session fitter's CURRENT (toas, prepared, resids) — no
+        re-prepare — with a PRIVATE model clone (own values/meta
+        dicts), so later appends (which keep mutating the session
+        model) can never leak into this version's in-flight requests.
+        The prepared/resids wrappers are shallow copies re-pointed at
+        the clone; their arrays and jit caches are shared (immutable /
+        registry-backed)."""
+        import copy as _copy
+
+        from pint_tpu import compile_cache as _cc
+
+        self = cls.__new__(cls)
+        src = fitter.model
+        clone = _copy.copy(src)
+        clone.values = dict(src.values)
+        clone.meta = dict(src.meta)
+        prep = _copy.copy(fitter.prepared)
+        prep.model = clone
+        resid = _copy.copy(fitter.resids)
+        resid.prepared = prep
+        resid.model = clone
+        self.dataset_id = prev.dataset_id
+        self.version = prev.version + 1
+        self.model = clone
+        self.toas = fitter.toas
+        self.prepared = prep
+        self.resid = resid
+        self.n_real = resid.n_real
+        self.bucket = len(fitter.toas)
+        self.kind = prev.kind
+        self.structure = _cc.fingerprint((
+            _cc.model_structure_key(clone),
+            tuple(clone.free_params), self.bucket))
+        self.noise_owned = prev.noise_owned
+        self.token = next(_dataset_tokens)
+        self._values_snapshot = dict(clone.values)
+        self._rung_snapshot = clone.meta.get("GUARD_RUNG")
+        return self
+
     def info(self) -> dict:
         return {"dataset": self.dataset_id, "n_toas": self.n_real,
                 "bucket": self.bucket, "kind": self.kind,
+                "version": self.version,
                 "free_params": list(self.model.free_params),
                 "structure": self.structure}
 
@@ -238,6 +284,78 @@ _TOA_SPEC_DEFAULTS = {
     "freq_mhz": 1400.0, "obs": "gbt", "error_us": 1.0, "seed": 0,
     "add_noise": True,
 }
+
+
+def _build_toas(model, toas=None, tim=None, flags=None,
+                defaults=None):
+    """TOAs from a request body: a server-local ``tim`` path, or a
+    synthetic spec dict over ``model`` (shared by /v1/load and the
+    append endpoint — appends use the same vocabulary to describe a
+    night's new arrivals)."""
+    if tim is not None:
+        from pint_tpu.toa import get_TOAs
+
+        return get_TOAs(tim)
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    spec = dict(defaults if defaults is not None
+                else _TOA_SPEC_DEFAULTS)
+    spec.update(toas or {})
+    return make_fake_toas_uniform(
+        float(spec["start_mjd"]),
+        float(spec["start_mjd"]) + float(spec["duration_days"]),
+        int(spec["n"]), model,
+        freq_mhz=float(spec["freq_mhz"]),
+        obs=str(spec["obs"]),
+        error_us=float(spec["error_us"]),
+        add_noise=bool(spec["add_noise"]),
+        rng=np.random.default_rng(int(spec["seed"])),
+        flags=flags)
+
+
+class StreamSession:
+    """Per-dataset persistent streaming state: a PRIVATE fitter (own
+    model clone over the dataset's padded TOAs) that absorbs appends
+    through the rank-k Woodbury path (:meth:`Fitter.append_refit`).
+    Each successful append is snapshotted into a fresh immutable
+    :meth:`Dataset.published` version; the session itself is never
+    served, so the refit write-backs can't race a flush's
+    values-rollback window.
+
+    A model whose streaming path is unsupported (free noise
+    parameters — the capture needs the frozen-noise leaves) degrades
+    to append + full refit: same versioned publish, no incremental
+    speedup."""
+
+    def __init__(self, ds, maxiter=3):
+        import copy as _copy
+
+        from pint_tpu.fitter import GLSFitter, WLSFitter
+
+        model = _copy.copy(ds.model)
+        model.values = dict(ds.model.values)
+        model.meta = dict(ds.model.meta)
+        cls = GLSFitter if ds.kind == "gls" else WLSFitter
+        self.fitter = cls(ds.toas, model, bucket=True)
+        self.maxiter = int(maxiter)
+        self.fitter.fit_toas(maxiter=self.maxiter)
+        self.incremental = True
+        try:
+            self.fitter.stream_prepare()
+        except NotImplementedError:
+            self.incremental = False
+        telemetry.counter_add("stream.sessions")
+
+    def append(self, delta, triage_sigma=None) -> dict:
+        """Absorb one delta; returns the fitter's append report."""
+        if not self.incremental:
+            self.fitter.append(delta)
+            chi2 = self.fitter.fit_toas(maxiter=self.maxiter)
+            return {"mode": "refit_full", "chi2": float(chi2),
+                    "triage": {"verdict": "skipped",
+                               "quarantine": []}}
+        return self.fitter.append_refit(
+            delta, triage_sigma=triage_sigma, maxiter=self.maxiter)
 
 
 class DatasetRegistry:
@@ -252,6 +370,8 @@ class DatasetRegistry:
     def __init__(self):
         self._datasets: dict = {}
         self.generation = 0
+        self._streams: dict = {}
+        self._append_lock = threading.Lock()
 
     def load(self, dataset_id, par, toas=None, tim=None,
              flags=None) -> dict:
@@ -264,31 +384,98 @@ class DatasetRegistry:
         from pint_tpu.models.builder import get_model
 
         model = get_model(par)
-        if tim is not None:
-            from pint_tpu.toa import get_TOAs
-
-            toas_obj = get_TOAs(tim)
-        else:
-            from pint_tpu.simulation import make_fake_toas_uniform
-
-            spec = dict(_TOA_SPEC_DEFAULTS)
-            spec.update(toas or {})
-            toas_obj = make_fake_toas_uniform(
-                float(spec["start_mjd"]),
-                float(spec["start_mjd"]) + float(spec["duration_days"]),
-                int(spec["n"]), model,
-                freq_mhz=float(spec["freq_mhz"]),
-                obs=str(spec["obs"]),
-                error_us=float(spec["error_us"]),
-                add_noise=bool(spec["add_noise"]),
-                rng=np.random.default_rng(int(spec["seed"])),
-                flags=flags)
+        toas_obj = _build_toas(model, toas=toas, tim=tim, flags=flags)
         ds = Dataset(dataset_id, model, toas_obj)
         self._datasets[ds.dataset_id] = ds
+        # a re-load is a NEW dataset: any streaming session over the
+        # replaced one is linearized against dead data
+        self._streams.pop(ds.dataset_id, None)
         self.generation += 1
         telemetry.counter_add("serve.datasets_loaded")
         telemetry.gauge_set("serve.datasets", len(self._datasets))
         return ds.info()
+
+    def append(self, dataset_id, toas=None, tim=None, flags=None,
+               maxiter=3, triage_sigma=None) -> dict:
+        """The streaming ingest pipeline: triage -> incremental refit
+        -> atomic version publish.
+
+        The session fitter absorbs the delta (anomaly triage
+        quarantines glitch/acceleration-shaped outliers into the
+        zero-weight guard ladder; a bucket-boundary crossing falls
+        back to a full re-prepare), then a NEW dataset version is
+        published as a single dict swap — in-flight requests keep the
+        version object they were admitted against, new requests see
+        the appended one.  The fitter mutates only session-private
+        state, so a crash anywhere before the swap leaves the served
+        version untouched (the chaos site ``stream.append`` kills
+        exactly there to prove it) and the session is simply rebuilt
+        from the registry on the next append."""
+        from pint_tpu import faults as _faults
+
+        t0_wall = time.time()
+        t0 = time.perf_counter()
+        ds = self.get(dataset_id)
+        with self._append_lock:
+            try:
+                session = self._streams.get(ds.dataset_id)
+                if session is None:
+                    session = StreamSession(ds, maxiter=maxiter)
+                    self._streams[ds.dataset_id] = session
+                spec_defaults = dict(_TOA_SPEC_DEFAULTS)
+                spec_defaults.update({
+                    "n": 8, "duration_days": 1.0,
+                    "start_mjd": float(np.max(
+                        np.asarray(ds.toas.mjd_float))) + 1.0,
+                })
+                delta = _build_toas(session.fitter.model, toas=toas,
+                                    tim=tim, flags=flags,
+                                    defaults=spec_defaults)
+                rep = session.append(delta,
+                                     triage_sigma=triage_sigma)
+                new_ds = Dataset.published(ds, session.fitter)
+                # the atomicity probe: a kill HERE (after the session
+                # mutated, before the publish) must leave the served
+                # version untouched and the retry must succeed
+                _faults.maybe_kill("stream.append")
+                _faults.maybe_delay("stream.append")
+                with SERVING_LOCK:
+                    self._datasets[ds.dataset_id] = new_ds
+                    self.generation += 1
+            except ServeError:
+                raise
+            except Exception:
+                # a torn session must not survive: rebuild from the
+                # (unchanged) served version on the next append
+                self._streams.pop(ds.dataset_id, None)
+                telemetry.counter_add("stream.append_errors")
+                _slo.record("append", 0.0, ok=False)
+                raise
+        freshness_s = time.time() - t0_wall
+        wall_s = time.perf_counter() - t0
+        telemetry.counter_add("stream.publishes")
+        telemetry.gauge_set("stream.freshness_s", freshness_s)
+        telemetry.gauge_set("stream.version", float(new_ds.version))
+        _slo.record("append", wall_s, ok=True)
+        tri = rep.get("triage") or {}
+        quarantined = [int(i) for i in
+                       np.asarray(tri.get("quarantine", []),
+                                  dtype=np.int64).tolist()]
+        doc = {
+            "dataset": new_ds.dataset_id,
+            "version": new_ds.version,
+            "n_toas": new_ds.n_real,
+            "n_appended": len(delta),
+            "bucket": new_ds.bucket,
+            "mode": rep.get("mode"),
+            "verdict": tri.get("verdict", "skipped"),
+            "quarantined": quarantined,
+            "chi2": (float(rep["chi2"])
+                     if rep.get("chi2") is not None else None),
+            "freshness_s": round(freshness_s, 6),
+            "latency_ms": round(wall_s * 1e3, 3),
+        }
+        return doc
 
     def get(self, dataset_id) -> Dataset:
         try:
@@ -766,3 +953,36 @@ def warm_serve(registry, dataset_id, max_batch, ops=("fit",),
                         "wall_s": round(time.perf_counter() - t0, 3)})
     telemetry.counter_add("serve.warm_flushes", float(len(out)))
     return out
+
+
+def warm_append(registry, dataset_id, maxiter=3):
+    """Warm the streaming-append compile surface for a dataset
+    WITHOUT mutating it: a THROWAWAY session (private model clone over
+    the same padded bucket) absorbs one tiny synthetic append and is
+    discarded.  The programs it builds — the session fit ladder, the
+    stream capture, the mini-delta evaluation, and the rank-k refit —
+    are registry-shared by structure, so the real session created by
+    the first client append reuses every one of them and a
+    sanitizer-armed replica streams appends with zero steady-state
+    compiles.  Best-effort: an unsupported model shape just skips."""
+    ds = registry.get(dataset_id)
+    t0 = time.perf_counter()
+    try:
+        session = StreamSession(ds, maxiter=maxiter)
+        start = float(np.max(np.asarray(ds.toas.mjd_float))) + 1.0
+        # carry the dataset's frontend flag so the warm delta lands in
+        # the same noise-mask groups a real night's arrivals would
+        fl = (ds.toas.flags[0] or {}).get("f") \
+            if getattr(ds.toas, "flags", None) else None
+        delta = _build_toas(
+            session.fitter.model,
+            toas={"n": 4, "start_mjd": start, "duration_days": 1.0,
+                  "seed": 1},
+            flags={"f": fl} if fl else None)
+        session.append(delta)
+    except Exception as e:  # noqa: BLE001 — warmup is best-effort
+        return {"dataset": dataset_id, "warmed": False,
+                "detail": f"{type(e).__name__}: {e}"}
+    telemetry.counter_add("stream.warm_appends")
+    return {"dataset": dataset_id, "warmed": True,
+            "wall_s": round(time.perf_counter() - t0, 3)}

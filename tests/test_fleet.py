@@ -338,6 +338,102 @@ class TestRouter:
         docs = {d["target"]: d for d in r.replica_docs()}
         assert docs[a2.target]["ready"] is True
 
+    def test_append_routes_to_owner_only(self, fakes, router_of):
+        # appends must land on the dataset's rendezvous OWNER: the
+        # stream session and its versioned history live in one
+        # process, and a spilled append would fork the history
+        a, b = fakes("a"), fakes("b")
+        r = router_of([a.target, b.target], retry=2)
+        r.probe_now()
+        owner_t = rendezvous_order("psrX", [a.target, b.target])[0]
+        owner = a if owner_t == a.target else b
+        sibling = b if owner is a else a
+        body = {"tim": "fake.tim", "refit": True}
+        s, obj, _ = request_json(
+            "127.0.0.1", r._port, "POST",
+            "/v1/datasets/psrX/append", body)
+        assert s == 200 and obj["replica"] == owner.name
+        hits = [p for m, p, _ in owner.requests
+                if p == "/v1/datasets/psrX/append"]
+        assert len(hits) == 1
+        assert not any(p == "/v1/datasets/psrX/append"
+                       for m, p, _ in sibling.requests)
+        # a 200 journals the body for restart replay
+        with r._lock:
+            assert r._appends["psrX"] == [body]
+
+    def test_append_replayed_to_replacement_owner(self, fakes,
+                                                  router_of):
+        # owner death -> replacement process gets the dataset load
+        # AND the journaled appends, in order, before rejoining
+        # rotation — it reconstructs the same appended dataset
+        a, b = fakes("a"), fakes("b")
+        r = router_of([a.target, b.target], retry=2)
+        r.probe_now()
+        s, _, _ = request_json(
+            "127.0.0.1", r._port, "POST", "/v1/load",
+            {"dataset": "psrX", "par": "fake.par"})
+        assert s == 200
+        bodies = [{"tim": f"night{i}.tim"} for i in range(3)]
+        for body in bodies:
+            s, _, _ = request_json(
+                "127.0.0.1", r._port, "POST",
+                "/v1/datasets/psrX/append", body)
+            assert s == 200
+        owner_t = rendezvous_order("psrX", [a.target, b.target])[0]
+        owner = a if owner_t == a.target else b
+        port = owner.port
+        owner.stop()
+        r.probe_now()
+        owner2 = fakes(owner.name + "2", port=port)
+        r.probe_now()
+        assert owner2.datasets == ["psrX"]
+        replayed = [bd for m, p, bd in owner2.requests
+                    if p == "/v1/datasets/psrX/append"]
+        assert replayed == bodies
+        docs = {d["target"]: d for d in r.replica_docs()}
+        assert docs[owner2.target]["ready"] is True
+
+    def test_append_journal_cleared_on_reload(self, fakes,
+                                              router_of):
+        # a fresh /v1/load replaces the dataset: the old appends
+        # described data that no longer exists and must not replay
+        a = fakes("a")
+        r = router_of([a.target])
+        r.probe_now()
+        for body in ({"dataset": "psrX", "par": "fake.par"},):
+            s, _, _ = request_json("127.0.0.1", r._port, "POST",
+                                   "/v1/load", body)
+            assert s == 200
+        s, _, _ = request_json(
+            "127.0.0.1", r._port, "POST",
+            "/v1/datasets/psrX/append", {"tim": "night0.tim"})
+        assert s == 200
+        with r._lock:
+            assert r._appends.get("psrX")
+        s, _, _ = request_json(
+            "127.0.0.1", r._port, "POST", "/v1/load",
+            {"dataset": "psrX", "par": "fake2.par"})
+        assert s == 200
+        with r._lock:
+            assert not r._appends.get("psrX")
+
+    def test_append_fails_over_to_successor_owner(self, fakes,
+                                                  router_of):
+        # the owner shedding (503 via drain semantics) walks the
+        # rendezvous succession order within one request
+        a, b = fakes("a"), fakes("b")
+        r = router_of([a.target, b.target], retry=2)
+        r.probe_now()
+        owner_t = rendezvous_order("psrX", [a.target, b.target])[0]
+        owner = a if owner_t == a.target else b
+        sibling = b if owner is a else a
+        owner.stop()
+        s, obj, _ = request_json(
+            "127.0.0.1", r._port, "POST",
+            "/v1/datasets/psrX/append", {"tim": "night0.tim"})
+        assert s == 200 and obj["replica"] == sibling.name
+
     def test_job_failover_resubmits_to_sibling(self, fakes,
                                                router_of):
         a, b = fakes("a"), fakes("b")

@@ -1,0 +1,306 @@
+"""Streaming timing: rank-k Woodbury append + low-latency refit.
+
+Oracles:
+- a from-scratch fit over the merged (base + nights) dataset — the
+  streamed parameters must land within a small fraction of the
+  from-scratch uncertainties (bench ``append_refit_speedup`` measures
+  the same agreement at scale)
+- the telemetry backend-compile counter pins the zero-recompile claim
+  for a steady-state same-bucket append
+- the registry's served Dataset object identity pins the atomic
+  versioned publish (a torn append leaves the served version
+  untouched; the chaos kill subprocess proves the same through a real
+  SIGKILL at the ``stream.append`` fault site)
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import pint_tpu  # noqa: F401  (x64 + cpu platform via conftest)
+from pint_tpu import telemetry
+from pint_tpu.fitter import GLSFitter, WLSFitter
+from pint_tpu.models import get_model
+from pint_tpu.simulation import make_fake_toas_uniform
+from pint_tpu.toa import TOAs, write_tim
+
+BASE_PAR = """
+PSR J1744-1134
+RAJ 17:44:29.4 1
+DECJ -11:34:54.7 1
+F0 245.4261196 1
+F1 -5.38e-16 1
+PEPOCH 54000
+DM 3.139 1
+TZRMJD 54000
+TZRFRQ 1400
+TZRSITE gbt
+"""
+
+WHITE = "EFAC -f fake 1.2\nEQUAD -f fake 0.5\n"
+RED = "TNREDAMP -13.5\nTNREDGAM 3.5\nTNREDC 10\n"
+ECORR = "ECORR -f fake 0.4\n"
+
+
+def _fake(model, n=100, seed=1, start=53000.0, end=54800.0):
+    return make_fake_toas_uniform(
+        start, end, n, model, freq_mhz=1400.0, obs="gbt",
+        error_us=1.0, add_noise=True,
+        rng=np.random.default_rng(seed), flags={"f": "fake"})
+
+
+def _night(model, i, n=8, seed=None, start=54801.0):
+    """One campaign night of new arrivals, strictly after the base."""
+    s0 = start + 3.0 * i
+    return make_fake_toas_uniform(
+        s0, s0 + 0.2, n, model, freq_mhz=1400.0, obs="gbt",
+        error_us=1.0, add_noise=True,
+        rng=np.random.default_rng(777 + i if seed is None else seed),
+        flags={"f": "fake"})
+
+
+def _fit_scratch(par, toas_list, cls, maxiter=5):
+    model = get_model(par)
+    f = cls(TOAs.merge(list(toas_list)), model, bucket=True)
+    f.fit_toas(maxiter=maxiter)
+    return f
+
+
+def _assert_params_close(f_stream, f_scratch, sigma_frac=0.05):
+    for name in f_scratch.model.free_params:
+        a = float(f_stream.model.values[name])
+        b = float(f_scratch.model.values[name])
+        err = float(f_scratch.model.params[name].uncertainty or 0.0)
+        tol = sigma_frac * err + 1e-9 * max(abs(b), 1.0)
+        assert abs(a - b) <= tol, \
+            f"{name}: streamed {a} vs scratch {b} (sigma {err})"
+
+
+class TestAppendConsistency:
+    """append_refit == from-scratch fit over the merged dataset."""
+
+    def _run(self, par, cls, base_toas, nights, maxiter=5):
+        model = get_model(par)
+        f = cls(base_toas, model, bucket=True)
+        f.fit_toas(maxiter=maxiter)
+        f.stream_prepare()
+        for d in nights:
+            rep = f.append_refit(d, maxiter=maxiter)
+            assert rep["mode"] == "incremental", rep["mode"]
+            assert rep["triage"]["verdict"] == "clean"
+        scratch = _fit_scratch(par, [base_toas] + list(nights), cls,
+                               maxiter=maxiter)
+        _assert_params_close(f, scratch)
+        return f
+
+    def test_wls_white_noise(self):
+        par = BASE_PAR + WHITE
+        sim = get_model(par)
+        toas = _fake(sim, n=105, seed=1)
+        nights = [_night(sim, i) for i in range(2)]
+        self._run(par, WLSFitter, toas, nights)
+
+    def test_gls_rednoise(self):
+        par = BASE_PAR + WHITE + RED
+        sim = get_model(par)
+        toas = _fake(sim, n=105, seed=2)
+        nights = [_night(sim, i) for i in range(2)]
+        f = self._run(par, GLSFitter, toas, nights)
+        # non-vacuous: the Fourier basis is live in the solve
+        assert f.resids._U_ext is not None
+
+    def test_gls_ecorr_epochs(self):
+        # base data with real ECORR epochs (clusters inside the 1-s
+        # quantization window); the appended nights are isolated
+        # singletons, so the structural fast path keeps the old basis
+        par = BASE_PAR + WHITE + ECORR
+        sim = get_model(par)
+        parts = [_fake(sim, n=95, seed=3)]
+        for j in range(4):
+            s0 = 53100.0 + 300.0 * j
+            parts.append(make_fake_toas_uniform(
+                s0, s0 + 5e-6, 3, sim, freq_mhz=1400.0, obs="gbt",
+                error_us=1.0, add_noise=True,
+                rng=np.random.default_rng(50 + j),
+                flags={"f": "fake"}))
+        toas = TOAs.merge(parts)
+        nights = [_night(sim, i, seed=880 + i) for i in range(2)]
+        f = self._run(par, GLSFitter, toas, nights)
+        counts = f.prepared.ctx["EcorrNoise"]["counts"]
+        assert sum(counts) >= 4  # the epochs actually formed
+
+
+class TestZeroRecompile:
+    def test_second_same_bucket_append_compiles_nothing(self):
+        par = BASE_PAR + WHITE
+        sim = get_model(par)
+        toas = _fake(sim, n=105, seed=4)
+        nights = [_night(sim, i, n=6, seed=900 + i) for i in range(3)]
+        model = get_model(par)
+        f = WLSFitter(toas, model, bucket=True)
+        f.fit_toas(maxiter=3)
+        f.stream_prepare()
+        # night 0 is the warm-up: the stream capture/delta/refit
+        # programs compile once here
+        f.append_refit(nights[0], maxiter=3)
+        before = telemetry.counter_get("jit.backend_compile_events")
+        for d in nights[1:]:
+            rep = f.append_refit(d, maxiter=3)
+            assert rep["mode"] == "incremental"
+        compiled = telemetry.counter_get(
+            "jit.backend_compile_events") - before
+        assert compiled == 0, \
+            f"{compiled} backend compiles on steady-state appends"
+
+
+class TestBucketBoundary:
+    def test_overflow_falls_back_to_reprepare(self):
+        # 120 TOAs live in the 125 bucket; a 16-row night overflows it
+        par = BASE_PAR + WHITE
+        sim = get_model(par)
+        toas = _fake(sim, n=120, seed=5)
+        big = _night(sim, 0, n=16, seed=950)
+        model = get_model(par)
+        f = WLSFitter(toas, model, bucket=True)
+        f.fit_toas(maxiter=5)
+        f.stream_prepare()
+        rep = f.append_refit(big, maxiter=5)
+        assert rep["mode"] == "reprepare"
+        assert rep["in_bucket"] is False
+        # the fallback is a full laddered refit — still consistent
+        scratch = _fit_scratch(par, [toas, big], WLSFitter)
+        _assert_params_close(f, scratch)
+        # and the stream re-anchored: the next small append is
+        # incremental again
+        rep = f.append_refit(_night(sim, 3, seed=951), maxiter=5)
+        assert rep["mode"] == "incremental"
+
+
+class TestRegistryAppend:
+    """The serve-plane ingest pipeline over DatasetRegistry."""
+
+    PAR = BASE_PAR + WHITE
+
+    @pytest.fixture()
+    def registry(self):
+        from pint_tpu.serve.state import DatasetRegistry
+
+        reg = DatasetRegistry()
+        reg.load("psrS", self.PAR,
+                 toas={"n": 105, "start_mjd": 53000.0,
+                       "duration_days": 1500.0, "seed": 5},
+                 flags={"f": "fake"})
+        return reg
+
+    def test_append_publishes_new_version_atomically(self, registry):
+        ds0 = registry.get("psrS")
+        doc = registry.append("psrS", toas={"n": 8, "seed": 11},
+                              flags={"f": "fake"})
+        assert doc["mode"] == "incremental"
+        assert doc["verdict"] == "clean"
+        assert doc["n_appended"] == 8
+        ds1 = registry.get("psrS")
+        # a NEW immutable version is served; the old object an
+        # in-flight request was admitted against is untouched
+        assert ds1 is not ds0
+        assert ds1.version == ds0.version + 1
+        assert doc["version"] == ds1.version
+        assert ds0.n_real == 105 and ds1.n_real == 113
+        assert ds1.model is not ds0.model
+
+    def test_torn_append_leaves_served_version(self, registry):
+        served = registry.get("psrS")
+        errs0 = telemetry.counter_get("stream.append_errors")
+        with pytest.raises(Exception):
+            registry.append("psrS", tim="/nonexistent/night.tim")
+        assert registry.get("psrS") is served  # nothing published
+        assert telemetry.counter_get("stream.append_errors") == \
+            errs0 + 1
+        # the torn session was dropped: the retry rebuilds it from the
+        # (unchanged) served version and succeeds
+        doc = registry.append("psrS", toas={"n": 8, "seed": 12},
+                              flags={"f": "fake"})
+        assert doc["version"] == served.version + 1
+        assert registry.get("psrS").n_real == 113
+
+    def test_glitch_night_quarantined(self, registry, tmp_path):
+        # one clean append first: its published values are the
+        # converged streaming solution the glitch must not perturb
+        registry.append("psrS", toas={"n": 8, "seed": 13},
+                        flags={"f": "fake"})
+        ds = registry.get("psrS")
+        sim = get_model(self.PAR)
+        s0 = float(np.max(np.asarray(ds.toas.mjd_float))) + 1.0
+        night = _night(sim, 0, n=12, seed=40, start=s0)
+        # a coherent one-sided timing excursion: the glitch signature
+        # the triage must quarantine rather than absorb
+        night.ticks = night.ticks + np.int64(round(200e-6 * 2 ** 32))
+        night._compute_posvels()
+        tim = tmp_path / "glitch_night.tim"
+        write_tim(night, tim)
+        vals0 = {k: float(ds.model.values[k])
+                 for k in ds.model.free_params}
+        with pytest.warns(UserWarning, match="stream triage"):
+            doc = registry.append("psrS", tim=str(tim))
+        assert doc["verdict"] in ("glitch", "acceleration")
+        assert len(doc["quarantined"]) == 12
+        # the quarantined night carries zero weight: the published
+        # solution did not absorb the excursion
+        ds1 = registry.get("psrS")
+        for k, v0 in vals0.items():
+            err = float(ds.model.params[k].uncertainty or 0.0)
+            assert abs(float(ds1.model.values[k]) - v0) <= \
+                0.05 * err + 1e-9 * max(abs(v0), 1.0), k
+
+
+_KILL_APPEND_SCRIPT = r"""
+import os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from pint_tpu.serve.state import DatasetRegistry
+
+PAR = open(sys.argv[1]).read()
+reg = DatasetRegistry()
+reg.load("psrK", PAR,
+         toas={"n": 56, "start_mjd": 53000.0, "duration_days": 900.0,
+               "seed": 3},
+         flags={"f": "fake"})
+print("LOADED", reg.get("psrK").version, flush=True)
+reg.append("psrK", toas={"n": 6, "seed": 9}, flags={"f": "fake"})
+print("PUBLISHED", reg.get("psrK").version, flush=True)
+"""
+
+
+@pytest.mark.slow
+class TestChaosKillMidAppend:
+    def test_kill_at_publish_site_is_before_the_swap(self, tmp_path):
+        """A SIGKILL at the ``stream.append`` fault site (after the
+        session mutated, before the version swap) dies with nothing
+        published — the exit code proves the kill landed, the missing
+        PUBLISHED line proves it landed before the swap.  Without the
+        fault the same driver publishes version 2."""
+        script = tmp_path / "driver.py"
+        script.write_text(_KILL_APPEND_SCRIPT)
+        par = tmp_path / "model.par"
+        par.write_text(BASE_PAR + WHITE)
+        repo_root = os.path.dirname(
+            os.path.dirname(pint_tpu.__file__))
+        pypath = repo_root + os.pathsep + os.environ.get(
+            "PYTHONPATH", "")
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=pypath,
+                   PINT_TPU_FAULTS="kill:site=stream.append")
+        r1 = subprocess.run(
+            [sys.executable, str(script), str(par)], env=env,
+            capture_output=True, text=True, timeout=600)
+        assert r1.returncode == 137, (r1.stdout, r1.stderr)
+        assert "LOADED 1" in r1.stdout
+        assert "PUBLISHED" not in r1.stdout
+        env2 = dict(env)
+        env2.pop("PINT_TPU_FAULTS", None)
+        r2 = subprocess.run(
+            [sys.executable, str(script), str(par)], env=env2,
+            capture_output=True, text=True, timeout=600)
+        assert r2.returncode == 0, (r2.stdout, r2.stderr)
+        assert "PUBLISHED 2" in r2.stdout
